@@ -1,0 +1,172 @@
+"""paddle_tpu — a TPU-native deep learning framework with PaddlePaddle's capabilities.
+
+Built from scratch against the blueprint in SURVEY.md (reference:
+ddchenhao66/Paddle, mounted at /root/reference). Not a port: the compute path
+is jax/XLA/Pallas, distribution is GSPMD over jax.sharding meshes, and program
+capture is jax tracing — the reference's phi/PIR/CINN/Fleet stacks are
+re-expressed in those terms. The public namespace mirrors `paddle.*`
+(python/paddle/__init__.py) so reference users can switch.
+"""
+from __future__ import annotations
+
+import jax as _jax
+
+# float64 tensors are part of the paddle API surface; creation ops still
+# default to float32 (TPU-native default). See framework/dtype.py.
+_jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"
+
+# ---- framework primitives ----
+from .framework.dtype import (  # noqa: F401,E402
+    bfloat16,
+    bool_ as bool8,
+    complex64,
+    complex128,
+    convert_dtype,
+    float16,
+    float32,
+    float64,
+    float8_e4m3fn,
+    float8_e5m2,
+    get_default_dtype,
+    int8,
+    int16,
+    int32,
+    int64,
+    set_default_dtype,
+    uint8,
+)
+from .framework.dtype import bool_  # noqa: E402
+
+# paddle calls it paddle.bool
+bool = bool_  # noqa: A001
+
+from .framework.device import (  # noqa: F401,E402
+    CPUPlace,
+    Place,
+    TPUPlace,
+    device_count,
+    get_device,
+    is_compiled_with_tpu,
+    set_device,
+)
+
+def is_compiled_with_cuda():  # paddle API compat: this framework targets TPU
+    return False
+
+def is_compiled_with_xpu():
+    return False
+
+def is_compiled_with_rocm():
+    return False
+
+def is_compiled_with_cinn():
+    return False
+
+def is_compiled_with_distribute():
+    return True
+
+CUDAPlace = TPUPlace  # alias: "the accelerator place"
+
+from .framework.random import get_rng_state, seed, set_rng_state  # noqa: F401,E402
+from .framework.flags import get_flags, set_flags  # noqa: F401,E402
+
+# ---- core tensor + ops (patches Tensor methods on import) ----
+from .core.tensor import Tensor  # noqa: E402
+from . import ops as _ops  # noqa: E402,F401
+
+from .ops.creation import (  # noqa: F401,E402
+    arange,
+    assign,
+    bernoulli,
+    clone,
+    complex,
+    diag,
+    diag_embed,
+    diagflat,
+    diagonal,
+    empty,
+    empty_like,
+    eye,
+    full,
+    full_like,
+    linspace,
+    logspace,
+    meshgrid,
+    multinomial,
+    normal,
+    ones,
+    ones_like,
+    poisson,
+    polar,
+    rand,
+    randint,
+    randint_like,
+    randn,
+    randperm,
+    standard_normal,
+    to_tensor,
+    tril,
+    tril_indices,
+    triu,
+    triu_indices,
+    uniform,
+    zeros,
+    zeros_like,
+)
+from .ops.math import *  # noqa: F401,F403,E402
+from .ops.manipulation import *  # noqa: F401,F403,E402
+from .ops.logic import *  # noqa: F401,F403,E402
+from .ops.search import *  # noqa: F401,F403,E402
+from .ops.linalg import (  # noqa: F401,E402
+    bmm,
+    cdist,
+    cholesky,
+    cholesky_solve,
+    dist,
+    inverse,
+    matmul,
+    mm,
+    mv,
+    norm,
+)
+from .ops.einsum import einsum  # noqa: F401,E402
+
+from . import linalg  # noqa: E402,F401
+from . import autograd  # noqa: E402,F401
+from .autograd import PyLayer, enable_grad, grad, is_grad_enabled, no_grad, set_grad_enabled  # noqa: F401,E402
+
+from . import nn  # noqa: E402,F401
+from . import optimizer  # noqa: E402,F401
+from . import amp  # noqa: E402,F401
+from . import io  # noqa: E402,F401
+from . import jit  # noqa: E402,F401
+from . import static  # noqa: E402,F401
+from . import vision  # noqa: E402,F401
+from . import metric  # noqa: E402,F401
+from .framework.io import load, save  # noqa: E402,F401
+from .jit import to_static  # noqa: E402,F401
+
+
+def disable_static(place=None):
+    """paddle.disable_static — dygraph is the only mode; kept for compat."""
+    return None
+
+
+def enable_static():
+    return None
+
+
+def in_dynamic_mode():
+    return True
+
+
+def get_cudnn_version():
+    return None
+
+
+def device_guard(*args, **kwargs):
+    import contextlib
+
+    return contextlib.nullcontext()

@@ -1,0 +1,222 @@
+"""Public autograd API.
+
+Reference parity: python/paddle/autograd/ (backward/grad in autograd.py,
+PyLayer in py_layer.py, saved_tensors_hooks) over the eager engine
+(paddle/fluid/eager/backward.cc:439 Backward, general_grad.h Grad).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import jax
+from jax import numpy as jnp
+
+from ..core import autograd_engine, state
+from ..core.apply import apply
+from ..core.autograd_engine import Edge, GradNode
+from ..core.state import enable_grad, is_grad_enabled, no_grad, set_grad_enabled_ctx as set_grad_enabled
+from ..core.tensor import Tensor
+
+__all__ = [
+    "backward",
+    "grad",
+    "no_grad",
+    "enable_grad",
+    "set_grad_enabled",
+    "is_grad_enabled",
+    "PyLayer",
+    "PyLayerContext",
+]
+
+
+def backward(tensors: Sequence[Tensor], grad_tensors=None, retain_graph=False):
+    """paddle.autograd.backward."""
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is not None and isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+    autograd_engine.run_backward(tensors, grad_tensors, retain_graph=retain_graph)
+
+
+def grad(
+    outputs,
+    inputs,
+    grad_outputs=None,
+    retain_graph=None,
+    create_graph=False,
+    only_inputs=True,
+    allow_unused=False,
+    no_grad_vars=None,
+):
+    """paddle.grad (python/paddle/autograd/autograd.py; engine general_grad.h)."""
+    outputs = [outputs] if isinstance(outputs, Tensor) else list(outputs)
+    inputs = [inputs] if isinstance(inputs, Tensor) else list(inputs)
+    if grad_outputs is not None and isinstance(grad_outputs, Tensor):
+        grad_outputs = [grad_outputs]
+    if retain_graph is None:
+        retain_graph = create_graph
+    collected: dict = {}
+    no_grad_ids = {id(t) for t in (no_grad_vars or [])}
+
+    def collect(leaf, cot):
+        if id(leaf) in no_grad_ids:
+            return
+        key = id(leaf)
+        if key in collected:
+            collected[key] = collected[key] + cot
+        else:
+            collected[key] = cot
+
+    # non-leaf inputs: watch their (producer node, slot) in the engine
+    watches = {}
+    for t in inputs:
+        if t._grad_node is not None:
+            watches[(t._grad_node, t._out_index)] = id(t)
+
+    def on_watch(key, cot):
+        if key in collected:
+            collected[key] = collected[key] + cot
+        else:
+            collected[key] = cot
+
+    autograd_engine.run_backward(
+        outputs,
+        grad_outputs,
+        retain_graph=retain_graph,
+        accumulate_fn=collect,
+        watches=watches or None,
+        watch_fn=on_watch,
+    )
+    results = []
+    for t in inputs:
+        c = collected.get(id(t))
+        if c is None:
+            if not allow_unused:
+                raise RuntimeError(
+                    "One of the differentiated tensors appears unused in the graph; "
+                    "pass allow_unused=True to return None for it."
+                )
+            results.append(None)
+        else:
+            results.append(Tensor(c, stop_gradient=not create_graph))
+    return results
+
+
+class PyLayerContext:
+    """Analog of paddle.autograd.PyLayerContext (pylayer/py_layer_node.h)."""
+
+    def __init__(self):
+        self._saved = ()
+        self.materialize_grads = True
+        self.not_inplace_tensors = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+    def mark_not_inplace(self, *tensors):
+        self.not_inplace_tensors = tensors
+
+    def set_materialize_grads(self, value: bool):
+        self.materialize_grads = bool(value)
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """User-defined autograd op: subclass with static forward(ctx, ...) and
+    backward(ctx, *grads). Analog of python/paddle/autograd/py_layer.py.
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *args):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        tensor_args = [(i, a) for i, a in enumerate(args) if isinstance(a, Tensor)]
+
+        with no_grad():
+            outputs = cls.forward(ctx, *args, **kwargs)
+
+        single = not isinstance(outputs, (tuple, list))
+        outs = (outputs,) if single else tuple(outputs)
+
+        if not state.is_grad_enabled() or not any(
+            not a.stop_gradient for _, a in tensor_args
+        ):
+            return outputs
+
+        out_avals = [jax.ShapeDtypeStruct(o._value.shape, o._value.dtype) for o in outs]
+
+        diff_inputs = [a for _, a in tensor_args if not a.stop_gradient]
+
+        def vjp_fn(cots):
+            cot_list = [cots] if single else list(cots)
+            cot_tensors = tuple(Tensor(c, stop_gradient=True) for c in cot_list)
+            with no_grad():
+                grads = cls.backward(ctx, *cot_tensors)
+            if isinstance(grads, Tensor):
+                grads = (grads,)
+            elif grads is None:
+                grads = (None,)
+            grads = tuple(grads)
+            if len(grads) != len(diff_inputs):
+                # paddle allows returning one grad per forward tensor input
+                all_t = [a for _, a in tensor_args]
+                if len(grads) == len(all_t):
+                    grads = tuple(g for g, a in zip(grads, all_t) if not a.stop_gradient)
+                else:
+                    raise RuntimeError(
+                        f"{cls.__name__}.backward returned {len(grads)} grads for "
+                        f"{len(diff_inputs)} differentiable inputs"
+                    )
+            return tuple(
+                (g._value if isinstance(g, Tensor) else g) if g is not None else jnp.zeros(t._value.shape, t._value.dtype)
+                for g, t in zip(grads, diff_inputs)
+            )
+
+        edges = []
+        for t in diff_inputs:
+            if t._grad_node is not None:
+                edges.append(Edge(node=t._grad_node, slot=t._out_index))
+            else:
+                edges.append(Edge(leaf=t))
+
+        node = GradNode(f"PyLayer[{cls.__name__}]", vjp_fn, edges, out_avals, single)
+        result = []
+        for i, o in enumerate(outs):
+            t = Tensor(o._value, stop_gradient=False)
+            t._grad_node = node
+            t._out_index = i
+            result.append(t)
+        return result[0] if single else tuple(result)
+
+
+class saved_tensors_hooks:
+    """No-op placeholder matching paddle.autograd.saved_tensors_hooks;
+    jax.vjp owns residuals so pack/unpack hooks do not apply. Kept for API
+    compatibility (python/paddle/autograd/saved_tensors_hooks.py)."""
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False

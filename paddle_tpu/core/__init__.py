@@ -1,0 +1,2 @@
+from . import state  # noqa: F401
+from .tensor import Tensor  # noqa: F401

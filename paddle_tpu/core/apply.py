@@ -1,0 +1,97 @@
+"""Op application: the dispatch + AD-capture hot path.
+
+Reference parity: this is the collapsed analog of the generated *_ad_func
+(paddle/fluid/eager/auto_code_generator/generator/eager_gen.py:433 — AMP cast,
+grad-node capture) + paddle::experimental API dispatch
+(paddle/phi/api/yaml/generator/api_gen.py, kernel_dispatch.h:92). TPU-native
+design: "kernel selection" is jax itself — every op forward is a pure jax
+function; when gradients are required we run it under jax.vjp and record the
+pullback on a GradNode. InferMeta is jax abstract evaluation; data transform /
+device placement is XLA's job.
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+from jax import numpy as jnp
+
+from . import state
+from .autograd_engine import Edge, GradNode
+from .tensor import Tensor
+
+_nan_check_ops = set()
+
+
+def _differentiable(t: Tensor) -> bool:
+    return (not t.stop_gradient) and jnp.issubdtype(jnp.result_type(t._value), jnp.inexact)
+
+
+def apply(name: str, fn: Callable, *args, n_outputs=None, **kwargs):
+    """Run op `fn` over raw values of `args` (Tensors and constants mixed).
+
+    Returns Tensor (single output) or tuple/list of Tensors, wired into the
+    autograd tape when grad is enabled and any input requires grad.
+    """
+    tensor_pos = []
+    raw = []
+    for i, a in enumerate(args):
+        if isinstance(a, Tensor):
+            tensor_pos.append(i)
+            raw.append(a.value)  # records trace reads
+        else:
+            raw.append(a)
+
+    grad_on = state.is_grad_enabled()
+    diff_pos = [i for i in tensor_pos if _differentiable(args[i])] if grad_on else []
+
+    if not diff_pos:
+        out = fn(*raw, **kwargs)
+        return _wrap(out, node=None)
+
+    def pure(*dvals):
+        vals = list(raw)
+        for p, v in zip(diff_pos, dvals):
+            vals[p] = v
+        return fn(*vals, **kwargs)
+
+    primals = [raw[p] for p in diff_pos]
+    out, vjp_fn = jax.vjp(pure, *primals)
+
+    single = not isinstance(out, (tuple, list))
+    outs = (out,) if single else tuple(out)
+    out_avals = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in outs]
+
+    edges = []
+    for p in diff_pos:
+        t = args[p]
+        if t._grad_node is not None:
+            edges.append(Edge(node=t._grad_node, slot=t._out_index))
+        else:
+            edges.append(Edge(leaf=t))
+
+    node = GradNode(name, vjp_fn, edges, out_avals, single)
+    return _wrap(out, node=node)
+
+
+def _wrap(out, node):
+    if isinstance(out, (tuple, list)):
+        res = []
+        for i, o in enumerate(out):
+            t = Tensor(o, stop_gradient=node is None or not jnp.issubdtype(jnp.result_type(o), jnp.inexact))
+            if node is not None and not t.stop_gradient:
+                t._grad_node = node
+                t._out_index = i
+            res.append(t)
+        return tuple(res) if isinstance(out, tuple) else res
+    t = Tensor(out, stop_gradient=node is None or not jnp.issubdtype(jnp.result_type(out), jnp.inexact))
+    if node is not None and not t.stop_gradient:
+        t._grad_node = node
+        t._out_index = 0
+    return t
+
+
+def apply_nograd(name: str, fn: Callable, *args, **kwargs):
+    """Fast path for ops that are never differentiable (comparisons, argmax...)."""
+    raw = [a.value if isinstance(a, Tensor) else a for a in args]
+    return _wrap(fn(*raw, **kwargs), node=None)

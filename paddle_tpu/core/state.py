@@ -1,0 +1,118 @@
+"""Thread-local framework state: grad mode + trace recording hooks.
+
+Reference parity: grad mode ≈ paddle.no_grad (python/paddle/base/dygraph/base.py);
+trace recording is the substrate for to_static program capture (the analog of
+run_program_op state capture, python/paddle/jit/dy2static/partial_program.py).
+"""
+from __future__ import annotations
+
+import functools
+import threading
+
+
+class _TLS(threading.local):
+    def __init__(self):
+        self.grad_enabled = True
+        self.recorder = None  # active StateRecorder during to_static capture
+        self.amp_state = None  # active AMP context (paddle_tpu.amp)
+
+
+_tls = _TLS()
+
+
+def is_grad_enabled() -> bool:
+    return _tls.grad_enabled
+
+
+def set_grad_enabled(mode: bool):
+    _tls.grad_enabled = bool(mode)
+
+
+class no_grad:
+    """paddle.no_grad analog: context manager AND decorator."""
+
+    def __enter__(self):
+        self._prev = _tls.grad_enabled
+        _tls.grad_enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        _tls.grad_enabled = self._prev
+        return False
+
+    def __call__(self, fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with no_grad():
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+
+class enable_grad:
+    def __enter__(self):
+        self._prev = _tls.grad_enabled
+        _tls.grad_enabled = True
+        return self
+
+    def __exit__(self, *exc):
+        _tls.grad_enabled = self._prev
+        return False
+
+    def __call__(self, fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with enable_grad():
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+
+class set_grad_enabled_ctx:
+    def __init__(self, mode: bool):
+        self.mode = bool(mode)
+
+    def __enter__(self):
+        self._prev = _tls.grad_enabled
+        _tls.grad_enabled = self.mode
+        return self
+
+    def __exit__(self, *exc):
+        _tls.grad_enabled = self._prev
+        return False
+
+
+# ---- trace recording (used by paddle_tpu.jit) ----
+
+def get_recorder():
+    return _tls.recorder
+
+
+def set_recorder(rec):
+    prev = _tls.recorder
+    _tls.recorder = rec
+    return prev
+
+
+def record_read(tensor):
+    rec = _tls.recorder
+    if rec is not None:
+        rec.on_read(tensor)
+
+
+def record_write(tensor):
+    rec = _tls.recorder
+    if rec is not None:
+        rec.on_write(tensor)
+
+
+# ---- AMP state (set by paddle_tpu.amp.auto_cast) ----
+
+def get_amp_state():
+    return _tls.amp_state
+
+
+def set_amp_state(st):
+    prev = _tls.amp_state
+    _tls.amp_state = st
+    return prev

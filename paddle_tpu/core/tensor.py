@@ -1,0 +1,339 @@
+"""paddle_tpu.Tensor — eager tensor wrapping a jax.Array.
+
+Reference parity: the public Tensor (paddle/phi/api/include/tensor.h) +
+eager autograd metadata (paddle/fluid/eager/autograd_meta.h) + python method
+patching (python/paddle/base/dygraph/math_op_patch.py,
+tensor_patch_methods.py). TPU-native design: storage IS a jax.Array (host or
+TPU HBM, possibly sharded across a mesh — the DistTensor global view comes for
+free), autograd metadata is (grad_node, out_index), and every method ends in a
+traced-or-eager jax computation.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax import numpy as jnp
+
+from ..framework import dtype as dtype_mod
+from ..framework.device import Place
+from . import state
+
+
+class Tensor:
+    __slots__ = (
+        "_value",
+        "stop_gradient",
+        "grad",
+        "_grad_node",
+        "_out_index",
+        "name",
+        "persistable",
+        "_backward_hooks",
+        "__weakref__",
+    )
+
+    def __init__(self, value, stop_gradient: bool = True, name: Optional[str] = None):
+        if isinstance(value, Tensor):
+            value = value._value
+        if not isinstance(value, (jax.Array, jax.core.Tracer)):
+            value = jnp.asarray(value)
+        self._value = value
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self._grad_node = None
+        self._out_index = 0
+        self.name = name
+        self.persistable = False
+        self._backward_hooks = []
+
+    # ---- raw value access (trace-recorded) ----
+    @property
+    def value(self):
+        state.record_read(self)
+        return self._value
+
+    def _raw(self):
+        """Value access WITHOUT trace recording (engine internals)."""
+        return self._value
+
+    def set_value(self, value):
+        """In-place value replacement (paddle Tensor.set_value). Detaches."""
+        if isinstance(value, Tensor):
+            value = value._value
+        elif not isinstance(value, (jax.Array, jax.core.Tracer)):
+            value = jnp.asarray(value, dtype=self._value.dtype)
+        self._value = value
+        self._grad_node = None
+        self._out_index = 0
+        state.record_write(self)
+        return self
+
+    def _replace_value(self, value):
+        """Functional-update write used by optimizers / in-place ops: keeps
+        autograd detachment semantics of set_value but is the designated
+        mutation point recorded by to_static capture."""
+        self._value = value
+        self._grad_node = None
+        self._out_index = 0
+        state.record_write(self)
+        return self
+
+    def _become(self, other: "Tensor"):
+        """Adopt another tensor's value + autograd node (in-place op result).
+
+        stop_gradient only flips to False when the result carries a grad node;
+        an in-place update under no_grad() must NOT freeze a trainable param.
+        """
+        self._value = other._value
+        self._grad_node = other._grad_node
+        self._out_index = other._out_index
+        if other._grad_node is not None:
+            self.stop_gradient = other.stop_gradient
+        state.record_write(self)
+        return self
+
+    # ---- metadata ----
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    @property
+    def dtype(self):
+        return np.dtype(self._value.dtype)
+
+    @property
+    def size(self):
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def place(self):
+        devs = getattr(self._value, "devices", None)
+        if devs is None or isinstance(self._value, jax.core.Tracer):
+            from ..framework.device import _get_current_place
+
+            return _get_current_place()
+        return Place(sorted(self._value.devices(), key=lambda d: d.id)[0])
+
+    @property
+    def is_leaf(self):
+        return self._grad_node is None
+
+    @property
+    def T(self):
+        from ..ops import manipulation
+
+        return manipulation.transpose(self, list(range(self.ndim))[::-1])
+
+    @property
+    def mT(self):
+        from ..ops import manipulation
+
+        perm = list(range(self.ndim))
+        perm[-1], perm[-2] = perm[-2], perm[-1]
+        return manipulation.transpose(self, perm)
+
+    def numel(self):
+        return self.size
+
+    def dim(self):
+        return self.ndim
+
+    # ---- host interop ----
+    def numpy(self):
+        return np.asarray(self._value)
+
+    def item(self, *args):
+        if args:
+            return self.numpy().item(*args)
+        return self.numpy().item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def __dlpack__(self, **kw):
+        return self._value.__dlpack__(**kw)
+
+    # ---- autograd ----
+    def backward(self, grad_tensor=None, retain_graph: bool = False):
+        from . import autograd_engine
+
+        autograd_engine.run_backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self.grad = None
+
+    clear_gradient = clear_grad
+
+    def register_hook(self, hook):
+        """Gradient hook on a leaf tensor (paddle Tensor.register_hook).
+        Fires when the engine accumulates into this tensor."""
+        self._backward_hooks.append(hook)
+
+        class _Removable:
+            def __init__(self, hooks, h):
+                self._hooks, self._h = hooks, h
+
+            def remove(self):
+                if self._h in self._hooks:
+                    self._hooks.remove(self._h)
+
+        return _Removable(self._backward_hooks, hook)
+
+    def detach(self) -> "Tensor":
+        t = Tensor(self._value, stop_gradient=True)
+        return t
+
+    def detach_(self):
+        self._grad_node = None
+        self._out_index = 0
+        self.stop_gradient = True
+        return self
+
+    def clone(self) -> "Tensor":
+        from .apply import apply
+
+        return apply("clone", lambda x: x + jnp.zeros((), x.dtype), self)
+
+    # ---- device/dtype movement ----
+    def to(self, *args, **kwargs):
+        """paddle Tensor.to: accepts device str/Place, dtype, or both."""
+        device = kwargs.get("device")
+        dtype = kwargs.get("dtype")
+        blocking = kwargs.get("blocking", None)
+        for a in args:
+            if isinstance(a, (Place,)) or (isinstance(a, str) and (":" in a or a in ("cpu", "tpu", "gpu", "xpu"))):
+                device = a
+            elif isinstance(a, bool):
+                blocking = a
+            else:
+                dtype = a
+        out = self
+        if dtype is not None:
+            out = out.astype(dtype)
+        if device is not None:
+            from ..framework.device import _parse_device
+
+            place = _parse_device(device) if isinstance(device, str) else device
+            val = jax.device_put(out._value, place.jax_device)
+            t = Tensor(val, stop_gradient=out.stop_gradient)
+            t._grad_node, t._out_index = out._grad_node, out._out_index
+            out = t
+        if blocking:
+            jax.block_until_ready(out._value)
+        return out
+
+    def cpu(self):
+        return self.to(device="cpu")
+
+    def cuda(self, device_id=0, blocking=True):
+        return self.to(device=f"tpu:{device_id}")  # gpu requests map to the accelerator
+
+    def tpu(self, device_id=0):
+        return self.to(device=f"tpu:{device_id}")
+
+    def pin_memory(self):
+        return self
+
+    def astype(self, dtype):
+        from .apply import apply
+
+        d = dtype_mod.convert_dtype(dtype)
+        return apply("cast", lambda x: x.astype(d), self)
+
+    def cast(self, dtype):
+        return self.astype(dtype)
+
+    # ---- python protocol ----
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-D tensor")
+        return self._value.shape[0]
+
+    def __repr__(self):
+        grad_info = "" if self.stop_gradient else ", stop_gradient=False"
+        try:
+            data = np.array2string(self.numpy(), precision=6, separator=", ", threshold=60)
+        except Exception:
+            data = f"<traced {self._value}>"
+        return (
+            f"Tensor(shape={self.shape}, dtype={self.dtype.name}, "
+            f"place={self.place!r}{grad_info},\n       {data})"
+        )
+
+    def __bool__(self):
+        if self.size != 1:
+            raise ValueError("truth value of a multi-element Tensor is ambiguous")
+        return bool(self.numpy())
+
+    def __int__(self):
+        return int(self.numpy())
+
+    def __float__(self):
+        return float(self.numpy())
+
+    def __index__(self):
+        return int(self.numpy())
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __hash__(self):
+        return id(self)
+
+    def __format__(self, spec):
+        if self.size == 1:
+            return format(self.item(), spec)
+        return repr(self)
+
+    # ---- indexing ----
+    def _normalize_index(self, idx):
+        def conv(i):
+            if isinstance(i, Tensor):
+                return i._value
+            if isinstance(i, (list, np.ndarray)):
+                return jnp.asarray(i)
+            return i
+
+        if isinstance(idx, tuple):
+            return tuple(conv(i) for i in idx)
+        return conv(idx)
+
+    def __getitem__(self, idx):
+        from .apply import apply
+
+        idx = self._normalize_index(idx)
+        return apply("getitem", lambda x: x[idx], self)
+
+    def __setitem__(self, idx, value):
+        from .apply import apply
+
+        idx = self._normalize_index(idx)
+        if isinstance(value, Tensor):
+            new = apply(
+                "setitem",
+                lambda x, v: x.at[idx].set(v.astype(x.dtype) if v.dtype != x.dtype else v),
+                self,
+                value,
+            )
+        else:
+            new = apply("setitem", lambda x: x.at[idx].set(value), self)
+        self._become(new)
+
+    # dunder arithmetic is patched in ops/_patch.py (math_op_patch analog)
+
+
+def _ensure_tensor(x, dtype=None) -> Tensor:
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(jnp.asarray(x, dtype=dtype))

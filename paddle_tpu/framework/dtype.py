@@ -1,0 +1,116 @@
+"""Dtype system for paddle_tpu.
+
+Reference parity: paddle/phi/common/data_type.h (DataType enum) and
+python/paddle/framework/dtype.py. TPU-native design: dtypes are numpy dtype
+objects (what jax uses natively) plus module-level aliases, rather than a
+protobuf enum — XLA consumes numpy dtypes directly.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+import ml_dtypes
+
+# Canonical dtype objects (np.dtype instances — hashable, comparable, jax-native).
+bool_ = np.dtype(np.bool_)
+uint8 = np.dtype(np.uint8)
+int8 = np.dtype(np.int8)
+int16 = np.dtype(np.int16)
+int32 = np.dtype(np.int32)
+int64 = np.dtype(np.int64)
+float16 = np.dtype(np.float16)
+bfloat16 = np.dtype(ml_dtypes.bfloat16)
+float32 = np.dtype(np.float32)
+float64 = np.dtype(np.float64)
+complex64 = np.dtype(np.complex64)
+complex128 = np.dtype(np.complex128)
+float8_e4m3fn = np.dtype(ml_dtypes.float8_e4m3fn)
+float8_e5m2 = np.dtype(ml_dtypes.float8_e5m2)
+
+_ALIASES = {
+    "bool": bool_,
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "fp16": float16,
+    "half": float16,
+    "bfloat16": bfloat16,
+    "bf16": bfloat16,
+    "float32": float32,
+    "fp32": float32,
+    "float": float32,
+    "float64": float64,
+    "fp64": float64,
+    "double": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+    "float8_e4m3fn": float8_e4m3fn,
+    "float8_e5m2": float8_e5m2,
+}
+
+_default_dtype = float32
+
+
+def convert_dtype(dtype) -> np.dtype:
+    """Normalize a user-facing dtype spec (str / np.dtype / python type) to np.dtype.
+
+    Analog of paddle.base.data_feeder.convert_dtype.
+    """
+    if dtype is None:
+        raise ValueError("dtype must not be None")
+    if isinstance(dtype, np.dtype):
+        return dtype
+    if isinstance(dtype, str):
+        if dtype in _ALIASES:
+            return _ALIASES[dtype]
+        return np.dtype(dtype)
+    # python builtin types / numpy scalar types / jnp dtypes
+    if dtype is bool:
+        return bool_
+    if dtype is int:
+        return int64
+    if dtype is float:
+        return _default_dtype
+    if dtype is complex:
+        return complex64
+    return np.dtype(dtype)
+
+
+def set_default_dtype(d):
+    """paddle.set_default_dtype analog (python/paddle/framework/framework.py)."""
+    global _default_dtype
+    d = convert_dtype(d)
+    if d not in (float16, bfloat16, float32, float64):
+        raise TypeError(
+            f"set_default_dtype only supports float16/bfloat16/float32/float64, got {d}"
+        )
+    _default_dtype = d
+
+
+def get_default_dtype() -> np.dtype:
+    return _default_dtype
+
+
+def is_floating_point_dtype(d) -> bool:
+    return jnp.issubdtype(convert_dtype(d), jnp.floating)
+
+
+def is_integer_dtype(d) -> bool:
+    return jnp.issubdtype(convert_dtype(d), jnp.integer) or convert_dtype(d) == bool_
+
+
+def is_complex_dtype(d) -> bool:
+    return jnp.issubdtype(convert_dtype(d), jnp.complexfloating)
+
+
+def is_differentiable_dtype(d) -> bool:
+    """Gradients only flow through inexact (float/complex) dtypes."""
+    d = convert_dtype(d)
+    return jnp.issubdtype(d, jnp.inexact)
+
+
+def promote_types(a, b) -> np.dtype:
+    return np.dtype(jnp.promote_types(convert_dtype(a), convert_dtype(b)))

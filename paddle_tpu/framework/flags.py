@@ -1,0 +1,66 @@
+"""Global flags registry.
+
+Reference parity: paddle/common/flags.cc (141 PHI_DEFINE_EXPORTED_* flags) +
+python/paddle/base/framework.py set_flags/get_flags. TPU-native design: a
+plain python registry seeded from FLAGS_* environment variables; flags that
+map to XLA behavior translate into jax config updates where applicable.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+_lock = threading.Lock()
+_registry: dict = {}
+_meta: dict = {}
+
+
+def define_flag(name: str, default, doc: str = ""):
+    """Analog of PHI_DEFINE_EXPORTED_* (paddle/common/flags.h:38)."""
+    with _lock:
+        if name in _registry:
+            return
+        env = os.environ.get(name)
+        value = default
+        if env is not None:
+            if isinstance(default, bool):
+                value = env.lower() in ("1", "true", "yes", "on")
+            elif isinstance(default, int):
+                value = int(env)
+            elif isinstance(default, float):
+                value = float(env)
+            else:
+                value = env
+        _registry[name] = value
+        _meta[name] = doc
+
+
+def set_flags(flags: dict):
+    """paddle.set_flags analog."""
+    with _lock:
+        for k, v in flags.items():
+            if k not in _registry:
+                raise KeyError(f"unknown flag {k!r}; define_flag it first")
+            _registry[k] = v
+
+
+def get_flags(flags):
+    """paddle.get_flags analog; accepts str or list of str."""
+    if isinstance(flags, str):
+        flags = [flags]
+    with _lock:
+        return {k: _registry[k] for k in flags}
+
+
+def get_flag(name: str):
+    with _lock:
+        return _registry[name]
+
+
+# Core flags (subset of paddle/common/flags.cc that is meaningful on TPU).
+define_flag("FLAGS_check_nan_inf", False, "scan op outputs for nan/inf (debug)")
+define_flag("FLAGS_use_bf16_default", False, "prefer bfloat16 in AMP on TPU")
+define_flag("FLAGS_jit_guard_shapes", True, "retrace to_static programs on input shape change")
+define_flag("FLAGS_eager_delete_tensor_gb", 0.0, "no-op on TPU; XLA owns HBM")
+define_flag("FLAGS_log_level", 0, "framework verbosity")
+define_flag("FLAGS_benchmark", False, "block_until_ready after each op (timing)")

@@ -1,0 +1,4 @@
+from . import creation, einsum, linalg, logic, manipulation, math, search  # noqa: F401
+from ._patch import patch_tensor
+
+patch_tensor()

@@ -1,0 +1,120 @@
+"""Patch Tensor with operator dunders and method forms of the op library.
+
+Reference parity: python/paddle/base/dygraph/math_op_patch.py +
+tensor_patch_methods.py (monkey-patch the eager Tensor with python methods).
+"""
+from __future__ import annotations
+
+from ..core.tensor import Tensor
+from . import creation, einsum as einsum_mod, linalg, logic, manipulation, math, search
+
+
+def _method(fn):
+    def m(self, *args, **kwargs):
+        return fn(self, *args, **kwargs)
+
+    m.__name__ = fn.__name__
+    return m
+
+
+def _rmethod(fn):
+    def m(self, other):
+        return fn(other, self)
+
+    return m
+
+
+def patch_tensor():
+    T = Tensor
+    # arithmetic dunders
+    T.__add__ = _method(math.add)
+    T.__radd__ = _rmethod(math.add)
+    T.__sub__ = _method(math.subtract)
+    T.__rsub__ = _rmethod(math.subtract)
+    T.__mul__ = _method(math.multiply)
+    T.__rmul__ = _rmethod(math.multiply)
+    T.__truediv__ = _method(math.divide)
+    T.__rtruediv__ = _rmethod(math.divide)
+    T.__floordiv__ = _method(math.floor_divide)
+    T.__rfloordiv__ = _rmethod(math.floor_divide)
+    T.__mod__ = _method(math.mod)
+    T.__rmod__ = _rmethod(math.mod)
+    T.__pow__ = _method(math.pow)
+    T.__rpow__ = _rmethod(math.pow)
+    T.__matmul__ = _method(linalg.matmul)
+    T.__rmatmul__ = _rmethod(linalg.matmul)
+    T.__neg__ = _method(math.neg)
+    T.__abs__ = _method(math.abs)
+    T.__invert__ = _method(logic.bitwise_not)
+    T.__and__ = _method(logic.bitwise_and)
+    T.__or__ = _method(logic.bitwise_or)
+    T.__xor__ = _method(logic.bitwise_xor)
+    T.__lshift__ = _method(logic.bitwise_left_shift)
+    T.__rshift__ = _method(logic.bitwise_right_shift)
+    # comparisons
+    T.__eq__ = _method(logic.equal)
+    T.__ne__ = _method(logic.not_equal)
+    T.__lt__ = _method(logic.less_than)
+    T.__le__ = _method(logic.less_equal)
+    T.__gt__ = _method(logic.greater_than)
+    T.__ge__ = _method(logic.greater_equal)
+
+    # method forms
+    for mod in (math, manipulation, linalg, search, logic):
+        for name in dir(mod):
+            if name.startswith("_"):
+                continue
+            fn = getattr(mod, name)
+            if not callable(fn) or isinstance(fn, type):
+                continue
+            if not hasattr(T, name):
+                setattr(T, name, _method(fn))
+
+    # paddle inplace-suffixed methods (functional under the hood, then _become)
+    def _inplace(fn):
+        def m(self, *args, **kwargs):
+            self._become(fn(self, *args, **kwargs))
+            return self
+
+        return m
+
+    for name, fn in [
+        ("add_", math.add),
+        ("subtract_", math.subtract),
+        ("multiply_", math.multiply),
+        ("divide_", math.divide),
+        ("scale_", math.scale),
+        ("clip_", math.clip),
+        ("exp_", math.exp),
+        ("sqrt_", math.sqrt),
+        ("rsqrt_", math.rsqrt),
+        ("abs_", math.abs),
+        ("ceil_", math.ceil),
+        ("floor_", math.floor),
+        ("round_", math.round),
+        ("reciprocal_", math.reciprocal),
+        ("tanh_", math.tanh),
+        ("cast_", manipulation.cast),
+        ("flatten_", manipulation.flatten),
+        ("fill_", lambda self, v: creation.full_like(self, v)),
+        ("zero_", lambda self: creation.zeros_like(self)),
+    ]:
+        setattr(T, name, _inplace(fn))
+
+    T.mean = _method(math.mean)
+    T.sum = _method(math.sum)
+    T.max = _method(math.max)
+    T.min = _method(math.min)
+    T.item = T.item  # keep
+
+    # uniform_ for initializers
+    def uniform_(self, min=-1.0, max=1.0, seed=0):
+        self.set_value(creation.uniform(self.shape, dtype=self.dtype, min=min, max=max)._value)
+        return self
+
+    def normal_(self, mean=0.0, std=1.0):
+        self.set_value(creation.normal(mean, std, self.shape)._value.astype(self._value.dtype))
+        return self
+
+    T.uniform_ = uniform_
+    T.normal_ = normal_

@@ -1,0 +1,328 @@
+"""Tensor creation ops.
+
+Reference parity: python/paddle/tensor/creation.py (to_tensor, zeros, ones,
+full, arange, linspace, eye, tril, triu, meshgrid, ...). Kernels are jnp —
+XLA materializes constants on device.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax import numpy as jnp
+
+from ..core.apply import apply
+from ..core.tensor import Tensor, _ensure_tensor
+from ..framework import dtype as dtype_mod
+from ..framework import random as random_mod
+
+
+def _dt(dtype, default=None):
+    if dtype is None:
+        return default
+    return dtype_mod.convert_dtype(dtype)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True) -> Tensor:
+    """paddle.to_tensor (python/paddle/tensor/creation.py)."""
+    if isinstance(data, Tensor):
+        val = data._value
+        if dtype is not None:
+            val = val.astype(dtype_mod.convert_dtype(dtype))
+        t = Tensor(val, stop_gradient=stop_gradient)
+    elif isinstance(data, (jax.Array, jax.core.Tracer)):
+        val = data if dtype is None else data.astype(dtype_mod.convert_dtype(dtype))
+        t = Tensor(val, stop_gradient=stop_gradient)
+    else:
+        if dtype is None:
+            a = np.asarray(data)
+            if a.dtype == np.float64:
+                a = a.astype(dtype_mod.get_default_dtype())
+            val = jnp.asarray(a)
+        else:
+            val = jnp.asarray(data, dtype=dtype_mod.convert_dtype(dtype))
+        t = Tensor(val, stop_gradient=stop_gradient)
+    if place is not None:
+        t = t.to(device=place)
+        t.stop_gradient = stop_gradient
+    return t
+
+
+def _shape_list(shape):
+    if isinstance(shape, Tensor):
+        return [int(s) for s in shape.numpy().tolist()]
+    if isinstance(shape, (int, np.integer)):
+        return [int(shape)]
+    return [int(s) if not isinstance(s, Tensor) else int(s.numpy()) for s in shape]
+
+
+def zeros(shape, dtype=None) -> Tensor:
+    return Tensor(jnp.zeros(_shape_list(shape), _dt(dtype, dtype_mod.get_default_dtype())))
+
+
+def ones(shape, dtype=None) -> Tensor:
+    return Tensor(jnp.ones(_shape_list(shape), _dt(dtype, dtype_mod.get_default_dtype())))
+
+
+def full(shape, fill_value, dtype=None) -> Tensor:
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        if isinstance(fill_value, bool):
+            dtype = dtype_mod.bool_
+        elif isinstance(fill_value, int):
+            dtype = dtype_mod.int64
+        else:
+            dtype = dtype_mod.get_default_dtype()
+    return Tensor(jnp.full(_shape_list(shape), fill_value, _dt(dtype)))
+
+
+def empty(shape, dtype=None) -> Tensor:
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None) -> Tensor:
+    x = _ensure_tensor(x)
+    return Tensor(jnp.zeros(x._value.shape, _dt(dtype, x._value.dtype)))
+
+
+def ones_like(x, dtype=None) -> Tensor:
+    x = _ensure_tensor(x)
+    return Tensor(jnp.ones(x._value.shape, _dt(dtype, x._value.dtype)))
+
+
+def full_like(x, fill_value, dtype=None) -> Tensor:
+    x = _ensure_tensor(x)
+    return Tensor(jnp.full(x._value.shape, fill_value, _dt(dtype, x._value.dtype)))
+
+
+def empty_like(x, dtype=None) -> Tensor:
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None) -> Tensor:
+    def _scalar(v):
+        return v.item() if isinstance(v, Tensor) else v
+
+    start, end, step = _scalar(start), _scalar(end), _scalar(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        vals = (start, end, step)
+        dtype = dtype_mod.int64 if all(isinstance(v, (int, np.integer)) for v in vals) else dtype_mod.get_default_dtype()
+    return Tensor(jnp.arange(start, end, step, dtype=_dt(dtype)))
+
+
+def linspace(start, stop, num, dtype=None) -> Tensor:
+    def _scalar(v):
+        return v.item() if isinstance(v, Tensor) else v
+
+    return Tensor(
+        jnp.linspace(_scalar(start), _scalar(stop), int(_scalar(num)), dtype=_dt(dtype, dtype_mod.get_default_dtype()))
+    )
+
+
+def logspace(start, stop, num, base=10.0, dtype=None) -> Tensor:
+    return Tensor(jnp.logspace(start, stop, int(num), base=base, dtype=_dt(dtype, dtype_mod.get_default_dtype())))
+
+
+def eye(num_rows, num_columns=None, dtype=None) -> Tensor:
+    return Tensor(jnp.eye(int(num_rows), None if num_columns is None else int(num_columns), dtype=_dt(dtype, dtype_mod.get_default_dtype())))
+
+
+def diag(x, offset=0, padding_value=0) -> Tensor:
+    x = _ensure_tensor(x)
+
+    def f(v):
+        if v.ndim == 1:
+            out = jnp.diag(v, k=offset)
+            if padding_value != 0:
+                mask = jnp.eye(out.shape[0], out.shape[1], k=offset, dtype=bool)
+                out = jnp.where(mask, out, jnp.asarray(padding_value, out.dtype))
+            return out
+        return jnp.diagonal(v, offset=offset)
+
+    return apply("diag", f, x)
+
+
+def diagflat(x, offset=0) -> Tensor:
+    x = _ensure_tensor(x)
+    return apply("diagflat", lambda v: jnp.diagflat(v, k=offset), x)
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1) -> Tensor:
+    x = _ensure_tensor(x)
+
+    def f(v):
+        n = v.shape[-1] + abs(offset)
+        out = jnp.zeros(v.shape[:-1] + (n, n), v.dtype)
+        idx = jnp.arange(v.shape[-1])
+        r = idx + max(-offset, 0)
+        c = idx + max(offset, 0)
+        out = out.at[..., r, c].set(v)
+        if (dim1, dim2) != (-2, -1):
+            out = jnp.moveaxis(out, (-2, -1), (dim1, dim2))
+        return out
+
+    return apply("diag_embed", f, x)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1) -> Tensor:
+    x = _ensure_tensor(x)
+    return apply("diagonal", lambda v: jnp.diagonal(v, offset=offset, axis1=axis1, axis2=axis2), x)
+
+
+def tril(x, diagonal=0) -> Tensor:
+    x = _ensure_tensor(x)
+    return apply("tril", lambda v: jnp.tril(v, k=diagonal), x)
+
+
+def triu(x, diagonal=0) -> Tensor:
+    x = _ensure_tensor(x)
+    return apply("triu", lambda v: jnp.triu(v, k=diagonal), x)
+
+
+def tril_indices(row, col, offset=0, dtype=dtype_mod.int64):
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=_dt(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype=dtype_mod.int64):
+    r, c = np.triu_indices(row, offset, col if col is not None else row)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=_dt(dtype)))
+
+
+def meshgrid(*args):
+    ts = [_ensure_tensor(a) for a in (args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args)]
+    outs = jnp.meshgrid(*[t.value for t in ts], indexing="ij")
+    return [Tensor(o) for o in outs]
+
+
+def assign(x, output=None) -> Tensor:
+    x = to_tensor(x) if not isinstance(x, Tensor) else x
+    out = Tensor(x.value)
+    if output is not None:
+        output._become(out)
+        return output
+    return out
+
+
+def clone(x) -> Tensor:
+    return _ensure_tensor(x).clone()
+
+
+def complex(real, imag) -> Tensor:
+    return apply("complex", lambda r, i: jax.lax.complex(r, i), _ensure_tensor(real), _ensure_tensor(imag))
+
+
+def polar(abs_t, angle) -> Tensor:
+    return apply(
+        "polar",
+        lambda a, th: jax.lax.complex(a * jnp.cos(th), a * jnp.sin(th)),
+        _ensure_tensor(abs_t),
+        _ensure_tensor(angle),
+    )
+
+
+def clone_detached(x) -> Tensor:
+    return Tensor(_ensure_tensor(x)._value)
+
+
+# ---- random creation (python/paddle/tensor/random.py) ----
+
+def _key():
+    return random_mod.next_key()
+
+
+def rand(shape, dtype=None) -> Tensor:
+    return uniform(shape, dtype=dtype, min=0.0, max=1.0)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0) -> Tensor:
+    d = _dt(dtype, dtype_mod.get_default_dtype())
+    return Tensor(jax.random.uniform(_key(), _shape_list(shape), dtype=jnp.float32, minval=min, maxval=max).astype(d))
+
+
+def randn(shape, dtype=None) -> Tensor:
+    d = _dt(dtype, dtype_mod.get_default_dtype())
+    return Tensor(jax.random.normal(_key(), _shape_list(shape), dtype=jnp.float32).astype(d))
+
+
+def standard_normal(shape, dtype=None) -> Tensor:
+    return randn(shape, dtype)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None) -> Tensor:
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = _ensure_tensor(mean).value
+        s = _ensure_tensor(std).value
+        shp = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        return Tensor(jax.random.normal(_key(), shp, dtype=jnp.float32) * s + m)
+    if shape is None:
+        shape = [1]
+    return Tensor(jax.random.normal(_key(), _shape_list(shape), dtype=jnp.float32) * std + mean)
+
+
+def randint(low=0, high=None, shape=(1,), dtype=dtype_mod.int64) -> Tensor:
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(_key(), _shape_list(shape), low, high, dtype=_dt(dtype)))
+
+
+def randint_like(x, low=0, high=None, dtype=None) -> Tensor:
+    x = _ensure_tensor(x)
+    if high is None:
+        low, high = 0, low
+    d = _dt(dtype, x.dtype)
+    return Tensor(jax.random.randint(_key(), x._value.shape, low, high).astype(d))
+
+
+def randperm(n, dtype=dtype_mod.int64) -> Tensor:
+    return Tensor(jax.random.permutation(_key(), int(n)).astype(_dt(dtype)))
+
+
+def bernoulli(x) -> Tensor:
+    x = _ensure_tensor(x)
+    return Tensor(jax.random.bernoulli(_key(), x.value.astype(jnp.float32)).astype(x._value.dtype))
+
+
+def poisson(x) -> Tensor:
+    x = _ensure_tensor(x)
+    return Tensor(jax.random.poisson(_key(), x.value.astype(jnp.float32)).astype(x._value.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False) -> Tensor:
+    x = _ensure_tensor(x)
+    v = x.value
+    if v.ndim == 1:
+        v = v[None]
+        squeeze = True
+    else:
+        squeeze = False
+    logits = jnp.log(jnp.maximum(v, 1e-30))
+    if replacement:
+        out = jax.random.categorical(_key(), logits, axis=-1, shape=(v.shape[0], num_samples) if num_samples else None)
+        out = out.reshape(v.shape[0], num_samples)
+    else:
+        # Gumbel top-k trick for sampling without replacement
+        g = jax.random.gumbel(_key(), v.shape, dtype=logits.dtype)
+        out = jnp.argsort(-(logits + g), axis=-1)[:, :num_samples]
+    out = out.astype(jnp.int64)
+    if squeeze:
+        out = out[0]
+    return Tensor(out)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1) -> Tensor:
+    x = _ensure_tensor(x)
+    g = jax.random.gumbel(_key(), x._value.shape, dtype=jnp.float32)
+
+    def f(v):
+        y = jax.nn.softmax((v + g.astype(v.dtype)) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y).at[...].set(0.0)
+            y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis) if hasattr(jnp, "put_along_axis") else y_hard.at[idx].set(1.0)
+            y = jax.lax.stop_gradient(y_hard - y) + y
+        return y
+
+    return apply("gumbel_softmax", f, x)

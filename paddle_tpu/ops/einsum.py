@@ -1,0 +1,17 @@
+"""Einsum.
+
+Reference parity: python/paddle/tensor/einsum.py (Paddle hand-rolls planning;
+here XLA's dot_general fusion does the planning — jnp.einsum maps directly to
+MXU contractions).
+"""
+from __future__ import annotations
+
+from jax import numpy as jnp
+
+from ..core.apply import apply
+from ..core.tensor import _ensure_tensor
+
+
+def einsum(equation, *operands):
+    ts = [_ensure_tensor(o) for o in operands]
+    return apply("einsum", lambda *vs: jnp.einsum(equation, *vs), *ts)

@@ -1,0 +1,257 @@
+"""Linear algebra ops.
+
+Reference parity: python/paddle/tensor/linalg.py (+ paddle.linalg namespace).
+Kernels: jnp.linalg / lax.linalg — XLA lowers these to MXU-friendly routines.
+"""
+from __future__ import annotations
+
+import jax
+from jax import numpy as jnp
+
+from ..core.apply import apply, apply_nograd
+from ..core.tensor import Tensor, _ensure_tensor
+
+
+def _t(x):
+    return _ensure_tensor(x)
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def f(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+
+    return apply("matmul", f, _t(x), _t(y))
+
+
+def mm(x, y):
+    return matmul(x, y)
+
+
+def bmm(x, y):
+    return apply("bmm", jnp.matmul, _t(x), _t(y))
+
+
+def mv(x, vec):
+    return apply("mv", jnp.matmul, _t(x), _t(vec))
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    x = _t(x)
+
+    def f(v):
+        if p is None or p == "fro":
+            if axis is None:
+                return jnp.sqrt(jnp.sum(jnp.square(v)))
+            return jnp.linalg.norm(v, ord=None, axis=tuple(axis) if isinstance(axis, (list, tuple)) else axis, keepdims=keepdim)
+        if p == "nuc":
+            return jnp.linalg.norm(v, ord="nuc", axis=tuple(axis), keepdims=keepdim)
+        if p == float("inf"):
+            ord_ = jnp.inf
+        elif p == float("-inf"):
+            ord_ = -jnp.inf
+        else:
+            ord_ = p
+        if axis is None:
+            return jnp.linalg.norm(v.reshape(-1), ord=ord_, keepdims=False)
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        return jnp.linalg.norm(v, ord=ord_, axis=ax, keepdims=keepdim)
+
+    return apply("norm", f, x)
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False):
+    def f(v):
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        if ax is None:
+            v = v.reshape(-1)
+            ax = 0
+        return jnp.linalg.vector_norm(v, ord=p, axis=ax, keepdims=keepdim)
+
+    return apply("vector_norm", f, _t(x))
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False):
+    return apply("matrix_norm", lambda v: jnp.linalg.matrix_norm(v, ord=p, keepdims=keepdim), _t(x))
+
+
+def dist(x, y, p=2.0):
+    def f(a, b):
+        d = (a - b).reshape(-1)
+        if p == 0:
+            return jnp.sum(d != 0).astype(a.dtype)
+        if p == float("inf"):
+            return jnp.max(jnp.abs(d))
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(d))
+        return jnp.sum(jnp.abs(d) ** p) ** (1.0 / p)
+
+    return apply("dist", f, _t(x), _t(y))
+
+
+def cdist(x, y, p=2.0):
+    def f(a, b):
+        d = a[..., :, None, :] - b[..., None, :, :]
+        if p == float("inf"):
+            return jnp.max(jnp.abs(d), axis=-1)
+        return jnp.sum(jnp.abs(d) ** p, axis=-1) ** (1.0 / p)
+
+    return apply("cdist", f, _t(x), _t(y))
+
+
+def cholesky(x, upper=False, name=None):
+    def f(v):
+        L = jnp.linalg.cholesky(v)
+        return jnp.swapaxes(L, -1, -2).conj() if upper else L
+
+    return apply("cholesky", f, _t(x))
+
+
+def cholesky_solve(x, y, upper=False):
+    def f(b, chol):
+        c = jnp.swapaxes(chol, -1, -2).conj() if upper else chol
+        z = jax.scipy.linalg.solve_triangular(c, b, lower=True)
+        return jax.scipy.linalg.solve_triangular(jnp.swapaxes(c, -1, -2).conj(), z, lower=False)
+
+    return apply("cholesky_solve", f, _t(x), _t(y))
+
+
+def qr(x, mode="reduced"):
+    outs = apply("qr", lambda v: tuple(jnp.linalg.qr(v, mode=mode)), _t(x))
+    return outs if isinstance(outs, tuple) else (outs,)
+
+
+def svd(x, full_matrices=False):
+    return apply("svd", lambda v: tuple(jnp.linalg.svd(v, full_matrices=full_matrices)), _t(x))
+
+
+def svdvals(x):
+    return apply("svdvals", lambda v: jnp.linalg.svd(v, compute_uv=False), _t(x))
+
+
+def eig(x):
+    x = _t(x)
+    import numpy as np
+
+    w, v = np.linalg.eig(np.asarray(x.value))  # CPU fallback; XLA has no general eig on TPU
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+
+def eigvals(x):
+    import numpy as np
+
+    return Tensor(jnp.asarray(np.linalg.eigvals(np.asarray(_t(x).value))))
+
+
+def eigh(x, UPLO="L"):
+    return apply("eigh", lambda v: tuple(jnp.linalg.eigh(v, symmetrize_input=True)), _t(x))
+
+
+def eigvalsh(x, UPLO="L"):
+    return apply("eigvalsh", lambda v: jnp.linalg.eigvalsh(v), _t(x))
+
+
+def inv(x, name=None):
+    return apply("inv", jnp.linalg.inv, _t(x))
+
+
+inverse = inv
+
+
+def pinv(x, rcond=1e-15, hermitian=False):
+    return apply("pinv", lambda v: jnp.linalg.pinv(v, rtol=rcond, hermitian=hermitian), _t(x))
+
+
+def det(x):
+    return apply("det", jnp.linalg.det, _t(x))
+
+
+def slogdet(x):
+    return apply("slogdet", lambda v: tuple(jnp.linalg.slogdet(v)), _t(x))
+
+
+def solve(x, y, name=None):
+    def f(a, b):
+        if b.ndim == a.ndim - 1:
+            return jnp.linalg.solve(a, b[..., None])[..., 0]
+        return jnp.linalg.solve(a, b)
+
+    return apply("solve", f, _t(x), _t(y))
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False):
+    def f(a, b):
+        aa = jnp.swapaxes(a, -1, -2) if transpose else a
+        return jax.scipy.linalg.solve_triangular(aa, b, lower=not upper if not transpose else upper, unit_diagonal=unitriangular)
+
+    return apply("triangular_solve", f, _t(x), _t(y))
+
+
+def lstsq(x, y, rcond=None, driver=None):
+    def f(a, b):
+        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return (sol, res, rank.astype(jnp.int64), sv)
+
+    return apply("lstsq", f, _t(x), _t(y))
+
+
+def lu(x, pivot=True):
+    def f(v):
+        lu_, piv = jax.scipy.linalg.lu_factor(v)
+        return (lu_, (piv + 1).astype(jnp.int32))
+
+    return apply("lu", f, _t(x))
+
+
+def matrix_power(x, n, name=None):
+    return apply("matrix_power", lambda v: jnp.linalg.matrix_power(v, n), _t(x))
+
+
+def matrix_rank(x, tol=None, hermitian=False):
+    tl = tol.value if isinstance(tol, Tensor) else tol
+    return apply_nograd("matrix_rank", lambda v: jnp.linalg.matrix_rank(v, rtol=tl).astype(jnp.int64), _t(x))
+
+
+def cond(x, p=None):
+    return apply("cond", lambda v: jnp.linalg.cond(v, p=p), _t(x))
+
+
+def multi_dot(xs):
+    ts = [_t(x) for x in xs]
+    return apply("multi_dot", lambda *vs: jnp.linalg.multi_dot(list(vs)), *ts)
+
+
+def corrcoef(x, rowvar=True):
+    return apply("corrcoef", lambda v: jnp.corrcoef(v, rowvar=rowvar), _t(x))
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None):
+    fw = _t(fweights).value if fweights is not None else None
+    aw = _t(aweights).value if aweights is not None else None
+    return apply("cov", lambda v: jnp.cov(v, rowvar=rowvar, ddof=1 if ddof else 0, fweights=fw, aweights=aw), _t(x))
+
+
+def householder_product(x, tau):
+    def f(a, t):
+        return jax.lax.linalg.householder_product(a, t)
+
+    return apply("householder_product", f, _t(x), _t(tau))
+
+
+def matrix_exp(x):
+    return apply("matrix_exp", jax.scipy.linalg.expm, _t(x))
+
+
+def pca_lowrank(x, q=None, center=True, niter=2):
+    x = _t(x)
+
+    def f(v):
+        k = q if q is not None else min(6, *v.shape[-2:])
+        vv = v - jnp.mean(v, axis=-2, keepdims=True) if center else v
+        u, s, vt = jnp.linalg.svd(vv, full_matrices=False)
+        return (u[..., :k], s[..., :k], jnp.swapaxes(vt, -1, -2)[..., :k])
+
+    return apply("pca_lowrank", f, x)

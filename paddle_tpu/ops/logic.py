@@ -1,0 +1,46 @@
+"""Comparison / logical / bitwise ops.
+
+Reference parity: python/paddle/tensor/logic.py. All non-differentiable.
+"""
+from __future__ import annotations
+
+from jax import numpy as jnp
+
+from ..core.apply import apply_nograd
+from ..core.tensor import Tensor, _ensure_tensor
+from .math import _binary_promote
+
+
+def _cmp(opname, fn):
+    def op(x, y, name=None):
+        x, y = _binary_promote(x, y)
+        return apply_nograd(opname, fn, x, y)
+
+    op.__name__ = opname
+    return op
+
+
+equal = _cmp("equal", jnp.equal)
+not_equal = _cmp("not_equal", jnp.not_equal)
+greater_than = _cmp("greater_than", jnp.greater)
+greater_equal = _cmp("greater_equal", jnp.greater_equal)
+less_than = _cmp("less_than", jnp.less)
+less_equal = _cmp("less_equal", jnp.less_equal)
+
+logical_and = _cmp("logical_and", jnp.logical_and)
+logical_or = _cmp("logical_or", jnp.logical_or)
+logical_xor = _cmp("logical_xor", jnp.logical_xor)
+
+bitwise_and = _cmp("bitwise_and", jnp.bitwise_and)
+bitwise_or = _cmp("bitwise_or", jnp.bitwise_or)
+bitwise_xor = _cmp("bitwise_xor", jnp.bitwise_xor)
+bitwise_left_shift = _cmp("bitwise_left_shift", jnp.left_shift)
+bitwise_right_shift = _cmp("bitwise_right_shift", jnp.right_shift)
+
+
+def logical_not(x, name=None):
+    return apply_nograd("logical_not", jnp.logical_not, _ensure_tensor(x))
+
+
+def bitwise_not(x, name=None):
+    return apply_nograd("bitwise_not", jnp.bitwise_not, _ensure_tensor(x))

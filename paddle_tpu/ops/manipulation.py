@@ -1,0 +1,609 @@
+"""Shape/layout manipulation ops.
+
+Reference parity: python/paddle/tensor/manipulation.py. All static-shape —
+XLA requires static shapes, so shape args are resolved to python ints at
+trace time (the PIR dynamic-shape path has no TPU analog by design).
+"""
+from __future__ import annotations
+
+import builtins
+import numpy as np
+import jax
+from jax import numpy as jnp
+
+from ..core.apply import apply, apply_nograd
+from ..core.tensor import Tensor, _ensure_tensor
+from ..framework import dtype as dtype_mod
+
+
+def _t(x):
+    return _ensure_tensor(x)
+
+
+def _static_shape(shape):
+    if isinstance(shape, Tensor):
+        return [int(s) for s in shape.numpy().tolist()]
+    out = []
+    for s in shape:
+        out.append(int(s.numpy()) if isinstance(s, Tensor) else int(s))
+    return out
+
+
+def reshape(x, shape, name=None):
+    x = _t(x)
+    shp = _static_shape(shape)
+    # paddle semantics: 0 means "copy this dim from input"
+    shp = [x._value.shape[i] if s == 0 else s for i, s in enumerate(shp)] if 0 in shp else shp
+    return apply("reshape", lambda v: jnp.reshape(v, shp), x)
+
+
+def reshape_(x, shape, name=None):
+    x._become(reshape(x, shape))
+    return x
+
+
+def transpose(x, perm, name=None):
+    return apply("transpose", lambda v: jnp.transpose(v, perm), _t(x))
+
+
+def moveaxis(x, source, destination):
+    return apply("moveaxis", lambda v: jnp.moveaxis(v, source, destination), _t(x))
+
+
+def swapaxes(x, axis0, axis1):
+    return apply("swapaxes", lambda v: jnp.swapaxes(v, axis0, axis1), _t(x))
+
+
+transpose_ = swapaxes
+
+
+def t(x):
+    x = _t(x)
+    if x.ndim < 2:
+        return apply("t", lambda v: v, x)
+    return apply("t", lambda v: v.T, x)
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    x = _t(x)
+
+    def f(v):
+        nd = v.ndim
+        s = start_axis % nd if nd else 0
+        e = stop_axis % nd if nd else 0
+        newshape = v.shape[:s] + (-1,) + v.shape[e + 1:]
+        return jnp.reshape(v, newshape)
+
+    return apply("flatten", f, x)
+
+
+def squeeze(x, axis=None, name=None):
+    x = _t(x)
+
+    def f(v):
+        if axis is None:
+            return jnp.squeeze(v)
+        ax = axis if isinstance(axis, (list, tuple)) else [axis]
+        ax = tuple(a % v.ndim for a in ax if v.shape[a % v.ndim] == 1)
+        return jnp.squeeze(v, axis=ax) if ax else v
+
+    return apply("squeeze", f, x)
+
+
+def squeeze_(x, axis=None):
+    x._become(squeeze(x, axis))
+    return x
+
+
+def unsqueeze(x, axis, name=None):
+    x = _t(x)
+    ax = axis if isinstance(axis, (list, tuple)) else [axis]
+    ax = [int(a.numpy()) if isinstance(a, Tensor) else int(a) for a in ax]
+
+    def f(v):
+        out = v
+        for a in sorted([a % (out.ndim + 1) if a >= 0 else a + out.ndim + 1 for a in ax]):
+            out = jnp.expand_dims(out, a)
+        return out
+
+    return apply("unsqueeze", f, x)
+
+
+def unsqueeze_(x, axis):
+    x._become(unsqueeze(x, axis))
+    return x
+
+
+def concat(x, axis=0, name=None):
+    ts = [_t(i) for i in x]
+    if isinstance(axis, Tensor):
+        axis = int(axis.numpy())
+    return apply("concat", lambda *vs: jnp.concatenate(vs, axis=axis), *ts)
+
+
+def stack(x, axis=0, name=None):
+    ts = [_t(i) for i in x]
+    return apply("stack", lambda *vs: jnp.stack(vs, axis=axis), *ts)
+
+
+def hstack(x):
+    return apply("hstack", lambda *vs: jnp.hstack(vs), *[_t(i) for i in x])
+
+
+def vstack(x):
+    return apply("vstack", lambda *vs: jnp.vstack(vs), *[_t(i) for i in x])
+
+
+def dstack(x):
+    return apply("dstack", lambda *vs: jnp.dstack(vs), *[_t(i) for i in x])
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    x = _t(x)
+    if isinstance(axis, Tensor):
+        axis = int(axis.numpy())
+    dim = x._value.shape[axis]
+    if isinstance(num_or_sections, int):
+        if dim % num_or_sections != 0:
+            raise ValueError(
+                f"split: dimension {axis} (size {dim}) is not divisible by {num_or_sections}"
+            )
+        sizes = [dim // num_or_sections] * num_or_sections
+    else:
+        sizes = [int(s) for s in num_or_sections]
+        if -1 in sizes:
+            rest = dim - sum(s for s in sizes if s != -1)
+            sizes = [rest if s == -1 else s for s in sizes]
+    offsets = np.cumsum([0] + sizes)
+
+    def f(v):
+        return tuple(jax.lax.slice_in_dim(v, int(offsets[i]), int(offsets[i + 1]), axis=axis) for i in range(len(sizes)))
+
+    return list(apply("split", f, x))
+
+
+def chunk(x, chunks, axis=0):
+    return split(x, chunks, axis)
+
+
+def tensor_split(x, num_or_indices, axis=0):
+    x = _t(x)
+
+    def f(v):
+        return tuple(jnp.array_split(v, num_or_indices, axis=axis))
+
+    return list(apply("tensor_split", f, x))
+
+
+def unbind(x, axis=0):
+    x = _t(x)
+    n = x._value.shape[axis]
+
+    def f(v):
+        return tuple(jnp.take(v, i, axis=axis) for i in range(n))
+
+    return list(apply("unbind", f, x))
+
+
+def tile(x, repeat_times, name=None):
+    reps = _static_shape(repeat_times)
+    return apply("tile", lambda v: jnp.tile(v, reps), _t(x))
+
+
+def expand(x, shape, name=None):
+    x = _t(x)
+    shp = _static_shape(shape)
+    cur = list(x._value.shape)
+    full = []
+    pad = len(shp) - len(cur)
+    for i, s in enumerate(shp):
+        if s == -1:
+            full.append(cur[i - pad])
+        else:
+            full.append(s)
+    return apply("expand", lambda v: jnp.broadcast_to(v, full), x)
+
+
+def expand_as(x, y, name=None):
+    y = _t(y)
+    return expand(x, y.shape)
+
+
+def broadcast_to(x, shape, name=None):
+    return apply("broadcast_to", lambda v: jnp.broadcast_to(v, _static_shape(shape)), _t(x))
+
+
+def broadcast_tensors(inputs):
+    ts = [_t(i) for i in inputs]
+    return list(apply("broadcast_tensors", lambda *vs: tuple(jnp.broadcast_arrays(*vs)), *ts))
+
+
+def cast(x, dtype):
+    d = dtype_mod.convert_dtype(dtype)
+    return apply("cast", lambda v: v.astype(d), _t(x))
+
+
+def gather(x, index, axis=0, name=None):
+    x, index = _t(x), _t(index)
+    if isinstance(axis, Tensor):
+        axis = int(axis.numpy())
+    return apply("gather", lambda v, i: jnp.take(v, i.reshape(-1) if i.ndim > 1 else i, axis=axis), x, index)
+
+
+def gather_nd(x, index, name=None):
+    x, index = _t(x), _t(index)
+
+    def f(v, idx):
+        k = idx.shape[-1]
+        flat = idx.reshape(-1, k)
+        out = v[tuple(flat[:, j] for j in range(k))]
+        return out.reshape(idx.shape[:-1] + v.shape[k:])
+
+    return apply("gather_nd", f, x, index)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    x, index, updates = _t(x), _t(index), _t(updates)
+
+    def f(v, i, u):
+        i = i.reshape(-1)
+        if overwrite:
+            return v.at[i].set(u)
+        z = v.at[i].set(jnp.zeros_like(u))
+        return z.at[i].add(u)
+
+    return apply("scatter", f, x, index, updates)
+
+
+def scatter_(x, index, updates, overwrite=True):
+    x._become(scatter(x, index, updates, overwrite))
+    return x
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    x, index, updates = _t(x), _t(index), _t(updates)
+
+    def f(v, idx, u):
+        k = idx.shape[-1]
+        flat = idx.reshape(-1, k)
+        uflat = u.reshape((-1,) + v.shape[k:])
+        return v.at[tuple(flat[:, j] for j in range(k))].add(uflat)
+
+    return apply("scatter_nd_add", f, x, index, updates)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    from .creation import zeros
+
+    z = zeros(shape, dtype=_t(updates).dtype)
+    return scatter_nd_add(z, index, updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    return apply("index_select", lambda v, i: jnp.take(v, i, axis=axis), _t(x), _t(index))
+
+
+def index_sample(x, index):
+    def f(v, i):
+        return jnp.take_along_axis(v, i, axis=1)
+
+    return apply("index_sample", f, _t(x), _t(index))
+
+
+def index_add(x, index, axis, value):
+    def f(v, i, u):
+        ax = axis % v.ndim
+        return v.at[(builtins.slice(None),) * ax + (i,)].add(u)
+
+    return apply("index_add", f, _t(x), _t(index), _t(value))
+
+
+def index_put(x, indices, value, accumulate=False):
+    x = _t(x)
+    idx = tuple(_t(i).value for i in indices)
+
+    def f(v, u):
+        if accumulate:
+            return v.at[idx].add(u)
+        return v.at[idx].set(u)
+
+    return apply("index_put", f, x, _t(value))
+
+
+def take_along_axis(arr, indices, axis, broadcast=True):
+    return apply("take_along_axis", lambda v, i: jnp.take_along_axis(v, i, axis=axis), _t(arr), _t(indices))
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign"):
+    def f(v, i, u):
+        u = jnp.broadcast_to(u, i.shape) if jnp.ndim(u) else jnp.full(i.shape, u, v.dtype)
+        if reduce == "assign":
+            return jnp.put_along_axis(v, i, u, axis=axis, inplace=False)
+        if reduce == "add":
+            dims = list(range(v.ndim))
+            # scatter-add along axis
+            idx_grid = jnp.indices(i.shape)
+            full_idx = tuple(i if d == axis % v.ndim else idx_grid[d] for d in dims)
+            return v.at[full_idx].add(u)
+        if reduce in ("mul", "multiply"):
+            idx_grid = jnp.indices(i.shape)
+            full_idx = tuple(i if d == axis % v.ndim else idx_grid[d] for d in range(v.ndim))
+            return v.at[full_idx].multiply(u)
+        raise ValueError(f"unsupported reduce {reduce}")
+
+    return apply("put_along_axis", f, _t(arr), _t(indices), _t(values) if isinstance(values, Tensor) else _t(jnp.asarray(values)))
+
+
+def take(x, index, mode="raise"):
+    def f(v, i):
+        flat = v.reshape(-1)
+        if mode == "wrap":
+            i = jnp.mod(i, flat.shape[0])
+        elif mode == "clip":
+            i = jnp.clip(i, 0, flat.shape[0] - 1)
+        else:
+            i = jnp.where(i < 0, i + flat.shape[0], i)
+        return flat[i]
+
+    return apply("take", f, _t(x), _t(index))
+
+
+def masked_select(x, mask, name=None):
+    x, mask = _t(x), _t(mask)
+    # dynamic output shape: resolved on host (not jittable — same as reference CPU sync)
+    v, m = np.asarray(x.value), np.asarray(mask.value)
+    m = np.broadcast_to(m, v.shape)
+    idx = np.nonzero(m.reshape(-1))[0]
+
+    def f(vv):
+        return vv.reshape(-1)[jnp.asarray(idx)]
+
+    return apply("masked_select", f, x)
+
+
+def masked_fill(x, mask, value):
+    x, mask = _t(x), _t(mask)
+    vval = value.value if isinstance(value, Tensor) else value
+
+    def f(v, m):
+        return jnp.where(m, jnp.asarray(vval, v.dtype), v)
+
+    return apply("masked_fill", f, x, mask)
+
+
+def masked_fill_(x, mask, value):
+    x._become(masked_fill(x, mask, value))
+    return x
+
+
+def masked_scatter(x, mask, value):
+    x, mask, value = _t(x), _t(mask), _t(value)
+    m = np.asarray(mask.value)
+    m = np.broadcast_to(m, x._value.shape)
+    cnt = int(m.sum())
+
+    def f(v, u):
+        mm = jnp.broadcast_to(mask.value, v.shape).reshape(-1)
+        pos = jnp.cumsum(mm) - 1
+        flat_u = u.reshape(-1)[:cnt] if u.size >= cnt else jnp.pad(u.reshape(-1), (0, cnt - u.size))
+        return jnp.where(mm, flat_u[jnp.clip(pos, 0, cnt - 1)], v.reshape(-1)).reshape(v.shape)
+
+    return apply("masked_scatter", f, x, value)
+
+
+def where(condition, x=None, y=None, name=None):
+    condition = _t(condition)
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    from .math import _binary_promote
+
+    x, y = _binary_promote(x, y)
+    return apply("where", lambda c, a, b: jnp.where(c, a, b), condition, x, y)
+
+
+def nonzero(x, as_tuple=False):
+    x = _t(x)
+    v = np.asarray(x.value)
+    nz = np.nonzero(v)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i, dtype=jnp.int64)) for i in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1), dtype=jnp.int64))
+
+
+def roll(x, shifts, axis=None, name=None):
+    return apply("roll", lambda v: jnp.roll(v, shifts, axis=axis), _t(x))
+
+
+def flip(x, axis, name=None):
+    return apply("flip", lambda v: jnp.flip(v, axis=axis), _t(x))
+
+
+def rot90(x, k=1, axes=(0, 1)):
+    return apply("rot90", lambda v: jnp.rot90(v, k=k, axes=tuple(axes)), _t(x))
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    x = _t(x)
+    if isinstance(repeats, Tensor):
+        reps = np.asarray(repeats.value)
+        total = int(reps.sum())
+        return apply(
+            "repeat_interleave",
+            lambda v: jnp.repeat(v, jnp.asarray(reps), axis=axis, total_repeat_length=total),
+            x,
+        )
+    return apply("repeat_interleave", lambda v: jnp.repeat(v, repeats, axis=axis), x)
+
+
+def slice(x, axes, starts, ends):  # noqa: A001
+    x = _t(x)
+    starts = _static_shape(starts)
+    ends = _static_shape(ends)
+
+    def f(v):
+        idx = [builtins.slice(None)] * v.ndim
+        for a, s, e in zip(axes, starts, ends):
+            idx[a] = builtins.slice(s, e)
+        return v[tuple(idx)]
+
+    return apply("slice", f, x)
+
+
+def strided_slice(x, axes, starts, ends, strides):
+    x = _t(x)
+
+    def f(v):
+        idx = [builtins.slice(None)] * v.ndim
+        for a, s, e, st in zip(axes, _static_shape(starts), _static_shape(ends), _static_shape(strides)):
+            idx[a] = builtins.slice(s, e, st)
+        return v[tuple(idx)]
+
+    return apply("strided_slice", f, x)
+
+
+def crop(x, shape=None, offsets=None):
+    x = _t(x)
+    shp = _static_shape(shape)
+    offs = _static_shape(offsets) if offsets is not None else [0] * len(shp)
+    shp = [x._value.shape[i] - offs[i] if s == -1 else s for i, s in enumerate(shp)]
+
+    def f(v):
+        return jax.lax.dynamic_slice(v, offs, shp)
+
+    return apply("crop", f, x)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    def f(v):
+        size = index_num // nshards
+        shard = v // size
+        return jnp.where(shard == shard_id, v % size, ignore_value)
+
+    return apply_nograd("shard_index", f, _t(input))
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None, dtype=dtype_mod.int64):
+    x = _t(x)
+    v = np.asarray(x.value)
+    res = np.unique(v, return_index=return_index, return_inverse=return_inverse, return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    outs = [Tensor(jnp.asarray(r)) for r in res]
+    return tuple(outs)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None):
+    x = _t(x)
+    v = np.asarray(x.value)
+    if axis is None:
+        v = v.reshape(-1)
+        keep = np.concatenate([[True], v[1:] != v[:-1]])
+        out = v[keep]
+        outs = [Tensor(jnp.asarray(out))]
+        if return_inverse:
+            outs.append(Tensor(jnp.asarray(np.cumsum(keep) - 1)))
+        if return_counts:
+            idx = np.nonzero(keep)[0]
+            counts = np.diff(np.concatenate([idx, [len(v)]]))
+            outs.append(Tensor(jnp.asarray(counts)))
+        return outs[0] if len(outs) == 1 else tuple(outs)
+    raise NotImplementedError("unique_consecutive with axis")
+
+
+def as_complex(x):
+    return apply("as_complex", lambda v: jax.lax.complex(v[..., 0], v[..., 1]), _t(x))
+
+
+def as_real(x):
+    return apply("as_real", lambda v: jnp.stack([jnp.real(v), jnp.imag(v)], axis=-1), _t(x))
+
+
+def view(x, shape_or_dtype):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return _t(x).astype(shape_or_dtype)
+
+
+def view_as(x, other):
+    return reshape(x, _t(other).shape)
+
+
+def as_strided(x, shape, stride, offset=0):
+    x = _t(x)
+
+    def f(v):
+        flat = v.reshape(-1)
+        idx = np.zeros(tuple(shape), dtype=np.int64) + offset
+        for d, (s, st) in enumerate(zip(shape, stride)):
+            r = np.arange(s) * st
+            idx = idx + r.reshape([-1 if i == d else 1 for i in range(len(shape))])
+        return flat[jnp.asarray(idx)]
+
+    return apply("as_strided", f, x)
+
+
+def atleast_1d(*inputs):
+    outs = [apply("atleast_1d", jnp.atleast_1d, _t(x)) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs):
+    outs = [apply("atleast_2d", jnp.atleast_2d, _t(x)) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs):
+    outs = [apply("atleast_3d", jnp.atleast_3d, _t(x)) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def numel(x):
+    return Tensor(jnp.asarray(_t(x).size, dtype=jnp.int64))
+
+
+def shape(x):
+    return Tensor(jnp.asarray(_t(x).shape, dtype=jnp.int32))
+
+
+def rank(x):
+    return Tensor(jnp.asarray(_t(x).ndim, dtype=jnp.int32))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def is_floating_point(x):
+    return bool(jnp.issubdtype(_t(x)._value.dtype, jnp.floating))
+
+
+def is_integer(x):
+    return bool(jnp.issubdtype(_t(x)._value.dtype, jnp.integer))
+
+
+def is_complex(x):
+    return bool(jnp.issubdtype(_t(x)._value.dtype, jnp.complexfloating))
+
+
+def is_empty(x):
+    return Tensor(jnp.asarray(_t(x).size == 0))
+
+
+def unfold(x, axis, size, step):
+    """paddle Tensor.unfold: windows along `axis`, window dim appended LAST."""
+    x = _t(x)
+
+    def f(v):
+        n = (v.shape[axis] - size) // step + 1
+        starts = np.arange(n) * step
+        slices = [
+            jnp.moveaxis(jax.lax.slice_in_dim(v, int(s), int(s) + size, axis=axis), axis, -1)
+            for s in starts
+        ]
+        return jnp.stack(slices, axis=axis % v.ndim)
+
+    return apply("unfold_tensor", f, x)
+
+
+def pad_sequences(*a, **k):
+    raise NotImplementedError

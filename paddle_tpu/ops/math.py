@@ -1,0 +1,441 @@
+"""Elementwise + reduction math ops.
+
+Reference parity: python/paddle/tensor/math.py (and the corresponding PHI
+kernels in paddle/phi/kernels/). Kernels are jnp/lax — XLA fuses elementwise
+chains into single TPU loops, so there is no fused-op zoo to maintain.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax import numpy as jnp
+
+from ..core.apply import apply, apply_nograd
+from ..core.tensor import Tensor, _ensure_tensor
+from ..framework import dtype as dtype_mod
+
+
+def _t(x):
+    return _ensure_tensor(x)
+
+
+def _binop(opname, fn):
+    def op(x, y, name=None):
+        x, y = _binary_promote(x, y)
+        return apply(opname, fn, x, y)
+
+    op.__name__ = opname
+    return op
+
+
+def _binary_promote(x, y):
+    """Paddle-style scalar handling: python scalars follow the tensor dtype."""
+    if isinstance(x, Tensor) and not isinstance(y, Tensor):
+        if isinstance(y, (int, float, bool, np.number)) and not isinstance(y, np.ndarray):
+            if isinstance(y, (bool, np.bool_)):
+                y = Tensor(jnp.asarray(y))
+            elif isinstance(y, (int, np.integer)):
+                y = Tensor(jnp.asarray(y, dtype=x._value.dtype if jnp.issubdtype(x._value.dtype, jnp.number) else None))
+            else:
+                d = x._value.dtype
+                if not jnp.issubdtype(d, jnp.inexact):
+                    d = dtype_mod.get_default_dtype()
+                y = Tensor(jnp.asarray(y, dtype=d))
+        else:
+            y = _t(y)
+    elif isinstance(y, Tensor) and not isinstance(x, Tensor):
+        y2, x2 = _binary_promote(y, x)
+        return x2, y2
+    else:
+        x, y = _t(x), _t(y)
+    return x, y
+
+
+add = _binop("add", jnp.add)
+subtract = _binop("subtract", jnp.subtract)
+multiply = _binop("multiply", jnp.multiply)
+divide = _binop("divide", jnp.true_divide)
+floor_divide = _binop("floor_divide", jnp.floor_divide)
+mod = _binop("mod", jnp.mod)
+remainder = mod
+floor_mod = mod
+pow_op = _binop("pow", jnp.power)
+maximum = _binop("maximum", jnp.maximum)
+minimum = _binop("minimum", jnp.minimum)
+fmax = _binop("fmax", jnp.fmax)
+fmin = _binop("fmin", jnp.fmin)
+atan2 = _binop("atan2", jnp.arctan2)
+heaviside = _binop("heaviside", jnp.heaviside)
+copysign = _binop("copysign", jnp.copysign)
+hypot = _binop("hypot", jnp.hypot)
+nextafter = _binop("nextafter", jnp.nextafter)
+ldexp = _binop("ldexp", lambda x, y: jnp.ldexp(x, y.astype(jnp.int32)))
+logaddexp = _binop("logaddexp", jnp.logaddexp)
+gcd = _binop("gcd", jnp.gcd)
+lcm = _binop("lcm", jnp.lcm)
+
+
+def pow(x, y, name=None):  # noqa: A001 - paddle API name
+    return pow_op(x, y)
+
+
+def divide_no_nan(x, y):
+    x, y = _binary_promote(x, y)
+    return apply("divide_no_nan", lambda a, b: jnp.where(b == 0, jnp.zeros((), a.dtype), a / jnp.where(b == 0, 1, b)), x, y)
+
+
+def _unop(opname, fn):
+    def op(x, name=None):
+        return apply(opname, fn, _t(x))
+
+    op.__name__ = opname
+    return op
+
+
+exp = _unop("exp", jnp.exp)
+expm1 = _unop("expm1", jnp.expm1)
+log = _unop("log", jnp.log)
+log2 = _unop("log2", jnp.log2)
+log10 = _unop("log10", jnp.log10)
+log1p = _unop("log1p", jnp.log1p)
+sqrt = _unop("sqrt", jnp.sqrt)
+rsqrt = _unop("rsqrt", jax.lax.rsqrt)
+abs = _unop("abs", jnp.abs)  # noqa: A001
+absolute = abs
+neg = _unop("neg", jnp.negative)
+negative = neg
+sign = _unop("sign", jnp.sign)
+sgn = sign
+sin = _unop("sin", jnp.sin)
+cos = _unop("cos", jnp.cos)
+tan = _unop("tan", jnp.tan)
+asin = _unop("asin", jnp.arcsin)
+acos = _unop("acos", jnp.arccos)
+atan = _unop("atan", jnp.arctan)
+arcsin, arccos, arctan = asin, acos, atan
+sinh = _unop("sinh", jnp.sinh)
+cosh = _unop("cosh", jnp.cosh)
+tanh = _unop("tanh", jnp.tanh)
+asinh = _unop("asinh", jnp.arcsinh)
+acosh = _unop("acosh", jnp.arccosh)
+atanh = _unop("atanh", jnp.arctanh)
+floor = _unop("floor", jnp.floor)
+ceil = _unop("ceil", jnp.ceil)
+trunc = _unop("trunc", jnp.trunc)
+frac = _unop("frac", lambda x: x - jnp.trunc(x))
+reciprocal = _unop("reciprocal", jnp.reciprocal)
+square = _unop("square", jnp.square)
+erf = _unop("erf", jax.scipy.special.erf)
+erfinv = _unop("erfinv", jax.scipy.special.erfinv)
+lgamma = _unop("lgamma", jax.scipy.special.gammaln)
+digamma = _unop("digamma", jax.scipy.special.digamma)
+polygamma_impl = jax.scipy.special.polygamma
+i0 = _unop("i0", jax.scipy.special.i0)
+i0e = _unop("i0e", jax.scipy.special.i0e)
+i1 = _unop("i1", jax.scipy.special.i1)
+i1e = _unop("i1e", jax.scipy.special.i1e)
+deg2rad = _unop("deg2rad", jnp.deg2rad)
+rad2deg = _unop("rad2deg", jnp.rad2deg)
+angle = _unop("angle", jnp.angle)
+conj = _unop("conj", jnp.conj)
+real = _unop("real", jnp.real)
+imag = _unop("imag", jnp.imag)
+sigmoid = _unop("sigmoid", jax.nn.sigmoid)
+logit_raw = None
+exponent_bits = None
+
+
+def polygamma(x, n):
+    return apply("polygamma", lambda v: polygamma_impl(n, v), _t(x))
+
+
+def round(x, decimals=0, name=None):  # noqa: A001
+    return apply("round", lambda v: jnp.round(v, decimals), _t(x))
+
+
+def rint(x):
+    return apply("rint", jnp.rint, _t(x))
+
+
+def logit(x, eps=None):
+    def f(v):
+        if eps is not None:
+            v = jnp.clip(v, eps, 1.0 - eps)
+        return jnp.log(v / (1.0 - v))
+
+    return apply("logit", f, _t(x))
+
+
+def clip(x, min=None, max=None, name=None):  # noqa: A001
+    x = _t(x)
+    mn = min.item() if isinstance(min, Tensor) else min
+    mx = max.item() if isinstance(max, Tensor) else max
+    return apply("clip", lambda v: jnp.clip(v, mn, mx), x)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    x = _t(x)
+    s = scale.value if isinstance(scale, Tensor) else scale
+
+    def f(v):
+        out = v * jnp.asarray(s, v.dtype) + bias if bias_after_scale else (v + bias) * jnp.asarray(s, v.dtype)
+        return out
+
+    out = apply("scale", f, x)
+    if act is not None:
+        from ..nn import functional as F
+
+        out = getattr(F, act)(out)
+    return out
+
+
+def increment(x, value=1.0):
+    x._become(add(x, value))
+    return x
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159):
+    return apply("stanh", lambda v: scale_b * jnp.tanh(scale_a * v), _t(x))
+
+
+def multiplex(inputs, index):
+    vals = [_t(i).value for i in inputs]
+    idx = _t(index).value.reshape(-1)
+    stacked = jnp.stack(vals, axis=0)
+    return Tensor(stacked[idx, jnp.arange(stacked.shape[1])])
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    return apply(
+        "addmm",
+        lambda i, a, b: beta * i + alpha * (a @ b),
+        _t(input), _t(x), _t(y),
+    )
+
+
+def inner(x, y):
+    return apply("inner", lambda a, b: jnp.inner(a, b), *_binary_promote(x, y))
+
+
+def outer(x, y):
+    return apply("outer", lambda a, b: jnp.outer(a, b), *_binary_promote(x, y))
+
+
+def dot(x, y):
+    def f(a, b):
+        if a.ndim == 1:
+            return jnp.sum(a * b)
+        return jnp.sum(a * b, axis=-1)
+
+    return apply("dot", f, *_binary_promote(x, y))
+
+
+def kron(x, y):
+    return apply("kron", jnp.kron, *_binary_promote(x, y))
+
+
+def cross(x, y, axis=9):
+    def f(a, b):
+        ax = axis
+        if ax == 9:
+            ax = next(i for i, s in enumerate(a.shape) if s == 3)
+        return jnp.cross(a, b, axis=ax)
+
+    return apply("cross", f, *_binary_promote(x, y))
+
+
+def trace(x, offset=0, axis1=0, axis2=1):
+    return apply("trace", lambda v: jnp.trace(v, offset=offset, axis1=axis1, axis2=axis2), _t(x))
+
+
+def lerp(x, y, weight):
+    w = weight.value if isinstance(weight, Tensor) else weight
+    return apply("lerp", lambda a, b: a + w * (b - a), *_binary_promote(x, y))
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None):
+    return apply("nan_to_num", lambda v: jnp.nan_to_num(v, nan=nan, posinf=posinf, neginf=neginf), _t(x))
+
+
+# ---- reductions ----
+
+def _axes(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        a = axis.numpy().tolist()
+        return tuple(a) if isinstance(a, list) else int(a)
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):  # noqa: A001
+    x = _t(x)
+    d = dtype_mod.convert_dtype(dtype) if dtype is not None else None
+
+    def f(v):
+        if d is None and jnp.issubdtype(v.dtype, jnp.bool_):
+            return jnp.sum(v, axis=_axes(axis), keepdims=keepdim, dtype=jnp.int64)
+        return jnp.sum(v, axis=_axes(axis), keepdims=keepdim, dtype=d)
+
+    return apply("sum", f, x)
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return apply("mean", lambda v: jnp.mean(v, axis=_axes(axis), keepdims=keepdim), _t(x))
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    d = dtype_mod.convert_dtype(dtype) if dtype is not None else None
+    return apply("prod", lambda v: jnp.prod(v, axis=_axes(axis), keepdims=keepdim, dtype=d), _t(x))
+
+
+def max(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return apply("max", lambda v: jnp.max(v, axis=_axes(axis), keepdims=keepdim), _t(x))
+
+
+def min(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return apply("min", lambda v: jnp.min(v, axis=_axes(axis), keepdims=keepdim), _t(x))
+
+
+def amax(x, axis=None, keepdim=False):
+    return max(x, axis, keepdim)
+
+
+def amin(x, axis=None, keepdim=False):
+    return min(x, axis, keepdim)
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False):
+    d = dtype_mod.convert_dtype(dtype) if dtype is not None else None
+    return apply("nansum", lambda v: jnp.nansum(v, axis=_axes(axis), keepdims=keepdim, dtype=d), _t(x))
+
+
+def nanmean(x, axis=None, keepdim=False):
+    return apply("nanmean", lambda v: jnp.nanmean(v, axis=_axes(axis), keepdims=keepdim), _t(x))
+
+
+def count_nonzero(x, axis=None, keepdim=False):
+    return apply_nograd("count_nonzero", lambda v: jnp.count_nonzero(v, axis=_axes(axis), keepdims=keepdim), _t(x))
+
+
+def logsumexp(x, axis=None, keepdim=False):
+    return apply("logsumexp", lambda v: jax.scipy.special.logsumexp(v, axis=_axes(axis), keepdims=keepdim), _t(x))
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    d = dtype_mod.convert_dtype(dtype) if dtype is not None else None
+
+    def f(v):
+        if axis is None:
+            return jnp.cumsum(v.reshape(-1), dtype=d)
+        return jnp.cumsum(v, axis=_axes(axis), dtype=d)
+
+    return apply("cumsum", f, _t(x))
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    d = dtype_mod.convert_dtype(dtype) if dtype is not None else None
+
+    def f(v):
+        if dim is None:
+            return jnp.cumprod(v.reshape(-1), dtype=d)
+        return jnp.cumprod(v, axis=dim, dtype=d)
+
+    return apply("cumprod", f, _t(x))
+
+
+def cummax(x, axis=None, dtype=dtype_mod.int64):
+    x = _t(x)
+
+    def f(v):
+        ax = axis if axis is not None else 0
+        vv = v.reshape(-1) if axis is None else v
+        vals = jax.lax.associative_scan(jnp.maximum, vv, axis=ax)
+        return vals
+
+    vals = apply("cummax_vals", f, x)
+    # indices via argmax of running max equality
+    def fi(v):
+        ax = axis if axis is not None else 0
+        vv = v.reshape(-1) if axis is None else v
+        vals_ = jax.lax.associative_scan(jnp.maximum, vv, axis=ax)
+        n = vv.shape[ax]
+        idx = jnp.arange(n).reshape([-1 if i == (ax % vv.ndim) else 1 for i in range(vv.ndim)])
+        eq = vv == vals_
+        first = jax.lax.associative_scan(jnp.maximum, jnp.where(eq, idx, -1), axis=ax)
+        return first.astype(dtype_mod.convert_dtype(dtype))
+
+    idxs = apply_nograd("cummax_idx", fi, x)
+    return vals, idxs
+
+
+def cummin(x, axis=None, dtype=dtype_mod.int64):
+    neg_vals, idxs = cummax(neg(_t(x)), axis=axis, dtype=dtype)
+    return neg(neg_vals), idxs
+
+
+def logcumsumexp(x, axis=None):
+    def f(v):
+        vv = v.reshape(-1) if axis is None else v
+        ax = 0 if axis is None else axis
+        return jax.lax.associative_scan(jnp.logaddexp, vv, axis=ax)
+
+    return apply("logcumsumexp", f, _t(x))
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None):
+    p = prepend.value if isinstance(prepend, Tensor) else prepend
+    a = append.value if isinstance(append, Tensor) else append
+    return apply("diff", lambda v: jnp.diff(v, n=n, axis=axis, prepend=p, append=a), _t(x))
+
+
+# ---- checks (non-differentiable) ----
+
+def isnan(x):
+    return apply_nograd("isnan", jnp.isnan, _t(x))
+
+
+def isinf(x):
+    return apply_nograd("isinf", jnp.isinf, _t(x))
+
+
+def isfinite(x):
+    return apply_nograd("isfinite", jnp.isfinite, _t(x))
+
+
+def isneginf(x):
+    return apply_nograd("isneginf", jnp.isneginf, _t(x))
+
+
+def isposinf(x):
+    return apply_nograd("isposinf", jnp.isposinf, _t(x))
+
+
+def isreal(x):
+    return apply_nograd("isreal", jnp.isreal, _t(x))
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return apply_nograd("isclose", lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan), _t(x), _t(y))
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply_nograd("allclose", lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan), _t(x), _t(y))
+
+
+def equal_all(x, y):
+    return apply_nograd("equal_all", lambda a, b: jnp.array_equal(a, b), _t(x), _t(y))
+
+
+def any(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return apply_nograd("any", lambda v: jnp.any(v, axis=_axes(axis), keepdims=keepdim), _t(x))
+
+
+def all(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return apply_nograd("all", lambda v: jnp.all(v, axis=_axes(axis), keepdims=keepdim), _t(x))
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(jnp.broadcast_shapes(tuple(x_shape), tuple(y_shape)))

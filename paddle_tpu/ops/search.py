@@ -1,0 +1,198 @@
+"""Search / sort ops.
+
+Reference parity: python/paddle/tensor/search.py + sort.py (argmax, argmin,
+argsort, sort, topk, searchsorted, kthvalue, mode, masked ops, bucketize).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax import numpy as jnp
+
+from ..core.apply import apply, apply_nograd
+from ..core.tensor import Tensor, _ensure_tensor
+from ..framework import dtype as dtype_mod
+
+
+def _t(x):
+    return _ensure_tensor(x)
+
+
+def argmax(x, axis=None, keepdim=False, dtype=dtype_mod.int64, name=None):
+    d = dtype_mod.convert_dtype(dtype)
+
+    def f(v):
+        if axis is None:
+            out = jnp.argmax(v.reshape(-1))
+            return out.reshape((1,) * v.ndim).astype(d) if keepdim else out.astype(d)
+        return jnp.argmax(v, axis=axis, keepdims=keepdim).astype(d)
+
+    return apply_nograd("argmax", f, _t(x))
+
+
+def argmin(x, axis=None, keepdim=False, dtype=dtype_mod.int64, name=None):
+    d = dtype_mod.convert_dtype(dtype)
+
+    def f(v):
+        if axis is None:
+            out = jnp.argmin(v.reshape(-1))
+            return out.reshape((1,) * v.ndim).astype(d) if keepdim else out.astype(d)
+        return jnp.argmin(v, axis=axis, keepdims=keepdim).astype(d)
+
+    return apply_nograd("argmin", f, _t(x))
+
+
+def argsort(x, axis=-1, descending=False, stable=True, name=None):
+    def f(v):
+        out = jnp.argsort(v, axis=axis, stable=stable, descending=descending)
+        return out.astype(jnp.int64)
+
+    return apply_nograd("argsort", f, _t(x))
+
+
+def sort(x, axis=-1, descending=False, stable=True, name=None):
+    def f(v):
+        return jnp.sort(v, axis=axis, stable=stable, descending=descending)
+
+    return apply("sort", f, _t(x))
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):  # noqa: A001
+    x = _t(x)
+    if isinstance(k, Tensor):
+        k = int(k.numpy())
+
+    def f(v):
+        vv = v if largest else -v
+        vv = jnp.moveaxis(vv, axis, -1)
+        vals, idx = jax.lax.top_k(vv, k)
+        vals = vals if largest else -vals
+        return (jnp.moveaxis(vals, -1, axis), jnp.moveaxis(idx, -1, axis).astype(jnp.int64))
+
+    # one lax.top_k call; the int64 indices output is non-differentiable and
+    # gets a float0 cotangent in the engine automatically.
+    vals, idx = apply("topk", f, x)
+    return vals, idx
+
+
+def kthvalue(x, k, axis=-1, keepdim=False):
+    x = _t(x)
+
+    def f(v):
+        s = jnp.sort(v, axis=axis)
+        si = jnp.argsort(v, axis=axis)
+        out = jnp.take(s, k - 1, axis=axis)
+        oidx = jnp.take(si, k - 1, axis=axis).astype(jnp.int64)
+        if keepdim:
+            out = jnp.expand_dims(out, axis)
+            oidx = jnp.expand_dims(oidx, axis)
+        return (out, oidx)
+
+    return apply("kthvalue", f, x)
+
+
+def mode(x, axis=-1, keepdim=False):
+    x = _t(x)
+
+    def fv(v):
+        s = jnp.sort(v, axis=axis)
+        n = s.shape[axis]
+        sm = jnp.moveaxis(s, axis, -1)
+        eq = sm[..., 1:] == sm[..., :-1]
+        runs = jnp.concatenate([jnp.zeros(eq.shape[:-1] + (1,), jnp.int32), jnp.cumsum(eq, axis=-1) * eq], axis=-1)
+        best = jnp.argmax(runs, axis=-1)
+        vals = jnp.take_along_axis(sm, best[..., None], axis=-1)[..., 0]
+        return jnp.expand_dims(jnp.moveaxis(vals, -1, -1), axis) if keepdim else vals
+
+    vals = apply("mode_values", fv, x)
+
+    def fi(v):
+        target = vals.value
+        tv = jnp.expand_dims(jnp.moveaxis(target, -1, -1), axis) if False else jnp.expand_dims(target, axis)
+        eq = v == jnp.moveaxis(tv, axis, axis)
+        n = v.shape[axis]
+        idxs = jnp.arange(n).reshape([-1 if i == axis % v.ndim else 1 for i in range(v.ndim)])
+        last = jnp.max(jnp.where(eq, idxs, -1), axis=axis, keepdims=keepdim)
+        return last.astype(jnp.int64)
+
+    return vals, apply_nograd("mode_indices", fi, x)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False):
+    d = jnp.int32 if out_int32 else jnp.int64
+
+    def f(s, v):
+        side = "right" if right else "left"
+        if s.ndim == 1:
+            return jnp.searchsorted(s, v, side=side).astype(d)
+        flat_s = s.reshape(-1, s.shape[-1])
+        flat_v = v.reshape(-1, v.shape[-1])
+        outs = jax.vmap(lambda a, b: jnp.searchsorted(a, b, side=side))(flat_s, flat_v)
+        return outs.reshape(v.shape).astype(d)
+
+    return apply_nograd("searchsorted", f, _t(sorted_sequence), _t(values))
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+def median(x, axis=None, keepdim=False, mode="avg"):
+    x = _t(x)
+
+    def f(v):
+        if mode == "avg":
+            return jnp.median(v, axis=axis, keepdims=keepdim)
+        # 'min' mode: lower of the two middle values
+        vv = v.reshape(-1) if axis is None else v
+        ax = 0 if axis is None else axis
+        s = jnp.sort(vv, axis=ax)
+        n = s.shape[ax]
+        out = jnp.take(s, (n - 1) // 2, axis=ax)
+        if keepdim:
+            out = jnp.expand_dims(out, ax if axis is not None else tuple(range(v.ndim)))
+        return out
+
+    return apply("median", f, x)
+
+
+def nanmedian(x, axis=None, keepdim=False):
+    return apply("nanmedian", lambda v: jnp.nanmedian(v, axis=axis, keepdims=keepdim), _t(x))
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear"):
+    qv = q.value if isinstance(q, Tensor) else q
+    return apply("quantile", lambda v: jnp.quantile(v, qv, axis=axis, keepdims=keepdim, method=interpolation), _t(x))
+
+
+def nanquantile(x, q, axis=None, keepdim=False):
+    qv = q.value if isinstance(q, Tensor) else q
+    return apply("nanquantile", lambda v: jnp.nanquantile(v, qv, axis=axis, keepdims=keepdim), _t(x))
+
+
+def histogram(x, bins=100, min=0, max=0, weight=None, density=False):  # noqa: A001
+    x = _t(x)
+    v = x.value
+    lo, hi = (float(jnp.min(v)), float(jnp.max(v))) if (min == 0 and max == 0) else (min, max)
+    w = _t(weight).value if weight is not None else None
+    h, _ = jnp.histogram(v, bins=bins, range=(lo, hi), weights=w, density=density)
+    return Tensor(h if (density or w is not None) else h.astype(jnp.int64))
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None):
+    x = _t(x)
+    w = _t(weights).value if weights is not None else None
+    h, edges = jnp.histogramdd(x.value, bins=bins, range=ranges, density=density, weights=w)
+    return Tensor(h), [Tensor(e) for e in edges]
+
+
+def bincount(x, weights=None, minlength=0):
+    x = _t(x)
+    v = np.asarray(x.value)
+    length = builtins_max(int(v.max()) + 1 if v.size else 0, minlength)
+    w = _t(weights).value if weights is not None else None
+    out = jnp.bincount(x.value, weights=w, length=length)
+    return Tensor(out if w is not None else out.astype(jnp.int64))
+
+
+builtins_max = max

@@ -1,0 +1,20 @@
+"""Test configuration.
+
+Tests run on an 8-device virtual CPU mesh (the SURVEY §4 analog of the
+reference's fake_cpu_device.h pluggable-backend tests): sharding/collective
+semantics are identical to a TPU pod slice, only the transport differs.
+
+The axon sitecustomize pins jax_platforms to the TPU plugin, so the env var
+alone is not enough — we override via jax.config before any backend init.
+"""
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_TRACEBACK_FILTERING", "off")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.default_backend() == "cpu" and len(jax.devices()) == 8

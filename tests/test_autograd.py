@@ -1,0 +1,220 @@
+"""Autograd engine tests.
+
+Models the reference's eager AD tests (test/legacy_test/test_imperative_*.py,
+paddle/fluid/eager backward.cc semantics): tape building, accumulation,
+retain_graph, hooks, paddle.grad, PyLayer, no_grad.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_simple_backward():
+    x = paddle.to_tensor([1.0, 2.0, 3.0])
+    x.stop_gradient = False
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 4.0, 6.0])
+
+
+def test_grad_accumulation_across_backwards():
+    x = paddle.to_tensor([1.0]); x.stop_gradient = False
+    (x * 2).backward()
+    (x * 3).backward()
+    assert x.grad.item() == 5.0
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_shared_subexpression():
+    x = paddle.to_tensor(2.0); x.stop_gradient = False
+    y = x * x          # used twice
+    z = y + y
+    z.backward()
+    assert x.grad.item() == 8.0  # d(2x^2)/dx = 4x
+
+
+def test_diamond_graph():
+    x = paddle.to_tensor(3.0); x.stop_gradient = False
+    a = x * 2
+    b = x * 3
+    c = a * b  # 6x^2 -> 12x = 36
+    c.backward()
+    np.testing.assert_allclose(x.grad.item(), 36.0, rtol=1e-6)
+
+
+def test_stop_gradient_blocks():
+    x = paddle.to_tensor(1.0); x.stop_gradient = False
+    y = paddle.to_tensor(1.0)  # stop_gradient True
+    z = x * y
+    z.backward()
+    assert x.grad is not None
+    assert y.grad is None
+
+
+def test_retain_graph():
+    x = paddle.to_tensor(2.0); x.stop_gradient = False
+    y = x * x
+    y.backward(retain_graph=True)
+    y.backward()
+    assert x.grad.item() == 8.0
+    with pytest.raises(RuntimeError):
+        y.backward()  # graph released
+
+
+def test_backward_with_grad_tensor():
+    x = paddle.to_tensor([1.0, 1.0]); x.stop_gradient = False
+    y = x * 2
+    y.backward(paddle.to_tensor([1.0, 3.0]))
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 6.0])
+
+
+def test_paddle_grad_api():
+    x = paddle.to_tensor(2.0); x.stop_gradient = False
+    y = paddle.to_tensor(3.0); y.stop_gradient = False
+    z = x * x * y
+    gx, gy = paddle.grad(z, [x, y])
+    assert gx.item() == 12.0 and gy.item() == 4.0
+    assert x.grad is None  # grad() must not pollute .grad
+
+
+def test_grad_allow_unused():
+    x = paddle.to_tensor(1.0); x.stop_gradient = False
+    u = paddle.to_tensor(1.0); u.stop_gradient = False
+    y = x * 2
+    with pytest.raises(RuntimeError):
+        paddle.grad(y, [x, u])
+    y = x * 2  # graph was consumed by the failed call; rebuild
+    gx, gu = paddle.grad(y, [x, u], allow_unused=True)
+    assert gx.item() == 2.0 and gu is None
+
+
+def test_no_grad_context_and_decorator():
+    x = paddle.to_tensor(1.0); x.stop_gradient = False
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+
+    @paddle.no_grad()
+    def f(a):
+        return a * 3
+
+    assert f(x).stop_gradient
+
+
+def test_multi_output_op_grad():
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32))
+    x.stop_gradient = False
+    parts = paddle.split(x, 3)
+    loss = parts[0].sum() * 1 + parts[1].sum() * 2 + parts[2].sum() * 3
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1, 1, 2, 2, 3, 3])
+
+
+def test_partial_use_of_outputs():
+    x = paddle.to_tensor(np.ones(4, np.float32)); x.stop_gradient = False
+    a, b = paddle.split(x, 2)
+    a.sum().backward()  # b unused -> zero cotangent
+    np.testing.assert_allclose(x.grad.numpy(), [1, 1, 0, 0])
+
+
+def test_hook():
+    x = paddle.to_tensor(1.0); x.stop_gradient = False
+    seen = []
+
+    def hook(g):
+        seen.append(g.item())
+        return g * 10
+
+    h = x.register_hook(hook)
+    (x * 2).backward()
+    assert seen == [2.0]
+    assert x.grad.item() == 20.0
+    h.remove()
+    x.clear_grad()
+    (x * 2).backward()
+    assert x.grad.item() == 2.0
+
+
+def test_int_inputs_dont_build_graph():
+    x = paddle.to_tensor([1, 2, 3])
+    x.stop_gradient = False  # int tensors never require grad
+    y = x + 1
+    assert y.stop_gradient
+
+
+def test_backward_through_reshape_concat():
+    a = paddle.ones([2, 2]); a.stop_gradient = False
+    b = paddle.ones([2, 2]); b.stop_gradient = False
+    c = paddle.concat([a.reshape([4]), b.flatten() * 2])
+    c.sum().backward()
+    np.testing.assert_allclose(a.grad.numpy(), np.ones((2, 2)))
+    np.testing.assert_allclose(b.grad.numpy(), np.full((2, 2), 2.0))
+
+
+def test_double_use_leaf():
+    x = paddle.to_tensor([1.0, 2.0]); x.stop_gradient = False
+    y = x * x + x
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [3.0, 5.0])
+
+
+class _Exp(paddle.PyLayer):
+    @staticmethod
+    def forward(ctx, x):
+        out = paddle.exp(x)
+        ctx.save_for_backward(out)
+        return out
+
+    @staticmethod
+    def backward(ctx, dy):
+        (out,) = ctx.saved_tensor
+        return dy * out
+
+
+def test_pylayer():
+    x = paddle.to_tensor(1.5); x.stop_gradient = False
+    y = _Exp.apply(x)
+    (y * 2).backward()
+    np.testing.assert_allclose(x.grad.item(), 2 * np.exp(1.5), rtol=1e-5)
+
+
+class _TwoOut(paddle.PyLayer):
+    @staticmethod
+    def forward(ctx, x):
+        return x * 2, x * 3
+
+    @staticmethod
+    def backward(ctx, d1, d2):
+        return d1 * 2 + d2 * 3
+
+
+def test_pylayer_multi_output():
+    x = paddle.to_tensor(1.0); x.stop_gradient = False
+    a, b = _TwoOut.apply(x)
+    (a + b).backward()
+    assert x.grad.item() == 5.0  # d1*2 + d2*3 with d1=d2=1
+
+
+def test_grad_wrt_nonleaf():
+    x = paddle.to_tensor([1.0, 2.0]); x.stop_gradient = False
+    y = x * 2
+    z = (y * y).sum()
+    (gy,) = paddle.grad(z, y)
+    np.testing.assert_allclose(gy.numpy(), [4.0, 8.0])
+
+
+def test_inplace_under_no_grad_keeps_trainable():
+    p = paddle.to_tensor([1.0, 2.0]); p.stop_gradient = False
+    with paddle.no_grad():
+        p.add_(1.0)
+    assert not p.stop_gradient
+    (p * 2).sum().backward()
+    np.testing.assert_allclose(p.grad.numpy(), [2.0, 2.0])
+
+
+def test_set_value_keeps_stop_gradient():
+    p = paddle.to_tensor([1.0]); p.stop_gradient = False
+    p.set_value(np.array([5.0], np.float32))
+    assert not p.stop_gradient

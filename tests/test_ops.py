@@ -1,0 +1,255 @@
+"""Op-level numeric tests on the OpTest-style harness (tests/op_test.py).
+
+Models test/legacy_test per-op tests: forward vs numpy, gradient vs jax oracle.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_forward, check_grad
+
+rng = np.random.RandomState(0)
+
+
+def _f32(*shape):
+    return rng.randn(*shape).astype(np.float32)
+
+
+@pytest.mark.parametrize(
+    "op,npop",
+    [
+        (paddle.add, np.add),
+        (paddle.subtract, np.subtract),
+        (paddle.multiply, np.multiply),
+        (paddle.divide, np.divide),
+        (paddle.maximum, np.maximum),
+        (paddle.minimum, np.minimum),
+        (paddle.atan2, np.arctan2),
+    ],
+)
+def test_binary_ops(op, npop):
+    a, b = _f32(3, 4), _f32(3, 4)
+    check_forward(op, npop, {"a": a, "b": b})
+    check_grad(op, {"a": a, "b": np.abs(b) + 0.5})
+
+
+@pytest.mark.parametrize(
+    "op,npop",
+    [
+        (paddle.exp, np.exp),
+        (paddle.log, np.log),
+        (paddle.sqrt, np.sqrt),
+        (paddle.tanh, np.tanh),
+        (paddle.sin, np.sin),
+        (paddle.cos, np.cos),
+        (paddle.floor, np.floor),
+        (paddle.abs, np.abs),
+        (paddle.square, np.square),
+    ],
+)
+def test_unary_forward(op, npop):
+    x = _f32(2, 5)
+    if op in (paddle.log, paddle.sqrt):
+        x = np.abs(x) + 1
+    check_forward(op, npop, {"x": x})
+
+
+def test_unary_grads():
+    x = np.abs(_f32(3, 3)) + 0.5
+    for op in (paddle.exp, paddle.log, paddle.sqrt, paddle.tanh, paddle.sigmoid, paddle.rsqrt):
+        check_grad(op, {"x": x})
+
+
+def test_broadcasting():
+    a, b = _f32(3, 1, 4), _f32(2, 1)
+    check_forward(paddle.add, np.add, {"a": a, "b": b})
+    check_grad(paddle.multiply, {"a": a, "b": b})
+
+
+def test_reductions():
+    x = _f32(2, 3, 4)
+    check_forward(paddle.sum, lambda v: np.sum(v), {"x": x})
+    np.testing.assert_allclose(
+        paddle.sum(paddle.to_tensor(x), axis=[0, 2]).numpy(), x.sum(axis=(0, 2)), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        paddle.mean(paddle.to_tensor(x), axis=1, keepdim=True).numpy(), x.mean(axis=1, keepdims=True), rtol=1e-5
+    )
+    check_grad(lambda t: paddle.max(t, axis=1), {"x": x})
+    np.testing.assert_allclose(paddle.logsumexp(paddle.to_tensor(x)).numpy(),
+                               np.log(np.sum(np.exp(x))), rtol=1e-5)
+
+
+def test_matmul_variants():
+    a, b = _f32(4, 5), _f32(5, 3)
+    check_forward(paddle.matmul, np.matmul, {"a": a, "b": b})
+    check_grad(paddle.matmul, {"a": a, "b": b})
+    # transpose flags
+    np.testing.assert_allclose(
+        paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b.T), transpose_y=True).numpy(),
+        a @ b, rtol=1e-5,
+    )
+    # batched
+    x, y = _f32(2, 4, 5), _f32(2, 5, 3)
+    check_forward(paddle.bmm, np.matmul, {"x": x, "y": y})
+
+
+def test_manipulation_ops():
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    t = paddle.to_tensor(x)
+    np.testing.assert_array_equal(paddle.reshape(t, [4, 6]).numpy(), x.reshape(4, 6))
+    np.testing.assert_array_equal(paddle.reshape(t, [0, -1]).numpy(), x.reshape(2, 12))
+    np.testing.assert_array_equal(paddle.transpose(t, [2, 0, 1]).numpy(), x.transpose(2, 0, 1))
+    np.testing.assert_array_equal(paddle.flatten(t, 1, 2).numpy(), x.reshape(2, 12))
+    np.testing.assert_array_equal(paddle.squeeze(paddle.ones([1, 3, 1])).shape, [3])
+    np.testing.assert_array_equal(paddle.unsqueeze(t, [0, 2]).shape, [1, 2, 1, 3, 4])
+    np.testing.assert_array_equal(paddle.tile(t, [1, 2, 1]).shape, [2, 6, 4])
+    np.testing.assert_array_equal(paddle.expand(paddle.ones([1, 3]), [5, 3]).shape, [5, 3])
+    np.testing.assert_array_equal(paddle.flip(t, [0]).numpy(), x[::-1])
+    np.testing.assert_array_equal(paddle.roll(t, 1, 0).numpy(), np.roll(x, 1, 0))
+    cat = paddle.concat([t, t], axis=1)
+    assert cat.shape == [2, 6, 4]
+    st = paddle.stack([t, t], axis=0)
+    assert st.shape == [2, 2, 3, 4]
+    parts = paddle.split(t, [1, 2], axis=1)
+    assert parts[0].shape == [2, 1, 4] and parts[1].shape == [2, 2, 4]
+    check_grad(lambda a: paddle.transpose(a, [1, 0]), {"x": _f32(3, 4)})
+    check_grad(lambda a: paddle.concat([a, a * 2], axis=0), {"x": _f32(2, 3)})
+
+
+def test_gather_scatter():
+    x = np.arange(12, dtype=np.float32).reshape(4, 3)
+    idx = np.array([0, 2])
+    t, i = paddle.to_tensor(x), paddle.to_tensor(idx)
+    np.testing.assert_array_equal(paddle.gather(t, i).numpy(), x[idx])
+    np.testing.assert_array_equal(paddle.index_select(t, i, axis=1).numpy(), x[:, [0, 2]])
+    upd = paddle.to_tensor(np.ones((2, 3), np.float32))
+    out = paddle.scatter(t, i, upd)
+    ref = x.copy(); ref[idx] = 1.0
+    np.testing.assert_array_equal(out.numpy(), ref)
+    # gather_nd
+    gidx = paddle.to_tensor(np.array([[0, 1], [3, 2]]))
+    np.testing.assert_array_equal(paddle.gather_nd(t, gidx).numpy(), [x[0, 1], x[3, 2]])
+    check_grad(lambda a: paddle.gather(a, paddle.to_tensor(idx)), {"x": x})
+
+
+def test_search_sort_ops():
+    x = rng.randn(3, 5).astype(np.float32)
+    t = paddle.to_tensor(x)
+    np.testing.assert_array_equal(paddle.argmax(t, axis=1).numpy(), x.argmax(1))
+    np.testing.assert_array_equal(paddle.argsort(t, axis=1).numpy(), x.argsort(1, kind="stable"))
+    np.testing.assert_allclose(paddle.sort(t, axis=1).numpy(), np.sort(x, 1), rtol=1e-6)
+    vals, idx = paddle.topk(t, 2, axis=1)
+    ref = np.sort(x, 1)[:, ::-1][:, :2]
+    np.testing.assert_allclose(vals.numpy(), ref, rtol=1e-6)
+    ss = paddle.searchsorted(paddle.to_tensor([1.0, 3.0, 5.0]), paddle.to_tensor([2.0, 6.0]))
+    np.testing.assert_array_equal(ss.numpy(), [1, 3])
+    u = paddle.unique(paddle.to_tensor([3, 1, 2, 1, 3]))
+    np.testing.assert_array_equal(u.numpy(), [1, 2, 3])
+    nz = paddle.nonzero(paddle.to_tensor([0, 1, 0, 2]))
+    np.testing.assert_array_equal(nz.numpy(), [[1], [3]])
+
+
+def test_linalg_ops():
+    a = _f32(4, 4)
+    spd = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+    t = paddle.to_tensor(spd)
+    np.testing.assert_allclose(paddle.linalg.cholesky(t).numpy(), np.linalg.cholesky(spd), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(paddle.linalg.det(t).item(), np.linalg.det(spd.astype(np.float64)), rtol=1e-3)
+    np.testing.assert_allclose(paddle.linalg.inv(t).numpy(), np.linalg.inv(spd), rtol=1e-3, atol=1e-4)
+    b = _f32(4, 2)
+    np.testing.assert_allclose(
+        paddle.linalg.solve(t, paddle.to_tensor(b)).numpy(), np.linalg.solve(spd, b), rtol=1e-3, atol=1e-4
+    )
+    np.testing.assert_allclose(paddle.norm(paddle.to_tensor(a)).item(), np.linalg.norm(a), rtol=1e-5)
+    w, v = paddle.linalg.eigh(t)
+    wref = np.linalg.eigvalsh(spd)
+    np.testing.assert_allclose(np.sort(w.numpy()), np.sort(wref), rtol=1e-4)
+    check_grad(paddle.linalg.det, {"x": spd})
+
+
+def test_einsum():
+    a, b = _f32(3, 4), _f32(4, 5)
+    np.testing.assert_allclose(paddle.einsum("ij,jk->ik", paddle.to_tensor(a), paddle.to_tensor(b)).numpy(),
+                               a @ b, rtol=1e-5)
+    check_grad(lambda x, y: paddle.einsum("bi,bj->ij", x, y), {"a": _f32(2, 3), "b": _f32(2, 4)})
+
+
+def test_cumulative():
+    x = _f32(3, 4)
+    np.testing.assert_allclose(paddle.cumsum(paddle.to_tensor(x), axis=1).numpy(), np.cumsum(x, 1), rtol=1e-5)
+    np.testing.assert_allclose(paddle.cumprod(paddle.to_tensor(x), dim=0).numpy(), np.cumprod(x, 0), rtol=1e-5)
+    check_grad(lambda a: paddle.cumsum(a, axis=0), {"x": x})
+
+
+def test_clip_and_where_grad():
+    x = _f32(4, 4)
+    check_grad(lambda a: paddle.clip(a, -0.5, 0.5), {"x": x})
+    check_grad(lambda a: paddle.where(a > 0, a * 2, a * 3), {"x": x})
+
+
+def test_pad_like_ops():
+    x = np.arange(6, dtype=np.float32).reshape(2, 3)
+    t = paddle.to_tensor(x)
+    np.testing.assert_array_equal(paddle.tril(t).numpy(), np.tril(x))
+    np.testing.assert_array_equal(paddle.triu(t).numpy(), np.triu(x))
+    np.testing.assert_array_equal(paddle.diag(paddle.to_tensor([1.0, 2.0])).numpy(), np.diag([1.0, 2.0]))
+
+
+def test_logic_ops():
+    a = paddle.to_tensor([True, False, True])
+    b = paddle.to_tensor([True, True, False])
+    np.testing.assert_array_equal(paddle.logical_and(a, b).numpy(), [True, False, False])
+    np.testing.assert_array_equal(paddle.logical_or(a, b).numpy(), [True, True, True])
+    np.testing.assert_array_equal(paddle.logical_not(a).numpy(), [False, True, False])
+    x = paddle.to_tensor([1, 2, 3])
+    np.testing.assert_array_equal((x & paddle.to_tensor([3, 3, 3])).numpy(), [1, 2, 3])
+    assert paddle.allclose(paddle.to_tensor([1.0]), paddle.to_tensor([1.0 + 1e-9])).item()
+    assert paddle.equal_all(x, x).item()
+
+
+def test_stat_ops():
+    x = _f32(100)
+    np.testing.assert_allclose(paddle.median(paddle.to_tensor(x)).item(), np.median(x), rtol=1e-5)
+    np.testing.assert_allclose(
+        paddle.quantile(paddle.to_tensor(x), 0.3).item(), np.quantile(x, 0.3), rtol=1e-4
+    )
+    h = paddle.histogram(paddle.to_tensor(x), bins=10, min=-3, max=3)
+    np.testing.assert_array_equal(h.numpy(), np.histogram(x, bins=10, range=(-3, 3))[0])
+    np.testing.assert_array_equal(
+        paddle.bincount(paddle.to_tensor([0, 1, 1, 4])).numpy(), np.bincount([0, 1, 1, 4])
+    )
+
+
+def test_split_nondivisible_raises():
+    with pytest.raises(ValueError):
+        paddle.split(paddle.arange(7), 3)
+
+
+def test_index_add():
+    x = paddle.zeros([3, 4])
+    out = paddle.index_add(x, paddle.to_tensor([0, 2]), 0, paddle.ones([2, 4]))
+    assert out.numpy().sum() == 8 and out.numpy()[1].sum() == 0
+    out2 = paddle.index_add(x, paddle.to_tensor([1]), 1, paddle.ones([3, 1]))
+    assert out2.numpy()[:, 1].sum() == 3
+
+
+def test_unfold_window_dim_last():
+    x = paddle.to_tensor(np.arange(20, dtype=np.float32).reshape(4, 5))
+    out = x.unfold(0, 2, 1)
+    assert out.shape == [3, 5, 2]
+    np.testing.assert_array_equal(out.numpy()[0, :, 0], np.arange(5))
+    np.testing.assert_array_equal(out.numpy()[0, :, 1], np.arange(5, 10))
+
+
+def test_topk_single_dispatch_grad():
+    x = np.random.RandomState(3).randn(4, 6).astype(np.float32)
+    t = paddle.to_tensor(x); t.stop_gradient = False
+    vals, idx = paddle.topk(t, 2, axis=1)
+    assert idx.dtype == paddle.int64 and idx.stop_gradient
+    vals.sum().backward()
+    ref = np.zeros_like(x)
+    srt = np.argsort(-x, axis=1)[:, :2]
+    for r in range(4):
+        ref[r, srt[r]] = 1.0
+    np.testing.assert_allclose(t.grad.numpy(), ref)

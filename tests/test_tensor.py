@@ -1,0 +1,125 @@
+"""Tensor basics: creation, dtype, indexing, methods, host interop.
+
+Models test/legacy_test tensor tests (e.g. test_Tensor_type.py,
+test_tensor_fill_.py) at the API level.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_to_tensor_dtypes():
+    t = paddle.to_tensor([1.0, 2.0])
+    assert t.dtype == paddle.float32
+    assert t.shape == [2]
+    t64 = paddle.to_tensor(np.array([1.0]), dtype="float64")
+    assert t64.dtype == paddle.float64
+    ti = paddle.to_tensor([1, 2, 3])
+    assert ti.dtype == paddle.int64
+    tb = paddle.to_tensor([True, False])
+    assert tb.dtype == paddle.bool
+    # float64 numpy input downcasts to default dtype (paddle semantics)
+    tf = paddle.to_tensor(np.zeros(3))
+    assert tf.dtype == paddle.float32
+
+
+def test_creation_ops():
+    assert paddle.zeros([2, 3]).numpy().sum() == 0
+    assert paddle.ones([2, 3], dtype="int32").dtype == paddle.int32
+    f = paddle.full([2, 2], 7)
+    assert f.dtype == paddle.int64 and f.numpy().sum() == 28
+    a = paddle.arange(1, 10, 2)
+    np.testing.assert_array_equal(a.numpy(), np.arange(1, 10, 2))
+    e = paddle.eye(3)
+    np.testing.assert_array_equal(e.numpy(), np.eye(3, dtype=np.float32))
+    lin = paddle.linspace(0, 1, 5)
+    np.testing.assert_allclose(lin.numpy(), np.linspace(0, 1, 5), rtol=1e-6)
+
+
+def test_indexing():
+    x = paddle.to_tensor(np.arange(24).reshape(2, 3, 4).astype(np.float32))
+    np.testing.assert_array_equal(x[0].numpy(), np.arange(12).reshape(3, 4))
+    np.testing.assert_array_equal(x[:, 1, ::2].numpy(), np.arange(24).reshape(2, 3, 4)[:, 1, ::2])
+    idx = paddle.to_tensor([0, 1])
+    np.testing.assert_array_equal(x[idx].shape, [2, 3, 4])
+    y = paddle.zeros([3, 3])
+    y[1, :] = 5.0
+    assert y.numpy()[1].sum() == 15.0
+    y[0, 0] = paddle.to_tensor(2.0)
+    assert y.numpy()[0, 0] == 2.0
+
+
+def test_methods_and_dunders():
+    x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert (x + 1).numpy()[0, 0] == 2.0
+    assert (1 + x).numpy()[0, 0] == 2.0
+    assert (x * 2 - 1).numpy()[1, 1] == 7.0
+    assert (x / 2).dtype == paddle.float32
+    assert (x ** 2).numpy()[1, 0] == 9.0
+    assert (x @ x).shape == [2, 2]
+    assert (-x).numpy()[0, 1] == -2.0
+    assert x.T.shape == [2, 2]
+    assert x.mean().item() == 2.5
+    assert x.sum(axis=0).numpy().tolist() == [4.0, 6.0]
+    assert x.reshape([4]).shape == [4]
+    assert x.astype("int32").dtype == paddle.int32
+    assert float(x.max()) == 4.0
+    assert x.numel() == 4 and x.ndim == 2
+    assert len(x) == 2
+    assert bool(paddle.to_tensor(True))
+    with pytest.raises(ValueError):
+        bool(x)
+
+
+def test_comparisons_and_where():
+    x = paddle.to_tensor([1.0, 2.0, 3.0])
+    m = x > 1.5
+    assert m.dtype == paddle.bool
+    out = paddle.where(m, x, paddle.zeros_like(x))
+    np.testing.assert_array_equal(out.numpy(), [0.0, 2.0, 3.0])
+
+
+def test_detach_and_clone():
+    x = paddle.to_tensor([1.0]);  x.stop_gradient = False
+    y = x * 2
+    d = y.detach()
+    assert d.stop_gradient
+    c = x.clone()
+    assert not c.stop_gradient or c.is_leaf  # clone keeps graph
+
+
+def test_inplace_ops():
+    x = paddle.to_tensor([1.0, 2.0])
+    x.add_(1.0)
+    np.testing.assert_array_equal(x.numpy(), [2.0, 3.0])
+    x.scale_(2.0)
+    np.testing.assert_array_equal(x.numpy(), [4.0, 6.0])
+    x.zero_()
+    assert x.numpy().sum() == 0
+
+
+def test_cast_and_item():
+    x = paddle.to_tensor(3.5)
+    assert x.item() == 3.5
+    assert int(x) == 3
+    assert paddle.to_tensor([1, 2]).astype(paddle.float32).dtype == paddle.float32
+
+
+def test_random_reproducibility():
+    paddle.seed(42)
+    a = paddle.randn([4, 4]).numpy()
+    paddle.seed(42)
+    b = paddle.randn([4, 4]).numpy()
+    np.testing.assert_array_equal(a, b)
+    c = paddle.randn([4, 4]).numpy()
+    assert not np.array_equal(b, c)
+
+
+def test_save_restore_rng_state():
+    paddle.seed(7)
+    s = paddle.get_rng_state()
+    a = paddle.rand([3]).numpy()
+    paddle.set_rng_state(s)
+    b = paddle.rand([3]).numpy()
+    np.testing.assert_array_equal(a, b)
