@@ -1,1 +1,256 @@
-# placeholder, filled in by subsequent milestones
+"""Automatic mixed precision.
+
+Reference parity: python/paddle/amp/ — auto_cast (auto_cast.py:860 / impl
+amp_guard:359; O1 list-based cast, O2 pure low-precision), GradScaler
+(grad_scaler.py:619 — dynamic loss scaling with found_inf), op allow/deny
+lists (amp_lists.py). TPU-native: the default low dtype is bfloat16 — same
+dynamic range as f32, so GradScaler degenerates to identity unless float16 is
+requested explicitly (kept fully functional for fp16).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+from jax import numpy as jnp
+
+from ..core import state as core_state
+from ..core.tensor import Tensor
+from ..core.state import no_grad
+from ..framework import dtype as dtype_mod
+
+# O1 lists (subset of python/paddle/amp/amp_lists.py: matmul-class ops run low
+# precision, reductions/norms/exp-class stay f32)
+WHITE_LIST = {
+    "matmul", "linear", "conv1d", "conv2d", "conv3d", "bmm", "mm", "einsum",
+    "scaled_dot_product_attention",
+}
+BLACK_LIST = {
+    "exp", "log", "log2", "log10", "log1p", "expm1", "pow", "square", "sqrt",
+    "rsqrt", "softmax", "log_softmax", "cross_entropy", "layer_norm",
+    "batch_norm", "group_norm", "rms_norm", "mean", "sum", "norm",
+    "logsumexp", "cumsum", "softmax_with_cross_entropy",
+}
+
+
+class AmpState:
+    def __init__(self, enable, dtype, level, custom_white_list=None, custom_black_list=None):
+        self.enable = enable
+        self.dtype = dtype_mod.convert_dtype(dtype)
+        self.level = level.upper()
+        self.white = set(WHITE_LIST) | set(custom_white_list or ())
+        self.black = set(BLACK_LIST) | set(custom_black_list or ())
+
+
+class auto_cast:
+    """paddle.amp.auto_cast context manager + decorator."""
+
+    def __init__(self, enable=True, custom_white_list=None, custom_black_list=None, level="O1", dtype="bfloat16", use_promote=True):
+        if level.upper() not in ("O0", "O1", "O2"):
+            raise ValueError(f"amp level must be O0/O1/O2, got {level}")
+        self.state = AmpState(enable and level.upper() != "O0", dtype, level, custom_white_list, custom_black_list)
+
+    def __enter__(self):
+        self._prev = core_state.set_amp_state(self.state if self.state.enable else None)
+        return self
+
+    def __exit__(self, *exc):
+        core_state.set_amp_state(self._prev)
+        return False
+
+    def __call__(self, fn):
+        ctx_state = self.state  # reuse the SAME AmpState (keeps custom lists)
+
+        @functools.wraps(fn)
+        def wrapper(*a, **kw):
+            prev = core_state.set_amp_state(ctx_state if ctx_state.enable else None)
+            try:
+                return fn(*a, **kw)
+            finally:
+                core_state.set_amp_state(prev)
+
+        return wrapper
+
+
+amp_guard = auto_cast
+
+
+def amp_cast_inputs(name: str, raw_values):
+    """Called from the op-apply hot path: cast inputs per the active AMP state.
+
+    O1: whitelist ops run in low precision, blacklist ops in float32,
+    everything else follows its inputs (paddle amp_guard semantics).
+    """
+    st = core_state.get_amp_state()
+    if st is None:
+        return raw_values
+    low = st.dtype
+
+    def cast_to(vals, d):
+        out = []
+        for v in vals:
+            if hasattr(v, "dtype") and jnp.issubdtype(jnp.result_type(v), jnp.floating) and v.dtype != d:
+                out.append(v.astype(d))
+            else:
+                out.append(v)
+        return out
+
+    if st.level == "O2":
+        if name in st.black:
+            return cast_to(raw_values, jnp.float32)
+        return cast_to(raw_values, low)
+    # O1
+    if name in st.white:
+        return cast_to(raw_values, low)
+    if name in st.black:
+        return cast_to(raw_values, jnp.float32)
+    return raw_values
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16", master_weight=None, save_dtype=None):
+    """paddle.amp.decorate: O2 converts model params to the low dtype.
+    Optimizers keep f32 master accumulators (built-in in our optimizers)."""
+    single = not isinstance(models, (list, tuple))
+    ms = [models] if single else list(models)
+    if level.upper() == "O2":
+        for m in ms:
+            m.to(dtype=dtype)
+    if optimizers is None:
+        return models
+    return models, optimizers
+
+
+class GradScaler:
+    """Dynamic loss scaling (python/paddle/amp/grad_scaler.py:619).
+
+    On TPU with bfloat16 this is an identity passthrough when disabled;
+    fully functional for float16 training. The scale/bookkeeping updates are
+    branchless (jnp.where) so the whole scaler traces into a captured step.
+    """
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15, incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000, decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = Tensor(jnp.asarray(init_loss_scaling, jnp.float32))
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = Tensor(jnp.zeros((), jnp.int32))
+        self._bad_steps = Tensor(jnp.zeros((), jnp.int32))
+        self._found_inf = Tensor(jnp.zeros((), jnp.bool_))
+        self._unscaled: set = set()  # optimizer ids already unscaled this step
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_loss_scaling(self):
+        return self._scale
+
+    def scale(self, loss):
+        if not self._enable:
+            return loss
+        from ..core.apply import apply
+
+        return apply("amp_scale", lambda l, s: l * s.astype(l.dtype), loss, self._scale)
+
+    @no_grad()
+    def unscale_(self, optimizer):
+        if not self._enable or id(optimizer) in self._unscaled:
+            return
+        self._unscaled.add(id(optimizer))
+        params = [p for _, p in optimizer._all_params() if p.grad is not None]
+        if not params:
+            return
+        inv = 1.0 / self._scale._value
+        found = jnp.zeros((), jnp.bool_)
+        for p in params:
+            g = p.grad._value.astype(jnp.float32) * inv
+            found = found | ~jnp.all(jnp.isfinite(g))
+            p.grad._replace_value(g.astype(p.grad._value.dtype))
+        self._found_inf._replace_value(found)
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        self._maybe_step(optimizer)
+        self._unscaled.discard(id(optimizer))
+        self.update()
+
+    @no_grad()
+    def _maybe_step(self, optimizer):
+        # branchless skip: run the step, then blend EVERY mutated piece of
+        # optimizer state (params, accumulators, step count) back to its
+        # pre-step value when inf was found. Equivalent to skipping the step
+        # (paddle semantics) while staying fully traceable under capture —
+        # no host sync on found_inf.
+        params = [p for _, p in optimizer._all_params()]
+        old_params = {id(p): p._value for p in params}
+        old_accs = {
+            name: dict(store_vals)
+            for name, store_vals in (
+                (n, {k: t._value for k, t in s.items()}) for n, s in optimizer._accumulators.items()
+            )
+        }
+        old_step = optimizer._step_count._value
+        optimizer.step()
+        found = self._found_inf._value
+        for p in params:
+            p._replace_value(jnp.where(found, old_params[id(p)], p._value))
+        for name, store in optimizer._accumulators.items():
+            fill = optimizer._accumulator_fills.get(name, 0.0)
+            olds = old_accs.get(name, {})
+            for k, t in store.items():
+                old = olds.get(k)
+                if old is None:
+                    # accumulator born inside this step: pre-step value is its fill
+                    old = jnp.full(t._value.shape, fill, t._value.dtype)
+                t._replace_value(jnp.where(found, old, t._value))
+        optimizer._step_count._replace_value(jnp.where(found, old_step, optimizer._step_count._value))
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+
+    @no_grad()
+    def update(self):
+        if not (self._enable and self._dynamic):
+            return
+        found = self._found_inf._value
+        good = jnp.where(found, 0, self._good_steps._value + 1)
+        bad = jnp.where(found, self._bad_steps._value + 1, 0)
+        scale = self._scale._value
+        scale = jnp.where(bad >= self._decr_every, jnp.maximum(scale * self._decr_ratio, 1.0), scale)
+        bad = jnp.where(bad >= self._decr_every, 0, bad).astype(jnp.int32)
+        scale = jnp.where(good >= self._incr_every, scale * self._incr_ratio, scale)
+        good = jnp.where(good >= self._incr_every, 0, good).astype(jnp.int32)
+        self._scale._replace_value(scale)
+        self._good_steps._replace_value(good)
+        self._bad_steps._replace_value(bad)
+
+    def state_dict(self):
+        return {
+            "scale": self._scale,
+            "good_steps": self._good_steps,
+            "bad_steps": self._bad_steps,
+        }
+
+    def load_state_dict(self, sd):
+        for key, t in (("scale", self._scale), ("good_steps", self._good_steps), ("bad_steps", self._bad_steps)):
+            if key in sd:
+                v = sd[key]
+                t._replace_value(v._value if isinstance(v, Tensor) else jnp.asarray(v))
+
+
+def is_bfloat16_supported(device=None):
+    return True
+
+
+def is_float16_supported(device=None):
+    return True

@@ -42,6 +42,17 @@ def apply(name: str, fn: Callable, *args, n_outputs=None, **kwargs):
         else:
             raw.append(a)
 
+    amp_active = state.get_amp_state() is not None
+    if amp_active:
+        # the cast must live INSIDE the differentiated function so the vjp
+        # transposes it (cotangents convert back to the param dtype)
+        from ..amp import amp_cast_inputs
+
+        inner_fn = fn
+
+        def fn(*vals, **kw):  # noqa: F811
+            return inner_fn(*amp_cast_inputs(name, list(vals)), **kw)
+
     grad_on = state.is_grad_enabled()
     diff_pos = [i for i in tensor_pos if _differentiable(args[i])] if grad_on else []
 

@@ -217,6 +217,7 @@ def _leaf_accumulate(tensor, cot, accumulate_fn):
         return
     from .tensor import Tensor
 
+    state.record_grad_write(tensor)  # pre-write: capture original for undo
     if tensor.grad is None:
         tensor.grad = Tensor(cot, stop_gradient=True)
     else:

@@ -106,6 +106,18 @@ def record_write(tensor):
         rec.on_write(tensor)
 
 
+def record_create(tensor):
+    rec = _tls.recorder
+    if rec is not None:
+        rec.on_create(tensor)
+
+
+def record_grad_write(tensor):
+    rec = _tls.recorder
+    if rec is not None:
+        rec.on_grad_write(tensor)
+
+
 # ---- AMP state (set by paddle_tpu.amp.auto_cast) ----
 
 def get_amp_state():

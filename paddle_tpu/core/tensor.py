@@ -47,6 +47,7 @@ class Tensor:
         self.name = name
         self.persistable = False
         self._backward_hooks = []
+        state.record_create(self)
 
     # ---- raw value access (trace-recorded) ----
     @property
@@ -59,25 +60,27 @@ class Tensor:
         return self._value
 
     def set_value(self, value):
-        """In-place value replacement (paddle Tensor.set_value). Detaches."""
+        """In-place value replacement (paddle Tensor.set_value). Detaches.
+        record_write fires BEFORE mutation so program capture can snapshot
+        the pre-write value (needed to undo trace-time side effects)."""
         if isinstance(value, Tensor):
             value = value._value
         elif not isinstance(value, (jax.Array, jax.core.Tracer)):
             value = jnp.asarray(value, dtype=self._value.dtype)
+        state.record_write(self)
         self._value = value
         self._grad_node = None
         self._out_index = 0
-        state.record_write(self)
         return self
 
     def _replace_value(self, value):
         """Functional-update write used by optimizers / in-place ops: keeps
         autograd detachment semantics of set_value but is the designated
         mutation point recorded by to_static capture."""
+        state.record_write(self)
         self._value = value
         self._grad_node = None
         self._out_index = 0
-        state.record_write(self)
         return self
 
     def _become(self, other: "Tensor"):
@@ -86,12 +89,12 @@ class Tensor:
         stop_gradient only flips to False when the result carries a grad node;
         an in-place update under no_grad() must NOT freeze a trainable param.
         """
+        state.record_write(self)
         self._value = other._value
         self._grad_node = other._grad_node
         self._out_index = other._out_index
         if other._grad_node is not None:
             self.stop_gradient = other.stop_gradient
-        state.record_write(self)
         return self
 
     # ---- metadata ----
@@ -170,6 +173,7 @@ class Tensor:
         autograd_engine.run_backward([self], [grad_tensor], retain_graph=retain_graph)
 
     def clear_grad(self):
+        state.record_grad_write(self)
         self.grad = None
 
     clear_gradient = clear_grad

@@ -1,6 +1,54 @@
-"""paddle.save/load — filled in at the checkpoint milestone."""
-def save(obj, path, **kw):
-    raise NotImplementedError
+"""paddle.save / paddle.load.
 
-def load(path, **kw):
-    raise NotImplementedError
+Reference parity: python/paddle/framework/io.py:723,960 — pickle-protocol
+state persistence for nn.Layer state_dicts, optimizer states, and arbitrary
+nested structures of Tensors. Tensors serialize as numpy arrays (device
+round-trip through host, like the reference's CPU staging).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+
+def _to_host(obj):
+    from ..core.tensor import Tensor
+
+    if isinstance(obj, Tensor):
+        return {"__paddle_tpu_tensor__": True, "data": obj.numpy(), "stop_gradient": obj.stop_gradient, "name": obj.name}
+    if isinstance(obj, dict):
+        return {k: _to_host(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_to_host(v) for v in obj)
+    return obj
+
+
+def _from_host(obj, return_numpy=False):
+    from ..core.tensor import Tensor
+
+    if isinstance(obj, dict):
+        if obj.get("__paddle_tpu_tensor__"):
+            if return_numpy:
+                return obj["data"]
+            return Tensor(obj["data"], stop_gradient=obj.get("stop_gradient", True), name=obj.get("name"))
+        return {k: _from_host(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_from_host(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_host(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **configs):
+    with open(path, "rb") as f:
+        data = pickle.load(f)
+    return _from_host(data, return_numpy)

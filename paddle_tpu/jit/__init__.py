@@ -1,3 +1,2 @@
-# placeholder, filled in by subsequent milestones
-def to_static(fn=None, **kw):
-    raise NotImplementedError
+"""paddle.jit namespace (python/paddle/jit/__init__.py)."""
+from .api import StaticFunction, cond, ignore_module, not_to_static, to_static  # noqa: F401

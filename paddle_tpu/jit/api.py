@@ -1,0 +1,357 @@
+"""Program capture: to_static.
+
+Reference parity: python/paddle/jit/api.py:135 (to_static) +
+dy2static/pir_partial_program.py (run captured program as one fused op) +
+the SOT guard-based retrace policy (python/paddle/jit/sot/).
+
+TPU-native design: instead of bytecode translation building a PIR program,
+capture = (1) one eager "recording" run that discovers the program state
+(every framework Tensor read or mutated — params, buffers, optimizer
+accumulators, LR), then (2) jax.jit of a functionalized replay: state in ->
+(outputs, state out). The whole train step — forward, tape backward, optimizer
+update — traces into ONE XLA program (CINN's role is played by XLA). Guards:
+input shapes/dtypes + layer train/eval epoch; any change retraces.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+from jax import numpy as jnp, tree_util
+
+from ..core import state as core_state
+from ..core.tensor import Tensor
+from ..framework import random as random_mod
+
+
+class _Recorder:
+    """Active during the recording run: collects framework-state tensors."""
+
+    def __init__(self, exclude_ids):
+        self.reads: "dict[int, Tensor]" = {}
+        self.writes: "dict[int, Tensor]" = {}
+        self.grad_writes: "dict[int, Tensor]" = {}
+        self.created: set = set()
+        self.exclude = exclude_ids
+
+    def on_create(self, t):
+        self.created.add(id(t))
+
+    def on_read(self, t):
+        # only persistent framework state counts: not the call's inputs, not
+        # temporaries created inside the recorded run
+        if id(t) in self.exclude or id(t) in self.created:
+            return
+        if not isinstance(t._value, jax.core.Tracer):
+            self.reads.setdefault(id(t), t)
+
+    def on_write(self, t):
+        if id(t) in self.exclude or id(t) in self.created:
+            return
+        # fires pre-mutation: snapshot the original value so trace-time side
+        # effects on not-yet-known state can be undone
+        self.writes.setdefault(id(t), (t, t._value))
+        self.reads.setdefault(id(t), t)
+
+    def on_grad_write(self, t):
+        if id(t) in self.created:
+            return
+        # pre-write: snapshot original .grad for undo
+        self.grad_writes.setdefault(id(t), (t, t.grad))
+
+
+def _tensor_flatten(obj):
+    """Flatten args pytree with Tensor leaves -> (raw leaves, rebuild)."""
+    leaves, treedef = tree_util.tree_flatten(obj, is_leaf=lambda x: isinstance(x, Tensor))
+    tensor_idx = [i for i, l in enumerate(leaves) if isinstance(l, Tensor)]
+    raw = [leaves[i]._value for i in tensor_idx]
+    sg = [leaves[i].stop_gradient for i in tensor_idx]
+
+    def rebuild(new_raw):
+        out = list(leaves)
+        for i, v, s in zip(tensor_idx, new_raw, sg):
+            t = Tensor(v)
+            t.stop_gradient = s
+            out[i] = t
+        return tree_util.tree_unflatten(treedef, out)
+
+    return raw, tensor_idx, leaves, treedef, rebuild
+
+
+class StaticFunction:
+    """The compiled-callable wrapper (analog of dy2static StaticFunction)."""
+
+    def __init__(self, fn: Callable, build_strategy=None, full_graph=True):
+        self._fn = fn
+        self._cache: dict = {}
+        functools.update_wrapper(self, fn, updated=[])
+
+    # guard key: arg structure + shapes/dtypes + global layer-mode epoch + grad mode
+    def _guard_key(self, args, kwargs):
+        def leaf_key(x):
+            if isinstance(x, Tensor):
+                return ("T", tuple(x._value.shape), str(x._value.dtype), x.stop_gradient)
+            if isinstance(x, (int, float, bool, str, bytes, type(None))):
+                return ("C", x)
+            return ("O", type(x).__name__)
+
+        leaves, treedef = tree_util.tree_flatten((args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
+        from ..nn.layer import Layer
+
+        return (
+            tuple(leaf_key(l) for l in leaves),
+            str(treedef),
+            _mode_epoch[0],
+            core_state.is_grad_enabled(),
+        )
+
+    def __call__(self, *args, **kwargs):
+        key = self._guard_key(args, kwargs)
+        entry = self._cache.get(key)
+        if entry is None:
+            entry = self._trace(args, kwargs, key)
+            if entry is None:  # recording run already produced the result
+                return self._last_record_output
+        return self._run_compiled(entry, args, kwargs)
+
+    # ---- phase 1: eager recording run ----
+    def _trace(self, args, kwargs, key):
+        arg_leaves = [l for l in tree_util.tree_leaves((args, kwargs), is_leaf=lambda x: isinstance(x, Tensor)) if isinstance(l, Tensor)]
+        rec = _Recorder(exclude_ids={id(t) for t in arg_leaves})
+        prev = core_state.set_recorder(rec)
+        try:
+            out = self._fn(*args, **kwargs)
+        finally:
+            core_state.set_recorder(prev)
+
+        state_tensors = list(rec.reads.values())
+        grad_tensors = [t for t, _ in rec.grad_writes.values()]
+        entry = _CompiledEntry(self._fn, state_tensors, grad_tensors)
+        self._cache[key] = entry
+        self._last_record_output = out
+        return None  # signal: output already computed by the recording run
+
+    def _run_compiled(self, entry, args, kwargs):
+        return entry.run(args, kwargs)
+
+    @property
+    def code(self):
+        import inspect
+
+        try:
+            return inspect.getsource(self._fn)
+        except OSError:
+            return "<source unavailable>"
+
+    def concrete_program(self):
+        return self._cache
+
+
+class _CompiledEntry:
+    def __init__(self, fn, state_tensors, grad_tensors):
+        self.fn = fn
+        self.state = state_tensors
+        self.grad_tensors = grad_tensors
+        self.jitted = None
+        self.out_rebuild = None
+
+    def _grad_inputs(self):
+        """Incoming .grad values (accumulation pattern): mask + present values."""
+        vals = [t.grad._value if t.grad is not None else None for t in self.grad_tensors]
+        mask = tuple(v is not None for v in vals)
+        return mask, [v for v in vals if v is not None]
+
+    def run(self, args, kwargs):
+        raw_args, t_idx, leaves, treedef, _ = _tensor_flatten((args, kwargs))
+        rng = random_mod.next_key()
+
+        if self.jitted is not None and self._grad_inputs()[0] != self.grad_in_mask:
+            self.jitted = None  # grad presence changed -> rebuild
+
+        if self.jitted is None:
+            # Fixpoint state discovery: any CONCRETE tensor read during tracing
+            # is framework state the eager recording missed (e.g. optimizer
+            # accumulators created lazily inside the recorded step) — it must
+            # become a program input, not a baked constant. Re-trace until the
+            # trace touches no concrete framework tensors.
+            for _ in range(8):
+                self._build(args, kwargs, treedef, t_idx, leaves)
+                rec = _Recorder(exclude_ids=set())
+                prev = core_state.set_recorder(rec)
+                try:
+                    traced = self.jitted.trace(
+                        raw_args, [t._value for t in self.state], rng, self._grad_inputs()[1]
+                    )
+                finally:
+                    core_state.set_recorder(prev)
+                known = {id(t) for t in self.state}
+                # undo trace-time mutation of tensors pure()'s finally doesn't
+                # cover (state discovered only this iteration)
+                for tid, (t, orig) in rec.writes.items():
+                    if tid not in known and isinstance(t._value, jax.core.Tracer):
+                        t._value = orig
+                        t._grad_node = None
+                known_grads = {id(g) for g in self.grad_tensors}
+                for tid, (t, orig_g) in rec.grad_writes.items():
+                    if tid not in known_grads and t.grad is not None and isinstance(t.grad._value, jax.core.Tracer):
+                        t.grad = orig_g
+                missed = [t for t in rec.reads.values() if id(t) not in known]
+                new_grad_ts = [
+                    t for t, _ in rec.grad_writes.values() if id(t) not in known_grads
+                ]
+                self.grad_tensors.extend(new_grad_ts)
+                if not missed and not new_grad_ts:
+                    self.jitted = traced.lower().compile()
+                    break
+                self.state.extend(missed)
+            else:
+                raise RuntimeError("to_static: state discovery did not converge")
+
+        state_vals = [t._value for t in self.state]
+        outs, new_state, new_grads = self.jitted(raw_args, state_vals, rng, self._grad_inputs()[1])
+        # write back mutated state
+        for t, mask, v in zip(self.state, self.mut_mask, new_state):
+            if mask:
+                t._replace_value(v)
+                if hasattr(t, "trainable"):
+                    t.stop_gradient = not t.trainable
+        for t, v in zip(self.grad_tensors, new_grads):
+            t.grad = Tensor(v) if v is not None else None
+        return self._rebuild_out(outs)
+
+    def _build(self, args, kwargs, treedef, t_idx, template_leaves):
+        entry = self
+        state = self.state
+        grad_ts = self.grad_tensors
+        fn = self.fn
+        gen = random_mod.default_generator()
+        grad_in_mask = self._grad_inputs()[0]
+        self.grad_in_mask = grad_in_mask
+
+        def pure(raw_args, state_vals, rng, grad_vals):
+            # reconstruct args with tracer-backed Tensors
+            new_leaves = list(template_leaves)
+            for i, v in zip(t_idx, raw_args):
+                t = Tensor(v)
+                t.stop_gradient = template_leaves[i].stop_gradient
+                new_leaves[i] = t
+            a, kw = tree_util.tree_unflatten(treedef, new_leaves)
+
+            originals = [t._value for t in state]
+            orig_nodes = [(t._grad_node, t._out_index) for t in state]
+            orig_grads = [t.grad for t in grad_ts]
+            markers = list(state_vals)
+            try:
+                for t, v in zip(state, state_vals):
+                    t._value = v
+                    t._grad_node = None
+                gi = iter(grad_vals)
+                for t, present in zip(grad_ts, grad_in_mask):
+                    t.grad = Tensor(next(gi)) if present else None
+                with gen.trace_scope(rng):
+                    out = fn(*a, **kw)
+                out_raw, out_spec = _flatten_output(out)
+                new_state = [t._value for t in state]
+                mutated = [ns is not m for ns, m in zip(new_state, markers)]
+                new_grads = [t.grad._value if t.grad is not None else None for t in grad_ts]
+                entry.out_spec = out_spec
+                entry.mut_mask = mutated
+                return out_raw, new_state, new_grads
+            finally:
+                for t, v, (n, oi) in zip(state, originals, orig_nodes):
+                    t._value = v
+                    t._grad_node = n
+                    t._out_index = oi
+                for t, g in zip(grad_ts, orig_grads):
+                    t.grad = g
+
+        self.jitted = jax.jit(pure)
+
+    def _rebuild_out(self, out_raw):
+        return _unflatten_output(out_raw, self.out_spec)
+
+
+def _flatten_output(out):
+    leaves, treedef = tree_util.tree_flatten(out, is_leaf=lambda x: isinstance(x, Tensor))
+    raw = []
+    spec = []
+    for l in leaves:
+        if isinstance(l, Tensor):
+            raw.append(l._value)
+            spec.append(("T", l.stop_gradient))
+        else:
+            raw.append(None)
+            spec.append(("C", l))
+    return raw, (treedef, spec)
+
+
+def _unflatten_output(raw, out_spec):
+    treedef, spec = out_spec
+    leaves = []
+    for v, (kind, meta) in zip(raw, spec):
+        if kind == "T":
+            t = Tensor(v)
+            t.stop_gradient = meta
+            leaves.append(t)
+        else:
+            leaves.append(meta)
+    return tree_util.tree_unflatten(treedef, leaves)
+
+
+# global train/eval mode epoch for guard keys (bumped by Layer.train/eval)
+_mode_epoch = [0]
+
+
+def _bump_mode_epoch():
+    _mode_epoch[0] += 1
+
+
+def to_static(function=None, input_spec=None, build_strategy=None, backend=None, full_graph=True, **kwargs):
+    """paddle.jit.to_static — decorator or call (api.py:135)."""
+    from ..nn.layer import Layer
+
+    def decorate(fn):
+        if isinstance(fn, Layer):
+            layer = fn
+            orig_forward = layer.forward  # bind BEFORE replacement
+            sf = StaticFunction(lambda *a, **kw: orig_forward(*a, **kw))
+            layer.forward = sf
+            return layer
+        return StaticFunction(fn, build_strategy, full_graph)
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def not_to_static(fn):
+    fn._paddle_not_to_static = True
+    return fn
+
+
+def ignore_module(modules):
+    return None
+
+
+# ---- lax control-flow re-exports for data-dependent control under capture ----
+
+def cond(pred, true_fn, false_fn, *operands):
+    """paddle.static.nn.cond analog over lax.cond for captured programs."""
+    from ..core.apply import apply
+
+    pred_t = pred if isinstance(pred, Tensor) else Tensor(jnp.asarray(pred))
+    ts = [o for o in operands if isinstance(o, Tensor)]
+
+    def f(p, *vals):
+        return jax.lax.cond(p, lambda *v: _call_raw(true_fn, v), lambda *v: _call_raw(false_fn, v), *vals)
+
+    return apply("cond", f, pred_t, *ts)
+
+
+def _call_raw(fn, raw_vals):
+    ts = [Tensor(v) for v in raw_vals]
+    out = fn(*ts)
+    if isinstance(out, Tensor):
+        return out._value
+    return tuple(o._value for o in out)

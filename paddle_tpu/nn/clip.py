@@ -1,0 +1,98 @@
+"""Gradient clipping.
+
+Reference parity: python/paddle/nn/clip.py (ClipGradByValue, ClipGradByNorm,
+ClipGradByGlobalNorm:604 — the TP/PP-aware global-norm clip). Under SPMD the
+global norm over sharded grads is computed on the global view automatically
+(XLA inserts the psum), so the dist-aware special cases collapse.
+"""
+from __future__ import annotations
+
+import jax
+from jax import numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.apply import apply
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):  # noqa: A002
+        self.max = max
+        self.min = -max if min is None else min
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            out.append((p, apply("clip_by_value", lambda v: jnp.clip(v, self.min, self.max), g)))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+
+            def f(v):
+                n = jnp.sqrt(jnp.sum(jnp.square(v)))
+                scale = jnp.minimum(self.clip_norm / jnp.maximum(n, 1e-12), 1.0)
+                return v * scale
+
+            out.append((p, apply("clip_by_norm", f, g)))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group", auto_skip_clip=False):
+        self.clip_norm = clip_norm
+
+    def __call__(self, params_grads):
+        grads = [g for p, g in params_grads if g is not None and getattr(p, "need_clip", True)]
+        if not grads:
+            return params_grads
+
+        def fnorm(*gs):
+            sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in gs)
+            return jnp.sqrt(sq)
+
+        gnorm = apply("global_norm", fnorm, *grads)
+
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+
+            def f(v, n):
+                scale = self.clip_norm / jnp.maximum(n, self.clip_norm)
+                return v * scale.astype(v.dtype)
+
+            out.append((p, apply("global_norm_clip", f, g, gnorm)))
+        return out
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=False):
+    """torch-style utility also present in paddle.nn.utils."""
+    params = [p for p in (parameters if isinstance(parameters, (list, tuple)) else [parameters]) if p.grad is not None]
+    if not params:
+        return Tensor(jnp.zeros((), jnp.float32))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(p.grad._value)) for p in params]))
+    else:
+        total = jnp.sum(jnp.stack([jnp.sum(jnp.abs(p.grad._value) ** norm_type) for p in params])) ** (1.0 / norm_type)
+    scale = jnp.minimum(max_norm / jnp.maximum(total, 1e-6), 1.0)
+    for p in params:
+        p.grad._replace_value(p.grad._value * scale.astype(p.grad._value.dtype))
+    return Tensor(total)

@@ -1,0 +1,184 @@
+"""Activation functionals.
+
+Reference parity: python/paddle/nn/functional/activation.py. jax.nn provides
+TPU-tuned lowerings; XLA fuses these into adjacent matmuls.
+"""
+from __future__ import annotations
+
+import jax
+from jax import numpy as jnp
+
+from ...core.apply import apply
+from ...core.tensor import Tensor, _ensure_tensor
+
+
+def _t(x):
+    return _ensure_tensor(x)
+
+
+def relu(x, name=None):
+    return apply("relu", jax.nn.relu, _t(x))
+
+
+def relu_(x):
+    x._become(relu(x))
+    return x
+
+
+def relu6(x, name=None):
+    return apply("relu6", jax.nn.relu6, _t(x))
+
+
+def sigmoid(x, name=None):
+    return apply("sigmoid", jax.nn.sigmoid, _t(x))
+
+
+def tanh(x, name=None):
+    return apply("tanh", jnp.tanh, _t(x))
+
+
+def gelu(x, approximate=False, name=None):
+    return apply("gelu", lambda v: jax.nn.gelu(v, approximate=approximate), _t(x))
+
+
+def silu(x, name=None):
+    return apply("silu", jax.nn.silu, _t(x))
+
+
+swish = silu
+
+
+def mish(x, name=None):
+    return apply("mish", lambda v: v * jnp.tanh(jax.nn.softplus(v)), _t(x))
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply("leaky_relu", lambda v: jax.nn.leaky_relu(v, negative_slope), _t(x))
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply("elu", lambda v: jax.nn.elu(v, alpha), _t(x))
+
+
+def elu_(x, alpha=1.0):
+    x._become(elu(x, alpha))
+    return x
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply("selu", lambda v: scale * jnp.where(v > 0, v, alpha * jnp.expm1(v)), _t(x))
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply("celu", lambda v: jax.nn.celu(v, alpha), _t(x))
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):  # noqa: A002
+    return apply("hardtanh", lambda v: jnp.clip(v, min, max), _t(x))
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply("hardshrink", lambda v: jnp.where(jnp.abs(v) > threshold, v, jnp.zeros((), v.dtype)), _t(x))
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply(
+        "softshrink",
+        lambda v: jnp.where(v > threshold, v - threshold, jnp.where(v < -threshold, v + threshold, jnp.zeros((), v.dtype))),
+        _t(x),
+    )
+
+
+def tanhshrink(x, name=None):
+    return apply("tanhshrink", lambda v: v - jnp.tanh(v), _t(x))
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return apply("hardsigmoid", lambda v: jnp.clip(v * slope + offset, 0.0, 1.0), _t(x))
+
+
+def hardswish(x, name=None):
+    return apply("hardswish", lambda v: v * jnp.clip(v + 3.0, 0.0, 6.0) / 6.0, _t(x))
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return apply(
+        "softplus",
+        lambda v: jnp.where(v * beta > threshold, v, jax.nn.softplus(v * beta) / beta),
+        _t(x),
+    )
+
+
+def softsign(x, name=None):
+    return apply("softsign", jax.nn.soft_sign, _t(x))
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return apply("thresholded_relu", lambda v: jnp.where(v > threshold, v, jnp.asarray(value, v.dtype)), _t(x))
+
+
+def log_sigmoid(x, name=None):
+    return apply("log_sigmoid", jax.nn.log_sigmoid, _t(x))
+
+
+def maxout(x, groups, axis=1, name=None):
+    def f(v):
+        ax = axis % v.ndim
+        c = v.shape[ax]
+        newshape = v.shape[:ax] + (groups, c // groups) + v.shape[ax + 1:]
+        return jnp.max(v.reshape(newshape), axis=ax)
+
+    return apply("maxout", f, _t(x))
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    x = _t(x)
+    if dtype is not None:
+        x = x.astype(dtype)
+    return apply("softmax", lambda v: jax.nn.softmax(v, axis=axis), x)
+
+
+def softmax_(x, axis=-1):
+    x._become(softmax(x, axis))
+    return x
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    x = _t(x)
+    if dtype is not None:
+        x = x.astype(dtype)
+    return apply("log_softmax", lambda v: jax.nn.log_softmax(v, axis=axis), x)
+
+
+def glu(x, axis=-1, name=None):
+    return apply("glu", lambda v: jax.nn.glu(v, axis=axis), _t(x))
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def f(v, w):
+        if w.size == 1:
+            a = w.reshape(())
+        else:
+            ch_axis = 1 if data_format == "NCHW" else v.ndim - 1
+            shape = [1] * v.ndim
+            shape[ch_axis] = w.size
+            a = w.reshape(shape)
+        return jnp.where(v >= 0, v, a * v)
+
+    return apply("prelu", f, _t(x), _t(weight))
+
+
+def rrelu(x, lower=0.125, upper=0.3333333, training=True, name=None):
+    x = _t(x)
+    if training:
+        from ...framework import random as random_mod
+
+        key = random_mod.next_key()
+
+        def f(v):
+            a = jax.random.uniform(key, v.shape, dtype=jnp.float32, minval=lower, maxval=upper).astype(v.dtype)
+            return jnp.where(v >= 0, v, a * v)
+
+        return apply("rrelu", f, x)
+    mid = (lower + upper) / 2.0
+    return apply("rrelu_eval", lambda v: jnp.where(v >= 0, v, mid * v), x)

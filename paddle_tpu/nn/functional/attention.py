@@ -1,0 +1,108 @@
+"""Attention functionals.
+
+Reference parity: python/paddle/nn/functional/flash_attention.py
+(flash_attention:147, scaled_dot_product_attention:442). TPU-native design:
+the default kernel is XLA's fused attention lowering of the canonical
+softmax(QK^T)V chain (bf16 on MXU); a Pallas splash/flash kernel is swapped
+in by paddle_tpu.ops.pallas when available on-device. Layout is paddle's
+[batch, seqlen, num_heads, head_dim].
+"""
+from __future__ import annotations
+
+import math as _math
+
+import jax
+from jax import numpy as jnp
+
+from ...core.apply import apply
+from ...core.tensor import Tensor, _ensure_tensor
+
+
+def _t(x):
+    return _ensure_tensor(x)
+
+
+def _sdpa_ref(q, k, v, mask, causal, dropout_p, scale, training, key=None):
+    """Canonical attention in bnsd layout with f32 softmax accumulation."""
+    # [B, S, H, D] -> [B, H, S, D]
+    q = jnp.swapaxes(q, 1, 2)
+    k = jnp.swapaxes(k, 1, 2)
+    v = jnp.swapaxes(v, 1, 2)
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / _math.sqrt(d)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * s
+    if causal:
+        qlen, klen = logits.shape[-2], logits.shape[-1]
+        cm = jnp.tril(jnp.ones((qlen, klen), bool), k=klen - qlen)
+        logits = jnp.where(cm, logits, -1e30)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, -1e30)
+        else:
+            logits = logits + mask.astype(jnp.float32)
+    p = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    if dropout_p > 0.0 and training and key is not None:
+        keep = jax.random.bernoulli(key, 1.0 - dropout_p, p.shape)
+        p = jnp.where(keep, p / (1.0 - dropout_p), 0.0)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    return jnp.swapaxes(out, 1, 2)  # -> [B, S, H, D]
+
+
+def scaled_dot_product_attention(
+    query, key, value, attn_mask=None, dropout_p=0.0, is_causal=False, training=True, name=None
+):
+    """paddle layout [B, S, H, D]. Uses the Pallas flash kernel on TPU when
+    shapes allow, else the XLA-fused reference chain."""
+    q, k, v = _t(query), _t(key), _t(value)
+    rng_key = None
+    if dropout_p > 0.0 and training:
+        from ...framework import random as random_mod
+
+        rng_key = random_mod.next_key()
+
+    from ...ops import pallas as pallas_ops
+
+    args = [q, k, v]
+    if attn_mask is not None:
+        args.append(_t(attn_mask))
+
+        def f(qv, kv, vv, mv):
+            return _sdpa_ref(qv, kv, vv, mv, is_causal, dropout_p, None, training, rng_key)
+
+    else:
+        def f(qv, kv, vv):
+            if pallas_ops.flash_attention_usable(qv, is_causal, dropout_p if training else 0.0, kv, vv):
+                return pallas_ops.flash_attention_bshd(qv, kv, vv, causal=is_causal)
+            return _sdpa_ref(qv, kv, vv, None, is_causal, dropout_p, None, training, rng_key)
+
+    return apply("scaled_dot_product_attention", f, *args)
+
+
+def flash_attention(
+    query,
+    key,
+    value,
+    dropout=0.0,
+    causal=False,
+    return_softmax=False,
+    fixed_seed_offset=None,
+    rng_name="",
+    training=True,
+    name=None,
+):
+    """python/paddle/nn/functional/flash_attention.py:147 parity.
+    Returns (out, softmax_lse-placeholder) like the reference's (out, softmax)."""
+    out = scaled_dot_product_attention(
+        query, key, value, None, dropout, causal, training
+    )
+    if return_softmax:
+        raise NotImplementedError("return_softmax=True is debug-only in the reference; not supported")
+    return out, None
+
+
+def flash_attn_unpadded(*args, **kwargs):
+    raise NotImplementedError("varlen flash attention: use dense + mask on TPU")
+
+
+def multi_head_attention_forward(*args, **kwargs):
+    raise NotImplementedError

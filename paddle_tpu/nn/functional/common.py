@@ -1,0 +1,344 @@
+"""Common functionals: linear, dropout, embedding, pad, interpolate, one_hot...
+
+Reference parity: python/paddle/nn/functional/common.py, input.py.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax import numpy as jnp
+
+from ...core.apply import apply
+from ...core.tensor import Tensor, _ensure_tensor
+from ...core import state
+from ...framework import random as random_mod
+
+
+def _t(x):
+    return _ensure_tensor(x)
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b; W is [in, out] (paddle layout, fine for MXU)."""
+    if bias is None:
+        return apply("linear", lambda v, w: v @ w, _t(x), _t(weight))
+    return apply("linear", lambda v, w, b: v @ w + b, _t(x), _t(weight), _t(bias))
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    x = _t(x)
+    if not training:
+        # eval: upscale_in_train is identity; downscale_in_infer scales by 1-p
+        if mode == "upscale_in_train":
+            return x
+        return apply("dropout_eval", lambda v: v * (1.0 - p), x)
+    if p == 0.0:
+        return x
+    key = random_mod.next_key()
+
+    def f(v):
+        shape = v.shape
+        if axis is not None:
+            axes = [axis] if isinstance(axis, int) else list(axis)
+            shape = tuple(s if i in axes else 1 for i, s in enumerate(v.shape))
+        keep = jax.random.bernoulli(key, 1.0 - p, shape)
+        if mode == "upscale_in_train":
+            return jnp.where(keep, v / (1.0 - p), jnp.zeros((), v.dtype))
+        return jnp.where(keep, v, jnp.zeros((), v.dtype))
+
+    return apply("dropout", f, x)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axes = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p=p, axis=axes, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axes = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p=p, axis=axes, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    x = _t(x)
+    if not training or p == 0.0:
+        return x
+    key = random_mod.next_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def f(v):
+        keep = jax.random.bernoulli(key, 1.0 - p, v.shape)
+        a = (1.0 - p + p * alpha_p ** 2 * (1.0 - p)) ** -0.5
+        b = -a * alpha_p * p
+        return a * jnp.where(keep, v, jnp.asarray(alpha_p, v.dtype)) + b
+
+    return apply("alpha_dropout", f, x)
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """Lookup rows of weight. sparse is a no-op on TPU (XLA gathers)."""
+
+    def f(ids, w):
+        out = jnp.take(w, ids, axis=0)
+        if padding_idx is not None:
+            mask = (ids == padding_idx)[..., None]
+            out = jnp.where(mask, jnp.zeros((), out.dtype), out)
+        return out
+
+    return apply("embedding", f, _t(x), _t(weight))
+
+
+def one_hot(x, num_classes, name=None):
+    return apply("one_hot", lambda v: jax.nn.one_hot(v, num_classes, dtype=jnp.float32), _t(x))
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def f(lbl, *rest):
+        k = lbl.shape[-1]
+        if rest:
+            return (1.0 - epsilon) * lbl + epsilon * rest[0]
+        return (1.0 - epsilon) * lbl + epsilon / k
+
+    if prior_dist is not None:
+        return apply("label_smooth", f, _t(label), _t(prior_dist))
+    return apply("label_smooth", f, _t(label))
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):  # noqa: A002
+    x = _t(x)
+    if isinstance(pad, Tensor):
+        pad = pad.numpy().tolist()
+    pad = [int(p) for p in pad]
+
+    def f(v):
+        nd = v.ndim
+        if len(pad) == 2 * nd:
+            # full-rank paddle format: per-dim [before, after] pairs, dim order ascending
+            width = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+        else:
+            # partial spec applies to spatial dims per data_format, last-dim-first
+            width = [(0, 0)] * nd
+            if data_format.startswith("NC"):
+                spatial = list(range(2, nd))
+            else:
+                spatial = list(range(1, nd - 1))
+            spatial = spatial[::-1]
+            for i, d in enumerate(spatial):
+                if 2 * i + 1 < len(pad):
+                    width[d] = (pad[2 * i], pad[2 * i + 1])
+        jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+        if jmode == "constant":
+            return jnp.pad(v, width, mode="constant", constant_values=value)
+        return jnp.pad(v, width, mode=jmode)
+
+    return apply("pad", f, x)
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0, data_format=data_format)
+
+
+def interpolate(
+    x,
+    size=None,
+    scale_factor=None,
+    mode="nearest",
+    align_corners=False,
+    align_mode=0,
+    data_format="NCHW",
+    name=None,
+):
+    """jax.image.resize-backed; supports nearest/bilinear/bicubic/area/trilinear."""
+    x = _t(x)
+    v = x._value
+    if data_format in ("NCHW", "NCDHW", "NCL"):
+        spatial = list(range(2, v.ndim))
+    else:
+        spatial = list(range(1, v.ndim - 1))
+    if size is not None:
+        if isinstance(size, Tensor):
+            size = size.numpy().tolist()
+        size = [int(s.numpy()) if isinstance(s, Tensor) else int(s) for s in (size if isinstance(size, (list, tuple)) else [size])]
+    else:
+        if isinstance(scale_factor, (int, float)):
+            scale_factor = [scale_factor] * len(spatial)
+        size = [int(v.shape[d] * s) for d, s in zip(spatial, scale_factor)]
+
+    out_shape = list(v.shape)
+    for d, s in zip(spatial, size):
+        out_shape[d] = s
+
+    method = {
+        "nearest": "nearest",
+        "bilinear": "linear",
+        "trilinear": "linear",
+        "linear": "linear",
+        "bicubic": "cubic",
+        "area": "linear",
+    }[mode]
+
+    def f(vv):
+        if mode == "nearest" or not align_corners:
+            return jax.image.resize(vv, out_shape, method=method)
+        # align_corners=True path: explicit coordinate map via map_coordinates
+        idx = [jnp.arange(s) for s in out_shape]
+        grids = []
+        for d in range(vv.ndim):
+            if d in spatial and out_shape[d] > 1:
+                scale_ = (vv.shape[d] - 1) / (out_shape[d] - 1)
+                grids.append(idx[d] * scale_)
+            else:
+                grids.append(idx[d].astype(jnp.float32))
+        mesh = jnp.meshgrid(*grids, indexing="ij")
+        return jax.scipy.ndimage.map_coordinates(vv, mesh, order=1, mode="nearest").astype(vv.dtype)
+
+    return apply("interpolate", f, x)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode, data_format)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def f(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v = v.reshape(n, c // (r * r), r, r, h, w)
+            v = v.transpose(0, 1, 4, 2, 5, 3)
+            return v.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = v.shape
+        v = v.reshape(n, h, w, r, r, c // (r * r))
+        v = v.transpose(0, 1, 3, 2, 4, 5)
+        return v.reshape(n, h * r, w * r, c // (r * r))
+
+    return apply("pixel_shuffle", f, _t(x))
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+
+    def f(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v = v.reshape(n, c, h // r, r, w // r, r)
+            v = v.transpose(0, 1, 3, 5, 2, 4)
+            return v.reshape(n, c * r * r, h // r, w // r)
+        raise NotImplementedError
+
+    return apply("pixel_unshuffle", f, _t(x))
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def f(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            return v.reshape(n, groups, c // groups, h, w).transpose(0, 2, 1, 3, 4).reshape(n, c, h, w)
+        n, h, w, c = v.shape
+        return v.reshape(n, h, w, groups, c // groups).transpose(0, 1, 2, 4, 3).reshape(n, h, w, c)
+
+    return apply("channel_shuffle", f, _t(x))
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (paddle.nn.functional.unfold): NCHW -> [N, C*kh*kw, L]."""
+    x = _t(x)
+
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    dh, dw = _pair(dilations)
+    if isinstance(paddings, int):
+        ph0 = ph1 = pw0 = pw1 = paddings
+    elif len(paddings) == 2:
+        ph0 = ph1 = paddings[0]
+        pw0 = pw1 = paddings[1]
+    else:
+        ph0, pw0, ph1, pw1 = paddings
+
+    def f(v):
+        n, c, h, w = v.shape
+        patches = jax.lax.conv_general_dilated_patches(
+            v,
+            filter_shape=(kh, kw),
+            window_strides=(sh, sw),
+            padding=((ph0, ph1), (pw0, pw1)),
+            rhs_dilation=(dh, dw),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        )
+        # -> [N, C*kh*kw, OH, OW]
+        return patches.reshape(n, c * kh * kw, -1)
+
+    return apply("unfold", f, x)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    x = _t(x)
+
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+
+    oh, ow = _pair(output_sizes)
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    dh, dw = _pair(dilations)
+    p = paddings if isinstance(paddings, int) else None
+    if p is None:
+        if len(paddings) == 2:
+            ph0 = ph1 = paddings[0]; pw0 = pw1 = paddings[1]
+        else:
+            ph0, pw0, ph1, pw1 = paddings
+    else:
+        ph0 = ph1 = pw0 = pw1 = p
+
+    def f(v):
+        n, ckk, L = v.shape
+        c = ckk // (kh * kw)
+        ohh = (oh + ph0 + ph1 - (dh * (kh - 1) + 1)) // sh + 1
+        oww = (ow + pw0 + pw1 - (dw * (kw - 1) + 1)) // sw + 1
+        v6 = v.reshape(n, c, kh, kw, ohh, oww)
+        out = jnp.zeros((n, c, oh + ph0 + ph1, ow + pw0 + pw1), v.dtype)
+        for i in range(kh):
+            for j in range(kw):
+                hi = i * dh
+                wi = j * dw
+                out = out.at[:, :, hi : hi + sh * ohh : sh, wi : wi + sw * oww : sw].add(v6[:, :, i, j])
+        return out[:, :, ph0 : ph0 + oh, pw0 : pw0 + ow]
+
+    return apply("fold", f, x)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    def f(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.sqrt(jnp.sum(a * a, axis=axis))
+        nb = jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return dot / jnp.maximum(na * nb, eps)
+
+    return apply("cosine_similarity", f, _t(x1), _t(x2))
+
+
+def normalize(x, p=2.0, axis=1, epsilon=1e-12, name=None):
+    def f(v):
+        n = jnp.sum(jnp.abs(v) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return v / jnp.maximum(n, epsilon)
+
+    return apply("normalize", f, _t(x))
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def f(a, b, w, *rest):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if rest:
+            out = out + rest[0]
+        return out
+
+    args = [_t(x1), _t(x2), _t(weight)]
+    if bias is not None:
+        args.append(_t(bias))
+    return apply("bilinear", f, *args)

@@ -1,0 +1,173 @@
+"""Convolution functionals.
+
+Reference parity: python/paddle/nn/functional/conv.py (conv1d/2d/3d,
+conv*_transpose). Kernel: lax.conv_general_dilated — XLA tiles these directly
+onto the MXU; NCHW API preserved (paddle default) with data_format passthrough.
+"""
+from __future__ import annotations
+
+import jax
+from jax import numpy as jnp
+
+from ...core.apply import apply
+from ...core.tensor import Tensor, _ensure_tensor
+
+
+def _t(x):
+    return _ensure_tensor(x)
+
+
+def _tuple(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(v)
+
+
+def _padding(padding, n):
+    """paddle padding spec -> lax padding list of (lo, hi) per spatial dim."""
+    if isinstance(padding, str):
+        return padding.upper()  # "SAME"/"VALID"
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * n:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+    if all(isinstance(p, (list, tuple)) for p in padding):
+        # full-rank [[0,0],[0,0],[lo,hi],...] paddle format
+        return [tuple(p) for p in padding[-n:]]
+    raise ValueError(f"bad padding {padding}")
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, n, data_format):
+    """n = number of spatial dims."""
+    stride = _tuple(stride, n)
+    dilation = _tuple(dilation, n)
+    pad = _padding(padding, n)
+    if data_format in (None, "NCL", "NCHW", "NCDHW"):
+        spatial = "DHW"[-n:] if n > 1 else "W"
+        lhs_spec = "NC" + spatial
+    else:
+        spatial = "DHW"[-n:] if n > 1 else "W"
+        lhs_spec = "N" + spatial + "C"
+    rhs_spec = "OI" + spatial
+    out_spec = lhs_spec
+    dn = jax.lax.conv_dimension_numbers((1,) * (n + 2), (1,) * (n + 2), (lhs_spec, rhs_spec, out_spec))
+
+    def f(v, w, *rest):
+        out = jax.lax.conv_general_dilated(
+            v,
+            w.astype(v.dtype),
+            window_strides=stride,
+            padding=pad,
+            rhs_dilation=dilation,
+            dimension_numbers=dn,
+            feature_group_count=groups,
+        )
+        if rest:
+            b = rest[0]
+            if lhs_spec.startswith("NC"):
+                out = out + b.reshape((1, -1) + (1,) * n)
+            else:
+                out = out + b
+        return out
+
+    args = [_t(x), _t(weight)]
+    if bias is not None:
+        args.append(_t(bias))
+    return apply(f"conv{n}d", f, *args)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCL", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1, data_format)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2, data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3, data_format)
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups, n, data_format, output_size):
+    """Transposed conv as jax.linear_transpose of the matching forward conv.
+
+    A conv_transpose IS the transpose of a forward conv (how the reference's
+    conv2d_transpose_grad kernels are derived); expressing it that way is
+    exact for every stride/padding/dilation/groups combination and lowers to
+    the same XLA transposed-conv HLO.
+    """
+    stride = _tuple(stride, n)
+    dilation = _tuple(dilation, n)
+    opad = _tuple(output_padding, n)
+    pad = _padding(padding, n)
+    if isinstance(pad, str):
+        raise NotImplementedError("SAME/VALID string padding for conv_transpose")
+
+    spatial = "DHW"[-n:] if n > 1 else "W"
+    channels_first = data_format in (None, "NCL", "NCHW", "NCDHW")
+    lhs_spec = ("NC" + spatial) if channels_first else ("N" + spatial + "C")
+    # paddle conv_transpose weight is [C_in, C_out/groups, *k] == the forward
+    # conv's weight [O=C_in, I=C_out/groups, *k]
+    rhs_spec = "OI" + spatial
+    dn = jax.lax.conv_dimension_numbers((1,) * (n + 2), (1,) * (n + 2), (lhs_spec, rhs_spec, lhs_spec))
+
+    xt = _t(x)
+    xshape = xt._value.shape
+    batch = xshape[0]
+    c_out = None
+
+    def f(v, w, *rest):
+        nonlocal c_out
+        k_eff = [dilation[i] * (w.shape[2 + i] - 1) + 1 for i in range(n)]
+        in_spatial = [xshape[2 + i] if channels_first else xshape[1 + i] for i in range(n)]
+        if output_size is not None:
+            sizes = output_size if isinstance(output_size, (list, tuple)) else [output_size] * n
+            out_spatial = [int(s) for s in sizes]
+        else:
+            out_spatial = [
+                (in_spatial[i] - 1) * stride[i] - pad[i][0] - pad[i][1] + k_eff[i] + opad[i]
+                for i in range(n)
+            ]
+        c_out = w.shape[1] * groups
+        if channels_first:
+            tgt_shape = (batch, c_out, *out_spatial)
+        else:
+            tgt_shape = (batch, *out_spatial, c_out)
+
+        def fwd(inp):
+            return jax.lax.conv_general_dilated(
+                inp,
+                w.astype(v.dtype),
+                window_strides=stride,
+                padding=pad,
+                rhs_dilation=dilation,
+                dimension_numbers=dn,
+                feature_group_count=groups,
+            )
+
+        transpose_fn = jax.linear_transpose(fwd, jax.ShapeDtypeStruct(tgt_shape, v.dtype))
+        (out,) = transpose_fn(v)
+        if rest:
+            b = rest[0]
+            out = out + (b.reshape((1, -1) + (1,) * n) if channels_first else b)
+        return out
+
+    args = [xt, _t(weight)]
+    if bias is not None:
+        args.append(_t(bias))
+    return apply(f"conv{n}d_transpose", f, *args)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, output_size=None, data_format="NCL", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups, 1, data_format, output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, output_size=None, data_format="NCHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups, 2, data_format, output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, output_size=None, data_format="NCDHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups, 3, data_format, output_size)

@@ -1,0 +1,346 @@
+"""Loss functionals.
+
+Reference parity: python/paddle/nn/functional/loss.py (cross_entropy at :2log,
+softmax_with_cross_entropy, bce, mse, nll, kl_div, smooth_l1, margin losses,
+ctc stub).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax import numpy as jnp
+
+from ...core.apply import apply
+from ...core.tensor import Tensor, _ensure_tensor
+
+
+def _t(x):
+    return _ensure_tensor(x)
+
+
+def _reduce(val, reduction):
+    if reduction == "mean":
+        return jnp.mean(val)
+    if reduction == "sum":
+        return jnp.sum(val)
+    return val
+
+
+def cross_entropy(
+    input,  # noqa: A002
+    label,
+    weight=None,
+    ignore_index=-100,
+    reduction="mean",
+    soft_label=False,
+    axis=-1,
+    use_softmax=True,
+    label_smoothing=0.0,
+    name=None,
+):
+    """paddle.nn.functional.cross_entropy (loss.py). Handles hard int labels
+    (optionally ignored), soft labels, class weights, label smoothing."""
+    x, y = _t(input), _t(label)
+
+    def f(v, lbl, *rest):
+        logp = jax.nn.log_softmax(v, axis=axis) if use_softmax else jnp.log(jnp.clip(v, 1e-15, 1.0))
+        nclass = v.shape[axis]
+        if soft_label:
+            soft = lbl
+            if label_smoothing > 0.0:
+                soft = soft * (1 - label_smoothing) + label_smoothing / nclass
+            per = -jnp.sum(soft * logp, axis=axis)
+            mask = None
+        else:
+            ids = lbl
+            if ids.ndim == v.ndim:  # [..., 1] labels
+                ids = jnp.squeeze(ids, axis=axis)
+            ids = ids.astype(jnp.int32)
+            mask = ids != ignore_index
+            safe = jnp.where(mask, ids, 0)
+            picked = jnp.take_along_axis(logp, jnp.expand_dims(safe, axis), axis=axis)
+            picked = jnp.squeeze(picked, axis=axis)
+            if label_smoothing > 0.0:
+                smooth_term = jnp.mean(logp, axis=axis)
+                per = -(1 - label_smoothing) * picked - label_smoothing * smooth_term
+            else:
+                per = -picked
+            if rest:  # class weights
+                wsel = jnp.take(rest[0], safe, axis=0)
+                per = per * wsel
+                denom_terms = jnp.where(mask, wsel, 0.0)
+            else:
+                denom_terms = mask.astype(per.dtype)
+            per = jnp.where(mask, per, 0.0)
+        if reduction == "mean":
+            if not soft_label:
+                return jnp.sum(per) / jnp.maximum(jnp.sum(denom_terms), 1e-12)
+            return jnp.mean(per)
+        if reduction == "sum":
+            return jnp.sum(per)
+        return per
+
+    args = [x, y]
+    if weight is not None:
+        args.append(_t(weight))
+    return apply("cross_entropy", f, *args)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100, numeric_stable_mode=True, return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label, ignore_index=ignore_index, reduction="none", axis=axis)
+    # paddle returns shape with trailing 1
+    from ...ops.manipulation import unsqueeze
+
+    loss = unsqueeze(loss, axis)
+    if return_softmax:
+        from .activation import softmax
+
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):  # noqa: A002
+    x, y = _t(input), _t(label)
+
+    def f(v, ids, *rest):
+        ids = ids.astype(jnp.int32)
+        mask = ids != ignore_index
+        safe = jnp.where(mask, ids, 0)
+        picked = jnp.take_along_axis(v, safe[..., None] if v.ndim == ids.ndim + 1 else safe, axis=1 if v.ndim > 1 else 0)
+        if v.ndim == ids.ndim + 1:
+            picked = jnp.squeeze(picked, 1)
+        per = -picked
+        if rest:
+            w = jnp.take(rest[0], safe, axis=0)
+            per = per * w
+        per = jnp.where(mask, per, 0.0)
+        if reduction == "mean":
+            denom = jnp.sum(jnp.where(mask, w if rest else jnp.ones_like(per), 0.0))
+            return jnp.sum(per) / jnp.maximum(denom, 1e-12)
+        return _reduce(per, reduction)
+
+    # nll over [N, C, ...] with label [N, ...]: reshape to [N*, C]
+    def g(v, ids, *rest):
+        if v.ndim > 2:
+            c = v.shape[1]
+            vm = jnp.moveaxis(v, 1, -1).reshape(-1, c)
+            idsr = ids.reshape(-1)
+            out = f(vm, idsr, *rest)
+            if reduction == "none":
+                return out.reshape(ids.shape)
+            return out
+        return f(v, ids, *rest)
+
+    args = [x, y]
+    if weight is not None:
+        args.append(_t(weight))
+    return apply("nll_loss", g, *args)
+
+
+def mse_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    return apply("mse_loss", lambda a, b: _reduce(jnp.square(a - b), reduction), _t(input), _t(label))
+
+
+def l1_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    return apply("l1_loss", lambda a, b: _reduce(jnp.abs(a - b), reduction), _t(input), _t(label))
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):  # noqa: A002
+    def f(a, b):
+        d = a - b
+        ad = jnp.abs(d)
+        val = jnp.where(ad < delta, 0.5 * d * d / delta, ad - 0.5 * delta)
+        # paddle multiplies by delta
+        return _reduce(val * delta, reduction)
+
+    return apply("smooth_l1_loss", f, _t(input), _t(label))
+
+
+def huber_loss(input, label, delta=1.0, reduction="mean"):  # noqa: A002
+    def f(a, b):
+        d = a - b
+        ad = jnp.abs(d)
+        val = jnp.where(ad <= delta, 0.5 * d * d, delta * (ad - 0.5 * delta))
+        return _reduce(val, reduction)
+
+    return apply("huber_loss", f, _t(input), _t(label))
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):  # noqa: A002
+    def f(p, y, *rest):
+        p = jnp.clip(p, 1e-12, 1.0 - 1e-12)
+        per = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+        if rest:
+            per = per * rest[0]
+        return _reduce(per, reduction)
+
+    args = [_t(input), _t(label)]
+    if weight is not None:
+        args.append(_t(weight))
+    return apply("bce", f, *args)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean", pos_weight=None, name=None):
+    def f(z, y, *rest):
+        i = 0
+        w = None
+        pw = None
+        if weight is not None:
+            w = rest[i]; i += 1
+        if pos_weight is not None:
+            pw = rest[i]; i += 1
+        # stable formulation
+        log_sig = jax.nn.log_sigmoid(z)
+        log_sig_neg = jax.nn.log_sigmoid(-z)
+        if pw is not None:
+            per = -(pw * y * log_sig + (1 - y) * log_sig_neg)
+        else:
+            per = -(y * log_sig + (1 - y) * log_sig_neg)
+        if w is not None:
+            per = per * w
+        return _reduce(per, reduction)
+
+    args = [_t(logit), _t(label)]
+    if weight is not None:
+        args.append(_t(weight))
+    if pos_weight is not None:
+        args.append(_t(pos_weight))
+    return apply("bce_with_logits", f, *args)
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):  # noqa: A002
+    def f(logp, q):
+        if log_target:
+            per = jnp.exp(q) * (q - logp)
+        else:
+            per = q * (jnp.log(jnp.clip(q, 1e-12)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(per) / logp.shape[0]
+        return _reduce(per, reduction)
+
+    return apply("kl_div", f, _t(input), _t(label))
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):  # noqa: A002
+    def f(a, b, y):
+        return _reduce(jnp.maximum(0.0, -y * (a - b) + margin), reduction)
+
+    return apply("margin_ranking_loss", f, _t(input), _t(other), _t(label))
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):  # noqa: A002
+    def f(x, y):
+        per = jnp.where(y == 1, x, jnp.maximum(0.0, margin - x))
+        return _reduce(per, reduction)
+
+    return apply("hinge_embedding_loss", f, _t(input), _t(label))
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean", name=None):
+    def f(a, b, y):
+        cos = jnp.sum(a * b, -1) / jnp.maximum(jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        per = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(per, reduction)
+
+    return apply("cosine_embedding_loss", f, _t(input1), _t(input2), _t(label))
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0, epsilon=1e-6, swap=False, reduction="mean", name=None):  # noqa: A002
+    def f(a, pos, neg):
+        dp = jnp.sum(jnp.abs(a - pos) ** p, axis=-1) ** (1 / p)
+        dn = jnp.sum(jnp.abs(a - neg) ** p, axis=-1) ** (1 / p)
+        if swap:
+            dpn = jnp.sum(jnp.abs(pos - neg) ** p, axis=-1) ** (1 / p)
+            dn = jnp.minimum(dn, dpn)
+        return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+
+    return apply("triplet_margin_loss", f, _t(input), _t(positive), _t(negative))
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):  # noqa: A002
+    def f(p, y):
+        return -y * jnp.log(p + epsilon) - (1 - y) * jnp.log(1 - p + epsilon)
+
+    return apply("log_loss", f, _t(input), _t(label))
+
+
+def square_error_cost(input, label):  # noqa: A002
+    return apply("square_error_cost", lambda a, b: jnp.square(a - b), _t(input), _t(label))
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0, reduction="sum", name=None):
+    def f(z, y, *rest):
+        p = jax.nn.sigmoid(z)
+        ce = -(y * jax.nn.log_sigmoid(z) + (1 - y) * jax.nn.log_sigmoid(-z))
+        pt = p * y + (1 - p) * (1 - y)
+        a = alpha * y + (1 - alpha) * (1 - y)
+        per = a * ((1 - pt) ** gamma) * ce
+        if rest:
+            per = per / rest[0]
+        return _reduce(per, reduction)
+
+    args = [_t(logit), _t(label)]
+    if normalizer is not None:
+        args.append(_t(normalizer))
+    return apply("sigmoid_focal_loss", f, *args)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0, reduction="mean", norm_by_times=False):
+    """CTC via the classic alpha-recursion in log space with lax.scan.
+
+    Reference kernel: paddle/phi/kernels/impl/warpctc_kernel_impl.h (warpctc);
+    here a pure-XLA dynamic program replaces the CUDA library.
+    log_probs: [T, N, C] log-softmax already applied (paddle convention:
+    logits accepted; we log_softmax for safety).
+    """
+    lp, lab = _t(log_probs), _t(labels)
+    ilen, llen = _t(input_lengths), _t(label_lengths)
+
+    def f(lpv, labv, ilenv, llenv):
+        lpv = jax.nn.log_softmax(lpv, axis=-1)
+        T, N, C = lpv.shape
+        S = labv.shape[1]
+        L = 2 * S + 1
+        NEG = jnp.asarray(-1e30, lpv.dtype)
+        # extended labels: blank, l1, blank, l2, ... blank
+        ext = jnp.full((N, L), blank, dtype=labv.dtype)
+        ext = ext.at[:, 1::2].set(labv)
+        # alpha init
+        alpha0 = jnp.full((N, L), NEG)
+        alpha0 = alpha0.at[:, 0].set(lpv[0, jnp.arange(N), blank])
+        alpha0 = alpha0.at[:, 1].set(lpv[0, jnp.arange(N), ext[:, 1]])
+
+        same_as_prev2 = jnp.concatenate(
+            [jnp.ones((N, 2), bool), ext[:, 2:] == ext[:, :-2]], axis=1
+        )
+
+        def step(alpha, lp_t):
+            a0 = alpha
+            a1 = jnp.concatenate([jnp.full((N, 1), NEG), alpha[:, :-1]], axis=1)
+            a2 = jnp.concatenate([jnp.full((N, 2), NEG), alpha[:, :-2]], axis=1)
+            a2 = jnp.where(same_as_prev2, NEG, a2)
+            m = jnp.maximum(jnp.maximum(a0, a1), a2)
+            m_safe = jnp.where(m == NEG, 0.0, m)
+            s = jnp.exp(a0 - m_safe) + jnp.exp(a1 - m_safe) + jnp.exp(a2 - m_safe)
+            new = m_safe + jnp.log(s)
+            new = jnp.where(m == NEG, NEG, new)
+            emit = jnp.take_along_axis(lp_t, ext, axis=1)
+            return new + emit, None
+
+        alphas, _ = jax.lax.scan(lambda a, x: step(a, x), alpha0, lpv[1:])
+        # gather alpha at t = input_length-1 for each n
+        all_alpha = jnp.concatenate([alpha0[None], alphas], axis=0)
+        t_idx = (ilenv - 1).astype(jnp.int32)
+        final = all_alpha[t_idx, jnp.arange(N)]  # [N, L]
+        end1 = 2 * llenv.astype(jnp.int32)
+        end2 = end1 - 1
+        fa = jnp.take_along_axis(final, end1[:, None], axis=1)[:, 0]
+        fb = jnp.take_along_axis(final, end2[:, None], axis=1)[:, 0]
+        m = jnp.maximum(fa, fb)
+        ll = m + jnp.log(jnp.exp(fa - m) + jnp.exp(fb - m))
+        loss = -ll
+        if reduction == "mean":
+            return jnp.mean(loss / llenv.astype(loss.dtype))
+        return _reduce(loss, reduction)
+
+    return apply("ctc_loss", f, lp, lab, ilen, llen)

@@ -1,0 +1,203 @@
+"""Normalization functionals.
+
+Reference parity: python/paddle/nn/functional/norm.py + the fused
+rms_norm/fused_layer_norm in python/paddle/incubate/nn/functional/. On TPU
+there is no hand-fused kernel zoo: XLA fuses the reduce+scale chain; the
+functionals here are the canonical formulations.
+"""
+from __future__ import annotations
+
+import jax
+from jax import numpy as jnp
+
+from ...core.apply import apply
+from ...core.tensor import Tensor, _ensure_tensor
+
+
+def _t(x):
+    return _ensure_tensor(x)
+
+
+def batch_norm(
+    x,
+    running_mean,
+    running_var,
+    weight=None,
+    bias=None,
+    training=False,
+    momentum=0.9,
+    epsilon=1e-5,
+    data_format="NCHW",
+    use_global_stats=None,
+    name=None,
+):
+    """Functional batch norm. In training mode updates running stats in-place
+    on the passed tensors (buffer mutation recorded for program capture)."""
+    x = _t(x)
+    ch_axis = 1 if data_format.startswith("NC") and x.ndim > 1 else x.ndim - 1
+    axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+    use_batch_stats = training and not use_global_stats
+
+    if use_batch_stats:
+        # compute batch stats on the graph
+        def fstats(v):
+            m = jnp.mean(v, axis=axes)
+            var = jnp.var(v, axis=axes)
+            return (m, var)
+
+        mean_t, var_t = apply("bn_stats", fstats, x)
+        # update running buffers (in-place, recorded)
+        with_no = running_mean._value * momentum + mean_t._value * (1 - momentum)
+        running_mean._replace_value(with_no.astype(running_mean._value.dtype))
+        running_var._replace_value(
+            (running_var._value * momentum + var_t._value * (1 - momentum)).astype(running_var._value.dtype)
+        )
+        mean_used, var_used = mean_t, var_t
+    else:
+        mean_used, var_used = _t(running_mean), _t(running_var)
+
+    shape = [1] * x.ndim
+    shape[ch_axis] = -1
+
+    def f(v, m, var, *rest):
+        inv = jax.lax.rsqrt(var.reshape(shape).astype(v.dtype) + epsilon)
+        out = (v - m.reshape(shape).astype(v.dtype)) * inv
+        i = 0
+        if weight is not None:
+            out = out * rest[i].reshape(shape).astype(v.dtype)
+            i += 1
+        if bias is not None:
+            out = out + rest[i].reshape(shape).astype(v.dtype)
+        return out
+
+    args = [x, mean_used, var_used]
+    if weight is not None:
+        args.append(_t(weight))
+    if bias is not None:
+        args.append(_t(bias))
+    return apply("batch_norm", f, *args)
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=None):
+    x = _t(x)
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    n_axes = len(normalized_shape)
+    axes = tuple(range(x.ndim - n_axes, x.ndim))
+
+    def f(v, *rest):
+        # stats in float32 for bf16 stability (TPU practice)
+        vf = v.astype(jnp.float32)
+        m = jnp.mean(vf, axis=axes, keepdims=True)
+        var = jnp.var(vf, axis=axes, keepdims=True)
+        out = (vf - m) * jax.lax.rsqrt(var + epsilon)
+        out = out.astype(v.dtype)
+        i = 0
+        if weight is not None:
+            out = out * rest[i].astype(v.dtype)
+            i += 1
+        if bias is not None:
+            out = out + rest[i].astype(v.dtype)
+        return out
+
+    args = [x]
+    if weight is not None:
+        args.append(_t(weight))
+    if bias is not None:
+        args.append(_t(bias))
+    return apply("layer_norm", f, *args)
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """RMSNorm (reference: python/paddle/incubate/nn/functional/fused_rms_norm.py)."""
+    x = _t(x)
+
+    def f(v, *rest):
+        vf = v.astype(jnp.float32)
+        ms = jnp.mean(jnp.square(vf), axis=-1, keepdims=True)
+        out = (vf * jax.lax.rsqrt(ms + epsilon)).astype(v.dtype)
+        if rest:
+            out = out * rest[0].astype(v.dtype)
+        return out
+
+    args = [x]
+    if weight is not None:
+        args.append(_t(weight))
+    return apply("rms_norm", f, *args)
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None, data_format="NCHW", name=None):
+    x = _t(x)
+    channels_first = data_format.startswith("NC")
+    ch_axis = 1 if channels_first else x.ndim - 1
+
+    def f(v, *rest):
+        if not channels_first:
+            v = jnp.moveaxis(v, -1, 1)
+        n, c = v.shape[0], v.shape[1]
+        g = num_groups
+        spatial = v.shape[2:]
+        r = v.reshape(n, g, c // g, *spatial).astype(jnp.float32)
+        axes = tuple(range(2, r.ndim))
+        m = jnp.mean(r, axis=axes, keepdims=True)
+        var = jnp.var(r, axis=axes, keepdims=True)
+        out = ((r - m) * jax.lax.rsqrt(var + epsilon)).reshape(n, c, *spatial).astype(v.dtype)
+        shape = (1, c) + (1,) * len(spatial)
+        i = 0
+        if weight is not None:
+            out = out * rest[i].reshape(shape).astype(v.dtype)
+            i += 1
+        if bias is not None:
+            out = out + rest[i].reshape(shape).astype(v.dtype)
+        if not channels_first:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    args = [x]
+    if weight is not None:
+        args.append(_t(weight))
+    if bias is not None:
+        args.append(_t(bias))
+    return apply("group_norm", f, *args)
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None, use_input_stats=True, momentum=0.9, eps=1e-5, data_format="NCHW", name=None):
+    x = _t(x)
+    axes = tuple(range(2, x.ndim))
+
+    def f(v, *rest):
+        m = jnp.mean(v, axis=axes, keepdims=True)
+        var = jnp.var(v, axis=axes, keepdims=True)
+        out = (v - m) * jax.lax.rsqrt(var + eps)
+        shape = (1, -1) + (1,) * (v.ndim - 2)
+        i = 0
+        if weight is not None:
+            out = out * rest[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + rest[i].reshape(shape)
+        return out
+
+    args = [x]
+    if weight is not None:
+        args.append(_t(weight))
+    if bias is not None:
+        args.append(_t(bias))
+    return apply("instance_norm", f, *args)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW", name=None):
+    x = _t(x)
+
+    def f(v):
+        sq = jnp.square(v)
+        half = size // 2
+        pads = [(0, 0)] * v.ndim
+        pads[1] = (half, size - half - 1)
+        padded = jnp.pad(sq, pads)
+        acc = jnp.zeros_like(v)
+        for i in range(size):
+            acc = acc + jax.lax.slice_in_dim(padded, i, i + v.shape[1], axis=1)
+        return v / jnp.power(k + alpha * acc / size, beta)
+
+    return apply("local_response_norm", f, x)

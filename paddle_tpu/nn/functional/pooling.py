@@ -1,0 +1,230 @@
+"""Pooling functionals.
+
+Reference parity: python/paddle/nn/functional/pooling.py. Kernel:
+lax.reduce_window (XLA pools natively on TPU).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax import numpy as jnp
+
+from ...core.apply import apply
+from ...core.tensor import Tensor, _ensure_tensor
+
+
+def _t(x):
+    return _ensure_tensor(x)
+
+
+def _tuple(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    if len(v) == 1:
+        return tuple(v) * n
+    return tuple(v)
+
+
+def _pad_spec(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * n:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+    return [tuple(p) for p in padding[-n:]]
+
+
+def _pool(x, kernel, stride, padding, n, reducer, init, data_format, ceil_mode=False, count_include_pad=True, exclusive=True):
+    kernel = _tuple(kernel, n)
+    stride = _tuple(stride if stride is not None else kernel, n)
+    pad = _pad_spec(padding, n)
+    channels_first = data_format in ("NCL", "NCHW", "NCDHW", None)
+
+    def f(v):
+        spatial_pad = pad
+        if ceil_mode and not isinstance(pad, str):
+            # extend the high-side padding so the window count is ceil-divided;
+            # padded cells are the reducer identity (-inf for max, 0 for add —
+            # avg's exclusive count pools the SAME padding so divisors stay right)
+            spatial_pad = []
+            spatial_start = 2 if channels_first else 1
+            for i in range(n):
+                size = v.shape[spatial_start + i]
+                lo, hi = pad[i]
+                span = size + lo + hi - kernel[i]
+                rem = span % stride[i]
+                extra = 0 if rem == 0 else stride[i] - rem
+                spatial_pad.append((lo, hi + extra))
+        if channels_first:
+            dims = (1, 1) + kernel
+            strides = (1, 1) + stride
+            pads = [(0, 0), (0, 0)] + (spatial_pad if not isinstance(spatial_pad, str) else spatial_pad)
+        else:
+            dims = (1,) + kernel + (1,)
+            strides = (1,) + stride + (1,)
+            pads = [(0, 0)] + (spatial_pad if not isinstance(spatial_pad, str) else spatial_pad) + [(0, 0)]
+        if isinstance(spatial_pad, str):
+            pads = spatial_pad
+        # init must be a python scalar literal: jax only derives the
+        # differentiable reduce_window_max/add primitives from identity consts
+        out = jax.lax.reduce_window(v, v.dtype.type(init), reducer, dims, strides, pads)
+        return out
+
+    return f
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCL", name=None):
+    return _max_pool(x, kernel_size, stride, padding, 1, data_format, return_mask, ceil_mode)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, return_mask=False, data_format="NCHW", name=None):
+    return _max_pool(x, kernel_size, stride, padding, 2, data_format, return_mask, ceil_mode)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, return_mask=False, data_format="NCDHW", name=None):
+    return _max_pool(x, kernel_size, stride, padding, 3, data_format, return_mask, ceil_mode)
+
+
+def _max_pool(x, kernel_size, stride, padding, n, data_format, return_mask, ceil_mode=False):
+    x = _t(x)
+    fmax = _pool(x, kernel_size, stride, padding, n, jax.lax.max, -np.inf, data_format, ceil_mode)
+    out = apply(f"max_pool{n}d", fmax, x)
+    if not return_mask:
+        return out
+    # indices via argmax over windows: use reduce_window on (value, index) pairs
+    kernel = _tuple(kernel_size, n)
+    stride_t = _tuple(stride if stride is not None else kernel_size, n)
+    pad = _pad_spec(padding, n)
+
+    def fidx(v):
+        # flat spatial index per element
+        spatial_shape = v.shape[2:]
+        idx = jnp.arange(int(np.prod(spatial_shape))).reshape(spatial_shape)
+        idx = jnp.broadcast_to(idx, v.shape)
+
+        def red(a, b):
+            av, ai = a
+            bv, bi = b
+            take_b = bv > av
+            return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
+
+        dims = (1, 1) + kernel
+        strides = (1, 1) + stride_t
+        pads = [(0, 0), (0, 0)] + (pad if not isinstance(pad, str) else pad)
+        _, oidx = jax.lax.reduce_window(
+            (v, idx.astype(jnp.int64)),
+            (jnp.asarray(-np.inf, v.dtype), jnp.asarray(-1, jnp.int64)),
+            red,
+            dims,
+            strides,
+            pads if not isinstance(pad, str) else pad,
+        )
+        return oidx
+
+    from ...core.apply import apply_nograd
+
+    mask = apply_nograd(f"max_pool{n}d_mask", fidx, x)
+    return out, mask
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False, name=None):
+    return _avg_pool(x, kernel_size, stride, padding, 1, "NCL", exclusive, None, ceil_mode)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+    return _avg_pool(x, kernel_size, stride, padding, 2, data_format, exclusive, divisor_override, ceil_mode)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
+    return _avg_pool(x, kernel_size, stride, padding, 3, data_format, exclusive, divisor_override, ceil_mode)
+
+
+def _avg_pool(x, kernel_size, stride, padding, n, data_format, exclusive, divisor_override=None, ceil_mode=False):
+    x = _t(x)
+    kernel = _tuple(kernel_size, n)
+    fsum = _pool(x, kernel_size, stride, padding, n, jax.lax.add, 0.0, data_format, ceil_mode)
+
+    def f(v):
+        s = fsum(v)
+        if divisor_override:
+            return s / divisor_override
+        if exclusive:
+            ones = jnp.ones(v.shape, v.dtype)
+            cnt = fsum(ones)
+            return s / cnt
+        return s / float(np.prod(kernel))
+
+    return apply(f"avg_pool{n}d", f, x)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(x, output_size, 1, "avg")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool(x, output_size, 2, "avg")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool(x, output_size, 3, "avg")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 1, "max")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 2, "max")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 3, "max")
+
+
+def _adaptive_pool(x, output_size, n, mode):
+    x = _t(x)
+    out_sizes = _tuple(output_size, n)
+    out_sizes = tuple(
+        x._value.shape[2 + i] if out_sizes[i] is None else int(out_sizes[i]) for i in range(n)
+    )
+
+    def f(v):
+        out = v
+        for i in range(n):
+            ax = 2 + i
+            in_s, out_s = out.shape[ax], out_sizes[i]
+            if in_s == out_s:
+                continue
+            if in_s % out_s == 0:
+                k = in_s // out_s
+                newshape = out.shape[:ax] + (out_s, k) + out.shape[ax + 1:]
+                r = out.reshape(newshape)
+                out = jnp.max(r, axis=ax + 1) if mode == "max" else jnp.mean(r, axis=ax + 1)
+            else:
+                # general adaptive: per output bin [floor(j*in/out), ceil((j+1)*in/out))
+                starts = [int(np.floor(j * in_s / out_s)) for j in range(out_s)]
+                ends = [int(np.ceil((j + 1) * in_s / out_s)) for j in range(out_s)]
+                pieces = []
+                for s_, e_ in zip(starts, ends):
+                    seg = jax.lax.slice_in_dim(out, s_, e_, axis=ax)
+                    red = jnp.max(seg, axis=ax, keepdims=True) if mode == "max" else jnp.mean(seg, axis=ax, keepdims=True)
+                    pieces.append(red)
+                out = jnp.concatenate(pieces, axis=ax)
+        return out
+
+    return apply(f"adaptive_{mode}_pool{n}d", f, x)
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0, ceil_mode=False, data_format="NCHW", name=None):
+    x = _t(x)
+    p = float(norm_type)
+    fsum = _pool(x, kernel_size, stride, padding, 2, jax.lax.add, 0.0, data_format)
+
+    def f(v):
+        return fsum(jnp.abs(v) ** p) ** (1.0 / p)
+
+    return apply("lp_pool2d", f, x)

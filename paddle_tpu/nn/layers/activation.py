@@ -1,0 +1,77 @@
+"""Activation layers (python/paddle/nn/layer/activation.py)."""
+from __future__ import annotations
+
+from ..layer import Layer
+from ..initializer import Constant
+from .. import functional as F
+
+
+def _simple(fn_name, **fixed):
+    class _Act(Layer):
+        def __init__(self, *args, **kwargs):
+            super().__init__()
+            self._kwargs = {**fixed}
+            # map positional args in paddle order for the common cases
+            self._args = args
+            self._kwargs.update({k: v for k, v in kwargs.items() if k != "name"})
+
+        def forward(self, x):
+            return getattr(F, fn_name)(x, *self._args, **self._kwargs)
+
+    _Act.__name__ = fn_name.title().replace("_", "")
+    return _Act
+
+
+ReLU = _simple("relu")
+ReLU6 = _simple("relu6")
+Sigmoid = _simple("sigmoid")
+Tanh = _simple("tanh")
+GELU = _simple("gelu")
+Silu = _simple("silu")
+Swish = _simple("swish")
+Mish = _simple("mish")
+LeakyReLU = _simple("leaky_relu")
+ELU = _simple("elu")
+SELU = _simple("selu")
+CELU = _simple("celu")
+Hardtanh = _simple("hardtanh")
+Hardshrink = _simple("hardshrink")
+Softshrink = _simple("softshrink")
+Tanhshrink = _simple("tanhshrink")
+Hardsigmoid = _simple("hardsigmoid")
+Hardswish = _simple("hardswish")
+Softplus = _simple("softplus")
+Softsign = _simple("softsign")
+LogSigmoid = _simple("log_sigmoid")
+ThresholdedReLU = _simple("thresholded_relu")
+Maxout = _simple("maxout")
+GLU = _simple("glu")
+RReLU = _simple("rrelu")
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return F.softmax(x, axis=self.axis)
+
+
+class LogSoftmax(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return F.log_softmax(x, axis=self.axis)
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None, data_format="NCHW", name=None):
+        super().__init__()
+        self._data_format = data_format
+        self.weight = self.create_parameter([num_parameters], attr=weight_attr, default_initializer=Constant(init))
+
+    def forward(self, x):
+        return F.prelu(x, self.weight, data_format=self._data_format)

@@ -1,0 +1,261 @@
+"""Recurrent layers: SimpleRNN / LSTM / GRU.
+
+Reference parity: python/paddle/nn/layer/rnn.py. TPU-native: the time loop is
+lax.scan (compiles to a single fused while-loop; no cuDNN analog needed),
+cells are batched matmuls on the MXU.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+from jax import numpy as jnp
+
+from ..layer import Layer
+from ..initializer import Uniform
+from ...core.apply import apply
+from ...core.tensor import Tensor, _ensure_tensor
+from ...ops import creation, manipulation as manip
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None, init_value=0.0, batch_dim_idx=0):
+        b = batch_ref.shape[batch_dim_idx]
+        return creation.full([b, self.hidden_size], init_value, dtype or "float32")
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh", weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter([hidden_size, input_size], weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter([hidden_size, hidden_size], weight_hh_attr, default_initializer=init)
+        self.bias_ih = None if bias_ih_attr is False else self.create_parameter([hidden_size], bias_ih_attr, is_bias=True, default_initializer=init)
+        self.bias_hh = None if bias_hh_attr is False else self.create_parameter([hidden_size], bias_hh_attr, is_bias=True, default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+
+        def f(x, h, wih, whh, *biases):
+            z = x @ wih.T + h @ whh.T
+            for b in biases:
+                z = z + b
+            return act(z)
+
+        args = [inputs, states, self.weight_ih, self.weight_hh]
+        if self.bias_ih is not None:
+            args.append(self.bias_ih)
+        if self.bias_hh is not None:
+            args.append(self.bias_hh)
+        h = apply("simple_rnn_cell", f, *args)
+        return h, h
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, proj_size=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter([4 * hidden_size, input_size], weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter([4 * hidden_size, hidden_size], weight_hh_attr, default_initializer=init)
+        self.bias_ih = None if bias_ih_attr is False else self.create_parameter([4 * hidden_size], bias_ih_attr, is_bias=True, default_initializer=init)
+        self.bias_hh = None if bias_hh_attr is False else self.create_parameter([4 * hidden_size], bias_hh_attr, is_bias=True, default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            h = self.get_initial_states(inputs)
+            c = self.get_initial_states(inputs)
+        else:
+            h, c = states
+
+        def f(x, hv, cv, wih, whh, *biases):
+            z = x @ wih.T + hv @ whh.T
+            for b in biases:
+                z = z + b
+            i, fg, g, o = jnp.split(z, 4, axis=-1)
+            i, fg, o = jax.nn.sigmoid(i), jax.nn.sigmoid(fg), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c_new = fg * cv + i * g
+            h_new = o * jnp.tanh(c_new)
+            return (h_new, c_new)
+
+        args = [inputs, h, c, self.weight_ih, self.weight_hh]
+        if self.bias_ih is not None:
+            args.append(self.bias_ih)
+        if self.bias_hh is not None:
+            args.append(self.bias_hh)
+        h_new, c_new = apply("lstm_cell", f, *args)
+        return h_new, (h_new, c_new)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter([3 * hidden_size, input_size], weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter([3 * hidden_size, hidden_size], weight_hh_attr, default_initializer=init)
+        self.bias_ih = None if bias_ih_attr is False else self.create_parameter([3 * hidden_size], bias_ih_attr, is_bias=True, default_initializer=init)
+        self.bias_hh = None if bias_hh_attr is False else self.create_parameter([3 * hidden_size], bias_hh_attr, is_bias=True, default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def f(x, h, wih, whh, *biases):
+            gi = x @ wih.T
+            gh = h @ whh.T
+            if biases:
+                gi = gi + biases[0]
+                if len(biases) > 1:
+                    gh = gh + biases[1]
+            ir, iz, ic = jnp.split(gi, 3, axis=-1)
+            hr, hz, hc = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            c = jnp.tanh(ic + r * hc)
+            return (1 - z) * c + z * h
+
+        args = [inputs, states, self.weight_ih, self.weight_hh]
+        if self.bias_ih is not None:
+            args.append(self.bias_ih)
+        if self.bias_hh is not None:
+            args.append(self.bias_hh)
+        h = apply("gru_cell", f, *args)
+        return h, h
+
+
+class RNN(Layer):
+    """Wraps a cell; runs lax.scan over time (python/paddle/nn/layer/rnn.py RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        # eager scan in python over cell (keeps autograd tape simple; under
+        # to_static the whole loop is captured and XLA rolls it)
+        x = inputs
+        if not self.time_major:
+            x = manip.transpose(x, [1, 0, 2])
+        T = x.shape[0]
+        steps = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        outs = [None] * T
+        states = initial_states
+        for t in steps:
+            out, states = self.cell(x[t], states)
+            outs[t] = out
+        y = manip.stack(outs, axis=0)
+        if not self.time_major:
+            y = manip.transpose(y, [1, 0, 2])
+        return y, states
+
+
+def _layer_suffix(layer, direction):
+    return f"{layer}" + ("_reverse" if direction == 1 else "")
+
+
+class _RNNBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, num_layers=1, direction="forward", time_major=False, dropout=0.0, **kw):
+        super().__init__()
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirect = 2 if direction in ("bidirect", "bidirectional") else 1
+
+        cell_cls = {"LSTM": LSTMCell, "GRU": GRUCell, "RNN_TANH": SimpleRNNCell, "RNN_RELU": SimpleRNNCell}[mode]
+        self._cells = []
+        for layer in range(num_layers):
+            for d in range(self.bidirect):
+                in_size = input_size if layer == 0 else hidden_size * self.bidirect
+                if mode.startswith("RNN"):
+                    cell = cell_cls(in_size, hidden_size, activation="tanh" if mode == "RNN_TANH" else "relu")
+                else:
+                    cell = cell_cls(in_size, hidden_size)
+                self.add_sublayer(f"cell_{_layer_suffix(layer, d)}", cell)
+                self._cells.append(cell)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from .. import functional as F
+
+        x = inputs
+        final_states = []
+        for layer in range(self.num_layers):
+            outs = []
+            for d in range(self.bidirect):
+                cell = self._cells[layer * self.bidirect + d]
+                rnn = RNN(cell, is_reverse=(d == 1), time_major=self.time_major)
+                init = None
+                if initial_states is not None:
+                    idx = layer * self.bidirect + d
+                    if self.mode == "LSTM":
+                        h0, c0 = initial_states
+                        init = (h0[idx], c0[idx])
+                    else:
+                        init = initial_states[idx]
+                y, st = rnn(x, init)
+                outs.append(y)
+                final_states.append(st)
+            x = outs[0] if len(outs) == 1 else manip.concat(outs, axis=-1)
+            if self.dropout > 0 and layer < self.num_layers - 1:
+                x = F.dropout(x, self.dropout, training=self.training)
+        if self.mode == "LSTM":
+            h = manip.stack([s[0] for s in final_states], axis=0)
+            c = manip.stack([s[1] for s in final_states], axis=0)
+            return x, (h, c)
+        h = manip.stack(final_states, axis=0)
+        return x, h
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward", time_major=False, dropout=0.0, activation="tanh", **kw):
+        mode = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        super().__init__(mode, input_size, hidden_size, num_layers, direction, time_major, dropout)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward", time_major=False, dropout=0.0, **kw):
+        super().__init__("LSTM", input_size, hidden_size, num_layers, direction, time_major, dropout)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward", time_major=False, dropout=0.0, **kw):
+        super().__init__("GRU", input_size, hidden_size, num_layers, direction, time_major, dropout)
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        sf = sb = None
+        if initial_states is not None:
+            sf, sb = initial_states
+        yf, stf = self.rnn_fw(inputs, sf)
+        yb, stb = self.rnn_bw(inputs, sb)
+        return manip.concat([yf, yb], axis=-1), (stf, stb)

@@ -1,0 +1,140 @@
+"""Pallas TPU kernels (flash attention first; more hot ops over time).
+
+Reference parity: the role of paddle/phi/kernels/gpu/flash_attn_kernel.cu and
+the fused CUDA ops in paddle/fluid/operators/fused/ — but written as Pallas
+TPU kernels (MXU-tiled, VMEM-resident softmax accumulators) per
+/opt/skills/guides/pallas_guide.md. Falls back to the XLA-fused reference
+implementation when the platform or shapes don't fit the kernel grid.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+from jax import numpy as jnp
+
+_BLOCK_Q = 128
+_BLOCK_K = 128
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+def flash_attention_usable(q, causal, dropout_p, k=None, v=None) -> bool:
+    """Kernel constraints: TPU platform, no dropout, self-attention shapes
+    (q==k==v layout), seq multiple of the block, head_dim <= 256. [B,S,H,D]."""
+    if dropout_p > 0.0:
+        return False
+    if not _on_tpu():
+        return False
+    if q.ndim != 4:
+        return False
+    for other in (k, v):
+        if other is not None and tuple(other.shape) != tuple(q.shape):
+            return False  # cross-attention / kv-cache: fall back to XLA chain
+    b, s, h, d = q.shape
+    return s % _BLOCK_Q == 0 and d <= 256 and s >= _BLOCK_Q
+
+
+def _ref_attention_bshd(q, k, v, causal, sm_scale):
+    """XLA reference chain (used for the backward pass until the Pallas
+    backward kernel lands — flash backward recomputes anyway)."""
+    qh = jnp.swapaxes(q, 1, 2)
+    kh = jnp.swapaxes(k, 1, 2)
+    vh = jnp.swapaxes(v, 1, 2)
+    d = qh.shape[-1]
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh).astype(jnp.float32) * scale
+    if causal:
+        ql, kl = logits.shape[-2], logits.shape[-1]
+        cm = jnp.tril(jnp.ones((ql, kl), bool), k=kl - ql)
+        logits = jnp.where(cm, logits, -1e30)
+    p = jax.nn.softmax(logits, axis=-1).astype(qh.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p, vh)
+    return jnp.swapaxes(out, 1, 2)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_attention_bshd(q, k, v, causal=False, sm_scale=None):
+    return _flash_attention_fwd_impl(q, k, v, causal, sm_scale)
+
+
+def _flash_fwd(q, k, v, causal, sm_scale):
+    return _flash_attention_fwd_impl(q, k, v, causal, sm_scale), (q, k, v)
+
+
+def _flash_bwd(causal, sm_scale, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda a, b, c: _ref_attention_bshd(a, b, c, causal, sm_scale), q, k, v)
+    return vjp(g)
+
+
+flash_attention_bshd.defvjp(_flash_fwd, _flash_bwd)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "sm_scale"))
+def _flash_attention_fwd_impl(q, k, v, causal=False, sm_scale=None):
+    """Flash attention on [B, S, H, D]: online-softmax over K blocks.
+
+    Grid: (batch*heads, q_blocks); each program instance streams K/V blocks
+    through VMEM keeping the (m, l, acc) running softmax state — the standard
+    TPU flash pattern (pallas_guide.md)."""
+    from jax.experimental import pallas as pl
+
+    b, s, h, d = q.shape
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+    # -> [B*H, S, D]
+    qr = jnp.swapaxes(q, 1, 2).reshape(b * h, s, d)
+    kr = jnp.swapaxes(k, 1, 2).reshape(b * h, s, d)
+    vr = jnp.swapaxes(v, 1, 2).reshape(b * h, s, d)
+
+    n_q = s // _BLOCK_Q
+
+    def kernel(q_ref, k_ref, v_ref, o_ref):
+        qi = pl.program_id(1)
+        qb = q_ref[...].astype(jnp.float32) * scale
+
+        m0 = jnp.full((_BLOCK_Q,), -1e30, jnp.float32)
+        l0 = jnp.zeros((_BLOCK_Q,), jnp.float32)
+        acc0 = jnp.zeros((_BLOCK_Q, d), jnp.float32)
+
+        n_k = s // _BLOCK_K
+        kmax = (qi + 1) * _BLOCK_Q // _BLOCK_K if causal else n_k
+
+        def body(ki, carry):
+            m, l, acc = carry
+            kb = pl.load(k_ref, (pl.dslice(ki * _BLOCK_K, _BLOCK_K), slice(None))).astype(jnp.float32)
+            vb = pl.load(v_ref, (pl.dslice(ki * _BLOCK_K, _BLOCK_K), slice(None))).astype(jnp.float32)
+            logits = qb @ kb.T  # [BQ, BK] on MXU
+            if causal:
+                qpos = qi * _BLOCK_Q + jax.lax.broadcasted_iota(jnp.int32, (_BLOCK_Q, _BLOCK_K), 0)
+                kpos = ki * _BLOCK_K + jax.lax.broadcasted_iota(jnp.int32, (_BLOCK_Q, _BLOCK_K), 1)
+                logits = jnp.where(qpos >= kpos, logits, -1e30)
+            m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
+            p = jnp.exp(logits - m_new[:, None])
+            alpha = jnp.exp(m - m_new)
+            l_new = l * alpha + jnp.sum(p, axis=-1)
+            acc_new = acc * alpha[:, None] + p @ vb
+            return m_new, l_new, acc_new
+
+        m, l, acc = jax.lax.fori_loop(0, kmax, body, (m0, l0, acc0))
+        o_ref[...] = (acc / l[:, None]).astype(o_ref.dtype)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, n_q),
+        in_specs=[
+            pl.BlockSpec((None, _BLOCK_Q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((None, s, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((None, s, d), lambda bh, qi: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, _BLOCK_Q, d), lambda bh, qi: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+    )(qr, kr, vr)
+
+    return jnp.swapaxes(out.reshape(b, h, s, d), 1, 2)

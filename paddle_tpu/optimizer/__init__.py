@@ -1,1 +1,6 @@
-# placeholder, filled in by subsequent milestones
+"""paddle.optimizer namespace (python/paddle/optimizer/__init__.py)."""
+from . import lr  # noqa: F401
+from .optimizer import (  # noqa: F401
+    Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb, Momentum, Optimizer,
+    RMSProp, SGD,
+)

@@ -1,0 +1,410 @@
+"""Optimizer base + concrete optimizers.
+
+Reference parity: python/paddle/optimizer/optimizer.py:104 (Optimizer:
+accumulators, step/minimize, grad clip, weight decay, LR scheduler bridge)
+with the per-op kernels (_C_ops.sgd_/adamw_...) re-expressed as pure jax
+update functions applied via in-place value replacement — the mutation points
+the to_static recorder captures, so a whole train step compiles to one XLA
+program.
+"""
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Iterable, List, Optional
+
+import jax
+from jax import numpy as jnp
+
+from ..core import state as core_state
+from ..core.state import no_grad
+from ..core.tensor import Tensor
+from ..framework import dtype as dtype_mod
+from .lr import LRScheduler
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None, grad_clip=None, name=None):
+        if parameters is None:
+            raise ValueError("parameters must be provided (dygraph mode)")
+        self._param_groups = self._build_param_groups(parameters)
+        self._lr_scheduler = learning_rate if isinstance(learning_rate, LRScheduler) else None
+        base_lr = learning_rate.last_lr if self._lr_scheduler else float(learning_rate)
+        # LR lives on device so compiled steps treat it as data
+        self._lr_tensor = Tensor(jnp.asarray(base_lr, jnp.float32))
+        self._weight_decay = weight_decay
+        self._grad_clip = grad_clip
+        self._accumulators: dict = defaultdict(dict)  # name -> {id(param): Tensor}
+        self._accumulator_fills: dict = {}  # name -> creation fill value
+        self._pending_state: dict = {}  # loaded state awaiting lazy accumulator creation
+        self._step_count = Tensor(jnp.zeros((), jnp.int64))
+
+    # ---- param groups ----
+    def _build_param_groups(self, parameters):
+        params = list(parameters)
+        if params and isinstance(params[0], dict):
+            groups = []
+            for g in params:
+                g = dict(g)
+                g["params"] = list(g["params"])
+                groups.append(g)
+            return groups
+        return [{"params": params}]
+
+    def _all_params(self):
+        for g in self._param_groups:
+            for p in g["params"]:
+                yield g, p
+
+    # ---- lr ----
+    def get_lr(self) -> float:
+        if self._lr_scheduler is not None:
+            return self._lr_scheduler.last_lr
+        return float(self._lr_tensor.numpy())
+
+    def set_lr(self, value: float):
+        if self._lr_scheduler is not None:
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._lr_tensor._replace_value(jnp.asarray(float(value), jnp.float32))
+
+    def set_lr_scheduler(self, scheduler):
+        self._lr_scheduler = scheduler
+
+    def _sync_lr(self):
+        if self._lr_scheduler is not None:
+            self._lr_tensor._replace_value(jnp.asarray(self._lr_scheduler.last_lr, jnp.float32))
+
+    # ---- accumulators ----
+    def _add_accumulator(self, name, param, fill=0.0, dtype=None, shape=None):
+        key = id(param)
+        if key not in self._accumulators[name]:
+            self._accumulator_fills.setdefault(name, fill)
+            pending = self._pending_state.pop((name, key), None)
+            if pending is not None:
+                self._accumulators[name][key] = Tensor(pending)
+            else:
+                shp = tuple(shape) if shape is not None else tuple(param._value.shape)
+                d = dtype or (jnp.float32 if param._value.dtype == jnp.bfloat16 else param._value.dtype)
+                self._accumulators[name][key] = Tensor(jnp.full(shp, fill, d))
+        return self._accumulators[name][key]
+
+    def _get_accumulator(self, name, param):
+        return self._accumulators[name][id(param)]
+
+    # ---- the step ----
+    @no_grad()
+    def step(self):
+        self._sync_lr()
+        self._step_count._replace_value(self._step_count._value + 1)
+        for group, params_grads in self._grouped_params_grads():
+            if not params_grads:
+                continue
+            clip = group.get("grad_clip", self._grad_clip)
+            if clip is not None:
+                params_grads = clip(params_grads)
+            wd = group.get("weight_decay", self._weight_decay)
+            lr_scale = group.get("learning_rate", 1.0)
+            for p, g in params_grads:
+                if g is None:
+                    continue
+                # per-param overrides: ParamAttr.learning_rate / regularizer
+                p_scale = lr_scale * getattr(p, "optimize_attr", {}).get("learning_rate", 1.0)
+                p_wd = getattr(p, "regularizer", None)
+                self._apply_one(p, g, p_wd if p_wd is not None else wd, p_scale)
+
+    def _grouped_params_grads(self):
+        for g in self._param_groups:
+            pgs = [(p, p.grad) for p in g["params"] if not p.stop_gradient and p.grad is not None]
+            yield g, pgs
+
+    def _apply_one(self, param, grad, weight_decay, lr_scale):
+        raise NotImplementedError
+
+    def _lr_value(self, lr_scale):
+        v = self._lr_tensor.value
+        if lr_scale != 1.0:
+            v = v * lr_scale
+        return v
+
+    def _decayed_grad(self, param, grad_val, weight_decay):
+        """Fold weight decay into the gradient (SGD/Momentum/Adam semantics):
+        L2 adds wd*param, L1 adds wd*sign(param)."""
+        from ..regularizer import L1Decay
+
+        wd = _wd_value(weight_decay)
+        if wd:
+            pv = param._value.astype(grad_val.dtype)
+            if isinstance(weight_decay, L1Decay):
+                return grad_val + wd * jnp.sign(pv)
+            return grad_val + wd * pv
+        return grad_val
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+    def clear_grad(self, set_to_zero=False):
+        for _, p in self._all_params():
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    # ---- state dict ----
+    def state_dict(self):
+        sd = {}
+        # accumulators keyed by (name, parameter order) for stable naming
+        for name, store in self._accumulators.items():
+            i = 0
+            for _, p in self._all_params():
+                if id(p) in store:
+                    sd[f"{name}_{i}"] = store[id(p)]
+                i += 1
+        if self._lr_scheduler is not None:
+            sd["LR_Scheduler"] = self._lr_scheduler.state_dict()
+        sd["@step"] = self._step_count
+        return sd
+
+    def set_state_dict(self, sd):
+        # group loaded keys "name_i" by accumulator name; accumulators may not
+        # exist yet (lazy creation in _apply_one) — stash those as pending so
+        # _add_accumulator picks them up instead of zeros on the first step.
+        import re
+
+        params = [p for _, p in self._all_params()]
+        for key, v in sd.items():
+            m = re.fullmatch(r"(.+)_(\d+)", key)
+            if not m:
+                continue
+            name, idx = m.group(1), int(m.group(2))
+            if idx >= len(params):
+                continue
+            p = params[idx]
+            val = v._value if isinstance(v, Tensor) else jnp.asarray(v)
+            store = self._accumulators.get(name)
+            if store is not None and id(p) in store:
+                store[id(p)]._replace_value(val)
+            else:
+                self._pending_state[(name, id(p))] = val
+        if "LR_Scheduler" in sd and self._lr_scheduler is not None:
+            self._lr_scheduler.set_state_dict(sd["LR_Scheduler"])
+        if "@step" in sd:
+            v = sd["@step"]
+            self._step_count._replace_value(v._value if isinstance(v, Tensor) else jnp.asarray(v))
+
+
+def _wd_value(weight_decay):
+    if weight_decay is None:
+        return 0.0
+    if hasattr(weight_decay, "_coeff"):  # regularizer.L2Decay
+        return float(weight_decay._coeff)
+    return float(weight_decay)
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+
+    def _apply_one(self, p, g, wd, lr_scale):
+        lr = self._lr_value(lr_scale)
+        gv = self._decayed_grad(p, g.value, wd)
+        p._replace_value((p._value - lr.astype(p._value.dtype) * gv.astype(p._value.dtype)))
+        p.stop_gradient = False
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None, use_nesterov=False, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _apply_one(self, p, g, wd, lr_scale):
+        vel = self._add_accumulator("velocity", p)
+        lr = self._lr_value(lr_scale)
+        gv = self._decayed_grad(p, g.value, wd)
+        mu = self._momentum
+        v_new = mu * vel.value + gv.astype(vel._value.dtype)
+        if self._nesterov:
+            upd = gv.astype(p._value.dtype) + mu * v_new.astype(p._value.dtype)
+        else:
+            upd = v_new.astype(p._value.dtype)
+        vel._replace_value(v_new)
+        p._replace_value(p._value - lr.astype(p._value.dtype) * upd)
+        p.stop_gradient = False
+
+
+class Adam(Optimizer):
+    _wd_mode = "l2"  # adam applies wd to grad; adamw decouples
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False, multi_precision=True, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._eps = epsilon
+        self._multi_precision = multi_precision
+
+    def _apply_one(self, p, g, wd, lr_scale):
+        m = self._add_accumulator("moment1", p)
+        v = self._add_accumulator("moment2", p)
+        b1p = self._add_accumulator("beta1_pow", p, fill=1.0, dtype=jnp.float32, shape=())
+        b2p = self._add_accumulator("beta2_pow", p, fill=1.0, dtype=jnp.float32, shape=())
+        lr = self._lr_value(lr_scale)
+        b1, b2, eps = self._beta1, self._beta2, self._eps
+
+        gv = g.value.astype(m._value.dtype)
+        pv32 = p._value.astype(m._value.dtype)
+        wdv = _wd_value(wd)
+        if self._wd_mode == "l2" and wdv:
+            gv = gv + wdv * pv32
+
+        b1p_new = b1p.value * b1
+        b2p_new = b2p.value * b2
+        m_new = b1 * m.value + (1 - b1) * gv
+        v_new = b2 * v.value + (1 - b2) * gv * gv
+        mhat = m_new / (1 - b1p_new)
+        vhat = v_new / (1 - b2p_new)
+        upd = mhat / (jnp.sqrt(vhat) + eps)
+        if self._wd_mode == "decoupled" and wdv:
+            upd = upd + wdv * pv32
+        new_p = pv32 - lr * upd
+        m._replace_value(m_new)
+        v._replace_value(v_new)
+        b1p._replace_value(b1p_new)
+        b2p._replace_value(b2p_new)
+        p._replace_value(new_p.astype(p._value.dtype))
+        p.stop_gradient = False
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (python/paddle/optimizer/adamw.py)."""
+
+    _wd_mode = "decoupled"
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, parameters=None, weight_decay=0.01, lr_ratio=None, apply_decay_param_fun=None, grad_clip=None, lazy_mode=False, multi_precision=True, name=None, **kw):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters, weight_decay, grad_clip, lazy_mode, multi_precision, name)
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _apply_one(self, p, g, wd, lr_scale):
+        if self._apply_decay_param_fun is not None and not self._apply_decay_param_fun(p.name or ""):
+            wd = 0.0
+        super()._apply_one(p, g, wd, lr_scale)
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None, weight_decay=None, grad_clip=None, initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._eps = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _apply_one(self, p, g, wd, lr_scale):
+        acc = self._add_accumulator("moment", p, fill=self._init_acc)
+        lr = self._lr_value(lr_scale)
+        gv = self._decayed_grad(p, g.value, wd).astype(acc._value.dtype)
+        acc_new = acc.value + gv * gv
+        upd = gv / (jnp.sqrt(acc_new) + self._eps)
+        acc._replace_value(acc_new)
+        p._replace_value((p._value.astype(acc_new.dtype) - lr * upd).astype(p._value.dtype))
+        p.stop_gradient = False
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0, centered=False, parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho = rho
+        self._eps = epsilon
+        self._momentum = momentum
+        self._centered = centered
+
+    def _apply_one(self, p, g, wd, lr_scale):
+        ms = self._add_accumulator("mean_square", p)
+        mom = self._add_accumulator("momentum", p)
+        lr = self._lr_value(lr_scale)
+        gv = self._decayed_grad(p, g.value, wd).astype(ms._value.dtype)
+        ms_new = self._rho * ms.value + (1 - self._rho) * gv * gv
+        if self._centered:
+            mg = self._add_accumulator("mean_grad", p)
+            mg_new = self._rho * mg.value + (1 - self._rho) * gv
+            denom = jnp.sqrt(ms_new - mg_new * mg_new + self._eps)
+            mg._replace_value(mg_new)
+        else:
+            denom = jnp.sqrt(ms_new + self._eps)
+        mom_new = self._momentum * mom.value + lr * gv / denom
+        ms._replace_value(ms_new)
+        mom._replace_value(mom_new)
+        p._replace_value((p._value.astype(mom_new.dtype) - mom_new).astype(p._value.dtype))
+        p.stop_gradient = False
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95, parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._eps = epsilon
+        self._rho = rho
+
+    def _apply_one(self, p, g, wd, lr_scale):
+        avg_sq = self._add_accumulator("avg_squared_grad", p)
+        avg_upd = self._add_accumulator("avg_squared_update", p)
+        lr = self._lr_value(lr_scale)
+        gv = self._decayed_grad(p, g.value, wd).astype(avg_sq._value.dtype)
+        sq_new = self._rho * avg_sq.value + (1 - self._rho) * gv * gv
+        upd = jnp.sqrt(avg_upd.value + self._eps) / jnp.sqrt(sq_new + self._eps) * gv
+        upd_new = self._rho * avg_upd.value + (1 - self._rho) * upd * upd
+        avg_sq._replace_value(sq_new)
+        avg_upd._replace_value(upd_new)
+        p._replace_value((p._value.astype(upd.dtype) - lr * upd).astype(p._value.dtype))
+        p.stop_gradient = False
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _apply_one(self, p, g, wd, lr_scale):
+        m = self._add_accumulator("moment", p)
+        inf_norm = self._add_accumulator("inf_norm", p)
+        b1p = self._add_accumulator("beta1_pow", p, fill=1.0, dtype=jnp.float32, shape=())
+        lr = self._lr_value(lr_scale)
+        gv = self._decayed_grad(p, g.value, wd).astype(m._value.dtype)
+        b1p_new = b1p.value * self._beta1
+        m_new = self._beta1 * m.value + (1 - self._beta1) * gv
+        u_new = jnp.maximum(self._beta2 * inf_norm.value, jnp.abs(gv))
+        upd = lr / (1 - b1p_new) * m_new / (u_new + self._eps)
+        m._replace_value(m_new)
+        inf_norm._replace_value(u_new)
+        b1p._replace_value(b1p_new)
+        p._replace_value((p._value.astype(upd.dtype) - upd).astype(p._value.dtype))
+        p.stop_gradient = False
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None, exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._wd = lamb_weight_decay
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _apply_one(self, p, g, wd, lr_scale):
+        m = self._add_accumulator("moment1", p)
+        v = self._add_accumulator("moment2", p)
+        b1p = self._add_accumulator("beta1_pow", p, fill=1.0, dtype=jnp.float32, shape=())
+        b2p = self._add_accumulator("beta2_pow", p, fill=1.0, dtype=jnp.float32, shape=())
+        lr = self._lr_value(lr_scale)
+        gv = g.value.astype(m._value.dtype)
+        pv = p._value.astype(m._value.dtype)
+        b1p_new, b2p_new = b1p.value * self._beta1, b2p.value * self._beta2
+        m_new = self._beta1 * m.value + (1 - self._beta1) * gv
+        v_new = self._beta2 * v.value + (1 - self._beta2) * gv * gv
+        mhat = m_new / (1 - b1p_new)
+        vhat = v_new / (1 - b2p_new)
+        r = mhat / (jnp.sqrt(vhat) + self._eps)
+        wd = self._wd if (self._exclude_fn is None or not self._exclude_fn(p)) else 0.0
+        r = r + wd * pv
+        w_norm = jnp.linalg.norm(pv)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        m._replace_value(m_new)
+        v._replace_value(v_new)
+        b1p._replace_value(b1p_new)
+        b2p._replace_value(b2p_new)
+        p._replace_value((pv - lr * trust * r).astype(p._value.dtype))
+        p.stop_gradient = False

@@ -1,0 +1,25 @@
+"""Weight-decay regularizers (python/paddle/regularizer.py: L1Decay, L2Decay).
+
+A Parameter's regularizer overrides the optimizer-level weight_decay, matching
+the reference's precedence (python/paddle/optimizer/optimizer.py)."""
+from __future__ import annotations
+
+
+class WeightDecayRegularizer:
+    pass
+
+
+class L2Decay(WeightDecayRegularizer):
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    def __repr__(self):
+        return f"L2Decay({self._coeff})"
+
+
+class L1Decay(WeightDecayRegularizer):
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    def __repr__(self):
+        return f"L1Decay({self._coeff})"

@@ -1,1 +1,25 @@
-# placeholder, filled in by subsequent milestones
+"""paddle.static compatibility shims.
+
+The reference's static-graph mode (ProgramDesc/PIR + Executor,
+python/paddle/static/) is subsumed by program capture (paddle_tpu.jit):
+jax tracing IS the static graph. This module keeps the high-traffic API
+names importable and functional where they map cleanly.
+"""
+from ..jit.api import cond  # noqa: F401
+
+
+class InputSpec:
+    """paddle.static.InputSpec parity (shape/dtype/name triple)."""
+
+    def __init__(self, shape=None, dtype="float32", name=None, stop_gradient=True):
+        self.shape = list(shape) if shape is not None else None
+        self.dtype = dtype
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, tensor.dtype, name or tensor.name)
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"

@@ -1,1 +1,2 @@
-# placeholder, filled in by subsequent milestones
+"""paddle.vision namespace — models land with the model-zoo milestone."""
+from . import models  # noqa: F401

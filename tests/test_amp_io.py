@@ -1,0 +1,116 @@
+"""AMP + DataLoader + save/load tests."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.io import BatchSampler, DataLoader, Dataset, DistributedBatchSampler, TensorDataset
+
+
+def test_auto_cast_o1():
+    m = nn.Linear(4, 4)
+    x = paddle.randn([2, 4])
+    with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+        out = m(x)
+        assert out.dtype == paddle.bfloat16
+        s = paddle.exp(out)  # blacklist -> f32
+        assert s.dtype == paddle.float32
+    out2 = m(x)
+    assert out2.dtype == paddle.float32
+
+
+def test_auto_cast_o2_and_decorate():
+    m = nn.Linear(4, 4)
+    paddle.amp.decorate(m, level="O2", dtype="bfloat16")
+    assert m.weight.dtype == paddle.bfloat16
+    with paddle.amp.auto_cast(level="O2", dtype="bfloat16"):
+        out = m(paddle.randn([2, 4]))
+    assert out.dtype == paddle.bfloat16
+
+
+def test_amp_training_converges():
+    paddle.seed(3)
+    m = nn.Sequential(nn.Linear(4, 16), nn.ReLU(), nn.Linear(16, 1))
+    opt = paddle.optimizer.Adam(0.01, parameters=m.parameters())
+    X = paddle.randn([32, 4]); Y = X.sum(axis=1, keepdim=True)
+    for i in range(80):
+        with paddle.amp.auto_cast(level="O1"):
+            loss = paddle.nn.functional.mse_loss(m(X).astype("float32"), Y)
+        loss.backward()
+        opt.step(); opt.clear_grad()
+    assert float(loss) < 0.3, float(loss)
+
+
+class _SquareDS(Dataset):
+    def __len__(self):
+        return 10
+
+    def __getitem__(self, i):
+        return np.float32(i), np.float32(i * i)
+
+
+def test_dataloader_basic():
+    dl = DataLoader(_SquareDS(), batch_size=4, drop_last=False)
+    batches = list(dl)
+    assert len(batches) == 3
+    x, y = batches[0]
+    assert x.shape == [4]
+    np.testing.assert_allclose(y.numpy(), [0, 1, 4, 9])
+    assert len(dl) == 3
+
+
+def test_dataloader_shuffle_and_prefetch():
+    dl = DataLoader(_SquareDS(), batch_size=5, shuffle=True, num_workers=2)
+    xs = np.concatenate([b[0].numpy() for b in dl])
+    assert sorted(xs.tolist()) == list(range(10))
+
+
+def test_tensor_dataset_and_collate_dict():
+    ds = TensorDataset([paddle.arange(6).reshape([6, 1]), paddle.ones([6, 2])])
+    dl = DataLoader(ds, batch_size=3)
+    a, b = next(iter(dl))
+    assert a.shape == [3, 1] and b.shape == [3, 2]
+
+
+def test_distributed_batch_sampler():
+    ds = _SquareDS()
+    s0 = DistributedBatchSampler(ds, batch_size=2, num_replicas=2, rank=0)
+    s1 = DistributedBatchSampler(ds, batch_size=2, num_replicas=2, rank=1)
+    i0 = [i for b in s0 for i in b]
+    i1 = [i for b in s1 for i in b]
+    assert len(i0) == len(i1) == 5
+    assert set(i0) | set(i1) == set(range(10))
+
+
+def test_save_load_roundtrip():
+    m = nn.Sequential(nn.Linear(3, 5), nn.Linear(5, 2))
+    opt = paddle.optimizer.Adam(0.01, parameters=m.parameters())
+    m(paddle.ones([1, 3])).sum().backward()
+    opt.step(); opt.clear_grad()
+    with tempfile.TemporaryDirectory() as d:
+        paddle.save(m.state_dict(), os.path.join(d, "model.pdparams"))
+        paddle.save(opt.state_dict(), os.path.join(d, "opt.pdopt"))
+        sd = paddle.load(os.path.join(d, "model.pdparams"))
+        od = paddle.load(os.path.join(d, "opt.pdopt"))
+    m2 = nn.Sequential(nn.Linear(3, 5), nn.Linear(5, 2))
+    m2.set_state_dict(sd)
+    np.testing.assert_allclose(m2[0].weight.numpy(), m[0].weight.numpy())
+    opt2 = paddle.optimizer.Adam(0.01, parameters=m2.parameters())
+    m2(paddle.ones([1, 3])).sum().backward()
+    opt2.step(); opt2.clear_grad()
+    opt2.set_state_dict(od)
+    np.testing.assert_allclose(float(opt2._step_count), 1)
+
+
+def test_metric_accuracy():
+    acc = paddle.metric.Accuracy()
+    pred = paddle.to_tensor([[0.1, 0.9], [0.8, 0.2]])
+    lbl = paddle.to_tensor([[1], [1]])
+    correct = acc.compute(pred, lbl)
+    acc.update(correct)
+    assert abs(acc.accumulate() - 0.5) < 1e-6
+    a = paddle.metric.accuracy(pred, paddle.to_tensor([1, 1]))
+    assert abs(float(a) - 0.5) < 1e-6

@@ -1,0 +1,136 @@
+"""to_static program capture tests (models test/dygraph_to_static/)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def _build(seed=7):
+    paddle.seed(seed)
+    m = nn.Sequential(nn.Linear(8, 16), nn.GELU(), nn.Linear(16, 1))
+    o = paddle.optimizer.AdamW(0.01, parameters=m.parameters())
+    return m, o
+
+
+def test_compiled_train_step_matches_eager():
+    X = paddle.randn([16, 8]); Y = X.sum(axis=1, keepdim=True)
+    m1, o1 = _build()
+    eager = []
+    for _ in range(6):
+        loss = paddle.nn.functional.mse_loss(m1(X), Y)
+        loss.backward(); o1.step(); o1.clear_grad()
+        eager.append(float(loss))
+    m2, o2 = _build()
+
+    @paddle.jit.to_static
+    def step(x, y):
+        loss = paddle.nn.functional.mse_loss(m2(x), y)
+        loss.backward(); o2.step(); o2.clear_grad()
+        return loss
+
+    jit = [float(step(X, Y)) for _ in range(6)]
+    np.testing.assert_allclose(eager, jit, rtol=1e-4)
+    np.testing.assert_allclose(
+        m1.state_dict()["0.weight"].numpy(), m2.state_dict()["0.weight"].numpy(), rtol=1e-3, atol=1e-6
+    )
+
+
+def test_forward_capture_and_shape_guard():
+    m, _ = _build()
+
+    f = paddle.jit.to_static(lambda x: m(x) * 2)
+    a = f(paddle.ones([2, 8]))
+    b = f(paddle.ones([2, 8]))
+    np.testing.assert_allclose(a.numpy(), b.numpy())
+    c = f(paddle.ones([5, 8]))  # shape change -> retrace, not crash
+    assert c.shape == [5, 1]
+    assert len(f._cache) == 2
+
+
+def test_dropout_varies_under_capture():
+    m = nn.Sequential(nn.Linear(4, 4), nn.Dropout(0.5))
+
+    @paddle.jit.to_static
+    def fwd(x):
+        return m(x)
+
+    outs = [fwd(paddle.ones([2, 4])).numpy() for _ in range(3)]
+    assert not (np.array_equal(outs[0], outs[1]) and np.array_equal(outs[1], outs[2]))
+    m.eval()
+    a, b = fwd(paddle.ones([2, 4])).numpy(), fwd(paddle.ones([2, 4])).numpy()
+    np.testing.assert_array_equal(a, b)
+
+
+def test_lr_schedule_visible_inside_compiled_step():
+    m = nn.Linear(8, 4)  # pure linear: dL/dW constant, isolates the LR effect
+    sched = paddle.optimizer.lr.StepDecay(0.1, step_size=1, gamma=0.5)
+    opt = paddle.optimizer.SGD(sched, parameters=m.parameters())
+
+    @paddle.jit.to_static
+    def step(x):
+        loss = m(x).sum()
+        loss.backward()
+        opt.step(); opt.clear_grad()
+        return loss
+
+    x = paddle.ones([1, 8])
+    w0 = m.weight.numpy().copy()
+    step(x)
+    d1 = np.abs(m.weight.numpy() - w0).max()
+    sched.step()
+    w1 = m.weight.numpy().copy()
+    step(x)
+    d2 = np.abs(m.weight.numpy() - w1).max()
+    # lr halved -> update magnitude exactly halves
+    np.testing.assert_allclose(d2 / d1, 0.5, rtol=1e-3)
+
+
+def test_bn_buffers_update_in_compiled_step():
+    m = nn.Sequential(nn.Linear(4, 4), nn.BatchNorm1D(4))
+
+    @paddle.jit.to_static
+    def fwd(x):
+        return m(x)
+
+    x = paddle.randn([8, 4])
+    m0 = m[1]._mean.numpy().copy()
+    fwd(x)
+    m1 = m[1]._mean.numpy().copy()
+    fwd(x)
+    m2 = m[1]._mean.numpy().copy()
+    assert not np.array_equal(m0, m1)
+    assert not np.array_equal(m1, m2)
+
+
+def test_grad_accumulation_pattern_under_capture():
+    m, _ = _build()
+
+    @paddle.jit.to_static
+    def accum(x):
+        m(x).sum().backward()  # no clear_grad: grads must accumulate across calls
+
+    x = paddle.ones([2, 8])
+    accum(x)
+    g1 = m[0].weight.grad.numpy().copy()
+    accum(x)
+    g2 = m[0].weight.grad.numpy()
+    np.testing.assert_allclose(g2, 2 * g1, rtol=1e-5)
+
+
+def test_to_static_on_layer():
+    m, _ = _build()
+    m2 = paddle.jit.to_static(m)
+    out = m2(paddle.ones([3, 8]))
+    assert out.shape == [3, 1]
+
+
+def test_nested_output_structure():
+    @paddle.jit.to_static
+    def f(x):
+        return {"a": x * 2, "b": (x + 1, 3.5)}
+
+    out = f(paddle.ones([2]))
+    out = f(paddle.ones([2]))  # compiled path
+    assert out["b"][1] == 3.5
+    np.testing.assert_allclose(out["a"].numpy(), [2, 2])

@@ -1,0 +1,337 @@
+"""nn.Layer + layers tests (models test/legacy_test layer tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+
+
+def test_layer_registration_and_traversal():
+    class M(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(4, 8)
+            self.fc2 = nn.Linear(8, 2)
+            self.register_buffer("counter", paddle.zeros([1]))
+
+        def forward(self, x):
+            return self.fc2(F.relu(self.fc1(x)))
+
+    m = M()
+    names = [n for n, _ in m.named_parameters()]
+    assert names == ["fc1.weight", "fc1.bias", "fc2.weight", "fc2.bias"]
+    assert len(m.sublayers()) == 2
+    assert "counter" in m.state_dict()
+    out = m(paddle.randn([3, 4]))
+    assert out.shape == [3, 2]
+
+
+def test_state_dict_roundtrip():
+    m1 = nn.Linear(4, 4)
+    m2 = nn.Linear(4, 4)
+    missing, unexpected = m2.set_state_dict(m1.state_dict())
+    assert not missing and not unexpected
+    x = paddle.randn([2, 4])
+    np.testing.assert_allclose(m1(x).numpy(), m2(x).numpy(), rtol=1e-6)
+    with pytest.raises(ValueError):
+        m2.set_state_dict({"weight": paddle.zeros([5, 5]), "bias": paddle.zeros([4])})
+
+
+def test_train_eval_mode():
+    m = nn.Sequential(nn.Linear(4, 4), nn.Dropout(0.5))
+    assert m.training
+    m.eval()
+    assert not m[1].training
+    x = paddle.ones([2, 4])
+    np.testing.assert_allclose(m(x).numpy(), m(x).numpy())  # deterministic in eval
+    m.train()
+    assert m[1].training
+
+
+def test_forward_hooks():
+    m = nn.Linear(2, 2)
+    calls = []
+    h1 = m.register_forward_pre_hook(lambda layer, inp: calls.append("pre"))
+    h2 = m.register_forward_post_hook(lambda layer, inp, out: calls.append("post"))
+    m(paddle.ones([1, 2]))
+    assert calls == ["pre", "post"]
+    h1.remove(); h2.remove()
+    m(paddle.ones([1, 2]))
+    assert calls == ["pre", "post"]
+
+
+def test_linear_matches_numpy():
+    m = nn.Linear(3, 5)
+    x = paddle.randn([4, 3])
+    ref = x.numpy() @ m.weight.numpy() + m.bias.numpy()
+    np.testing.assert_allclose(m(x).numpy(), ref, rtol=1e-5)
+
+
+def test_conv2d_matches_torch():
+    torch = pytest.importorskip("torch")
+    m = nn.Conv2D(3, 6, 3, stride=2, padding=1)
+    x = np.random.RandomState(0).randn(2, 3, 8, 8).astype(np.float32)
+    out = m(paddle.to_tensor(x)).numpy()
+    tout = torch.nn.functional.conv2d(
+        torch.tensor(x), torch.tensor(m.weight.numpy()), torch.tensor(m.bias.numpy()),
+        stride=2, padding=1,
+    ).numpy()
+    np.testing.assert_allclose(out, tout, rtol=1e-4, atol=1e-5)
+
+
+def test_conv2d_transpose_matches_torch():
+    torch = pytest.importorskip("torch")
+    m = nn.Conv2DTranspose(4, 6, 3, stride=2, padding=1, output_padding=1)
+    x = np.random.RandomState(1).randn(2, 4, 5, 5).astype(np.float32)
+    out = m(paddle.to_tensor(x)).numpy()
+    tout = torch.nn.functional.conv_transpose2d(
+        torch.tensor(x), torch.tensor(m.weight.numpy()), torch.tensor(m.bias.numpy()),
+        stride=2, padding=1, output_padding=1,
+    ).numpy()
+    assert out.shape == tout.shape == (2, 6, 10, 10)
+    np.testing.assert_allclose(out, tout, rtol=1e-4, atol=1e-5)
+
+
+def test_grouped_and_dilated_conv():
+    torch = pytest.importorskip("torch")
+    m = nn.Conv2D(4, 8, 3, groups=2, dilation=2, padding=2)
+    x = np.random.RandomState(2).randn(1, 4, 9, 9).astype(np.float32)
+    out = m(paddle.to_tensor(x)).numpy()
+    tout = torch.nn.functional.conv2d(
+        torch.tensor(x), torch.tensor(m.weight.numpy()), torch.tensor(m.bias.numpy()),
+        padding=2, dilation=2, groups=2,
+    ).numpy()
+    np.testing.assert_allclose(out, tout, rtol=1e-4, atol=1e-5)
+
+
+def test_batch_norm_train_eval():
+    m = nn.BatchNorm2D(3, momentum=0.9)
+    x = paddle.randn([4, 3, 5, 5]) * 2 + 1
+    m.train()
+    y = m(x)
+    # normalized output: per-channel mean~0 std~1
+    yn = y.numpy()
+    assert abs(yn.mean()) < 1e-5
+    assert abs(yn.std() - 1) < 1e-2
+    # running stats moved toward batch stats
+    assert abs(m._mean.numpy().mean() - 0.1 * x.numpy().mean(axis=(0, 2, 3)).mean()) < 1e-5
+    m.eval()
+    y2 = m(x)
+    assert not np.allclose(y2.numpy(), yn)
+
+
+def test_layer_norm_and_rms_norm():
+    x = paddle.randn([2, 6, 16])
+    ln = nn.LayerNorm(16)
+    y = ln(x).numpy()
+    np.testing.assert_allclose(y.mean(-1), 0, atol=1e-5)
+    np.testing.assert_allclose(y.std(-1), 1, atol=1e-2)
+    rms = nn.RMSNorm(16)
+    yr = rms(x).numpy()
+    ref = x.numpy() / np.sqrt((x.numpy() ** 2).mean(-1, keepdims=True) + 1e-6)
+    np.testing.assert_allclose(yr, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_group_norm():
+    torch = pytest.importorskip("torch")
+    m = nn.GroupNorm(2, 4)
+    x = np.random.RandomState(3).randn(2, 4, 6, 6).astype(np.float32)
+    out = m(paddle.to_tensor(x)).numpy()
+    tout = torch.nn.functional.group_norm(torch.tensor(x), 2).numpy()
+    np.testing.assert_allclose(out, tout, rtol=1e-4, atol=1e-5)
+
+
+def test_pooling():
+    torch = pytest.importorskip("torch")
+    x = np.random.RandomState(4).randn(2, 3, 8, 8).astype(np.float32)
+    out = F.max_pool2d(paddle.to_tensor(x), 2, 2).numpy()
+    tout = torch.nn.functional.max_pool2d(torch.tensor(x), 2, 2).numpy()
+    np.testing.assert_allclose(out, tout)
+    out = F.avg_pool2d(paddle.to_tensor(x), 3, 2, 1).numpy()
+    tout = torch.nn.functional.avg_pool2d(torch.tensor(x), 3, 2, 1, count_include_pad=False).numpy()
+    np.testing.assert_allclose(out, tout, rtol=1e-5)
+    out = F.adaptive_avg_pool2d(paddle.to_tensor(x), 1).numpy()
+    np.testing.assert_allclose(out.reshape(2, 3), x.mean((2, 3)), rtol=1e-5)
+    out = F.adaptive_avg_pool2d(paddle.to_tensor(x), 3).numpy()
+    tout = torch.nn.functional.adaptive_avg_pool2d(torch.tensor(x), 3).numpy()
+    np.testing.assert_allclose(out, tout, rtol=1e-5)
+
+
+def test_activations_match_torch():
+    torch = pytest.importorskip("torch")
+    x = np.linspace(-3, 3, 50, dtype=np.float32)
+    tx = torch.tensor(x)
+    pairs = [
+        (F.relu, torch.nn.functional.relu),
+        (F.gelu, lambda v: torch.nn.functional.gelu(v)),
+        (F.silu, torch.nn.functional.silu),
+        (F.hardswish, torch.nn.functional.hardswish),
+        (F.softplus, torch.nn.functional.softplus),
+        (F.leaky_relu, torch.nn.functional.leaky_relu),
+        (F.elu, torch.nn.functional.elu),
+        (F.mish, torch.nn.functional.mish),
+    ]
+    for pf, tf in pairs:
+        np.testing.assert_allclose(pf(paddle.to_tensor(x)).numpy(), tf(tx).numpy(), rtol=1e-4, atol=1e-5, err_msg=str(pf))
+
+
+def test_softmax_cross_entropy_matches_torch():
+    torch = pytest.importorskip("torch")
+    logits = np.random.RandomState(5).randn(8, 10).astype(np.float32)
+    labels = np.random.RandomState(6).randint(0, 10, 8)
+    out = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(labels)).numpy()
+    tout = torch.nn.functional.cross_entropy(torch.tensor(logits), torch.tensor(labels)).numpy()
+    np.testing.assert_allclose(out, tout, rtol=1e-5)
+    # ignore_index
+    labels2 = labels.copy(); labels2[:3] = -100
+    out = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(labels2), ignore_index=-100).numpy()
+    tout = torch.nn.functional.cross_entropy(torch.tensor(logits), torch.tensor(labels2), ignore_index=-100).numpy()
+    np.testing.assert_allclose(out, tout, rtol=1e-5)
+    # soft label
+    soft = np.random.RandomState(7).rand(8, 10).astype(np.float32)
+    soft /= soft.sum(-1, keepdims=True)
+    out = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(soft), soft_label=True).numpy()
+    tout = torch.nn.functional.cross_entropy(torch.tensor(logits), torch.tensor(soft)).numpy()
+    np.testing.assert_allclose(out, tout, rtol=1e-5)
+    # label smoothing
+    out = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(labels), label_smoothing=0.1).numpy()
+    tout = torch.nn.functional.cross_entropy(torch.tensor(logits), torch.tensor(labels), label_smoothing=0.1).numpy()
+    np.testing.assert_allclose(out, tout, rtol=1e-4)
+
+
+def test_losses_match_torch():
+    torch = pytest.importorskip("torch")
+    rng = np.random.RandomState(8)
+    a, b = rng.randn(6, 4).astype(np.float32), rng.randn(6, 4).astype(np.float32)
+    np.testing.assert_allclose(
+        F.mse_loss(paddle.to_tensor(a), paddle.to_tensor(b)).numpy(),
+        torch.nn.functional.mse_loss(torch.tensor(a), torch.tensor(b)).numpy(), rtol=1e-5)
+    np.testing.assert_allclose(
+        F.l1_loss(paddle.to_tensor(a), paddle.to_tensor(b)).numpy(),
+        torch.nn.functional.l1_loss(torch.tensor(a), torch.tensor(b)).numpy(), rtol=1e-5)
+    logit = rng.randn(6, 4).astype(np.float32)
+    lbl = rng.randint(0, 2, (6, 4)).astype(np.float32)
+    np.testing.assert_allclose(
+        F.binary_cross_entropy_with_logits(paddle.to_tensor(logit), paddle.to_tensor(lbl)).numpy(),
+        torch.nn.functional.binary_cross_entropy_with_logits(torch.tensor(logit), torch.tensor(lbl)).numpy(), rtol=1e-5)
+    logp = np.log(np.abs(rng.rand(6, 4)).astype(np.float32) + 0.1)
+    q = np.abs(rng.rand(6, 4)).astype(np.float32)
+    np.testing.assert_allclose(
+        F.kl_div(paddle.to_tensor(logp), paddle.to_tensor(q), reduction="batchmean").numpy(),
+        torch.nn.functional.kl_div(torch.tensor(logp), torch.tensor(q), reduction="batchmean").numpy(), rtol=1e-4)
+
+
+def test_embedding_and_padding_idx():
+    emb = nn.Embedding(10, 4, padding_idx=0)
+    ids = paddle.to_tensor([[1, 0, 3]])
+    out = emb(ids)
+    assert out.shape == [1, 3, 4]
+    np.testing.assert_allclose(out.numpy()[0, 1], np.zeros(4))
+    # grad flows to looked-up rows only
+    emb.weight.clear_grad()
+    out.sum().backward()
+    g = emb.weight.grad.numpy()
+    assert g[1].sum() != 0 and g[2].sum() == 0
+
+
+def test_attention_matches_reference():
+    q = paddle.randn([2, 6, 4, 8])
+    out = F.scaled_dot_product_attention(q, q, q, is_causal=True, training=False)
+    assert out.shape == [2, 6, 4, 8]
+    # causal: first position attends only to itself -> equals v[0]
+    np.testing.assert_allclose(out.numpy()[:, 0], q.numpy()[:, 0], rtol=1e-4, atol=1e-5)
+
+
+def test_mha_and_transformer_encoder():
+    m = nn.TransformerEncoderLayer(d_model=32, nhead=4, dim_feedforward=64)
+    m.eval()
+    src = paddle.randn([2, 7, 32])
+    out = m(src)
+    assert out.shape == [2, 7, 32]
+    enc = nn.TransformerEncoder(m, 2)
+    enc.eval()
+    assert enc(src).shape == [2, 7, 32]
+    # params are distinct between stacked layers
+    p0 = enc.layers[0].linear1.weight
+    p1 = enc.layers[1].linear1.weight
+    assert p0 is not p1
+
+
+def test_lstm_matches_torch():
+    torch = pytest.importorskip("torch")
+    cell = nn.LSTMCell(4, 6)
+    x = np.random.RandomState(9).randn(3, 4).astype(np.float32)
+    h0 = np.zeros((3, 6), np.float32)
+    c0 = np.zeros((3, 6), np.float32)
+    out, (h, c) = cell(paddle.to_tensor(x), (paddle.to_tensor(h0), paddle.to_tensor(c0)))
+    tcell = torch.nn.LSTMCell(4, 6)
+    with torch.no_grad():
+        tcell.weight_ih.copy_(torch.tensor(cell.weight_ih.numpy()))
+        tcell.weight_hh.copy_(torch.tensor(cell.weight_hh.numpy()))
+        tcell.bias_ih.copy_(torch.tensor(cell.bias_ih.numpy()))
+        tcell.bias_hh.copy_(torch.tensor(cell.bias_hh.numpy()))
+        th, tc = tcell(torch.tensor(x), (torch.tensor(h0), torch.tensor(c0)))
+    np.testing.assert_allclose(h.numpy(), th.numpy(), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(c.numpy(), tc.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_grad_clip_global_norm():
+    m = nn.Linear(3, 3)
+    (m(paddle.ones([1, 3])).sum() * 100).backward()
+    clip = nn.ClipGradByGlobalNorm(1.0)
+    pg = clip([(p, p.grad) for p in m.parameters()])
+    total = np.sqrt(sum((g.numpy() ** 2).sum() for _, g in pg))
+    np.testing.assert_allclose(total, 1.0, rtol=1e-4)
+
+
+def test_sequential_and_layerlist():
+    s = nn.Sequential(nn.Linear(2, 3), nn.ReLU(), nn.Linear(3, 1))
+    assert len(s) == 3 and s[0].weight.shape == [2, 3]
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    ll.append(nn.Linear(2, 2))
+    assert len(ll) == 4
+    assert len(list(ll.parameters())) == 8
+
+
+def test_layer_to_dtype():
+    m = nn.Linear(2, 2)
+    m.to(dtype="bfloat16")
+    assert m.weight.dtype == paddle.bfloat16
+    out = m(paddle.ones([1, 2], dtype="bfloat16"))
+    assert out.dtype == paddle.bfloat16
+
+
+def test_ceil_mode_pooling():
+    torch = pytest.importorskip("torch")
+    x = np.random.RandomState(11).randn(1, 2, 8, 8).astype(np.float32)
+    out = F.max_pool2d(paddle.to_tensor(x), 3, 2, 0, ceil_mode=True).numpy()
+    tout = torch.nn.functional.max_pool2d(torch.tensor(x), 3, 2, 0, ceil_mode=True).numpy()
+    assert out.shape == tout.shape == (1, 2, 4, 4)
+    np.testing.assert_allclose(out, tout)
+    out = F.avg_pool2d(paddle.to_tensor(x), 3, 2, 0, ceil_mode=True, exclusive=True).numpy()
+    tout = torch.nn.functional.avg_pool2d(torch.tensor(x), 3, 2, 0, ceil_mode=True, count_include_pad=False).numpy()
+    np.testing.assert_allclose(out, tout, rtol=1e-5)
+
+
+def test_param_attr_overrides():
+    attr = nn.ParamAttr(learning_rate=0.5, need_clip=False)
+    lin = nn.Linear(2, 2, weight_attr=attr)
+    assert lin.weight.optimize_attr["learning_rate"] == 0.5
+    assert lin.weight.need_clip is False
+    opt = paddle.optimizer.SGD(0.1, parameters=lin.parameters())
+    w0 = lin.weight.numpy().copy()
+    lin(paddle.ones([1, 2])).sum().backward()
+    opt.step()
+    # effective lr = 0.1 * 0.5; grad = 1 everywhere for this loss
+    np.testing.assert_allclose(w0 - lin.weight.numpy(), np.full((2, 2), 0.05), rtol=1e-5)
+
+
+def test_regularizer_precedence():
+    import paddle_tpu.regularizer as reg
+    p = nn.Parameter(np.ones((2,), np.float32))
+    p.regularizer = reg.L2Decay(1.0)  # overrides optimizer wd=0
+    opt = paddle.optimizer.SGD(0.1, parameters=[p], weight_decay=0.0)
+    (p * 0.0).sum().backward()
+    opt.step()
+    np.testing.assert_allclose(p.numpy(), [0.9, 0.9], rtol=1e-6)

@@ -1,0 +1,205 @@
+"""Optimizer tests (models test/legacy_test/test_sgd_op.py, test_adamw_op.py
+style checks at the API level: numeric parity with torch.optim)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def _one_step_compare(p_opt_fn, t_opt_fn, steps=5):
+    torch = pytest.importorskip("torch")
+    w0 = np.random.RandomState(0).randn(4, 3).astype(np.float32)
+    x = np.random.RandomState(1).randn(8, 4).astype(np.float32)
+
+    p = nn.Parameter(w0.copy())
+    popt = p_opt_fn([p])
+    for _ in range(steps):
+        loss = (paddle.to_tensor(x) @ p).sum()
+        loss.backward()
+        popt.step()
+        popt.clear_grad()
+
+    tw = torch.nn.Parameter(torch.tensor(w0.copy()))
+    topt = t_opt_fn([tw])
+    for _ in range(steps):
+        loss = (torch.tensor(x) @ tw).sum()
+        loss.backward()
+        topt.step()
+        topt.zero_grad()
+    np.testing.assert_allclose(p.numpy(), tw.detach().numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_sgd_matches_torch():
+    import torch
+
+    _one_step_compare(
+        lambda ps: paddle.optimizer.SGD(0.1, parameters=ps),
+        lambda ps: torch.optim.SGD(ps, lr=0.1),
+    )
+
+
+def test_momentum_matches_torch():
+    import torch
+
+    _one_step_compare(
+        lambda ps: paddle.optimizer.Momentum(0.1, momentum=0.9, parameters=ps),
+        lambda ps: torch.optim.SGD(ps, lr=0.1, momentum=0.9),
+    )
+
+
+def test_adam_matches_torch():
+    import torch
+
+    _one_step_compare(
+        lambda ps: paddle.optimizer.Adam(0.01, parameters=ps),
+        lambda ps: torch.optim.Adam(ps, lr=0.01),
+    )
+
+
+def test_adamw_matches_torch():
+    import torch
+
+    _one_step_compare(
+        lambda ps: paddle.optimizer.AdamW(0.01, weight_decay=0.05, parameters=ps),
+        lambda ps: torch.optim.AdamW(ps, lr=0.01, weight_decay=0.05),
+    )
+
+
+def test_weight_decay_l2_in_sgd():
+    p = nn.Parameter(np.ones((2,), np.float32))
+    opt = paddle.optimizer.SGD(0.1, parameters=[p], weight_decay=0.5)
+    (paddle.to_tensor([0.0, 0.0]) * p).sum().backward()
+    opt.step()
+    # grad = 0 + wd*p = 0.5 -> p = 1 - 0.1*0.5
+    np.testing.assert_allclose(p.numpy(), [0.95, 0.95], rtol=1e-6)
+
+
+def test_param_groups():
+    a = nn.Parameter(np.ones((2,), np.float32))
+    b = nn.Parameter(np.ones((2,), np.float32))
+    opt = paddle.optimizer.SGD(
+        0.1,
+        parameters=[{"params": [a], "learning_rate": 0.1}, {"params": [b], "learning_rate": 10.0}],
+    )
+    (a.sum() + b.sum()).backward()
+    opt.step()
+    np.testing.assert_allclose(a.numpy(), [0.99, 0.99], rtol=1e-5)
+    np.testing.assert_allclose(b.numpy(), [0.0, 0.0], atol=1e-6)
+
+
+def test_lr_scheduler_bridge():
+    m = nn.Linear(2, 2)
+    sched = paddle.optimizer.lr.MultiStepDecay(0.1, milestones=[2, 4], gamma=0.1)
+    opt = paddle.optimizer.Adam(sched, parameters=m.parameters())
+    seen = []
+    for i in range(5):
+        m(paddle.ones([1, 2])).sum().backward()
+        opt.step(); opt.clear_grad(); sched.step()
+        seen.append(round(opt.get_lr(), 6))
+    assert seen == [0.1, 0.01, 0.01, 0.001, 0.001]
+
+
+def test_cosine_and_warmup_schedulers():
+    s = paddle.optimizer.lr.CosineAnnealingDecay(1.0, T_max=10)
+    vals = [s.last_lr]
+    for _ in range(10):
+        s.step()
+        vals.append(s.last_lr)
+    np.testing.assert_allclose(vals[0], 1.0)
+    np.testing.assert_allclose(vals[10], 0.0, atol=1e-8)
+    w = paddle.optimizer.lr.LinearWarmup(0.5, warmup_steps=5, start_lr=0.0, end_lr=0.5)
+    ws = [w.last_lr]
+    for _ in range(6):
+        w.step()
+        ws.append(w.last_lr)
+    np.testing.assert_allclose(ws[5], 0.5, rtol=1e-6)
+
+
+def test_optimizer_state_dict_roundtrip():
+    m = nn.Linear(2, 2)
+    opt = paddle.optimizer.Adam(0.01, parameters=m.parameters())
+    for _ in range(3):
+        m(paddle.ones([1, 2])).sum().backward()
+        opt.step(); opt.clear_grad()
+    sd = opt.state_dict()
+    assert any(k.startswith("moment1") for k in sd)
+    m2 = nn.Linear(2, 2)
+    opt2 = paddle.optimizer.Adam(0.01, parameters=m2.parameters())
+    m2(paddle.ones([1, 2])).sum().backward()
+    opt2.step(); opt2.clear_grad()  # materialize accumulators
+    opt2.set_state_dict(sd)
+    k = [k for k in sd if k.startswith("moment1")][0]
+    np.testing.assert_allclose(
+        opt2._accumulators["moment1"][id(m2.parameters()[0])].numpy(),
+        sd[k].numpy(),
+    )
+
+
+def test_grad_scaler_fp16():
+    m = nn.Linear(4, 4)
+    opt = paddle.optimizer.SGD(0.1, parameters=m.parameters())
+    scaler = paddle.amp.GradScaler(init_loss_scaling=1024.0)
+    x = paddle.randn([2, 4])
+    loss = m(x).sum()
+    scaled = scaler.scale(loss)
+    assert abs(float(scaled) - float(loss) * 1024.0) < 1e-2 * abs(float(loss) * 1024)
+    scaled.backward()
+    scaler.step(opt)
+    opt.clear_grad()
+    # inf grads must skip the update
+    w_before = m.weight.numpy().copy()
+    loss = m(x).sum()
+    scaler.scale(loss).backward()
+    m.weight.grad._replace_value(m.weight.grad._value * np.inf)
+    scaler.step(opt)
+    np.testing.assert_allclose(m.weight.numpy(), w_before)
+
+
+def test_set_state_dict_before_first_step():
+    # checkpoint-resume trap: load optimizer state BEFORE accumulators exist
+    m = nn.Linear(2, 2)
+    opt = paddle.optimizer.Adam(0.01, parameters=m.parameters())
+    for _ in range(3):
+        m(paddle.ones([1, 2])).sum().backward()
+        opt.step(); opt.clear_grad()
+    sd = opt.state_dict()
+    m2 = nn.Linear(2, 2)
+    m2.set_state_dict(m.state_dict())
+    opt2 = paddle.optimizer.Adam(0.01, parameters=m2.parameters())
+    opt2.set_state_dict(sd)  # accumulators don't exist yet
+    # one more step on both must produce identical params
+    for o, mm in ((opt, m), (opt2, m2)):
+        mm(paddle.ones([1, 2])).sum().backward()
+        o.step(); o.clear_grad()
+    np.testing.assert_allclose(m.weight.numpy(), m2.weight.numpy(), rtol=1e-6)
+
+
+def test_grad_scaler_skips_stateful_update_on_inf():
+    # Adam must not advance moments/step on an overflow step
+    p = nn.Parameter(np.ones((2,), np.float32))
+    opt = paddle.optimizer.Adam(0.1, parameters=[p])
+    scaler = paddle.amp.GradScaler(init_loss_scaling=8.0)
+    (p * 2).sum().backward()
+    scaler.step(opt); opt.clear_grad()
+    w1 = p.numpy().copy()
+    m1 = opt._accumulators["moment1"][id(p)].numpy().copy()
+    # overflow step
+    (p * 2).sum().backward()
+    p.grad._replace_value(p.grad._value * np.inf)
+    scaler.step(opt); opt.clear_grad()
+    np.testing.assert_allclose(p.numpy(), w1)
+    np.testing.assert_allclose(opt._accumulators["moment1"][id(p)].numpy(), m1)
+    assert float(opt._step_count) == 1
+
+
+def test_explicit_unscale_then_step_not_double():
+    p = nn.Parameter(np.ones((2,), np.float32))
+    opt = paddle.optimizer.SGD(0.1, parameters=[p])
+    scaler = paddle.amp.GradScaler(init_loss_scaling=16.0)
+    scaler.scale((p * 1.0).sum()).backward()
+    scaler.unscale_(opt)
+    g = p.grad.numpy().copy()
+    scaler.step(opt)  # must NOT unscale again
+    np.testing.assert_allclose(g, [1.0, 1.0], rtol=1e-6)
+    np.testing.assert_allclose(p.numpy(), [0.9, 0.9], rtol=1e-5)
