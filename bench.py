@@ -1,0 +1,116 @@
+"""Benchmark: ERNIE-3.0-base MLM pretrain throughput on one TPU chip.
+
+The BASELINE.json headline metric is "ERNIE-3.0 tokens/sec/chip" (the
+reference publishes no number — BASELINE.md records published: {} — so
+vs_baseline reports measured MFU as the comparable hardware-efficiency
+figure; see BASELINE.md).
+
+Run: python bench.py            -> one JSON line on stdout
+Env: BENCH_STEPS / BENCH_BATCH / BENCH_SEQ to override.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def main():
+    import numpy as np
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.models import ErnieForMaskedLM, ErnieModel
+
+    steps = int(os.environ.get("BENCH_STEPS", 20))
+    batch = int(os.environ.get("BENCH_BATCH", 32))
+    seq = int(os.environ.get("BENCH_SEQ", 128))
+
+    paddle.seed(0)
+    model = ErnieForMaskedLM(
+        ErnieModel(
+            vocab_size=40000, hidden_size=768, num_hidden_layers=12,
+            num_attention_heads=12, intermediate_size=3072,
+            hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        )
+    )
+    opt = paddle.optimizer.AdamW(1e-4, parameters=model.parameters(), weight_decay=0.01)
+
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, 40000, (batch, seq)).astype(np.int64))
+    labels = paddle.to_tensor(rng.randint(0, 40000, (batch, seq)).astype(np.int64))
+
+    @paddle.jit.to_static
+    def train_step(ids, labels):
+        with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+            loss, _ = model(ids, labels=labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    # warmup: recording run + compile + 1 steady step
+    for _ in range(3):
+        loss = train_step(ids, labels)
+    jax.block_until_ready(loss._value)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = train_step(ids, labels)
+    jax.block_until_ready(loss._value)
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = steps * batch * seq / dt
+
+    # MFU: 6 * matmul-params per token (fwd+bwd). Word embeddings are a
+    # lookup on input BUT also the tied MLM decoder matmul, so they count
+    # once; position/token-type embeddings are pure lookups and don't.
+    n_params = sum(p.size for p in model.parameters())
+    pos = model.ernie.embeddings.position_embeddings.weight.size
+    tok = model.ernie.embeddings.token_type_embeddings.weight.size
+    flops_per_token = 6 * (n_params - pos - tok)
+    achieved = tokens_per_sec * flops_per_token
+    peak = _peak_flops()
+    mfu = achieved / peak if peak else 0.0
+
+    print(
+        json.dumps(
+            {
+                "metric": "ernie3.0-base tokens/sec/chip",
+                "value": round(tokens_per_sec, 1),
+                "unit": "tokens/s",
+                "vs_baseline": round(mfu, 4),
+                "detail": {
+                    "steps": steps,
+                    "batch": batch,
+                    "seq": seq,
+                    "ms_per_step": round(dt / steps * 1000, 2),
+                    "final_loss": float(loss.numpy()),
+                    "mfu_note": "vs_baseline = measured MFU (bf16 peak); reference publishes no number",
+                },
+            }
+        )
+    )
+
+
+def _peak_flops():
+    import jax
+
+    d = jax.devices()[0]
+    kind = getattr(d, "device_kind", "") or ""
+    # bf16 peak per chip
+    table = {
+        "TPU v5 lite": 394e12,  # v5e: 394 TFLOPs bf16
+        "TPU v5": 459e12,       # v5p
+        "TPU v4": 275e12,
+    }
+    for k, v in table.items():
+        if kind.startswith(k):
+            return v
+    return 0.0  # unknown hardware: report MFU 0 rather than a made-up ratio
+
+
+if __name__ == "__main__":
+    main()

@@ -325,6 +325,54 @@ def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
     return decorate
 
 
+def functional_call(layer, params: dict, *args, training=None, **kwargs):
+    """Run layer.forward with parameter/buffer VALUES substituted from
+    `params` (name -> raw array or Tensor). The functional bridge for
+    jax.jit/grad/pjit over framework Layers (the role of the reference's
+    run_program_op parameter feeding, dy2static/partial_program.py).
+
+    Values may be jax tracers — this is how entry()/dryrun paths stage
+    framework models into pure XLA programs.
+    """
+    sd = layer.state_dict()
+    unknown = set(params) - set(sd)
+    if unknown:
+        raise KeyError(
+            f"functional_call: params keys not in {type(layer).__name__}.state_dict(): "
+            f"{sorted(unknown)[:5]}{'...' if len(unknown) > 5 else ''} — a typo here "
+            "would silently bake the layer's stored weight in as a constant"
+        )
+    originals = {}
+    try:
+        for name, t in sd.items():
+            if name in params:
+                v = params[name]
+                originals[name] = (t, t._value, t._grad_node, t._out_index)
+                t._value = v._value if isinstance(v, Tensor) else v
+                t._grad_node = None
+        prev_training = None
+        if training is not None:
+            prev_training = [l.training for l in layer.sublayers(include_self=True)]
+            for l in layer.sublayers(include_self=True):
+                l.training = training
+        try:
+            return layer(*args, **kwargs)
+        finally:
+            if prev_training is not None:
+                for l, tr in zip(layer.sublayers(include_self=True), prev_training):
+                    l.training = tr
+    finally:
+        for name, (t, v, n, oi) in originals.items():
+            t._value = v
+            t._grad_node = n
+            t._out_index = oi
+
+
+def state_values(layer) -> dict:
+    """name -> raw jax array for every param/buffer (functional_call input)."""
+    return {k: v._value for k, v in layer.state_dict().items()}
+
+
 def not_to_static(fn):
     fn._paddle_not_to_static = True
     return fn

@@ -1,0 +1,10 @@
+"""Model zoo beyond vision (flagship NLP models)."""
+from .ernie import (  # noqa: F401
+    ErnieForMaskedLM,
+    ErnieForSequenceClassification,
+    ErnieModel,
+    ernie_3_0_base,
+    ernie_3_0_medium,
+    ernie_tiny,
+)
+from .llama import LlamaForCausalLM, LlamaModel, llama_tiny  # noqa: F401

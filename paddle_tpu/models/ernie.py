@@ -1,0 +1,160 @@
+"""ERNIE-3.0 / BERT-style transformer encoder (the flagship model).
+
+Reference parity: the PaddleNLP ErnieModel/BertModel architecture the
+reference's BASELINE configs train (transformer encoder with learned
+positional + token-type embeddings, post-LN, MLM + pooler heads). Built on
+paddle_tpu.nn.TransformerEncoder, whose attention runs the Pallas flash
+kernel on TPU.
+
+ERNIE-3.0-base config: 12 layers, hidden 768, 12 heads, ffn 3072 — the
+BASELINE.json `ERNIE-3.0 tokens/sec/chip` workload.
+"""
+from __future__ import annotations
+
+from .. import nn
+from ..ops import creation, manipulation as manip
+from ..nn import functional as F
+
+
+class ErnieEmbeddings(nn.Layer):
+    def __init__(self, vocab_size, hidden_size, max_position_embeddings=512, type_vocab_size=4, pad_token_id=0, hidden_dropout_prob=0.1, weight_attr=None):
+        super().__init__()
+        self.word_embeddings = nn.Embedding(vocab_size, hidden_size, padding_idx=pad_token_id, weight_attr=weight_attr)
+        self.position_embeddings = nn.Embedding(max_position_embeddings, hidden_size, weight_attr=weight_attr)
+        self.token_type_embeddings = nn.Embedding(type_vocab_size, hidden_size, weight_attr=weight_attr)
+        self.layer_norm = nn.LayerNorm(hidden_size)
+        self.dropout = nn.Dropout(hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        seq_len = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = creation.arange(seq_len, dtype="int64")
+            position_ids = manip.unsqueeze(position_ids, 0)
+        if token_type_ids is None:
+            token_type_ids = creation.zeros_like(input_ids)
+        emb = (
+            self.word_embeddings(input_ids)
+            + self.position_embeddings(position_ids)
+            + self.token_type_embeddings(token_type_ids)
+        )
+        return self.dropout(self.layer_norm(emb))
+
+
+class ErniePooler(nn.Layer):
+    def __init__(self, hidden_size, weight_attr=None):
+        super().__init__()
+        self.dense = nn.Linear(hidden_size, hidden_size, weight_attr=weight_attr)
+        self.activation = nn.Tanh()
+
+    def forward(self, hidden_states):
+        return self.activation(self.dense(hidden_states[:, 0]))
+
+
+class ErnieModel(nn.Layer):
+    def __init__(
+        self,
+        vocab_size=40000,
+        hidden_size=768,
+        num_hidden_layers=12,
+        num_attention_heads=12,
+        intermediate_size=3072,
+        hidden_act="gelu",
+        hidden_dropout_prob=0.1,
+        attention_probs_dropout_prob=0.1,
+        max_position_embeddings=512,
+        type_vocab_size=4,
+        initializer_range=0.02,
+        pad_token_id=0,
+    ):
+        super().__init__()
+        self.pad_token_id = pad_token_id
+        # reference applies Normal(0, initializer_range) to EVERY Linear and
+        # Embedding weight (ErnieModel.init_weights)
+        init = nn.initializer.Normal(0.0, initializer_range)
+        attr = nn.ParamAttr(initializer=init)
+        self.embeddings = ErnieEmbeddings(
+            vocab_size, hidden_size, max_position_embeddings, type_vocab_size, pad_token_id, hidden_dropout_prob,
+            weight_attr=attr,
+        )
+        encoder_layer = nn.TransformerEncoderLayer(
+            hidden_size,
+            num_attention_heads,
+            intermediate_size,
+            dropout=hidden_dropout_prob,
+            activation=hidden_act,
+            attn_dropout=attention_probs_dropout_prob,
+            act_dropout=0.0,
+            weight_attr=attr,
+        )
+        self.encoder = nn.TransformerEncoder(encoder_layer, num_hidden_layers)
+        self.pooler = ErniePooler(hidden_size, weight_attr=attr)
+        self._init_attr = attr
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None, attention_mask=None):
+        if attention_mask is not None:
+            # [B, S] 1/0 -> additive [B, 1, 1, S]
+            am = manip.unsqueeze(attention_mask.astype("float32"), [1, 2])
+            attention_mask = (am - 1.0) * 1e4
+        emb = self.embeddings(input_ids, token_type_ids, position_ids)
+        encoded = self.encoder(emb, attention_mask)
+        pooled = self.pooler(encoded)
+        return encoded, pooled
+
+
+class ErnieForMaskedLM(nn.Layer):
+    """MLM head tied to word embeddings (pretraining objective)."""
+
+    def __init__(self, ernie: ErnieModel = None, **config):
+        super().__init__()
+        self.ernie = ernie or ErnieModel(**config)
+        hidden = self.ernie.pooler.dense.weight.shape[0]
+        self.transform = nn.Linear(hidden, hidden, weight_attr=getattr(self.ernie, "_init_attr", None))
+        self.layer_norm = nn.LayerNorm(hidden)
+        vocab = self.ernie.embeddings.word_embeddings.weight.shape[0]
+        self.decoder_bias = self.create_parameter([vocab], is_bias=True)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None, attention_mask=None, labels=None):
+        encoded, _ = self.ernie(input_ids, token_type_ids, position_ids, attention_mask)
+        h = self.layer_norm(F.gelu(self.transform(encoded)))
+        # tied decoder: h @ E^T
+        logits = F.linear(h, self.ernie.embeddings.word_embeddings.weight.T) + self.decoder_bias
+        if labels is not None:
+            loss = F.cross_entropy(
+                manip.reshape(logits, [-1, logits.shape[-1]]),
+                manip.reshape(labels, [-1]),
+                ignore_index=-100,
+            )
+            return loss, logits
+        return logits
+
+
+class ErnieForSequenceClassification(nn.Layer):
+    def __init__(self, ernie: ErnieModel = None, num_classes=2, dropout=0.1, **config):
+        super().__init__()
+        self.ernie = ernie or ErnieModel(**config)
+        hidden = self.ernie.pooler.dense.weight.shape[0]
+        self.dropout = nn.Dropout(dropout)
+        self.classifier = nn.Linear(hidden, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None, attention_mask=None):
+        _, pooled = self.ernie(input_ids, token_type_ids, position_ids, attention_mask)
+        return self.classifier(self.dropout(pooled))
+
+
+def ernie_3_0_base(**kw):
+    cfg = dict(vocab_size=40000, hidden_size=768, num_hidden_layers=12, num_attention_heads=12, intermediate_size=3072)
+    cfg.update(kw)
+    return ErnieModel(**cfg)
+
+
+def ernie_3_0_medium(**kw):
+    cfg = dict(vocab_size=40000, hidden_size=768, num_hidden_layers=6, num_attention_heads=12, intermediate_size=3072)
+    cfg.update(kw)
+    return ErnieModel(**cfg)
+
+
+def ernie_tiny(**kw):
+    """Small config for tests/dryrun."""
+    cfg = dict(vocab_size=1024, hidden_size=64, num_hidden_layers=2, num_attention_heads=4, intermediate_size=128, max_position_embeddings=128)
+    cfg.update(kw)
+    return ErnieModel(**cfg)

@@ -1,0 +1,142 @@
+"""Llama-style decoder-only LM (the hybrid-parallel pretrain workload).
+
+Reference parity: the architecture PaddleNLP's llama / ERNIE-4.5 pretrain
+configs train (BASELINE configs[4]): RMSNorm pre-norm, rotary embeddings,
+SwiGLU MLP, causal flash attention, optional GQA. Written so every weight
+carries a logical sharding axis name — the distributed layer shards these
+over the mesh (tp on heads/ffn, dp/fsdp on batch/params).
+"""
+from __future__ import annotations
+
+from jax import numpy as jnp
+
+from .. import nn
+from ..core.apply import apply
+from ..nn import functional as F
+from ..ops import creation, manipulation as manip
+
+
+def _rope(q, k, pos_base=10000.0):
+    """Rotary position embeddings applied to [B, S, H, D] q/k (raw jax)."""
+    b, s, h, d = q.shape
+    inv = 1.0 / (pos_base ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    t = jnp.arange(s, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv)  # [S, D/2]
+    cos = jnp.cos(freqs)[None, :, None, :]
+    sin = jnp.sin(freqs)[None, :, None, :]
+
+    def rot(x):
+        x1, x2 = x[..., 0::2], x[..., 1::2]
+        xr1 = x1 * cos - x2 * sin
+        xr2 = x2 * cos + x1 * sin
+        out = jnp.stack([xr1, xr2], axis=-1).reshape(x.shape)
+        return out.astype(x.dtype)
+
+    return rot(q), rot(k)
+
+
+class LlamaAttention(nn.Layer):
+    def __init__(self, hidden_size, num_heads, num_kv_heads=None):
+        super().__init__()
+        self.num_heads = num_heads
+        self.num_kv_heads = num_kv_heads or num_heads
+        self.head_dim = hidden_size // num_heads
+        self.q_proj = nn.Linear(hidden_size, num_heads * self.head_dim, bias_attr=False)
+        self.k_proj = nn.Linear(hidden_size, self.num_kv_heads * self.head_dim, bias_attr=False)
+        self.v_proj = nn.Linear(hidden_size, self.num_kv_heads * self.head_dim, bias_attr=False)
+        self.o_proj = nn.Linear(num_heads * self.head_dim, hidden_size, bias_attr=False)
+
+    def forward(self, x):
+        b, s = x.shape[0], x.shape[1]
+        q = manip.reshape(self.q_proj(x), [b, s, self.num_heads, self.head_dim])
+        k = manip.reshape(self.k_proj(x), [b, s, self.num_kv_heads, self.head_dim])
+        v = manip.reshape(self.v_proj(x), [b, s, self.num_kv_heads, self.head_dim])
+
+        qk = apply("rope", lambda qv, kv: _rope(qv, kv), q, k)
+        q, k = qk
+        if self.num_kv_heads != self.num_heads:
+            rep = self.num_heads // self.num_kv_heads
+            k = manip.repeat_interleave(k, rep, axis=2)
+            v = manip.repeat_interleave(v, rep, axis=2)
+        out = F.scaled_dot_product_attention(q, k, v, is_causal=True, training=self.training)
+        out = manip.reshape(out, [b, s, self.num_heads * self.head_dim])
+        return self.o_proj(out)
+
+
+class LlamaMLP(nn.Layer):
+    def __init__(self, hidden_size, intermediate_size):
+        super().__init__()
+        self.gate_proj = nn.Linear(hidden_size, intermediate_size, bias_attr=False)
+        self.up_proj = nn.Linear(hidden_size, intermediate_size, bias_attr=False)
+        self.down_proj = nn.Linear(intermediate_size, hidden_size, bias_attr=False)
+
+    def forward(self, x):
+        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+
+
+class LlamaDecoderLayer(nn.Layer):
+    def __init__(self, hidden_size, num_heads, intermediate_size, num_kv_heads=None, rms_eps=1e-6):
+        super().__init__()
+        self.input_layernorm = nn.RMSNorm(hidden_size, rms_eps)
+        self.self_attn = LlamaAttention(hidden_size, num_heads, num_kv_heads)
+        self.post_attention_layernorm = nn.RMSNorm(hidden_size, rms_eps)
+        self.mlp = LlamaMLP(hidden_size, intermediate_size)
+
+    def forward(self, x):
+        x = x + self.self_attn(self.input_layernorm(x))
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        return x
+
+
+class LlamaModel(nn.Layer):
+    def __init__(
+        self,
+        vocab_size=32000,
+        hidden_size=512,
+        num_hidden_layers=4,
+        num_attention_heads=8,
+        num_key_value_heads=None,
+        intermediate_size=1376,
+        rms_norm_eps=1e-6,
+    ):
+        super().__init__()
+        self.embed_tokens = nn.Embedding(vocab_size, hidden_size)
+        self.layers = nn.LayerList(
+            [
+                LlamaDecoderLayer(hidden_size, num_attention_heads, intermediate_size, num_key_value_heads, rms_norm_eps)
+                for _ in range(num_hidden_layers)
+            ]
+        )
+        self.norm = nn.RMSNorm(hidden_size, rms_norm_eps)
+
+    def forward(self, input_ids):
+        x = self.embed_tokens(input_ids)
+        for layer in self.layers:
+            x = layer(x)
+        return self.norm(x)
+
+
+class LlamaForCausalLM(nn.Layer):
+    def __init__(self, **config):
+        super().__init__()
+        self.llama = LlamaModel(**config)
+        hidden = self.llama.norm.weight.shape[0]
+        vocab = self.llama.embed_tokens.weight.shape[0]
+        self.lm_head = nn.Linear(hidden, vocab, bias_attr=False)
+
+    def forward(self, input_ids, labels=None):
+        h = self.llama(input_ids)
+        logits = self.lm_head(h)
+        if labels is not None:
+            loss = F.cross_entropy(
+                manip.reshape(logits[:, :-1], [-1, logits.shape[-1]]),
+                manip.reshape(labels[:, 1:], [-1]),
+            )
+            return loss, logits
+        return logits
+
+
+def llama_tiny(**kw):
+    cfg = dict(vocab_size=1024, hidden_size=64, num_hidden_layers=2, num_attention_heads=4, intermediate_size=176)
+    cfg.update(kw)
+    return LlamaForCausalLM(**cfg)

@@ -335,3 +335,35 @@ def test_regularizer_precedence():
     (p * 0.0).sum().backward()
     opt.step()
     np.testing.assert_allclose(p.numpy(), [0.9, 0.9], rtol=1e-6)
+
+
+def test_model_zoo_forward():
+    from paddle_tpu.vision.models import LeNet, resnet18
+    from paddle_tpu.models import ernie_tiny, llama_tiny
+
+    assert LeNet()(paddle.randn([2, 1, 28, 28])).shape == [2, 10]
+    assert resnet18(num_classes=7)(paddle.randn([2, 3, 32, 32])).shape == [2, 7]
+    enc, pooled = ernie_tiny()(paddle.randint(0, 1024, [2, 16]))
+    assert enc.shape == [2, 16, 64] and pooled.shape == [2, 64]
+    loss, _ = llama_tiny()(paddle.randint(0, 1024, [2, 16]), labels=paddle.randint(0, 1024, [2, 16]))
+    loss.backward()
+    assert float(loss) > 0
+
+
+def test_functional_call_pure():
+    import jax
+    from paddle_tpu.jit.api import functional_call, state_values
+
+    m = nn.Linear(4, 2)
+    params = state_values(m)
+
+    def f(p, x):
+        return functional_call(m, p, paddle.Tensor(x))._value
+
+    x = np.ones((3, 4), np.float32)
+    out = jax.jit(f)(params, x)
+    np.testing.assert_allclose(np.asarray(out), m(paddle.to_tensor(x)).numpy(), rtol=1e-6)
+    # grads through functional_call
+    g = jax.grad(lambda p, x: f(p, x).sum())(params, x)
+    assert set(g) == {"weight", "bias"}
+    np.testing.assert_allclose(np.asarray(g["bias"]), [3.0, 3.0])
