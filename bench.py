@@ -21,7 +21,6 @@ def main():
     import jax
 
     import paddle_tpu as paddle
-    from paddle_tpu import nn
     from paddle_tpu.models import ErnieForMaskedLM, ErnieModel
 
     steps = int(os.environ.get("BENCH_STEPS", 20))

@@ -1,0 +1,50 @@
+"""paddle_tpu.distributed — distributed training over jax device meshes.
+
+Reference parity: python/paddle/distributed/ (136 kLoC; SURVEY.md §2.3).
+TPU-native design: every parallelism strategy is expressed as shardings over
+a jax.sharding.Mesh compiled by GSPMD — collectives ride ICI/DCN as XLA HLO
+ops, not NCCL calls. The eager collective API (collective.py) operates on
+rank-stacked global arrays; the auto-parallel API (auto_parallel/) maps
+ProcessMesh/placements onto NamedSharding; fleet (fleet/) builds hybrid
+dp/tp/pp/sharding/sp/ep topologies as multi-axis meshes.
+"""
+from __future__ import annotations
+
+from .parallel_env import (  # noqa: F401
+    ParallelEnv,
+    get_backend,
+    get_rank,
+    get_world_size,
+    init_parallel_env,
+    is_available,
+    is_initialized,
+)
+from .collective import (  # noqa: F401
+    Group,
+    P2POp,
+    ReduceOp,
+    all_gather,
+    all_gather_object,
+    all_reduce,
+    all_to_all,
+    all_to_all_single,
+    alltoall,
+    barrier,
+    batch_isend_irecv,
+    broadcast,
+    broadcast_object_list,
+    destroy_process_group,
+    get_group,
+    irecv,
+    isend,
+    new_group,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    scatter_object_list,
+    send,
+    stream,
+    wait,
+)
+from .parallel import DataParallel, spawn  # noqa: F401

@@ -1,0 +1,137 @@
+"""World topology state for paddle_tpu.distributed.
+
+Reference parity: python/paddle/distributed/parallel.py (ParallelEnv,
+init_parallel_env:943) + the TCPStore rendezvous
+(paddle/phi/core/distributed/store/tcp_store.h). TPU-native design: the
+single-controller SPMD world IS the device list jax sees; multi-host
+bootstrap is jax.distributed.initialize (JAX's coordination service plays
+TCPStore's role — rank-0 coordinator address, barriers, KV exchange), after
+which every host addresses the same global mesh. There is no per-rank
+process group wiring to do: collectives are XLA ops over the mesh.
+
+Env contract kept from the reference launcher: PADDLE_TRAINER_ID (process
+rank), PADDLE_TRAINERS_NUM / PADDLE_WORLD_SIZE (process count),
+PADDLE_MASTER / MASTER_ADDR:MASTER_PORT (coordinator).
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import jax
+
+_initialized = False
+
+
+def _coordinator_from_env() -> Optional[str]:
+    master = os.environ.get("PADDLE_MASTER")
+    if master:
+        return master
+    addr = os.environ.get("MASTER_ADDR")
+    port = os.environ.get("MASTER_PORT")
+    if addr and port:
+        return f"{addr}:{port}"
+    eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS")
+    if eps:
+        return eps.split(",")[0]
+    return None
+
+
+def init_parallel_env():
+    """Initialize the distributed environment.
+
+    Single process: nothing to rendezvous — the world is jax.devices().
+    Multi process (launcher-set env): jax.distributed.initialize() connects
+    this host to the coordinator; afterwards jax.devices() spans all hosts.
+    """
+    global _initialized
+    if _initialized:
+        return ParallelEnv()
+    nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", os.environ.get("PADDLE_WORLD_SIZE", "1")))
+    if nprocs > 1 and jax.process_count() == 1:
+        coordinator = _coordinator_from_env()
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", os.environ.get("PADDLE_RANK", "0")))
+        jax.distributed.initialize(
+            coordinator_address=coordinator, num_processes=nprocs, process_id=rank
+        )
+    _initialized = True
+    # materialize the default (world) communication group
+    from . import collective
+
+    collective._ensure_world_group()
+    return ParallelEnv()
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def is_available() -> bool:
+    return True
+
+
+def get_rank(group=None) -> int:
+    """Rank of this *process* in the group (paddle semantics: one rank per
+    process). Single-controller: the controller is process 0 of N hosts."""
+    if group is not None:
+        from . import collective
+
+        return group.get_group_rank(jax.process_index())
+    return jax.process_index()
+
+
+def get_world_size(group=None) -> int:
+    """Number of participating ranks. In the single-controller SPMD model the
+    parallel width is the DEVICE count (each device is a "rank" of the mesh);
+    paddle's process-centric world_size maps onto it 1:1 when the launcher
+    starts one process per device, which is the reference deployment."""
+    if group is not None:
+        return group.nranks
+    return jax.device_count()
+
+
+def world_devices() -> List:
+    return list(jax.devices())
+
+
+class ParallelEnv:
+    """Reference parity: python/paddle/distributed/parallel.py ParallelEnv."""
+
+    @property
+    def rank(self) -> int:
+        return get_rank()
+
+    @property
+    def world_size(self) -> int:
+        return get_world_size()
+
+    @property
+    def local_rank(self) -> int:
+        return int(os.environ.get("PADDLE_RANK_IN_NODE", "0"))
+
+    @property
+    def dev_id(self) -> int:
+        return jax.local_devices()[0].id
+
+    @property
+    def device_type(self) -> str:
+        return jax.local_devices()[0].platform
+
+    @property
+    def nranks(self) -> int:
+        return get_world_size()
+
+    @property
+    def trainer_endpoints(self) -> List[str]:
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        return eps.split(",") if eps else []
+
+    @property
+    def current_endpoint(self) -> str:
+        eps = self.trainer_endpoints
+        r = self.rank
+        return eps[r] if r < len(eps) else ""
+
+
+def get_backend(group=None) -> str:
+    return "xla"

@@ -134,7 +134,9 @@ class ErnieForSequenceClassification(nn.Layer):
         self.ernie = ernie or ErnieModel(**config)
         hidden = self.ernie.pooler.dense.weight.shape[0]
         self.dropout = nn.Dropout(dropout)
-        self.classifier = nn.Linear(hidden, num_classes)
+        self.classifier = nn.Linear(
+            hidden, num_classes, weight_attr=getattr(self.ernie, "_init_attr", None)
+        )
 
     def forward(self, input_ids, token_type_ids=None, position_ids=None, attention_mask=None):
         _, pooled = self.ernie(input_ids, token_type_ids, position_ids, attention_mask)

@@ -1,5 +1,6 @@
 """LeNet (python/paddle/vision/models/lenet.py)."""
 from ... import nn
+from ...ops.manipulation import flatten
 
 
 class LeNet(nn.Layer):
@@ -22,7 +23,6 @@ class LeNet(nn.Layer):
     def forward(self, inputs):
         x = self.features(inputs)
         if self.num_classes > 0:
-            from ...ops.manipulation import flatten
 
             x = flatten(x, 1)
             x = self.fc(x)

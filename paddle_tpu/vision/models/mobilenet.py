@@ -1,5 +1,6 @@
 """MobileNet V1/V2 (python/paddle/vision/models/mobilenetv1.py, mobilenetv2.py)."""
 from ... import nn
+from ...ops.manipulation import flatten
 
 
 def _make_divisible(v, divisor=8, min_value=None):
@@ -63,7 +64,6 @@ class MobileNetV1(nn.Layer):
         if self.with_pool:
             x = self.pool(x)
         if self.num_classes > 0:
-            from ...ops.manipulation import flatten
 
             x = self.fc(flatten(x, 1))
         return x
@@ -119,7 +119,6 @@ class MobileNetV2(nn.Layer):
         if self.with_pool:
             x = self.pool(x)
         if self.num_classes > 0:
-            from ...ops.manipulation import flatten
 
             x = self.classifier(flatten(x, 1))
         return x

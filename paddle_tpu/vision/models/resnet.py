@@ -7,6 +7,7 @@ initialize fresh (no download in this environment).
 from __future__ import annotations
 
 from ... import nn
+from ...ops.manipulation import flatten
 
 
 class BasicBlock(nn.Layer):
@@ -93,7 +94,7 @@ class ResNet(nn.Layer):
         if num_classes > 0:
             self.fc = nn.Linear(512 * block.expansion, num_classes)
 
-    def _make_layer(self, block, planes, blocks, stride=1, dilate=False):
+    def _make_layer(self, block, planes, blocks, stride=1):
         norm_layer = self._norm_layer
         downsample = None
         if stride != 1 or self.inplanes != planes * block.expansion:
@@ -117,7 +118,6 @@ class ResNet(nn.Layer):
         if self.with_pool:
             x = self.avgpool(x)
         if self.num_classes > 0:
-            from ...ops.manipulation import flatten
 
             x = flatten(x, 1)
             x = self.fc(x)

@@ -1,5 +1,6 @@
 """VGG (python/paddle/vision/models/vgg.py)."""
 from ... import nn
+from ...ops.manipulation import flatten
 
 cfgs = {
     "A": [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
@@ -49,7 +50,6 @@ class VGG(nn.Layer):
         if self.with_pool:
             x = self.avgpool(x)
         if self.num_classes > 0:
-            from ...ops.manipulation import flatten
 
             x = flatten(x, 1)
             x = self.classifier(x)

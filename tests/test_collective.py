@@ -1,0 +1,176 @@
+"""Collective API tests on the 8-device CPU mesh.
+
+Reference parity: test/collective/collective_*_api.py — there each script runs
+under the multi-process launcher; here ranks are mesh shards (stacked axis 0)
+and numerics are checked against the same numpy ground truth.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+
+N = 8
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _init():
+    dist.init_parallel_env()
+
+
+def _stacked(shape=(N, 4), seed=0, dtype=np.float32):
+    return np.random.RandomState(seed).randn(*shape).astype(dtype)
+
+
+def test_env():
+    assert dist.get_world_size() == N
+    assert dist.get_rank() == 0
+    assert dist.is_initialized()
+    env = dist.ParallelEnv()
+    assert env.world_size == N
+
+
+def test_all_reduce_sum():
+    x = _stacked()
+    t = paddle.to_tensor(x)
+    dist.all_reduce(t)
+    expect = np.broadcast_to(x.sum(0, keepdims=True), x.shape)
+    np.testing.assert_allclose(t.numpy(), expect, rtol=1e-5)
+
+
+def test_all_reduce_ops():
+    x = _stacked(seed=1)
+    for op, ref in [
+        (dist.ReduceOp.MAX, x.max(0)),
+        (dist.ReduceOp.MIN, x.min(0)),
+        (dist.ReduceOp.AVG, x.mean(0)),
+        (dist.ReduceOp.PROD, x.prod(0)),
+    ]:
+        t = paddle.to_tensor(x)
+        dist.all_reduce(t, op=op)
+        np.testing.assert_allclose(t.numpy()[3], ref, rtol=1e-5)
+
+
+def test_all_gather():
+    x = _stacked(seed=2)
+    out = []
+    dist.all_gather(out, paddle.to_tensor(x))
+    assert len(out) == N
+    for i in range(N):
+        np.testing.assert_allclose(out[i].numpy(), x[i], rtol=1e-6)
+
+
+def test_broadcast():
+    x = _stacked(seed=3)
+    t = paddle.to_tensor(x)
+    dist.broadcast(t, src=2)
+    np.testing.assert_allclose(t.numpy(), np.broadcast_to(x[2:3], x.shape), rtol=1e-6)
+
+
+def test_reduce():
+    x = _stacked(seed=4)
+    t = paddle.to_tensor(x)
+    dist.reduce(t, dst=1)
+    np.testing.assert_allclose(t.numpy()[1], x.sum(0), rtol=1e-5)
+    np.testing.assert_allclose(t.numpy()[0], x[0], rtol=1e-6)
+
+
+def test_reduce_scatter():
+    # list form: chunk r's per-rank values
+    chunks = [_stacked(seed=10 + r) for r in range(N)]
+    out = paddle.zeros([N, 4])
+    dist.reduce_scatter(out, [paddle.to_tensor(c) for c in chunks])
+    for r in range(N):
+        np.testing.assert_allclose(out.numpy()[r], chunks[r].sum(0), rtol=1e-5)
+
+
+def test_scatter():
+    parts = [np.full((3,), float(r), np.float32) for r in range(N)]
+    t = paddle.zeros([N, 3])
+    dist.scatter(t, [paddle.to_tensor(p) for p in parts], src=0)
+    for r in range(N):
+        np.testing.assert_allclose(t.numpy()[r], parts[r])
+
+
+def test_all_to_all():
+    # rank i sends chunk c_{i->j}; rank r receives c_{s->r} from s
+    rng = np.random.RandomState(7)
+    x = rng.randn(N, N, 2).astype(np.float32)  # x[i, j] = c_{i->j}
+    in_list = [paddle.to_tensor(x[:, j]) for j in range(N)]  # stacked elem j
+    out = []
+    dist.all_to_all(out, in_list)
+    assert len(out) == N
+    for s in range(N):
+        for r in range(N):
+            np.testing.assert_allclose(out[s].numpy()[r], x[s, r], rtol=1e-6)
+
+
+def test_all_to_all_single():
+    rng = np.random.RandomState(8)
+    x = rng.randn(N, N * 3).astype(np.float32)
+    out = paddle.zeros([N, N * 3])
+    dist.all_to_all_single(out, paddle.to_tensor(x))
+    x4 = x.reshape(N, N, 3)
+    y = np.swapaxes(x4, 0, 1).reshape(N, N * 3)
+    np.testing.assert_allclose(out.numpy(), y, rtol=1e-6)
+
+
+def test_barrier_and_wait():
+    dist.barrier()
+    t = paddle.to_tensor(_stacked())
+    dist.wait(t)
+
+
+def test_new_group():
+    g = dist.new_group([0, 1, 2, 3])
+    assert g.nranks == 4
+    assert dist.get_world_size(g) == 4
+    x = _stacked(shape=(4, 5), seed=9)
+    t = paddle.to_tensor(x)
+    dist.all_reduce(t, group=g)
+    np.testing.assert_allclose(t.numpy(), np.broadcast_to(x.sum(0, keepdims=True), x.shape), rtol=1e-5)
+    assert dist.get_group(g.id) is g
+
+
+def test_batch_isend_irecv():
+    x = _stacked(shape=(N, 4), seed=11)
+    send_t = paddle.to_tensor(x)
+    recv_t = paddle.zeros([N, 4])
+    ops = [
+        dist.P2POp(dist.isend, send_t, peer=1),
+        dist.P2POp(dist.irecv, recv_t, peer=N - 1),
+    ]
+    tasks = dist.batch_isend_irecv(ops)
+    for task in tasks:
+        task.wait()
+    # shift-by-1 ring: rank r receives rank (r-1)'s tensor
+    np.testing.assert_allclose(recv_t.numpy(), np.roll(x, 1, axis=0), rtol=1e-6)
+
+
+def test_send_recv_guidance():
+    with pytest.raises(RuntimeError):
+        dist.send(paddle.ones([2]), dst=1)
+
+
+def test_data_parallel_grads_match_single():
+    """DP-wrapped model grads == single-device grads on the full batch
+    (the EagerReducer allreduce equivalence, test_dist_base.py analog)."""
+    from paddle_tpu import nn
+
+    paddle.seed(0)
+    model = nn.Linear(6, 3)
+    dp = dist.DataParallel(model)
+
+    xs = np.random.RandomState(0).randn(16, 6).astype(np.float32)
+    ys = np.random.RandomState(1).randn(16, 3).astype(np.float32)
+
+    out = dp(paddle.to_tensor(xs))
+    loss = ((out - paddle.to_tensor(ys)) ** 2).mean()
+    loss.backward()
+    g_dp = model.weight.grad.numpy().copy()
+
+    model.clear_gradients()
+    out2 = model(paddle.to_tensor(xs))
+    loss2 = ((out2 - paddle.to_tensor(ys)) ** 2).mean()
+    loss2.backward()
+    np.testing.assert_allclose(g_dp, model.weight.grad.numpy(), rtol=1e-5, atol=1e-6)
