@@ -31,6 +31,7 @@ class Tensor:
         "name",
         "persistable",
         "_backward_hooks",
+        "_dist_attr",
         "__weakref__",
     )
 
@@ -47,6 +48,7 @@ class Tensor:
         self.name = name
         self.persistable = False
         self._backward_hooks = []
+        self._dist_attr = None  # (ProcessMesh, placements) for DistTensor
         state.record_create(self)
 
     # ---- raw value access (trace-recorded) ----

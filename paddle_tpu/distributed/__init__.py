@@ -48,3 +48,20 @@ from .collective import (  # noqa: F401
     wait,
 )
 from .parallel import DataParallel, spawn  # noqa: F401
+from .auto_parallel import (  # noqa: F401
+    Partial,
+    Placement,
+    ProcessMesh,
+    Replicate,
+    Shard,
+    ShardDataloader,
+    dtensor_from_fn,
+    get_mesh,
+    reshard,
+    set_mesh,
+    shard_dataloader,
+    shard_layer,
+    shard_optimizer,
+    shard_tensor,
+    unshard_dtensor,
+)

@@ -1,0 +1,13 @@
+"""Auto-parallel: ProcessMesh + placements + DistTensor API (SURVEY §2.3)."""
+from .process_mesh import ProcessMesh, get_mesh, set_mesh  # noqa: F401
+from .placement import Partial, Placement, Replicate, Shard  # noqa: F401
+from .api import (  # noqa: F401
+    ShardDataloader,
+    dtensor_from_fn,
+    reshard,
+    shard_dataloader,
+    shard_layer,
+    shard_optimizer,
+    shard_tensor,
+    unshard_dtensor,
+)

@@ -1,0 +1,226 @@
+"""Auto-parallel (DistTensor) API.
+
+Reference parity: python/paddle/distributed/auto_parallel/api.py
+(shard_tensor:126, dtensor_from_fn:310, reshard:344, shard_layer:441,
+shard_optimizer, to_static:2087) over the C++ DistTensor substrate
+(paddle/phi/core/distributed/auto_parallel/dist_tensor.h:39) + SPMD rules
+(paddle/phi/infermeta/spmd_rules/) + the reshard function library
+(paddle/phi/core/distributed/auto_parallel/reshard/).
+
+TPU-native design: a DistTensor is a regular paddle_tpu.Tensor whose
+jax.Array carries a NamedSharding over the ProcessMesh's jax mesh, plus
+(mesh, placements) metadata. The reference's completion pass (propagate dist
+attrs via per-op SPMD rules, completion.py) and partitioner/reshard
+injection collapse into GSPMD: ops on sharded arrays propagate sharding
+inside XLA, and `reshard` is a device_put / with_sharding_constraint.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import numpy as np
+
+from ...core.apply import apply
+from ...core.tensor import Tensor
+from ...nn.layer import Layer
+from .placement import (
+    Partial,
+    Placement,
+    Replicate,
+    Shard,
+    dist_sharding,
+    normalize_placements,
+    placements_to_spec,
+)
+from .process_mesh import ProcessMesh, get_mesh, set_mesh  # noqa: F401
+
+
+# ---- Tensor dist surface (patched onto Tensor) ----
+
+
+def _t_placements(self):
+    return self._dist_attr[1] if self._dist_attr else None
+
+
+def _t_process_mesh(self):
+    return self._dist_attr[0] if self._dist_attr else None
+
+
+def _t_is_dist(self):
+    return self._dist_attr is not None
+
+
+Tensor.placements = property(_t_placements)
+Tensor.process_mesh = property(_t_process_mesh)
+Tensor.is_dist = _t_is_dist
+
+
+def _resharded(t: Tensor, mesh: ProcessMesh, placements) -> Tensor:
+    """Differentiable relayout: routed through apply() so the tape records a
+    grad node (the cotangent flows back through device_put/constraint — the
+    transpose of a resharding is a resharding)."""
+    sh = dist_sharding(mesh, placements, t._raw().ndim)
+
+    def relayout(x):
+        if isinstance(x, jax.core.Tracer):
+            return jax.lax.with_sharding_constraint(x, sh)
+        return jax.device_put(x, sh)
+
+    return apply("reshard", relayout, t)
+
+
+def shard_tensor(data, mesh: ProcessMesh, placements, dtype=None, place=None, stop_gradient=None):
+    """Create a DistTensor from `data` with the given mesh/placements.
+    `place` is accepted for API compat (XLA owns placement)."""
+    t = data if isinstance(data, Tensor) else Tensor(np.asarray(data))
+    if dtype is not None:
+        t = t.astype(dtype)
+    placements = normalize_placements(placements, mesh.ndim)
+    out = _resharded(t, mesh, placements)
+    if stop_gradient is not None:
+        out.stop_gradient = stop_gradient
+    out._dist_attr = (mesh, placements)
+    out.name = t.name
+    return out
+
+
+def dtensor_from_fn(fn: Callable, mesh: ProcessMesh, placements, *args, **kwargs):
+    """Reference parity: api.py:310 — build locally then shard (XLA moves it)."""
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+def reshard(dist_tensor: Tensor, mesh: ProcessMesh, placements):
+    """Change a DistTensor's layout: the reference's reshard function library
+    (r_to_s, s_to_r, p_to_r, s_to_s, cross-mesh...) is one device_put — XLA
+    picks the collective (all-gather for s_to_r, all-to-all for s_to_s,
+    slice for r_to_s; p_to_* is metadata-only, see placement.py)."""
+    placements = normalize_placements(placements, mesh.ndim)
+    out = _resharded(dist_tensor, mesh, placements)
+    out._dist_attr = (mesh, placements)
+    return out
+
+
+def unshard_dtensor(dist_tensor: Tensor) -> Tensor:
+    """Back to a dense replicated tensor (api.py unshard_dtensor)."""
+    mesh = dist_tensor.process_mesh
+    if mesh is None:
+        return dist_tensor
+    out = _resharded(dist_tensor, mesh, [Replicate() for _ in range(mesh.ndim)])
+    out._dist_attr = None
+    return out
+
+
+def shard_layer(
+    layer: Layer,
+    process_mesh: ProcessMesh,
+    shard_fn: Optional[Callable] = None,
+    input_fn: Optional[Callable] = None,
+    output_fn: Optional[Callable] = None,
+) -> Layer:
+    """Shard a Layer's parameters in place (reference: api.py:441).
+
+    shard_fn(sublayer_name, sublayer, process_mesh) shards each sublayer's
+    params via shard_tensor; default replicates everything over the mesh.
+    """
+
+    def _default_shard(name, sub, mesh):
+        for pname, param in list(sub.named_parameters(include_sublayers=False)):
+            if param.is_dist():
+                continue
+            d = shard_tensor(param, mesh, [Replicate() for _ in range(mesh.ndim)])
+            param._replace_value(d._raw())
+            param._dist_attr = d._dist_attr
+
+    fn = shard_fn or _default_shard
+    for name, sub in layer.named_sublayers(include_self=True):
+        fn(name, sub, process_mesh)
+
+    if input_fn is not None:
+
+        def _pre(l, inp):
+            out = input_fn(inp, process_mesh)
+            # paddle's shard_layer convention lets input_fn return a list;
+            # Layer.__call__ expects a tuple of positional args
+            return tuple(out) if isinstance(out, list) else out
+
+        layer.register_forward_pre_hook(_pre)
+    if output_fn is not None:
+        layer.register_forward_post_hook(lambda l, inp, out: output_fn(out, process_mesh))
+    return layer
+
+
+def shard_optimizer(optimizer, shard_fn: Optional[Callable] = None):
+    """Shard optimizer states like their parameters (ZeRO-style when params
+    are sharded). Accumulator creation is wrapped so each new accumulator
+    (a) inherits its parameter's sharding and (b) is passed through
+    shard_fn(accumulator_name, param, accumulator) which may return a
+    replacement tensor (reference: api.py shard_optimizer)."""
+    orig_add = optimizer._add_accumulator
+
+    def _add(name, param, *args, **kwargs):
+        fresh = id(param) not in optimizer._accumulators[name]
+        acc = orig_add(name, param, *args, **kwargs)
+        if fresh:
+            if param.is_dist() and tuple(acc._raw().shape) == tuple(param._raw().shape):
+                mesh, placements = param._dist_attr
+                d = shard_tensor(acc, mesh, placements)
+                acc._replace_value(d._raw())
+                acc._dist_attr = d._dist_attr
+            if shard_fn is not None:
+                replaced = shard_fn(name, param, acc)
+                if replaced is not None and replaced is not acc:
+                    acc._replace_value(replaced._raw())
+                    acc._dist_attr = replaced._dist_attr
+        return acc
+
+    optimizer._add_accumulator = _add
+    return optimizer
+
+
+class ShardDataloader:
+    """Wraps a DataLoader: batches become DistTensors sharded over the mesh's
+    data axis (reference: api.py shard_dataloader)."""
+
+    def __init__(self, dataloader, meshes, shard_dims=None, input_keys=None):
+        self._loader = dataloader
+        self._mesh = meshes[0] if isinstance(meshes, (list, tuple)) else meshes
+        if shard_dims is None:
+            shard_dims = self._mesh.dim_names[0]
+        self._axis = (
+            self._mesh.dim_names.index(shard_dims) if isinstance(shard_dims, str) else shard_dims
+        )
+        self._input_keys = set(input_keys) if input_keys else None
+
+    def __len__(self):
+        return len(self._loader)
+
+    def _shard(self, t):
+        if not isinstance(t, Tensor):
+            return t
+        pl: list = [Replicate() for _ in range(self._mesh.ndim)]
+        pl[self._axis] = Shard(0)
+        return shard_tensor(t, self._mesh, pl)
+
+    def __iter__(self):
+        for batch in self._loader:
+            if isinstance(batch, dict):
+                yield {
+                    k: (self._shard(v) if self._input_keys is None or k in self._input_keys else v)
+                    for k, v in batch.items()
+                }
+            elif isinstance(batch, (list, tuple)):
+                yield type(batch)(self._shard(v) for v in batch)
+            else:
+                yield self._shard(batch)
+
+
+def shard_dataloader(dataloader, meshes, shard_dims=None, is_dataset_splitted=False, input_keys=None):
+    if is_dataset_splitted:
+        raise ValueError(
+            "is_dataset_splitted=True means the dataset already yields this "
+            "rank's local split — impossible under single-controller SPMD, "
+            "where the controller loads the GLOBAL batch and shards it. Load "
+            "the full dataset (is_dataset_splitted=False)."
+        )
+    return ShardDataloader(dataloader, meshes, shard_dims, input_keys)

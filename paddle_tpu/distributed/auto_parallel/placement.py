@@ -1,0 +1,106 @@
+"""Placements: Shard / Replicate / Partial.
+
+Reference parity: python/paddle/distributed/auto_parallel/placement_type.py +
+paddle/phi/core/distributed/auto_parallel/placement_types.h. TPU-native
+design: a placements list (one entry per mesh dim) compiles to a
+jax PartitionSpec. Partial has no NamedSharding encoding; in the eager
+single-controller view a partial tensor stores its logical (already-summed)
+global value with replicated layout plus the Partial marker in dist_attr —
+the reshard p_to_r/p_to_s pair
+(paddle/phi/core/distributed/auto_parallel/reshard/p_to_r_reshard_function.cc)
+then only rewrites placement metadata / layout. Real pending-reduction
+partials exist only inside compiled programs, where GSPMD tracks them.
+"""
+from __future__ import annotations
+
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+class Placement:
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicated(self):
+        return False
+
+    def is_partial(self):
+        return False
+
+    def __eq__(self, other):
+        return repr(self) == repr(other)
+
+    def __hash__(self):
+        return hash(repr(self))
+
+
+class Replicate(Placement):
+    def is_replicated(self):
+        return True
+
+    def __repr__(self):
+        return "Replicate()"
+
+
+class Shard(Placement):
+    def __init__(self, dim: int):
+        self.dim = dim
+
+    def is_shard(self, dim=None):
+        return dim is None or dim == self.dim
+
+    def get_dim(self):
+        return self.dim
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+
+class Partial(Placement):
+    def __init__(self, reduce_type=None):
+        from ..collective import ReduceOp
+
+        self.reduce_type = ReduceOp.SUM if reduce_type is None else reduce_type
+
+    def is_partial(self):
+        return True
+
+    def __repr__(self):
+        return f"Partial(reduce_type={self.reduce_type})"
+
+
+def normalize_placements(placements, mesh_ndim: int):
+    if placements is None:
+        placements = []
+    placements = list(placements)
+    while len(placements) < mesh_ndim:
+        placements.append(Replicate())
+    return placements
+
+
+def placements_to_spec(placements, mesh, tensor_ndim: int) -> P:
+    """[Placement per mesh dim] -> PartitionSpec per tensor dim.
+
+    Partial dims contribute nothing here (handled by the stacked-axis
+    convention, see module docstring).
+    """
+    entries = [[] for _ in range(tensor_ndim)]
+    for axis_idx, pl in enumerate(placements):
+        if isinstance(pl, Shard):
+            if pl.dim >= tensor_ndim:
+                raise ValueError(f"Shard(dim={pl.dim}) out of range for ndim={tensor_ndim}")
+            entries[pl.dim].append(mesh.dim_names[axis_idx])
+    spec = []
+    for e in entries:
+        if not e:
+            spec.append(None)
+        elif len(e) == 1:
+            spec.append(e[0])
+        else:
+            spec.append(tuple(e))
+    return P(*spec)
+
+
+def dist_sharding(mesh, placements, tensor_ndim: int) -> NamedSharding:
+    """NamedSharding for the stored array (Partial dims add no sharding)."""
+    spec = placements_to_spec(placements, mesh, tensor_ndim)
+    return NamedSharding(mesh.jax_mesh, spec)

@@ -1,0 +1,108 @@
+"""ProcessMesh — the logical device mesh.
+
+Reference parity: python/paddle/distributed/auto_parallel/process_mesh.py +
+the C++ ProcessMesh/DeviceMesh
+(paddle/phi/core/distributed/auto_parallel/process_mesh.h). TPU-native
+design: a ProcessMesh IS a jax.sharding.Mesh — process ids index the world
+device list, dim names become mesh axis names, and every placement maps to a
+PartitionSpec over those axes. ICI topology mapping is XLA's job (device
+order in the mesh controls which axes ride ICI rings).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+_global_mesh: Optional["ProcessMesh"] = None
+
+
+class ProcessMesh:
+    def __init__(self, mesh, dim_names: Optional[Sequence[str]] = None, shape=None, process_ids=None):
+        if mesh is None and shape is not None and process_ids is not None:
+            arr = np.asarray(process_ids).reshape(shape)
+        else:
+            arr = np.asarray(mesh)
+        if arr.ndim == 0:
+            arr = arr.reshape(1)
+        self._ids = arr
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        if len(dim_names) != arr.ndim:
+            raise ValueError(f"dim_names {dim_names} rank != mesh rank {arr.ndim}")
+        self._dim_names = list(dim_names)
+        self._jax_mesh: Optional[Mesh] = None
+
+    # ---- paddle surface ----
+    @property
+    def shape(self) -> List[int]:
+        return list(self._ids.shape)
+
+    @property
+    def ndim(self) -> int:
+        return self._ids.ndim
+
+    @property
+    def dim_names(self) -> List[str]:
+        return list(self._dim_names)
+
+    @property
+    def process_ids(self) -> List[int]:
+        return [int(i) for i in self._ids.flatten()]
+
+    @property
+    def mesh(self):
+        return self._ids
+
+    def get_dim_size(self, dim) -> int:
+        if isinstance(dim, str):
+            dim = self._dim_names.index(dim)
+        return self._ids.shape[dim]
+
+    def get_rank_by_dim_and_process_id(self, dim, process_id):
+        axis = self._dim_names.index(dim) if isinstance(dim, str) else dim
+        pos = np.argwhere(self._ids == process_id)
+        return int(pos[0][axis]) if len(pos) else -1
+
+    def get_mesh_with_dim(self, dim_name: str):
+        """Submesh view with `dim_name` moved first (paddle API)."""
+        axis = self._dim_names.index(dim_name)
+        order = [axis] + [i for i in range(self.ndim) if i != axis]
+        return ProcessMesh(np.transpose(self._ids, order), [self._dim_names[i] for i in order])
+
+    # ---- jax mapping ----
+    @property
+    def jax_mesh(self) -> Mesh:
+        if self._jax_mesh is None:
+            devs = jax.devices()
+            arr = np.empty(self._ids.shape, dtype=object)
+            for idx, pid in np.ndenumerate(self._ids):
+                arr[idx] = devs[int(pid)]
+            self._jax_mesh = Mesh(arr, tuple(self._dim_names))
+        return self._jax_mesh
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, ProcessMesh)
+            and self._dim_names == other._dim_names
+            and np.array_equal(self._ids, other._ids)
+        )
+
+    def __hash__(self):
+        return hash((tuple(self._dim_names), self._ids.tobytes(), self._ids.shape))
+
+    def __str__(self):
+        return f"ProcessMesh(shape={self.shape}, process_ids={self.process_ids}, dim_names={self.dim_names})"
+
+    __repr__ = __str__
+
+
+def get_mesh() -> Optional[ProcessMesh]:
+    return _global_mesh
+
+
+def set_mesh(mesh: ProcessMesh):
+    global _global_mesh
+    _global_mesh = mesh

@@ -246,8 +246,11 @@ def _stacked_value(tensor, group: Group):
 
 
 def _set_inplace(tensor, value):
+    # collectives are in-place, non-differentiated ops (paddle eager
+    # semantics): _replace_value records the write for to_static capture and
+    # detaches any stale grad node from the pre-collective value
     if isinstance(tensor, Tensor):
-        tensor._value = value
+        tensor._replace_value(value)
         return tensor
     return Tensor(value)
 

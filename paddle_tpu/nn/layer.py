@@ -23,7 +23,8 @@ class Parameter(Tensor):
     """Trainable tensor (analog of paddle Parameter / EagerParamBase,
     python/paddle/base/framework.py)."""
 
-    __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip", "is_distributed", "placements", "process_mesh")
+    # placements/process_mesh live on Tensor as dist-attr properties
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip", "is_distributed")
 
     def __init__(self, value, trainable=True, name=None):
         super().__init__(value, stop_gradient=not trainable, name=name)
@@ -32,8 +33,6 @@ class Parameter(Tensor):
         self.regularizer = None
         self.need_clip = True
         self.is_distributed = False
-        self.placements = None
-        self.process_mesh = None
 
 
 class Layer:
