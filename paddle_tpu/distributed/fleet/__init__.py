@@ -1,0 +1,43 @@
+"""paddle_tpu.distributed.fleet — hybrid-parallel orchestration.
+
+Reference parity: python/paddle/distributed/fleet/ (SURVEY §2.3). The
+module doubles as the `fleet` singleton object (paddle style:
+`from paddle.distributed import fleet; fleet.init(...)`).
+"""
+from .base.distributed_strategy import DistributedStrategy  # noqa: F401
+from .base.topology import (  # noqa: F401
+    CommunicateTopology,
+    HybridCommunicateGroup,
+    get_hybrid_communicate_group,
+)
+from .fleet import (  # noqa: F401
+    Fleet,
+    barrier_worker,
+    distributed_model,
+    distributed_optimizer,
+    init,
+    init_worker,
+    is_first_worker,
+    local_rank,
+    node_num,
+    stop_worker,
+    worker_endpoints,
+    worker_index,
+    worker_num,
+)
+from . import meta_parallel  # noqa: F401
+from . import utils  # noqa: F401
+from .recompute import recompute, recompute_sequential  # noqa: F401
+from .utils import sequence_parallel_utils  # noqa: F401
+from .meta_parallel import (  # noqa: F401
+    ColumnParallelLinear,
+    LayerDesc,
+    ParallelCrossEntropy,
+    PipelineLayer,
+    PipelineParallel,
+    RowParallelLinear,
+    SharedLayerDesc,
+    TensorParallel,
+    VocabParallelEmbedding,
+    get_rng_state_tracker,
+)

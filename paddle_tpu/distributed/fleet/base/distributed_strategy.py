@@ -1,0 +1,166 @@
+"""DistributedStrategy.
+
+Reference parity: python/paddle/distributed/fleet/base/distributed_strategy.py
+(:175 — 155 accessors over a protobuf,
+paddle/fluid/framework/distributed_strategy.proto). TPU-native design: plain
+python config (no protobuf wire format needed — there is no cross-process
+strategy exchange under a single controller); accessors keep the reference
+names so user code ports unchanged. Strategies that are NCCL/stream
+scheduling knobs (fuse_grad_size_in_MB, nccl_comm_num...) are accepted and
+recorded but have no effect: XLA owns fusion and scheduling.
+"""
+from __future__ import annotations
+
+import copy
+
+
+_HYBRID_DEFAULTS = {
+    # -1 = infer from world size (reference distributed_strategy.proto default)
+    "dp_degree": -1,
+    "mp_degree": 1,
+    "pp_degree": 1,
+    "sharding_degree": 1,
+    "sep_degree": 1,
+    "order": ["dp", "pp", "sharding", "sep", "mp"],
+}
+
+_AMP_DEFAULTS = {
+    "init_loss_scaling": 32768.0,
+    "incr_every_n_steps": 1000,
+    "decr_every_n_nan_or_inf": 2,
+    "incr_ratio": 2.0,
+    "decr_ratio": 0.8,
+    "use_dynamic_loss_scaling": True,
+    "custom_white_list": [],
+    "custom_black_list": [],
+    "use_pure_fp16": False,
+    "use_bf16": True,  # TPU-native default
+    "use_fp16_guard": True,
+}
+
+_RECOMPUTE_DEFAULTS = {"checkpoints": [], "enable_offload": False, "checkpoint_shape": []}
+
+_SHARDING_DEFAULTS = {
+    "sharding_segment_strategy": "segment_broadcast_MB",
+    "segment_broadcast_MB": 32,
+    "sharding_degree": 8,
+    "stage": 1,
+    "offload": False,
+}
+
+_PIPELINE_DEFAULTS = {
+    "micro_batch_size": 1,
+    "accumulate_steps": 1,
+    "schedule_mode": "1F1B",
+    "p2p_cache_shape": True,
+    "enable_partial_send_recv": True,
+}
+
+_TENSOR_PARALLEL_DEFAULTS = {"tensor_parallel_degree": 1, "tensor_init_seed": -1}
+
+
+class _ConfigDict(dict):
+    def __init__(self, defaults, values=None):
+        super().__init__(copy.deepcopy(defaults))
+        if values:
+            self.update(values)
+
+
+class DistributedStrategy:
+    def __init__(self):
+        # toggles
+        self.amp = False
+        self.recompute = False
+        self.pipeline = False
+        self.tensor_parallel = False
+        self.sharding = False
+        self.heter_ccl_mode = False
+        self.gradient_merge = False
+        self.lamb = False
+        self.lars = False
+        self.dgc = False
+        self.localsgd = False
+        self.adaptive_localsgd = False
+        self.fp16_allreduce = False
+        self.find_unused_parameters = False
+        self.without_graph_optimization = True
+        self.asp = False
+        self.qat = False
+        # accepted-but-inert NCCL/stream knobs (XLA owns fusion/scheduling)
+        self.fuse_all_reduce_ops = True
+        self.fuse_grad_size_in_MB = 32
+        self.nccl_comm_num = 1
+        self.sync_nccl_allreduce = True
+        self.last_comm_group_size_MB = 1
+
+        self._hybrid_configs = _ConfigDict(_HYBRID_DEFAULTS)
+        self._amp_configs = _ConfigDict(_AMP_DEFAULTS)
+        self._recompute_configs = _ConfigDict(_RECOMPUTE_DEFAULTS)
+        self._sharding_configs = _ConfigDict(_SHARDING_DEFAULTS)
+        self._pipeline_configs = _ConfigDict(_PIPELINE_DEFAULTS)
+        self._tensor_parallel_configs = _ConfigDict(_TENSOR_PARALLEL_DEFAULTS)
+        self._gradient_merge_configs = _ConfigDict({"k_steps": 1, "avg": True})
+        self.hybrid_parallel_order = list(_HYBRID_DEFAULTS["order"])
+
+    # ---- config-dict accessors (reference setter semantics: merge) ----
+    @property
+    def hybrid_configs(self):
+        return self._hybrid_configs
+
+    @hybrid_configs.setter
+    def hybrid_configs(self, configs):
+        if "order" in configs:
+            self.hybrid_parallel_order = list(configs["order"])
+        self._hybrid_configs.update(configs)
+
+    @property
+    def amp_configs(self):
+        return self._amp_configs
+
+    @amp_configs.setter
+    def amp_configs(self, configs):
+        self._amp_configs.update(configs)
+
+    @property
+    def recompute_configs(self):
+        return self._recompute_configs
+
+    @recompute_configs.setter
+    def recompute_configs(self, configs):
+        self._recompute_configs.update(configs)
+
+    @property
+    def sharding_configs(self):
+        return self._sharding_configs
+
+    @sharding_configs.setter
+    def sharding_configs(self, configs):
+        self._sharding_configs.update(configs)
+
+    @property
+    def pipeline_configs(self):
+        return self._pipeline_configs
+
+    @pipeline_configs.setter
+    def pipeline_configs(self, configs):
+        self._pipeline_configs.update(configs)
+
+    @property
+    def tensor_parallel_configs(self):
+        return self._tensor_parallel_configs
+
+    @tensor_parallel_configs.setter
+    def tensor_parallel_configs(self, configs):
+        self._tensor_parallel_configs.update(configs)
+
+    @property
+    def gradient_merge_configs(self):
+        return self._gradient_merge_configs
+
+    @gradient_merge_configs.setter
+    def gradient_merge_configs(self, configs):
+        self._gradient_merge_configs.update(configs)
+
+    def __repr__(self):
+        on = [k for k, v in self.__dict__.items() if v is True]
+        return f"DistributedStrategy(enabled={on}, hybrid={dict(self._hybrid_configs)})"

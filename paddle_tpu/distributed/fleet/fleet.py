@@ -1,0 +1,173 @@
+"""Fleet orchestration singleton.
+
+Reference parity: python/paddle/distributed/fleet/fleet.py (init:167,
+distributed_optimizer:1302) + fleet/model.py:32 distributed_model.
+TPU-native design: init builds the hybrid mesh topology
+(HybridCommunicateGroup over a multi-axis jax Mesh); distributed_model /
+distributed_optimizer wrap per the strategy — the wrapping sets shardings,
+GSPMD does the communication.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+from .. import parallel_env
+from ..parallel import DataParallel
+from .base.distributed_strategy import DistributedStrategy
+from .base.topology import (
+    CommunicateTopology,
+    HybridCommunicateGroup,
+    get_hybrid_communicate_group,
+    set_hybrid_communicate_group,
+)
+
+
+class _FleetState:
+    def __init__(self):
+        self.initialized = False
+        self.strategy: Optional[DistributedStrategy] = None
+        self.hcg: Optional[HybridCommunicateGroup] = None
+        self.is_collective = False
+
+
+_state = _FleetState()
+
+_ORDER_TO_TOPO_NAME = {"dp": "data", "pp": "pipe", "sharding": "sharding", "sep": "sep", "mp": "model"}
+_DEGREE_KEY = {"dp": "dp_degree", "pp": "pp_degree", "sharding": "sharding_degree", "sep": "sep_degree", "mp": "mp_degree"}
+
+
+def init(role_maker=None, is_collective: bool = False, strategy: Optional[DistributedStrategy] = None):
+    """paddle.distributed.fleet.init."""
+    parallel_env.init_parallel_env()
+    strategy = strategy or DistributedStrategy()
+    _state.strategy = strategy
+    _state.is_collective = is_collective
+    _state.initialized = True
+
+    hybrid = strategy.hybrid_configs
+    order = strategy.hybrid_parallel_order
+    world = jax.device_count()
+    degrees = {k: int(hybrid.get(_DEGREE_KEY[k], 1)) for k in order}
+    # dp_degree == -1 (or unset remainder): infer from world size
+    known = 1
+    for k, d in degrees.items():
+        if k != "dp" and d > 0:
+            known *= d
+    if degrees.get("dp", 1) in (-1, 0):
+        degrees["dp"] = max(1, world // known)
+
+    names = [_ORDER_TO_TOPO_NAME[k] for k in order]
+    dims = [degrees[k] for k in order]
+    topo = CommunicateTopology(hybrid_group_names=names, dims=dims)
+    hcg = HybridCommunicateGroup(topo)
+    set_hybrid_communicate_group(hcg)
+    _state.hcg = hcg
+    return None
+
+
+def is_first_worker() -> bool:
+    return parallel_env.get_rank() == 0
+
+
+def worker_index() -> int:
+    return parallel_env.get_rank()
+
+
+def worker_num() -> int:
+    return jax.process_count()
+
+
+def node_num() -> int:
+    return jax.process_count()
+
+
+def local_rank() -> int:
+    return 0
+
+
+def worker_endpoints(to_string=False):
+    eps = parallel_env.ParallelEnv().trainer_endpoints
+    return ",".join(eps) if to_string else eps
+
+
+def barrier_worker():
+    from ..collective import barrier
+
+    barrier()
+
+
+def init_worker(scopes=None):
+    return None
+
+
+def stop_worker():
+    return None
+
+
+def get_strategy() -> Optional[DistributedStrategy]:
+    return _state.strategy
+
+
+def distributed_model(model):
+    """Wrap a model per the active strategy (fleet/model.py:32).
+
+    - mp/pp layers (mpu.*, PipelineLayer) are already mesh-aware at
+      construction; they pass through.
+    - pure data parallel wraps in DataParallel (batch sharding).
+    """
+    if not _state.initialized:
+        init()
+    hcg = _state.hcg
+    from .meta_parallel.pipeline_parallel import PipelineParallel
+    from .meta_parallel.parallel_layers.pp_layers import PipelineLayer
+
+    if hcg.get_pipe_parallel_world_size() > 1 and isinstance(model, PipelineLayer):
+        return PipelineParallel(model, hcg, _state.strategy)
+    if hcg.get_parallel_mode() == "data_parallel" and jax.device_count() > 1:
+        return DataParallel(model)
+    return model
+
+
+def distributed_optimizer(optimizer, strategy: Optional[DistributedStrategy] = None):
+    """Wrap the optimizer per the strategy (fleet.py:1302).
+
+    Sharding stage-1 (optimizer-state sharding over the sharding axis) is
+    applied via shard_optimizer; TP/PP-aware grad clip is already correct
+    because norms are computed on global arrays (a sharded param's norm IS
+    the global norm — there are no partial per-rank norms to fix up).
+    """
+    strategy = strategy or _state.strategy or DistributedStrategy()
+    hcg = _state.hcg
+    if hcg is not None and hcg.get_sharding_parallel_world_size() > 1:
+        from ...distributed.auto_parallel.api import shard_optimizer
+        from ...distributed.auto_parallel.placement import Replicate, Shard
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        axis = hcg.axis_name("sharding")
+        mesh = hcg.mesh
+
+        def _shard_acc(name, param, acc):
+            x = acc._raw()
+            if x.ndim >= 1 and x.shape[0] % mesh.shape[axis] == 0:
+                sh = NamedSharding(mesh, P(axis))
+                acc._replace_value(jax.device_put(x, sh))
+            return None
+
+        shard_optimizer(optimizer, _shard_acc)
+    return optimizer
+
+
+class Fleet:
+    """Object surface for `from paddle.distributed.fleet import Fleet`."""
+
+    init = staticmethod(init)
+    is_first_worker = staticmethod(is_first_worker)
+    worker_index = staticmethod(worker_index)
+    worker_num = staticmethod(worker_num)
+    worker_endpoints = staticmethod(worker_endpoints)
+    barrier_worker = staticmethod(barrier_worker)
+    distributed_model = staticmethod(distributed_model)
+    distributed_optimizer = staticmethod(distributed_optimizer)

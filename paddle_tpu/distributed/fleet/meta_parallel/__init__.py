@@ -1,0 +1,14 @@
+from .parallel_layers import (  # noqa: F401
+    ColumnParallelLinear,
+    LayerDesc,
+    ParallelCrossEntropy,
+    PipelineLayer,
+    RowParallelLinear,
+    SegmentLayers,
+    SharedLayerDesc,
+    VocabParallelEmbedding,
+    get_rng_state_tracker,
+)
+from .pipeline_parallel import PipelineParallel, PipelineParallelWithInterleave  # noqa: F401
+from .spmd_pipeline import pipeline_spmd, stack_stage_params  # noqa: F401
+from .tensor_parallel import TensorParallel  # noqa: F401

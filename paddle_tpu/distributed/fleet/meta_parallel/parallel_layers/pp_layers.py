@@ -1,0 +1,214 @@
+"""Pipeline model partition descriptors.
+
+Reference parity: python/paddle/distributed/fleet/meta_parallel/parallel_layers/pp_layers.py
+(LayerDesc:56, SharedLayerDesc:76, SegmentLayers:92, PipelineLayer:257).
+
+TPU-native design: the controller owns ALL stages (no per-rank partial
+build), so PipelineLayer materializes every layer and records the
+stage-segment map. Stage placement is a sharding concern: the uniform-stage
+fast path stacks per-stage params over the mesh's pp axis and runs the
+circular shard_map pipeline (see ../spmd_pipeline.py); the general path
+executes stages in order inside one program, with micro-batch scheduling
+supplying the pipelining semantics (PipelineParallel.train_batch).
+"""
+from __future__ import annotations
+
+import math
+from typing import Callable, List, Optional, Sequence, Union
+
+from .....nn.layer import Layer
+
+
+class LayerDesc:
+    """Deferred layer constructor (reference :56)."""
+
+    def __init__(self, layer_func, *inputs, **kwargs):
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not issubclass(layer_func, Layer):
+            raise TypeError("LayerDesc expects a Layer subclass")
+
+    def build_layer(self) -> Layer:
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_func.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """Weight-tied layer appearing in several stages (reference :76) —
+    e.g. embedding + output projection. Single-controller: the SAME built
+    Layer object is reused, so tying is free (no broadcast sync needed)."""
+
+    def __init__(self, key, layer_func, forward_func=None, shared_weight_attr="weight", *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class SegmentLayers:
+    """Split N layers into num_parts stages (reference :92)."""
+
+    def __init__(self, layers_desc, num_parts, method="uniform", num_virtual_pipeline_stage=None):
+        self.layers_desc = layers_desc
+        self.num_parts = num_parts
+        self.method = method
+        assert len(layers_desc) >= num_parts, "number of layers must be >= number of stages"
+
+    def do_segment(self) -> List[int]:
+        """Returns stage boundaries: len num_parts+1, stage i = [b[i], b[i+1])."""
+        n = len(self.layers_desc)
+        if self.method == "uniform":
+            return self._uniform(n, self.num_parts)
+        if self.method.startswith("layer:"):
+            # segment so layers of the named class are evenly spread
+            name = self.method.split(":", 1)[1]
+            weights = [1 if self._layer_name(d) == name else 0 for d in self.layers_desc]
+            if sum(weights) == 0:
+                return self._uniform(n, self.num_parts)
+            return self._by_weight(weights)
+        if self.method == "parameter":
+            weights = [self._param_count(d) for d in self.layers_desc]
+            return self._by_weight(weights)
+        raise ValueError(f"unknown segment method {self.method}")
+
+    @staticmethod
+    def _layer_name(desc):
+        if isinstance(desc, LayerDesc):
+            return desc.layer_func.__name__
+        return type(desc).__name__
+
+    @staticmethod
+    def _param_count(desc):
+        if isinstance(desc, LayerDesc):
+            # estimate from ctor args without building: fall back to 1
+            return 1
+        if isinstance(desc, Layer):
+            return max(1, sum(int(math.prod(p.shape)) for p in desc.parameters()))
+        return 1
+
+    @staticmethod
+    def _uniform(n, parts):
+        bounds = [0]
+        base, extra = divmod(n, parts)
+        for i in range(parts):
+            bounds.append(bounds[-1] + base + (1 if i < extra else 0))
+        return bounds
+
+    def _by_weight(self, weights):
+        """Greedy balanced partition; every stage is guaranteed >= 1 layer
+        (the reference asserts non-empty stages)."""
+        n = len(weights)
+        total = sum(weights)
+        target = total / self.num_parts
+        bounds = [0]
+        acc = 0.0
+        for i, w in enumerate(weights):
+            acc += w
+            remaining_layers = n - (i + 1)
+            remaining_parts = self.num_parts - len(bounds)
+            if remaining_parts == 0:
+                break
+            # close a stage when it reached its share, but never leave fewer
+            # layers than still-open stages
+            if (acc >= target * len(bounds) and remaining_layers >= remaining_parts) or (
+                remaining_layers == remaining_parts
+            ):
+                bounds.append(i + 1)
+        while len(bounds) < self.num_parts:
+            bounds.append(bounds[-1] + 1)
+        bounds.append(n)
+        assert all(bounds[i + 1] > bounds[i] for i in range(self.num_parts)), (
+            f"empty pipeline stage in partition {bounds}"
+        )
+        return bounds
+
+
+class PipelineLayer(Layer):
+    """Reference parity: pp_layers.py:257.
+
+    layers: list of Layer / LayerDesc / SharedLayerDesc / callables.
+    loss_fn: applied by PipelineParallel.train_batch after the last stage.
+    """
+
+    def __init__(
+        self,
+        layers: Sequence[Union[Layer, LayerDesc, Callable]],
+        num_stages: Optional[int] = None,
+        topology=None,
+        loss_fn=None,
+        seg_method: str = "uniform",
+        recompute_interval: int = 0,
+        recompute_ctx=None,
+        num_virtual_pipeline_stages=None,
+    ):
+        super().__init__()
+        from ...base.topology import get_hybrid_communicate_group
+
+        hcg = get_hybrid_communicate_group()
+        if num_stages is None:
+            num_stages = hcg.get_pipe_parallel_world_size() if hcg else 1
+        self._num_stages = num_stages
+        self._loss_fn = loss_fn
+        self._recompute_interval = recompute_interval
+        self._topology = topology
+
+        # build all layers (controller owns every stage)
+        self._shared: dict = {}
+        built: List = []
+        self._shared_forward: dict = {}
+        for i, d in enumerate(layers):
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name not in self._shared:
+                    self._shared[d.layer_name] = d.build_layer()
+                layer = self._shared[d.layer_name]
+                if d.forward_func is not None:
+                    self._shared_forward[i] = (layer, d.forward_func)
+                built.append(layer)
+            elif isinstance(d, LayerDesc):
+                built.append(d.build_layer())
+            else:
+                built.append(d)  # Layer instance or plain callable (lambda)
+        self.run_function = built
+        for i, l in enumerate(built):
+            if isinstance(l, Layer):
+                setattr(self, f"_stage_layer_{i}", l)
+
+        seg = SegmentLayers(
+            [layers[i] if isinstance(layers[i], LayerDesc) else built[i] for i in range(len(built))],
+            num_parts=num_stages,
+            method=seg_method,
+        )
+        self.segment_parts = seg.do_segment()
+
+    @property
+    def num_stages(self):
+        return self._num_stages
+
+    def get_stage_from_index(self, layer_idx: int) -> int:
+        for s in range(self._num_stages):
+            if self.segment_parts[s] <= layer_idx < self.segment_parts[s + 1]:
+                return s
+        raise IndexError(layer_idx)
+
+    def stage_layers(self, stage: int) -> List:
+        return self.run_function[self.segment_parts[stage] : self.segment_parts[stage + 1]]
+
+    def forward_stage(self, x, stage: int):
+        for i in range(self.segment_parts[stage], self.segment_parts[stage + 1]):
+            fn = self.run_function[i]
+            if i in self._shared_forward:
+                layer, ffn = self._shared_forward[i]
+                x = ffn(layer, x)
+            elif isinstance(x, tuple):
+                x = fn(*x)
+            else:
+                x = fn(x)
+        return x
+
+    def forward(self, x):
+        for s in range(self._num_stages):
+            x = self.forward_stage(x, s)
+        return x

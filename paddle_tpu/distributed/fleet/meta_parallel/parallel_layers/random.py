@@ -1,0 +1,100 @@
+"""TP-aware RNG state tracking.
+
+Reference parity: python/paddle/distributed/fleet/layers/mpu/random.py
+(RNGStatesTracker:34, get_rng_state_tracker, model_parallel_random_seed,
+dropout:140). The reference keeps per-name CUDA generator states so dropout
+inside TP regions uses a LOCAL (per-mp-rank distinct) seed while the rest of
+the model uses the cross-TP-identical global seed.
+
+TPU-native design: jax PRNG is stateless; the tracker keeps a named key per
+state and splits it on use. Under GSPMD a dropout mask computed from one
+key over a sharded activation is already per-device-distinct data (each
+device materializes its own mask shard), so "local seed" semantics come for
+free inside compiled programs; the tracker exists for API parity and for
+deterministic replay.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+from .....framework import random as random_mod
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def add(self, name, seed):
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already exists")
+        if name in self.states_:
+            raise ValueError(f"state {name} already exists")
+        self.seeds_.add(seed)
+        self.states_[name] = jax.random.PRNGKey(seed)
+
+    def get_states_tracker(self):
+        return dict(self.states_)
+
+    def set_states_tracker(self, states):
+        self.states_ = dict(states)
+
+    @contextlib.contextmanager
+    def rng_state(self, name=MODEL_PARALLEL_RNG):
+        if name not in self.states_:
+            raise ValueError(f"state {name} does not exist")
+        # swap the framework key stream to this named state for the block
+        orig = random_mod.get_rng_state()
+        random_mod.set_rng_state(self.states_[name])
+        try:
+            yield
+        finally:
+            self.states_[name] = random_mod.get_rng_state()
+            random_mod.set_rng_state(orig)
+
+
+_RNG_STATE_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _RNG_STATE_TRACKER
+
+
+def model_parallel_random_seed(seed=None):
+    import random as pyrandom
+
+    from ...base.topology import get_hybrid_communicate_group
+
+    hcg = get_hybrid_communicate_group()
+    rank = 0 if hcg is None else hcg.get_model_parallel_rank()
+    if seed:
+        global_seed = seed
+        local_seed = seed * 1024 + rank * 100
+    else:
+        global_seed = pyrandom.randint(0, 655350)
+        local_seed = pyrandom.randint(rank * 10000, (rank + 1) * 10000 - 1)
+    _RNG_STATE_TRACKER.reset()
+    _RNG_STATE_TRACKER.add(MODEL_PARALLEL_RNG, local_seed)
+    random_mod.seed(global_seed)
+
+
+def determinate_seed(rng_name):
+    return 0
+
+
+def dropout(x, p=0.5, axis=None, rng_name=None, training=True, mode="upscale_in_train", name=None):
+    """mpu/random.py:140 — dropout drawing from a named tracker state."""
+    from .....nn import functional as F
+
+    if rng_name is None:
+        return F.dropout(x, p=p, axis=axis, training=training, mode=mode)
+    with _RNG_STATE_TRACKER.rng_state(rng_name):
+        return F.dropout(x, p=p, axis=axis, training=training, mode=mode)

@@ -1,0 +1,31 @@
+"""TensorParallel model wrapper.
+
+Reference parity: fleet/meta_parallel/tensor_parallel.py (TensorParallel:28)
+— there it broadcasts non-distributed params across the mp group at init
+(so every mp rank starts identical) and syncs grads. TPU-native: params
+live once on the controller, non-distributed params are replicated over the
+mesh by construction and mp-sharded params (mpu layers) were placed at
+creation — the wrapper is a passthrough kept for API parity.
+"""
+from __future__ import annotations
+
+from ....nn.layer import Layer
+
+
+class TensorParallel(Layer):
+    def __init__(self, layers, hcg=None, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)

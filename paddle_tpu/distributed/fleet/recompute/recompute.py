@@ -1,0 +1,173 @@
+"""Activation recomputation (gradient checkpointing).
+
+Reference parity: python/paddle/distributed/fleet/recompute/recompute.py
+(RecomputeFunction:108, recompute:402) — a PyLayer that drops activations
+and replays the forward during backward with RNG state restore.
+
+TPU-native design: the segment becomes ONE tape node wrapping
+jax.checkpoint(pure_segment): jax saves only the segment inputs and
+re-traces the jaxpr in the backward pass (same constants → same dropout
+keys, so preserve_rng_state is automatic). Parameters read inside the
+segment are discovered with a one-time recording probe (the to_static
+recorder) and passed as differentiable inputs so their grads flow.
+
+Caveat (documented): state WRITES inside a recomputed segment (e.g.
+BatchNorm running stats) are applied by the discovery probe's eager run
+only; steady-state recomputed calls treat the segment as pure.
+"""
+from __future__ import annotations
+
+import weakref
+from typing import Callable, List, Tuple
+
+import jax
+from jax import tree_util
+
+from ....core import state as core_state
+from ....core.apply import apply
+from ....core.tensor import Tensor
+from ....jit.api import _Recorder
+
+# Discovery cache keyed by LIVE function identity (weak refs, so a freed
+# lambda can never alias a new one via CPython id reuse). Bound methods are
+# keyed by their __self__ (weakly) + underlying __func__, since each
+# attribute access creates a fresh method object.
+_discovery_cache: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def _cache_get(function):
+    self_obj = getattr(function, "__self__", None)
+    if self_obj is not None:
+        inner = _discovery_cache.get(self_obj)
+        return None if inner is None else inner.get(function.__func__)
+    try:
+        return _discovery_cache.get(function)
+    except TypeError:
+        return None
+
+
+def _cache_set(function, state_list):
+    self_obj = getattr(function, "__self__", None)
+    try:
+        if self_obj is not None:
+            _discovery_cache.setdefault(self_obj, {})[function.__func__] = state_list
+        else:
+            _discovery_cache[function] = state_list
+    except TypeError:
+        pass  # un-weakref-able callable: probe every call (correct, uncached)
+
+
+def _flatten_tensors(obj):
+    leaves, treedef = tree_util.tree_flatten(obj, is_leaf=lambda x: isinstance(x, Tensor))
+    idx = [i for i, l in enumerate(leaves) if isinstance(l, Tensor)]
+    return leaves, treedef, idx
+
+
+def _discover_state(function: Callable, args, kwargs) -> Tuple[List[Tensor], object]:
+    """Eager probe run under the capture recorder: returns the framework
+    tensors (params/buffers) the segment reads, and the probe's output."""
+    arg_tensors = [
+        l for l in tree_util.tree_leaves((args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
+        if isinstance(l, Tensor)
+    ]
+    rec = _Recorder(exclude_ids={id(t) for t in arg_tensors})
+    prev = core_state.set_recorder(rec)
+    try:
+        out = function(*args, **kwargs)
+    finally:
+        core_state.set_recorder(prev)
+    return list(rec.reads.values()), out
+
+
+def recompute(function: Callable, *args, **kwargs):
+    """paddle.distributed.fleet.utils.recompute / paddle.distributed.recompute."""
+    kwargs.pop("use_reentrant", None)
+    kwargs.pop("preserve_rng_state", None)  # automatic: jaxpr replay reuses keys
+    if not core_state.is_grad_enabled():
+        return function(*args, **kwargs)
+
+    state_list = _cache_get(function)
+    if state_list is None:
+        state_list, probe_out = _discover_state(function, args, kwargs)
+        _cache_set(function, state_list)
+        # the probe run IS a correct (un-checkpointed) forward on the tape —
+        # use it so discovery costs nothing extra
+        return probe_out
+
+    leaves, treedef, t_idx = _flatten_tensors((args, kwargs))
+    diff_args = [leaves[i] for i in t_idx]
+    n_args = len(diff_args)
+    out_treedef = [None]
+
+    def segment(*vals):
+        # rebuild args with traced values; swap state tensors to traced
+        # values so param grads flow; undo any state writes after the call
+        new_leaves = list(leaves)
+        for i, v in zip(t_idx, vals[:n_args]):
+            t = Tensor(v)
+            t.stop_gradient = leaves[i].stop_gradient
+            new_leaves[i] = t
+        a, kw = tree_util.tree_unflatten(treedef, new_leaves)
+        saved = [(t, t._value, t._grad_node, t._out_index) for t in state_list]
+        rec = _Recorder(exclude_ids=set())
+        prev = core_state.set_recorder(rec)
+        try:
+            for t, v in zip(state_list, vals[n_args:]):
+                t._value = v
+                t._grad_node = None
+            with core_state.no_grad():  # inner ops: plain jax, outer vjp differentiates
+                out = function(*a, **kw)
+        finally:
+            core_state.set_recorder(prev)
+            state_ids = {id(t) for t in state_list}
+            for t, v, gn, oi in saved:
+                t._value = v
+                t._grad_node = gn
+                t._out_index = oi
+            # undo probe-invisible writes (e.g. a buffer updated only on some
+            # path) so trace-time tracers never leak into framework state
+            for tid, (t, orig) in rec.writes.items():
+                if tid not in state_ids:
+                    t._value = orig
+        out_leaves, odef = tree_util.tree_flatten(out, is_leaf=lambda x: isinstance(x, Tensor))
+        if not all(isinstance(o, Tensor) for o in out_leaves):
+            raise TypeError("recompute segment must return Tensors (or pytrees of Tensors)")
+        out_treedef[0] = odef
+        return tuple(o._value for o in out_leaves)
+
+    ckpt = jax.checkpoint(segment)
+    res = apply("recompute", lambda *vals: ckpt(*vals), *(diff_args + state_list))
+    outs = list(res) if isinstance(res, (tuple, list)) else [res]
+    return tree_util.tree_unflatten(out_treedef[0], outs)
+
+
+class _Chunk:
+    """Stable callable for one segment of a Sequential (cacheable identity)."""
+
+    def __init__(self, layers):
+        self.layers = tuple(layers)
+
+    def __call__(self, x):
+        for l in self.layers:
+            x = l(x)
+        return x
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """paddle.incubate.distributed.fleet.recompute_sequential — checkpoint a
+    Sequential in `segments` chunks. Chunk callables are cached on the
+    Sequential so discovery runs once per chunk, not once per step."""
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    sub_layers = list(functions)
+    step = max(1, len(sub_layers) // max(1, segments))
+    chunks = getattr(functions, "_recompute_chunks", None)
+    if chunks is None or len(chunks) != (len(sub_layers) + step - 1) // step:
+        chunks = [_Chunk(sub_layers[i : i + step]) for i in range(0, len(sub_layers), step)]
+        try:
+            functions._recompute_chunks = chunks
+        except AttributeError:
+            pass
+    out = args[0] if len(args) == 1 else args
+    for chunk in chunks:
+        out = recompute(chunk, out)
+    return out

@@ -1,0 +1,151 @@
+"""Sequence parallelism (Megatron-SP) utilities.
+
+Reference parity: python/paddle/distributed/fleet/utils/sequence_parallel_utils.py
+(ScatterOp:85, GatherOp:97, AllGatherOp:111, ReduceScatterOp:127,
+ColumnSequenceParallelLinear:395, RowSequenceParallelLinear:517,
+register_sequence_parallel_allreduce_hooks:192).
+
+TPU-native design: "activations sharded along the sequence dim between TP
+regions" is a sharding constraint on the seq axis over the mp mesh axis; the
+all-gather entering a TP matmul and the reduce-scatter leaving it are
+GSPMD-inserted when layouts demand them. The PyLayer forward/backward pairs
+(scatter fwd/gather bwd etc.) collapse into differentiable relayouts — the
+vjp of a resharding is the opposite resharding, which is exactly the
+reference's autograd pairing.
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ....core.apply import apply
+from ....core.tensor import Tensor
+from ....nn.initializer import Constant, XavierUniform
+from ....nn.layer import Layer
+from ..base.topology import get_hybrid_communicate_group
+from ..meta_parallel.parallel_layers.mp_layers import ColumnParallelLinear, RowParallelLinear
+
+
+def _mesh():
+    hcg = get_hybrid_communicate_group()
+    if hcg is None:
+        raise RuntimeError("fleet.init must run before sequence-parallel ops")
+    return hcg.mesh
+
+
+def _relayout(t: Tensor, spec: P) -> Tensor:
+    mesh = _mesh()
+    sh = NamedSharding(mesh, spec)
+
+    def f(x):
+        if isinstance(x, jax.core.Tracer):
+            return jax.lax.with_sharding_constraint(x, sh)
+        return jax.device_put(x, sh)
+
+    return apply("sp_relayout", f, t)
+
+
+def _seq_spec(ndim: int, seq_axis: int = 0) -> P:
+    spec = [None] * ndim
+    spec[seq_axis] = "mp"
+    return P(*spec)
+
+
+def _rep_spec(ndim: int) -> P:
+    return P(*([None] * ndim))
+
+
+class ScatterOp:
+    """[s, b, h] replicated -> seq-sharded over mp (bwd: gather)."""
+
+    @staticmethod
+    def apply(input, axis=0):  # noqa: A002
+        return _relayout(input, _seq_spec(len(input.shape), axis))
+
+
+class GatherOp:
+    """seq-sharded -> replicated (bwd: scatter)."""
+
+    @staticmethod
+    def apply(input, axis=0):  # noqa: A002
+        return _relayout(input, _rep_spec(len(input.shape)))
+
+
+class AllGatherOp:
+    """seq all-gather entering a TP block (bwd: reduce-scatter)."""
+
+    @staticmethod
+    def apply(input):  # noqa: A002
+        return _relayout(input, _rep_spec(len(input.shape)))
+
+
+class ReduceScatterOp:
+    """partial-sum -> seq-sharded sum leaving a TP block (bwd: all-gather).
+    GSPMD fuses the pending matmul reduction with the scatter layout."""
+
+    @staticmethod
+    def apply(input):  # noqa: A002
+        return _relayout(input, _seq_spec(len(input.shape)))
+
+
+def scatter(input, axis=0):  # noqa: A002
+    return ScatterOp.apply(input, axis)
+
+
+def all_gather(input):  # noqa: A002
+    return AllGatherOp.apply(input)
+
+
+def reduce_scatter(input):  # noqa: A002
+    return ReduceScatterOp.apply(input)
+
+
+def mark_as_sequence_parallel_parameter(param):
+    param.sequence_parallel = True
+
+
+def is_sequence_parallel_parameter(param):
+    return getattr(param, "sequence_parallel", False)
+
+
+def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1, fuse_sequence_parallel_allreduce=False):
+    """Reference :192 — per-param grad allreduce over mp for params marked
+    sequence-parallel (LayerNorm weights etc. that see seq-sharded
+    activations). Under GSPMD those grads are computed from the sharded seq
+    axis by a contraction, so the reduction is already inside backward; this
+    is a no-op kept for API parity."""
+    return None
+
+
+class ColumnSequenceParallelLinear(ColumnParallelLinear):
+    """Column-parallel linear whose input arrives seq-sharded: all-gather
+    (layout change) in, column-sharded out (reference :395)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=True,
+                 gather_output=False, fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__(
+            in_features, out_features, weight_attr=weight_attr, has_bias=has_bias,
+            gather_output=gather_output, fuse_matmul_bias=fuse_matmul_bias,
+            mp_group=mp_group, name=name,
+        )
+
+    def forward(self, x):
+        x = AllGatherOp.apply(x)
+        return super().forward(x)
+
+
+class RowSequenceParallelLinear(RowParallelLinear):
+    """Row-parallel linear whose output leaves seq-sharded: reduce-scatter
+    out (reference :517)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=True,
+                 input_is_parallel=True, fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__(
+            in_features, out_features, weight_attr=weight_attr, has_bias=has_bias,
+            input_is_parallel=input_is_parallel, fuse_matmul_bias=fuse_matmul_bias,
+            mp_group=mp_group, name=name,
+        )
+
+    def forward(self, x):
+        out = super().forward(x)
+        return ReduceScatterOp.apply(out)
