@@ -10,5 +10,10 @@ from .parallel_layers import (  # noqa: F401
     get_rng_state_tracker,
 )
 from .pipeline_parallel import PipelineParallel, PipelineParallelWithInterleave  # noqa: F401
+from .segment_parallel import (  # noqa: F401
+    SegmentParallel,
+    ring_flash_attention,
+    split_inputs_along_seq,
+)
 from .spmd_pipeline import pipeline_spmd, stack_stage_params  # noqa: F401
 from .tensor_parallel import TensorParallel  # noqa: F401

@@ -1,0 +1,96 @@
+"""Segment (context) parallelism engine.
+
+Reference parity: fleet/meta_parallel/segment_parallel.py:26 SegmentParallel —
+in the reference it is a scheduling shell only (SURVEY §2.3: no ring/Ulysses
+kernels exist there). Here the `sep` axis gets a real long-context engine:
+
+- `SegmentParallel` wraps a model whose attention ops route through
+  `ring_flash_attention` (ops/ring_attention.py): q/k/v sequence-sharded over
+  the `sep` mesh axis, k/v streamed around the ring with `lax.ppermute`,
+  flash online-softmax combining — exact attention with O(S/n) memory.
+- `split_inputs_along_seq` marks batch inputs seq-sharded over `sep` so XLA
+  keeps every elementwise/matmul op local to the shard; only attention (the
+  ring) and any cross-seq reductions communicate.
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ....core.apply import apply
+from ....core.tensor import Tensor, _ensure_tensor
+from ....nn.layer import Layer
+from ..base.topology import get_hybrid_communicate_group
+
+
+def _sep_mesh():
+    hcg = get_hybrid_communicate_group()
+    if hcg is None:
+        raise RuntimeError("fleet.init(sep_degree=...) must run before segment parallelism")
+    return hcg.mesh
+
+
+def split_inputs_along_seq(tensor, seq_axis: int = 1):
+    """Constrain a [B, S, ...] input to be seq-sharded over the sep axis."""
+    t = _ensure_tensor(tensor)
+    mesh = _sep_mesh()
+    spec = [None] * len(t.shape)
+    spec[seq_axis] = "sep"
+    sh = NamedSharding(mesh, P(*spec))
+
+    def f(x):
+        if isinstance(x, jax.core.Tracer):
+            return jax.lax.with_sharding_constraint(x, sh)
+        return jax.device_put(x, sh)
+
+    return apply("sep_split", f, t)
+
+
+def ring_flash_attention(query, key, value, causal: bool = False, sm_scale=None, group=None):
+    """Tensor-level exact ring attention over the hybrid topology's sep axis.
+
+    query/key/value: GLOBAL [B, S, H, D] (kv heads may be fewer — GQA).
+    Works eagerly and under to_static/jit (the mesh is trace-static).
+    """
+    from ....ops.ring_attention import ring_attention
+
+    q, k, v = _ensure_tensor(query), _ensure_tensor(key), _ensure_tensor(value)
+    mesh = _sep_mesh()
+
+    def f(qv, kv, vv):
+        return ring_attention(
+            qv, kv, vv, mesh=mesh, axis_name="sep", causal=causal, sm_scale=sm_scale
+        )
+
+    return apply("ring_flash_attention", f, q, k, v)
+
+
+class SegmentParallel(Layer):
+    """Reference parity: SegmentParallel:26. Wraps the model; inputs are
+    seq-split on entry, and the model's attention should call
+    `ring_flash_attention` (nn.functional.scaled_dot_product_attention does so
+    automatically when `sep_degree > 1` — see nn/functional/attention.py)."""
+
+    def __init__(self, layers, hcg=None, strategy=None, seq_axis: int = 1):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg or get_hybrid_communicate_group()
+        self._seq_axis = seq_axis
+
+    def forward(self, *args, **kwargs):
+        args = tuple(
+            split_inputs_along_seq(a, self._seq_axis)
+            if isinstance(a, Tensor) and len(a.shape) > self._seq_axis
+            else a
+            for a in args
+        )
+        return self._layers(*args, **kwargs)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)

@@ -48,12 +48,36 @@ def _sdpa_ref(q, k, v, mask, causal, dropout_p, scale, training, key=None):
     return jnp.swapaxes(out, 1, 2)  # -> [B, S, H, D]
 
 
+def _sep_degree() -> int:
+    """Context-parallel degree of the active hybrid topology (0 if none)."""
+    try:
+        from ...distributed.fleet.base.topology import get_hybrid_communicate_group
+
+        hcg = get_hybrid_communicate_group()
+        return hcg.get_sep_parallel_world_size() if hcg is not None else 0
+    except Exception:
+        return 0
+
+
 def scaled_dot_product_attention(
     query, key, value, attn_mask=None, dropout_p=0.0, is_causal=False, training=True, name=None
 ):
     """paddle layout [B, S, H, D]. Uses the Pallas flash kernel on TPU when
-    shapes allow, else the XLA-fused reference chain."""
+    shapes allow, else the XLA-fused reference chain. When the hybrid
+    topology has sep_degree > 1 (context parallelism) and there is no mask or
+    dropout, routes through the exact ring-attention kernel so the sequence
+    stays sharded over the sep axis."""
     q, k, v = _t(query), _t(key), _t(value)
+    if (
+        attn_mask is None
+        and dropout_p == 0.0
+        and _sep_degree() > 1
+        and len(q.shape) == 4
+        and q.shape[1] % _sep_degree() == 0
+    ):
+        from ...distributed.fleet.meta_parallel.segment_parallel import ring_flash_attention
+
+        return ring_flash_attention(q, k, v, causal=is_causal)
     rng_key = None
     if dropout_p > 0.0 and training:
         from ...framework import random as random_mod

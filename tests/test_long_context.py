@@ -1,0 +1,146 @@
+"""Long-context (ring attention / context parallelism) tests on the 8-device
+CPU mesh.
+
+The reference has no ring-attention to test against (SURVEY §2.3) — numerics
+are checked against the dense softmax(QK^T)V chain, which ring attention must
+match EXACTLY (it is flash-style exact attention, not an approximation).
+"""
+import math
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu.ops.ring_attention import ring_attention
+
+N = 8
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()), ("sep",))
+
+
+def _ref(q, k, v, causal):
+    qh, kh, vh = (jnp.swapaxes(t, 1, 2).astype(jnp.float32) for t in (q, k, v))
+    if kh.shape[1] != qh.shape[1]:
+        rep = qh.shape[1] // kh.shape[1]
+        kh = jnp.repeat(kh, rep, axis=1)
+        vh = jnp.repeat(vh, rep, axis=1)
+    d = qh.shape[-1]
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) / math.sqrt(d)
+    if causal:
+        s = logits.shape[-1]
+        logits = jnp.where(jnp.tril(jnp.ones((s, s), bool)), logits, -1e30)
+    p = jax.nn.softmax(logits, axis=-1)
+    return jnp.swapaxes(jnp.einsum("bhqk,bhkd->bhqd", p, vh), 1, 2)
+
+
+def _qkv(b=2, s=64, h=4, d=16, hkv=None, seed=0):
+    rng = np.random.RandomState(seed)
+    hkv = hkv or h
+    q = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, s, hkv, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, s, hkv, d), jnp.float32)
+    return q, k, v
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_dense(causal):
+    q, k, v = _qkv()
+    out = ring_attention(q, k, v, mesh=_mesh(), causal=causal)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(_ref(q, k, v, causal)), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_ring_attention_gqa():
+    q, k, v = _qkv(h=8, hkv=2)
+    out = ring_attention(q, k, v, mesh=_mesh(), causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(_ref(q, k, v, True)), rtol=2e-5, atol=2e-5
+    )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_grads(causal):
+    q, k, v = _qkv(b=1, s=32, h=2, d=8)
+    mesh = _mesh()
+
+    def f_ring(q, k, v):
+        return jnp.sum(ring_attention(q, k, v, mesh=mesh, causal=causal) ** 2)
+
+    def f_ref(q, k, v):
+        return jnp.sum(_ref(q, k, v, causal).astype(q.dtype) ** 2)
+
+    g_ring = jax.grad(f_ring, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4)
+
+
+def test_ring_attention_output_stays_seq_sharded():
+    q, k, v = _qkv()
+    mesh = _mesh()
+    sh = jax.sharding.NamedSharding(mesh, P(None, "sep", None, None))
+    q, k, v = (jax.device_put(t, sh) for t in (q, k, v))
+    out = ring_attention(q, k, v, mesh=mesh, causal=False)
+    assert out.sharding.spec == P(None, "sep", None, None)
+
+
+class TestFleetSepIntegration:
+    @pytest.fixture(autouse=True)
+    def _fleet(self):
+        import paddle_tpu.distributed.fleet as fleet
+
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1, "pp_degree": 1, "sep_degree": N}
+        fleet.init(is_collective=True, strategy=strategy)
+        yield
+        from paddle_tpu.distributed.fleet.base import topology as topo
+
+        topo._hcg = None
+
+    def test_sdpa_routes_through_ring(self):
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.distributed.fleet.meta_parallel import ring_flash_attention
+
+        q, k, v = _qkv(s=64)
+        out = F.scaled_dot_product_attention(
+            paddle.to_tensor(np.asarray(q)), paddle.to_tensor(np.asarray(k)),
+            paddle.to_tensor(np.asarray(v)), is_causal=True,
+        )
+        np.testing.assert_allclose(
+            out.numpy(), np.asarray(_ref(q, k, v, True)), rtol=2e-5, atol=2e-5
+        )
+        out2 = ring_flash_attention(
+            paddle.to_tensor(np.asarray(q)), paddle.to_tensor(np.asarray(k)),
+            paddle.to_tensor(np.asarray(v)), causal=True,
+        )
+        np.testing.assert_allclose(out.numpy(), out2.numpy(), rtol=1e-6, atol=1e-6)
+
+    def test_segment_parallel_wrapper(self):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.distributed.fleet.meta_parallel import SegmentParallel
+
+        class Attn(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.proj = nn.Linear(16, 16)
+
+            def forward(self, x):
+                import paddle_tpu.nn.functional as F
+
+                b, s, _ = x.shape
+                h = self.proj(x).reshape([b, s, 4, 4])
+                return F.scaled_dot_product_attention(h, h, h, is_causal=True).reshape([b, s, 16])
+
+        paddle.seed(0)
+        model = SegmentParallel(Attn())
+        x = paddle.to_tensor(np.random.RandomState(0).randn(2, 64, 16).astype(np.float32))
+        out = model(x)
+        assert tuple(out.shape) == (2, 64, 16)
+        assert np.isfinite(out.numpy()).all()
