@@ -1,0 +1,57 @@
+"""ZeRO stage-1 optimizer wrapper (dygraph sharding).
+
+Reference parity: fleet/meta_optimizers/dygraph_optimizer/
+dygraph_sharding_optimizer.py (DygraphShardingOptimizer) — there: params are
+partitioned per sharding rank (greedy by size), each rank keeps optimizer
+state only for its partition, updated params broadcast after step; optional
+reduce-scatter ("reduce_overlap") of grads. TPU-native design: the partition
+IS a placement — every accumulator is sharded over the sharding axis (GSPMD
+tiles the update and all-gathers params where used), so the greedy
+param-to-rank assignment, broadcast loop and fused buffers disappear.
+"""
+from __future__ import annotations
+
+from ...meta_parallel.sharding import group_sharded_utils as utils
+
+
+class DygraphShardingOptimizer:
+    def __init__(self, optimizer, hcg=None, **kw):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        if hcg is not None and "sharding" in hcg.mesh.shape:
+            self._mesh, self._axis = hcg.mesh, "sharding"
+        else:
+            self._mesh = utils.group_mesh(None)
+            self._axis = utils.group_axis_name(None)
+
+    @property
+    def inner_opt(self):
+        return self._inner_opt
+
+    def __getattr__(self, name):
+        return getattr(self._inner_opt, name)
+
+    def _shard_states(self):
+        for _, by_param in self._inner_opt._accumulators.items():
+            for t in by_param.values():
+                utils.place_sharded(t, self._mesh, self._axis)
+
+    def step(self):
+        self._inner_opt.step()
+        self._shard_states()
+
+    def minimize(self, loss, *a, **kw):
+        loss.backward()
+        self.step()
+        self._inner_opt.clear_grad()
+        return [], []
+
+    def clear_grad(self, set_to_zero: bool = False):
+        self._inner_opt.clear_grad(set_to_zero)
+
+    def state_dict(self):
+        return self._inner_opt.state_dict()
+
+    def set_state_dict(self, sd):
+        self._inner_opt.set_state_dict(sd)
+        self._shard_states()

@@ -1,0 +1,50 @@
+"""Hybrid-parallel optimizer wrapper.
+
+Reference parity: fleet/meta_optimizers/dygraph_optimizer/
+hybrid_parallel_optimizer.py (HybridParallelOptimizer) — there it (a) fixes
+grad clip so TP/PP partial params produce the correct GLOBAL norm (per-rank
+square sums allreduced over mp/pp/sharding groups), and (b) triggers
+sharding/DP grad syncs. TPU-native design: params and grads are global
+arrays (sharded placements), so `ClipGradByGlobalNorm` already computes the
+global norm and backward already holds the dp-summed grad — the wrapper only
+delegates, plus applies stage-1 sharding when the topology has a sharding
+axis.
+"""
+from __future__ import annotations
+
+from .dygraph_sharding_optimizer import DygraphShardingOptimizer
+
+
+class HybridParallelOptimizer:
+    def __init__(self, optimizer, hcg=None, strategy=None):
+        self._hcg = hcg
+        self._strategy = strategy
+        if hcg is not None and hcg.get_sharding_parallel_world_size() > 1:
+            self._inner_opt = DygraphShardingOptimizer(optimizer, hcg)
+        else:
+            self._inner_opt = optimizer
+
+    @property
+    def inner_opt(self):
+        return self._inner_opt
+
+    def __getattr__(self, name):
+        return getattr(self._inner_opt, name)
+
+    def step(self):
+        self._inner_opt.step()
+
+    def minimize(self, loss, *a, **kw):
+        loss.backward()
+        self._inner_opt.step()
+        self._inner_opt.clear_grad()
+        return [], []
+
+    def clear_grad(self, set_to_zero: bool = False):
+        self._inner_opt.clear_grad(set_to_zero)
+
+    def state_dict(self):
+        return self._inner_opt.state_dict()
+
+    def set_state_dict(self, sd):
+        self._inner_opt.set_state_dict(sd)

@@ -78,10 +78,18 @@ class SegmentParallel(Layer):
         self._seq_axis = seq_axis
 
     def forward(self, *args, **kwargs):
+        sep = self._hcg.get_sep_parallel_world_size() if self._hcg else 1
+
+        def _shardable(a):
+            return (
+                isinstance(a, Tensor)
+                and len(a.shape) > self._seq_axis
+                and a.shape[self._seq_axis] % sep == 0
+                and a.shape[self._seq_axis] >= sep
+            )
+
         args = tuple(
-            split_inputs_along_seq(a, self._seq_axis)
-            if isinstance(a, Tensor) and len(a.shape) > self._seq_axis
-            else a
+            split_inputs_along_seq(a, self._seq_axis) if _shardable(a) else a
             for a in args
         )
         return self._layers(*args, **kwargs)

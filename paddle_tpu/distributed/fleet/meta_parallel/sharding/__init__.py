@@ -1,0 +1,6 @@
+from .group_sharded_stage2 import (  # noqa: F401
+    GroupShardedOptimizerStage2,
+    GroupShardedStage2,
+)
+from .group_sharded_stage3 import GroupShardedStage3  # noqa: F401
+from . import group_sharded_utils  # noqa: F401

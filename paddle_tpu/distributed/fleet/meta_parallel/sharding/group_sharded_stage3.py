@@ -1,0 +1,67 @@
+"""ZeRO stage 3 (parameter + gradient + optimizer-state sharding) — FSDP.
+
+Reference parity: fleet/meta_parallel/sharding/group_sharded_stage3.py
+(GroupShardedStage3): params are sliced per rank, all-gathered on demand in
+forward/backward, grads reduce-scattered, optimizer updates local slices.
+TPU-native design: the whole dance is a placement policy — params, grads and
+accumulators all live sharded over the sharding axis; XLA all-gathers a
+param exactly where its first use needs it (and frees the gathered copy
+after, which is the reference's `release` hook), reduce-scatters grads, and
+keeps updates shard-local. `segment_size`/buffer bookkeeping is GSPMD tiling.
+"""
+from __future__ import annotations
+
+from .....nn.layer import Layer
+from . import group_sharded_utils as utils
+
+
+class GroupShardedStage3(Layer):
+    def __init__(self, layer, optimizer=None, group=None, sync_buffers=False,
+                 device="tpu", segment_size=2**20, pertrain_sync_models=True,
+                 offload=False, sync_comm=False, dp_group=None, exclude_layer=None):
+        super().__init__()
+        if offload:
+            raise NotImplementedError("offload: use jax host memory kinds; not yet wired")
+        self._layers = layer
+        self._optim = optimizer
+        self._mesh = utils.group_mesh(group)
+        self._axis = utils.group_axis_name(group)
+        self._shard_params()
+
+    def _shard_params(self):
+        for p in self._layers.parameters():
+            utils.place_sharded(p, self._mesh, self._axis)
+
+    def _shard_grads_and_states(self):
+        for p in self._layers.parameters():
+            if p.grad is not None:
+                utils.place_sharded(p.grad, self._mesh, self._axis)
+        if self._optim is not None:
+            for name, by_param in self._optim._accumulators.items():
+                for t in by_param.values():
+                    utils.place_sharded(t, self._mesh, self._axis)
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        out = self._layers.set_state_dict(state_dict, *args, **kwargs)
+        self._shard_params()
+        return out
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def get_all_parameters(self, convert2cpu: bool = False):
+        """Reference: gathers full params. Here params are logically global
+        already; optionally re-place replicated (the 'gather')."""
+        if convert2cpu:
+            for p in self._layers.parameters():
+                utils.place_replicated(p, self._mesh)
+        return self.parameters()
+
+    def to(self, *args, **kwargs):
+        return self

@@ -1,0 +1,57 @@
+"""Shared helpers for ZeRO/group-sharded parallelism.
+
+Reference parity: fleet/meta_parallel/sharding/group_sharded_utils.py +
+tensor_fusion_helper.py. TPU-native design: "sharding a state across the dp
+group" is a jax placement — NamedSharding over the group's mesh axis on the
+first divisible dim. The reference's fused-buffer bookkeeping (chunking flat
+buffers per rank) is what GSPMD's tiled layout already is, so no fusion
+helper is needed; eager placement + jit sharding constraints carry the whole
+design.
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .....core.tensor import Tensor
+
+
+def shard_axis_spec(shape, n: int, axis_name: str) -> P:
+    """First-dim sharding when divisible, else replicated."""
+    if len(shape) >= 1 and shape[0] % n == 0 and shape[0] > 0:
+        return P(*([axis_name] + [None] * (len(shape) - 1)))
+    return P(*([None] * len(shape)))
+
+
+def place_sharded(t: Tensor, mesh: Mesh, axis_name: str) -> None:
+    """Re-place a Tensor's value sharded over `axis_name` (in-place)."""
+    n = mesh.shape[axis_name]
+    v = t._raw()
+    spec = shard_axis_spec(v.shape, n, axis_name)
+    t._replace_value(jax.device_put(v, NamedSharding(mesh, spec)))
+
+
+def place_replicated(t: Tensor, mesh: Mesh) -> None:
+    v = t._raw()
+    t._replace_value(jax.device_put(v, NamedSharding(mesh, P(*([None] * v.ndim)))))
+
+
+def group_mesh(group=None, axis_name: str = "sharding") -> Mesh:
+    """Mesh for a sharding group: the group's own 1-D mesh, or the hybrid
+    topology's mesh if a HybridCommunicateGroup is active."""
+    if group is not None and hasattr(group, "mesh"):
+        return group.mesh
+    from ...base.topology import get_hybrid_communicate_group
+
+    hcg = get_hybrid_communicate_group()
+    if hcg is not None and axis_name in hcg.mesh.shape:
+        return hcg.mesh
+    import numpy as np
+
+    return Mesh(np.array(jax.devices()), (axis_name,))
+
+
+def group_axis_name(group=None, axis_name: str = "sharding") -> str:
+    if group is not None and hasattr(group, "mesh"):
+        return group.mesh.axis_names[0]
+    return axis_name

@@ -1,0 +1,76 @@
+"""Group-sharded (ZeRO) public API.
+
+Reference parity: python/paddle/distributed/sharding/group_sharded.py:40
+group_sharded_parallel(model, optimizer, level, ...) with
+level in {"os", "os_g", "p_g_os"} ≙ ZeRO stages 1/2/3, and
+save_group_sharded_model. See the stage modules for the TPU-native design
+(sharded placements; GSPMD emits reduce-scatter/all-gather).
+"""
+from __future__ import annotations
+
+import os
+
+from ..fleet.meta_parallel.sharding import (
+    GroupShardedOptimizerStage2,
+    GroupShardedStage2,
+    GroupShardedStage3,
+)
+
+__all__ = ["group_sharded_parallel", "save_group_sharded_model"]
+
+
+def group_sharded_parallel(
+    model,
+    optimizer,
+    level: str,
+    scaler=None,
+    group=None,
+    offload: bool = False,
+    sync_buffers: bool = False,
+    buffer_max_size: int = 2**23,
+    segment_size: int = 2**20,
+    sync_comm: bool = False,
+    dp_group=None,
+    exclude_layer=None,
+):
+    """Wrap model+optimizer for ZeRO level "os" (stage1), "os_g" (stage2) or
+    "p_g_os" (stage3). Returns (model, optimizer, scaler)."""
+    if level not in ("os", "os_g", "p_g_os"):
+        raise ValueError(f"level must be os / os_g / p_g_os, got {level!r}")
+
+    if level in ("os", "os_g"):
+        sharded_opt = GroupShardedOptimizerStage2(
+            params=list(model.parameters()), optim=optimizer, group=group, offload=offload
+        )
+        if level == "os":
+            # stage 1: only optimizer states shard; grads stay dp-replicated
+            sharded_opt._stage1 = True
+        model = GroupShardedStage2(
+            model, sharded_opt, group=group, sync_buffers=sync_buffers,
+            buffer_max_size=buffer_max_size,
+        )
+        optimizer = sharded_opt
+    else:
+        model = GroupShardedStage3(
+            model, optimizer=optimizer, group=group, sync_buffers=sync_buffers,
+            segment_size=segment_size, offload=offload, sync_comm=sync_comm,
+            dp_group=dp_group, exclude_layer=exclude_layer,
+        )
+    # scaler works unchanged: unscale/found_inf are elementwise over (possibly
+    # sharded) grads, reductions are global by construction
+    return model, optimizer, scaler
+
+
+def save_group_sharded_model(model, output, optimizer=None):
+    """Reference: gathers shards to rank 0 and saves. Single-controller: the
+    logical state dict is already global — re-place replicated and save."""
+    from ...framework import io as fio
+
+    inner = getattr(model, "_layers", model)
+    if isinstance(model, GroupShardedStage3):
+        model.get_all_parameters(convert2cpu=True)
+    os.makedirs(output, exist_ok=True)
+    fio.save(inner.state_dict(), os.path.join(output, "model.pdmodel"))
+    if optimizer is not None:
+        opt = getattr(optimizer, "_inner_opt", optimizer)
+        fio.save(opt.state_dict(), os.path.join(output, "model.pdopt"))

@@ -68,12 +68,17 @@ def scaled_dot_product_attention(
     dropout, routes through the exact ring-attention kernel so the sequence
     stays sharded over the sep axis."""
     q, k, v = _t(query), _t(key), _t(value)
+    sep = _sep_degree()
     if (
         attn_mask is None
         and dropout_p == 0.0
-        and _sep_degree() > 1
+        and sep > 1
         and len(q.shape) == 4
-        and q.shape[1] % _sep_degree() == 0
+        and q.shape[1] % sep == 0
+        # self-attention shapes only: cross-attention / kv-cache lengths
+        # can't ride the ring (per-chunk global positions assume equal S)
+        and k.shape[1] == q.shape[1]
+        and v.shape[1] == q.shape[1]
     ):
         from ...distributed.fleet.meta_parallel.segment_parallel import ring_flash_attention
 

@@ -77,8 +77,16 @@ def _flash_bwd(causal, sm_scale, res, g):
 flash_attention_bshd.defvjp(_flash_fwd, _flash_bwd)
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "sm_scale"))
 def _flash_attention_fwd_impl(q, k, v, causal=False, sm_scale=None):
+    # Mosaic rejects i64 grid/index types, and the framework enables x64
+    # globally (paddle dtype semantics) — trace the kernel with x64 off.
+    # All kernel dtypes are explicit so numerics are unchanged.
+    with jax.enable_x64(False):
+        return _flash_attention_fwd_x32(q, k, v, causal, sm_scale)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "sm_scale"))
+def _flash_attention_fwd_x32(q, k, v, causal=False, sm_scale=None):
     """Flash attention on [B, S, H, D]: online-softmax over K blocks.
 
     Grid: (batch*heads, q_blocks); each program instance streams K/V blocks
@@ -99,31 +107,40 @@ def _flash_attention_fwd_impl(q, k, v, causal=False, sm_scale=None):
         qi = pl.program_id(1)
         qb = q_ref[...].astype(jnp.float32) * scale
 
-        m0 = jnp.full((_BLOCK_Q,), -1e30, jnp.float32)
-        l0 = jnp.zeros((_BLOCK_Q,), jnp.float32)
+        # (BQ, 1) 2-D running stats: Mosaic wants >=2-D vregs in loop carry
+        m0 = jnp.full((_BLOCK_Q, 1), -1e30, jnp.float32)
+        l0 = jnp.zeros((_BLOCK_Q, 1), jnp.float32)
         acc0 = jnp.zeros((_BLOCK_Q, d), jnp.float32)
 
         n_k = s // _BLOCK_K
-        kmax = (qi + 1) * _BLOCK_Q // _BLOCK_K if causal else n_k
+        # NB: no traced floordiv here — x64 mode + pallas floor_divide
+        # recurses in promote_dtypes (jax 0.9); BLOCK_Q % BLOCK_K == 0 so a
+        # static ratio multiply is exact.
+        kmax = (qi + 1) * (_BLOCK_Q // _BLOCK_K) if causal else n_k
 
         def body(ki, carry):
             m, l, acc = carry
-            kb = pl.load(k_ref, (pl.dslice(ki * _BLOCK_K, _BLOCK_K), slice(None))).astype(jnp.float32)
-            vb = pl.load(v_ref, (pl.dslice(ki * _BLOCK_K, _BLOCK_K), slice(None))).astype(jnp.float32)
+            # all index math in i32: x64 mode makes fori_loop indices i64,
+            # which Mosaic's arith.muli/trunc legalization rejects
+            ki = jnp.asarray(ki, jnp.int32)
+            kb = k_ref[pl.dslice(ki * _BLOCK_K, _BLOCK_K), :].astype(jnp.float32)
+            vb = v_ref[pl.dslice(ki * _BLOCK_K, _BLOCK_K), :].astype(jnp.float32)
             logits = qb @ kb.T  # [BQ, BK] on MXU
             if causal:
                 qpos = qi * _BLOCK_Q + jax.lax.broadcasted_iota(jnp.int32, (_BLOCK_Q, _BLOCK_K), 0)
                 kpos = ki * _BLOCK_K + jax.lax.broadcasted_iota(jnp.int32, (_BLOCK_Q, _BLOCK_K), 1)
                 logits = jnp.where(qpos >= kpos, logits, -1e30)
-            m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
-            p = jnp.exp(logits - m_new[:, None])
+            m_new = jnp.maximum(m, jnp.max(logits, axis=-1, keepdims=True))
+            p = jnp.exp(logits - m_new)
             alpha = jnp.exp(m - m_new)
-            l_new = l * alpha + jnp.sum(p, axis=-1)
-            acc_new = acc * alpha[:, None] + p @ vb
+            l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+            acc_new = acc * alpha + p @ vb
             return m_new, l_new, acc_new
 
-        m, l, acc = jax.lax.fori_loop(0, kmax, body, (m0, l0, acc0))
-        o_ref[...] = (acc / l[:, None]).astype(o_ref.dtype)
+        m, l, acc = jax.lax.fori_loop(
+            jnp.asarray(0, jnp.int32), jnp.asarray(kmax, jnp.int32), body, (m0, l0, acc0)
+        )
+        o_ref[...] = (acc / l).astype(o_ref.dtype)
 
     out = pl.pallas_call(
         kernel,

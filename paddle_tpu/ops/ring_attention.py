@@ -73,9 +73,7 @@ def ring_attention_local(
 
     qpos = idx * s + lax.broadcasted_iota(jnp.int32, (s, s), 0)
 
-    def step(carry, t):
-        m, l, acc, kc, vc = carry
-        src = (idx - t) % n  # global chunk id of the kv shard we hold now
+    def _attend(m, l, acc, kc, vc, src):
         kh = jnp.swapaxes(_repeat_kv(kc, n_rep), 1, 2).astype(jnp.float32)
         vh = jnp.swapaxes(_repeat_kv(vc, n_rep), 1, 2).astype(jnp.float32)
         logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh)  # MXU
@@ -90,9 +88,26 @@ def ring_attention_local(
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + jnp.sum(p, axis=-1)
         acc_new = acc * alpha[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, vh)
+        return m_new, l_new, acc_new
+
+    def step(carry, t):
+        m, l, acc, kc, vc = carry
+        src = (idx - t) % n  # global chunk id of the kv shard we hold now
+        if causal:
+            # future chunks (src > idx) are fully masked — skip their einsums
+            # entirely (about half the ring steps; load is uneven per rank,
+            # the classic ring-causal tradeoff)
+            m, l, acc = lax.cond(
+                src > idx,
+                lambda m, l, acc, kc, vc, src: (m, l, acc),
+                _attend,
+                m, l, acc, kc, vc, src,
+            )
+        else:
+            m, l, acc = _attend(m, l, acc, kc, vc, src)
         k_next = lax.ppermute(kc, axis_name, perm)
         v_next = lax.ppermute(vc, axis_name, perm)
-        return (m_new, l_new, acc_new, k_next, v_next), None
+        return (m, l, acc, k_next, v_next), None
 
     m0 = jnp.full((b, h, s), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((b, h, s), jnp.float32)

@@ -1,0 +1,125 @@
+"""ZeRO / group-sharded tests on the 8-device CPU mesh.
+
+Reference parity: test/collective/fleet/dygraph_group_sharded_stage2/3 tests —
+there multi-process launchers compare sharded vs unsharded training losses;
+here stages are placement policies, so we check (a) numerics identical to the
+unsharded run, (b) states actually placed sharded over the mesh.
+"""
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed.sharding import group_sharded_parallel, save_group_sharded_model
+
+N = 8
+
+
+def _model_and_data(seed=0):
+    paddle.seed(seed)
+    model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 8))
+    rng = np.random.RandomState(seed)
+    x = paddle.to_tensor(rng.randn(16, 16).astype(np.float32))
+    y = paddle.to_tensor(rng.randn(16, 8).astype(np.float32))
+    return model, x, y
+
+
+def _train(model, opt, x, y, steps=3):
+    losses = []
+    for _ in range(steps):
+        loss = ((model(x) - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    return losses
+
+
+def _is_sharded(t, axis="sharding"):
+    sh = t._raw().sharding
+    return isinstance(sh, jax.sharding.NamedSharding) and axis in jax.tree_util.tree_leaves(
+        [list(p) if isinstance(p, tuple) else p for p in sh.spec]
+    )
+
+
+def _baseline_losses():
+    model, x, y = _model_and_data()
+    opt = paddle.optimizer.AdamW(learning_rate=0.01, parameters=model.parameters())
+    return _train(model, opt, x, y)
+
+
+@pytest.mark.parametrize("level", ["os", "os_g", "p_g_os"])
+def test_group_sharded_matches_unsharded(level):
+    base = _baseline_losses()
+    model, x, y = _model_and_data()
+    opt = paddle.optimizer.AdamW(learning_rate=0.01, parameters=model.parameters())
+    model, opt, _ = group_sharded_parallel(model, opt, level=level)
+    losses = _train(model, opt, x, y)
+    np.testing.assert_allclose(losses, base, rtol=1e-5, atol=1e-6)
+
+
+def test_stage2_states_sharded():
+    model, x, y = _model_and_data()
+    opt = paddle.optimizer.AdamW(learning_rate=0.01, parameters=model.parameters())
+    model, opt, _ = group_sharded_parallel(model, opt, level="os_g")
+    _train(model, opt, x, y, steps=1)
+    inner = opt._inner_opt
+    sharded = [
+        t for by_p in inner._accumulators.values() for t in by_p.values()
+        if t._raw().ndim >= 1 and t._raw().shape[0] % N == 0
+    ]
+    assert sharded, "expected at least one shardable accumulator"
+    axis = opt._axis
+    for t in sharded:
+        spec = t._raw().sharding.spec
+        assert spec and spec[0] == axis, f"accumulator not sharded: {spec}"
+
+
+def test_stage3_params_sharded():
+    model, x, y = _model_and_data()
+    opt = paddle.optimizer.AdamW(learning_rate=0.01, parameters=model.parameters())
+    model, opt, _ = group_sharded_parallel(model, opt, level="p_g_os")
+    axis = model._axis
+    shardable = [p for p in model.parameters() if p._raw().shape and p._raw().shape[0] % N == 0]
+    assert shardable
+    for p in shardable:
+        assert p._raw().sharding.spec[0] == axis
+
+
+def test_save_group_sharded_model(tmp_path):
+    model, x, y = _model_and_data()
+    opt = paddle.optimizer.AdamW(learning_rate=0.01, parameters=model.parameters())
+    model, opt, _ = group_sharded_parallel(model, opt, level="p_g_os")
+    _train(model, opt, x, y, steps=1)
+    out = str(tmp_path / "ckpt")
+    save_group_sharded_model(model, out, optimizer=opt)
+    import os
+
+    assert os.path.exists(os.path.join(out, "model.pdmodel"))
+    assert os.path.exists(os.path.join(out, "model.pdopt"))
+
+
+def test_dygraph_sharding_optimizer():
+    import paddle_tpu.distributed.fleet as fleet
+    from paddle_tpu.distributed.fleet.meta_optimizers import (
+        DygraphShardingOptimizer,
+        HybridParallelOptimizer,
+    )
+    from paddle_tpu.distributed.fleet.base import topology as topo
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1, "pp_degree": 1, "sharding_degree": N}
+    fleet.init(is_collective=True, strategy=strategy)
+    try:
+        base = _baseline_losses()
+        model, x, y = _model_and_data()
+        opt = paddle.optimizer.AdamW(learning_rate=0.01, parameters=model.parameters())
+        hopt = HybridParallelOptimizer(opt, hcg=topo.get_hybrid_communicate_group())
+        assert isinstance(hopt.inner_opt, DygraphShardingOptimizer)
+        losses = _train(model, hopt, x, y)
+        np.testing.assert_allclose(losses, base, rtol=1e-5, atol=1e-6)
+    finally:
+        topo._hcg = None
