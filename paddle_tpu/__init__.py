@@ -145,6 +145,7 @@ from . import jit  # noqa: E402,F401
 from . import static  # noqa: E402,F401
 from . import vision  # noqa: E402,F401
 from . import metric  # noqa: E402,F401
+from . import incubate  # noqa: E402,F401
 from .framework.io import load, save  # noqa: E402,F401
 from .jit import to_static  # noqa: E402,F401
 

@@ -40,11 +40,11 @@ class DygraphShardingOptimizer:
         self._inner_opt.step()
         self._shard_states()
 
-    def minimize(self, loss, *a, **kw):
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        # base Optimizer.minimize contract: no clear_grad, returns (None, None)
         loss.backward()
         self.step()
-        self._inner_opt.clear_grad()
-        return [], []
+        return None, None
 
     def clear_grad(self, set_to_zero: bool = False):
         self._inner_opt.clear_grad(set_to_zero)

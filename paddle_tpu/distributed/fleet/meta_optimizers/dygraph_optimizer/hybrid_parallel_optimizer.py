@@ -34,11 +34,12 @@ class HybridParallelOptimizer:
     def step(self):
         self._inner_opt.step()
 
-    def minimize(self, loss, *a, **kw):
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        # base Optimizer.minimize contract: no clear_grad, returns (None, None);
+        # self.step() (not _inner_opt.step) so hybrid grad clip/hooks run
         loss.backward()
-        self._inner_opt.step()
-        self._inner_opt.clear_grad()
-        return [], []
+        self.step()
+        return None, None
 
     def clear_grad(self, set_to_zero: bool = False):
         self._inner_opt.clear_grad(set_to_zero)

@@ -29,6 +29,8 @@ class GroupShardedOptimizerStage2:
         self._mesh = utils.group_mesh(group)
         self._axis = utils.group_axis_name(group)
         self._offload = offload
+        # stage 1 ("os"): only optimizer states shard, grads stay replicated
+        self._stage1 = False
         if offload:
             raise NotImplementedError(
                 "offload: host offload on TPU should use jax.sharding memory kinds; not yet wired"
@@ -50,9 +52,10 @@ class GroupShardedOptimizerStage2:
     def step(self):
         # grads arrive from backward; reduce-scatter = sharded placement of
         # the (already dp-summed) grad. The update then runs per-shard.
-        for _, p in self._inner_opt._all_params():
-            if p.grad is not None:
-                utils.place_sharded(p.grad, self._mesh, self._axis)
+        if not self._stage1:
+            for _, p in self._inner_opt._all_params():
+                if p.grad is not None:
+                    utils.place_sharded(p.grad, self._mesh, self._axis)
         self._inner_opt.step()
         self._shard_states()
 
@@ -66,11 +69,11 @@ class GroupShardedOptimizerStage2:
         self._inner_opt.set_state_dict(sd)
         self._shard_states()
 
-    def minimize(self, loss, *a, **kw):
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        # base Optimizer.minimize contract: no clear_grad, returns (None, None)
         loss.backward()
         self.step()
-        self.clear_grad()
-        return [], []
+        return None, None
 
 
 class GroupShardedStage2(Layer):

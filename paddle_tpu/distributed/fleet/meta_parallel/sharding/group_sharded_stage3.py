@@ -32,15 +32,6 @@ class GroupShardedStage3(Layer):
         for p in self._layers.parameters():
             utils.place_sharded(p, self._mesh, self._axis)
 
-    def _shard_grads_and_states(self):
-        for p in self._layers.parameters():
-            if p.grad is not None:
-                utils.place_sharded(p.grad, self._mesh, self._axis)
-        if self._optim is not None:
-            for name, by_param in self._optim._accumulators.items():
-                for t in by_param.values():
-                    utils.place_sharded(t, self._mesh, self._axis)
-
     def forward(self, *args, **kwargs):
         return self._layers(*args, **kwargs)
 

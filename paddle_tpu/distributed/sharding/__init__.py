@@ -38,24 +38,26 @@ def group_sharded_parallel(
     if level not in ("os", "os_g", "p_g_os"):
         raise ValueError(f"level must be os / os_g / p_g_os, got {level!r}")
 
+    sharded_opt = GroupShardedOptimizerStage2(
+        params=list(model.parameters()), optim=optimizer, group=group, offload=offload
+    )
+    if level == "os":
+        # stage 1: only optimizer states shard; grads stay dp-replicated
+        sharded_opt._stage1 = True
     if level in ("os", "os_g"):
-        sharded_opt = GroupShardedOptimizerStage2(
-            params=list(model.parameters()), optim=optimizer, group=group, offload=offload
-        )
-        if level == "os":
-            # stage 1: only optimizer states shard; grads stay dp-replicated
-            sharded_opt._stage1 = True
         model = GroupShardedStage2(
             model, sharded_opt, group=group, sync_buffers=sync_buffers,
             buffer_max_size=buffer_max_size,
         )
-        optimizer = sharded_opt
     else:
+        # stage 3: the same step-time grad/state sharding applies (the "g"
+        # and "os" of p_g_os); GroupShardedStage3 adds parameter sharding
         model = GroupShardedStage3(
-            model, optimizer=optimizer, group=group, sync_buffers=sync_buffers,
+            model, optimizer=sharded_opt, group=group, sync_buffers=sync_buffers,
             segment_size=segment_size, offload=offload, sync_comm=sync_comm,
             dp_group=dp_group, exclude_layer=exclude_layer,
         )
+    optimizer = sharded_opt
     # scaler works unchanged: unscale/found_inf are elementwise over (possibly
     # sharded) grads, reductions are global by construction
     return model, optimizer, scaler
@@ -67,10 +69,17 @@ def save_group_sharded_model(model, output, optimizer=None):
     from ...framework import io as fio
 
     inner = getattr(model, "_layers", model)
-    if isinstance(model, GroupShardedStage3):
+    is_stage3 = isinstance(model, GroupShardedStage3)
+    if is_stage3:
         model.get_all_parameters(convert2cpu=True)
-    os.makedirs(output, exist_ok=True)
-    fio.save(inner.state_dict(), os.path.join(output, "model.pdmodel"))
-    if optimizer is not None:
-        opt = getattr(optimizer, "_inner_opt", optimizer)
-        fio.save(opt.state_dict(), os.path.join(output, "model.pdopt"))
+    try:
+        os.makedirs(output, exist_ok=True)
+        fio.save(inner.state_dict(), os.path.join(output, "model.pdmodel"))
+        if optimizer is not None:
+            opt = getattr(optimizer, "_inner_opt", optimizer)
+            fio.save(opt.state_dict(), os.path.join(output, "model.pdopt"))
+    finally:
+        if is_stage3:
+            # the gather above re-placed params replicated; restore sharding so
+            # continued training keeps stage-3 memory behavior
+            model._shard_params()

@@ -1,0 +1,6 @@
+"""paddle.incubate parity — staging ground for experimental APIs.
+
+Reference: python/paddle/incubate/ (MoE expert parallelism, fused ops,
+autotune, auto-checkpoint). Subpackages are populated as they land.
+"""
+from . import distributed  # noqa: F401

@@ -1,0 +1,41 @@
+"""Expert parallelism (MoE).
+
+Reference parity: python/paddle/incubate/distributed/models/moe/.
+See moe_layer.py for the TPU-native dispatch design.
+"""
+from .gate import BaseGate, GShardGate, NaiveGate, SwitchGate  # noqa: F401
+from .moe_layer import ExpertLayer, MoELayer  # noqa: F401
+from .utils import count_by_gate, limit_by_capacity, prune_gate_by_capacity  # noqa: F401
+
+
+def global_scatter(x, local_count, global_count, group=None, use_calc_stream=True):
+    """Reference: paddle.distributed.utils.global_scatter
+    (paddle/fluid/operators/collective/global_scatter_op.cc) — variable-count
+    token exchange between expert ranks.
+
+    Design decision (SURVEY.md §5 "Distributed communication backend"): on
+    TPU, cross-rank token movement is *compiled* — MoELayer's dense dispatch
+    einsum + GSPMD-sharded expert dim emits the all-to-all inside the XLA
+    program, so there is no eager variable-count scatter. With a size-1 group
+    (or none) the reference op is the identity permutation into expert order,
+    which is what this returns; a multi-rank *eager* exchange would need
+    dynamic shapes XLA cannot compile and is intentionally unsupported.
+    """
+    if group is not None and getattr(group, "nranks", 1) > 1:
+        raise NotImplementedError(
+            "eager variable-count global_scatter is not XLA-compilable; "
+            "use MoELayer (dense dispatch + sharded expert dim) instead"
+        )
+    # size-1 group: input rows were already permuted into expert order by the
+    # caller (via count_by_gate's pos), so the exchange is the identity.
+    return x
+
+
+def global_gather(x, local_count, global_count, group=None, use_calc_stream=True):
+    """Inverse of global_scatter; identity at group size 1 (see above)."""
+    if group is not None and getattr(group, "nranks", 1) > 1:
+        raise NotImplementedError(
+            "eager variable-count global_gather is not XLA-compilable; "
+            "use MoELayer (dense combine + sharded expert dim) instead"
+        )
+    return x

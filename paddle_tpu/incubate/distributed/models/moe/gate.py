@@ -1,0 +1,97 @@
+"""MoE gates.
+
+Reference parity: python/paddle/incubate/distributed/models/moe/gate/
+(base_gate.py BaseGate, naive_gate.py NaiveGate, gshard_gate.py GShardGate,
+switch_gate.py SwitchGate).
+
+TPU-native deviation: the reference gates return sparse (topk_value,
+topk_index) pairs that feed a variable-count global_scatter. On TPU the
+dispatch must be a static-shape dense einsum (GShard-style), so gates here
+return the full softmax probability matrix [tokens, tot_expert]; top-k
+selection, capacity enforcement and the auxiliary load-balancing loss are
+computed inside MoELayer's fused dispatch kernel, parameterised by the
+gate's `top_k` / `capacity_factor` / `aux_loss_mode` attributes. After a
+forward pass the layer stores the differentiable aux loss on `gate.l_aux`
+(the attribute the reference exposes, gshard_gate.py).
+"""
+from __future__ import annotations
+
+from .....nn import functional as F
+from .....nn.initializer import XavierUniform, Constant
+from .....nn.layer import Layer
+
+
+class BaseGate(Layer):
+    """Reference: gate/base_gate.py — holds (num_expert, world_size) split.
+
+    Here `world_size` is the expert-parallel degree (the size of the mesh
+    axis the expert dim is sharded over); tot_expert = num_expert * world_size
+    exactly as in the reference.
+    """
+
+    def __init__(self, num_expert: int, world_size: int):
+        super().__init__()
+        self.world_size = world_size
+        self.num_expert = num_expert
+        self.tot_expert = world_size * num_expert
+        self.loss = None
+        self.l_aux = None
+
+    # dispatch policy consumed by MoELayer
+    top_k: int = 2
+    capacity_factor = (1.2, 2.4)  # (train, eval), reference gshard_gate.py
+    aux_loss_mode = "gshard"
+    normalize_gate = True
+
+    def get_loss(self):
+        return self.l_aux if self.l_aux is not None else self.loss
+
+
+class NaiveGate(BaseGate):
+    """Reference: gate/naive_gate.py — plain linear scorer, no capacity.
+
+    top-k softmax routing with no capacity limiting (capacity factor set so
+    no token is ever dropped) and no aux loss.
+    """
+
+    aux_loss_mode = None
+
+    def __init__(self, d_model: int, num_expert: int, world_size: int, topk: int = 2):
+        super().__init__(num_expert, world_size)
+        self.top_k = topk
+        self.capacity_factor = (float(self.tot_expert), float(self.tot_expert))
+        self.gate_weight = self.create_parameter(
+            [d_model, self.tot_expert], default_initializer=XavierUniform()
+        )
+        self.gate_bias = self.create_parameter(
+            [self.tot_expert], default_initializer=Constant(0.0), is_bias=True
+        )
+
+    def forward(self, inp):
+        logits = F.linear(inp, self.gate_weight, self.gate_bias)
+        return F.softmax(logits, axis=-1)
+
+
+class GShardGate(NaiveGate):
+    """Reference: gate/gshard_gate.py — top-2, capacity-limited, aux loss."""
+
+    aux_loss_mode = "gshard"
+
+    def __init__(self, d_model, num_expert, world_size, topk: int = 2,
+                 capacity=(1.2, 2.4), random_routing: bool = True, group=None):
+        super().__init__(d_model, num_expert, world_size, topk=topk)
+        self.capacity_factor = tuple(capacity)
+        self.random_routing = random_routing
+
+
+class SwitchGate(NaiveGate):
+    """Reference: gate/switch_gate.py — top-1 (Switch Transformer) routing."""
+
+    aux_loss_mode = "switch"
+
+    def __init__(self, d_model, num_expert, world_size, topk: int = 1,
+                 switch_eps: float = 0.1, capacity=(1.2, 2.4), group=None):
+        super().__init__(d_model, num_expert, world_size, topk=1)
+        self.switch_eps = switch_eps
+        self.capacity_factor = tuple(capacity)
+        self.normalize_gate = False

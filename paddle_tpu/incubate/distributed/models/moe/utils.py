@@ -1,0 +1,64 @@
+"""MoE routing utilities.
+
+Reference parity: python/paddle/incubate/distributed/models/moe/utils.py
+(_number_count/count_by_gate, _limit_by_capacity, _prune_gate_by_capacity —
+backed by CUDA ops number_count_op.cu, limit_by_capacity_op.cu,
+prune_gate_by_capacity_op.cu). Here they are dense jnp computations: static
+shapes, no host round-trip, differentiability not required (routing indices).
+"""
+from __future__ import annotations
+
+import jax
+from jax import numpy as jnp
+
+from .....core.apply import apply
+from .....core.tensor import Tensor
+
+
+def count_by_gate(gate_idx, num_expert: int, world_size: int = 1, require_pos: bool = True, group=None):
+    """-> (pos, local_expert_count, global_expert_count).
+
+    pos: for each slot of the expert-sorted order, the source token index
+    (the permutation global_scatter would apply); counts are per global
+    expert. With world_size == 1 (the compiled-collective design — see
+    global_scatter below) local and global counts coincide.
+    """
+    tot = num_expert * world_size
+
+    def fn(idx):
+        idx = idx.reshape(-1).astype(jnp.int32)
+        counts = jnp.sum(jax.nn.one_hot(idx, tot, dtype=jnp.int64), axis=0)
+        pos = jnp.argsort(idx, stable=True).astype(jnp.int64)
+        return pos, counts, counts
+
+    pos, local_count, global_count = apply("count_by_gate", fn, gate_idx, n_outputs=3)
+    if not require_pos:
+        pos = None
+    return pos, local_count, global_count
+
+
+def limit_by_capacity(expert_count, capacity, n_worker: int = 1, group=None):
+    """Clip per-expert token counts at capacity (limit_by_capacity_op.cu)."""
+
+    def fn(ec, cap):
+        return jnp.minimum(ec, jnp.broadcast_to(jnp.asarray(cap, ec.dtype), ec.shape))
+
+    return apply("limit_by_capacity", fn, expert_count, capacity)
+
+
+def prune_gate_by_capacity(gate_idx, expert_count, n_expert: int, n_worker: int = 1):
+    """Set gate index to -1 for tokens past their expert's (limited) count.
+
+    Reference: prune_gate_by_capacity_op.cu — token order within an expert is
+    arrival order (cumsum), matching _routing()'s priority-major positions.
+    """
+
+    def fn(idx, ec):
+        flat = idx.reshape(-1).astype(jnp.int32)
+        oh = jax.nn.one_hot(flat, n_expert * n_worker, dtype=jnp.int32)
+        pos_in_expert = jnp.sum((jnp.cumsum(oh, axis=0) - 1) * oh, axis=1)
+        allowed = jnp.take(ec.astype(jnp.int32), flat)
+        pruned = jnp.where(pos_in_expert < allowed, flat, -1)
+        return pruned.reshape(idx.shape).astype(idx.dtype)
+
+    return apply("prune_gate_by_capacity", fn, gate_idx, expert_count)

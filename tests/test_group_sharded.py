@@ -123,3 +123,63 @@ def test_dygraph_sharding_optimizer():
         np.testing.assert_allclose(losses, base, rtol=1e-5, atol=1e-6)
     finally:
         topo._hcg = None
+
+
+def test_stage3_grads_and_states_sharded():
+    """p_g_os must shard grads + optimizer accumulators, not just params."""
+    model, x, y = _model_and_data()
+    opt = paddle.optimizer.AdamW(learning_rate=0.01, parameters=model.parameters())
+    model, opt, _ = group_sharded_parallel(model, opt, level="p_g_os")
+    loss = ((model(x) - y) ** 2).mean()
+    loss.backward()
+    opt.step()
+    axis = opt._axis
+    inner = opt._inner_opt
+    accs = [
+        t for by_p in inner._accumulators.values() for t in by_p.values()
+        if t._raw().ndim >= 1 and t._raw().shape[0] % N == 0
+    ]
+    assert accs
+    for t in accs:
+        assert t._raw().sharding.spec[0] == axis, "stage3 accumulator not sharded"
+
+
+def test_stage1_keeps_grads_replicated():
+    """level='os' shards optimizer states only; grads stay replicated."""
+    model, x, y = _model_and_data()
+    opt = paddle.optimizer.AdamW(learning_rate=0.01, parameters=model.parameters())
+    model, opt, _ = group_sharded_parallel(model, opt, level="os")
+    loss = ((model(x) - y) ** 2).mean()
+    loss.backward()
+    opt.step()
+    axis = opt._axis
+    for p in model.parameters():
+        if p.grad is not None and p.grad._raw().ndim >= 1:
+            sh = p.grad._raw().sharding
+            spec = getattr(sh, "spec", None)  # SingleDeviceSharding = replicated
+            assert not (spec and spec[0] == axis), "stage1 grad was sharded"
+
+
+def test_save_restores_stage3_sharding(tmp_path):
+    """Checkpointing mid-training must not leave params replicated."""
+    model, x, y = _model_and_data()
+    opt = paddle.optimizer.AdamW(learning_rate=0.01, parameters=model.parameters())
+    model, opt, _ = group_sharded_parallel(model, opt, level="p_g_os")
+    _train(model, opt, x, y, steps=1)
+    save_group_sharded_model(model, str(tmp_path / "ckpt"), optimizer=opt)
+    axis = model._axis
+    shardable = [p for p in model.parameters() if p._raw().shape and p._raw().shape[0] % N == 0]
+    assert shardable
+    for p in shardable:
+        assert p._raw().sharding.spec[0] == axis, "param left replicated after save"
+
+
+def test_minimize_keeps_grads():
+    """Wrapper minimize() follows base contract: grads not cleared."""
+    model, x, y = _model_and_data()
+    opt = paddle.optimizer.AdamW(learning_rate=0.01, parameters=model.parameters())
+    model, opt, _ = group_sharded_parallel(model, opt, level="os_g")
+    loss = ((model(x) - y) ** 2).mean()
+    ret = opt.minimize(loss)
+    assert ret == (None, None)
+    assert any(p.grad is not None for p in model.parameters())
