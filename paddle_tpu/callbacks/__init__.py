@@ -1,0 +1,22 @@
+"""paddle.callbacks namespace (reference: python/paddle/callbacks.py re-exports hapi callbacks)."""
+from ..hapi.callbacks import (  # noqa: F401
+    Callback,
+    EarlyStopping,
+    LRScheduler,
+    ModelCheckpoint,
+    ProgBarLogger,
+    ReduceLROnPlateau,
+    VisualDL,
+    WandbCallback,
+)
+
+__all__ = [
+    "Callback",
+    "ProgBarLogger",
+    "ModelCheckpoint",
+    "LRScheduler",
+    "EarlyStopping",
+    "ReduceLROnPlateau",
+    "VisualDL",
+    "WandbCallback",
+]

@@ -1,0 +1,6 @@
+"""High-level API (reference parity: python/paddle/hapi/)."""
+from . import callbacks  # noqa: F401
+from .model import Model  # noqa: F401
+from .model_summary import summary  # noqa: F401
+
+__all__ = ["Model", "summary", "callbacks"]

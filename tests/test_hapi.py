@@ -1,0 +1,123 @@
+"""hapi Model.fit/evaluate/predict + callbacks + summary.
+
+Mirrors the reference's test/legacy_test/test_model.py style: a small MNIST-shaped
+classifier trained on synthetic data through the high-level API.
+"""
+import io as _io
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.io import Dataset
+from paddle_tpu.metric import Accuracy
+
+
+class RandomDataset(Dataset):
+    def __init__(self, n=64, num_classes=4, feat=8, seed=0):
+        rng = np.random.RandomState(seed)
+        self.x = rng.randn(n, feat).astype("float32")
+        self.y = rng.randint(0, num_classes, (n, 1)).astype("int64")
+
+    def __getitem__(self, idx):
+        return self.x[idx], self.y[idx]
+
+    def __len__(self):
+        return len(self.x)
+
+
+def _mlp(feat=8, num_classes=4):
+    return paddle.nn.Sequential(
+        paddle.nn.Linear(feat, 16),
+        paddle.nn.ReLU(),
+        paddle.nn.Linear(16, num_classes),
+    )
+
+
+def test_fit_decreases_loss(tmp_path):
+    net = _mlp()
+    model = paddle.Model(net)
+    opt = paddle.optimizer.Adam(learning_rate=0.01, parameters=model.parameters())
+    model.prepare(opt, paddle.nn.CrossEntropyLoss(), Accuracy())
+    ds = RandomDataset(n=64)
+    first = model.train_batch([ds.x[:16]], [ds.y[:16]])
+    logs = model.fit(ds, epochs=4, batch_size=16, verbose=0, shuffle=False)
+    assert "loss" in logs
+    first_loss = first[0][0] if isinstance(first, tuple) else first[0]
+    assert logs["loss"] < first_loss
+
+
+def test_evaluate_and_predict():
+    model = paddle.Model(_mlp())
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+    model.prepare(opt, paddle.nn.CrossEntropyLoss(), Accuracy(topk=(1, 2)))
+    ds = RandomDataset(n=32)
+    res = model.evaluate(ds, batch_size=8, verbose=0)
+    assert "acc_top1" in res and "acc_top2" in res
+    assert 0.0 <= res["acc_top1"] <= res["acc_top2"] <= 1.0
+
+    out = model.predict(ds, batch_size=8, stack_outputs=True, verbose=0)
+    assert out[0].shape == (32, 4)
+
+
+def test_save_load_checkpoint(tmp_path):
+    model = paddle.Model(_mlp())
+    opt = paddle.optimizer.Adam(learning_rate=0.01, parameters=model.parameters())
+    model.prepare(opt, paddle.nn.CrossEntropyLoss())
+    ds = RandomDataset(n=16)
+    model.fit(ds, epochs=1, batch_size=8, verbose=0, save_dir=str(tmp_path / "ckpt"))
+    assert os.path.exists(tmp_path / "ckpt" / "final.pdparams")
+    assert os.path.exists(tmp_path / "ckpt" / "final.pdopt")
+
+    model2 = paddle.Model(_mlp())
+    opt2 = paddle.optimizer.Adam(learning_rate=0.01, parameters=model2.parameters())
+    model2.prepare(opt2, paddle.nn.CrossEntropyLoss())
+    model2.load(str(tmp_path / "ckpt" / "final"))
+    w1 = model.network.state_dict()
+    w2 = model2.network.state_dict()
+    for k in w1:
+        np.testing.assert_allclose(w1[k].numpy(), w2[k].numpy())
+
+
+def test_early_stopping_stops():
+    model = paddle.Model(_mlp())
+    opt = paddle.optimizer.SGD(learning_rate=0.0, parameters=model.parameters())
+    model.prepare(opt, paddle.nn.CrossEntropyLoss(), Accuracy())
+    ds = RandomDataset(n=16)
+    es = paddle.callbacks.EarlyStopping(monitor="loss", patience=0, verbose=0, save_best_model=False)
+    model.fit(ds, eval_data=ds, epochs=10, batch_size=8, verbose=0, callbacks=[es])
+    # lr=0 -> no improvement -> must stop well before 10 epochs
+    assert model.stop_training
+
+
+def test_summary():
+    net = _mlp()
+    res = paddle.summary(net, (1, 8))
+    # 8*16+16 + 16*4+4 = 212
+    assert res["total_params"] == 212
+    assert res["trainable_params"] == 212
+
+
+def test_visualdl_jsonl(tmp_path):
+    model = paddle.Model(_mlp())
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+    model.prepare(opt, paddle.nn.CrossEntropyLoss())
+    ds = RandomDataset(n=16)
+    cb = paddle.callbacks.VisualDL(log_dir=str(tmp_path / "vdl"))
+    model.fit(ds, epochs=1, batch_size=8, verbose=0, callbacks=[cb])
+    assert os.path.exists(tmp_path / "vdl" / "scalars.jsonl")
+
+
+def test_reduce_lr_on_plateau():
+    model = paddle.Model(_mlp())
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+    model.prepare(opt, paddle.nn.CrossEntropyLoss())
+    ds = RandomDataset(n=16)
+    cb = paddle.callbacks.ReduceLROnPlateau(monitor="loss", factor=0.5, patience=0, verbose=0, cooldown=0)
+    # force "no improvement": two evals with the same data and lr applied
+    cb.set_model(model)
+    cb.best = -np.inf  # any observed loss counts as non-improvement (mode=min->best starts inf; set to -inf)
+    cb.monitor_op = lambda a, b: False
+    cb.on_eval_end({"loss": 1.0})
+    assert abs(opt.get_lr() - 0.05) < 1e-7
