@@ -149,6 +149,7 @@ from . import incubate  # noqa: E402,F401
 from .framework.io import load, save  # noqa: E402,F401
 from .jit import to_static  # noqa: E402,F401
 from . import hapi  # noqa: E402,F401
+from . import profiler  # noqa: E402,F401
 from . import callbacks  # noqa: E402,F401
 from .hapi import Model, summary  # noqa: E402,F401
 

@@ -384,14 +384,18 @@ class ReduceLROnPlateau(Callback):
             self.wait += 1
             if self.wait >= self.patience:
                 sched = getattr(opt, "_lr_scheduler", None)
+                if sched is not None:
+                    # an LRScheduler recomputes last_lr every step, which would
+                    # undo the reduction — same limitation as the reference
+                    # (hapi ReduceLROnPlateau requires a float learning rate)
+                    import warnings
+
+                    warnings.warn("ReduceLROnPlateau requires a float learning_rate, not an LRScheduler; skipped.")
+                    return
                 old_lr = opt.get_lr()
                 if old_lr > np.float32(self.min_lr):
                     new_lr = max(old_lr * self.factor, self.min_lr)
-                    if sched is not None:
-                        sched.last_lr = new_lr
-                        opt._sync_lr()
-                    else:
-                        opt.set_lr(new_lr)
+                    opt.set_lr(new_lr)
                     if self.verbose > 0:
                         print(f"Epoch {self.epoch + 1}: ReduceLROnPlateau reducing learning rate to {new_lr}.")
                 self.cooldown_counter = self.cooldown

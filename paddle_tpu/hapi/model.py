@@ -43,6 +43,7 @@ class Model:
         self._loss = None
         self._metrics = []
         self._scaler = None
+        self._amp_level = None
         self.stop_training = False
 
     # ---- preparation ----
@@ -132,6 +133,20 @@ class Model:
         inputs = _to_tensor_list(inputs)
         outputs = self._run_forward(inputs)
         return [o.numpy() for o in outputs]
+
+    def _split_batch(self, batch):
+        """Split a loader batch into (inputs, labels): declared specs first,
+        then the single-input-plus-label convention when a loss is prepared
+        (multi-input nets must declare inputs=, as in the reference)."""
+        if self._inputs:
+            ni = len(self._inputs)
+        elif self._labels:
+            ni = len(batch) - len(self._labels)
+        elif self._loss is not None and len(batch) > 1:
+            ni = len(batch) - 1
+        else:
+            ni = len(batch)
+        return batch[:ni], batch[ni:]
 
     def _update_metrics(self, outputs, labels):
         metric_vals = []
@@ -239,16 +254,7 @@ class Model:
         outputs = []
         count = 0
         for step, batch in enumerate(loader):
-            batch = _to_list(batch)
-            # datasets that also yield labels: keep only the input slice
-            if self._inputs:
-                batch = batch[: len(self._inputs)]
-            elif self._labels:
-                batch = batch[: len(batch) - len(self._labels)]
-            elif self._loss is not None and len(batch) == 2:
-                # single-input + label convention; multi-input nets must
-                # declare inputs= specs (same requirement as the reference)
-                batch = batch[:1]
+            batch, _ = self._split_batch(_to_list(batch))
             cbks.on_predict_batch_begin(step)
             out = self.predict_batch(batch)
             outputs.append(out)
@@ -271,18 +277,7 @@ class Model:
         for step, batch in enumerate(data_loader):
             if num_iters is not None and step >= num_iters:
                 break
-            batch = _to_list(batch)
-            # split inputs/labels: loss consumes (outputs + labels); the
-            # reference splits by declared specs, defaulting to last-is-label
-            if self._inputs:
-                ni = len(self._inputs)
-            elif self._labels:
-                ni = len(batch) - len(self._labels)
-            elif self._loss is not None and len(batch) > 1:
-                ni = len(batch) - 1
-            else:
-                ni = len(batch)
-            inputs, labels = batch[:ni], batch[ni:]
+            inputs, labels = self._split_batch(_to_list(batch))
             bs = inputs[0].shape[0] if inputs and len(getattr(inputs[0], "shape", ())) else 1
             callbacks._call(f"on_{mode}_batch_begin", step)
             if mode == "train":
@@ -312,7 +307,11 @@ class Model:
             callbacks._call(f"on_{mode}_batch_end", step, dict(logs))
         if pending_update:
             # flush gradients accumulated past the last full accumulation window
-            self._optimizer.step()
+            if self._scaler is not None:
+                self._scaler.step(self._optimizer)
+                self._scaler.update()
+            else:
+                self._optimizer.step()
             self._optimizer.clear_grad()
         logs["samples"] = count
         # final accumulated metrics

@@ -76,9 +76,10 @@ def summary(net: Layer, input_size=None, dtypes=None, input=None):
 
         hooks.append(layer.register_forward_post_hook(hook))
 
+    # hook every sublayer: leaves report their own params; composite layers
+    # report only direct (non-sublayer) params, deduped via `counted`
     for _, sub in net.named_sublayers(include_self=False):
-        if not list(sub.children()):  # leaves only, like the reference table
-            register(sub, "")
+        register(sub, "")
     if not hooks:
         register(net, "")
 

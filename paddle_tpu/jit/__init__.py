@@ -1,2 +1,3 @@
 """paddle.jit namespace (python/paddle/jit/__init__.py)."""
 from .api import StaticFunction, cond, ignore_module, not_to_static, to_static  # noqa: F401
+from .save_load import TranslatedLayer, load, save  # noqa: F401

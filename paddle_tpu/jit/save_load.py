@@ -1,0 +1,128 @@
+"""jit.save / jit.load — inference model export.
+
+Reference parity: python/paddle/jit/api.py `jit.save`/`jit.load` +
+`translated_layer.py` (TranslatedLayer runs a saved program without the
+original Python class). TPU-native: the "program" is a serialized
+jax.export artifact (StableHLO bytes, portable across processes and
+hardware generations) instead of a ProgramDesc; weights are captured as
+constants in the exported module, and the state_dict is additionally
+saved beside it so the artifact can seed further training.
+
+Layout on disk for `save(layer, "path/model")`:
+  path/model.pdmodel   — jax.export serialized StableHLO (bytes)
+  path/model.pdiparams — state_dict pickle (framework.io format)
+  path/model.pdmeta    — input specs + output tree metadata (pickle)
+"""
+from __future__ import annotations
+
+import pickle
+
+import jax
+import jax.numpy as jnp
+from jax import export as jax_export
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..framework import dtype as dtype_mod
+from ..framework import io as fio
+from ..nn.layer import Layer
+
+
+def _resolve_input_specs(layer, input_spec):
+    from ..static import InputSpec
+
+    specs = []
+    for s in input_spec:
+        if isinstance(s, InputSpec):
+            shape = tuple(1 if d in (-1, None) else int(d) for d in s.shape)
+            specs.append(jax.ShapeDtypeStruct(shape, dtype_mod.convert_dtype(s.dtype)))
+        elif isinstance(s, Tensor):
+            specs.append(jax.ShapeDtypeStruct(tuple(s.shape), s._value.dtype))
+        elif isinstance(s, jax.ShapeDtypeStruct):
+            specs.append(s)
+        else:
+            raise TypeError(f"input_spec entries must be InputSpec/Tensor, got {type(s)}")
+    return specs
+
+
+def save(layer, path, input_spec=None, **configs):
+    """Export `layer.forward` (or a plain function) for inference.
+
+    input_spec: list of static.InputSpec or example Tensors. Required unless
+    the layer was called through to_static and retains example shapes.
+    """
+    fn = layer.forward if isinstance(layer, Layer) else layer
+    if input_spec is None:
+        raise ValueError("jit.save requires input_spec (shapes to export for)")
+    specs = _resolve_input_specs(layer, input_spec)
+
+    if isinstance(layer, Layer):
+        layer.eval()
+
+    out_meta = {}
+
+    def pure(*raw_inputs):
+        inputs = [Tensor(r) for r in raw_inputs]
+        with jax.disable_jit(False):
+            out = fn(*inputs)
+        leaves, treedef = jax.tree_util.tree_flatten(
+            out, is_leaf=lambda x: isinstance(x, Tensor)
+        )
+        out_meta["treedef"] = treedef
+        return tuple(l._value if isinstance(l, Tensor) else jnp.asarray(l) for l in leaves)
+
+    exported = jax_export.export(jax.jit(pure))(*specs)
+    blob = exported.serialize()
+
+    with open(path + ".pdmodel", "wb") as f:
+        f.write(blob)
+    if isinstance(layer, Layer):
+        fio.save(layer.state_dict(), path + ".pdiparams")
+    meta = {
+        "in_shapes": [tuple(s.shape) for s in specs],
+        "in_dtypes": [str(np.dtype(s.dtype)) for s in specs],
+        "n_outputs": len(exported.out_avals),
+    }
+    with open(path + ".pdmeta", "wb") as f:
+        pickle.dump(meta, f)
+    return path
+
+
+class TranslatedLayer(Layer):
+    """A loaded inference program, callable like the original Layer
+    (reference: python/paddle/jit/translated_layer.py)."""
+
+    def __init__(self, exported, meta, state_dict=None):
+        super().__init__()
+        self._exported = exported
+        self._meta = meta
+        self._loaded_state = state_dict or {}
+
+    def forward(self, *inputs):
+        raw = [i._value if isinstance(i, Tensor) else jnp.asarray(i) for i in inputs]
+        out = self._exported.call(*raw)
+        outs = [Tensor(o) for o in (out if isinstance(out, (tuple, list)) else (out,))]
+        return outs[0] if len(outs) == 1 else outs
+
+    def state_dict(self, *a, **kw):
+        return dict(self._loaded_state)
+
+    @property
+    def input_shapes(self):
+        return self._meta.get("in_shapes")
+
+
+def load(path, **configs) -> TranslatedLayer:
+    import os
+
+    with open(path + ".pdmodel", "rb") as f:
+        exported = jax_export.deserialize(f.read())
+    meta = {}
+    if os.path.exists(path + ".pdmeta"):
+        with open(path + ".pdmeta", "rb") as f:
+            meta = pickle.load(f)
+    state = None
+    if os.path.exists(path + ".pdiparams"):
+        state = fio.load(path + ".pdiparams")
+    return TranslatedLayer(exported, meta, state)

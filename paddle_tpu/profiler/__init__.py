@@ -1,0 +1,29 @@
+"""paddle.profiler namespace (reference: python/paddle/profiler/__init__.py)."""
+from .profiler import (  # noqa: F401
+    Profiler,
+    ProfilerState,
+    ProfilerTarget,
+    export_chrome_tracing,
+    export_protobuf,
+    load_profiler_result,
+    make_scheduler,
+)
+from .profiler_statistic import SortedKeys, StatisticData  # noqa: F401
+from .utils import RecordEvent, TracerEventType, in_profiler_mode, wrap_optimizers  # noqa: F401
+from .timer import benchmark  # noqa: F401
+
+__all__ = [
+    "Profiler",
+    "ProfilerState",
+    "ProfilerTarget",
+    "make_scheduler",
+    "export_chrome_tracing",
+    "export_protobuf",
+    "load_profiler_result",
+    "SortedKeys",
+    "RecordEvent",
+    "TracerEventType",
+    "in_profiler_mode",
+    "wrap_optimizers",
+    "benchmark",
+]

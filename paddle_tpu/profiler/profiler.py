@@ -1,0 +1,266 @@
+"""Profiler orchestration over host events + XLA's xplane device tracer.
+
+Reference parity: python/paddle/profiler/profiler.py — `Profiler` (:346) with
+the CLOSED/READY/RECORD(_AND_RETURN) state machine (:79), `make_scheduler`,
+`export_chrome_tracing` callbacks, `profiler.step()` driving state
+transitions. TPU-native: the device tracer is jax.profiler (XLA xplane dumps,
+viewable in TensorBoard/XProf) instead of CUPTI; host spans are recorded by
+utils.RecordEvent and exported as chrome://tracing JSON.
+"""
+from __future__ import annotations
+
+import json
+import os
+import socket
+import time
+from enum import Enum
+from typing import Callable, Iterable, Optional, Union
+
+from .utils import TracerEventType, _disable_host_tracer, _enable_host_tracer, RecordEvent
+from .profiler_statistic import StatisticData, SortedKeys, _build_summary_table
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3  # last step of a record window: collect + callback
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1  # accepted for API compat; maps to the accelerator target
+    TPU = 2
+    CUSTOM_DEVICE = 3
+
+
+def make_scheduler(
+    *, closed: int, ready: int, record: int, repeat: int = 0, skip_first: int = 0
+) -> Callable[[int], ProfilerState]:
+    """python/paddle/profiler/profiler.py make_scheduler parity: cycle of
+    [closed, ready, record] phases, repeated `repeat` times (0 = forever),
+    after skipping `skip_first` steps."""
+    num_cycle = closed + ready + record
+
+    def getter(step: int) -> ProfilerState:
+        assert step >= 0
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        step -= skip_first
+        period_index = step // num_cycle
+        if repeat > 0 and period_index >= repeat:
+            return ProfilerState.CLOSED
+        pos = step % num_cycle
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos < num_cycle - 1:
+            return ProfilerState.RECORD
+        return ProfilerState.RECORD_AND_RETURN
+
+    return getter
+
+
+def _default_state_scheduler(step: int) -> ProfilerState:
+    return ProfilerState.RECORD
+
+
+def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None) -> Callable:
+    """on_trace_ready callback writing chrome://tracing JSON per record window."""
+
+    def handle_fn(prof: "Profiler"):
+        nonlocal worker_name
+        if not worker_name:
+            worker_name = f"host_{socket.gethostname()}pid_{os.getpid()}"
+        os.makedirs(dir_name, exist_ok=True)
+        filename = f"{worker_name}_time_{time.strftime('%Y_%m_%d_%H_%M_%S')}.paddle_trace.json"
+        prof.export(os.path.join(dir_name, filename), format="json")
+
+    return handle_fn
+
+
+def export_protobuf(dir_name: str, worker_name: Optional[str] = None) -> Callable:
+    """Reference exports a protobuf; the xplane .pb from jax.profiler plays
+    that role (written to <dir>/plugins/profile by the device tracer). The
+    host events are still dumped as JSON next to it."""
+    return export_chrome_tracing(dir_name, worker_name)
+
+
+def _has_tpu() -> bool:
+    import jax
+
+    try:
+        return jax.devices()[0].platform != "cpu"
+    except Exception:
+        return False
+
+
+class Profiler:
+    """paddle.profiler.Profiler parity (profiler.py:346).
+
+    with Profiler(targets=[ProfilerTarget.CPU, ProfilerTarget.TPU],
+                  scheduler=(2, 5)) as p:
+        for it in loop:
+            train_step()
+            p.step()
+    """
+
+    def __init__(
+        self,
+        *,
+        targets: Optional[Iterable[ProfilerTarget]] = None,
+        scheduler: Union[Callable[[int], ProfilerState], tuple, None] = None,
+        on_trace_ready: Optional[Callable] = None,
+        record_shapes: bool = False,
+        profile_memory: bool = False,
+        timer_only: bool = False,
+        emit_nvtx: bool = False,  # API compat; no NVTX on TPU
+        custom_device_types: list = [],
+        with_flops: bool = False,
+    ):
+        if targets is None:
+            targets = [ProfilerTarget.CPU]
+            if _has_tpu():
+                targets.append(ProfilerTarget.TPU)
+        self.targets = list(targets)
+        self._device_tracing = any(
+            t in (ProfilerTarget.TPU, ProfilerTarget.GPU, ProfilerTarget.CUSTOM_DEVICE) for t in self.targets
+        )
+        if scheduler is None:
+            self._scheduler = _default_state_scheduler
+        elif isinstance(scheduler, (tuple, list)):
+            start, end = scheduler
+            start = max(start, 0)
+            self._scheduler = make_scheduler(closed=max(start - 1, 0), ready=min(start, 1), record=end - start, repeat=1)
+        else:
+            self._scheduler = scheduler
+        self.on_trace_ready = on_trace_ready
+        self.timer_only = timer_only
+        self.record_shapes = record_shapes
+        self.profile_memory = profile_memory
+        self.step_num = 0
+        self.current_state = ProfilerState.CLOSED
+        self.profiler_result = None
+        self._trace_dir = None
+        self._device_trace_active = False
+        self._step_record: Optional[RecordEvent] = None
+        self._timer = None
+
+    # ---- lifecycle ----
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def start(self):
+        from . import timer as timer_mod
+
+        self._timer = timer_mod.benchmark()
+        self._timer.begin()
+        if self.timer_only:
+            return
+        self.current_state = self._scheduler(self.step_num)
+        if self.current_state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
+            self._start_tracers()
+        self._step_record = RecordEvent(f"ProfileStep#{self.step_num}", TracerEventType.ProfileStep)
+        self._step_record.begin()
+
+    def stop(self):
+        if self._timer is not None:
+            self._timer.end()
+        if self.timer_only:
+            return
+        if self._step_record is not None:
+            self._step_record.end()
+            self._step_record = None
+        if self.current_state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
+            self._collect()
+            if self.on_trace_ready:
+                self.on_trace_ready(self)
+        self.current_state = ProfilerState.CLOSED
+
+    def step(self, num_samples: Optional[int] = None):
+        if self._timer is not None:
+            self._timer.step(num_samples)
+        if self.timer_only:
+            return
+        if self._step_record is not None:
+            self._step_record.end()
+        prev = self.current_state
+        self.step_num += 1
+        self.current_state = self._scheduler(self.step_num)
+        self._transition(prev, self.current_state)
+        self._step_record = RecordEvent(f"ProfileStep#{self.step_num}", TracerEventType.ProfileStep)
+        self._step_record.begin()
+
+    def step_info(self, unit=None):
+        if self._timer is None:
+            return ""
+        return self._timer.step_info(unit)
+
+    # ---- state transitions ----
+    def _transition(self, prev: ProfilerState, new: ProfilerState):
+        recording = prev in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
+        will_record = new in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
+        if prev == ProfilerState.RECORD_AND_RETURN:
+            # window closes at the step boundary: collect + fire callback
+            self._collect()
+            if self.on_trace_ready:
+                self.on_trace_ready(self)
+            recording = False
+        if will_record and not recording:
+            self._start_tracers()
+        elif recording and not will_record:
+            self._collect()
+            if self.on_trace_ready:
+                self.on_trace_ready(self)
+
+    def _start_tracers(self):
+        _enable_host_tracer()
+        if self._device_tracing and not self._device_trace_active:
+            import jax
+
+            self._trace_dir = self._trace_dir or os.path.join(
+                os.environ.get("PADDLE_TPU_PROFILE_DIR", "/tmp/paddle_tpu_profile"),
+                time.strftime("%Y%m%d_%H%M%S"),
+            )
+            try:
+                jax.profiler.start_trace(self._trace_dir)
+                self._device_trace_active = True
+            except Exception:
+                self._device_trace_active = False  # tracer busy / unsupported
+
+    def _collect(self):
+        events = _disable_host_tracer()
+        if self._device_trace_active:
+            import jax
+
+            try:
+                jax.profiler.stop_trace()
+            finally:
+                self._device_trace_active = False
+        self.profiler_result = StatisticData(events, device_trace_dir=self._trace_dir)
+
+    # ---- reporting ----
+    def export(self, path: str, format: str = "json"):
+        if self.profiler_result is None:
+            return
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(self.profiler_result.to_chrome_trace(), f)
+
+    def summary(self, sorted_by=SortedKeys.CPUTotal, op_detail=True, thread_sep=False, time_unit="ms"):
+        if self.profiler_result is None:
+            return
+        print(_build_summary_table(self.profiler_result, sorted_by=sorted_by, time_unit=time_unit))
+
+
+def load_profiler_result(filename: str):
+    with open(filename) as f:
+        return json.load(f)

@@ -1,0 +1,113 @@
+"""Aggregation + summary tables over collected host events.
+
+Reference parity: python/paddle/profiler/profiler_statistic.py (SortedKeys,
+summary tables printed by Profiler.summary) and chrometracing_logger.cc's
+chrome://tracing JSON export.
+"""
+from __future__ import annotations
+
+from collections import defaultdict
+from enum import Enum
+
+
+class SortedKeys(Enum):
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4  # API compat: device times live in the xplane dump
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+class EventSummary:
+    __slots__ = ("name", "call", "total_ns", "max_ns", "min_ns")
+
+    def __init__(self, name):
+        self.name = name
+        self.call = 0
+        self.total_ns = 0
+        self.max_ns = 0
+        self.min_ns = None
+
+    def add(self, dur_ns):
+        self.call += 1
+        self.total_ns += dur_ns
+        self.max_ns = max(self.max_ns, dur_ns)
+        self.min_ns = dur_ns if self.min_ns is None else min(self.min_ns, dur_ns)
+
+    @property
+    def avg_ns(self):
+        return self.total_ns / self.call if self.call else 0
+
+
+class StatisticData:
+    """Collected result for one record window: host events + the directory
+    holding the XLA xplane protobuf (device timeline, open with XProf)."""
+
+    def __init__(self, host_events, device_trace_dir=None):
+        self.host_events = list(host_events)
+        self.device_trace_dir = device_trace_dir
+
+    def event_summaries(self):
+        table = {}
+        for ev in self.host_events:
+            s = table.get(ev.name)
+            if s is None:
+                s = table[ev.name] = EventSummary(ev.name)
+            s.add(ev.duration_ns)
+        return table
+
+    def to_chrome_trace(self):
+        events = []
+        for ev in self.host_events:
+            events.append(
+                {
+                    "name": ev.name,
+                    "cat": ev.event_type,
+                    "ph": "X",
+                    "ts": ev.start_ns / 1e3,  # chrome tracing uses microseconds
+                    "dur": ev.duration_ns / 1e3,
+                    "pid": 0,
+                    "tid": ev.tid,
+                }
+            )
+        meta = {"device_trace_dir": self.device_trace_dir}
+        return {"traceEvents": events, "metadata": meta}
+
+
+_UNIT_DIV = {"s": 1e9, "ms": 1e6, "us": 1e3, "ns": 1.0}
+
+_SORT_KEY = {
+    SortedKeys.CPUTotal: lambda s: s.total_ns,
+    SortedKeys.CPUAvg: lambda s: s.avg_ns,
+    SortedKeys.CPUMax: lambda s: s.max_ns,
+    SortedKeys.CPUMin: lambda s: s.min_ns or 0,
+    SortedKeys.GPUTotal: lambda s: s.total_ns,
+    SortedKeys.GPUAvg: lambda s: s.avg_ns,
+    SortedKeys.GPUMax: lambda s: s.max_ns,
+    SortedKeys.GPUMin: lambda s: s.min_ns or 0,
+}
+
+
+def _build_summary_table(data: StatisticData, sorted_by=SortedKeys.CPUTotal, time_unit="ms"):
+    div = _UNIT_DIV.get(time_unit, 1e6)
+    rows = sorted(data.event_summaries().values(), key=_SORT_KEY[sorted_by], reverse=True)
+    name_w = max([len(r.name) for r in rows] + [20]) + 2
+    lines = []
+    total = sum(r.total_ns for r in rows)
+    lines.append("-" * (name_w + 58))
+    lines.append(
+        f"{'Name':<{name_w}}{'Calls':>8}{'Total(' + time_unit + ')':>14}{'Avg(' + time_unit + ')':>12}{'Max(' + time_unit + ')':>12}{'Ratio(%)':>10}"
+    )
+    lines.append("=" * (name_w + 58))
+    for r in rows:
+        ratio = 100.0 * r.total_ns / total if total else 0.0
+        lines.append(
+            f"{r.name:<{name_w}}{r.call:>8}{r.total_ns / div:>14.4f}{r.avg_ns / div:>12.4f}{r.max_ns / div:>12.4f}{ratio:>10.2f}"
+        )
+    lines.append("-" * (name_w + 58))
+    if data.device_trace_dir:
+        lines.append(f"Device timeline (xplane): {data.device_trace_dir}")
+    return "\n".join(lines)

@@ -1,0 +1,83 @@
+"""Throughput benchmark hooks.
+
+Reference parity: python/paddle/profiler/timer.py — `benchmark()` singleton
+with begin/step/end driven by Profiler (or directly by training loops);
+reports reader cost, batch cost and ips (items/sec) with warmup discarding,
+as the reference's hapi/fleet logs do.
+"""
+from __future__ import annotations
+
+import time
+
+
+class Stat:
+    def __init__(self, skip_n=10):
+        self.reset()
+        self.skip_n = skip_n  # discard first steps: compile + warmup
+
+    def reset(self):
+        self.total = 0.0
+        self.count = 0
+        self.skipped = 0
+
+    def update(self, v):
+        if self.skipped < self.skip_n:
+            self.skipped += 1
+            return
+        self.total += v
+        self.count += 1
+
+    @property
+    def avg(self):
+        return self.total / self.count if self.count else 0.0
+
+
+class Benchmark:
+    def __init__(self):
+        self.reader_cost = Stat()
+        self.batch_cost = Stat()
+        self.ips_stat = Stat()
+        self._last_step_t = None
+        self._reader_t = None
+        self.num_samples = None
+        self.running = False
+
+    def begin(self):
+        self.running = True
+        self._last_step_t = time.perf_counter()
+
+    def before_reader(self):
+        self._reader_t = time.perf_counter()
+
+    def after_reader(self):
+        if self._reader_t is not None:
+            self.reader_cost.update(time.perf_counter() - self._reader_t)
+            self._reader_t = None
+
+    def step(self, num_samples=None):
+        if not self.running:
+            return
+        now = time.perf_counter()
+        dt = now - self._last_step_t
+        self._last_step_t = now
+        self.batch_cost.update(dt)
+        self.num_samples = num_samples
+        if num_samples is not None and dt > 0:
+            self.ips_stat.update(num_samples / dt)
+
+    def end(self):
+        self.running = False
+
+    def step_info(self, unit=None):
+        msg = f"reader_cost: {self.reader_cost.avg:.5f} s, batch_cost: {self.batch_cost.avg:.5f} s"
+        if self.ips_stat.count:
+            u = unit or "samples/sec"
+            msg += f", ips: {self.ips_stat.avg:.5f} {u}"
+        return msg
+
+
+_benchmark = Benchmark()
+
+
+def benchmark() -> Benchmark:
+    return _benchmark

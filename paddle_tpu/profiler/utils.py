@@ -1,0 +1,116 @@
+"""Host-side event recording + throughput benchmark.
+
+Reference parity: python/paddle/profiler/utils.py (RecordEvent, in_profiler_mode)
+and the host tracer side of paddle/fluid/platform/profiler/host_tracer.cc. The
+device side is XLA's own xplane tracer (jax.profiler), wired in profiler.py —
+host events here capture Python-level spans (dataloader, forward, backward,
+optimizer) the way the reference's RecordEvent instruments its Python loops.
+"""
+from __future__ import annotations
+
+import functools
+import threading
+import time
+from typing import List, Optional
+
+_state = threading.local()
+_global = {"enabled": False, "events": None, "lock": threading.Lock(), "start_ns": 0}
+
+
+class TracerEventType:
+    # mirrors paddle/fluid/platform/profiler/trace_event.h enum
+    Operator = "Operator"
+    Dataloader = "Dataloader"
+    ProfileStep = "ProfileStep"
+    Forward = "Forward"
+    Backward = "Backward"
+    Optimization = "Optimization"
+    PythonOp = "PythonOp"
+    PythonUserDefined = "PythonUserDefined"
+    UserDefined = "UserDefined"
+    Communication = "Communication"
+
+
+class HostEvent:
+    __slots__ = ("name", "event_type", "start_ns", "end_ns", "tid")
+
+    def __init__(self, name, event_type, start_ns, end_ns, tid):
+        self.name = name
+        self.event_type = event_type
+        self.start_ns = start_ns
+        self.end_ns = end_ns
+        self.tid = tid
+
+    @property
+    def duration_ns(self):
+        return self.end_ns - self.start_ns
+
+
+def in_profiler_mode():
+    return _global["enabled"]
+
+
+def _enable_host_tracer():
+    with _global["lock"]:
+        _global["events"] = []
+        _global["start_ns"] = time.perf_counter_ns()
+        _global["enabled"] = True
+
+
+def _disable_host_tracer() -> List[HostEvent]:
+    with _global["lock"]:
+        _global["enabled"] = False
+        events, _global["events"] = _global["events"], None
+    return events or []
+
+
+class RecordEvent:
+    """Context manager / decorator that records a named host span while a
+    Profiler is active (python/paddle/profiler/utils.py:RecordEvent)."""
+
+    def __init__(self, name: str, event_type: str = TracerEventType.PythonUserDefined):
+        self.name = name
+        self.event_type = event_type
+        self._begin_ns: Optional[int] = None
+
+    def begin(self):
+        if not _global["enabled"]:
+            return
+        self._begin_ns = time.perf_counter_ns()
+
+    def end(self):
+        if self._begin_ns is None or not _global["enabled"]:
+            return
+        ev = HostEvent(
+            self.name,
+            self.event_type,
+            self._begin_ns,
+            time.perf_counter_ns(),
+            threading.get_ident(),
+        )
+        with _global["lock"]:
+            if _global["events"] is not None:
+                _global["events"].append(ev)
+        self._begin_ns = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+    def __call__(self, fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with RecordEvent(self.name, self.event_type):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+
+def wrap_optimizers():
+    """Reference hook point: auto-instrument Optimizer.step under profiling.
+    Our RecordEvent is cheap enough that hapi/timer call sites opt in directly."""
+    return None

@@ -134,3 +134,39 @@ def test_nested_output_structure():
     out = f(paddle.ones([2]))  # compiled path
     assert out["b"][1] == 3.5
     np.testing.assert_allclose(out["a"].numpy(), [2, 2])
+
+
+def test_jit_save_load_roundtrip(tmp_path):
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.static import InputSpec
+
+    net = paddle.nn.Sequential(paddle.nn.Linear(4, 8), paddle.nn.ReLU(), paddle.nn.Linear(8, 2))
+    net.eval()
+    path = str(tmp_path / "export" / "model")
+    import os
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    paddle.jit.save(net, path, input_spec=[InputSpec([2, 4], "float32")])
+    assert os.path.exists(path + ".pdmodel")
+    assert os.path.exists(path + ".pdiparams")
+
+    loaded = paddle.jit.load(path)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(2, 4).astype("float32"))
+    np.testing.assert_allclose(loaded(x).numpy(), net(x).numpy(), rtol=1e-5, atol=1e-5)
+
+
+def test_model_save_inference(tmp_path):
+    import os
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.static import InputSpec
+
+    net = paddle.nn.Linear(4, 2)
+    model = paddle.Model(net, inputs=[InputSpec([1, 4], "float32")])
+    model.prepare()
+    path = str(tmp_path / "infer")
+    model.save(path, training=False)
+    assert os.path.exists(path + ".pdmodel")
+    loaded = paddle.jit.load(path)
+    x = paddle.to_tensor(np.ones((1, 4), "float32"))
+    np.testing.assert_allclose(loaded(x).numpy(), net(x).numpy(), rtol=1e-5)

@@ -1,0 +1,110 @@
+"""Profiler state machine, RecordEvent spans, chrome export, timer."""
+import json
+import os
+import time
+
+import paddle_tpu as paddle
+from paddle_tpu.profiler import (
+    Profiler,
+    ProfilerState,
+    ProfilerTarget,
+    RecordEvent,
+    SortedKeys,
+    make_scheduler,
+)
+
+
+def test_make_scheduler_states():
+    sched = make_scheduler(closed=1, ready=1, record=2, repeat=1, skip_first=1)
+    states = [sched(i) for i in range(6)]
+    assert states == [
+        ProfilerState.CLOSED,  # skip_first
+        ProfilerState.CLOSED,
+        ProfilerState.READY,
+        ProfilerState.RECORD,
+        ProfilerState.RECORD_AND_RETURN,
+        ProfilerState.CLOSED,  # repeat exhausted
+    ]
+
+
+def test_profiler_records_host_events(tmp_path):
+    collected = []
+
+    def on_ready(prof):
+        collected.append(prof.profiler_result)
+
+    with Profiler(
+        targets=[ProfilerTarget.CPU],
+        scheduler=make_scheduler(closed=0, ready=0, record=3, repeat=1),
+        on_trace_ready=on_ready,
+    ) as p:
+        for _ in range(4):
+            with RecordEvent("my_span"):
+                time.sleep(0.001)
+            p.step()
+    assert collected
+    events = collected[0].host_events
+    names = {e.name for e in events}
+    assert "my_span" in names
+    spans = [e for e in events if e.name == "my_span"]
+    assert all(e.duration_ns >= 1_000_000 for e in spans)
+
+
+def test_chrome_trace_export(tmp_path):
+    out = str(tmp_path / "trace")
+    with Profiler(
+        targets=[ProfilerTarget.CPU],
+        on_trace_ready=paddle.profiler.export_chrome_tracing(out),
+    ) as p:
+        with RecordEvent("work"):
+            pass
+        p.step()
+    files = os.listdir(out)
+    assert any(f.endswith(".json") for f in files)
+    with open(os.path.join(out, files[0])) as f:
+        trace = json.load(f)
+    assert any(ev["name"] == "work" for ev in trace["traceEvents"])
+
+
+def test_summary_table(capsys):
+    with Profiler(targets=[ProfilerTarget.CPU]) as p:
+        with RecordEvent("alpha"):
+            pass
+        with RecordEvent("beta"):
+            pass
+    p.summary(sorted_by=SortedKeys.CPUTotal)
+    out = capsys.readouterr().out
+    assert "alpha" in out and "beta" in out and "Calls" in out
+
+
+def test_record_event_noop_when_closed():
+    # no profiler active: RecordEvent must be a cheap no-op
+    with RecordEvent("outside"):
+        pass
+    assert not paddle.profiler.in_profiler_mode()
+
+
+def test_timer_only_step_info():
+    with Profiler(timer_only=True) as p:
+        for _ in range(3):
+            p.step(num_samples=8)
+        info = p.step_info()
+    assert "batch_cost" in info
+
+
+def test_timer_benchmark_ips():
+    b = paddle.profiler.benchmark()
+    b.reader_cost.skip_n = 0
+    b.batch_cost.skip_n = 0
+    b.ips_stat.skip_n = 0
+    b.reader_cost.reset()
+    b.batch_cost.reset()
+    b.ips_stat.reset()
+    b.begin()
+    for _ in range(3):
+        b.before_reader()
+        b.after_reader()
+        b.step(num_samples=4)
+    b.end()
+    assert b.ips_stat.count == 3
+    assert b.ips_stat.avg > 0
