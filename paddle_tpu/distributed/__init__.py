@@ -68,3 +68,4 @@ from .auto_parallel import (  # noqa: F401
     shard_tensor,
     unshard_dtensor,
 )
+from . import checkpoint  # noqa: F401,E402

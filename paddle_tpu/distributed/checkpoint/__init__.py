@@ -1,0 +1,6 @@
+"""paddle.distributed.checkpoint namespace (reference: python/paddle/distributed/checkpoint/)."""
+from .load_state_dict import load_state_dict  # noqa: F401
+from .metadata import LocalTensorMetadata, Metadata, TensorMetadata  # noqa: F401
+from .save_state_dict import save_state_dict  # noqa: F401
+
+__all__ = ["save_state_dict", "load_state_dict", "Metadata", "TensorMetadata", "LocalTensorMetadata"]

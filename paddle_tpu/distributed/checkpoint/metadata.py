@@ -1,0 +1,50 @@
+"""Checkpoint metadata structures.
+
+Reference parity: python/paddle/distributed/checkpoint/metadata.py —
+LocalTensorMetadata/LocalTensorIndex + a global Metadata map describing, for
+every saved tensor, which file holds which slice of the global shape. The
+re-sharding load path (load_state_dict.py) intersects saved slices with the
+slices the target placement needs.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Tuple
+
+
+@dataclass
+class LocalTensorMetadata:
+    """One saved shard: where it sits in the global tensor."""
+
+    global_offset: Tuple[int, ...]
+    local_shape: Tuple[int, ...]
+    dtype: str
+    file_name: str
+
+
+@dataclass
+class TensorMetadata:
+    global_shape: Tuple[int, ...]
+    dtype: str
+    shards: List[LocalTensorMetadata] = field(default_factory=list)
+
+
+@dataclass
+class Metadata:
+    state_dict_metadata: Dict[str, TensorMetadata] = field(default_factory=dict)
+    flat_mapping: Dict[str, str] = field(default_factory=dict)  # structured name aliases
+
+
+def slices_overlap(off_a, shape_a, off_b, shape_b):
+    """Do two hyper-rectangles intersect? Used by the re-sharding loader."""
+    for oa, sa, ob, sb in zip(off_a, shape_a, off_b, shape_b):
+        if oa + sa <= ob or ob + sb <= oa:
+            return False
+    return True
+
+
+def intersection(off_a, shape_a, off_b, shape_b):
+    """Intersection rectangle in global coords: (offset, shape)."""
+    off = tuple(max(oa, ob) for oa, ob in zip(off_a, off_b))
+    end = tuple(min(oa + sa, ob + sb) for oa, sa, ob, sb in zip(off_a, shape_a, off_b, shape_b))
+    return off, tuple(e - o for o, e in zip(off, end))

@@ -1,0 +1,65 @@
+"""Sharded checkpoint save.
+
+Reference parity: python/paddle/distributed/checkpoint/save_state_dict.py:104
+— every rank writes the shards it owns plus one global metadata file mapping
+tensor name → [(global_offset, local_shape, file)]. TPU-native: a "rank"'s
+shards are the jax.Array's addressable shards on this process; replicas are
+deduped with shard.replica_id == 0 so each slice is written exactly once
+across the job (the reference dedupes with its coordinator gather instead).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import jax
+import numpy as np
+
+from ...core.tensor import Tensor
+from .metadata import LocalTensorMetadata, Metadata, TensorMetadata
+
+
+def _flatten_state_dict(state_dict, prefix=""):
+    flat = {}
+    for k, v in state_dict.items():
+        name = f"{prefix}.{k}" if prefix else str(k)
+        if isinstance(v, dict):
+            flat.update(_flatten_state_dict(v, name))
+        else:
+            flat[name] = v
+    return flat
+
+
+def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0, async_save=False):
+    flat = _flatten_state_dict(state_dict)
+    os.makedirs(path, exist_ok=True)
+    proc = jax.process_index()
+    meta = Metadata()
+    file_idx = 0
+    for name, t in flat.items():
+        if not isinstance(t, Tensor):
+            t = Tensor(np.asarray(t))
+        arr = t._value
+        tm = TensorMetadata(global_shape=tuple(arr.shape), dtype=str(np.dtype(arr.dtype)))
+        for shard in arr.addressable_shards:
+            if shard.replica_id != 0:
+                continue  # replicas hold identical bytes; first replica writes
+            offset = tuple(sl.start or 0 for sl in shard.index) if shard.index else ()
+            local = np.asarray(shard.data)
+            fname = f"{proc}_{file_idx}.distcp.npy"
+            file_idx += 1
+            np.save(os.path.join(path, fname), local)
+            tm.shards.append(
+                LocalTensorMetadata(
+                    global_offset=offset,
+                    local_shape=tuple(local.shape),
+                    dtype=tm.dtype,
+                    file_name=fname,
+                )
+            )
+        meta.state_dict_metadata[name] = tm
+    # each process writes its own metadata piece; process 0's piece is merged
+    # with the others at load time (single-host: one file)
+    with open(os.path.join(path, f"{proc}.metadata"), "wb") as f:
+        pickle.dump(meta, f)
+    return path
