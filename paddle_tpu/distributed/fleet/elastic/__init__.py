@@ -1,0 +1,2 @@
+"""Elastic training (reference: python/paddle/distributed/fleet/elastic/)."""
+from .manager import ELASTIC_TIMEOUT, ElasticManager, ElasticStatus  # noqa: F401

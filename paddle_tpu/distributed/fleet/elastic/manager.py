@@ -1,0 +1,87 @@
+"""Elastic node management.
+
+Reference parity: python/paddle/distributed/fleet/elastic/manager.py:124
+ElasticManager — nodes register in a shared store (ETCD there), heartbeat,
+and a watcher detects dead/joined nodes to trigger relaunch with re-ranked
+envs. TPU-native: the store is the launcher's HTTP KV master (master.py);
+liveness is timestamped heartbeats (the KV has no ETCD leases). The launch
+controller consumes scale events by restarting its pod with new ranks —
+note a TPU pod slice is fixed hardware, so elasticity here means node
+replacement (preemption recovery), not arbitrary resize.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+from ...launch.master import KVClient
+
+ELASTIC_TIMEOUT = 30  # heartbeat staleness => node considered dead
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    def __init__(self, endpoint: str, job_id: str, np: int, host: str, timeout: int = ELASTIC_TIMEOUT):
+        self.client = KVClient(endpoint)
+        self.job_id = job_id
+        self.np = np  # expected node count
+        self.host = host
+        self.timeout = timeout
+        self._stop = threading.Event()
+        self._hb_thread = None
+        self.enabled = True
+
+    # ---- registration + heartbeat ----
+    def _key(self, host=None):
+        return f"elastic/{self.job_id}/{(host or self.host).replace(':', '_')}"
+
+    def register(self, interval: float = 3.0):
+        self._heartbeat()
+        self._hb_thread = threading.Thread(target=self._hb_loop, args=(interval,), daemon=True)
+        self._hb_thread.start()
+
+    def _heartbeat(self):
+        self.client.put(self._key(), json.dumps({"host": self.host, "ts": time.time()}))
+
+    def _hb_loop(self, interval):
+        while not self._stop.is_set():
+            self._heartbeat()
+            self._stop.wait(interval)
+
+    def exit(self, completed=True):
+        self._stop.set()
+        if self._hb_thread:
+            self._hb_thread.join(timeout=5)
+
+    # ---- watch ----
+    def alive_nodes(self):
+        now = time.time()
+        nodes = []
+        for k, v in self.client.get_all().items():
+            if not k.startswith(f"/elastic/{self.job_id}/"):
+                continue
+            try:
+                rec = json.loads(v)
+            except Exception:
+                continue
+            if now - rec.get("ts", 0) <= self.timeout:
+                nodes.append(rec["host"])
+        return sorted(nodes)
+
+    def watch(self) -> str:
+        """One poll: HOLD while the world matches np, RESTART when membership
+        changed (dead node aged out or a new node joined)."""
+        nodes = self.alive_nodes()
+        if len(nodes) == self.np and self.host in nodes:
+            return ElasticStatus.HOLD
+        if len(nodes) < self.np:
+            return ElasticStatus.RESTART if self.host in nodes else ElasticStatus.EXIT
+        return ElasticStatus.RESTART

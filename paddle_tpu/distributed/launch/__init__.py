@@ -1,0 +1,5 @@
+"""paddle.distributed.launch namespace (reference: python/paddle/distributed/launch/)."""
+from .controller import CollectiveController, Context  # noqa: F401
+from .job import Container, Pod  # noqa: F401
+from .main import launch, parse_args  # noqa: F401
+from .master import HTTPMaster, KVClient, KVServer  # noqa: F401

@@ -1,0 +1,119 @@
+"""Collective controller: build per-process env, deploy, watch, restart.
+
+Reference parity: python/paddle/distributed/launch/controllers/collective.py
+(:22 CollectiveController.build_pod) + watcher.py (:22 Watcher). The env
+contract matches parallel_env.py: PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM /
+PADDLE_MASTER (+ MASTER_ADDR/PORT), so a launched script's
+init_parallel_env() lands on jax.distributed.initialize. TPU-native default:
+one process per node (nproc_per_node=1) — the controller process drives all
+local chips; the reference's one-proc-per-GPU shape is still available for
+CPU-mesh testing via --nproc_per_node.
+"""
+from __future__ import annotations
+
+import os
+import socket
+import sys
+import time
+
+from .job import Pod
+from .master import HTTPMaster
+
+
+class Context:
+    def __init__(self, args):
+        self.args = args
+
+    def is_master_host(self, host):
+        try:
+            return host in ("127.0.0.1", "localhost", socket.gethostname(), socket.gethostbyname(socket.gethostname()))
+        except Exception:
+            return host in ("127.0.0.1", "localhost")
+
+
+class CollectiveController:
+    def __init__(self, ctx: Context):
+        self.ctx = ctx
+        self.pod = Pod()
+        self.master = None
+
+    # ---- topology ----
+    def _rendezvous(self):
+        args = self.ctx.args
+        if args.nnodes <= 1:
+            return 0
+        self.master = HTTPMaster(self.ctx)
+        endpoint = f"{socket.gethostname()}:{os.getpid()}"
+        _, node_rank = self.master.sync_peers(args.job_id, endpoint, args.nnodes)
+        return node_rank
+
+    def build_pod(self):
+        args = self.ctx.args
+        node_rank = args.node_rank if args.node_rank is not None else self._rendezvous()
+        nproc = args.nproc_per_node
+        world = args.nnodes * nproc
+        if args.master:
+            coord = args.master.replace("http://", "")
+        else:
+            coord = f"127.0.0.1:{args.port}"
+        for local_rank in range(nproc):
+            rank = node_rank * nproc + local_rank
+            env = {
+                "PADDLE_TRAINER_ID": str(rank),
+                "PADDLE_TRAINERS_NUM": str(world),
+                "PADDLE_LOCAL_RANK": str(local_rank),
+                "PADDLE_LOCAL_SIZE": str(nproc),
+                "PADDLE_NNODES": str(args.nnodes),
+                "PADDLE_MASTER": coord,
+                "MASTER_ADDR": coord.rsplit(":", 1)[0],
+                "MASTER_PORT": coord.rsplit(":", 1)[1],
+                "PADDLE_JOB_ID": args.job_id,
+            }
+            if args.devices:
+                env["TPU_VISIBLE_DEVICES"] = args.devices
+                env["CUDA_VISIBLE_DEVICES"] = args.devices
+            out = os.path.join(args.log_dir, f"workerlog.{rank}") if args.log_dir else None
+            entry = [sys.executable, "-u"] + ([args.training_script] if not args.module else ["-m", args.training_script])
+            self.pod.add_container(entry + list(args.training_script_args), env, out)
+        return self.pod
+
+    # ---- run + watch ----
+    def run(self):
+        self.build_pod()
+        self.pod.deploy()
+        code = self.watch()
+        if self.master:
+            self.master.stop()
+        return code
+
+    def watch(self) -> int:
+        """Poll container status (reference watcher.py): on failure either
+        restart the whole pod (elastic, up to max_restart) or tear down."""
+        args = self.ctx.args
+        while True:
+            time.sleep(args.poll_interval)
+            if not self.pod.is_running():
+                failed = self.pod.failed_containers()
+                if not failed:
+                    return 0
+                if args.max_restart > 0 and all(c.restarts < args.max_restart for c in self.pod.containers):
+                    print(f"[launch] {len(failed)} container(s) failed, restarting pod", file=sys.stderr)
+                    for c in self.pod.containers:
+                        c.terminate(force=True)
+                        c.restarts += 1
+                    self.pod.deploy()
+                    continue
+                print(f"[launch] job failed: exit codes {self.pod.exit_codes()}", file=sys.stderr)
+                return 1
+            failed = self.pod.failed_containers()
+            if failed:
+                restartable = args.max_restart > 0 and all(c.restarts < args.max_restart for c in failed)
+                if restartable:
+                    for c in failed:
+                        print(f"[launch] restarting rank {c.env['PADDLE_TRAINER_ID']}", file=sys.stderr)
+                        c.restarts += 1
+                        c.start()
+                else:
+                    print("[launch] container failed, stopping pod", file=sys.stderr)
+                    self.pod.stop(force=True)
+                    return 1

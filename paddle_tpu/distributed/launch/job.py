@@ -1,0 +1,93 @@
+"""Job abstractions for the launcher.
+
+Reference parity: python/paddle/distributed/launch/job/ — Job/Pod/Container.
+A Container is one managed subprocess with its env and log file; a Pod is
+the set of containers on this node. TPU-native default is one container per
+node (the single controller drives every local chip), vs. the reference's
+one-per-GPU.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+
+class Container:
+    def __init__(self, entrypoint: List[str], env: Dict[str, str], out: Optional[str] = None):
+        self.entrypoint = entrypoint
+        self.env = dict(env)
+        self.out = out
+        self.proc: Optional[subprocess.Popen] = None
+        self._log_fh = None
+        self.restarts = 0
+
+    def start(self):
+        full_env = {**os.environ, **self.env}
+        stdout = None
+        if self.out:
+            os.makedirs(os.path.dirname(self.out) or ".", exist_ok=True)
+            self._log_fh = open(self.out, "ab")
+            stdout = self._log_fh
+        self.proc = subprocess.Popen(self.entrypoint, env=full_env, stdout=stdout, stderr=subprocess.STDOUT if stdout else None)
+
+    @property
+    def alive(self):
+        return self.proc is not None and self.proc.poll() is None
+
+    @property
+    def exit_code(self):
+        return None if self.proc is None else self.proc.poll()
+
+    def terminate(self, force=False):
+        if self.proc is None:
+            return
+        if self.alive:
+            self.proc.kill() if force else self.proc.terminate()
+        if self._log_fh:
+            self._log_fh.close()
+            self._log_fh = None
+
+    def wait(self, timeout=None):
+        if self.proc is not None:
+            try:
+                self.proc.wait(timeout)
+            except subprocess.TimeoutExpired:
+                pass
+
+    def __repr__(self):
+        return f"Container(rank={self.env.get('PADDLE_TRAINER_ID')}, alive={self.alive}, exit={self.exit_code})"
+
+
+class Pod:
+    def __init__(self, name: str = None):
+        self.name = name or f"pod_{os.getpid()}"
+        self.containers: List[Container] = []
+
+    def add_container(self, entrypoint, env, out=None):
+        self.containers.append(Container(entrypoint, env, out))
+
+    def deploy(self):
+        for c in self.containers:
+            c.start()
+
+    def is_running(self):
+        return any(c.alive for c in self.containers)
+
+    def failed_containers(self):
+        return [c for c in self.containers if c.exit_code not in (None, 0)]
+
+    def join(self, timeout=None):
+        deadline = None if timeout is None else time.time() + timeout
+        for c in self.containers:
+            t = None if deadline is None else max(0, deadline - time.time())
+            c.wait(t)
+
+    def stop(self, force=False):
+        for c in self.containers:
+            c.terminate(force=force)
+
+    def exit_codes(self):
+        return [c.exit_code for c in self.containers]

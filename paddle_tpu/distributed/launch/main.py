@@ -1,0 +1,40 @@
+"""`python -m paddle_tpu.distributed.launch` CLI.
+
+Reference parity: python/paddle/distributed/launch/main.py:20 — the launch
+entry that builds env per local process, deploys, and watches. Arguments keep
+the reference's names (--nnodes, --nproc_per_node, --master, --log_dir,
+--job_id, --devices, elastic --max_restart).
+"""
+from __future__ import annotations
+
+import argparse
+
+from .controller import CollectiveController, Context
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    p.add_argument("--master", default=None, help="rank-0 rendezvous endpoint host:port (multi-node)")
+    p.add_argument("--nnodes", type=int, default=1)
+    p.add_argument("--nproc_per_node", type=int, default=1, help="TPU default: 1 controller per node")
+    p.add_argument("--node_rank", type=int, default=None, help="explicit node rank (skips rendezvous)")
+    p.add_argument("--job_id", default="default")
+    p.add_argument("--devices", "--gpus", default=None, help="visible device ids, comma separated")
+    p.add_argument("--log_dir", default=None)
+    p.add_argument("--port", type=int, default=10071, help="coordinator port for single-node multi-proc")
+    p.add_argument("--max_restart", type=int, default=0, help="elastic: restarts before giving up")
+    p.add_argument("--poll_interval", type=float, default=1.0)
+    p.add_argument("--module", "-m", action="store_true", help="run script as a python module")
+    p.add_argument("training_script")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def launch(argv=None) -> int:
+    args = parse_args(argv)
+    ctx = Context(args)
+    return CollectiveController(ctx).run()
+
+
+if __name__ == "__main__":
+    raise SystemExit(launch())

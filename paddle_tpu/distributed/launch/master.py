@@ -1,0 +1,143 @@
+"""Multi-node rendezvous masters.
+
+Reference parity: python/paddle/distributed/launch/controllers/master.py —
+HTTPMaster (:73) runs a tiny KV service on the rank-0 node that other nodes
+register with to receive their rank and the full peer list; ETCDMaster
+(:186) is the elastic variant. Here HTTPMaster is a stdlib http.server KV
+store (no brpc); ETCDMaster is gated (etcd3 is not in the TPU image).
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class KVServer:
+    """In-memory KV over HTTP: PUT /key, GET /key, GET /__all__."""
+
+    def __init__(self, port: int):
+        self.port = port
+        self._kv = {}
+        self._lock = threading.Lock()
+        kv, lock = self._kv, self._lock
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # silence request logging
+                pass
+
+            def do_PUT(self):
+                length = int(self.headers.get("Content-Length", 0))
+                value = self.rfile.read(length)
+                with lock:
+                    kv[self.path] = value
+                self.send_response(200)
+                self.end_headers()
+
+            def do_GET(self):
+                with lock:
+                    if self.path == "/__all__":
+                        body = json.dumps({k: v.decode() for k, v in kv.items()}).encode()
+                    elif self.path in kv:
+                        body = kv[self.path]
+                    else:
+                        self.send_response(404)
+                        self.end_headers()
+                        return
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_DELETE(self):
+                with lock:
+                    kv.pop(self.path, None)
+                self.send_response(200)
+                self.end_headers()
+
+        self._server = ThreadingHTTPServer(("", port), Handler)
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+
+    def start(self):
+        self._thread.start()
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class KVClient:
+    def __init__(self, endpoint: str):
+        if not endpoint.startswith("http"):
+            endpoint = "http://" + endpoint
+        self.endpoint = endpoint.rstrip("/")
+
+    def put(self, key: str, value: str) -> bool:
+        try:
+            req = urllib.request.Request(f"{self.endpoint}/{key.lstrip('/')}", data=value.encode(), method="PUT")
+            with urllib.request.urlopen(req, timeout=5) as r:
+                return r.status == 200
+        except Exception:
+            return False
+
+    def get(self, key: str):
+        try:
+            with urllib.request.urlopen(f"{self.endpoint}/{key.lstrip('/')}", timeout=5) as r:
+                return r.read().decode()
+        except Exception:
+            return None
+
+    def get_all(self):
+        v = self.get("__all__")
+        return json.loads(v) if v else {}
+
+
+class Master:
+    def __init__(self, ctx):
+        self.ctx = ctx
+
+    @classmethod
+    def factory(cls, ctx):
+        if ctx.args.master and ctx.args.master.startswith("etcd://"):
+            raise RuntimeError("ETCDMaster requires etcd3, which is not in the TPU image; use http:// master")
+        return HTTPMaster(ctx)
+
+
+class HTTPMaster(Master):
+    """Node-level rendezvous: every node PUTs its endpoint, polls until
+    nnodes endpoints arrive, and takes its sorted position as node rank."""
+
+    def __init__(self, ctx):
+        super().__init__(ctx)
+        self.server = None
+        self.client = None
+
+    def lazy_init(self):
+        addr = self.ctx.args.master  # host:port of node 0
+        host, port = addr.split(":")
+        if self.ctx.args.node_rank in (0, None) and self.ctx.is_master_host(host):
+            self.server = KVServer(int(port))
+            self.server.start()
+        self.client = KVClient(addr)
+
+    def sync_peers(self, job_id: str, endpoint: str, nnodes: int, timeout=600):
+        self.lazy_init()
+        key = f"{job_id}/{endpoint.replace(':', '_').replace('/', '_')}"
+        deadline = time.time() + timeout
+        while not self.client.put(key, endpoint):
+            if time.time() > deadline:
+                raise TimeoutError(f"cannot reach master {self.ctx.args.master}")
+            time.sleep(0.5)
+        while True:
+            peers = sorted(v for k, v in self.client.get_all().items() if k.startswith(f"/{job_id}/"))
+            if len(peers) >= nnodes:
+                return peers, peers.index(endpoint)
+            if time.time() > deadline:
+                raise TimeoutError(f"rendezvous timeout: {len(peers)}/{nnodes} nodes")
+            time.sleep(0.5)
+
+    def stop(self):
+        if self.server:
+            self.server.stop()

@@ -391,6 +391,8 @@ class ReduceLROnPlateau(Callback):
                     import warnings
 
                     warnings.warn("ReduceLROnPlateau requires a float learning_rate, not an LRScheduler; skipped.")
+                    self.cooldown_counter = self.cooldown
+                    self.wait = 0
                     return
                 old_lr = opt.get_lr()
                 if old_lr > np.float32(self.min_lr):

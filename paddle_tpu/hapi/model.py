@@ -134,15 +134,18 @@ class Model:
         outputs = self._run_forward(inputs)
         return [o.numpy() for o in outputs]
 
-    def _split_batch(self, batch):
+    def _split_batch(self, batch, for_predict=False):
         """Split a loader batch into (inputs, labels): declared specs first,
         then the single-input-plus-label convention when a loss is prepared
-        (multi-input nets must declare inputs=, as in the reference)."""
+        (multi-input nets must declare inputs=, as in the reference).
+        predict() only applies the loss fallback to 2-element batches — a
+        longer undeclared batch is assumed to be all inputs there, while
+        train/eval always need a label to feed the loss."""
         if self._inputs:
             ni = len(self._inputs)
         elif self._labels:
             ni = len(batch) - len(self._labels)
-        elif self._loss is not None and len(batch) > 1:
+        elif self._loss is not None and (len(batch) == 2 if for_predict else len(batch) > 1):
             ni = len(batch) - 1
         else:
             ni = len(batch)
@@ -254,7 +257,7 @@ class Model:
         outputs = []
         count = 0
         for step, batch in enumerate(loader):
-            batch, _ = self._split_batch(_to_list(batch))
+            batch, _ = self._split_batch(_to_list(batch), for_predict=True)
             cbks.on_predict_batch_begin(step)
             out = self.predict_batch(batch)
             outputs.append(out)

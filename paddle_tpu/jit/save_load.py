@@ -15,6 +15,7 @@ Layout on disk for `save(layer, "path/model")`:
 """
 from __future__ import annotations
 
+import os
 import pickle
 
 import jax
@@ -33,9 +34,21 @@ def _resolve_input_specs(layer, input_spec):
     from ..static import InputSpec
 
     specs = []
+    scope = jax_export.SymbolicScope()
+    sym_count = 0
     for s in input_spec:
         if isinstance(s, InputSpec):
-            shape = tuple(1 if d in (-1, None) else int(d) for d in s.shape)
+            dims = []
+            for d in s.shape:
+                if d in (-1, None):
+                    dims.append(f"d{sym_count}")  # dynamic dim -> symbolic
+                    sym_count += 1
+                else:
+                    dims.append(str(int(d)))
+            if sym_count:
+                shape = jax_export.symbolic_shape(",".join(dims), scope=scope) if dims else ()
+            else:
+                shape = tuple(int(d) for d in s.shape)
             specs.append(jax.ShapeDtypeStruct(shape, dtype_mod.convert_dtype(s.dtype)))
         elif isinstance(s, Tensor):
             specs.append(jax.ShapeDtypeStruct(tuple(s.shape), s._value.dtype))
@@ -75,14 +88,18 @@ def save(layer, path, input_spec=None, **configs):
     exported = jax_export.export(jax.jit(pure))(*specs)
     blob = exported.serialize()
 
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
     with open(path + ".pdmodel", "wb") as f:
         f.write(blob)
     if isinstance(layer, Layer):
         fio.save(layer.state_dict(), path + ".pdiparams")
     meta = {
-        "in_shapes": [tuple(s.shape) for s in specs],
+        "in_shapes": [tuple(str(dim) if not isinstance(dim, int) else dim for dim in s.shape) for s in specs],
         "in_dtypes": [str(np.dtype(s.dtype)) for s in specs],
         "n_outputs": len(exported.out_avals),
+        "out_treedef": out_meta.get("treedef"),  # PyTreeDef pickles since jax 0.4
     }
     with open(path + ".pdmeta", "wb") as f:
         pickle.dump(meta, f)
@@ -103,6 +120,9 @@ class TranslatedLayer(Layer):
         raw = [i._value if isinstance(i, Tensor) else jnp.asarray(i) for i in inputs]
         out = self._exported.call(*raw)
         outs = [Tensor(o) for o in (out if isinstance(out, (tuple, list)) else (out,))]
+        treedef = self._meta.get("out_treedef")
+        if treedef is not None:
+            return jax.tree_util.tree_unflatten(treedef, outs)
         return outs[0] if len(outs) == 1 else outs
 
     def state_dict(self, *a, **kw):
@@ -114,8 +134,6 @@ class TranslatedLayer(Layer):
 
 
 def load(path, **configs) -> TranslatedLayer:
-    import os
-
     with open(path + ".pdmodel", "rb") as f:
         exported = jax_export.deserialize(f.read())
     meta = {}

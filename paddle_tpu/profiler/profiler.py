@@ -40,6 +40,10 @@ def make_scheduler(
     """python/paddle/profiler/profiler.py make_scheduler parity: cycle of
     [closed, ready, record] phases, repeated `repeat` times (0 = forever),
     after skipping `skip_first` steps."""
+    if record < 1:
+        raise ValueError(f"record must be >= 1, got {record}")
+    if closed < 0 or ready < 0 or skip_first < 0 or repeat < 0:
+        raise ValueError("closed/ready/skip_first/repeat must be non-negative")
     num_cycle = closed + ready + record
 
     def getter(step: int) -> ProfilerState:
@@ -132,6 +136,8 @@ class Profiler:
         elif isinstance(scheduler, (tuple, list)):
             start, end = scheduler
             start = max(start, 0)
+            if end <= start:
+                raise ValueError(f"scheduler window ({start}, {end}) records no steps")
             self._scheduler = make_scheduler(closed=max(start - 1, 0), ready=min(start, 1), record=end - start, repeat=1)
         else:
             self._scheduler = scheduler

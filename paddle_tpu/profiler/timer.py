@@ -43,6 +43,11 @@ class Benchmark:
         self.running = False
 
     def begin(self):
+        # fresh window: the singleton is shared across Profiler runs, so each
+        # begin() discards the previous run's accumulated stats
+        self.reader_cost.reset()
+        self.batch_cost.reset()
+        self.ips_stat.reset()
         self.running = True
         self._last_step_t = time.perf_counter()
 

@@ -170,3 +170,29 @@ def test_model_save_inference(tmp_path):
     loaded = paddle.jit.load(path)
     x = paddle.to_tensor(np.ones((1, 4), "float32"))
     np.testing.assert_allclose(loaded(x).numpy(), net(x).numpy(), rtol=1e-5)
+
+
+def test_jit_save_dynamic_batch_and_dict_output(tmp_path):
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.static import InputSpec
+
+    class Net(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = paddle.nn.Linear(4, 2)
+
+        def forward(self, x):
+            y = self.fc(x)
+            return {"logits": y, "probs": paddle.nn.functional.softmax(y, axis=-1)}
+
+    net = Net()
+    net.eval()
+    path = str(tmp_path / "dyn" / "model")
+    paddle.jit.save(net, path, input_spec=[InputSpec([-1, 4], "float32")])
+    loaded = paddle.jit.load(path)
+    for bs in (1, 3, 7):
+        x = paddle.to_tensor(np.random.RandomState(bs).randn(bs, 4).astype("float32"))
+        out = loaded(x)
+        assert isinstance(out, dict) and set(out) == {"logits", "probs"}
+        np.testing.assert_allclose(out["logits"].numpy(), net(x)["logits"].numpy(), rtol=1e-5, atol=1e-5)

@@ -1,0 +1,110 @@
+"""Launcher CLI: env contract, pod lifecycle, KV rendezvous, elastic manager."""
+import json
+import os
+import sys
+import time
+
+import pytest
+
+from paddle_tpu.distributed.launch import (
+    CollectiveController,
+    Context,
+    KVClient,
+    KVServer,
+    parse_args,
+)
+from paddle_tpu.distributed.fleet.elastic import ElasticManager, ElasticStatus
+
+
+def _free_port():
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def test_parse_args_defaults():
+    args = parse_args(["train.py", "--lr", "0.1"])
+    assert args.nnodes == 1 and args.nproc_per_node == 1
+    assert args.training_script == "train.py"
+    assert args.training_script_args == ["--lr", "0.1"]
+
+
+def test_launch_two_procs_env_contract(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(
+        "import os, json, sys\n"
+        "out = os.environ['OUT_DIR']\n"
+        "rank = os.environ['PADDLE_TRAINER_ID']\n"
+        "rec = {k: os.environ[k] for k in ('PADDLE_TRAINER_ID','PADDLE_TRAINERS_NUM','PADDLE_LOCAL_RANK','PADDLE_MASTER')}\n"
+        "open(os.path.join(out, f'env.{rank}.json'), 'w').write(json.dumps(rec))\n"
+    )
+    os.environ["OUT_DIR"] = str(tmp_path)
+    try:
+        args = parse_args(["--nproc_per_node", "2", "--poll_interval", "0.2", str(script)])
+        code = CollectiveController(Context(args)).run()
+    finally:
+        del os.environ["OUT_DIR"]
+    assert code == 0
+    recs = [json.load(open(tmp_path / f"env.{r}.json")) for r in (0, 1)]
+    assert [r["PADDLE_TRAINER_ID"] for r in recs] == ["0", "1"]
+    assert all(r["PADDLE_TRAINERS_NUM"] == "2" for r in recs)
+    assert [r["PADDLE_LOCAL_RANK"] for r in recs] == ["0", "1"]
+
+
+def test_launch_nonzero_exit(tmp_path):
+    script = tmp_path / "bad.py"
+    script.write_text("import sys; sys.exit(3)\n")
+    args = parse_args(["--poll_interval", "0.2", str(script)])
+    code = CollectiveController(Context(args)).run()
+    assert code == 1
+
+
+def test_launch_restart_then_success(tmp_path):
+    # fails on first run, succeeds after restart (elastic --max_restart)
+    script = tmp_path / "flaky.py"
+    marker = tmp_path / "ran_once"
+    script.write_text(
+        f"import os, sys\n"
+        f"m = {str(repr(str(marker)))}\n"
+        "if not os.path.exists(m):\n"
+        "    open(m, 'w').write('x'); sys.exit(1)\n"
+        "sys.exit(0)\n"
+    )
+    args = parse_args(["--max_restart", "2", "--poll_interval", "0.2", str(script)])
+    code = CollectiveController(Context(args)).run()
+    assert code == 0
+    assert marker.exists()
+
+
+def test_kv_server_roundtrip():
+    port = _free_port()
+    srv = KVServer(port)
+    srv.start()
+    try:
+        cli = KVClient(f"127.0.0.1:{port}")
+        assert cli.put("job/a", "1.2.3.4:8000")
+        assert cli.get("job/a") == "1.2.3.4:8000"
+        allkv = cli.get_all()
+        assert "/job/a" in allkv
+    finally:
+        srv.stop()
+
+
+def test_elastic_manager_membership():
+    port = _free_port()
+    srv = KVServer(port)
+    srv.start()
+    try:
+        m1 = ElasticManager(f"127.0.0.1:{port}", "job1", np=2, host="hostA", timeout=5)
+        m2 = ElasticManager(f"127.0.0.1:{port}", "job1", np=2, host="hostB", timeout=5)
+        m1._heartbeat()
+        assert m1.watch() == ElasticStatus.RESTART  # only 1/2 alive, self in
+        m2._heartbeat()
+        assert m1.alive_nodes() == ["hostA", "hostB"]
+        assert m1.watch() == ElasticStatus.HOLD
+        m1.exit()
+        m2.exit()
+    finally:
+        srv.stop()
