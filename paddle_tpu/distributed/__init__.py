@@ -69,3 +69,16 @@ from .auto_parallel import (  # noqa: F401
     unshard_dtensor,
 )
 from . import checkpoint  # noqa: F401,E402
+
+
+def __getattr__(name):
+    # paddle.distributed.TCPStore parity (native C++ server, see
+    # paddle_tpu/native/src/core.cc); resolved lazily so importing
+    # paddle_tpu never requires the native build, while preserving class
+    # identity for isinstance/subclass use.
+    if name == "TCPStore":
+        from ..native.store import TCPStore
+
+        globals()["TCPStore"] = TCPStore
+        return TCPStore
+    raise AttributeError(f"module 'paddle_tpu.distributed' has no attribute {name!r}")

@@ -13,6 +13,7 @@ import itertools
 import math
 import queue
 import threading
+import time as _time
 from typing import Iterable, List, Optional
 
 import numpy as np
@@ -300,6 +301,114 @@ class _PrefetchIter:
         return item
 
 
+class _NativeRingIter:
+    """Prefetch through the native fixed-buffer ring (paddle_tpu/native):
+    the producer thread serializes host (numpy) batches into reusable C++
+    buffers with a multi-threaded memcpy (GIL released), playing the role of
+    the reference's shared-memory worker queues
+    (python/paddle/io/dataloader/dataloader_iter.py). Protocol: every batch
+    puts one record on a Python side queue — ("ring", spec) if its payload
+    went through the ring, ("py", batch) for anything else (device Tensors,
+    nested structures, oversized batches) — so the consumer pops the side
+    queue first and only then the ring, preserving order. The ring is
+    created lazily on the first numpy batch, sized to it; batch types come
+    out exactly as the non-ring paths produce them."""
+
+    _RING_BYTES_MAX = 64 << 20
+
+    def __init__(self, gen_fn, depth):
+        from ..native.ring import PrefetchRing  # raises NativeUnavailable early
+
+        from ..native import get_lib
+
+        get_lib()  # fail fast (caught by DataLoader.__iter__) if no native core
+        self._PrefetchRing = PrefetchRing
+        self._depth = max(2, min(depth, 16))
+        self._ring = None
+        self._side = queue.Queue(maxsize=max(depth * 2, 4))
+        self._exc = None
+        self._done = False
+        self._eof = object()
+
+        def to_leaves(batch):
+            # ring carries host bytes; device Tensors ride the side channel
+            # unchanged (no D2H bounce), as do nested/non-array structures
+            if isinstance(batch, np.ndarray):
+                return None, [batch]
+            if isinstance(batch, (tuple, list)) and batch and all(isinstance(x, np.ndarray) for x in batch):
+                return len(batch), list(batch)
+            raise TypeError
+
+        def producer():
+            try:
+                for batch in gen_fn():
+                    rec = None
+                    try:
+                        spec, leaves = to_leaves(batch)
+                        if self._ring is None:
+                            nbytes = sum(a.nbytes for a in leaves)
+                            cap = min(self._RING_BYTES_MAX, max(1 << 20, 2 * nbytes))
+                            self._ring = self._PrefetchRing(capacity=self._depth, buffer_bytes=cap)
+                        if not self._ring.put_arrays(leaves):
+                            return  # consumer tore down the ring
+                        rec = ("ring", spec)
+                    except (TypeError, ValueError):
+                        rec = ("py", batch)
+                    self._side.put(rec)
+            except BaseException as e:  # propagate dataset/collate errors
+                self._exc = e
+            finally:
+                if self._ring is not None:
+                    self._ring.close()
+                self._side.put(self._eof)
+
+        self._t = threading.Thread(target=producer, daemon=True)
+        self._t.start()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._done:
+            raise StopIteration
+        rec = self._side.get()
+        if rec is self._eof:
+            self._shutdown()
+            if self._exc is not None:
+                raise self._exc
+            raise StopIteration
+        kind, payload = rec
+        if kind == "py":
+            return payload
+        arrays = self._ring.get_arrays()
+        if arrays is None:  # ring closed underneath us (shutdown race)
+            self._shutdown()
+            raise StopIteration
+        if payload is None:  # single-array batch
+            return arrays[0]
+        return list(arrays)
+
+    def _shutdown(self):
+        self._done = True
+        if self._ring is not None:
+            self._ring.close()  # unblocks a producer stuck in acquire_fill
+        deadline = _time.monotonic() + 10
+        while self._t.is_alive() and _time.monotonic() < deadline:
+            try:  # drain so a producer blocked on the bounded side queue exits
+                self._side.get_nowait()
+            except queue.Empty:
+                self._t.join(timeout=0.05)
+        if self._ring is not None and not self._t.is_alive():
+            self._ring.destroy()
+            self._ring = None
+
+    def __del__(self):
+        try:
+            self._shutdown()
+        except Exception:
+            pass
+
+
 class DataLoader:
     """python/paddle/io/reader.py:216 parity."""
 
@@ -325,6 +434,7 @@ class DataLoader:
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
+        self.use_shared_memory = use_shared_memory  # native fixed-buffer ring
         self.prefetch = max(prefetch_factor, 1) if use_buffer_reader else 0
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
@@ -352,7 +462,15 @@ class DataLoader:
 
     def __iter__(self):
         if self.prefetch and self.num_workers != 0:
-            return _PrefetchIter(self._gen, self.prefetch * max(self.num_workers, 1))
+            depth = self.prefetch * max(self.num_workers, 1)
+            if self.use_shared_memory:
+                from ..native import NativeUnavailable
+
+                try:
+                    return _NativeRingIter(self._gen, depth)
+                except (NativeUnavailable, MemoryError):
+                    pass  # no native core / no memory: python-queue prefetch
+            return _PrefetchIter(self._gen, depth)
         return self._gen()
 
     def __len__(self):

@@ -1,0 +1,134 @@
+"""Prefetch ring + parallel collate over the native core.
+
+The ring owns `capacity` fixed-size host buffers. Python worker threads
+serialize batches of numpy sample arrays straight into a free buffer
+(native parallel memcpy, GIL released during the copy), and the consumer
+deserializes zero-copy numpy views before the buffer is recycled.
+
+Batch wire format inside one buffer:
+  u32 n_arrays | per array: u32 hdr_len | hdr(utf8: dtype|shape) | payload
+"""
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from . import get_lib
+
+
+def _pack_header(arr: np.ndarray) -> bytes:
+    return f"{arr.dtype.str}|{','.join(map(str, arr.shape))}".encode()
+
+
+def _parse_header(b: bytes):
+    dt, shp = b.decode().split("|")
+    shape = tuple(int(s) for s in shp.split(",")) if shp else ()
+    return np.dtype(dt), shape
+
+
+def collate(dst_view: memoryview, arrays, offsets, nthreads=4):
+    """Native scatter of `arrays` into dst at byte `offsets`."""
+    lib = get_lib()
+    n = len(arrays)
+    srcs = (ctypes.c_void_p * n)()
+    sizes = (ctypes.c_long * n)()
+    offs = (ctypes.c_long * n)()
+    keepalive = []
+    for i, a in enumerate(arrays):
+        a = np.ascontiguousarray(a)
+        keepalive.append(a)
+        srcs[i] = a.ctypes.data
+        sizes[i] = a.nbytes
+        offs[i] = offsets[i]
+    dst = (ctypes.c_char * len(dst_view)).from_buffer(dst_view)
+    lib.pt_collate(ctypes.addressof(dst), srcs, sizes, offs, n, nthreads)
+
+
+class PrefetchRing:
+    def __init__(self, capacity: int = 4, buffer_bytes: int = 64 << 20):
+        self._lib = get_lib()
+        self._ring = self._lib.pt_ring_create(capacity, buffer_bytes)
+        if not self._ring:
+            raise MemoryError("cannot allocate prefetch ring")
+        self.buffer_bytes = buffer_bytes
+        self._closed = False
+
+    # ---- producer ----
+    def put_arrays(self, arrays, nthreads=4) -> bool:
+        """Serialize one batch (list of numpy arrays) into the ring.
+        Returns False if the ring is closed."""
+        arrays = [np.ascontiguousarray(a) for a in arrays]
+        headers = [_pack_header(a) for a in arrays]
+        total = 4 + sum(4 + len(h) + a.nbytes for h, a in zip(headers, arrays))
+        if total > self.buffer_bytes:
+            raise ValueError(f"batch of {total} bytes exceeds ring buffer {self.buffer_bytes}")
+        buf = self._lib.pt_ring_acquire_fill(self._ring)
+        if not buf:
+            return False
+        try:
+            mv = (ctypes.c_char * self.buffer_bytes).from_address(buf)
+            view = memoryview(mv).cast("B")
+            off = 4
+            view[0:4] = len(arrays).to_bytes(4, "little")
+            payload_offsets = []
+            for h, a in zip(headers, arrays):
+                view[off : off + 4] = len(h).to_bytes(4, "little")
+                off += 4
+                view[off : off + len(h)] = h
+                off += len(h)
+                payload_offsets.append(off)
+                off += a.nbytes
+            collate(view, arrays, payload_offsets, nthreads=nthreads)
+        except Exception:
+            self._lib.pt_ring_abort_fill(self._ring, buf)
+            raise
+        self._lib.pt_ring_commit(self._ring, buf, total)
+        return True
+
+    # ---- consumer ----
+    def get_arrays(self):
+        """Pop one batch; returns list of numpy arrays (copies — the buffer
+        is recycled immediately) or None at EOF."""
+        nbytes = ctypes.c_long()
+        buf = self._lib.pt_ring_acquire_batch(self._ring, ctypes.byref(nbytes))
+        if not buf:
+            return None
+        try:
+            mv = (ctypes.c_char * nbytes.value).from_address(buf)
+            view = memoryview(mv).cast("B")
+            n = int.from_bytes(view[0:4], "little")
+            off = 4
+            out = []
+            for _ in range(n):
+                hlen = int.from_bytes(view[off : off + 4], "little")
+                off += 4
+                dtype, shape = _parse_header(bytes(view[off : off + hlen]))
+                off += hlen
+                nb = int(dtype.itemsize * int(np.prod(shape)) if shape else dtype.itemsize)
+                arr = np.frombuffer(view[off : off + nb], dtype=dtype).reshape(shape).copy()
+                off += nb
+                out.append(arr)
+            return out
+        finally:
+            self._lib.pt_ring_release(self._ring, buf)
+
+    def ready_count(self):
+        return self._lib.pt_ring_ready_count(self._ring)
+
+    def close(self):
+        if not self._closed:
+            self._closed = True
+            self._lib.pt_ring_close(self._ring)
+
+    def destroy(self):
+        self.close()
+        if self._ring:
+            self._lib.pt_ring_destroy(self._ring)
+            self._ring = None
+
+    def __del__(self):
+        try:
+            self.destroy()
+        except Exception:
+            pass

@@ -1,0 +1,434 @@
+// paddle_tpu native runtime core.
+//
+// TPU-native equivalents of the reference's native data/runtime pieces:
+//  - prefetch ring: bounded producer/consumer buffer pool backing the Python
+//    DataLoader (reference: paddle/fluid/framework/data_feed.cc +
+//    python/paddle/io/dataloader/dataloader_iter.py shared-memory queues).
+//    Fixed-size host buffers are reused, so steady-state loading does no
+//    allocation; Python threads fill them with the GIL released (ctypes).
+//  - parallel collate: multi-threaded scatter of N sample blobs into one
+//    contiguous batch buffer (the memcpy half of default_collate_fn).
+//  - TCPStore: rendezvous KV over TCP with SET/GET/ADD/WAIT, the bootstrap
+//    store (reference: paddle/phi/core/distributed/store/tcp_store.cc) used
+//    when the HTTP master is not; also exercised by ProcessGroup tests.
+//
+// Exposed as a plain C ABI for ctypes (no pybind11 in the image).
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <climits>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Prefetch ring
+// ---------------------------------------------------------------------------
+
+struct RingBuf {
+  char* data;
+  long nbytes;  // committed payload size
+};
+
+struct Ring {
+  std::vector<char*> pool;      // all buffers (owned)
+  std::deque<char*> free_q;     // fillable
+  std::deque<RingBuf> ready_q;  // committed, awaiting consumer
+  long buf_cap;
+  bool closed = false;
+  std::mutex mu;
+  std::condition_variable cv_free, cv_ready;
+};
+
+void* pt_ring_create(int capacity, long buffer_bytes) {
+  Ring* r = new Ring();
+  r->buf_cap = buffer_bytes;
+  for (int i = 0; i < capacity; i++) {
+    char* b = static_cast<char*>(::malloc(buffer_bytes));
+    if (!b) {
+      for (char* p : r->pool) ::free(p);
+      delete r;
+      return nullptr;
+    }
+    r->pool.push_back(b);
+    r->free_q.push_back(b);
+  }
+  return r;
+}
+
+void pt_ring_destroy(void* ring) {
+  Ring* r = static_cast<Ring*>(ring);
+  if (!r) return;
+  for (char* p : r->pool) ::free(p);
+  delete r;
+}
+
+long pt_ring_buffer_bytes(void* ring) { return static_cast<Ring*>(ring)->buf_cap; }
+
+// Producer: block until a free buffer is available (nullptr after close).
+void* pt_ring_acquire_fill(void* ring) {
+  Ring* r = static_cast<Ring*>(ring);
+  std::unique_lock<std::mutex> lk(r->mu);
+  r->cv_free.wait(lk, [&] { return r->closed || !r->free_q.empty(); });
+  if (r->free_q.empty()) return nullptr;  // closed
+  char* b = r->free_q.front();
+  r->free_q.pop_front();
+  return b;
+}
+
+void pt_ring_commit(void* ring, void* buf, long nbytes) {
+  Ring* r = static_cast<Ring*>(ring);
+  {
+    std::lock_guard<std::mutex> lk(r->mu);
+    r->ready_q.push_back({static_cast<char*>(buf), nbytes});
+  }
+  r->cv_ready.notify_one();
+}
+
+// Producer changed its mind (e.g. worker error): return buffer unused.
+void pt_ring_abort_fill(void* ring, void* buf) {
+  Ring* r = static_cast<Ring*>(ring);
+  {
+    std::lock_guard<std::mutex> lk(r->mu);
+    r->free_q.push_back(static_cast<char*>(buf));
+  }
+  r->cv_free.notify_one();
+}
+
+// Consumer: block for the next committed batch; returns nullptr at EOF
+// (closed and drained). nbytes receives the payload size.
+void* pt_ring_acquire_batch(void* ring, long* nbytes) {
+  Ring* r = static_cast<Ring*>(ring);
+  std::unique_lock<std::mutex> lk(r->mu);
+  r->cv_ready.wait(lk, [&] { return r->closed || !r->ready_q.empty(); });
+  if (r->ready_q.empty()) return nullptr;
+  RingBuf b = r->ready_q.front();
+  r->ready_q.pop_front();
+  *nbytes = b.nbytes;
+  return b.data;
+}
+
+void pt_ring_release(void* ring, void* buf) {
+  Ring* r = static_cast<Ring*>(ring);
+  {
+    std::lock_guard<std::mutex> lk(r->mu);
+    r->free_q.push_back(static_cast<char*>(buf));
+  }
+  r->cv_free.notify_one();
+}
+
+void pt_ring_close(void* ring) {
+  Ring* r = static_cast<Ring*>(ring);
+  {
+    std::lock_guard<std::mutex> lk(r->mu);
+    r->closed = true;
+  }
+  r->cv_free.notify_all();
+  r->cv_ready.notify_all();
+}
+
+int pt_ring_ready_count(void* ring) {
+  Ring* r = static_cast<Ring*>(ring);
+  std::lock_guard<std::mutex> lk(r->mu);
+  return static_cast<int>(r->ready_q.size());
+}
+
+// ---------------------------------------------------------------------------
+// Parallel collate: dst[offsets[i] : offsets[i]+sizes[i]] = srcs[i]
+// ---------------------------------------------------------------------------
+
+void pt_collate(void* dst, void** srcs, const long* sizes, const long* offsets,
+                int n, int nthreads) {
+  char* d = static_cast<char*>(dst);
+  if (nthreads <= 1 || n <= 1) {
+    for (int i = 0; i < n; i++) std::memcpy(d + offsets[i], srcs[i], sizes[i]);
+    return;
+  }
+  std::atomic<int> next(0);
+  auto work = [&] {
+    int i;
+    while ((i = next.fetch_add(1)) < n) std::memcpy(d + offsets[i], srcs[i], sizes[i]);
+  };
+  int t = nthreads < n ? nthreads : n;
+  std::vector<std::thread> threads;
+  threads.reserve(t - 1);
+  for (int i = 0; i < t - 1; i++) threads.emplace_back(work);
+  work();
+  for (auto& th : threads) th.join();
+}
+
+// ---------------------------------------------------------------------------
+// TCPStore — length-prefixed protocol:
+//   request : u8 op | u32 klen | key | u32 vlen | value
+//   response: i64 status/number | u32 vlen | value
+// ops: 0=SET 1=GET 2=ADD(value=i64 delta) 3=WAIT 4=DEL 5=PING
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct StoreServer {
+  int listen_fd = -1;
+  int port = 0;
+  std::atomic<bool> stop{false};
+  std::map<std::string, std::string> kv;
+  std::mutex mu;
+  std::condition_variable cv;
+  std::thread accept_thread;
+  std::vector<std::thread> handlers;
+  std::mutex handlers_mu;
+};
+
+bool read_full(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n) {
+    ssize_t k = ::recv(fd, p, n, 0);
+    if (k <= 0) return false;
+    p += k;
+    n -= static_cast<size_t>(k);
+  }
+  return true;
+}
+
+bool write_full(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n) {
+    ssize_t k = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (k <= 0) return false;
+    p += k;
+    n -= static_cast<size_t>(k);
+  }
+  return true;
+}
+
+void handle_conn(StoreServer* s, int fd) {
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  while (!s->stop.load()) {
+    uint8_t op;
+    uint32_t klen, vlen;
+    if (!read_full(fd, &op, 1) || !read_full(fd, &klen, 4)) break;
+    if (klen > (1u << 20)) break;
+    std::string key(klen, '\0');
+    if (klen && !read_full(fd, &key[0], klen)) break;
+    if (!read_full(fd, &vlen, 4)) break;
+    if (vlen > (1u << 26)) break;
+    std::string val(vlen, '\0');
+    if (vlen && !read_full(fd, &val[0], vlen)) break;
+
+    int64_t status = 0;
+    std::string out;
+    switch (op) {
+      case 0: {  // SET
+        std::lock_guard<std::mutex> lk(s->mu);
+        s->kv[key] = val;
+        s->cv.notify_all();
+        break;
+      }
+      case 1: {  // GET
+        std::lock_guard<std::mutex> lk(s->mu);
+        auto it = s->kv.find(key);
+        if (it == s->kv.end()) {
+          status = -1;
+        } else {
+          out = it->second;
+        }
+        break;
+      }
+      case 2: {  // ADD
+        int64_t delta = 0;
+        std::memcpy(&delta, val.data(), val.size() < 8 ? val.size() : 8);
+        std::lock_guard<std::mutex> lk(s->mu);
+        int64_t cur = 0;
+        auto it = s->kv.find(key);
+        if (it != s->kv.end() && it->second.size() == 8) std::memcpy(&cur, it->second.data(), 8);
+        cur += delta;
+        std::string enc(8, '\0');
+        std::memcpy(&enc[0], &cur, 8);
+        s->kv[key] = enc;
+        status = cur;
+        s->cv.notify_all();
+        break;
+      }
+      case 3: {  // WAIT (value = i64 timeout ms)
+        int64_t timeout_ms = 0;
+        std::memcpy(&timeout_ms, val.data(), val.size() < 8 ? val.size() : 8);
+        std::unique_lock<std::mutex> lk(s->mu);
+        bool ok = s->cv.wait_for(lk, std::chrono::milliseconds(timeout_ms), [&] {
+          return s->stop.load() || s->kv.count(key) > 0;
+        });
+        status = (ok && s->kv.count(key)) ? 0 : -1;
+        break;
+      }
+      case 4: {  // DEL
+        std::lock_guard<std::mutex> lk(s->mu);
+        s->kv.erase(key);
+        break;
+      }
+      case 5:  // PING
+        break;
+      default:
+        status = -2;
+    }
+    uint32_t olen = static_cast<uint32_t>(out.size());
+    if (!write_full(fd, &status, 8) || !write_full(fd, &olen, 4)) break;
+    if (olen && !write_full(fd, out.data(), olen)) break;
+  }
+  ::close(fd);
+}
+
+}  // namespace
+
+void* pt_store_server_start(int port) {
+  StoreServer* s = new StoreServer();
+  s->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (s->listen_fd < 0) {
+    delete s;
+    return nullptr;
+  }
+  int one = 1;
+  ::setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(s->listen_fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      ::listen(s->listen_fd, 128) != 0) {
+    ::close(s->listen_fd);
+    delete s;
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  ::getsockname(s->listen_fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  s->port = ntohs(addr.sin_port);
+  s->accept_thread = std::thread([s] {
+    while (!s->stop.load()) {
+      int fd = ::accept(s->listen_fd, nullptr, nullptr);
+      if (fd < 0) break;
+      std::lock_guard<std::mutex> lk(s->handlers_mu);
+      s->handlers.emplace_back(handle_conn, s, fd);
+    }
+  });
+  return s;
+}
+
+int pt_store_server_port(void* sv) { return static_cast<StoreServer*>(sv)->port; }
+
+void pt_store_server_stop(void* sv) {
+  StoreServer* s = static_cast<StoreServer*>(sv);
+  s->stop.store(true);
+  s->cv.notify_all();
+  ::shutdown(s->listen_fd, SHUT_RDWR);
+  ::close(s->listen_fd);
+  if (s->accept_thread.joinable()) s->accept_thread.join();
+  {
+    std::lock_guard<std::mutex> lk(s->handlers_mu);
+    for (auto& t : s->handlers)
+      if (t.joinable()) t.detach();  // blocked conns die with the socket
+  }
+  delete s;
+}
+
+struct StoreClient {
+  int fd = -1;
+};
+
+void* pt_store_client_connect(const char* host, int port, int timeout_ms) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    ::close(fd);
+    return nullptr;
+  }
+  auto deadline = std::chrono::steady_clock::now() + std::chrono::milliseconds(timeout_ms);
+  while (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    if (std::chrono::steady_clock::now() > deadline) {
+      ::close(fd);
+      return nullptr;
+    }
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  }
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  StoreClient* c = new StoreClient();
+  c->fd = fd;
+  return c;
+}
+
+static int64_t store_request(StoreClient* c, uint8_t op, const char* key, const void* val,
+                             uint32_t vlen, char* out, int out_cap, int* out_len) {
+  uint32_t klen = static_cast<uint32_t>(std::strlen(key));
+  if (!write_full(c->fd, &op, 1) || !write_full(c->fd, &klen, 4) ||
+      (klen && !write_full(c->fd, key, klen)) || !write_full(c->fd, &vlen, 4) ||
+      (vlen && !write_full(c->fd, val, vlen)))
+    return INT64_MIN;
+  int64_t status;
+  uint32_t olen;
+  if (!read_full(c->fd, &status, 8) || !read_full(c->fd, &olen, 4)) return INT64_MIN;
+  std::string tmp(olen, '\0');
+  if (olen && !read_full(c->fd, &tmp[0], olen)) return INT64_MIN;
+  if (out_len) *out_len = static_cast<int>(olen);
+  if (out && out_cap > 0) {
+    uint32_t n = olen < static_cast<uint32_t>(out_cap) ? olen : static_cast<uint32_t>(out_cap);
+    std::memcpy(out, tmp.data(), n);
+  }
+  return status;
+}
+
+int pt_store_set(void* cv, const char* key, const void* val, int len) {
+  return store_request(static_cast<StoreClient*>(cv), 0, key, val, len, nullptr, 0, nullptr) ==
+                 INT64_MIN
+             ? -1
+             : 0;
+}
+
+int pt_store_get(void* cv, const char* key, char* out, int cap) {
+  int out_len = 0;
+  int64_t st =
+      store_request(static_cast<StoreClient*>(cv), 1, key, nullptr, 0, out, cap, &out_len);
+  if (st == INT64_MIN || st == -1) return -1;
+  return out_len;
+}
+
+long pt_store_add(void* cv, const char* key, long delta) {
+  int64_t d = delta;
+  int64_t st = store_request(static_cast<StoreClient*>(cv), 2, key, &d, 8, nullptr, 0, nullptr);
+  return st == INT64_MIN ? LONG_MIN : static_cast<long>(st);
+}
+
+int pt_store_wait(void* cv, const char* key, int timeout_ms) {
+  int64_t t = timeout_ms;
+  int64_t st = store_request(static_cast<StoreClient*>(cv), 3, key, &t, 8, nullptr, 0, nullptr);
+  return st == 0 ? 0 : -1;
+}
+
+int pt_store_del(void* cv, const char* key) {
+  return store_request(static_cast<StoreClient*>(cv), 4, key, nullptr, 0, nullptr, 0, nullptr) ==
+                 INT64_MIN
+             ? -1
+             : 0;
+}
+
+void pt_store_client_close(void* cv) {
+  StoreClient* c = static_cast<StoreClient*>(cv);
+  ::close(c->fd);
+  delete c;
+}
+
+}  // extern "C"

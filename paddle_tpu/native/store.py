@@ -1,0 +1,82 @@
+"""TCPStore — native rendezvous KV.
+
+Reference parity: paddle/phi/core/distributed/store/tcp_store.h — rank 0
+hosts the store (is_master=True), all ranks connect; get/set/add/wait back
+process-group bootstrap and barriers. The server and protocol live in C++
+(src/core.cc); this wraps the C ABI.
+"""
+from __future__ import annotations
+
+import ctypes
+import socket
+
+from . import NativeUnavailable, get_lib
+
+
+class TCPStore:
+    def __init__(self, host="127.0.0.1", port=0, is_master=False, world_size=1, timeout=30.0):
+        self._lib = get_lib()
+        self._server = None
+        self._client = None
+        self.is_master = is_master
+        if is_master:
+            self._server = self._lib.pt_store_server_start(port)
+            if not self._server:
+                raise RuntimeError(f"TCPStore: cannot bind port {port}")
+            port = self._lib.pt_store_server_port(self._server)
+        self.host = host
+        self.port = port
+        ip = socket.gethostbyname(host)
+        self._client = self._lib.pt_store_client_connect(
+            ip.encode(), port, int(timeout * 1000)
+        )
+        if not self._client:
+            if self._server:
+                self._lib.pt_store_server_stop(self._server)
+            raise TimeoutError(f"TCPStore: cannot connect to {host}:{port}")
+
+    def set(self, key: str, value) -> None:
+        if isinstance(value, str):
+            value = value.encode()
+        rc = self._lib.pt_store_set(self._client, key.encode(), value, len(value))
+        if rc != 0:
+            raise RuntimeError("TCPStore.set failed (connection lost)")
+
+    def get(self, key: str) -> bytes:
+        cap = 1 << 16
+        buf = ctypes.create_string_buffer(cap)
+        n = self._lib.pt_store_get(self._client, key.encode(), buf, cap)
+        if n < 0:
+            raise KeyError(key)
+        return buf.raw[:n]
+
+    def add(self, key: str, delta: int) -> int:
+        v = self._lib.pt_store_add(self._client, key.encode(), delta)
+        if v == -(2**63) or v == -(2**31):  # LONG_MIN sentinel
+            raise RuntimeError("TCPStore.add failed (connection lost)")
+        return int(v)
+
+    def wait(self, keys, timeout=30.0) -> None:
+        if isinstance(keys, str):
+            keys = [keys]
+        for k in keys:
+            rc = self._lib.pt_store_wait(self._client, k.encode(), int(timeout * 1000))
+            if rc != 0:
+                raise TimeoutError(f"TCPStore.wait timed out on key '{k}'")
+
+    def delete_key(self, key: str) -> None:
+        self._lib.pt_store_del(self._client, key.encode())
+
+    def close(self):
+        if self._client:
+            self._lib.pt_store_client_close(self._client)
+            self._client = None
+        if self._server:
+            self._lib.pt_store_server_stop(self._server)
+            self._server = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
