@@ -58,7 +58,9 @@ def apply(name: str, fn: Callable, *args, n_outputs=None, **kwargs):
 
     if not diff_pos:
         out = fn(*raw, **kwargs)
-        return _wrap(out, node=None)
+        res = _wrap(out, node=None)
+        _record_static(name, fn, args, kwargs, res)
+        return res
 
     def pure(*dvals):
         vals = list(raw)
@@ -82,7 +84,17 @@ def apply(name: str, fn: Callable, *args, n_outputs=None, **kwargs):
             edges.append(Edge(leaf=t))
 
     node = GradNode(name, vjp_fn, edges, out_avals, single)
-    return _wrap(out, node=node)
+    res = _wrap(out, node=node)
+    _record_static(name, fn, args, kwargs, res)
+    return res
+
+
+def _record_static(name, fn, args, kwargs, res):
+    """Append this op to the Program being captured (paddle_tpu.static):
+    the static-graph analog of OpDesc append in LayerHelper.append_op."""
+    prog = state.get_program_capture()
+    if prog is not None:
+        prog.record_op(name, fn, args, kwargs, res)
 
 
 def _wrap(out, node):
@@ -105,4 +117,6 @@ def _wrap(out, node):
 def apply_nograd(name: str, fn: Callable, *args, **kwargs):
     """Fast path for ops that are never differentiable (comparisons, argmax...)."""
     raw = [a.value if isinstance(a, Tensor) else a for a in args]
-    return _wrap(fn(*raw, **kwargs), node=None)
+    res = _wrap(fn(*raw, **kwargs), node=None)
+    _record_static(name, fn, args, kwargs, res)
+    return res

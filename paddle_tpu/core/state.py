@@ -128,3 +128,15 @@ def set_amp_state(st):
     prev = _tls.amp_state
     _tls.amp_state = st
     return prev
+
+
+# ---- static program capture (set by paddle_tpu.static.program_guard) ----
+
+def get_program_capture():
+    return getattr(_tls, "program_capture", None)
+
+
+def set_program_capture(prog):
+    prev = getattr(_tls, "program_capture", None)
+    _tls.program_capture = prog
+    return prev

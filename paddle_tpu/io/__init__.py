@@ -333,9 +333,13 @@ class _NativeRingIter:
         def to_leaves(batch):
             # ring carries host bytes; device Tensors ride the side channel
             # unchanged (no D2H bounce), as do nested/non-array structures
-            if isinstance(batch, np.ndarray):
+            if isinstance(batch, np.ndarray) and not batch.dtype.hasobject:
                 return None, [batch]
-            if isinstance(batch, (tuple, list)) and batch and all(isinstance(x, np.ndarray) for x in batch):
+            if (
+                isinstance(batch, (tuple, list))
+                and batch
+                and all(isinstance(x, np.ndarray) and not x.dtype.hasobject for x in batch)
+            ):
                 return len(batch), list(batch)
             raise TypeError
 

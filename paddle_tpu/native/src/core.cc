@@ -189,6 +189,7 @@ struct StoreServer {
   std::condition_variable cv;
   std::thread accept_thread;
   std::vector<std::thread> handlers;
+  std::vector<int> handler_fds;  // parallel to handlers; for shutdown wakeup
   std::mutex handlers_mu;
 };
 
@@ -320,6 +321,7 @@ void* pt_store_server_start(int port) {
       if (fd < 0) break;
       std::lock_guard<std::mutex> lk(s->handlers_mu);
       s->handlers.emplace_back(handle_conn, s, fd);
+      s->handler_fds.push_back(fd);
     }
   });
   return s;
@@ -335,9 +337,12 @@ void pt_store_server_stop(void* sv) {
   ::close(s->listen_fd);
   if (s->accept_thread.joinable()) s->accept_thread.join();
   {
+    // wake handlers blocked in recv(), then join them — they must not
+    // outlive the StoreServer they dereference
     std::lock_guard<std::mutex> lk(s->handlers_mu);
+    for (int fd : s->handler_fds) ::shutdown(fd, SHUT_RDWR);
     for (auto& t : s->handlers)
-      if (t.joinable()) t.detach();  // blocked conns die with the socket
+      if (t.joinable()) t.join();
   }
   delete s;
 }

@@ -48,6 +48,11 @@ class TCPStore:
         n = self._lib.pt_store_get(self._client, key.encode(), buf, cap)
         if n < 0:
             raise KeyError(key)
+        if n > cap:  # value larger than the first buffer: refetch exactly
+            buf = ctypes.create_string_buffer(n)
+            n = self._lib.pt_store_get(self._client, key.encode(), buf, n)
+            if n < 0:
+                raise KeyError(key)
         return buf.raw[:n]
 
     def add(self, key: str, delta: int) -> int:

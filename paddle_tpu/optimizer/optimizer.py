@@ -139,6 +139,14 @@ class Optimizer:
         return grad_val
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        from ..core import state as _state
+
+        if _state.get_program_capture() is not None:
+            # static mode: append backward + update instructions instead of
+            # executing (reference: static _append_optimize_op path)
+            from ..static.optimizer_hooks import static_minimize
+
+            return static_minimize(self, loss, parameters)
         loss.backward()
         self.step()
         return None, None

@@ -1,11 +1,21 @@
-"""paddle.static compatibility shims.
+"""paddle.static namespace.
 
-The reference's static-graph mode (ProgramDesc/PIR + Executor,
-python/paddle/static/) is subsumed by program capture (paddle_tpu.jit):
-jax tracing IS the static graph. This module keeps the high-traffic API
-names importable and functional where they map cleanly.
+Reference parity: python/paddle/static/ — Program/program_guard/data
+placeholders, Executor.run(feed, fetch_list), append_backward,
+save/load_inference_model, InputSpec. TPU-native: the "graph" is a recorded
+instruction list over pure jax fns (program.py) and the executor is one
+jax.jit replay (executor.py) — see those modules for the design mapping.
 """
 from ..jit.api import cond  # noqa: F401
+from .executor import Executor, append_backward, global_scope, scope_guard  # noqa: F401
+from .io import load_inference_model, save_inference_model  # noqa: F401
+from .program import (  # noqa: F401
+    Program,
+    data,
+    default_main_program,
+    default_startup_program,
+    program_guard,
+)
 
 
 class InputSpec:

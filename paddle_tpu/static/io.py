@@ -1,0 +1,113 @@
+"""save/load_inference_model for static programs.
+
+Reference parity: python/paddle/static/io.py — freeze a program to a
+deployable artifact. TPU-native: the artifact is the same jax.export
+(StableHLO) format paddle_tpu.jit.save uses; params are baked in as
+constants.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import export as jax_export
+
+from ..core.tensor import Tensor
+from .program import Program, default_main_program
+
+
+class _InferenceProgram:
+    """Result of load_inference_model; Executor.run dispatches to _run."""
+
+    def __init__(self, exported, feed_names, n_fetch):
+        self._exported = exported
+        self.feed_names = feed_names
+        self.n_fetch = n_fetch
+
+    def _run(self, feed, return_numpy=True):
+        args = [jnp.asarray(feed[n]) for n in self.feed_names]
+        out = self._exported.call(*args)
+        outs = list(out) if isinstance(out, (tuple, list)) else [out]
+        if return_numpy:
+            return [np.asarray(o) for o in outs]
+        return [Tensor(o) for o in outs]
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None, program=None, **kwargs):
+    """Freeze `program` (default: current main) to path_prefix.pdmodel +
+    .pdmeta. Weights are constants inside the StableHLO blob."""
+    program = program or default_main_program()
+    feed_vars = feed_vars if isinstance(feed_vars, (list, tuple)) else [feed_vars]
+    fetch_vars = fetch_vars if isinstance(fetch_vars, (list, tuple)) else [fetch_vars]
+    feed_ids, feed_names = [], []
+    for fv in feed_vars:
+        vid = program._id2var.get(id(fv))
+        if vid is None or vid not in program.feed_vars.values():
+            raise ValueError("feed_vars must be static.data placeholders of this program")
+        feed_ids.append(vid)
+        feed_names.append(fv.name)
+    fetch_ids = []
+    for fv in fetch_vars:
+        vid = program._id2var.get(id(fv))
+        if vid is None:
+            raise ValueError("fetch_vars must be outputs of this program")
+        fetch_ids.append(vid)
+
+    param_arrays = [program._var_tensors[v]._value for v in program.param_vars]
+
+    def infer_fn(*feed_arrays):
+        env = {}
+        for vid, arr in zip(feed_ids, feed_arrays):
+            env[vid] = arr
+        for vid, arr in zip(program.param_vars, param_arrays):
+            env[vid] = arr
+        for instr in program.ops:
+            args = [env[r[1]] if r[0] == "var" else r[1] for r in instr.in_refs]
+            out = instr.fn(*args, **instr.kwargs)
+            outs = out if isinstance(out, (tuple, list)) else (out,)
+            for vid, o in zip(instr.out_vars, outs):
+                env[vid] = o
+        return tuple(env[v] for v in fetch_ids)
+
+    # dynamic batch: feed placeholders keep their declared -1 dims
+    scope = jax_export.SymbolicScope()
+    specs = []
+    si = 0
+    for fv in feed_vars:
+        declared = program.feed_shapes.get(fv.name, tuple(fv.shape))
+        dims = []
+        dynamic = False
+        for d in declared:
+            if d in (-1, None):
+                dims.append(f"s{si}")
+                si += 1
+                dynamic = True
+            else:
+                dims.append(str(int(d)))
+        shape = jax_export.symbolic_shape(",".join(dims), scope=scope) if dynamic else tuple(int(d) for d in declared)
+        specs.append(jax.ShapeDtypeStruct(shape, fv._value.dtype))
+
+    exported = jax_export.export(jax.jit(infer_fn))(*specs)
+    d = os.path.dirname(path_prefix)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        f.write(exported.serialize())
+    with open(path_prefix + ".pdmeta", "wb") as f:
+        pickle.dump({"feed_names": feed_names, "n_fetch": len(fetch_ids)}, f)
+    return path_prefix
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    """Returns [program, feed_target_names, fetch_targets] like the
+    reference; fetch_targets are positional indices here (the artifact is a
+    compiled function, not a mutable graph)."""
+    with open(path_prefix + ".pdmodel", "rb") as f:
+        exported = jax_export.deserialize(f.read())
+    with open(path_prefix + ".pdmeta", "rb") as f:
+        meta = pickle.load(f)
+    prog = _InferenceProgram(exported, meta["feed_names"], meta["n_fetch"])
+    return [prog, list(meta["feed_names"]), list(range(meta["n_fetch"]))]

@@ -1,0 +1,92 @@
+"""Static-mode optimizer support: minimize() under program_guard.
+
+Reference parity: in static mode the reference's Optimizer.minimize appends
+backward + per-parameter update *ops* to the program
+(python/paddle/optimizer/optimizer.py `_append_optimize_op`). Here the
+appended "update op" is a pure jax function `(param, grad, lr, *accums) ->
+(new_param, *new_accums)`; accumulators are persistable tensors written back
+by the Executor after each run.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .executor import _OptUpdate, append_backward
+from .program import default_main_program
+
+
+def _sgd_update(p, g, lr):
+    return (p - lr.astype(p.dtype) * g.astype(p.dtype),)
+
+
+def _make_momentum_update(mu):
+    def upd(p, g, lr, vel):
+        v = mu * vel + g.astype(vel.dtype)
+        return p - lr.astype(p.dtype) * v.astype(p.dtype), v
+
+    return upd
+
+
+def _make_adam_update(b1, b2, eps, with_decoupled_wd=0.0):
+    def upd(p, g, lr, m, v, t):
+        t = t + 1
+        g32 = g.astype(m.dtype)
+        m2 = b1 * m + (1 - b1) * g32
+        v2 = b2 * v + (1 - b2) * g32 * g32
+        mhat = m2 / (1 - b1**t)
+        vhat = v2 / (1 - b2**t)
+        step = lr.astype(p.dtype) * (mhat / (jnp.sqrt(vhat) + eps)).astype(p.dtype)
+        newp = p - step
+        if with_decoupled_wd:
+            newp = newp - lr.astype(p.dtype) * with_decoupled_wd * p
+        return newp, m2, v2, t
+
+    return upd
+
+
+def static_minimize(optimizer, loss, parameters=None):
+    """Record backward + update instructions on the default main program.
+    Returns (None, params_grads) like the reference's minimize."""
+    from ..optimizer.optimizer import SGD, Adam, AdamW, Momentum
+
+    prog = default_main_program()
+    params = parameters if parameters is not None else [p for _, p in optimizer._all_params()]
+    params = [p for p in params if not p.stop_gradient]
+    pairs = append_backward(loss, parameter_list=params)
+
+    def lr_getter():
+        return optimizer.get_lr()
+
+    from ..optimizer.optimizer import _wd_value
+
+    clip = optimizer._grad_clip
+    coupled_wd = 0.0
+    if type(optimizer) is not AdamW:  # SGD/Momentum/Adam fold L2 into the grad
+        coupled_wd = _wd_value(optimizer._weight_decay) or 0.0
+    for p, g in pairs:
+        pv = prog.var_of(p)
+        gv = prog._id2var[id(g)]
+        if type(optimizer) is SGD:
+            fn, accums = _sgd_update, []
+        elif type(optimizer) is Momentum:
+            fn = _make_momentum_update(optimizer._momentum)
+            accums = [Tensor(jnp.zeros_like(p._value))]
+        elif type(optimizer) in (Adam, AdamW):
+            wd = 0.0
+            if type(optimizer) is AdamW:
+                wd = _wd_value(optimizer._weight_decay) or 0.0
+            fn = _make_adam_update(optimizer._beta1, optimizer._beta2, optimizer._eps, wd)
+            fdtype = jnp.float32 if p._value.dtype == jnp.bfloat16 else p._value.dtype
+            accums = [
+                Tensor(jnp.zeros(p._value.shape, fdtype)),
+                Tensor(jnp.zeros(p._value.shape, fdtype)),
+                Tensor(jnp.zeros((), jnp.int32)),
+            ]
+        else:
+            raise NotImplementedError(
+                f"static minimize supports SGD/Momentum/Adam/AdamW, got {type(optimizer).__name__}"
+            )
+        prog.opt_updates.append(_OptUpdate(pv, gv, fn, accums, lr_getter, clip=clip, wd=coupled_wd))
+    prog._compiled.clear()
+    return None, pairs

@@ -1,0 +1,193 @@
+"""Static-graph Program IR.
+
+Reference parity: the ProgramDesc/PIR Program + build-by-append model
+(paddle/fluid/framework/program_desc.h:33, python/paddle/base/framework.py
+Program/Block). TPU-native design: under `program_guard`, every op that goes
+through core.apply is recorded as an instruction (pure jax fn + SSA var refs)
+while still executing eagerly on placeholder values — concrete eager
+evaluation IS the shape/dtype inference (InferMeta). The Executor then
+replays the instruction list inside one `jax.jit`, which is the
+PirInterpreter+CINN role collapsed into XLA whole-program compilation.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..core import state
+from ..core.tensor import Tensor
+
+
+class OpInstr:
+    """One recorded op: out_vars = fn(*in_refs, **kwargs)."""
+
+    __slots__ = ("name", "fn", "in_refs", "kwargs", "out_vars")
+
+    def __init__(self, name, fn, in_refs, kwargs, out_vars):
+        self.name = name
+        self.fn = fn
+        self.in_refs = in_refs  # list of ("var", var_id) | ("lit", value)
+        self.kwargs = kwargs
+        self.out_vars = out_vars  # list of var_id
+
+    def __repr__(self):
+        ins = [f"v{r[1]}" if r[0] == "var" else repr(r[1]) for r in self.in_refs]
+        return f"{[f'v{v}' for v in self.out_vars]} = {self.name}({', '.join(ins)})"
+
+
+class Program:
+    """A recorded instruction list with feed/param/fetch bookkeeping."""
+
+    def __init__(self):
+        self.ops: List[OpInstr] = []
+        self.feed_vars: Dict[str, int] = {}  # feed name -> var id
+        self.feed_shapes: Dict[str, tuple] = {}  # declared shapes (-1 = dynamic)
+        self._id2var: Dict[int, int] = {}  # id(Tensor) -> var id
+        self._var_tensors: Dict[int, Tensor] = {}  # var id -> Tensor (keepalive)
+        self.param_vars: List[int] = []  # external persistable inputs (Parameters etc.)
+        self.grad_requests: List[Tuple[int, List[int], List[int]]] = []  # (loss, params, grad vars)
+        self.opt_updates: List = []  # _OptUpdate records (see executor)
+        self._next_var = 0
+        self._compiled = {}
+        self._rng_seed = 0
+
+    # ---- var management ----
+    def _new_var(self, tensor: Optional[Tensor] = None) -> int:
+        vid = self._next_var
+        self._next_var += 1
+        if tensor is not None:
+            self._id2var[id(tensor)] = vid
+            self._var_tensors[vid] = tensor
+        return vid
+
+    def var_of(self, tensor: Tensor, external_ok=True) -> int:
+        """Var id of a Tensor; unseen tensors become external persistable
+        inputs (parameters / captured constants), read fresh at each run."""
+        vid = self._id2var.get(id(tensor))
+        if vid is None:
+            if not external_ok:
+                raise KeyError("tensor is not part of this program")
+            vid = self._new_var(tensor)
+            self.param_vars.append(vid)
+        return vid
+
+    def add_feed(self, name: str, tensor: Tensor) -> int:
+        vid = self._new_var(tensor)
+        self.feed_vars[name] = vid
+        return vid
+
+    # ---- recording (called from core.apply) ----
+    def record_op(self, name, fn, args, kwargs, outs):
+        in_refs = []
+        for a in args:
+            if isinstance(a, Tensor):
+                in_refs.append(("var", self.var_of(a)))
+            else:
+                in_refs.append(("lit", a))
+        out_list = outs if isinstance(outs, (tuple, list)) else [outs]
+        out_vars = [self._new_var(o) for o in out_list if isinstance(o, Tensor)]
+        self.ops.append(OpInstr(name, fn, in_refs, dict(kwargs), out_vars))
+        self._compiled.clear()
+
+    # ---- introspection ----
+    def list_vars(self):
+        return list(self._var_tensors.values())
+
+    def global_block(self):
+        return self
+
+    def all_parameters(self):
+        from ..nn.layer import Parameter
+
+        return [
+            self._var_tensors[v]
+            for v in self.param_vars
+            if isinstance(self._var_tensors.get(v), Parameter)
+        ]
+
+    def __repr__(self):
+        lines = [f"Program(feeds={list(self.feed_vars)}, params={len(self.param_vars)} ops={len(self.ops)})"]
+        lines += [f"  {op!r}" for op in self.ops]
+        return "\n".join(lines)
+
+    clone = None  # assigned below
+
+
+def _clone(self, for_test=False):
+    import copy
+
+    p = Program()
+    p.ops = list(self.ops)
+    p.feed_vars = dict(self.feed_vars)
+    p.feed_shapes = dict(self.feed_shapes)
+    p._id2var = dict(self._id2var)
+    p._var_tensors = dict(self._var_tensors)
+    p.param_vars = list(self.param_vars)
+    p.grad_requests = [] if for_test else list(self.grad_requests)
+    p.opt_updates = [] if for_test else list(self.opt_updates)
+    p._next_var = self._next_var
+    return p
+
+
+Program.clone = _clone
+
+
+# ---- global default programs (paddle.static.default_main_program) ----
+
+_default_main = Program()
+_default_startup = Program()
+
+
+def default_main_program() -> Program:
+    return _default_main
+
+
+def default_startup_program() -> Program:
+    return _default_startup
+
+
+class program_guard:
+    """paddle.static.program_guard parity: activates instruction capture."""
+
+    def __init__(self, main_program: Program, startup_program: Optional[Program] = None):
+        self.main = main_program
+        self.startup = startup_program
+
+    def __enter__(self):
+        global _default_main, _default_startup
+        self._prev_main = _default_main
+        self._prev_startup = _default_startup
+        _default_main = self.main
+        if self.startup is not None:
+            _default_startup = self.startup
+        self._prev_capture = state.set_program_capture(self.main)
+        return self
+
+    def __exit__(self, *exc):
+        global _default_main, _default_startup
+        _default_main = self._prev_main
+        _default_startup = self._prev_startup
+        state.set_program_capture(self._prev_capture)
+        return False
+
+
+def data(name: str, shape, dtype="float32", lod_level=0) -> Tensor:
+    """paddle.static.data parity: a feed placeholder. The returned Tensor
+    carries zeros of the given shape (dims of -1/None become 1 for the
+    eager dry-run; the Executor re-traces per concrete feed shape).
+
+    Caveat (same class of limitation as dy2static shape specialization):
+    Python-level reads of a dynamic dim during capture (e.g.
+    ``x.reshape([x.shape[0], -1])``) bake the dry-run size 1 into the
+    program — pass -1 to reshape/view for batch-polymorphic programs."""
+    from ..framework.dtype import convert_dtype
+
+    prog = state.get_program_capture()
+    if prog is None:
+        raise RuntimeError("static.data must be called under paddle.static.program_guard")
+    dims = tuple(1 if d in (-1, None) else int(d) for d in shape)
+    t = Tensor(np.zeros(dims, dtype=np.dtype(convert_dtype(dtype))), stop_gradient=True, name=name)
+    prog.add_feed(name, t)
+    prog.feed_shapes[name] = tuple(shape)
+    return t

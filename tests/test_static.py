@@ -1,0 +1,179 @@
+"""Static graph: program capture, Executor feed/fetch, append_backward,
+static minimize training, save/load_inference_model."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+
+
+def test_program_capture_and_fetch():
+    main = static.Program()
+    startup = static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [-1, 4], "float32")
+        y = paddle.matmul(x, paddle.ones([4, 2])) + 1.0
+    assert len(main.ops) >= 2
+    exe = static.Executor()
+    feed_x = np.arange(8, dtype="float32").reshape(2, 4)
+    (out,) = exe.run(main, feed={"x": feed_x}, fetch_list=[y])
+    np.testing.assert_allclose(out, feed_x @ np.ones((4, 2), "float32") + 1.0)
+    # different batch size: executor re-jits transparently
+    feed_x8 = np.ones((8, 4), "float32")
+    (out8,) = exe.run(main, feed={"x": feed_x8}, fetch_list=[y])
+    assert out8.shape == (8, 2)
+
+
+def test_layers_under_program_guard():
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        x = static.data("x", [3, 4], "float32")
+        net = paddle.nn.Linear(4, 5)
+        out = net(x)
+    exe = static.Executor()
+    xv = np.random.RandomState(0).randn(3, 4).astype("float32")
+    (got,) = exe.run(main, feed={"x": xv}, fetch_list=[out])
+    want = xv @ net.weight.numpy() + net.bias.numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_append_backward_grads():
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        x = static.data("x", [2, 3], "float32")
+        lin = paddle.nn.Linear(3, 1)
+        loss = (lin(x) ** 2).mean()
+        pairs = static.append_backward(loss)
+    assert len(pairs) == 2  # weight + bias
+    exe = static.Executor()
+    xv = np.ones((2, 3), "float32")
+    outs = exe.run(main, feed={"x": xv}, fetch_list=[loss] + [g for _, g in pairs])
+    assert outs[0].shape == ()
+    assert outs[1].shape == (3, 1) and np.abs(outs[1]).sum() > 0
+
+
+@pytest.mark.parametrize("opt_name", ["sgd", "momentum", "adam", "adamw"])
+def test_static_training_converges(opt_name):
+    rng = np.random.RandomState(0)
+    xs = rng.randn(64, 3).astype("float32")
+    w_true = np.array([[1.5], [-2.0], [0.5]], "float32")
+    ys = xs @ w_true + 0.3
+
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        x = static.data("x", [-1, 3], "float32")
+        y = static.data("y", [-1, 1], "float32")
+        lin = paddle.nn.Linear(3, 1)
+        pred = lin(x)
+        loss = ((pred - y) ** 2).mean()
+        opt = {
+            "sgd": lambda: paddle.optimizer.SGD(0.1, parameters=lin.parameters()),
+            "momentum": lambda: paddle.optimizer.Momentum(0.05, parameters=lin.parameters()),
+            "adam": lambda: paddle.optimizer.Adam(0.1, parameters=lin.parameters()),
+            "adamw": lambda: paddle.optimizer.AdamW(0.1, parameters=lin.parameters()),
+        }[opt_name]()
+        opt.minimize(loss)
+    exe = static.Executor()
+    losses = []
+    for _ in range(60):
+        (lv,) = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.05, losses[::20]
+    # parameters were updated in place
+    np.testing.assert_allclose(lin.weight.numpy(), w_true, atol=0.4)
+
+
+def test_program_clone_for_test_drops_updates():
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        x = static.data("x", [2, 2], "float32")
+        lin = paddle.nn.Linear(2, 1)
+        loss = lin(x).sum()
+        paddle.optimizer.SGD(0.1, parameters=lin.parameters()).minimize(loss)
+    test_prog = main.clone(for_test=True)
+    assert test_prog.opt_updates == [] and test_prog.grad_requests == []
+    w0 = lin.weight.numpy().copy()
+    exe = static.Executor()
+    exe.run(test_prog, feed={"x": np.ones((2, 2), "float32")}, fetch_list=[loss])
+    np.testing.assert_array_equal(lin.weight.numpy(), w0)  # eval: no update
+
+
+def test_save_load_inference_model(tmp_path):
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        x = static.data("x", [-1, 4], "float32")
+        lin = paddle.nn.Linear(4, 2)
+        out = paddle.nn.functional.softmax(lin(x), axis=-1)
+    exe = static.Executor()
+    prefix = str(tmp_path / "infer" / "model")
+    static.save_inference_model(prefix, [x], [out], exe, program=main)
+
+    prog, feed_names, fetch_targets = static.load_inference_model(prefix, exe)
+    assert feed_names == ["x"]
+    for bs in (2, 5):
+        xv = np.random.RandomState(bs).randn(bs, 4).astype("float32")
+        (got,) = exe.run(prog, feed={"x": xv}, fetch_list=fetch_targets)
+        (want,) = exe.run(main, feed={"x": xv}, fetch_list=[out])
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_fetch_by_name_and_errors():
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        x = static.data("x", [2], "float32")
+        y = x * 2.0
+    exe = static.Executor()
+    (got,) = exe.run(main, feed={"x": np.array([1.0, 2.0], "float32")}, fetch_list=["x"])
+    np.testing.assert_array_equal(got, [1.0, 2.0])
+    with pytest.raises(ValueError):
+        exe.run(main, feed={"x": np.zeros(2, "float32")}, fetch_list=[paddle.ones([2])])
+
+
+def test_two_append_backward_requests_independent():
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        x = static.data("x", [2, 2], "float32")
+        lin = paddle.nn.Linear(2, 1)
+        loss1 = lin(x).sum()
+        loss2 = (lin(x) ** 2).sum() * 0.0  # grad must be exactly 0
+        pairs1 = static.append_backward(loss1, parameter_list=[lin.weight])
+        pairs2 = static.append_backward(loss2, parameter_list=[lin.weight])
+    exe = static.Executor()
+    xv = np.ones((2, 2), "float32")
+    g1, g2 = exe.run(main, feed={"x": xv}, fetch_list=[pairs1[0][1], pairs2[0][1]])
+    np.testing.assert_allclose(g1, np.full((2, 1), 2.0), rtol=1e-6)  # d(sum(Wx+b))/dW
+    np.testing.assert_allclose(g2, np.zeros((2, 1)), atol=1e-7)  # NOT contaminated by loss1
+
+
+def test_static_minimize_with_clip_and_wd():
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        x = static.data("x", [4, 3], "float32")
+        lin = paddle.nn.Linear(3, 1)
+        loss = (lin(x) * 100.0).sum()  # huge grads -> clip must engage
+        opt = paddle.optimizer.SGD(
+            0.1,
+            parameters=lin.parameters(),
+            weight_decay=0.01,
+            grad_clip=paddle.nn.ClipGradByGlobalNorm(1.0),
+        )
+        opt.minimize(loss)
+    w0 = lin.weight.numpy().copy()
+    exe = static.Executor()
+    exe.run(main, feed={"x": np.ones((4, 3), "float32")}, fetch_list=[loss])
+    delta = np.abs(lin.weight.numpy() - w0).max()
+    # clipped global grad norm <= 1 -> per-step delta bounded by lr*(1 + wd*|w|)
+    assert 0 < delta <= 0.1 * (1.0 + 0.01 * np.abs(w0).max()) + 1e-6
+
+
+def test_external_int_tensor_does_not_break_grads():
+    idx = paddle.to_tensor(np.array([0, 1], "int64"))  # created OUTSIDE guard
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        x = static.data("x", [2, 3], "float32")
+        emb = paddle.nn.Embedding(4, 3)
+        loss = (emb(idx).sum() + x.sum())
+        pairs = static.append_backward(loss, parameter_list=[emb.weight])
+    exe = static.Executor()
+    (g,) = exe.run(main, feed={"x": np.zeros((2, 3), "float32")}, fetch_list=[pairs[0][1]])
+    assert g.shape == (4, 3) and g[:2].sum() > 0
