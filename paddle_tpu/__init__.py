@@ -150,6 +150,10 @@ from .framework.io import load, save  # noqa: E402,F401
 from .jit import to_static  # noqa: E402,F401
 from . import hapi  # noqa: E402,F401
 from . import profiler  # noqa: E402,F401
+from . import distribution  # noqa: E402,F401
+from . import sparse  # noqa: E402,F401
+from . import fft  # noqa: E402,F401
+from . import signal  # noqa: E402,F401
 from . import callbacks  # noqa: E402,F401
 from .hapi import Model, summary  # noqa: E402,F401
 

@@ -1,0 +1,47 @@
+"""paddle.distribution namespace.
+
+Reference parity: python/paddle/distribution/ (8.1 kLoC torch.distributions-
+like library): Distribution base with sample/rsample/log_prob/entropy/kl,
+concrete families, and a kl_divergence registry. TPU-native: densities are
+pure jnp expressions (jit/vmap-compatible); sampling draws from the global
+framework Generator (framework/random.py) so paddle.seed governs it.
+"""
+from .distribution import Distribution  # noqa: F401
+from .normal import LogNormal, Normal  # noqa: F401
+from .uniform import Uniform  # noqa: F401
+from .categorical import Categorical  # noqa: F401
+from .bernoulli import Bernoulli  # noqa: F401
+from .beta import Beta  # noqa: F401
+from .dirichlet import Dirichlet  # noqa: F401
+from .exponential import Exponential  # noqa: F401
+from .gamma import Gamma  # noqa: F401
+from .geometric import Geometric  # noqa: F401
+from .gumbel import Gumbel  # noqa: F401
+from .laplace import Laplace  # noqa: F401
+from .multinomial import Multinomial  # noqa: F401
+from .poisson import Poisson  # noqa: F401
+from .independent import Independent  # noqa: F401
+from .transformed_distribution import TransformedDistribution  # noqa: F401
+from .kl import kl_divergence, register_kl  # noqa: F401
+
+__all__ = [
+    "Distribution",
+    "Normal",
+    "LogNormal",
+    "Uniform",
+    "Categorical",
+    "Bernoulli",
+    "Beta",
+    "Dirichlet",
+    "Exponential",
+    "Gamma",
+    "Geometric",
+    "Gumbel",
+    "Laplace",
+    "Multinomial",
+    "Poisson",
+    "Independent",
+    "TransformedDistribution",
+    "kl_divergence",
+    "register_kl",
+]

@@ -1,0 +1,42 @@
+"""Bernoulli (reference: python/paddle/distribution/bernoulli.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _as_value, _key, _wrap
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs_v = _as_value(probs)
+        super().__init__(batch_shape=self.probs_v.shape)
+
+    @property
+    def mean(self):
+        return _wrap(self.probs_v)
+
+    @property
+    def variance(self):
+        return _wrap(self.probs_v * (1 - self.probs_v))
+
+    def sample(self, shape=()):
+        shp = self._extend_shape(shape)
+        return _wrap(jax.random.bernoulli(_key(), self.probs_v, shp).astype(jnp.float32))
+
+    def rsample(self, shape=(), temperature=1.0):
+        # Gumbel-softmax style relaxation (reference rsample uses temperature)
+        shp = self._extend_shape(shape)
+        u = jax.random.uniform(_key(), shp, jnp.float32, 1e-6, 1 - 1e-6)
+        logits = jnp.log(self.probs_v) - jnp.log1p(-self.probs_v)
+        z = (logits + jnp.log(u) - jnp.log1p(-u)) / temperature
+        return _wrap(jax.nn.sigmoid(z))
+
+    def log_prob(self, value):
+        v = _as_value(value)
+        p = jnp.clip(self.probs_v, 1e-7, 1 - 1e-7)
+        return _wrap(v * jnp.log(p) + (1 - v) * jnp.log1p(-p))
+
+    def entropy(self):
+        p = jnp.clip(self.probs_v, 1e-7, 1 - 1e-7)
+        return _wrap(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)))

@@ -1,0 +1,46 @@
+"""Beta (reference: python/paddle/distribution/beta.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _as_value, _key, _wrap
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta):
+        self.alpha = _as_value(alpha)
+        self.beta = _as_value(beta)
+        super().__init__(batch_shape=jnp.broadcast_shapes(self.alpha.shape, self.beta.shape))
+
+    @property
+    def mean(self):
+        return _wrap(self.alpha / (self.alpha + self.beta))
+
+    @property
+    def variance(self):
+        s = self.alpha + self.beta
+        return _wrap(self.alpha * self.beta / (s**2 * (s + 1)))
+
+    def sample(self, shape=()):
+        shp = self._extend_shape(shape)
+        return _wrap(jax.random.beta(_key(), self.alpha, self.beta, shp))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _as_value(value)
+        lbeta = (
+            jax.scipy.special.gammaln(self.alpha)
+            + jax.scipy.special.gammaln(self.beta)
+            - jax.scipy.special.gammaln(self.alpha + self.beta)
+        )
+        return _wrap((self.alpha - 1) * jnp.log(v) + (self.beta - 1) * jnp.log1p(-v) - lbeta)
+
+    def entropy(self):
+        a, b = self.alpha, self.beta
+        dg = jax.scipy.special.digamma
+        lbeta = (
+            jax.scipy.special.gammaln(a) + jax.scipy.special.gammaln(b) - jax.scipy.special.gammaln(a + b)
+        )
+        return _wrap(lbeta - (a - 1) * dg(a) - (b - 1) * dg(b) + (a + b - 2) * dg(a + b))

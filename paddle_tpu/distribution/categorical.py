@@ -1,0 +1,38 @@
+"""Categorical + Multinomial-adjacent (reference: python/paddle/distribution/categorical.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _as_value, _key, _wrap
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        self.logits = _as_value(logits)
+        self._log_norm = self.logits - jax.scipy.special.logsumexp(self.logits, axis=-1, keepdims=True)
+        super().__init__(batch_shape=self.logits.shape[:-1])
+
+    @property
+    def probs(self):
+        return _wrap(jnp.exp(self._log_norm))
+
+    def sample(self, shape=()):
+        shp = self._extend_shape(shape)
+        return _wrap(jax.random.categorical(_key(), self.logits, shape=shp))
+
+    def log_prob(self, value):
+        idx = _as_value(value, jnp.int32).astype(jnp.int32)
+        return _wrap(jnp.take_along_axis(self._log_norm, idx[..., None], axis=-1)[..., 0])
+
+    def probabilities(self, value):
+        return _wrap(jnp.exp(self.log_prob(value)._value))
+
+    def entropy(self):
+        p = jnp.exp(self._log_norm)
+        return _wrap(-jnp.sum(p * self._log_norm, axis=-1))
+
+    def kl_divergence(self, other):
+        # explicit: paddle's Categorical exposes kl_divergence(other) directly
+        p = jnp.exp(self._log_norm)
+        return _wrap(jnp.sum(p * (self._log_norm - other._log_norm), axis=-1))

@@ -1,0 +1,47 @@
+"""Dirichlet (reference: python/paddle/distribution/dirichlet.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _as_value, _key, _wrap
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration):
+        self.concentration = _as_value(concentration)
+        super().__init__(
+            batch_shape=self.concentration.shape[:-1], event_shape=self.concentration.shape[-1:]
+        )
+
+    @property
+    def mean(self):
+        return _wrap(self.concentration / jnp.sum(self.concentration, -1, keepdims=True))
+
+    @property
+    def variance(self):
+        a0 = jnp.sum(self.concentration, -1, keepdims=True)
+        m = self.concentration / a0
+        return _wrap(m * (1 - m) / (a0 + 1))
+
+    def sample(self, shape=()):
+        if isinstance(shape, int):
+            shape = (shape,)
+        shp = tuple(shape) + self.batch_shape
+        return _wrap(jax.random.dirichlet(_key(), self.concentration, shp))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _as_value(value)
+        a = self.concentration
+        lnorm = jnp.sum(jax.scipy.special.gammaln(a), -1) - jax.scipy.special.gammaln(jnp.sum(a, -1))
+        return _wrap(jnp.sum((a - 1) * jnp.log(v), -1) - lnorm)
+
+    def entropy(self):
+        a = self.concentration
+        a0 = jnp.sum(a, -1)
+        k = a.shape[-1]
+        dg = jax.scipy.special.digamma
+        lnorm = jnp.sum(jax.scipy.special.gammaln(a), -1) - jax.scipy.special.gammaln(a0)
+        return _wrap(lnorm + (a0 - k) * dg(a0) - jnp.sum((a - 1) * dg(a), -1))

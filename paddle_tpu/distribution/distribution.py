@@ -1,0 +1,71 @@
+"""Distribution base class (reference: python/paddle/distribution/distribution.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..framework import random as random_mod
+
+
+def _as_value(x, dtype=jnp.float32):
+    if isinstance(x, Tensor):
+        v = x._value
+        return v.astype(dtype) if jnp.issubdtype(v.dtype, jnp.integer) else v
+    return jnp.asarray(x, dtype)
+
+
+def _key():
+    return random_mod.next_key()
+
+
+def _wrap(v) -> Tensor:
+    return Tensor(v, stop_gradient=True)
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return _wrap(jnp.exp(self.log_prob(value)._value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        from .kl import kl_divergence
+
+        return kl_divergence(self, other)
+
+    def _extend_shape(self, sample_shape):
+        if isinstance(sample_shape, (int, np.integer)):
+            sample_shape = (int(sample_shape),)
+        return tuple(sample_shape) + self._batch_shape + self._event_shape

@@ -1,0 +1,34 @@
+"""Exponential (reference: python/paddle/distribution/exponential.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _as_value, _key, _wrap
+
+
+class Exponential(Distribution):
+    def __init__(self, rate):
+        self.rate = _as_value(rate)
+        super().__init__(batch_shape=self.rate.shape)
+
+    @property
+    def mean(self):
+        return _wrap(1.0 / self.rate)
+
+    @property
+    def variance(self):
+        return _wrap(1.0 / self.rate**2)
+
+    def sample(self, shape=()):
+        shp = self._extend_shape(shape)
+        return _wrap(jax.random.exponential(_key(), shp, jnp.float32) / self.rate)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _as_value(value)
+        return _wrap(jnp.log(self.rate) - self.rate * v)
+
+    def entropy(self):
+        return _wrap(1.0 - jnp.log(self.rate))

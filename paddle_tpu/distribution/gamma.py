@@ -1,0 +1,40 @@
+"""Gamma (reference: python/paddle/distribution/gamma.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _as_value, _key, _wrap
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate):
+        self.concentration = _as_value(concentration)
+        self.rate = _as_value(rate)
+        super().__init__(
+            batch_shape=jnp.broadcast_shapes(self.concentration.shape, self.rate.shape)
+        )
+
+    @property
+    def mean(self):
+        return _wrap(self.concentration / self.rate)
+
+    @property
+    def variance(self):
+        return _wrap(self.concentration / self.rate**2)
+
+    def sample(self, shape=()):
+        shp = self._extend_shape(shape)
+        return _wrap(jax.random.gamma(_key(), self.concentration, shp) / self.rate)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _as_value(value)
+        a, b = self.concentration, self.rate
+        return _wrap(a * jnp.log(b) + (a - 1) * jnp.log(v) - b * v - jax.scipy.special.gammaln(a))
+
+    def entropy(self):
+        a, b = self.concentration, self.rate
+        dg = jax.scipy.special.digamma
+        return _wrap(a - jnp.log(b) + jax.scipy.special.gammaln(a) + (1 - a) * dg(a))

@@ -1,0 +1,37 @@
+"""Geometric (reference: python/paddle/distribution/geometric.py).
+Counts failures before the first success (support {0, 1, ...})."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _as_value, _key, _wrap
+
+
+class Geometric(Distribution):
+    def __init__(self, probs):
+        self.probs_v = _as_value(probs)
+        super().__init__(batch_shape=self.probs_v.shape)
+
+    @property
+    def mean(self):
+        return _wrap((1 - self.probs_v) / self.probs_v)
+
+    @property
+    def variance(self):
+        return _wrap((1 - self.probs_v) / self.probs_v**2)
+
+    def sample(self, shape=()):
+        shp = self._extend_shape(shape)
+        u = jax.random.uniform(_key(), shp, jnp.float32, 1e-7, 1.0)
+        return _wrap(jnp.floor(jnp.log(u) / jnp.log1p(-self.probs_v)))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _as_value(value)
+        return _wrap(v * jnp.log1p(-self.probs_v) + jnp.log(self.probs_v))
+
+    def entropy(self):
+        p = self.probs_v
+        return _wrap(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)) / p)

@@ -1,0 +1,41 @@
+"""Gumbel (reference: python/paddle/distribution/gumbel.py)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _as_value, _key, _wrap
+
+_EULER = 0.57721566490153286
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _as_value(loc)
+        self.scale = _as_value(scale)
+        super().__init__(batch_shape=jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        return _wrap(self.loc + self.scale * _EULER)
+
+    @property
+    def variance(self):
+        return _wrap((math.pi**2 / 6) * self.scale**2)
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        shp = self._extend_shape(shape)
+        g = jax.random.gumbel(_key(), shp, jnp.float32)
+        return _wrap(self.loc + self.scale * g)
+
+    def log_prob(self, value):
+        z = (_as_value(value) - self.loc) / self.scale
+        return _wrap(-(z + jnp.exp(-z)) - jnp.log(self.scale))
+
+    def entropy(self):
+        return _wrap(jnp.log(jnp.broadcast_to(self.scale, self.batch_shape)) + 1 + _EULER)

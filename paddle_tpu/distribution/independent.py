@@ -1,0 +1,42 @@
+"""Independent (reference: python/paddle/distribution/independent.py):
+reinterprets batch dims of a base distribution as event dims."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .distribution import Distribution, _wrap
+
+
+class Independent(Distribution):
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.reinterpreted_batch_rank = int(reinterpreted_batch_rank)
+        shape = base.batch_shape + base.event_shape
+        split = len(base.batch_shape) - self.reinterpreted_batch_rank
+        if split < 0:
+            raise ValueError("reinterpreted_batch_rank exceeds base batch rank")
+        super().__init__(batch_shape=shape[:split], event_shape=shape[split:])
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)._value
+        axes = tuple(range(lp.ndim - self.reinterpreted_batch_rank, lp.ndim))
+        return _wrap(jnp.sum(lp, axis=axes) if axes else lp)
+
+    def entropy(self):
+        e = self.base.entropy()._value
+        axes = tuple(range(e.ndim - self.reinterpreted_batch_rank, e.ndim))
+        return _wrap(jnp.sum(e, axis=axes) if axes else e)

@@ -1,0 +1,144 @@
+"""KL divergence registry (reference: python/paddle/distribution/kl.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import _wrap
+
+_KL_REGISTRY = {}
+
+
+def register_kl(type_p, type_q):
+    def decorator(fn):
+        _KL_REGISTRY[(type_p, type_q)] = fn
+        return fn
+
+    return decorator
+
+
+def kl_divergence(p, q):
+    fn = _KL_REGISTRY.get((type(p), type(q)))
+    if fn is None:
+        for (tp, tq), f in _KL_REGISTRY.items():
+            if isinstance(p, tp) and isinstance(q, tq):
+                fn = f
+                break
+    if fn is None:
+        raise NotImplementedError(f"no KL registered for ({type(p).__name__}, {type(q).__name__})")
+    return fn(p, q)
+
+
+# ---- standard pairs ----
+from .normal import Normal  # noqa: E402
+from .uniform import Uniform  # noqa: E402
+from .categorical import Categorical  # noqa: E402
+from .bernoulli import Bernoulli  # noqa: E402
+from .beta import Beta  # noqa: E402
+from .dirichlet import Dirichlet  # noqa: E402
+from .exponential import Exponential  # noqa: E402
+from .gamma import Gamma  # noqa: E402
+from .geometric import Geometric  # noqa: E402
+from .laplace import Laplace  # noqa: E402
+from .poisson import Poisson  # noqa: E402
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    var_ratio = (p.scale / q.scale) ** 2
+    t1 = ((p.loc - q.loc) / q.scale) ** 2
+    return _wrap(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform_uniform(p, q):
+    lo = p.low >= q.low
+    hi = p.high <= q.high
+    kl = jnp.log((q.high - q.low) / (p.high - p.low))
+    return _wrap(jnp.where(lo & hi, kl, jnp.inf))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical_categorical(p, q):
+    pp = jnp.exp(p._log_norm)
+    return _wrap(jnp.sum(pp * (p._log_norm - q._log_norm), -1))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli_bernoulli(p, q):
+    pa = jnp.clip(p.probs_v, 1e-7, 1 - 1e-7)
+    qa = jnp.clip(q.probs_v, 1e-7, 1 - 1e-7)
+    return _wrap(pa * (jnp.log(pa) - jnp.log(qa)) + (1 - pa) * (jnp.log1p(-pa) - jnp.log1p(-qa)))
+
+
+@register_kl(Beta, Beta)
+def _kl_beta_beta(p, q):
+    gl = jax.scipy.special.gammaln
+    dg = jax.scipy.special.digamma
+    pa, pb = p.alpha, p.beta
+    qa, qb = q.alpha, q.beta
+    t = (
+        gl(qa) + gl(qb) - gl(qa + qb) - (gl(pa) + gl(pb) - gl(pa + pb))
+        + (pa - qa) * dg(pa)
+        + (pb - qb) * dg(pb)
+        + (qa + qb - pa - pb) * dg(pa + pb)
+    )
+    return _wrap(t)
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet_dirichlet(p, q):
+    gl = jax.scipy.special.gammaln
+    dg = jax.scipy.special.digamma
+    pa, qa = p.concentration, q.concentration
+    pa0 = jnp.sum(pa, -1)
+    t = (
+        gl(pa0)
+        - jnp.sum(gl(pa), -1)
+        - gl(jnp.sum(qa, -1))
+        + jnp.sum(gl(qa), -1)
+        + jnp.sum((pa - qa) * (dg(pa) - dg(pa0)[..., None]), -1)
+    )
+    return _wrap(t)
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential_exponential(p, q):
+    r = q.rate / p.rate
+    return _wrap(jnp.log(1 / r) + r - 1)
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma_gamma(p, q):
+    gl = jax.scipy.special.gammaln
+    dg = jax.scipy.special.digamma
+    t = (
+        (p.concentration - q.concentration) * dg(p.concentration)
+        - gl(p.concentration)
+        + gl(q.concentration)
+        + q.concentration * (jnp.log(p.rate) - jnp.log(q.rate))
+        + p.concentration * (q.rate / p.rate - 1)
+    )
+    return _wrap(t)
+
+
+@register_kl(Geometric, Geometric)
+def _kl_geometric_geometric(p, q):
+    pp, qp = p.probs_v, q.probs_v
+    return _wrap(
+        (jnp.log(pp) - jnp.log(qp)) + (1 - pp) / pp * (jnp.log1p(-pp) - jnp.log1p(-qp))
+    )
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace_laplace(p, q):
+    # log(b2/b1) + (b1*exp(-|u1-u2|/b1) + |u1-u2|)/b2 - 1
+    d = jnp.abs(p.loc - q.loc)
+    return _wrap(
+        jnp.log(q.scale / p.scale) + (p.scale * jnp.exp(-d / p.scale) + d) / q.scale - 1
+    )
+
+
+@register_kl(Poisson, Poisson)
+def _kl_poisson_poisson(p, q):
+    return _wrap(p.rate * (jnp.log(p.rate) - jnp.log(q.rate)) - p.rate + q.rate)

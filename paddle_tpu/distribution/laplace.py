@@ -1,0 +1,49 @@
+"""Laplace (reference: python/paddle/distribution/laplace.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _as_value, _key, _wrap
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _as_value(loc)
+        self.scale = _as_value(scale)
+        super().__init__(batch_shape=jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return _wrap(jnp.broadcast_to(2 * self.scale**2, self.batch_shape))
+
+    @property
+    def stddev(self):
+        return _wrap(jnp.broadcast_to(jnp.sqrt(2.0) * self.scale, self.batch_shape))
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        shp = self._extend_shape(shape)
+        u = jax.random.uniform(_key(), shp, jnp.float32, -0.5 + 1e-7, 0.5)
+        return _wrap(self.loc - self.scale * jnp.sign(u) * jnp.log1p(-2 * jnp.abs(u)))
+
+    def log_prob(self, value):
+        v = _as_value(value)
+        return _wrap(-jnp.abs(v - self.loc) / self.scale - jnp.log(2 * self.scale))
+
+    def entropy(self):
+        return _wrap(1 + jnp.log(2 * jnp.broadcast_to(self.scale, self.batch_shape)))
+
+    def cdf(self, value):
+        z = (_as_value(value) - self.loc) / self.scale
+        return _wrap(0.5 - 0.5 * jnp.sign(z) * jnp.expm1(-jnp.abs(z)))
+
+    def icdf(self, value):
+        p = _as_value(value) - 0.5
+        return _wrap(self.loc - self.scale * jnp.sign(p) * jnp.log1p(-2 * jnp.abs(p)))

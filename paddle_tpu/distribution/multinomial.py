@@ -1,0 +1,48 @@
+"""Multinomial (reference: python/paddle/distribution/multinomial.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _as_value, _key, _wrap
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs):
+        self.total_count = int(total_count)
+        p = _as_value(probs)
+        self.probs_v = p / jnp.sum(p, -1, keepdims=True)
+        super().__init__(batch_shape=p.shape[:-1], event_shape=p.shape[-1:])
+
+    @property
+    def mean(self):
+        return _wrap(self.total_count * self.probs_v)
+
+    @property
+    def variance(self):
+        return _wrap(self.total_count * self.probs_v * (1 - self.probs_v))
+
+    def sample(self, shape=()):
+        if isinstance(shape, int):
+            shape = (shape,)
+        logits = jnp.log(self.probs_v)
+        draw_shape = tuple(shape) + self.batch_shape + (self.total_count,)
+        cats = jax.random.categorical(_key(), logits, shape=draw_shape)
+        k = self.probs_v.shape[-1]
+        counts = jax.nn.one_hot(cats, k, dtype=jnp.float32).sum(-2)
+        return _wrap(counts)
+
+    def log_prob(self, value):
+        v = _as_value(value)
+        logf = jax.scipy.special.gammaln
+        return _wrap(
+            logf(jnp.asarray(self.total_count + 1.0))
+            - jnp.sum(logf(v + 1.0), -1)
+            + jnp.sum(v * jnp.log(self.probs_v), -1)
+        )
+
+    def entropy(self):
+        # no closed form; Monte-Carlo estimate (matches reference behavior of
+        # exposing entropy only approximately for Multinomial)
+        s = self.sample((128,))._value
+        return _wrap(-jnp.mean(self.log_prob(_wrap(s))._value, 0))

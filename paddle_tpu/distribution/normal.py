@@ -1,0 +1,82 @@
+"""Normal / LogNormal (reference: python/paddle/distribution/normal.py, lognormal.py)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _as_value, _key, _wrap
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _as_value(loc)
+        self.scale = _as_value(scale)
+        super().__init__(batch_shape=jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return _wrap(jnp.broadcast_to(self.scale**2, self.batch_shape))
+
+    @property
+    def stddev(self):
+        return _wrap(jnp.broadcast_to(self.scale, self.batch_shape))
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        shp = self._extend_shape(shape)
+        eps = jax.random.normal(_key(), shp, jnp.float32)
+        return _wrap(self.loc + eps * self.scale)
+
+    def log_prob(self, value):
+        v = _as_value(value)
+        var = self.scale**2
+        return _wrap(-((v - self.loc) ** 2) / (2 * var) - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        e = 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(jnp.broadcast_to(self.scale, self.batch_shape))
+        return _wrap(e)
+
+    def cdf(self, value):
+        v = _as_value(value)
+        return _wrap(0.5 * (1 + jax.scipy.special.erf((v - self.loc) / (self.scale * math.sqrt(2)))))
+
+    def icdf(self, value):
+        v = _as_value(value)
+        return _wrap(self.loc + self.scale * math.sqrt(2) * jax.scipy.special.erfinv(2 * v - 1))
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.base = Normal(loc, scale)
+        self.loc = self.base.loc
+        self.scale = self.base.scale
+        super().__init__(batch_shape=self.base.batch_shape)
+
+    @property
+    def mean(self):
+        return _wrap(jnp.exp(self.loc + self.scale**2 / 2))
+
+    @property
+    def variance(self):
+        s2 = self.scale**2
+        return _wrap((jnp.exp(s2) - 1) * jnp.exp(2 * self.loc + s2))
+
+    def sample(self, shape=()):
+        return _wrap(jnp.exp(self.base.rsample(shape)._value))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _as_value(value)
+        return _wrap(self.base.log_prob(_wrap(jnp.log(v)))._value - jnp.log(v))
+
+    def entropy(self):
+        return _wrap(self.base.entropy()._value + jnp.broadcast_to(self.loc, self.batch_shape))

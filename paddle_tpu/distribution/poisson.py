@@ -1,0 +1,34 @@
+"""Poisson (reference: python/paddle/distribution/poisson.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _as_value, _key, _wrap
+
+
+class Poisson(Distribution):
+    def __init__(self, rate):
+        self.rate = _as_value(rate)
+        super().__init__(batch_shape=self.rate.shape)
+
+    @property
+    def mean(self):
+        return _wrap(self.rate)
+
+    @property
+    def variance(self):
+        return _wrap(self.rate)
+
+    def sample(self, shape=()):
+        shp = self._extend_shape(shape)
+        return _wrap(jax.random.poisson(_key(), self.rate, shp).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _as_value(value)
+        return _wrap(v * jnp.log(self.rate) - self.rate - jax.scipy.special.gammaln(v + 1))
+
+    def entropy(self):
+        # second-order Stirling approximation (reference uses a series too)
+        r = self.rate
+        return _wrap(0.5 * jnp.log(2 * jnp.pi * jnp.e * r) - 1 / (12 * r) - 1 / (24 * r**2))

@@ -1,0 +1,83 @@
+"""TransformedDistribution + basic transforms
+(reference: python/paddle/distribution/transformed_distribution.py, transform.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .distribution import Distribution, _as_value, _wrap
+
+
+class Transform:
+    def forward(self, x):
+        raise NotImplementedError
+
+    def inverse(self, y):
+        raise NotImplementedError
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = _as_value(loc)
+        self.scale = _as_value(scale)
+
+    def forward(self, x):
+        return self.loc + self.scale * x
+
+    def inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def forward_log_det_jacobian(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), jnp.shape(x))
+
+
+class ExpTransform(Transform):
+    def forward(self, x):
+        return jnp.exp(x)
+
+    def inverse(self, y):
+        return jnp.log(y)
+
+    def forward_log_det_jacobian(self, x):
+        return x
+
+
+class SigmoidTransform(Transform):
+    def forward(self, x):
+        return 1 / (1 + jnp.exp(-x))
+
+    def inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def forward_log_det_jacobian(self, x):
+        return -jnp.logaddexp(0.0, -x) - jnp.logaddexp(0.0, x)
+
+
+class TransformedDistribution(Distribution):
+    def __init__(self, base, transforms):
+        self.base = base
+        self.transforms = list(transforms)
+        super().__init__(batch_shape=base.batch_shape, event_shape=base.event_shape)
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)._value
+        for t in self.transforms:
+            x = t.forward(x)
+        return _wrap(x)
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape)._value
+        for t in self.transforms:
+            x = t.forward(x)
+        return _wrap(x)
+
+    def log_prob(self, value):
+        y = _as_value(value)
+        lp = 0.0
+        for t in reversed(self.transforms):
+            x = t.inverse(y)
+            lp = lp - t.forward_log_det_jacobian(x)
+            y = x
+        return _wrap(lp + self.base.log_prob(_wrap(y))._value)

@@ -1,0 +1,43 @@
+"""Uniform (reference: python/paddle/distribution/uniform.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _as_value, _key, _wrap
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _as_value(low)
+        self.high = _as_value(high)
+        super().__init__(batch_shape=jnp.broadcast_shapes(self.low.shape, self.high.shape))
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to((self.low + self.high) / 2, self.batch_shape))
+
+    @property
+    def variance(self):
+        return _wrap(jnp.broadcast_to((self.high - self.low) ** 2 / 12, self.batch_shape))
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        shp = self._extend_shape(shape)
+        u = jax.random.uniform(_key(), shp, jnp.float32)
+        return _wrap(self.low + u * (self.high - self.low))
+
+    def log_prob(self, value):
+        v = _as_value(value)
+        inside = (v >= self.low) & (v < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return _wrap(jnp.where(inside, lp, -jnp.inf))
+
+    def entropy(self):
+        return _wrap(jnp.broadcast_to(jnp.log(self.high - self.low), self.batch_shape))
+
+    def cdf(self, value):
+        v = _as_value(value)
+        return _wrap(jnp.clip((v - self.low) / (self.high - self.low), 0.0, 1.0))

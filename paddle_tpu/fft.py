@@ -1,0 +1,110 @@
+"""paddle.fft namespace (reference: python/paddle/fft.py) over jnp.fft.
+
+Primary path is jnp.fft (XLA lax.fft) on the default backend. Some TPU
+backends (the axon v5-lite tunnel used here) have no complex/FFT lowering at
+all; on those every fft op dispatches to the host CPU backend
+(jax.default_device) — numerics and autograd are identical, and real-valued
+results migrate back to the accelerator on their next use. Detection is one
+cached probe at first call. Norm semantics match the reference
+("backward"/"ortho"/"forward").
+
+Known limitation on the axon backend: forward fft (and follow-up ops on the
+CPU-committed complex result) work, but `.backward()` through complex
+cotangents raises UNIMPLEMENTED — the autograd engine seeds cotangents on
+the accelerator, which cannot hold complex buffers there. Grad-through-fft
+is fully supported on cpu/gpu/standard-tpu backends (covered by the CPU-mesh
+test suite).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .core.apply import apply
+from .core.tensor import Tensor
+
+_FFT_NATIVE = None  # None = undecided, True = lax.fft works on default backend
+
+
+def _native_fft_supported() -> bool:
+    # Decided from the backend name, NOT by probing: a failed complex op on
+    # the axon backend wedges the whole TPU client (every later transfer
+    # returns UNIMPLEMENTED), so we must never execute one speculatively.
+    # Standard cpu/gpu/tpu XLA backends all lower lax.fft.
+    global _FFT_NATIVE
+    if _FFT_NATIVE is None:
+        try:
+            import jax.extend.backend as _jeb
+
+            version = getattr(_jeb.get_backend(), "platform_version", "") or ""
+        except Exception:
+            version = ""
+        is_axon = "axon" in version or "axon" in (jax.config.jax_platforms or "")
+        _FFT_NATIVE = (not is_axon) and jax.default_backend() in ("cpu", "gpu", "cuda", "rocm", "tpu")
+    return _FFT_NATIVE
+
+
+def _run(fn, *args, **kwargs):
+    """Run an fft computation; on complex-less backends, on the host CPU.
+    Device-resident operands are explicitly staged to CPU first — an
+    accelerator-resident array would otherwise pin dispatch to the
+    accelerator regardless of default_device."""
+    if _native_fft_supported():
+        return fn(*args, **kwargs)
+    cpu = jax.devices("cpu")[0]
+
+    def stage(a):
+        return jax.device_put(a, cpu) if isinstance(a, jax.Array) else a
+
+    args = tuple(stage(a) for a in args)
+    kwargs = {k: stage(v) for k, v in kwargs.items()}
+    with jax.default_device(cpu):
+        return fn(*args, **kwargs)
+
+
+def _mk1(jfn, name):
+    def op(x, n=None, axis=-1, norm="backward", name_arg=None):
+        return apply(name, lambda v: _run(jfn, v, n=n, axis=axis, norm=norm), x)
+
+    op.__name__ = name
+    return op
+
+
+def _mkn(jfn, name, default_axes=None):
+    def op(x, s=None, axes=default_axes, norm="backward", name_arg=None):
+        return apply(name, lambda v: _run(jfn, v, s=s, axes=axes, norm=norm), x)
+
+    op.__name__ = name
+    return op
+
+
+fft = _mk1(jnp.fft.fft, "fft")
+ifft = _mk1(jnp.fft.ifft, "ifft")
+rfft = _mk1(jnp.fft.rfft, "rfft")
+irfft = _mk1(jnp.fft.irfft, "irfft")
+hfft = _mk1(jnp.fft.hfft, "hfft")
+ihfft = _mk1(jnp.fft.ihfft, "ihfft")
+fft2 = _mkn(jnp.fft.fft2, "fft2", default_axes=(-2, -1))
+ifft2 = _mkn(jnp.fft.ifft2, "ifft2", default_axes=(-2, -1))
+rfft2 = _mkn(jnp.fft.rfft2, "rfft2", default_axes=(-2, -1))
+irfft2 = _mkn(jnp.fft.irfft2, "irfft2", default_axes=(-2, -1))
+fftn = _mkn(jnp.fft.fftn, "fftn")
+ifftn = _mkn(jnp.fft.ifftn, "ifftn")
+rfftn = _mkn(jnp.fft.rfftn, "rfftn")
+irfftn = _mkn(jnp.fft.irfftn, "irfftn")
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.fft.fftfreq(n, d).astype(dtype or jnp.float32))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.fft.rfftfreq(n, d).astype(dtype or jnp.float32))
+
+
+def fftshift(x, axes=None, name=None):
+    return apply("fftshift", lambda v: jnp.fft.fftshift(v, axes=axes), x)
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply("ifftshift", lambda v: jnp.fft.ifftshift(v, axes=axes), x)

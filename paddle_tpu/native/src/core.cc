@@ -28,6 +28,7 @@
 #include <cstring>
 #include <deque>
 #include <map>
+#include <set>
 #include <mutex>
 #include <string>
 #include <thread>
@@ -189,7 +190,7 @@ struct StoreServer {
   std::condition_variable cv;
   std::thread accept_thread;
   std::vector<std::thread> handlers;
-  std::vector<int> handler_fds;  // parallel to handlers; for shutdown wakeup
+  std::set<int> live_fds;  // open handler fds, for shutdown wakeup
   std::mutex handlers_mu;
 };
 
@@ -288,6 +289,11 @@ void handle_conn(StoreServer* s, int fd) {
     if (!write_full(fd, &status, 8) || !write_full(fd, &olen, 4)) break;
     if (olen && !write_full(fd, out.data(), olen)) break;
   }
+  {
+    // deregister before closing so server_stop never shuts down a reused fd
+    std::lock_guard<std::mutex> lk(s->handlers_mu);
+    s->live_fds.erase(fd);
+  }
   ::close(fd);
 }
 
@@ -320,8 +326,8 @@ void* pt_store_server_start(int port) {
       int fd = ::accept(s->listen_fd, nullptr, nullptr);
       if (fd < 0) break;
       std::lock_guard<std::mutex> lk(s->handlers_mu);
+      s->live_fds.insert(fd);
       s->handlers.emplace_back(handle_conn, s, fd);
-      s->handler_fds.push_back(fd);
     }
   });
   return s;
@@ -338,9 +344,13 @@ void pt_store_server_stop(void* sv) {
   if (s->accept_thread.joinable()) s->accept_thread.join();
   {
     // wake handlers blocked in recv(), then join them — they must not
-    // outlive the StoreServer they dereference
-    std::lock_guard<std::mutex> lk(s->handlers_mu);
-    for (int fd : s->handler_fds) ::shutdown(fd, SHUT_RDWR);
+    // outlive the StoreServer they dereference. Joining under handlers_mu
+    // would deadlock with a handler's own deregistration, so snapshot fds
+    // under the lock and join outside it.
+    {
+      std::lock_guard<std::mutex> lk(s->handlers_mu);
+      for (int fd : s->live_fds) ::shutdown(fd, SHUT_RDWR);
+    }
     for (auto& t : s->handlers)
       if (t.joinable()) t.join();
   }

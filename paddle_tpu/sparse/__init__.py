@@ -1,0 +1,250 @@
+"""paddle.sparse namespace.
+
+Reference parity: python/paddle/sparse/ (COO/CSR creation, elementwise/
+matmul/reduction ops, .nn layers) over phi sparse kernels
+(paddle/phi/core/sparse_coo_tensor.h, kernels/sparse/). TPU-native: sparse
+tensors wrap jax.experimental.sparse BCOO/BCSR — XLA lowers scatter/gather
+and sparse-dense matmul natively, which is the TPU analog of the cuSPARSE
+kernels the reference dispatches to.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor
+
+
+class SparseTensor(Tensor):
+    """A Tensor wrapping a BCOO/BCSR payload. Dense fallbacks materialize
+    via .to_dense(); arithmetic with dense tensors densifies explicitly."""
+
+    _sparse_kind: str = "coo"
+
+    def __init__(self, mat, kind="coo", stop_gradient=True, name=None):
+        self._mat = mat
+        super().__init__(jnp.zeros((), jnp.float32), stop_gradient=stop_gradient, name=name)
+        self._sparse_kind = kind
+        self._dense_cache = None
+
+    @property
+    def value(self):
+        # generic Tensor ops (paddle.add, reductions, ...) reach raw values
+        # through this property: densify so mixed sparse/dense arithmetic is
+        # numerically correct (the sparse.* functions use ._mat fast paths)
+        if self._dense_cache is None:
+            self._dense_cache = self._mat.todense()
+        return self._dense_cache
+
+    # shape/dtype reflect the sparse payload
+    @property
+    def shape(self):
+        return list(self._mat.shape)
+
+    @property
+    def dtype(self):
+        return self._mat.dtype
+
+    def is_sparse(self):
+        return True
+
+    def is_sparse_coo(self):
+        return self._sparse_kind == "coo"
+
+    def is_sparse_csr(self):
+        return self._sparse_kind == "csr"
+
+    # ---- paddle API ----
+    def indices(self):
+        if self._sparse_kind != "coo":
+            raise RuntimeError("indices() requires a COO tensor")
+        return Tensor(self._mat.indices.T)  # paddle layout: [ndim, nnz]
+
+    def values(self):
+        return Tensor(self._mat.data)
+
+    def crows(self):
+        if self._sparse_kind != "csr":
+            raise RuntimeError("crows() requires a CSR tensor")
+        return Tensor(self._mat.indptr)
+
+    def cols(self):
+        if self._sparse_kind != "csr":
+            raise RuntimeError("cols() requires a CSR tensor")
+        return Tensor(self._mat.indices)
+
+    def nnz(self):
+        return int(self._mat.nse)
+
+    def to_dense(self) -> Tensor:
+        return Tensor(self._mat.todense())
+
+    def to_sparse_csr(self) -> "SparseTensor":
+        if self._sparse_kind == "csr":
+            return self
+        dense = self._mat.todense()
+        return SparseTensor(jsparse.BCSR.fromdense(dense), kind="csr")
+
+    def to_sparse_coo(self, sparse_dim=None) -> "SparseTensor":
+        if self._sparse_kind == "coo":
+            return self
+        return SparseTensor(jsparse.BCOO.fromdense(self._mat.todense()), kind="coo")
+
+    def numpy(self):
+        return np.asarray(self._mat.todense())
+
+    def __repr__(self):
+        return f"SparseTensor({self._sparse_kind}, shape={self.shape}, nnz={self.nnz()})"
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None, stop_gradient=True):
+    """paddle.sparse.sparse_coo_tensor parity: indices [ndim, nnz]."""
+    idx = indices.numpy() if isinstance(indices, Tensor) else np.asarray(indices)
+    vals = values._value if isinstance(values, Tensor) else jnp.asarray(values)
+    if dtype is not None:
+        from ..framework.dtype import convert_dtype
+
+        vals = vals.astype(convert_dtype(dtype))
+    idx = jnp.asarray(idx.T)  # BCOO layout: [nnz, ndim]
+    if shape is None:
+        shape = tuple(int(i) + 1 for i in np.asarray(idx).max(0))
+    mat = jsparse.BCOO((vals, idx), shape=tuple(shape))
+    return SparseTensor(mat, kind="coo", stop_gradient=stop_gradient)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None, stop_gradient=True):
+    crows_v = crows._value if isinstance(crows, Tensor) else jnp.asarray(crows)
+    cols_v = cols._value if isinstance(cols, Tensor) else jnp.asarray(cols)
+    vals = values._value if isinstance(values, Tensor) else jnp.asarray(values)
+    if dtype is not None:
+        from ..framework.dtype import convert_dtype
+
+        vals = vals.astype(convert_dtype(dtype))
+    mat = jsparse.BCSR((vals, cols_v.astype(jnp.int32), crows_v.astype(jnp.int32)), shape=tuple(shape))
+    return SparseTensor(mat, kind="csr", stop_gradient=stop_gradient)
+
+
+def _dense_of(x):
+    if isinstance(x, SparseTensor):
+        return x._mat.todense()
+    if isinstance(x, Tensor):
+        return x._value
+    return jnp.asarray(x)
+
+
+def _coo_unary(x: SparseTensor, fn) -> SparseTensor:
+    """Apply an elementwise zero-preserving fn to the stored values only —
+    the sparse fast path (reference: sparse unary kernels)."""
+    mat = x._mat
+    if isinstance(mat, jsparse.BCSR):
+        new = jsparse.BCSR((fn(mat.data), mat.indices, mat.indptr), shape=mat.shape)
+        return SparseTensor(new, kind="csr")
+    new = jsparse.BCOO((fn(mat.data), mat.indices), shape=mat.shape)
+    return SparseTensor(new, kind="coo")
+
+
+def relu(x):
+    return _coo_unary(x, jax.nn.relu)
+
+
+def abs(x):  # noqa: A001
+    return _coo_unary(x, jnp.abs)
+
+
+def neg(x):
+    return _coo_unary(x, jnp.negative)
+
+
+def sin(x):
+    return _coo_unary(x, jnp.sin)
+
+
+def tanh(x):
+    return _coo_unary(x, jnp.tanh)
+
+
+def sqrt(x):
+    return _coo_unary(x, jnp.sqrt)
+
+
+def pow(x, factor):  # noqa: A001
+    return _coo_unary(x, lambda v: jnp.power(v, factor))
+
+
+def cast(x, index_dtype=None, value_dtype=None):
+    from ..framework.dtype import convert_dtype
+
+    out = _coo_unary(x, lambda v: v.astype(convert_dtype(value_dtype)) if value_dtype else v)
+    if index_dtype is not None:
+        idt = convert_dtype(index_dtype)
+        mat = out._mat
+        if isinstance(mat, jsparse.BCSR):
+            out = SparseTensor(
+                jsparse.BCSR((mat.data, mat.indices.astype(idt), mat.indptr.astype(idt)), shape=mat.shape),
+                kind="csr",
+            )
+        else:
+            out = SparseTensor(jsparse.BCOO((mat.data, mat.indices.astype(idt)), shape=mat.shape), kind="coo")
+    return out
+
+
+def add(x, y):
+    if isinstance(x, SparseTensor) and isinstance(y, SparseTensor) and x.is_sparse_coo() and y.is_sparse_coo():
+        xs, ys = x._mat, y._mat
+        out = jsparse.BCOO(
+            (jnp.concatenate([xs.data, ys.data]), jnp.concatenate([xs.indices, ys.indices])),
+            shape=xs.shape,
+        ).sum_duplicates(nse=xs.nse + ys.nse)
+        return SparseTensor(out, kind="coo")
+    return Tensor(_dense_of(x) + _dense_of(y))
+
+
+def subtract(x, y):
+    return add(x, neg(y) if isinstance(y, SparseTensor) else Tensor(-_dense_of(y)))
+
+
+def multiply(x, y):
+    return Tensor(_dense_of(x) * _dense_of(y))
+
+
+def divide(x, y):
+    return Tensor(_dense_of(x) / _dense_of(y))
+
+
+def matmul(x, y):
+    """sparse @ dense (and sparse @ sparse via densify) — XLA fuses the
+    gather/scatter form of BCOO matmul on TPU."""
+    if isinstance(x, SparseTensor) and not isinstance(y, SparseTensor):
+        return Tensor(x._mat @ _dense_of(y))
+    if isinstance(y, SparseTensor) and not isinstance(x, SparseTensor):
+        return Tensor((y._mat.T @ _dense_of(x).T).T)
+    return Tensor(_dense_of(x) @ _dense_of(y))
+
+
+def masked_matmul(x, y, mask: SparseTensor):
+    """dense @ dense evaluated only at mask's nonzeros (SDDMM)."""
+    xv, yv = _dense_of(x), _dense_of(y)
+    idx = mask._mat.indices  # [nnz, 2]
+    rows, cols = idx[:, 0], idx[:, 1]
+    vals = jnp.einsum("nk,nk->n", xv[rows, :], yv[:, cols].T)
+    return SparseTensor(jsparse.BCOO((vals, idx), shape=mask._mat.shape), kind="coo")
+
+
+def sum(x, axis=None, dtype=None, keepdim=False):  # noqa: A001
+    v = jnp.sum(_dense_of(x), axis=axis, keepdims=keepdim)
+    return Tensor(v)
+
+
+def transpose(x, perm):
+    if isinstance(x, SparseTensor) and x.is_sparse_coo():
+        mat = x._mat
+        new_idx = mat.indices[:, jnp.asarray(perm)]
+        new_shape = tuple(mat.shape[p] for p in perm)
+        return SparseTensor(jsparse.BCOO((mat.data, new_idx), shape=new_shape), kind="coo")
+    return Tensor(jnp.transpose(_dense_of(x), perm))
+
+
+def is_same_shape(x, y):
+    return list(x.shape) == list(y.shape)

@@ -121,13 +121,13 @@ class Executor:
             [a._value for a in upd.accum_tensors] for upd in program.opt_updates
         ]
         lr_arrays = [jnp.asarray(upd.lr() if callable(upd.lr) else upd.lr, jnp.float32) for upd in program.opt_updates]
-        fetches, new_params, new_accums = compiled(feed_arrays, param_arrays, accum_arrays, lr_arrays)
+        fetches, updated, new_accums = compiled(feed_arrays, param_arrays, accum_arrays, lr_arrays)
 
-        # write back persistables (params + optimizer accumulators)
-        for v, new in zip(program.param_vars, new_params):
-            t = program._var_tensors[v]
-            if t._value is not new:
-                t._replace_value(new)
+        # write back persistables (optimizer-touched params + accumulators)
+        pos_of = {v: i for i, v in enumerate(program.param_vars)}
+        updated_positions = sorted({pos_of[u.param_var] for u in program.opt_updates})
+        for i, new in zip(updated_positions, updated):
+            program._var_tensors[program.param_vars[i]]._replace_value(new)
         for upd, accs in zip(program.opt_updates, new_accums):
             for t, new in zip(upd.accum_tensors, accs):
                 t._replace_value(new)
@@ -148,20 +148,10 @@ class Executor:
         opt_updates = list(program.opt_updates)
 
         def forward_env(feed_arrays, param_arrays):
-            env = {}
-            for vid, arr in zip(feed_var_ids, feed_arrays):
-                env[vid] = arr
-            for vid, arr in zip(program.param_vars, param_arrays):
-                env[vid] = arr
-            for instr in program.ops:
-                args = [env[r[1]] if r[0] == "var" else r[1] for r in instr.in_refs]
-                out = instr.fn(*args, **instr.kwargs)
-                outs = out if isinstance(out, (tuple, list)) else (out,)
-                for vid, o in zip(instr.out_vars, outs):
-                    env[vid] = o
-            return env
+            return program.replay_env(dict(zip(feed_var_ids, feed_arrays)), param_arrays)
 
         pos_of_param = {v: i for i, v in enumerate(program.param_vars)}
+        updated_positions = sorted({pos_of_param[u.param_var] for u in opt_updates})
 
         def replay(feed_arrays, param_arrays, accum_arrays, lr_arrays):
             env = None
@@ -218,7 +208,10 @@ class Executor:
                 new_params[i] = new_p
                 new_accums.append(new_a)
             fetches = [env[v] for v in fetch_vars]
-            return fetches, new_params, new_accums
+            # only parameters an optimizer touched leave the jit — frozen
+            # weights must not round-trip through outputs every run
+            updated = [new_params[i] for i in updated_positions]
+            return fetches, updated, new_accums
 
         compiled = jax.jit(replay)
         program._compiled[key] = compiled

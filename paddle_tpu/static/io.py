@@ -59,17 +59,7 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None, prog
     param_arrays = [program._var_tensors[v]._value for v in program.param_vars]
 
     def infer_fn(*feed_arrays):
-        env = {}
-        for vid, arr in zip(feed_ids, feed_arrays):
-            env[vid] = arr
-        for vid, arr in zip(program.param_vars, param_arrays):
-            env[vid] = arr
-        for instr in program.ops:
-            args = [env[r[1]] if r[0] == "var" else r[1] for r in instr.in_refs]
-            out = instr.fn(*args, **instr.kwargs)
-            outs = out if isinstance(out, (tuple, list)) else (out,)
-            for vid, o in zip(instr.out_vars, outs):
-                env[vid] = o
+        env = program.replay_env(dict(zip(feed_ids, feed_arrays)), param_arrays)
         return tuple(env[v] for v in fetch_ids)
 
     # dynamic batch: feed placeholders keep their declared -1 dims

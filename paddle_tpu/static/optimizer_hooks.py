@@ -20,10 +20,14 @@ def _sgd_update(p, g, lr):
     return (p - lr.astype(p.dtype) * g.astype(p.dtype),)
 
 
-def _make_momentum_update(mu):
+def _make_momentum_update(mu, nesterov=False):
     def upd(p, g, lr, vel):
         v = mu * vel + g.astype(vel.dtype)
-        return p - lr.astype(p.dtype) * v.astype(p.dtype), v
+        if nesterov:
+            step = g.astype(p.dtype) + mu * v.astype(p.dtype)
+        else:
+            step = v.astype(p.dtype)
+        return p - lr.astype(p.dtype) * step, v
 
     return upd
 
@@ -70,7 +74,7 @@ def static_minimize(optimizer, loss, parameters=None):
         if type(optimizer) is SGD:
             fn, accums = _sgd_update, []
         elif type(optimizer) is Momentum:
-            fn = _make_momentum_update(optimizer._momentum)
+            fn = _make_momentum_update(optimizer._momentum, optimizer._nesterov)
             accums = [Tensor(jnp.zeros_like(p._value))]
         elif type(optimizer) in (Adam, AdamW):
             wd = 0.0

@@ -90,6 +90,21 @@ class Program:
         self.ops.append(OpInstr(name, fn, in_refs, dict(kwargs), out_vars))
         self._compiled.clear()
 
+    # ---- replay (shared by Executor._compile and save_inference_model) ----
+    def replay_env(self, feed_bindings, param_arrays):
+        """Execute the instruction list over an env seeded with feed/param
+        arrays; returns the full env (var id -> value)."""
+        env = dict(feed_bindings)
+        for vid, arr in zip(self.param_vars, param_arrays):
+            env[vid] = arr
+        for instr in self.ops:
+            args = [env[r[1]] if r[0] == "var" else r[1] for r in instr.in_refs]
+            out = instr.fn(*args, **instr.kwargs)
+            outs = out if isinstance(out, (tuple, list)) else (out,)
+            for vid, o in zip(instr.out_vars, outs):
+                env[vid] = o
+        return env
+
     # ---- introspection ----
     def list_vars(self):
         return list(self._var_tensors.values())
