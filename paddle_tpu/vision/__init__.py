@@ -1,2 +1,5 @@
-"""paddle.vision namespace — models land with the model-zoo milestone."""
+"""paddle.vision namespace (reference: python/paddle/vision/)."""
+from . import datasets  # noqa: F401
 from . import models  # noqa: F401
+from . import ops  # noqa: F401
+from . import transforms  # noqa: F401

@@ -1,0 +1,304 @@
+"""Vision ops (reference: python/paddle/vision/ops.py — nms, roi_align,
+roi_pool, deform_conv2d, box handling).
+
+TPU-native design: all ops are pure-jax, static-shape, gather/scatter based —
+nms is the O(n^2) mask formulation (one [N,N] IoU matrix on the MXU + a scan,
+instead of the reference's sequential CUDA kernel), roi_align is bilinear
+gather, deform_conv2d is the sampling-grid gather + matmul formulation.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.apply import apply, apply_nograd
+from ..core.tensor import Tensor
+
+
+def _v(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+# ---------------------------------------------------------------------------
+# boxes
+# ---------------------------------------------------------------------------
+
+def box_area(boxes):
+    b = _v(boxes)
+    return Tensor((b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1]))
+
+
+def _iou_matrix(a, b):
+    area_a = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+    area_b = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    return inter / jnp.maximum(area_a[:, None] + area_b[None, :] - inter, 1e-10)
+
+
+def box_iou(boxes1, boxes2):
+    return Tensor(_iou_matrix(_v(boxes1), _v(boxes2)))
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None, categories=None, top_k=None):
+    """paddle.vision.ops.nms parity. Returns kept indices (by descending
+    score when scores are given, else box order)."""
+    b = _v(boxes)
+    n = b.shape[0]
+    if scores is not None:
+        s = _v(scores)
+        order = jnp.argsort(-s)
+    else:
+        order = jnp.arange(n)
+    sorted_boxes = b[order]
+    if category_idxs is not None:
+        # class-aware: offset boxes per category so cross-class boxes never overlap
+        cat = _v(category_idxs)[order]
+        span = jnp.max(b[:, 2:]) + 1.0
+        sorted_boxes = sorted_boxes + (cat.astype(sorted_boxes.dtype) * span)[:, None] * jnp.ones(
+            (1, 4), sorted_boxes.dtype
+        )
+    iou = _iou_matrix(sorted_boxes, sorted_boxes)
+
+    def body(i, keep):
+        # suppress i if any kept higher-score box overlaps it too much
+        sup = jnp.any(jnp.where(jnp.arange(n) < i, (iou[i] > iou_threshold) & keep, False))
+        return keep.at[i].set(~sup)
+
+    keep = jax.lax.fori_loop(0, n, body, jnp.ones(n, bool))
+    kept_sorted = jnp.nonzero(keep, size=n, fill_value=-1)[0]
+    kept = jnp.where(kept_sorted >= 0, order[jnp.clip(kept_sorted, 0)], -1)
+    kept_np = np.asarray(kept)
+    kept_np = kept_np[kept_np >= 0]
+    if top_k is not None:
+        kept_np = kept_np[:top_k]
+    return Tensor(jnp.asarray(kept_np, jnp.int64))
+
+
+# ---------------------------------------------------------------------------
+# roi align / pool
+# ---------------------------------------------------------------------------
+
+def roi_align(x, boxes, boxes_num=None, output_size=1, spatial_scale=1.0, sampling_ratio=-1, aligned=True, name=None):
+    """Bilinear-sampled RoIAlign. x: [N,C,H,W]; boxes: [R,4] (x1,y1,x2,y2);
+    boxes_num: [N] rois per image."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    ratio = 2 if sampling_ratio <= 0 else sampling_ratio
+
+    bn = _v(boxes_num) if boxes_num is not None else None
+
+    def fn(xv, bv):
+        n, c, h, w = xv.shape
+        r = bv.shape[0]
+        if bn is not None:
+            img_idx = jnp.repeat(jnp.arange(n), np.asarray(bn), total_repeat_length=r)
+        else:
+            img_idx = jnp.zeros((r,), jnp.int32)
+        offset = 0.5 if aligned else 0.0
+        x1 = bv[:, 0] * spatial_scale - offset
+        y1 = bv[:, 1] * spatial_scale - offset
+        x2 = bv[:, 2] * spatial_scale - offset
+        y2 = bv[:, 3] * spatial_scale - offset
+        rw = x2 - x1
+        rh = y2 - y1
+        if not aligned:
+            rw = jnp.maximum(rw, 1.0)
+            rh = jnp.maximum(rh, 1.0)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        # sample grid: [R, ph, ratio] y coords, [R, pw, ratio] x coords
+        iy = (jnp.arange(ratio) + 0.5) / ratio
+        gy = y1[:, None, None] + (jnp.arange(ph)[None, :, None] + iy[None, None, :]) * bin_h[:, None, None]
+        gx = x1[:, None, None] + (jnp.arange(pw)[None, :, None] + iy[None, None, :]) * bin_w[:, None, None]
+
+        def bilinear(img, yy, xx):
+            # img: [C,H,W]; yy/xx: [...]: bilinear sample each channel
+            y0 = jnp.clip(jnp.floor(yy), 0, h - 1).astype(jnp.int32)
+            x0 = jnp.clip(jnp.floor(xx), 0, w - 1).astype(jnp.int32)
+            y1i = jnp.clip(y0 + 1, 0, h - 1)
+            x1i = jnp.clip(x0 + 1, 0, w - 1)
+            wy = jnp.clip(yy - y0, 0, 1)
+            wx = jnp.clip(xx - x0, 0, 1)
+            valid = (yy >= -1) & (yy <= h) & (xx >= -1) & (xx <= w)
+            ia = img[:, y0, x0]
+            ib = img[:, y0, x1i]
+            ic = img[:, y1i, x0]
+            id_ = img[:, y1i, x1i]
+            out = ia * (1 - wy) * (1 - wx) + ib * (1 - wy) * wx + ic * wy * (1 - wx) + id_ * wy * wx
+            return out * valid.astype(out.dtype)
+
+        def one_roi(ri):
+            img = xv[img_idx[ri]]  # [C,H,W]
+            yy = gy[ri]  # [ph, ratio]
+            xx = gx[ri]  # [pw, ratio]
+            # full sample grid [ph*ratio, pw*ratio]
+            ys = yy.reshape(-1)
+            xs = xx.reshape(-1)
+            grid_y = jnp.broadcast_to(ys[:, None], (ys.shape[0], xs.shape[0]))
+            grid_x = jnp.broadcast_to(xs[None, :], (ys.shape[0], xs.shape[0]))
+            samples = bilinear(img, grid_y, grid_x)  # [C, ph*ratio, pw*ratio]
+            samples = samples.reshape(c, ph, ratio, pw, ratio)
+            return samples.mean((2, 4))  # [C, ph, pw]
+
+        return jax.vmap(one_roi)(jnp.arange(r))
+
+    return apply("roi_align", fn, x, boxes)
+
+
+def roi_pool(x, boxes, boxes_num=None, output_size=1, spatial_scale=1.0, name=None):
+    """Max-pool RoI (reference roi_pool): nearest bins, max within each."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    bn = _v(boxes_num) if boxes_num is not None else None
+
+    def fn(xv, bv):
+        n, c, h, w = xv.shape
+        r = bv.shape[0]
+        if bn is not None:
+            img_idx = jnp.repeat(jnp.arange(n), np.asarray(bn), total_repeat_length=r)
+        else:
+            img_idx = jnp.zeros((r,), jnp.int32)
+        x1 = jnp.round(bv[:, 0] * spatial_scale).astype(jnp.int32)
+        y1 = jnp.round(bv[:, 1] * spatial_scale).astype(jnp.int32)
+        x2 = jnp.maximum(jnp.round(bv[:, 2] * spatial_scale).astype(jnp.int32), x1 + 1)
+        y2 = jnp.maximum(jnp.round(bv[:, 3] * spatial_scale).astype(jnp.int32), y1 + 1)
+
+        def one_roi(ri):
+            img = xv[img_idx[ri]]
+            # exact bin max via masked reduction over the full feature map
+            # (static shapes; XLA fuses the where+max — the TPU-friendly form
+            # of the reference's per-bin pixel loop)
+            iy = jnp.arange(h, dtype=jnp.float32)
+            ix = jnp.arange(w, dtype=jnp.float32)
+            biny = jnp.floor((iy - y1[ri]) * ph / jnp.maximum(y2[ri] - y1[ri], 1))
+            binx = jnp.floor((ix - x1[ri]) * pw / jnp.maximum(x2[ri] - x1[ri], 1))
+            in_y = (iy >= y1[ri]) & (iy < y2[ri])
+            in_x = (ix >= x1[ri]) & (ix < x2[ri])
+            mask_y = (biny[:, None] == jnp.arange(ph)[None, :]) & in_y[:, None]  # [h, ph]
+            mask_x = (binx[:, None] == jnp.arange(pw)[None, :]) & in_x[:, None]  # [w, pw]
+            neg = jnp.asarray(-jnp.inf, img.dtype)
+            tmp = jnp.max(
+                jnp.where(mask_y.T[None, :, :, None], img[:, None, :, :], neg), axis=2
+            )  # [c, ph, w]
+            out = jnp.max(
+                jnp.where(mask_x[None, None, :, :], tmp[:, :, :, None], neg), axis=2
+            )  # [c, ph, pw]
+            return jnp.where(jnp.isfinite(out), out, 0.0)
+
+        return jax.vmap(one_roi)(jnp.arange(r))
+
+    return apply("roi_pool", fn, x, boxes)
+
+
+# ---------------------------------------------------------------------------
+# deformable conv
+# ---------------------------------------------------------------------------
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0, dilation=1, deformable_groups=1, groups=1, mask=None, name=None):
+    """Deformable conv v1/v2 (reference: vision/ops.py deform_conv2d) as
+    bilinear gather + matmul — the canonical TPU formulation."""
+    if isinstance(stride, int):
+        stride = (stride, stride)
+    if isinstance(padding, int):
+        padding = (padding, padding)
+    if isinstance(dilation, int):
+        dilation = (dilation, dilation)
+    if groups != 1 or deformable_groups != 1:
+        raise NotImplementedError("deform_conv2d: groups/deformable_groups > 1 not yet supported")
+
+    def fn(xv, ov, wv, *rest):
+        rest = list(rest)
+        bv = rest.pop(0) if bias is not None else None
+        mv = rest.pop(0) if mask is not None else None
+        n, c, h, w = xv.shape
+        oc, ic, kh, kw = wv.shape
+        sh, sw = stride
+        ph_, pw_ = padding
+        dh, dw = dilation
+        oh = (h + 2 * ph_ - dh * (kh - 1) - 1) // sh + 1
+        ow = (w + 2 * pw_ - dw * (kw - 1) - 1) // sw + 1
+        xp = jnp.pad(xv, ((0, 0), (0, 0), (ph_, ph_), (pw_, pw_)))
+        hp, wp = h + 2 * ph_, w + 2 * pw_
+        # base sampling positions [oh, ow, kh, kw]
+        base_y = (jnp.arange(oh) * sh)[:, None, None, None] + (jnp.arange(kh) * dh)[None, None, :, None]
+        base_x = (jnp.arange(ow) * sw)[None, :, None, None] + (jnp.arange(kw) * dw)[None, None, None, :]
+        base_y = jnp.broadcast_to(base_y, (oh, ow, kh, kw)).astype(jnp.float32)
+        base_x = jnp.broadcast_to(base_x, (oh, ow, kh, kw)).astype(jnp.float32)
+        # offsets: [N, 2*kh*kw, oh, ow] (y0,x0,y1,x1,... per kernel point)
+        off = ov.reshape(n, kh * kw, 2, oh, ow)
+        off_y = jnp.moveaxis(off[:, :, 0], 1, -1).reshape(n, oh, ow, kh, kw)
+        off_x = jnp.moveaxis(off[:, :, 1], 1, -1).reshape(n, oh, ow, kh, kw)
+        sy = base_y[None] + off_y
+        sx = base_x[None] + off_x
+
+        y0 = jnp.floor(sy)
+        x0 = jnp.floor(sx)
+        wy = sy - y0
+        wx = sx - x0
+
+        def gather(img, yy, xx):
+            yi = jnp.clip(yy, 0, hp - 1).astype(jnp.int32)
+            xi = jnp.clip(xx, 0, wp - 1).astype(jnp.int32)
+            valid = (yy >= 0) & (yy <= hp - 1) & (xx >= 0) & (xx <= wp - 1)
+            return img[:, yi, xi] * valid.astype(img.dtype)  # [C, ...]
+
+        def one_image(img, yy0, xx0, wyy, wxx, m):
+            a = gather(img, yy0, xx0)
+            b = gather(img, yy0, xx0 + 1)
+            cc = gather(img, yy0 + 1, xx0)
+            d = gather(img, yy0 + 1, xx0 + 1)
+            s = (
+                a * (1 - wyy) * (1 - wxx)
+                + b * (1 - wyy) * wxx
+                + cc * wyy * (1 - wxx)
+                + d * wyy * wxx
+            )  # [C, oh, ow, kh, kw]
+            if m is not None:
+                s = s * m[None]
+            # contract (C,kh,kw) against weight
+            return jnp.einsum("cyxhw,ochw->oyx", s, wv)
+
+        if mv is not None:
+            mm = jnp.moveaxis(mv.reshape(n, kh * kw, oh, ow), 1, -1).reshape(n, oh, ow, kh, kw)
+        else:
+            mm = None
+        out = jax.vmap(lambda im, a1, a2, a3, a4, m5: one_image(im, a1, a2, a3, a4, m5))(
+            xp, y0, x0, wy, wx, mm if mm is not None else jnp.ones((n, oh, ow, kh, kw), xv.dtype)
+        )
+        if bv is not None:
+            out = out + bv[None, :, None, None]
+        return out
+
+    args = [x, offset, weight] + ([bias] if bias is not None else []) + ([mask] if mask is not None else [])
+    return apply("deform_conv2d", fn, *args)
+
+
+# ---------------------------------------------------------------------------
+# fpn
+# ---------------------------------------------------------------------------
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level, refer_scale, pixel_offset=False, rois_num=None, name=None):
+    """Assign each RoI to an FPN level by scale (reference fpn.py). Returns
+    (multi_rois, restore_ind, rois_num_per_level)."""
+    rois = _v(fpn_rois)
+    off = 1.0 if pixel_offset else 0.0
+    scale = jnp.sqrt(jnp.clip((rois[:, 2] - rois[:, 0] + off) * (rois[:, 3] - rois[:, 1] + off), 1e-6))
+    level = jnp.floor(jnp.log2(scale / refer_scale + 1e-8)) + refer_level
+    level = jnp.clip(level, min_level, max_level).astype(jnp.int32)
+    level_np = np.asarray(level)
+    rois_np = np.asarray(rois)
+    multi_rois, rois_num_per_level, order = [], [], []
+    for lv in range(min_level, max_level + 1):
+        idx = np.nonzero(level_np == lv)[0]
+        multi_rois.append(Tensor(jnp.asarray(rois_np[idx])))
+        rois_num_per_level.append(Tensor(jnp.asarray([len(idx)], jnp.int32)))
+        order.append(idx)
+    order = np.concatenate(order) if order else np.zeros(0, np.int64)
+    restore = np.argsort(order)
+    return multi_rois, Tensor(jnp.asarray(restore, jnp.int32)), rois_num_per_level

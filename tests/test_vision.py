@@ -1,0 +1,209 @@
+"""vision: transforms, ops (nms/roi_align/roi_pool/deform_conv), datasets."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import datasets, ops, transforms as T
+
+
+# ---------- transforms ----------
+
+def test_to_tensor_and_normalize():
+    img = (np.arange(2 * 3 * 3) % 255).astype(np.uint8).reshape(3, 3, 2)
+    t = T.ToTensor()(img)
+    assert tuple(t.shape) == (2, 3, 3)
+    assert t.numpy().max() <= 1.0
+    n = T.Normalize(mean=[0.5, 0.5], std=[0.5, 0.5])(t)
+    np.testing.assert_allclose(n.numpy(), (t.numpy() - 0.5) / 0.5, rtol=1e-6)
+
+
+def test_resize_bilinear_matches_shape_and_range():
+    img = np.random.RandomState(0).randint(0, 255, (10, 20, 3), dtype=np.uint8)
+    out = T.Resize((5, 8))(img)
+    assert out.shape == (5, 8, 3) and out.dtype == np.uint8
+    # int size: shorter side
+    out2 = T.Resize(5)(img)
+    assert out2.shape == (5, 10, 3)
+    # identity resize returns the same pixels
+    same = T.Resize((10, 20))(img)
+    np.testing.assert_array_equal(same, img)
+
+
+def test_crops_flips_pad():
+    img = np.arange(36, dtype=np.uint8).reshape(6, 6)
+    cc = T.CenterCrop(2)(img)
+    np.testing.assert_array_equal(cc, img[2:4, 2:4])
+    rc = T.RandomCrop(4)(img)
+    assert rc.shape == (4, 4)
+    fl = T.RandomHorizontalFlip(prob=1.0)(img[..., None])
+    np.testing.assert_array_equal(fl[:, :, 0], img[:, ::-1])
+    pd = T.Pad(1)(img)
+    assert pd.shape == (8, 8)
+    rrc = T.RandomResizedCrop(3)(np.random.rand(8, 8, 3).astype("float32"))
+    assert rrc.shape == (3, 3, 3)
+
+
+def test_compose_pipeline_with_dataloader():
+    tf = T.Compose([T.Resize((8, 8)), T.ToTensor(), T.Normalize(mean=[0.5], std=[0.5])])
+    ds = datasets.MNIST(mode="test", transform=tf)
+    img, label = ds[0]
+    assert tuple(img.shape) == (1, 8, 8)
+    from paddle_tpu.io import DataLoader
+
+    dl = DataLoader(ds, batch_size=4)
+    xb, yb = next(iter(dl))
+    assert tuple(xb.shape) == (4, 1, 8, 8) and tuple(yb.shape) == (4, 1)
+
+
+def test_color_and_gray():
+    img = np.random.RandomState(0).randint(0, 255, (6, 6, 3), dtype=np.uint8)
+    b = T.ColorJitter(brightness=0.5, contrast=0.5, hue=0.1)(img)
+    assert b.shape == img.shape
+    g = T.Grayscale(3)(img)
+    assert g.shape == img.shape
+    assert np.allclose(g[..., 0], g[..., 1])
+
+
+# ---------- ops ----------
+
+def test_nms_suppresses_overlaps():
+    boxes = np.array(
+        [[0, 0, 10, 10], [1, 1, 10.5, 10.5], [20, 20, 30, 30], [0, 0, 9, 9]], "float32"
+    )
+    scores = np.array([0.9, 0.8, 0.7, 0.95], "float32")
+    keep = ops.nms(paddle.to_tensor(boxes), 0.5, scores=paddle.to_tensor(scores)).numpy()
+    # box 3 (score .95) kept, suppresses 0&1; box 2 disjoint kept
+    assert list(keep) == [3, 2]
+    # without scores: order by index
+    keep2 = ops.nms(paddle.to_tensor(boxes), 0.5).numpy()
+    assert list(keep2) == [0, 2]
+
+
+def test_nms_category_aware():
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 10, 10]], "float32")
+    scores = np.array([0.9, 0.8], "float32")
+    cats = np.array([0, 1], dtype=np.int64)
+    keep = ops.nms(
+        paddle.to_tensor(boxes), 0.5, scores=paddle.to_tensor(scores),
+        category_idxs=paddle.to_tensor(cats), categories=[0, 1],
+    ).numpy()
+    assert sorted(keep.tolist()) == [0, 1]  # different classes: both survive
+
+
+def test_box_iou():
+    a = np.array([[0, 0, 2, 2]], "float32")
+    b = np.array([[1, 1, 3, 3], [0, 0, 2, 2]], "float32")
+    iou = ops.box_iou(paddle.to_tensor(a), paddle.to_tensor(b)).numpy()
+    np.testing.assert_allclose(iou[0], [1 / 7, 1.0], rtol=1e-5)
+
+
+def test_roi_align_constant_region():
+    x = np.zeros((1, 1, 8, 8), "float32")
+    x[0, 0, 2:6, 2:6] = 5.0
+    rois = np.array([[2.0, 2.0, 6.0, 6.0]], "float32")
+    out = ops.roi_align(
+        paddle.to_tensor(x), paddle.to_tensor(rois), boxes_num=paddle.to_tensor(np.array([1], "int32")),
+        output_size=2, spatial_scale=1.0, aligned=True,
+    )
+    np.testing.assert_allclose(out.numpy(), np.full((1, 1, 2, 2), 5.0), rtol=1e-4)
+
+
+def test_roi_pool_max():
+    x = np.zeros((1, 1, 8, 8), "float32")
+    x[0, 0, 3, 3] = 7.0
+    rois = np.array([[0.0, 0.0, 8.0, 8.0]], "float32")
+    out = ops.roi_pool(
+        paddle.to_tensor(x), paddle.to_tensor(rois), boxes_num=paddle.to_tensor(np.array([1], "int32")),
+        output_size=1, spatial_scale=1.0,
+    )
+    assert float(out.numpy().max()) == 7.0
+
+
+def test_deform_conv_zero_offset_equals_conv():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 3, 8, 8).astype("float32")
+    w = rng.randn(4, 3, 3, 3).astype("float32")
+    offset = np.zeros((2, 2 * 9, 6, 6), "float32")
+    out = ops.deform_conv2d(
+        paddle.to_tensor(x), paddle.to_tensor(offset), paddle.to_tensor(w)
+    ).numpy()
+    want = paddle.nn.functional.conv2d(paddle.to_tensor(x), paddle.to_tensor(w)).numpy()
+    np.testing.assert_allclose(out, want, rtol=1e-3, atol=1e-3)
+
+
+def test_deform_conv_with_mask():
+    rng = np.random.RandomState(1)
+    x = rng.randn(1, 2, 6, 6).astype("float32")
+    w = rng.randn(3, 2, 3, 3).astype("float32")
+    offset = np.zeros((1, 18, 4, 4), "float32")
+    mask = np.full((1, 9, 4, 4), 0.5, "float32")
+    out = ops.deform_conv2d(
+        paddle.to_tensor(x), paddle.to_tensor(offset), paddle.to_tensor(w), mask=paddle.to_tensor(mask)
+    ).numpy()
+    want = 0.5 * paddle.nn.functional.conv2d(paddle.to_tensor(x), paddle.to_tensor(w)).numpy()
+    np.testing.assert_allclose(out, want, rtol=1e-3, atol=1e-3)
+
+
+def test_distribute_fpn_proposals():
+    rois = np.array(
+        [[0, 0, 16, 16], [0, 0, 64, 64], [0, 0, 224, 224], [0, 0, 500, 500]], "float32"
+    )
+    multi, restore, nums = ops.distribute_fpn_proposals(paddle.to_tensor(rois), 2, 5, 4, 224)
+    assert len(multi) == 4
+    total = sum(int(n.numpy()[0]) for n in nums)
+    assert total == 4
+    # restore index maps concatenated levels back to original order
+    cat = np.concatenate([m.numpy() for m in multi if m.numpy().size], 0)
+    np.testing.assert_allclose(cat[restore.numpy()], rois)
+
+
+# ---------- datasets ----------
+
+def test_synthetic_datasets_shapes():
+    m = datasets.MNIST(mode="train")
+    img, label = m[0]
+    assert img.shape == (28, 28) and label.shape == (1,)
+    c = datasets.Cifar10(mode="test")
+    img, _ = c[0]
+    assert img.shape == (32, 32, 3)
+    f = datasets.Flowers(mode="test")
+    img, lbl = f[5]
+    assert img.shape == (64, 64, 3) and 0 <= int(lbl[0]) < 102
+
+
+def test_dataset_folder(tmp_path):
+    for cls in ("cat", "dog"):
+        d = tmp_path / cls
+        d.mkdir()
+        for i in range(3):
+            np.save(d / f"{i}.npy", np.full((4, 4), i, np.float32))
+    ds = datasets.DatasetFolder(str(tmp_path))
+    assert len(ds) == 6
+    assert ds.classes == ["cat", "dog"]
+    img, target = ds[0]
+    assert img.shape == (4, 4) and target == 0
+
+
+def test_deform_conv_bias_grad_flows():
+    rng = np.random.RandomState(2)
+    x = paddle.to_tensor(rng.randn(1, 2, 5, 5).astype("float32"))
+    w = paddle.to_tensor(rng.randn(3, 2, 3, 3).astype("float32"), stop_gradient=False)
+    b = paddle.to_tensor(np.zeros(3, "float32"), stop_gradient=False)
+    off = paddle.to_tensor(np.zeros((1, 18, 3, 3), "float32"))
+    out = ops.deform_conv2d(x, off, w, bias=b)
+    out.sum().backward()
+    assert b.grad is not None and np.allclose(b.grad.numpy(), 9.0)  # 3x3 output positions
+
+
+def test_rotate_expand():
+    from paddle_tpu.vision.transforms import functional as F
+
+    img = np.ones((10, 4), np.uint8) * 255
+    out = F.rotate(img, 90, expand=True)
+    assert out.shape[0] >= 4 and out.shape[1] >= 10  # canvas grew to fit
+
+
+def test_random_crop_pad_if_needed_width():
+    img = np.zeros((32, 32), np.uint8)
+    out = T.RandomCrop((32, 64), pad_if_needed=True)(img)
+    assert out.shape == (32, 64)
