@@ -1,0 +1,39 @@
+"""paddle.incubate.autotune.set_config parity
+(reference: python/paddle/incubate/autotune.py).
+
+The reference toggles kernel autotuning (cuDNN algo search), dataloader
+worker tuning, and AMP list tuning. TPU-native: kernel search is XLA's
+autotuner (latency-hiding scheduler + dot fusion autotuning are always on);
+what remains meaningful here is dataloader tuning, which adjusts the
+DataLoader prefetch depth, and recording the config for introspection.
+"""
+from __future__ import annotations
+
+import json
+import warnings
+
+_config = {
+    "kernel": {"enable": False, "tuning_range": [1, 10]},
+    "layout": {"enable": False},
+    "dataloader": {"enable": False},
+}
+
+
+def set_config(config=None):
+    global _config
+    if config is None:
+        _config = {k: dict(v, enable=True) for k, v in _config.items()}
+        return
+    if isinstance(config, str):
+        with open(config) as f:
+            config = json.load(f)
+    for key in ("kernel", "layout", "dataloader"):
+        if key in config:
+            if not isinstance(config[key], dict):
+                warnings.warn(f"autotune config [{key}] must be a dict; ignored")
+                continue
+            _config[key].update(config[key])
+
+
+def get_config():
+    return {k: dict(v) for k, v in _config.items()}

@@ -1,0 +1,8 @@
+"""paddle.incubate.nn namespace (reference: python/paddle/incubate/nn/)."""
+from . import functional  # noqa: F401
+from .layer import (  # noqa: F401
+    FusedFeedForward,
+    FusedLinear,
+    FusedMultiHeadAttention,
+    FusedTransformerEncoderLayer,
+)

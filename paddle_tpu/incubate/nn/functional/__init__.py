@@ -1,0 +1,324 @@
+"""Fused ops (reference: python/paddle/incubate/nn/functional/ —
+fused_rms_norm.py, swiglu.py, fused_transformer.py, fused_rotary_position_
+embedding.py, fused_dropout_add.py).
+
+TPU-native: "fused" here means (a) a Pallas kernel where the fusion is
+genuinely profitable (rms_norm: one VMEM pass instead of two reductions) and
+(b) jit-scoped jnp expressions elsewhere — XLA fuses elementwise chains into
+the surrounding matmuls on its own, so the CUDA-style mega-kernels of the
+reference collapse to composition.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ....core.apply import apply
+from ....core.tensor import Tensor
+
+_BLOCK_R = 256
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# rms_norm — Pallas kernel
+# ---------------------------------------------------------------------------
+
+def _rms_norm_ref(x, w, b, eps):
+    ms = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    out = x.astype(jnp.float32) * jax.lax.rsqrt(ms + eps)
+    out = out * w.astype(jnp.float32)
+    if b is not None:
+        out = out + b.astype(jnp.float32)
+    return out.astype(x.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("eps", "has_bias"))
+def _rms_norm_pallas_2d(x, w, b, eps, has_bias):
+    """Rows-normalize [R, D] in one VMEM pass (pallas_guide.md pattern:
+    block rows, keep the row reduction in-register)."""
+    from jax.experimental import pallas as pl
+
+    r, d = x.shape
+
+    def kernel(x_ref, w_ref, b_ref, o_ref):
+        xb = x_ref[...].astype(jnp.float32)
+        ms = jnp.mean(xb * xb, axis=-1, keepdims=True)
+        out = xb * jax.lax.rsqrt(ms + eps) * w_ref[...].astype(jnp.float32)
+        if has_bias:
+            out = out + b_ref[...].astype(jnp.float32)
+        o_ref[...] = out.astype(o_ref.dtype)
+
+    block_r = _BLOCK_R
+    while r % block_r:
+        block_r //= 2
+        if block_r == 0:
+            return _rms_norm_ref(x, w, b if has_bias else None, eps)
+    bz = b if has_bias else jnp.zeros_like(w)
+    return pl.pallas_call(
+        kernel,
+        grid=(r // block_r,),
+        in_specs=[
+            pl.BlockSpec((block_r, d), lambda i: (i, 0)),
+            pl.BlockSpec((d,), lambda i: (0,)),
+            pl.BlockSpec((d,), lambda i: (0,)),
+        ],
+        out_specs=pl.BlockSpec((block_r, d), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((r, d), x.dtype),
+    )(x, w, bz)
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6, begin_norm_axis=-1, **kw):
+    """paddle.incubate.nn.functional.fused_rms_norm parity."""
+
+    def fn(xv, wv, *rest):
+        bv = rest[0] if norm_bias is not None else None
+        if begin_norm_axis not in (-1, xv.ndim - 1):
+            raise NotImplementedError("fused_rms_norm normalizes the last axis")
+        d = xv.shape[-1]
+        lead = xv.shape[:-1]
+        x2 = xv.reshape(-1, d)
+        rows = x2.shape[0]
+        use_pallas = _on_tpu() and d % 128 == 0 and rows % 8 == 0
+        if use_pallas:
+            with jax.enable_x64(False):  # Mosaic rejects i64 index types
+                out = _rms_norm_pallas_2d(x2, wv, bv if bv is not None else None, float(epsilon), bv is not None)
+        else:
+            out = _rms_norm_ref(x2, wv, bv, float(epsilon))
+        return out.reshape(*lead, d)
+
+    args = [x, norm_weight] + ([norm_bias] if norm_bias is not None else [])
+    return apply("fused_rms_norm", fn, *args)
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5, begin_norm_axis=-1, **kw):
+    # one canonical last-axis layer norm lives in nn/functional/norm.py
+    from ....nn.functional.norm import layer_norm as _layer_norm
+
+    return _layer_norm(x, int(x.shape[-1]), norm_weight, norm_bias, epsilon)
+
+
+# ---------------------------------------------------------------------------
+# activations / glu
+# ---------------------------------------------------------------------------
+
+def swiglu(x, y=None, name=None):
+    """reference swiglu.py: silu(x) * y; with y=None, x splits in half."""
+    if y is None:
+        return apply("swiglu", lambda v: (lambda a, b: jax.nn.silu(a) * b)(*jnp.split(v, 2, axis=-1)), x)
+    return apply("swiglu", lambda a, b: jax.nn.silu(a) * b, x, y)
+
+
+def fused_bias_act(x, bias=None, act_method="gelu", **kw):
+    acts = {
+        "gelu": jax.nn.gelu,
+        "relu": jax.nn.relu,
+        "silu": jax.nn.silu,
+        "swiglu": lambda v: (lambda a, b: jax.nn.silu(a) * b)(*jnp.split(v, 2, axis=-1)),
+        "geglu": lambda v: (lambda a, b: jax.nn.gelu(a) * b)(*jnp.split(v, 2, axis=-1)),
+    }
+    act = acts[act_method]
+    if bias is None:
+        return apply(f"fused_bias_{act_method}", act, x)
+    return apply(f"fused_bias_{act_method}", lambda v, b: act(v + b), x, bias)
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train", seed=None, name=None):
+    """reference fused_dropout_add.py: dropout(x) + y. Delegates to the
+    canonical dropout (nn/functional/common.py) so mode semantics — incl.
+    downscale_in_infer's (1-p) eval scaling — stay in one place; XLA fuses
+    the add."""
+    from ....nn.functional.common import dropout as _dropout
+
+    return _dropout(x, p=p, training=training, mode=mode) + y
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    def fn(xv, wv, *rest):
+        w = wv.T if transpose_weight else wv
+        out = xv @ w
+        if rest:
+            out = out + rest[0]
+        return out
+
+    args = [x, weight] + ([bias] if bias is not None else [])
+    return apply("fused_linear", fn, *args)
+
+
+def fused_linear_activation(x, y, bias, trans_x=False, trans_y=False, activation="gelu"):
+    acts = {"gelu": jax.nn.gelu, "relu": jax.nn.relu, "none": lambda v: v}
+    act = acts[activation]
+
+    def fn(xv, yv, bv):
+        a = xv.T if trans_x else xv
+        b = yv.T if trans_y else yv
+        return act(a @ b + bv)
+
+    return apply("fused_linear_activation", fn, x, y, bias)
+
+
+# ---------------------------------------------------------------------------
+# rotary embedding
+# ---------------------------------------------------------------------------
+
+def fused_rotary_position_embedding(
+    q, k=None, v=None, sin=None, cos=None, position_ids=None, use_neox_rotary_style=True, name=None
+):
+    """reference fused_rotary_position_embedding.py. q/k/v: [B, S, H, D];
+    sin/cos: [1, S, 1, D] (auto-built when not given)."""
+
+    def build_sincos(s, d, dtype):
+        inv = 1.0 / (10000.0 ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+        t = jnp.arange(s, dtype=jnp.float32)
+        freqs = jnp.outer(t, inv)  # [S, D/2]
+        emb = jnp.concatenate([freqs, freqs], axis=-1) if use_neox_rotary_style else jnp.repeat(freqs, 2, axis=-1)
+        return jnp.sin(emb).astype(dtype)[None, :, None, :], jnp.cos(emb).astype(dtype)[None, :, None, :]
+
+    def rotate(xv, sinv, cosv):
+        if use_neox_rotary_style:
+            half = xv.shape[-1] // 2
+            x1, x2 = xv[..., :half], xv[..., half:]
+            rot = jnp.concatenate([-x2, x1], axis=-1)
+        else:
+            x1 = xv[..., 0::2]
+            x2 = xv[..., 1::2]
+            rot = jnp.stack([-x2, x1], axis=-1).reshape(xv.shape)
+        return xv * cosv + rot * sinv
+
+    ref = next(t for t in (q, k, v) if t is not None)
+    s_len, d = int(ref.shape[1]), int(ref.shape[-1])
+    if sin is None or cos is None:
+        sv, cv = build_sincos(s_len, d, jnp.float32)
+    else:
+        sv = sin._value if isinstance(sin, Tensor) else jnp.asarray(sin)
+        cv = cos._value if isinstance(cos, Tensor) else jnp.asarray(cos)
+    if position_ids is not None:
+        pid = position_ids._value if isinstance(position_ids, Tensor) else jnp.asarray(position_ids)
+        sv = jnp.take(sv[0, :, 0, :], pid, axis=0)[:, :, None, :]
+        cv = jnp.take(cv[0, :, 0, :], pid, axis=0)[:, :, None, :]
+    sv32, cv32 = sv.astype(jnp.float32), cv.astype(jnp.float32)
+
+    def fn(xv):
+        return rotate(xv.astype(jnp.float32), sv32, cv32).astype(xv.dtype)
+
+    outs = [apply("fused_rope", fn, t) if t is not None else None for t in (q, k, v)]
+    return tuple(outs)
+
+
+# ---------------------------------------------------------------------------
+# attention / ffn blocks
+# ---------------------------------------------------------------------------
+
+def fused_multi_head_attention(
+    x,
+    qkv_weight,
+    linear_weight,
+    pre_layer_norm=False,
+    pre_ln_scale=None,
+    pre_ln_bias=None,
+    ln_scale=None,
+    ln_bias=None,
+    pre_ln_epsilon=1e-5,
+    qkv_bias=None,
+    linear_bias=None,
+    cache_kv=None,
+    attn_mask=None,
+    dropout_rate=0.0,
+    attn_dropout_rate=0.0,
+    ln_epsilon=1e-5,
+    training=True,
+    num_heads=None,
+    name=None,
+):
+    """reference fused_transformer.py fused_multi_head_attention:
+    (pre-LN ->) qkv matmul -> attention -> out proj (-> post-LN), flash
+    attention kernel when shapes allow. qkv_weight: [3, H, D, E]."""
+    from ....nn.functional.attention import scaled_dot_product_attention
+
+    if cache_kv is not None:
+        raise NotImplementedError("fused_multi_head_attention: cache_kv (incremental decode) not yet supported")
+    xin = x
+    if pre_layer_norm:
+        xin = fused_layer_norm(x, pre_ln_scale, pre_ln_bias, pre_ln_epsilon)
+
+    def qkv_fn(xv, wv, *rest):
+        b, s, e = xv.shape
+        three, h, d, _ = wv.shape
+        qkv = jnp.einsum("bse,thde->bsthd", xv, wv)
+        if rest:
+            qkv = qkv + rest[0][None, None]
+        return qkv
+
+    args = [xin, qkv_weight] + ([qkv_bias] if qkv_bias is not None else [])
+    qkv = apply("fused_qkv", qkv_fn, *args)
+    q = qkv[:, :, 0]
+    k = qkv[:, :, 1]
+    v = qkv[:, :, 2]
+    ctx = scaled_dot_product_attention(q, k, v, attn_mask=attn_mask, dropout_p=attn_dropout_rate if training else 0.0)
+
+    def proj_fn(cv, wv, *rest):
+        b, s, h, d = cv.shape
+        out = cv.reshape(b, s, h * d) @ wv
+        if rest:
+            out = out + rest[0]
+        return out
+
+    args = [ctx, linear_weight] + ([linear_bias] if linear_bias is not None else [])
+    out = apply("fused_attn_proj", proj_fn, *args)
+    if dropout_rate and training:
+        from ....nn.functional.common import dropout as _dropout
+
+        out = _dropout(out, p=dropout_rate, training=True)
+    out = out + x  # residual (reference adds residual inside the fused op)
+    if not pre_layer_norm:
+        out = fused_layer_norm(out, ln_scale, ln_bias, ln_epsilon)
+    return out
+
+
+def fused_feedforward(
+    x,
+    linear1_weight,
+    linear2_weight,
+    linear1_bias=None,
+    linear2_bias=None,
+    ln1_scale=None,
+    ln1_bias=None,
+    ln2_scale=None,
+    ln2_bias=None,
+    dropout1_rate=0.5,
+    dropout2_rate=0.5,
+    activation="relu",
+    ln1_epsilon=1e-5,
+    ln2_epsilon=1e-5,
+    pre_layer_norm=False,
+    training=True,
+    name=None,
+):
+    """reference fused_transformer.py fused_feedforward: (pre-LN ->) linear
+    -> act -> dropout -> linear -> dropout -> residual (-> post-LN)."""
+    from ....nn.functional.common import dropout as _dropout
+
+    xin = x
+    if pre_layer_norm:
+        xin = fused_layer_norm(x, ln1_scale, ln1_bias, ln1_epsilon)
+    h = fused_linear(xin, linear1_weight, linear1_bias)
+    if activation != "none":
+        h = fused_bias_act(h, None, act_method=activation)
+    if dropout1_rate and training:
+        h = _dropout(h, p=dropout1_rate, training=True)
+    h = fused_linear(h, linear2_weight, linear2_bias)
+    if dropout2_rate and training:
+        h = _dropout(h, p=dropout2_rate, training=True)
+    out = x + h
+    if not pre_layer_norm:
+        out = fused_layer_norm(out, ln2_scale, ln2_bias, ln2_epsilon)
+    return out

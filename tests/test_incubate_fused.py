@@ -1,0 +1,132 @@
+"""incubate fused ops + layers + autotune + auto-checkpoint."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.incubate.nn.functional as F
+
+
+def test_fused_rms_norm_matches_reference():
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(8, 128).astype("float32"))
+    w = paddle.to_tensor(rng.rand(128).astype("float32") + 0.5)
+    out = F.fused_rms_norm(x, w, epsilon=1e-6).numpy()
+    xv = x.numpy()
+    want = xv / np.sqrt((xv**2).mean(-1, keepdims=True) + 1e-6) * w.numpy()
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+    # with bias + odd shapes (fallback path)
+    b = paddle.to_tensor(rng.randn(100).astype("float32"))
+    x2 = paddle.to_tensor(rng.randn(3, 5, 100).astype("float32"))
+    w2 = paddle.to_tensor(np.ones(100, "float32"))
+    out2 = F.fused_rms_norm(x2, w2, norm_bias=b).numpy()
+    xv2 = x2.numpy()
+    want2 = xv2 / np.sqrt((xv2**2).mean(-1, keepdims=True) + 1e-6) + b.numpy()
+    np.testing.assert_allclose(out2, want2, rtol=1e-4, atol=1e-5)
+
+
+def test_fused_rms_norm_grad():
+    x = paddle.to_tensor(np.random.RandomState(1).randn(8, 128).astype("float32"), stop_gradient=False)
+    w = paddle.to_tensor(np.ones(128, "float32"), stop_gradient=False)
+    F.fused_rms_norm(x, w).sum().backward()
+    assert x.grad is not None and w.grad is not None
+    assert np.abs(w.grad.numpy()).sum() > 0
+
+
+def test_swiglu():
+    rng = np.random.RandomState(0)
+    a = rng.randn(4, 8).astype("float32")
+    b = rng.randn(4, 8).astype("float32")
+    out = F.swiglu(paddle.to_tensor(a), paddle.to_tensor(b)).numpy()
+    silu = a / (1 + np.exp(-a)) * b
+    np.testing.assert_allclose(out, silu, rtol=1e-5)
+    # split form
+    cat = np.concatenate([a, b], -1)
+    out2 = F.swiglu(paddle.to_tensor(cat)).numpy()
+    np.testing.assert_allclose(out2, silu, rtol=1e-5)
+
+
+def test_fused_rope_neox_roundtrip():
+    rng = np.random.RandomState(0)
+    q = paddle.to_tensor(rng.randn(2, 16, 4, 32).astype("float32"))
+    k = paddle.to_tensor(rng.randn(2, 16, 4, 32).astype("float32"))
+    oq, ok, _ = F.fused_rotary_position_embedding(q, k, None)
+    assert tuple(oq.shape) == (2, 16, 4, 32)
+    # norms preserved per 2d rotation pair
+    np.testing.assert_allclose(
+        np.linalg.norm(oq.numpy(), axis=-1), np.linalg.norm(q.numpy(), axis=-1), rtol=1e-4
+    )
+    # position 0 is identity (angle 0)
+    np.testing.assert_allclose(oq.numpy()[:, 0], q.numpy()[:, 0], rtol=1e-5)
+
+
+def test_fused_dropout_add_and_linear():
+    x = paddle.to_tensor(np.ones((4, 8), "float32"))
+    y = paddle.to_tensor(np.full((4, 8), 2.0, "float32"))
+    out = F.fused_dropout_add(x, y, p=0.0, training=True)
+    np.testing.assert_allclose(out.numpy(), 3.0)
+    w = paddle.to_tensor(np.random.RandomState(0).randn(8, 3).astype("float32"))
+    b = paddle.to_tensor(np.zeros(3, "float32"))
+    lo = F.fused_linear(x, w, b).numpy()
+    np.testing.assert_allclose(lo, x.numpy() @ w.numpy(), rtol=1e-5)
+
+
+def test_fused_mha_layer_runs_and_trains():
+    import paddle_tpu.incubate.nn as inn
+
+    layer = inn.FusedMultiHeadAttention(64, 4, dropout_rate=0.0, attn_dropout_rate=0.0)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(2, 8, 64).astype("float32"))
+    out = layer(x)
+    assert tuple(out.shape) == (2, 8, 64)
+    out.sum().backward()
+    assert layer.qkv_weight.grad is not None
+
+
+def test_fused_encoder_layer():
+    import paddle_tpu.incubate.nn as inn
+
+    enc = inn.FusedTransformerEncoderLayer(32, 2, 64, dropout_rate=0.0)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(2, 6, 32).astype("float32"))
+    out = enc(x)
+    assert tuple(out.shape) == (2, 6, 32)
+
+
+def test_autotune_config():
+    from paddle_tpu.incubate import autotune
+
+    autotune.set_config({"dataloader": {"enable": True}})
+    assert autotune.get_config()["dataloader"]["enable"]
+
+
+def test_auto_checkpoint_resume(tmp_path, monkeypatch):
+    from paddle_tpu.incubate.checkpoint import auto_checkpoint as ac
+
+    monkeypatch.setenv(ac.ENV_DIR, str(tmp_path))
+    net = paddle.nn.Linear(2, 2)
+    opt = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+    r = ac.train_epoch_range(3, name="job1", save_checkpoint_inter=0)
+    r.attach(net, opt)
+    seen = []
+    for e in r:
+        seen.append(e)
+        net(paddle.ones([1, 2])).sum().backward()
+        opt.step()
+        opt.clear_grad()
+    assert seen == [0, 1, 2]
+    w_trained = net.weight.numpy().copy()
+
+    # "relaunch": fresh net resumes from epoch 3 (nothing to do) with weights restored
+    net2 = paddle.nn.Linear(2, 2)
+    r2 = ac.train_epoch_range(3, name="job1", save_checkpoint_inter=0)
+    r2.attach(net2)
+    seen2 = list(r2)
+    assert seen2 == []  # all epochs done
+    # partial resume: max_epoch larger -> restores weights then continues
+    net3 = paddle.nn.Linear(2, 2)
+    r3 = ac.train_epoch_range(5, name="job1", save_checkpoint_inter=0)
+    r3.attach(net3)
+    it = iter(r3)
+    first = next(it)
+    assert first == 3
+    np.testing.assert_allclose(net3.weight.numpy(), w_trained)
