@@ -151,6 +151,7 @@ from .jit import to_static  # noqa: E402,F401
 from . import hapi  # noqa: E402,F401
 from . import profiler  # noqa: E402,F401
 from . import distribution  # noqa: E402,F401
+from . import quantization  # noqa: E402,F401
 from . import sparse  # noqa: E402,F401
 from . import fft  # noqa: E402,F401
 from . import signal  # noqa: E402,F401

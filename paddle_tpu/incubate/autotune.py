@@ -4,8 +4,8 @@
 The reference toggles kernel autotuning (cuDNN algo search), dataloader
 worker tuning, and AMP list tuning. TPU-native: kernel search is XLA's
 autotuner (latency-hiding scheduler + dot fusion autotuning are always on);
-what remains meaningful here is dataloader tuning, which adjusts the
-DataLoader prefetch depth, and recording the config for introspection.
+what remains meaningful here is dataloader tuning: DataLoader consults
+get_config() at iteration start and deepens its prefetch when enabled.
 """
 from __future__ import annotations
 

@@ -42,8 +42,29 @@ def _rms_norm_ref(x, w, b, eps):
     return out.astype(x.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("eps", "has_bias"))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def _rms_norm_pallas_2d(x, w, b, eps, has_bias):
+    """Pallas forward + reference-impl backward: pallas_call has no built-in
+    AD rule, so the vjp recomputes through _rms_norm_ref (same pattern as
+    flash_attention_bshd in ops/pallas.py)."""
+    return _rms_norm_pallas_fwd_impl(x, w, b, eps, has_bias)
+
+
+def _rms_norm_fwd_rule(x, w, b, eps, has_bias):
+    return _rms_norm_pallas_fwd_impl(x, w, b, eps, has_bias), (x, w, b)
+
+
+def _rms_norm_bwd_rule(eps, has_bias, res, g):
+    x, w, b = res
+    _, vjp = jax.vjp(lambda a, ww, bb: _rms_norm_ref(a, ww, bb if has_bias else None, eps), x, w, b)
+    return vjp(g)
+
+
+_rms_norm_pallas_2d.defvjp(_rms_norm_fwd_rule, _rms_norm_bwd_rule)
+
+
+@functools.partial(jax.jit, static_argnames=("eps", "has_bias"))
+def _rms_norm_pallas_fwd_impl(x, w, b, eps, has_bias):
     """Rows-normalize [R, D] in one VMEM pass (pallas_guide.md pattern:
     block rows, keep the row reduction in-register)."""
     from jax.experimental import pallas as pl
@@ -91,7 +112,8 @@ def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6, begin_norm_axis
         use_pallas = _on_tpu() and d % 128 == 0 and rows % 8 == 0
         if use_pallas:
             with jax.enable_x64(False):  # Mosaic rejects i64 index types
-                out = _rms_norm_pallas_2d(x2, wv, bv if bv is not None else None, float(epsilon), bv is not None)
+                bz = bv if bv is not None else jnp.zeros_like(wv)
+                out = _rms_norm_pallas_2d(x2, wv, bz, float(epsilon), bv is not None)
         else:
             out = _rms_norm_ref(x2, wv, bv, float(epsilon))
         return out.reshape(*lead, d)
@@ -101,10 +123,14 @@ def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6, begin_norm_axis
 
 
 def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5, begin_norm_axis=-1, **kw):
-    # one canonical last-axis layer norm lives in nn/functional/norm.py
+    # the canonical layer norm lives in nn/functional/norm.py; begin_norm_axis
+    # selects how many trailing axes normalize (reference semantics)
     from ....nn.functional.norm import layer_norm as _layer_norm
 
-    return _layer_norm(x, int(x.shape[-1]), norm_weight, norm_bias, epsilon)
+    ndim = len(x.shape)
+    begin = begin_norm_axis % ndim
+    normalized_shape = [int(d) for d in x.shape[begin:]]
+    return _layer_norm(x, normalized_shape, norm_weight, norm_bias, epsilon)
 
 
 # ---------------------------------------------------------------------------

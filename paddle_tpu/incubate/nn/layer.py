@@ -7,8 +7,6 @@ fused layouts (qkv_weight [3, H, D, E]) so state_dicts port over.
 """
 from __future__ import annotations
 
-import numpy as np
-
 from ...nn.layer import Layer
 from . import functional as F
 
@@ -66,14 +64,18 @@ class FusedMultiHeadAttention(Layer):
         self.linear_bias = (
             None if linear_bias_attr is False else self.create_parameter((embed_dim,), is_bias=True)
         )
-        ones = np.ones(embed_dim, np.float32)
-        zeros = np.zeros(embed_dim, np.float32)
-        self.pre_ln_scale = self.create_parameter((embed_dim,), default_initializer=lambda s, d: ones)
+        from ...nn.initializer import Constant
+
+        self.pre_ln_scale = self.create_parameter((embed_dim,), default_initializer=Constant(1.0))
         self.pre_ln_bias = self.create_parameter((embed_dim,), is_bias=True)
-        self.ln_scale = self.create_parameter((embed_dim,), default_initializer=lambda s, d: ones)
+        self.ln_scale = self.create_parameter((embed_dim,), default_initializer=Constant(1.0))
         self.ln_bias = self.create_parameter((embed_dim,), is_bias=True)
 
     def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
+        if cache is not None:
+            raise NotImplementedError("FusedMultiHeadAttention: cache (incremental decode) not supported")
+        if (key is not None and key is not query) or (value is not None and value is not query):
+            raise NotImplementedError("FusedMultiHeadAttention computes self-attention; cross-attention needs nn.MultiHeadAttention")
         return F.fused_multi_head_attention(
             query,
             self.qkv_weight,
@@ -118,7 +120,8 @@ class FusedFeedForward(Layer):
         name=None,
     ):
         super().__init__()
-        ones = np.ones(d_model, np.float32)
+        from ...nn.initializer import Constant
+
         self.linear1_weight = self.create_parameter((d_model, dim_feedforward), attr=linear1_weight_attr)
         self.linear1_bias = (
             None if linear1_bias_attr is False else self.create_parameter((dim_feedforward,), is_bias=True)
@@ -127,9 +130,9 @@ class FusedFeedForward(Layer):
         self.linear2_bias = (
             None if linear2_bias_attr is False else self.create_parameter((d_model,), is_bias=True)
         )
-        self.ln1_scale = self.create_parameter((d_model,), default_initializer=lambda s, d: ones)
+        self.ln1_scale = self.create_parameter((d_model,), default_initializer=Constant(1.0))
         self.ln1_bias = self.create_parameter((d_model,), is_bias=True)
-        self.ln2_scale = self.create_parameter((d_model,), default_initializer=lambda s, d: ones)
+        self.ln2_scale = self.create_parameter((d_model,), default_initializer=Constant(1.0))
         self.ln2_bias = self.create_parameter((d_model,), is_bias=True)
         self.dropout_rate = dropout_rate
         self.act_dropout_rate = dropout_rate if act_dropout_rate is None else act_dropout_rate

@@ -467,6 +467,13 @@ class DataLoader:
     def __iter__(self):
         if self.prefetch and self.num_workers != 0:
             depth = self.prefetch * max(self.num_workers, 1)
+            try:  # incubate.autotune dataloader tuning: deepen prefetch
+                from ..incubate.autotune import get_config
+
+                if get_config()["dataloader"].get("enable"):
+                    depth = max(depth, 2 * self.prefetch * max(self.num_workers, 1), 8)
+            except Exception:
+                pass
             if self.use_shared_memory:
                 from ..native import NativeUnavailable
 

@@ -1,0 +1,24 @@
+"""paddle.quantization namespace.
+
+Reference parity: python/paddle/quantization/ — QuantConfig (per-layer /
+per-type quanter wiring), QAT (quantize-aware training via fake quant with
+straight-through gradients), PTQ (observer insertion + convert). TPU-native:
+fake quant is the STE identity trick `x + stop_gradient(q(x) - x)` (works
+under jax AD and jit); int8 simulation stays in the bf16/f32 compute graph,
+which is how XLA consumes quantization anyway (scale annotations, not int
+kernels, on current TPU gens).
+"""
+from .config import QuantConfig  # noqa: F401
+from .observers import AbsmaxObserver, AVGObserver  # noqa: F401
+from .ptq import PTQ  # noqa: F401
+from .qat import QAT  # noqa: F401
+from .quanters import FakeQuanterWithAbsMaxObserver  # noqa: F401
+
+__all__ = [
+    "QuantConfig",
+    "QAT",
+    "PTQ",
+    "FakeQuanterWithAbsMaxObserver",
+    "AbsmaxObserver",
+    "AVGObserver",
+]

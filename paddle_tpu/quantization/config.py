@@ -1,0 +1,42 @@
+"""QuantConfig (reference: python/paddle/quantization/config.py).
+
+Maps layers (by instance, by type, or by name) to (activation, weight)
+quanter factories.
+"""
+from __future__ import annotations
+
+
+class QuantConfig:
+    def __init__(self, activation=None, weight=None):
+        self._global_activation = activation
+        self._global_weight = weight
+        self._type_configs = {}  # layer type -> (act, weight)
+        self._layer_configs = {}  # id(layer) -> (act, weight)
+        self._name_configs = {}  # qualified name -> (act, weight)
+
+    def add_layer_config(self, layer, activation=None, weight=None):
+        layers = layer if isinstance(layer, (list, tuple)) else [layer]
+        for l in layers:
+            self._layer_configs[id(l)] = (activation, weight)
+
+    def add_name_config(self, layer_name, activation=None, weight=None):
+        names = layer_name if isinstance(layer_name, (list, tuple)) else [layer_name]
+        for n in names:
+            self._name_configs[n] = (activation, weight)
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        types = layer_type if isinstance(layer_type, (list, tuple)) else [layer_type]
+        for t in types:
+            self._type_configs[t] = (activation, weight)
+
+    def _config_for(self, layer, qualified_name=""):
+        if id(layer) in self._layer_configs:
+            return self._layer_configs[id(layer)]
+        if qualified_name in self._name_configs:
+            return self._name_configs[qualified_name]
+        for t, cfg in self._type_configs.items():
+            if type(layer) is t:
+                return cfg
+        if self._global_activation is not None or self._global_weight is not None:
+            return (self._global_activation, self._global_weight)
+        return None

@@ -1,0 +1,69 @@
+"""PTQ observers (reference: python/paddle/quantization/observers/abs_max.py).
+
+Observers watch activations during calibration (forward-only) and expose
+scales; they never alter the tensor.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .quanters import BaseQuanter, fake_quant
+
+
+class BaseObserver(BaseQuanter):
+    pass
+
+
+class AbsmaxObserverLayer(BaseObserver):
+    def __init__(self, layer=None, quant_bits=8):
+        super().__init__()
+        self._quant_bits = quant_bits
+        self.register_buffer("scale", Tensor(jnp.asarray(1e-9, jnp.float32)))
+
+    def forward(self, x):
+        absmax = jnp.max(jnp.abs(x._value)).astype(jnp.float32)
+        self.scale._replace_value(jnp.maximum(self.scale._value, absmax))
+        return x
+
+    def scales(self):
+        return self.scale
+
+    def bit_length(self):
+        return self._quant_bits
+
+
+class AVGObserverLayer(BaseObserver):
+    def __init__(self, layer=None, quant_bits=8):
+        super().__init__()
+        self._quant_bits = quant_bits
+        self.register_buffer("scale", Tensor(jnp.asarray(0.0, jnp.float32)))
+        self._n = 0
+
+    def forward(self, x):
+        absmax = jnp.max(jnp.abs(x._value)).astype(jnp.float32)
+        self._n += 1
+        self.scale._replace_value(self.scale._value + (absmax - self.scale._value) / self._n)
+        return x
+
+    def scales(self):
+        return self.scale
+
+    def bit_length(self):
+        return self._quant_bits
+
+
+class AbsmaxObserver:
+    def __init__(self, quant_bits=8):
+        self.kwargs = dict(quant_bits=quant_bits)
+
+    def _instance(self, layer=None):
+        return AbsmaxObserverLayer(layer, **self.kwargs)
+
+
+class AVGObserver:
+    def __init__(self, quant_bits=8):
+        self.kwargs = dict(quant_bits=quant_bits)
+
+    def _instance(self, layer=None):
+        return AVGObserverLayer(layer, **self.kwargs)

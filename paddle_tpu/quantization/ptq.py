@@ -1,0 +1,60 @@
+"""PTQ driver (reference: python/paddle/quantization/ptq.py).
+
+PTQ(config).quantize(model) inserts observers; run calibration batches
+through the model; convert() replaces observers with fixed-scale fake-quant
+on weights and bakes the result.
+"""
+from __future__ import annotations
+
+import copy
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+from ..nn.layers.common import Linear
+from ..nn.layers.conv import Conv2D
+from .qat import _materialize_layer_configs, _walk_and_replace
+from .quanted_layers import QuantedConv2D, QuantedLinear
+from .quanters import fake_quant
+
+_PTQ_WRAPPERS = {Linear: QuantedLinear, Conv2D: QuantedConv2D}
+
+
+class PTQ:
+    def __init__(self, config):
+        self._config = config
+
+    def quantize(self, model: Layer, inplace=False):
+        _materialize_layer_configs(self._config, model)
+        if not inplace:
+            model = copy.deepcopy(model)
+
+        def decide(layer, qualified):
+            wrapper = _PTQ_WRAPPERS.get(type(layer))
+            if wrapper is None:
+                return None
+            cfg = self._config._config_for(layer, qualified)
+            if cfg is None:
+                return None
+            return wrapper(layer, cfg)
+
+        _walk_and_replace(model, decide)
+        model.eval()
+        return model
+
+    def convert(self, model: Layer, inplace=False):
+        if not inplace:
+            model = copy.deepcopy(model)
+
+        def decide(layer, qualified):
+            if isinstance(layer, (QuantedLinear, QuantedConv2D)):
+                inner = layer._inner
+                wq = layer.weight_quanter
+                if wq is not None:
+                    scale = wq.scales()
+                    bits = wq.bit_length() if hasattr(wq, "bit_length") else 8
+                    inner.weight._replace_value(fake_quant(inner.weight, scale, bits)._value)
+                return inner
+            return None
+
+        _walk_and_replace(model, decide)
+        return model

@@ -1,0 +1,81 @@
+"""QAT driver (reference: python/paddle/quantization/qat.py).
+
+QAT(config).quantize(model) swaps Linear/Conv2D sublayers for quantized
+wrappers per the QuantConfig; convert() strips quanters for deployment,
+leaving weights fake-quantized in place (deploy graph sees the quantized
+values — the reference's ONNX-style convert).
+"""
+from __future__ import annotations
+
+import copy
+
+from ..nn.layer import Layer
+from ..nn.layers.common import Linear
+from ..nn.layers.conv import Conv2D
+from .quanted_layers import QuantedConv2D, QuantedLinear
+
+_QAT_WRAPPERS = {Linear: QuantedLinear, Conv2D: QuantedConv2D}
+
+
+def _walk_and_replace(model: Layer, decide, prefix=""):
+    for name, child in list(model.named_children()):
+        qualified = f"{prefix}.{name}" if prefix else name
+        replacement = decide(child, qualified)
+        if replacement is not None:
+            model.add_sublayer(name, replacement)
+        else:
+            _walk_and_replace(child, decide, qualified)
+
+
+def _materialize_layer_configs(config, model, prefix=""):
+    """id(layer)-keyed configs don't survive deepcopy — pin them to the
+    layer's qualified name on the ORIGINAL model before copying."""
+    if not config._layer_configs:
+        return
+    for qualified, sub in model.named_sublayers(include_self=False):
+        cfg = config._layer_configs.get(id(sub))
+        if cfg is not None:
+            config._name_configs.setdefault(qualified, cfg)
+
+
+class QAT:
+    def __init__(self, config):
+        self._config = config
+
+    def quantize(self, model: Layer, inplace=False):
+        _materialize_layer_configs(self._config, model)
+        if not inplace:
+            model = copy.deepcopy(model)
+
+        def decide(layer, qualified):
+            wrapper = _QAT_WRAPPERS.get(type(layer))
+            if wrapper is None:
+                return None
+            cfg = self._config._config_for(layer, qualified)
+            if cfg is None:
+                return None
+            return wrapper(layer, cfg)
+
+        _walk_and_replace(model, decide)
+        return model
+
+    def convert(self, model: Layer, inplace=False):
+        """Bake fake-quantized weights into the plain layers."""
+        if not inplace:
+            model = copy.deepcopy(model)
+
+        def decide(layer, qualified):
+            if isinstance(layer, (QuantedLinear, QuantedConv2D)):
+                inner = layer._inner
+                if layer.weight_quanter is not None:
+                    with_q = layer.weight_quanter
+                    was_training = with_q.training
+                    with_q.eval()
+                    inner.weight._replace_value(with_q(inner.weight)._value)
+                    if was_training:
+                        with_q.train()
+                return inner
+            return None
+
+        _walk_and_replace(model, decide)
+        return model

@@ -1,0 +1,59 @@
+"""Quantized wrappers for nn layers.
+
+Reference parity: python/paddle/nn/quant/qat/ (QuantedLinear, QuantedConv2D)
+— the layers QAT swaps in: fake-quant the activation and the weight, then
+run the original computation.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..nn.layer import Layer
+from .quanters import fake_quant
+
+
+class QuantedLinear(Layer):
+    def __init__(self, layer, q_config):
+        super().__init__()
+        self._inner = layer
+        act_f, w_f = q_config
+        self.activation_quanter = act_f._instance(layer) if act_f is not None else None
+        self.weight_quanter = w_f._instance(layer) if w_f is not None else None
+
+    def forward(self, x):
+        w = self._inner.weight
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        out = x @ w
+        if getattr(self._inner, "bias", None) is not None:
+            out = out + self._inner.bias
+        return out
+
+
+class QuantedConv2D(Layer):
+    def __init__(self, layer, q_config):
+        super().__init__()
+        self._inner = layer
+        act_f, w_f = q_config
+        self.activation_quanter = act_f._instance(layer) if act_f is not None else None
+        self.weight_quanter = w_f._instance(layer) if w_f is not None else None
+
+    def forward(self, x):
+        from ..nn.functional.conv import conv2d
+
+        w = self._inner.weight
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        return conv2d(
+            x,
+            w,
+            bias=getattr(self._inner, "bias", None),
+            stride=self._inner._stride,
+            padding=self._inner._padding,
+            dilation=self._inner._dilation,
+            groups=self._inner._groups,
+        )

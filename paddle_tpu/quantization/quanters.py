@@ -1,0 +1,74 @@
+"""QAT quanters (reference: python/paddle/quantization/quanters/abs_max.py).
+
+FakeQuanterWithAbsMaxObserver: tracks a moving-average absmax scale and
+fake-quantizes with straight-through gradients.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+import numpy as np
+
+from ..core.apply import apply
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+
+
+def fake_quant(x, scale, bit_length=8):
+    """STE fake quantization: forward rounds to the int grid, backward is
+    identity (x + stop_grad(q - x))."""
+    qmax = float(2 ** (bit_length - 1) - 1)
+
+    def fn(v, s):
+        s = jnp.maximum(s.astype(jnp.float32), 1e-9)
+        q = jnp.clip(jnp.round(v.astype(jnp.float32) / s * qmax), -qmax, qmax) * s / qmax
+        return (v + lax.stop_gradient(q.astype(v.dtype) - v)).astype(v.dtype)
+
+    return apply("fake_quant", fn, x, scale)
+
+
+class BaseQuanter(Layer):
+    def scales(self):
+        raise NotImplementedError
+
+    def zero_points(self):
+        return None
+
+
+class FakeQuanterWithAbsMaxObserverLayer(BaseQuanter):
+    def __init__(self, layer=None, moving_rate=0.9, bit_length=8, dtype="float32", name=None):
+        super().__init__()
+        self._moving_rate = moving_rate
+        self._bit_length = bit_length
+        self.register_buffer("scale", Tensor(jnp.asarray(0.0, jnp.float32)))
+        self.register_buffer("state", Tensor(jnp.asarray(0.0, jnp.float32)))
+
+    def forward(self, x):
+        if self.training:
+            # all-device update: no host sync in the training hot loop
+            absmax = jnp.max(jnp.abs(x._value)).astype(jnp.float32)
+            r = self._moving_rate
+            state = self.state._value * r + 1.0
+            old = self.scale._value
+            scale = jnp.where(state > 1.0, (old * (state - 1.0) + absmax) / state, absmax)
+            self.scale._replace_value(jnp.maximum(scale, 1e-9))
+            self.state._replace_value(state)
+        return fake_quant(x, self.scale, self._bit_length)
+
+    def scales(self):
+        return self.scale
+
+    def bit_length(self):
+        return self._bit_length
+
+
+class FakeQuanterWithAbsMaxObserver:
+    """Factory (reference QuanterFactory): holds kwargs, instantiates the
+    layer-level quanter per wrapped layer."""
+
+    def __init__(self, moving_rate=0.9, bit_length=8, dtype="float32", name=None):
+        self.kwargs = dict(moving_rate=moving_rate, bit_length=bit_length, dtype=dtype)
+
+    def _instance(self, layer=None):
+        return FakeQuanterWithAbsMaxObserverLayer(layer, **self.kwargs)
