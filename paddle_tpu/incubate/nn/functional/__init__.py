@@ -129,8 +129,16 @@ def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5, begin_norm_axis=-1
 
     ndim = len(x.shape)
     begin = begin_norm_axis % ndim
-    normalized_shape = [int(d) for d in x.shape[begin:]]
-    return _layer_norm(x, normalized_shape, norm_weight, norm_bias, epsilon)
+    if begin == ndim - 1:
+        return _layer_norm(x, int(x.shape[-1]), norm_weight, norm_bias, epsilon)
+    # multi-axis case: reference stores weight/bias flat over prod(trailing
+    # dims) — flatten, normalize, restore
+    shape = [int(d) for d in x.shape]
+    lead, prod = shape[:begin], 1
+    for d in shape[begin:]:
+        prod *= d
+    out = _layer_norm(x.reshape(lead + [prod]), prod, norm_weight, norm_bias, epsilon)
+    return out.reshape(shape)
 
 
 # ---------------------------------------------------------------------------

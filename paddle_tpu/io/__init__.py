@@ -469,11 +469,10 @@ class DataLoader:
             depth = self.prefetch * max(self.num_workers, 1)
             try:  # incubate.autotune dataloader tuning: deepen prefetch
                 from ..incubate.autotune import get_config
-
-                if get_config()["dataloader"].get("enable"):
-                    depth = max(depth, 2 * self.prefetch * max(self.num_workers, 1), 8)
-            except Exception:
-                pass
+            except ImportError:
+                get_config = None
+            if get_config is not None and get_config()["dataloader"].get("enable"):
+                depth = max(2 * depth, 8)
             if self.use_shared_memory:
                 from ..native import NativeUnavailable
 

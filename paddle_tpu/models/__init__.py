@@ -8,3 +8,5 @@ from .ernie import (  # noqa: F401
     ernie_tiny,
 )
 from .llama import LlamaForCausalLM, LlamaModel, llama_tiny  # noqa: F401
+from .ocr import CRNN, DBNet, OCRSystem, ctc_greedy_decode, db_loss, db_postprocess  # noqa: F401
+from .detection import PPYOLOE, ppyoloe_loss  # noqa: F401

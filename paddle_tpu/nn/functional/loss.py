@@ -325,9 +325,10 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0, reduction
             new = m_safe + jnp.log(s)
             new = jnp.where(m == NEG, NEG, new)
             emit = jnp.take_along_axis(lp_t, ext, axis=1)
-            return new + emit, None
+            out = new + emit
+            return out, out  # carry AND stack: per-step alphas are gathered below
 
-        alphas, _ = jax.lax.scan(lambda a, x: step(a, x), alpha0, lpv[1:])
+        _, alphas = jax.lax.scan(step, alpha0, lpv[1:])
         # gather alpha at t = input_length-1 for each n
         all_alpha = jnp.concatenate([alpha0[None], alphas], axis=0)
         t_idx = (ilenv - 1).astype(jnp.int32)

@@ -8,15 +8,12 @@ from __future__ import annotations
 
 import copy
 
-from ..core.tensor import Tensor
 from ..nn.layer import Layer
-from ..nn.layers.common import Linear
-from ..nn.layers.conv import Conv2D
-from .qat import _materialize_layer_configs, _walk_and_replace
+from .qat import _QAT_WRAPPERS, _materialize_layer_configs, _walk_and_replace
 from .quanted_layers import QuantedConv2D, QuantedLinear
 from .quanters import fake_quant
 
-_PTQ_WRAPPERS = {Linear: QuantedLinear, Conv2D: QuantedConv2D}
+_PTQ_WRAPPERS = _QAT_WRAPPERS  # same wrapper table; one registration point
 
 
 class PTQ:
@@ -51,6 +48,14 @@ class PTQ:
                 wq = layer.weight_quanter
                 if wq is not None:
                     scale = wq.scales()
+                    if float(scale.numpy()) <= 1e-8:
+                        import warnings
+
+                        warnings.warn(
+                            f"PTQ.convert: observer for {qualified!r} was never calibrated "
+                            "(scale ~ 0); run calibration batches before convert. Skipping."
+                        )
+                        return inner
                     bits = wq.bit_length() if hasattr(wq, "bit_length") else 8
                     inner.weight._replace_value(fake_quant(inner.weight, scale, bits)._value)
                 return inner

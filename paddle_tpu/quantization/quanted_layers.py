@@ -56,4 +56,5 @@ class QuantedConv2D(Layer):
             padding=self._inner._padding,
             dilation=self._inner._dilation,
             groups=self._inner._groups,
+            data_format=self._inner._data_format,
         )

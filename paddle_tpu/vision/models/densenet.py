@@ -1,0 +1,105 @@
+"""DenseNet (reference: python/paddle/vision/models/densenet.py)."""
+from __future__ import annotations
+
+from ... import nn
+
+
+class DenseLayer(nn.Layer):
+    def __init__(self, c_in, growth_rate, bn_size, drop_rate=0.0):
+        super().__init__()
+        self.norm1 = nn.BatchNorm2D(c_in)
+        self.relu = nn.ReLU()
+        self.conv1 = nn.Conv2D(c_in, bn_size * growth_rate, 1, bias_attr=False)
+        self.norm2 = nn.BatchNorm2D(bn_size * growth_rate)
+        self.conv2 = nn.Conv2D(bn_size * growth_rate, growth_rate, 3, padding=1, bias_attr=False)
+        self.drop_rate = drop_rate
+
+    def forward(self, x):
+        from ... import concat
+
+        out = self.conv1(self.relu(self.norm1(x)))
+        out = self.conv2(self.relu(self.norm2(out)))
+        if self.drop_rate:
+            out = nn.functional.dropout(out, p=self.drop_rate, training=self.training)
+        return concat([x, out], axis=1)
+
+
+class Transition(nn.Layer):
+    def __init__(self, c_in, c_out):
+        super().__init__()
+        self.norm = nn.BatchNorm2D(c_in)
+        self.relu = nn.ReLU()
+        self.conv = nn.Conv2D(c_in, c_out, 1, bias_attr=False)
+        self.pool = nn.AvgPool2D(2, stride=2)
+
+    def forward(self, x):
+        return self.pool(self.conv(self.relu(self.norm(x))))
+
+
+_CFG = {
+    121: (6, 12, 24, 16),
+    161: (6, 12, 36, 24),
+    169: (6, 12, 32, 32),
+    201: (6, 12, 48, 32),
+    264: (6, 12, 64, 48),
+}
+
+
+class DenseNet(nn.Layer):
+    def __init__(self, layers=121, bn_size=4, dropout=0.0, num_classes=1000, with_pool=True, growth_rate=None):
+        super().__init__()
+        block_config = _CFG[layers]
+        growth = growth_rate or (48 if layers == 161 else 32)
+        init_c = 2 * growth
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, init_c, 7, stride=2, padding=3, bias_attr=False),
+            nn.BatchNorm2D(init_c),
+            nn.ReLU(),
+            nn.MaxPool2D(3, stride=2, padding=1),
+        )
+        blocks = []
+        c = init_c
+        for i, n in enumerate(block_config):
+            for _ in range(n):
+                blocks.append(DenseLayer(c, growth, bn_size, dropout))
+                c += growth
+            if i != len(block_config) - 1:
+                blocks.append(Transition(c, c // 2))
+                c //= 2
+        self.blocks = nn.Sequential(*blocks)
+        self.norm_final = nn.BatchNorm2D(c)
+        self.relu = nn.ReLU()
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(c, num_classes)
+
+    def forward(self, x):
+        x = self.relu(self.norm_final(self.blocks(self.stem(x))))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.flatten(1))
+        return x
+
+
+def densenet121(pretrained=False, **kwargs):
+    return DenseNet(121, **kwargs)
+
+
+def densenet161(pretrained=False, **kwargs):
+    return DenseNet(161, **kwargs)
+
+
+def densenet169(pretrained=False, **kwargs):
+    return DenseNet(169, **kwargs)
+
+
+def densenet201(pretrained=False, **kwargs):
+    return DenseNet(201, **kwargs)
+
+
+def densenet264(pretrained=False, **kwargs):
+    return DenseNet(264, **kwargs)

@@ -1,0 +1,119 @@
+"""ShuffleNetV2 (reference: python/paddle/vision/models/shufflenetv2.py)."""
+from __future__ import annotations
+
+from ... import nn
+
+
+def channel_shuffle(x, groups):
+    from ... import reshape, transpose
+
+    b, c, h, w = x.shape
+    x = reshape(x, [b, groups, c // groups, h, w])
+    x = transpose(x, [0, 2, 1, 3, 4])
+    return reshape(x, [b, c, h, w])
+
+
+def _conv_bn(c_in, c_out, k, stride=1, padding=0, groups=1, act=True):
+    layers = [
+        nn.Conv2D(c_in, c_out, k, stride=stride, padding=padding, groups=groups, bias_attr=False),
+        nn.BatchNorm2D(c_out),
+    ]
+    if act:
+        layers.append(nn.ReLU())
+    return nn.Sequential(*layers)
+
+
+class InvertedResidual(nn.Layer):
+    def __init__(self, c_in, c_out, stride):
+        super().__init__()
+        self.stride = stride
+        branch = c_out // 2
+        if stride == 1:
+            self.branch2 = nn.Sequential(
+                _conv_bn(c_in // 2, branch, 1),
+                _conv_bn(branch, branch, 3, stride, 1, groups=branch, act=False),
+                _conv_bn(branch, branch, 1),
+            )
+        else:
+            self.branch1 = nn.Sequential(
+                _conv_bn(c_in, c_in, 3, stride, 1, groups=c_in, act=False),
+                _conv_bn(c_in, branch, 1),
+            )
+            self.branch2 = nn.Sequential(
+                _conv_bn(c_in, branch, 1),
+                _conv_bn(branch, branch, 3, stride, 1, groups=branch, act=False),
+                _conv_bn(branch, branch, 1),
+            )
+
+    def forward(self, x):
+        from ... import concat, split
+
+        if self.stride == 1:
+            x1, x2 = split(x, 2, axis=1)
+            out = concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = concat([self.branch1(x), self.branch2(x)], axis=1)
+        return channel_shuffle(out, 2)
+
+
+_STAGE_OUT = {
+    0.25: [24, 24, 48, 96, 512],
+    0.5: [24, 48, 96, 192, 1024],
+    1.0: [24, 116, 232, 464, 1024],
+    1.5: [24, 176, 352, 704, 1024],
+    2.0: [24, 244, 488, 976, 2048],
+}
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000, with_pool=True):
+        super().__init__()
+        stage_repeats = [4, 8, 4]
+        stage_out = _STAGE_OUT[scale]
+        self.conv1 = _conv_bn(3, stage_out[0], 3, 2, 1)
+        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1)
+        blocks = []
+        c_in = stage_out[0]
+        for stage_i, repeats in enumerate(stage_repeats):
+            c_out = stage_out[stage_i + 1]
+            for i in range(repeats):
+                blocks.append(InvertedResidual(c_in, c_out, stride=2 if i == 0 else 1))
+                c_in = c_out
+        self.blocks = nn.Sequential(*blocks)
+        self.conv5 = _conv_bn(c_in, stage_out[-1], 1)
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(stage_out[-1], num_classes)
+
+    def forward(self, x):
+        x = self.maxpool(self.conv1(x))
+        x = self.blocks(x)
+        x = self.conv5(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.flatten(1))
+        return x
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=0.25, **kwargs)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=0.5, **kwargs)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=1.0, **kwargs)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=1.5, **kwargs)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=2.0, **kwargs)

@@ -1,0 +1,162 @@
+"""Model zoo: classification additions, DBNet+CRNN OCR, PP-YOLOE detection."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import CRNN, DBNet, OCRSystem, PPYOLOE, ctc_greedy_decode, db_loss, ppyoloe_loss
+from paddle_tpu.vision import models as zoo
+
+
+@pytest.mark.parametrize(
+    "ctor,size",
+    [
+        (lambda: zoo.googlenet(num_classes=10), 64),
+        (lambda: zoo.shufflenet_v2_x0_5(num_classes=10), 64),
+        (lambda: zoo.densenet121(num_classes=10), 64),
+        (lambda: zoo.squeezenet1_1(num_classes=10), 64),
+    ],
+)
+def test_classification_forward(ctor, size):
+    net = ctor()
+    net.eval()
+    x = paddle.to_tensor(np.random.RandomState(0).randn(2, 3, size, size).astype("float32"))
+    out = net(x)
+    assert tuple(out.shape) == (2, 10)
+
+
+def test_googlenet_aux_heads_in_train():
+    net = zoo.googlenet(num_classes=5)
+    net.train()
+    x = paddle.to_tensor(np.random.RandomState(0).randn(1, 3, 64, 64).astype("float32"))
+    out, aux1, aux2 = net(x)
+    assert tuple(out.shape) == tuple(aux1.shape) == tuple(aux2.shape) == (1, 5)
+
+
+def test_dbnet_forward_and_loss():
+    net = DBNet(base_channels=8, neck_channels=32)
+    net.train()
+    x = paddle.to_tensor(np.random.RandomState(0).randn(1, 3, 64, 64).astype("float32"))
+    out = net(x)
+    assert tuple(out.shape) == (1, 3, 64, 64)
+    gt_prob = paddle.to_tensor((np.random.RandomState(1).rand(1, 1, 64, 64) > 0.8).astype("float32"))
+    gt_thresh = paddle.to_tensor(np.full((1, 1, 64, 64), 0.3, "float32"))
+    loss = db_loss(out, gt_prob, gt_thresh)
+    assert np.isfinite(float(loss.numpy()))
+    loss.backward()
+    grads = [p.grad for p in net.parameters() if p.grad is not None]
+    assert grads
+    # eval: prob map only
+    net.eval()
+    assert tuple(net(x).shape) == (1, 1, 64, 64)
+
+
+def test_db_postprocess_finds_blob():
+    pm = np.zeros((1, 1, 32, 32), "float32")
+    pm[0, 0, 8:16, 10:20] = 0.9
+    boxes = __import__("paddle_tpu.models.ocr", fromlist=["db_postprocess"]).db_postprocess(pm)
+    assert len(boxes) == 1 and boxes[0].shape[0] == 1
+    x1, y1, x2, y2, score = boxes[0][0]
+    assert (x1, y1, x2, y2) == (10, 8, 20, 16) and score > 0.8
+
+
+def test_crnn_shapes_and_ctc_training():
+    rec = CRNN(num_classes=11, hidden_size=32)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(2, 3, 32, 64).astype("float32"))
+    logits = rec(x)
+    t = logits.shape[1]
+    assert logits.shape[0] == 2 and logits.shape[2] == 11 and t >= 8
+    # one CTC training step
+    import paddle_tpu.nn.functional as F
+
+    labels = paddle.to_tensor(np.random.RandomState(1).randint(1, 11, (2, 5)).astype("int64"))
+    log_probs = F.log_softmax(logits.transpose([1, 0, 2]), axis=-1)  # [T,B,C]
+    loss = F.ctc_loss(
+        log_probs,
+        labels,
+        paddle.to_tensor(np.array([t, t], "int64")),
+        paddle.to_tensor(np.array([5, 5], "int64")),
+    )
+    assert np.isfinite(float(loss.numpy()))
+    loss.backward()
+    assert rec.fc.weight.grad is not None
+
+
+def test_ctc_greedy_decode():
+    logits = np.zeros((1, 6, 4), "float32")
+    # blank a a blank b b -> [a, b]
+    for i, c in enumerate([0, 1, 1, 0, 2, 2]):
+        logits[0, i, c] = 5.0
+    assert ctc_greedy_decode(logits) == [[1, 2]]
+
+
+def test_ppyoloe_forward_decode_infer():
+    det = PPYOLOE(num_classes=4, base_channels=8, neck_channels=32)
+    det.eval()
+    x = paddle.to_tensor(np.random.RandomState(0).randn(1, 3, 64, 64).astype("float32"))
+    outs = det(x)
+    assert len(outs) == 3
+    hw = [(8, 8), (4, 4), (2, 2)]
+    for (cls, reg), (h, w) in zip(outs, hw):
+        assert tuple(cls.shape) == (1, 4, h, w) and tuple(reg.shape) == (1, 4, h, w)
+    boxes, scores = det.decode(outs)
+    n = 8 * 8 + 4 * 4 + 2 * 2
+    assert tuple(boxes.shape) == (1, n, 4) and tuple(scores.shape) == (1, n, 4)
+    bb = boxes.numpy()
+    assert (bb[..., 2] >= bb[..., 0]).all() and (bb[..., 3] >= bb[..., 1]).all()
+    res = det.infer(x, score_thresh=0.0, top_k=5)
+    assert len(res) == 1 and res[0].shape[1] == 6 and res[0].shape[0] <= 5 * 4
+
+
+def test_ppyoloe_train_step():
+    det = PPYOLOE(num_classes=3, base_channels=8, neck_channels=32)
+    det.train()
+    x = paddle.to_tensor(np.random.RandomState(0).randn(1, 3, 64, 64).astype("float32"))
+    outs = det(x)
+    rng = np.random.RandomState(1)
+    targets = []
+    for (cls, reg) in outs:
+        shape = tuple(cls.shape)
+        mask = (rng.rand(shape[0], 1, shape[2], shape[3]) > 0.7).astype("float32")
+        targets.append(
+            {
+                "cls": paddle.to_tensor((rng.rand(*shape) > 0.9).astype("float32")),
+                "box": paddle.to_tensor(rng.rand(shape[0], 4, shape[2], shape[3]).astype("float32")),
+                "mask": paddle.to_tensor(mask),
+            }
+        )
+    loss = ppyoloe_loss(outs, targets, 3)
+    assert np.isfinite(float(loss.numpy()))
+    loss.backward()
+    assert any(p.grad is not None for p in det.parameters())
+
+
+def test_ocr_system_end_to_end():
+    sys_model = OCRSystem(DBNet(base_channels=8, neck_channels=32), CRNN(num_classes=11, hidden_size=32))
+    x = paddle.to_tensor(np.random.RandomState(0).rand(1, 3, 64, 64).astype("float32"))
+    results = sys_model(x)
+    assert isinstance(results, list) and len(results) == 1
+
+
+def test_ctc_loss_matches_torch():
+    torch = pytest.importorskip("torch")
+    import paddle_tpu.nn.functional as F
+
+    T, N, C, S = 12, 3, 7, 4
+    rng = np.random.RandomState(0)
+    logits = rng.randn(T, N, C).astype("float32")
+    labels = rng.randint(1, C, (N, S)).astype("int64")
+    il = np.full(N, T, "int64")
+    ll = np.full(N, S, "int64")
+    ours = float(
+        F.ctc_loss(
+            paddle.to_tensor(logits), paddle.to_tensor(labels),
+            paddle.to_tensor(il), paddle.to_tensor(ll),
+        ).numpy()
+    )
+    want = float(
+        torch.nn.functional.ctc_loss(
+            torch.log_softmax(torch.tensor(logits), -1), torch.tensor(labels),
+            torch.tensor(il), torch.tensor(ll), blank=0, reduction="mean",
+        )
+    )
+    assert abs(ours - want) < 1e-3
