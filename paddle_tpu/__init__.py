@@ -152,6 +152,10 @@ from . import hapi  # noqa: E402,F401
 from . import profiler  # noqa: E402,F401
 from . import distribution  # noqa: E402,F401
 from . import quantization  # noqa: E402,F401
+from . import audio  # noqa: E402,F401
+from . import text  # noqa: E402,F401
+from . import device  # noqa: E402,F401
+from . import onnx  # noqa: E402,F401
 from . import sparse  # noqa: E402,F401
 from . import fft  # noqa: E402,F401
 from . import signal  # noqa: E402,F401

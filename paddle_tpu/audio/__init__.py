@@ -1,0 +1,4 @@
+"""paddle.audio namespace (reference: python/paddle/audio/)."""
+from . import datasets, features, functional  # noqa: F401
+
+__all__ = ["features", "functional", "datasets"]

@@ -1,0 +1,52 @@
+"""Audio datasets (reference: python/paddle/audio/datasets/ — TESS, ESC50).
+
+No network egress in this image: synthetic waveform datasets with the real
+datasets' shapes/label spaces (sine mixtures keyed by label so features are
+learnable), same pattern as vision.datasets.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..io import Dataset
+
+
+class _SyntheticAudioDataset(Dataset):
+    SAMPLE_RATE = 16000
+    DURATION = 1.0  # seconds
+    NUM_CLASSES = 10
+    TRAIN_N = 128
+    TEST_N = 32
+
+    def __init__(self, mode="train", feat_type="raw", seed=0, **kwargs):
+        assert mode in ("train", "dev", "test")
+        self.mode = mode
+        self.feat_type = feat_type
+        n = self.TRAIN_N if mode == "train" else self.TEST_N
+        rng = np.random.RandomState(seed + (0 if mode == "train" else 1))
+        length = int(self.SAMPLE_RATE * self.DURATION)
+        t = np.arange(length) / self.SAMPLE_RATE
+        self.labels = rng.randint(0, self.NUM_CLASSES, n).astype(np.int64)
+        waves = []
+        for lbl in self.labels:
+            # linear pitch grid: unique per class and well below Nyquist
+            freq = 200.0 + float(lbl) * (6000.0 / max(self.NUM_CLASSES, 1))
+            wave = np.sin(2 * np.pi * freq * t) + 0.1 * rng.randn(length)
+            waves.append(wave.astype(np.float32))
+        self.waves = np.stack(waves)
+
+    def __getitem__(self, idx):
+        return self.waves[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.waves)
+
+
+class ESC50(_SyntheticAudioDataset):
+    SAMPLE_RATE = 16000
+    NUM_CLASSES = 50
+
+
+class TESS(_SyntheticAudioDataset):
+    SAMPLE_RATE = 16000
+    NUM_CLASSES = 7

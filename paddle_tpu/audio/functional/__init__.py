@@ -1,0 +1,112 @@
+"""Audio functional ops.
+
+Reference parity: python/paddle/audio/functional/ — window functions,
+mel filterbank construction, dct matrix, power_to_db. Pure jnp, matching
+librosa conventions like the reference (slaney mel by default off; HTK
+formula when htk=True).
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+
+
+def _wrap(v):
+    return Tensor(v)
+
+
+def hz_to_mel(freq, htk=False):
+    f = freq.numpy() if isinstance(freq, Tensor) else freq
+    f = jnp.asarray(f, jnp.float32)
+    if htk:
+        out = 2595.0 * jnp.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        mels = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        out = jnp.where(f >= min_log_hz, min_log_mel + jnp.log(jnp.maximum(f, 1e-10) / min_log_hz) / logstep, mels)
+    return _wrap(out) if isinstance(freq, Tensor) else out
+
+
+def mel_to_hz(mel, htk=False):
+    m = mel.numpy() if isinstance(mel, Tensor) else mel
+    m = jnp.asarray(m, jnp.float32)
+    if htk:
+        out = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        freqs = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        out = jnp.where(m >= min_log_mel, min_log_hz * jnp.exp(logstep * (m - min_log_mel)), freqs)
+    return _wrap(out) if isinstance(mel, Tensor) else out
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False, dtype="float32"):
+    low = hz_to_mel(f_min, htk)
+    high = hz_to_mel(f_max, htk)
+    mels = jnp.linspace(low, high, n_mels)
+    return _wrap(mel_to_hz(mels, htk).astype(dtype))
+
+
+def fft_frequencies(sr, n_fft, dtype="float32"):
+    return _wrap(jnp.linspace(0, sr / 2, n_fft // 2 + 1).astype(dtype))
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None, htk=False, norm="slaney", dtype="float32"):
+    """[n_mels, n_fft//2+1] triangular mel filterbank."""
+    f_max = f_max or sr / 2.0
+    fftfreqs = fft_frequencies(sr, n_fft)._value  # same grid the stft uses
+    mel_f = mel_frequencies(n_mels + 2, f_min, f_max, htk)._value
+    fdiff = jnp.diff(mel_f)
+    ramps = mel_f[:, None] - fftfreqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = jnp.maximum(0.0, jnp.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2 : n_mels + 2] - mel_f[:n_mels])
+        weights = weights * enorm[:, None]
+    return _wrap(weights.astype(dtype))
+
+
+def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0):
+    s = spect._value if isinstance(spect, Tensor) else jnp.asarray(spect)
+    log_spec = 10.0 * jnp.log10(jnp.maximum(amin, s))
+    log_spec = log_spec - 10.0 * jnp.log10(jnp.maximum(amin, ref_value))
+    if top_db is not None:
+        log_spec = jnp.maximum(log_spec, jnp.max(log_spec) - top_db)
+    return _wrap(log_spec) if isinstance(spect, Tensor) else log_spec
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
+    """[n_mels, n_mfcc] DCT-II basis (torchaudio/paddle layout)."""
+    n = jnp.arange(n_mels, dtype=jnp.float32)
+    k = jnp.arange(n_mfcc, dtype=jnp.float32)
+    dct = jnp.cos(math.pi / n_mels * (n[:, None] + 0.5) * k[None, :]) * 2.0
+    if norm == "ortho":
+        dct = dct.at[:, 0].multiply(1.0 / math.sqrt(2.0))
+        dct = dct * math.sqrt(1.0 / (2.0 * n_mels))
+    return _wrap(dct.astype(dtype))
+
+
+def get_window(window, win_length, fftbins=True, dtype="float32"):
+    n = win_length
+    i = jnp.arange(n, dtype=jnp.float32)
+    denom = n if fftbins else n - 1
+    if window in ("hann", "hanning"):
+        w = 0.5 - 0.5 * jnp.cos(2 * math.pi * i / denom)
+    elif window == "hamming":
+        w = 0.54 - 0.46 * jnp.cos(2 * math.pi * i / denom)
+    elif window == "blackman":
+        w = 0.42 - 0.5 * jnp.cos(2 * math.pi * i / denom) + 0.08 * jnp.cos(4 * math.pi * i / denom)
+    elif window in ("rect", "boxcar", "ones"):
+        w = jnp.ones(n)
+    else:
+        raise ValueError(f"unsupported window {window!r}")
+    return _wrap(w.astype(dtype))

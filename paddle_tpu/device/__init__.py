@@ -1,0 +1,103 @@
+"""paddle.device namespace.
+
+Reference parity: python/paddle/device/ — set_device/get_device plus the
+paddle.device.cuda stream/event surface. TPU-native: streams collapse to
+XLA's async dispatch queue — Stream/Event are ordering no-ops that preserve
+the API (synchronize() blocks on all pending device work, the one operation
+with real semantics here).
+"""
+from __future__ import annotations
+
+import jax
+
+from ..framework.device import (  # noqa: F401
+    CPUPlace,
+    Place,
+    TPUPlace,
+    device_count,
+    get_device,
+    is_compiled_with_tpu,
+    set_device,
+)
+from . import cuda  # noqa: F401
+
+
+def get_all_device_type():
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_all_custom_device_type():
+    return []
+
+
+def get_available_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device():
+    return []
+
+
+def synchronize(device=None):
+    """Block until all dispatched device work completes."""
+    (jax.device_put(0) + 0).block_until_ready()
+
+
+class Stream:
+    """API-compat stream: XLA orders device work; record/wait are no-ops."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        synchronize(self.device)
+
+    def wait_event(self, event):
+        return None
+
+    def wait_stream(self, stream):
+        return None
+
+    def record_event(self, event=None):
+        return event or Event()
+
+
+class Event:
+    def __init__(self, enable_timing=False, blocking=False, interprocess=False):
+        self._recorded = False
+
+    def record(self, stream=None):
+        self._recorded = True
+
+    def query(self):
+        return True
+
+    def synchronize(self):
+        synchronize()
+
+
+_current_stream = Stream()
+
+
+def current_stream(device=None):
+    return _current_stream
+
+
+def set_stream(stream):
+    global _current_stream
+    prev = _current_stream
+    _current_stream = stream
+    return prev
+
+
+class stream_guard:
+    def __init__(self, stream):
+        self.stream = stream
+
+    def __enter__(self):
+        self._prev = set_stream(self.stream)
+        return self.stream
+
+    def __exit__(self, *exc):
+        set_stream(self._prev)
+        return False

@@ -1,0 +1,88 @@
+"""paddle.device.cuda compat surface (reference: python/paddle/device/cuda/).
+
+This framework targets TPU; these functions answer honestly about the
+accelerator jax sees (paddle code probing "cuda" keeps working), and the
+stream/event API maps to the no-op Stream/Event in paddle_tpu.device.
+"""
+from __future__ import annotations
+
+import jax
+
+
+def device_count():
+    try:
+        return len([d for d in jax.devices() if d.platform != "cpu"])
+    except Exception:
+        return 0
+
+
+def current_device_id():
+    return 0
+
+
+def get_device_name(device_id=0):
+    devs = jax.devices()
+    return devs[min(device_id, len(devs) - 1)].device_kind
+
+
+def get_device_capability(device_id=0):
+    return (0, 0)  # CUDA compute capability has no TPU analog
+
+
+def get_device_properties(device=None):
+    class _Props:
+        def __init__(self, d):
+            self.name = d.device_kind
+            self.major, self.minor = 0, 0
+            self.total_memory = getattr(d, "memory_stats", lambda: {})().get("bytes_limit", 0)
+            self.multi_processor_count = 0
+
+    devs = jax.devices()
+    return _Props(devs[0])
+
+
+def max_memory_allocated(device=None):
+    stats = _stats(device)
+    return stats.get("peak_bytes_in_use", 0)
+
+
+def max_memory_reserved(device=None):
+    return max_memory_allocated(device)
+
+
+def memory_allocated(device=None):
+    return _stats(device).get("bytes_in_use", 0)
+
+
+def memory_reserved(device=None):
+    return memory_allocated(device)
+
+
+def _stats(device):
+    try:
+        d = jax.devices()[0]
+        return d.memory_stats() or {}
+    except Exception:
+        return {}
+
+
+def empty_cache():
+    return None
+
+
+def synchronize(device=None):
+    from . import synchronize as _sync
+
+    return _sync(device)
+
+
+def stream_guard(stream):
+    from . import stream_guard as _sg
+
+    return _sg(stream)
+
+
+def current_stream(device=None):
+    from . import current_stream as _cs
+
+    return _cs(device)

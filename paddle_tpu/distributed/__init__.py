@@ -69,6 +69,8 @@ from .auto_parallel import (  # noqa: F401
     unshard_dtensor,
 )
 from . import checkpoint  # noqa: F401,E402
+from . import auto_tuner  # noqa: F401,E402
+from . import rpc  # noqa: F401,E402
 
 
 def __getattr__(name):

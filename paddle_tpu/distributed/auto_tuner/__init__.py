@@ -1,0 +1,4 @@
+"""paddle.distributed.auto_tuner (reference: python/paddle/distributed/auto_tuner/)."""
+from .prune import prune_configs  # noqa: F401
+from .search import GridSearch, search_space  # noqa: F401
+from .tuner import AutoTuner  # noqa: F401

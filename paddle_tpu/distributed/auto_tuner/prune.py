@@ -1,0 +1,53 @@
+"""Candidate pruning rules.
+
+Reference parity: python/paddle/distributed/auto_tuner/prune.py — cut
+configs that cannot fit or cannot be fast before paying for a trial run.
+TPU-native additions: mp should divide attention heads AND stay inside one
+ICI domain (<= chips per host*slice axis); memory model counts params,
+grads, optimizer moments with the sharding-stage discounts.
+"""
+from __future__ import annotations
+
+
+def estimate_memory_per_chip_gb(
+    config,
+    num_params_b,
+    bytes_per_param=2.0,  # bf16 master-in-optimizer layout
+    optimizer_bytes_per_param=8.0,  # adam m+v in f32
+    grad_bytes_per_param=2.0,
+    activation_gb_per_microbatch=1.0,
+):
+    """Coarse HBM model: params/mp/pp (+stage-3 dp discount), grads
+    (stage>=2 discount), optimizer states (stage>=1 discount), activations
+    scaled by pp microbatching."""
+    dp, mp, pp, st = config["dp"], config["mp"], config["pp"], config["sharding_stage"]
+    shard = dp if st >= 1 else 1
+    p = num_params_b * 1e9 / (mp * pp)
+    param_gb = p * bytes_per_param / (dp if st >= 3 else 1) / 1e9
+    grad_gb = p * grad_bytes_per_param / (dp if st >= 2 else 1) / 1e9
+    opt_gb = p * optimizer_bytes_per_param / shard / 1e9
+    act_gb = activation_gb_per_microbatch * config.get("micro_batch", 1)
+    return param_gb + grad_gb + opt_gb + act_gb
+
+
+def prune_configs(
+    configs,
+    hbm_gb=95.0,
+    num_params_b=1.0,
+    num_heads=None,
+    ici_mp_limit=None,
+    activation_gb_per_microbatch=1.0,
+):
+    out = []
+    for c in configs:
+        if num_heads is not None and num_heads % c["mp"]:
+            continue  # mp must divide attention heads
+        if ici_mp_limit is not None and c["mp"] > ici_mp_limit:
+            continue  # keep tensor parallel inside the fast ICI domain
+        mem = estimate_memory_per_chip_gb(
+            c, num_params_b, activation_gb_per_microbatch=activation_gb_per_microbatch
+        )
+        if mem > hbm_gb:
+            continue
+        out.append(c)
+    return out

@@ -1,0 +1,71 @@
+"""Search space enumeration for hybrid-parallel configs.
+
+Reference parity: python/paddle/distributed/auto_tuner/search.py — enumerate
+(dp, mp, pp, sharding stage, micro batch) candidates for a given world size.
+"""
+from __future__ import annotations
+
+import itertools
+
+
+def _divisors(n):
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def search_space(
+    world_size,
+    global_batch_size=None,
+    num_layers=None,
+    max_mp=8,
+    max_pp=8,
+    sharding_stages=(0, 1, 2, 3),
+):
+    """All (dp, mp, pp, sharding_stage, micro_batch) tuples with
+    dp*mp*pp == world_size and micro_batch | (global_batch/dp)."""
+    out = []
+    for mp, pp in itertools.product(_divisors(world_size), repeat=2):
+        if mp > max_mp or pp > max_pp:
+            continue
+        if num_layers is not None and pp > 1 and num_layers % pp:
+            continue
+        if world_size % (mp * pp):
+            continue
+        dp = world_size // (mp * pp)
+        if global_batch_size is not None:
+            if global_batch_size % dp:
+                continue
+            local = global_batch_size // dp
+            micro_batches = _divisors(local)
+        else:
+            micro_batches = [1]
+        for st, mb in itertools.product(sharding_stages, micro_batches):
+            if st > 0 and dp == 1:
+                continue  # sharding needs a dp group
+            out.append({"dp": dp, "mp": mp, "pp": pp, "sharding_stage": st, "micro_batch": mb})
+    return out
+
+
+class GridSearch:
+    """Iterate candidates; caller reports back (config, metric)."""
+
+    def __init__(self, configs):
+        self.configs = list(configs)
+        self.results = []
+        self._i = 0
+
+    def has_next(self):
+        return self._i < len(self.configs)
+
+    def next_config(self):
+        cfg = self.configs[self._i]
+        self._i += 1
+        return cfg
+
+    def report(self, config, metric, error=None):
+        self.results.append({"config": config, "metric": metric, "error": error})
+
+    def best(self, maximize=True):
+        ok = [r for r in self.results if r["error"] is None and r["metric"] is not None]
+        if not ok:
+            return None
+        return (max if maximize else min)(ok, key=lambda r: r["metric"])

@@ -1,0 +1,61 @@
+"""Tuner loop.
+
+Reference parity: python/paddle/distributed/auto_tuner/tuner.py — iterate
+pruned configs, launch a measured trial per config, track the best. Here the
+trial runner is injected (a callable config -> metric), so tests and users
+can measure real step time (e.g. via Profiler/timer) or a cost model without
+the reference's subprocess relaunch machinery; launching via
+paddle_tpu.distributed.launch is one such runner.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+from .prune import prune_configs
+from .search import GridSearch, search_space
+
+
+class AutoTuner:
+    def __init__(
+        self,
+        world_size,
+        runner,
+        global_batch_size=None,
+        num_layers=None,
+        num_heads=None,
+        num_params_b=1.0,
+        hbm_gb=95.0,
+        maximize=True,
+        max_trials=None,
+        log_path=None,
+    ):
+        self.runner = runner
+        self.maximize = maximize
+        self.max_trials = max_trials
+        self.log_path = log_path
+        cands = search_space(world_size, global_batch_size, num_layers)
+        cands = prune_configs(cands, hbm_gb=hbm_gb, num_params_b=num_params_b, num_heads=num_heads)
+        self.search = GridSearch(cands)
+
+    def tune(self):
+        trials = 0
+        while self.search.has_next():
+            if self.max_trials is not None and trials >= self.max_trials:
+                break
+            cfg = self.search.next_config()
+            t0 = time.time()
+            try:
+                metric = self.runner(cfg)
+                err = None
+            except Exception as e:  # a failing config is data, not fatal
+                metric, err = None, f"{type(e).__name__}: {e}"
+            self.search.report(cfg, metric, err)
+            trials += 1
+            if self.log_path:
+                with open(self.log_path, "a") as f:
+                    f.write(
+                        json.dumps({"config": cfg, "metric": metric, "error": err, "sec": time.time() - t0})
+                        + "\n"
+                    )
+        return self.search.best(self.maximize)

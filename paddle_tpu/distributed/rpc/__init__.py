@@ -1,0 +1,160 @@
+"""paddle.distributed.rpc — minimal P2P RPC.
+
+Reference parity: python/paddle/distributed/rpc/rpc.py (brpc-based
+init_rpc/rpc_sync/rpc_async/shutdown with WorkerInfo). TPU-native transport:
+the native TCPStore (paddle_tpu/native) is the registry + mailbox — workers
+poll their inbox key; payloads are pickled callables. This is the control
+plane only (the reference uses it the same way); tensors move via
+collectives, not RPC.
+"""
+from __future__ import annotations
+
+import pickle
+import threading
+import time
+import uuid
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Optional
+
+from ...native.store import TCPStore
+
+_state = {}
+
+
+class WorkerInfo:
+    def __init__(self, name, rank, ip=None, port=None):
+        self.name = name
+        self.rank = rank
+        self.ip = ip
+        self.port = port
+
+    def __repr__(self):
+        return f"WorkerInfo(name={self.name}, rank={self.rank})"
+
+
+def init_rpc(name, rank=None, world_size=None, master_endpoint="127.0.0.1:0"):
+    host, port = master_endpoint.rsplit(":", 1)
+    rank = rank or 0
+    world_size = world_size or 1
+    is_master = rank == 0
+    store = TCPStore(host, int(port), is_master=is_master, world_size=world_size)
+    _state.update(
+        store=store,
+        name=name,
+        rank=rank,
+        world_size=world_size,
+        running=True,
+        serve_thread=None,
+        # bounded waiter pool: each thread holds one store connection, so an
+        # unbounded thread-per-call design would leak sockets with call count
+        waiters=ThreadPoolExecutor(max_workers=4, thread_name_prefix="rpc-wait"),
+    )
+    store.set(f"rpc/worker/{rank}", name)
+    # wait for all workers to register
+    if world_size:
+        for r in range(world_size):
+            store.wait(f"rpc/worker/{r}", timeout=60)
+    t = threading.Thread(target=_serve_loop, daemon=True)
+    _state["serve_thread"] = t
+    t.start()
+
+
+def _inbox_key(rank, i):
+    return f"rpc/inbox/{rank}/{i}"
+
+
+def _serve_loop():
+    store: TCPStore = _state["store"]
+    rank = _state["rank"]
+    served = 0
+    while _state["running"]:
+        key = _inbox_key(rank, served)
+        try:
+            store.wait(key, timeout=0.3)
+        except TimeoutError:
+            continue
+        try:
+            req = pickle.loads(store.get(key))
+        except KeyError:
+            continue
+        served += 1
+        try:
+            fn = req["fn"]
+            result = {"ok": fn(*req.get("args", ()), **req.get("kwargs", {}))}
+        except Exception as e:
+            result = {"err": f"{type(e).__name__}: {e}"}
+        store.set(f"rpc/result/{req['id']}", pickle.dumps(result))
+
+
+def get_worker_info(name=None) -> Optional[WorkerInfo]:
+    store: TCPStore = _state["store"]
+    if name is None:
+        return WorkerInfo(_state["name"], _state["rank"])
+    for r in range(_state["world_size"]):
+        try:
+            if store.get(f"rpc/worker/{r}").decode() == name:
+                return WorkerInfo(name, r)
+        except KeyError:
+            continue
+    return None
+
+
+def get_all_worker_infos():
+    return [
+        WorkerInfo(_state["store"].get(f"rpc/worker/{r}").decode(), r)
+        for r in range(_state["world_size"])
+    ]
+
+
+def rpc_async(to, fn, args=(), kwargs=None, timeout=30.0) -> Future:
+    store: TCPStore = _state["store"]
+    info = get_worker_info(to) if isinstance(to, str) else to
+    if info is None:
+        raise ValueError(f"unknown rpc worker {to!r}")
+    req_id = uuid.uuid4().hex
+    seq = store.add(f"rpc/seq/{info.rank}", 1) - 1
+    store.set(_inbox_key(info.rank, seq), pickle.dumps({"id": req_id, "fn": fn, "args": args, "kwargs": kwargs or {}}))
+    fut: Future = Future()
+
+    def waiter():
+        try:
+            store.wait(f"rpc/result/{req_id}", timeout=timeout)
+            res = pickle.loads(store.get(f"rpc/result/{req_id}"))
+            if "err" in res:
+                fut.set_exception(RuntimeError(res["err"]))
+            else:
+                fut.set_result(res["ok"])
+        except Exception as e:
+            fut.set_exception(e)
+
+    _state["waiters"].submit(waiter)
+    return fut
+
+
+def rpc_sync(to, fn, args=(), kwargs=None, timeout=30.0):
+    return rpc_async(to, fn, args=args, kwargs=kwargs, timeout=timeout).result(timeout=timeout)
+
+
+def shutdown():
+    if not _state.get("running"):
+        return
+    store: TCPStore = _state["store"]
+    rank, ws = _state["rank"], _state["world_size"] or 1
+    # barrier: everyone checks in before teardown (reference shutdown barrier)
+    store.add("rpc/shutdown", 1)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        try:
+            n = store.get("rpc/shutdown")
+            if int.from_bytes(n[:8], "little", signed=True) >= ws:
+                break
+        except KeyError:
+            pass
+        time.sleep(0.05)
+    _state["running"] = False
+    if _state.get("serve_thread"):
+        _state["serve_thread"].join(timeout=2)
+    if _state.get("waiters"):
+        _state["waiters"].shutdown(wait=False)
+    store.close()
+    _state.clear()

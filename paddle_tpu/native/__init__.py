@@ -99,6 +99,7 @@ def _declare(lib):
     lib.pt_store_del.restype = c.c_int
     lib.pt_store_del.argtypes = [c.c_void_p, c.c_char_p]
     lib.pt_store_client_close.argtypes = [c.c_void_p]
+    lib.pt_store_client_shutdown.argtypes = [c.c_void_p]
     return lib
 
 

@@ -446,4 +446,14 @@ void pt_store_client_close(void* cv) {
   delete c;
 }
 
+// Shutdown + close the socket WITHOUT freeing the StoreClient: safe to call
+// while another thread is blocked inside store_request on this client (its
+// recv/send fails with EBADF and the call returns an error). The tiny struct
+// is intentionally leaked; delete would be a use-after-free.
+void pt_store_client_shutdown(void* cv) {
+  StoreClient* c = static_cast<StoreClient*>(cv);
+  ::shutdown(c->fd, SHUT_RDWR);
+  ::close(c->fd);
+}
+
 }  // extern "C"

@@ -9,15 +9,25 @@ from __future__ import annotations
 
 import ctypes
 import socket
+import threading
 
 from . import NativeUnavailable, get_lib
 
 
 class TCPStore:
+    """The wire protocol is strict request/response per connection, so each
+    Python thread gets its own socket (lazily connected) — concurrent use
+    from multiple threads (e.g. the rpc serve loop + callers) would otherwise
+    interleave frames."""
+
     def __init__(self, host="127.0.0.1", port=0, is_master=False, world_size=1, timeout=30.0):
         self._lib = get_lib()
         self._server = None
-        self._client = None
+        self._tls = threading.local()
+        self._all_clients = []
+        self._clients_lock = threading.Lock()
+        self._timeout = timeout
+        self._closed = False
         self.is_master = is_master
         if is_master:
             self._server = self._lib.pt_store_server_start(port)
@@ -26,14 +36,30 @@ class TCPStore:
             port = self._lib.pt_store_server_port(self._server)
         self.host = host
         self.port = port
-        ip = socket.gethostbyname(host)
-        self._client = self._lib.pt_store_client_connect(
-            ip.encode(), port, int(timeout * 1000)
-        )
-        if not self._client:
-            if self._server:
+        self._ip = socket.gethostbyname(host)
+        self._connect()  # fail fast on the creating thread
+
+    def _connect(self):
+        c = self._lib.pt_store_client_connect(self._ip.encode(), self.port, int(self._timeout * 1000))
+        if not c:
+            if self._server and not self._all_clients:
                 self._lib.pt_store_server_stop(self._server)
-            raise TimeoutError(f"TCPStore: cannot connect to {host}:{port}")
+                self._server = None
+            raise TimeoutError(f"TCPStore: cannot connect to {self.host}:{self.port}")
+        with self._clients_lock:
+            if self._closed:  # lost the race with close(): don't leak a live socket
+                self._lib.pt_store_client_shutdown(c)
+                raise RuntimeError("TCPStore is closed")
+            self._all_clients.append(c)
+        self._tls.client = c
+        return c
+
+    @property
+    def _client(self):
+        if self._closed:
+            raise RuntimeError("TCPStore is closed")
+        c = getattr(self._tls, "client", None)
+        return c if c is not None else self._connect()
 
     def set(self, key: str, value) -> None:
         if isinstance(value, str):
@@ -73,9 +99,16 @@ class TCPStore:
         self._lib.pt_store_del(self._client, key.encode())
 
     def close(self):
-        if self._client:
-            self._lib.pt_store_client_close(self._client)
-            self._client = None
+        with self._clients_lock:
+            if self._closed:
+                return
+            self._closed = True
+            clients, self._all_clients = self._all_clients, []
+        # shutdown (not free): other threads may be blocked mid-request on
+        # these sockets — they wake with a clean error instead of a UAF
+        for c in clients:
+            self._lib.pt_store_client_shutdown(c)
+        self._tls = threading.local()
         if self._server:
             self._lib.pt_store_server_stop(self._server)
             self._server = None

@@ -1,0 +1,13 @@
+"""paddle.onnx namespace (reference: python/paddle/onnx/export.py delegates
+to paddle2onnx). paddle2onnx is not in the TPU image; the deployable export
+format here is jax.export StableHLO — point users at it."""
+from __future__ import annotations
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    raise NotImplementedError(
+        "paddle.onnx.export requires paddle2onnx, which is not available in the TPU "
+        "image. Use paddle_tpu.jit.save(layer, path, input_spec=...) for a portable "
+        "StableHLO artifact (loadable with paddle_tpu.jit.load / jax.export), or "
+        "paddle_tpu.static.save_inference_model for static programs."
+    )

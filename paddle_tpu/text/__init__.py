@@ -1,0 +1,141 @@
+"""paddle.text namespace (reference: python/paddle/text/).
+
+Datasets are synthetic (no network egress; same pattern as vision/audio) and
+`viterbi_decode` / `ViterbiDecoder` port the CRF decoding op
+(reference: python/paddle/text/viterbi_decode.py over phi viterbi kernels)
+as a lax.scan dynamic program.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.apply import apply
+from ..core.tensor import Tensor
+from ..io import Dataset
+from ..nn.layer import Layer
+
+__all__ = ["Imdb", "Conll05st", "UCIHousing", "viterbi_decode", "ViterbiDecoder"]
+
+
+class Imdb(Dataset):
+    """Synthetic IMDB-shaped dataset: token id sequences + binary labels."""
+
+    VOCAB = 5000
+    SEQ = 128
+
+    def __init__(self, data_file=None, mode="train", cutoff=150, seed=0):
+        n = 256 if mode == "train" else 64
+        rng = np.random.RandomState(seed + (0 if mode == "train" else 1))
+        self.docs = rng.randint(1, self.VOCAB, (n, self.SEQ)).astype(np.int64)
+        self.labels = rng.randint(0, 2, n).astype(np.int64)
+        self.word_idx = {f"tok{i}": i for i in range(self.VOCAB)}
+
+    def __getitem__(self, idx):
+        return self.docs[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class Conll05st(Dataset):
+    """Synthetic CoNLL-05 SRL-shaped dataset."""
+
+    VOCAB = 2000
+    NUM_TAGS = 67
+    SEQ = 64
+
+    def __init__(self, data_file=None, mode="train", seed=0, **kw):
+        n = 128 if mode == "train" else 32
+        rng = np.random.RandomState(seed)
+        self.words = rng.randint(1, self.VOCAB, (n, self.SEQ)).astype(np.int64)
+        self.tags = rng.randint(0, self.NUM_TAGS, (n, self.SEQ)).astype(np.int64)
+
+    def __getitem__(self, idx):
+        return self.words[idx], self.tags[idx]
+
+    def __len__(self):
+        return len(self.words)
+
+
+class UCIHousing(Dataset):
+    """Synthetic UCI-housing-shaped regression dataset (13 features)."""
+
+    def __init__(self, data_file=None, mode="train", seed=0):
+        n = 404 if mode == "train" else 102
+        rng = np.random.RandomState(seed)
+        self.x = rng.randn(n, 13).astype("float32")
+        w = rng.randn(13, 1).astype("float32")
+        self.y = (self.x @ w + 0.1 * rng.randn(n, 1)).astype("float32")
+
+    def __getitem__(self, idx):
+        return self.x[idx], self.y[idx]
+
+    def __len__(self):
+        return len(self.x)
+
+
+def viterbi_decode(potentials, transition_params, lengths=None, include_bos_eos_tag=True, name=None):
+    """CRF Viterbi decoding. potentials: [B, T, N] unary scores;
+    transition_params: [N+2, N+2] with BOS=N, EOS=N+1 rows/cols when
+    include_bos_eos_tag (reference semantics), else [N, N].
+    Returns (scores [B], paths [B, T])."""
+    import jax
+    import jax.numpy as jnp
+
+    def fn(pot, trans, *rest):
+        b, t, n = pot.shape
+        lens = rest[0].astype(jnp.int32) if rest else None
+        if include_bos_eos_tag:
+            start = trans[n, :n]
+            stop = trans[:n, n + 1]
+            tr = trans[:n, :n]
+        else:
+            start = jnp.zeros((n,), pot.dtype)
+            stop = jnp.zeros((n,), pot.dtype)
+            tr = trans
+
+        alpha0 = pot[:, 0] + start[None, :]
+        identity_bp = jnp.broadcast_to(jnp.arange(n)[None, :], (b, n))
+
+        def step(alpha, xs):
+            emit, t_idx = xs
+            # alpha: [B, N]; scores[b, i, j] = alpha[b,i] + tr[i,j] + emit[b,j]
+            scores = alpha[:, :, None] + tr[None, :, :]
+            best_prev = jnp.argmax(scores, axis=1)  # [B, N]
+            new = jnp.max(scores, axis=1) + emit
+            if lens is not None:
+                # past a sequence's end: freeze alpha, identity backpointer
+                valid = (t_idx < lens)[:, None]
+                new = jnp.where(valid, new, alpha)
+                best_prev = jnp.where(valid, best_prev, identity_bp)
+            return new, best_prev
+
+        emits = jnp.moveaxis(pot[:, 1:], 1, 0)  # [T-1, B, N]
+        t_steps = jnp.arange(1, t, dtype=jnp.int32)
+        alpha_final, backptrs = jax.lax.scan(step, alpha0, (emits, t_steps))
+        alpha_final = alpha_final + stop[None, :]
+        last = jnp.argmax(alpha_final, axis=-1)  # [B]
+        score = jnp.max(alpha_final, axis=-1)
+
+        def backtrace(carry, bp):
+            tag = carry
+            prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+            return prev, tag
+
+        # reverse scan: ys[i] = tag at time i+1, final carry = tag at time 0
+        first, path_rev = jax.lax.scan(backtrace, last, backptrs, reverse=True)
+        paths = jnp.concatenate([first[:, None], jnp.moveaxis(path_rev, 0, 1)], axis=1)
+        return score, paths.astype(jnp.int64)
+
+    args = [potentials, transition_params] + ([lengths] if lengths is not None else [])
+    return apply("viterbi_decode", fn, *args, n_outputs=2)
+
+
+class ViterbiDecoder(Layer):
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = transitions if isinstance(transitions, Tensor) else Tensor(np.asarray(transitions))
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths, self.include_bos_eos_tag)

@@ -1,0 +1,125 @@
+"""audio features/functional + text datasets/viterbi."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.audio import datasets as adatasets, features, functional as AF
+from paddle_tpu import text
+
+
+def test_mel_hz_roundtrip():
+    hz = np.array([0.0, 440.0, 1000.0, 4000.0], "float32")
+    mel = AF.hz_to_mel(hz)
+    back = AF.mel_to_hz(mel)
+    np.testing.assert_allclose(np.asarray(back), hz, rtol=1e-4, atol=1e-2)
+    # htk formula
+    np.testing.assert_allclose(
+        np.asarray(AF.hz_to_mel(np.array(1000.0, "float32"), htk=True)), 1000.0, rtol=0.01
+    )
+
+
+def test_fbank_matrix_properties():
+    fb = AF.compute_fbank_matrix(16000, 512, n_mels=40).numpy()
+    assert fb.shape == (40, 257)
+    assert (fb >= 0).all()
+    assert (fb.sum(axis=1) > 0).all()  # every filter has support
+
+
+def test_power_to_db():
+    s = np.array([1.0, 10.0, 100.0], "float32")
+    db = AF.power_to_db(paddle.to_tensor(s), top_db=None).numpy()
+    np.testing.assert_allclose(db, [0.0, 10.0, 20.0], atol=1e-4)
+
+
+def test_create_dct_orthonormal():
+    d = AF.create_dct(8, 8).numpy()
+    np.testing.assert_allclose(d.T @ d, np.eye(8), atol=1e-4)
+
+
+def test_window_functions():
+    for w in ("hann", "hamming", "blackman"):
+        win = AF.get_window(w, 64).numpy()
+        assert win.shape == (64,) and win.max() <= 1.0 + 1e-6
+
+
+def test_spectrogram_and_melspectrogram_shapes():
+    sr = 16000
+    x = paddle.to_tensor(np.sin(np.linspace(0, 100, sr)).astype("float32")[None, :])
+    spec = features.Spectrogram(n_fft=512, hop_length=256)(x)
+    assert spec.shape[1] == 257  # freq bins
+    mel = features.MelSpectrogram(sr=sr, n_fft=512, hop_length=256, n_mels=40)(x)
+    assert mel.shape[1] == 40
+    logmel = features.LogMelSpectrogram(sr=sr, n_fft=512, hop_length=256, n_mels=40)(x)
+    assert logmel.shape[1] == 40
+    mfcc = features.MFCC(sr=sr, n_mfcc=13, n_fft=512, hop_length=256, n_mels=40)(x)
+    assert mfcc.shape[1] == 13
+
+
+def test_mel_feature_separates_pitches():
+    ds = adatasets.ESC50(mode="test")
+    w0, l0 = ds[0]
+    assert w0.shape == (16000,) and 0 <= l0 < 50
+    mel = features.MelSpectrogram(sr=16000, n_fft=512, hop_length=256, n_mels=40)
+    m = mel(paddle.to_tensor(ds.waves[:2]))
+    assert tuple(m.shape)[:2] == (2, 40)
+
+
+def test_text_datasets():
+    imdb = text.Imdb(mode="train")
+    doc, label = imdb[0]
+    assert doc.shape == (128,) and label in (0, 1)
+    conll = text.Conll05st(mode="test")
+    words, tags = conll[0]
+    assert words.shape == tags.shape == (64,)
+    h = text.UCIHousing(mode="test")
+    assert h[0][0].shape == (13,)
+
+
+def test_viterbi_decode_matches_bruteforce():
+    rng = np.random.RandomState(0)
+    B, T, N = 2, 5, 3
+    pot = rng.randn(B, T, N).astype("float32")
+    trans = rng.randn(N, N).astype("float32")
+    score, path = text.viterbi_decode(
+        paddle.to_tensor(pot), paddle.to_tensor(trans), include_bos_eos_tag=False
+    )
+    # brute force over all N^T paths
+    import itertools
+
+    for b in range(B):
+        best, best_path = -1e30, None
+        for p in itertools.product(range(N), repeat=T):
+            s = pot[b, 0, p[0]] + sum(trans[p[i - 1], p[i]] + pot[b, i, p[i]] for i in range(1, T))
+            if s > best:
+                best, best_path = s, p
+        np.testing.assert_allclose(float(score.numpy()[b]), best, rtol=1e-5)
+        assert list(path.numpy()[b]) == list(best_path)
+
+
+def test_viterbi_decoder_layer_with_bos_eos():
+    rng = np.random.RandomState(1)
+    N = 4
+    pot = rng.randn(1, 6, N).astype("float32")
+    trans = rng.randn(N + 2, N + 2).astype("float32")
+    dec = text.ViterbiDecoder(paddle.to_tensor(trans), include_bos_eos_tag=True)
+    score, path = dec(paddle.to_tensor(pot))
+    assert path.numpy().shape == (1, 6)
+    assert ((path.numpy() >= 0) & (path.numpy() < N)).all()
+
+
+def test_viterbi_decode_respects_lengths():
+    rng = np.random.RandomState(2)
+    B, T, N = 2, 6, 3
+    pot = rng.randn(B, T, N).astype("float32")
+    trans = rng.randn(N, N).astype("float32")
+    lens = np.array([3, 6], "int64")
+    score, path = text.viterbi_decode(
+        paddle.to_tensor(pot), paddle.to_tensor(trans),
+        lengths=paddle.to_tensor(lens), include_bos_eos_tag=False,
+    )
+    # sequence 0 truncated to length 3 must match decoding of its prefix
+    s3, p3 = text.viterbi_decode(
+        paddle.to_tensor(pot[:1, :3]), paddle.to_tensor(trans), include_bos_eos_tag=False
+    )
+    np.testing.assert_allclose(float(score.numpy()[0]), float(s3.numpy()[0]), rtol=1e-5)
+    assert list(path.numpy()[0][:3]) == list(p3.numpy()[0])

@@ -1,0 +1,102 @@
+"""auto_tuner search/prune/tune, rpc over native store, device namespace."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.auto_tuner import AutoTuner, GridSearch, prune_configs, search_space
+
+
+def test_search_space_partitions():
+    cfgs = search_space(8, global_batch_size=16, num_layers=12)
+    assert cfgs
+    for c in cfgs:
+        assert c["dp"] * c["mp"] * c["pp"] == 8
+        if c["pp"] > 1:
+            assert 12 % c["pp"] == 0
+        assert (16 // c["dp"]) % c["micro_batch"] == 0
+
+
+def test_prune_rules():
+    cfgs = search_space(8, global_batch_size=8)
+    pruned = prune_configs(cfgs, hbm_gb=95.0, num_params_b=1.0, num_heads=12, ici_mp_limit=4)
+    assert pruned
+    for c in pruned:
+        assert 12 % c["mp"] == 0 and c["mp"] <= 4
+    # tiny memory budget prunes everything un-sharded
+    tight = prune_configs(cfgs, hbm_gb=2.0, num_params_b=7.0)
+    for c in tight:
+        assert c["sharding_stage"] >= 1 or c["mp"] * c["pp"] > 1
+
+
+def test_autotuner_picks_best(tmp_path):
+    # synthetic cost: prefer mp=2, penalize pp
+    def runner(cfg):
+        if cfg["pp"] > 2:
+            raise RuntimeError("OOM")  # failing configs are recorded, not fatal
+        return 100.0 / (abs(cfg["mp"] - 2) + 1) / cfg["pp"]
+
+    tuner = AutoTuner(
+        8, runner, global_batch_size=8, num_heads=8, num_params_b=0.1,
+        log_path=str(tmp_path / "trials.jsonl"),
+    )
+    best = tuner.tune()
+    assert best is not None and best["config"]["mp"] == 2 and best["config"]["pp"] == 1
+    assert (tmp_path / "trials.jsonl").exists()
+    errs = [r for r in tuner.search.results if r["error"]]
+    assert all("OOM" in e["error"] for e in errs)
+
+
+def _double(x):
+    return 2 * x
+
+
+def _boom():
+    raise ValueError("kaput")
+
+
+def test_rpc_single_worker_loopback():
+    native = pytest.importorskip("paddle_tpu.native")
+    if not native.available():
+        pytest.skip("native core unavailable")
+    from paddle_tpu.distributed import rpc
+
+    rpc.init_rpc("worker0", rank=0, world_size=1, master_endpoint="127.0.0.1:0")
+    try:
+        info = rpc.get_worker_info()
+        assert info.name == "worker0" and info.rank == 0
+        assert rpc.rpc_sync("worker0", _double, args=(21,)) == 42
+        fut = rpc.rpc_async("worker0", _double, args=(5,))
+        assert fut.result(timeout=10) == 10
+        with pytest.raises(RuntimeError, match="kaput"):
+            rpc.rpc_sync("worker0", _boom)
+    finally:
+        rpc.shutdown()
+
+
+def test_device_namespace():
+    import paddle_tpu.device as device
+
+    assert isinstance(device.get_device(), str)
+    assert device.get_all_device_type()
+    device.synchronize()
+    s = device.Stream()
+    with device.stream_guard(s):
+        assert device.current_stream() is s
+    e = s.record_event()
+    e.synchronize()
+    assert e.query()
+
+
+def test_device_cuda_compat():
+    from paddle_tpu.device import cuda
+
+    assert isinstance(cuda.device_count(), int)
+    assert isinstance(cuda.get_device_name(), str)
+    assert cuda.memory_allocated() >= 0
+    cuda.empty_cache()
+    cuda.synchronize()
+
+
+def test_onnx_export_guides_to_stablehlo():
+    with pytest.raises(NotImplementedError, match="jit.save"):
+        paddle.onnx.export(paddle.nn.Linear(2, 2), "/tmp/x")
