@@ -1,0 +1,208 @@
+"""AMP debugging utilities.
+
+Reference parity: python/paddle/amp/debugging.py — check_numerics (per-tensor
+nan/inf scan with op context), operator stats collection (per-op per-dtype
+call counts printed as the reference's four-column table), compare_accuracy
+(align two runs' per-op dumps), and TensorCheckerConfig/enable_tensor_checker
+driving the global FLAGS_check_nan_inf scan in core.apply.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+from collections import defaultdict
+from enum import Enum
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..framework import flags as flags_mod
+
+
+class DebugMode(Enum):
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL_FOR_OVERFLOW = 2
+    CHECK_ALL = 3
+
+
+def check_numerics(tensor, op_type="", var_name="", debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT):
+    """Scan a tensor; returns (num_nan, num_inf, num_zero) Tensors and, in
+    ABORT mode, raises on nan/inf (reference returns the same triple)."""
+    v = tensor._value if isinstance(tensor, Tensor) else jnp.asarray(tensor)
+    vf = v.astype(jnp.float32) if jnp.issubdtype(v.dtype, jnp.floating) else v
+    if jnp.issubdtype(vf.dtype, jnp.floating):
+        num_nan = jnp.sum(jnp.isnan(vf))
+        num_inf = jnp.sum(jnp.isinf(vf))
+    else:
+        num_nan = jnp.zeros((), jnp.int64)
+        num_inf = jnp.zeros((), jnp.int64)
+    num_zero = jnp.sum(vf == 0)
+    if debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT:
+        if int(num_nan) or int(num_inf):
+            raise RuntimeError(
+                f"check_numerics: op={op_type!r} var={var_name!r} has "
+                f"{int(num_nan)} nan / {int(num_inf)} inf values"
+            )
+    return Tensor(num_nan), Tensor(num_inf), Tensor(num_zero)
+
+
+# ---------------------------------------------------------------------------
+# operator stats collection (wired into core.apply)
+# ---------------------------------------------------------------------------
+
+_op_stats = {"active": False, "counts": defaultdict(int)}
+
+
+def _record_op(name: str, dtype) -> None:
+    if _op_stats["active"]:
+        _op_stats["counts"][(name, str(dtype))] += 1
+
+
+def enable_operator_stats_collection():
+    _op_stats["counts"].clear()
+    _op_stats["active"] = True
+
+
+def disable_operator_stats_collection():
+    _op_stats["active"] = False
+    _print_operator_stats(_op_stats["counts"])
+
+
+@contextlib.contextmanager
+def collect_operator_stats():
+    enable_operator_stats_collection()
+    try:
+        yield
+    finally:
+        disable_operator_stats_collection()
+
+
+_DTYPE_COLS = ("float32", "float16", "bfloat16", "other")
+
+
+def _col_of(dtype_str):
+    for c in _DTYPE_COLS[:3]:
+        if c in dtype_str:
+            return c
+    return "other"
+
+
+def _print_operator_stats(counts):
+    """The reference's table: op, FP16/BF16/FP32/other call counts."""
+    per_op = defaultdict(lambda: defaultdict(int))
+    for (name, dt), n in counts.items():
+        per_op[name][_col_of(dt)] += n
+    print("<------------------------------------------ op list ------------------------------------------->")
+    print(f"{'<--- Op Name --->':<40}{'| FP32 Calls':<14}{'| BF16 Calls':<14}{'| FP16 Calls':<14}{'| Other Calls':<14}")
+    for name in sorted(per_op):
+        row = per_op[name]
+        print(
+            f"{name:<40}|  {row['float32']:<12}|  {row['bfloat16']:<12}|  {row['float16']:<12}|  {row['other']:<12}"
+        )
+    print("<----------------------------------------------- op count: %d ----------------------------------->" % len(per_op))
+
+
+def operator_stats():
+    """Programmatic access to the collected counts ({(op, dtype): n})."""
+    return dict(_op_stats["counts"])
+
+
+# ---------------------------------------------------------------------------
+# tensor checker (global per-op nan/inf scan)
+# ---------------------------------------------------------------------------
+
+class TensorCheckerConfig:
+    def __init__(self, enable=True, debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT, checked_op_list=None, skipped_op_list=None, debug_step=None):
+        self.enable = enable
+        self.debug_mode = debug_mode
+        self.checked_op_list = set(checked_op_list or [])
+        self.skipped_op_list = set(skipped_op_list or [])
+        self.debug_step = debug_step
+
+
+_checker = {"config": None}
+
+
+def enable_tensor_checker(checker_config: TensorCheckerConfig):
+    _checker["config"] = checker_config if checker_config.enable else None
+    flags_mod.set_flags({"FLAGS_check_nan_inf": bool(_checker["config"])})
+
+
+def disable_tensor_checker():
+    _checker["config"] = None
+    flags_mod.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def _should_check(op_name: str) -> bool:
+    cfg = _checker["config"]
+    if cfg is None:
+        return flags_mod.get_flag("FLAGS_check_nan_inf")
+    if cfg.checked_op_list and op_name not in cfg.checked_op_list:
+        return False
+    if op_name in cfg.skipped_op_list:
+        return False
+    return True
+
+
+def _check_op_output(op_name: str, value) -> None:
+    """Called from core.apply for each op output when the scan is on."""
+    if not jnp.issubdtype(jnp.result_type(value), jnp.floating):
+        return
+    bad = bool(jnp.any(jnp.isnan(value)) | jnp.any(jnp.isinf(value)))
+    if bad:
+        cfg = _checker["config"]
+        mode = cfg.debug_mode if cfg else DebugMode.CHECK_NAN_INF_AND_ABORT
+        msg = f"nan/inf detected in output of op {op_name!r}"
+        if mode == DebugMode.CHECK_NAN_INF_AND_ABORT:
+            raise FloatingPointError(msg)
+        print(f"[check_nan_inf] {msg}")
+
+
+# ---------------------------------------------------------------------------
+# accuracy comparison between two runs
+# ---------------------------------------------------------------------------
+
+def save_tensor_dump(path, step, name, tensor):
+    """Dump one tensor for later compare_accuracy (npz per step)."""
+    os.makedirs(path, exist_ok=True)
+    v = tensor.numpy() if isinstance(tensor, Tensor) else np.asarray(tensor)
+    np.savez(os.path.join(path, f"{step:06d}_{name}.npz"), value=v)
+
+
+def compare_accuracy(dump_path, another_dump_path, output_filename=None, loss_scale=1.0, dump_all_tensors=False, atol=1e-3, rtol=1e-3):
+    """Align two dump directories by filename; report per-tensor max abs/rel
+    diff (reference: excel report; here a list of dicts + optional csv)."""
+    rows = []
+    a_files = {f: os.path.join(dump_path, f) for f in sorted(os.listdir(dump_path)) if f.endswith(".npz")}
+    for fname, apath in a_files.items():
+        bpath = os.path.join(another_dump_path, fname)
+        if not os.path.exists(bpath):
+            rows.append({"name": fname, "status": "missing_in_b"})
+            continue
+        a = np.load(apath)["value"].astype(np.float64)
+        b = np.load(bpath)["value"].astype(np.float64) * loss_scale
+        if a.shape != b.shape:
+            rows.append({"name": fname, "status": "shape_mismatch", "a": a.shape, "b": b.shape})
+            continue
+        adiff = float(np.max(np.abs(a - b))) if a.size else 0.0
+        denom = np.maximum(np.abs(a), 1e-12)
+        rdiff = float(np.max(np.abs(a - b) / denom)) if a.size else 0.0
+        rows.append(
+            {
+                "name": fname,
+                "status": "ok" if (adiff <= atol or rdiff <= rtol) else "diff",
+                "max_abs_diff": adiff,
+                "max_rel_diff": rdiff,
+            }
+        )
+    if output_filename:
+        import csv
+
+        with open(output_filename, "w", newline="") as f:
+            w = csv.DictWriter(f, fieldnames=["name", "status", "max_abs_diff", "max_rel_diff", "a", "b"])
+            w.writeheader()
+            for r in rows:
+                w.writerow(r)
+    return rows

@@ -91,10 +91,51 @@ def apply(name: str, fn: Callable, *args, n_outputs=None, **kwargs):
 
 def _record_static(name, fn, args, kwargs, res):
     """Append this op to the Program being captured (paddle_tpu.static):
-    the static-graph analog of OpDesc append in LayerHelper.append_op."""
+    the static-graph analog of OpDesc append in LayerHelper.append_op.
+    Also the per-op debug hook point: AMP operator-stats counting, the
+    FLAGS_check_nan_inf scan (reference: nan_inf_utils.cc per-op checks in
+    the generated ad_funcs), and FLAGS_benchmark per-op sync."""
     prog = state.get_program_capture()
     if prog is not None:
         prog.record_op(name, fn, args, kwargs, res)
+    _debug_hooks(name, res)
+
+
+def _debug_hooks(name, res):
+    from ..framework import flags as _flags
+
+    # hot path: raw dict reads (GIL-atomic), no locks; the debugging module
+    # imports lazily only when a hook is actually on
+    reg = _flags._registry
+    stats_on = _amp_stats_active()
+    nan_on = reg.get("FLAGS_check_nan_inf", False)
+    bench_on = reg.get("FLAGS_benchmark", False)
+    if not (stats_on or nan_on or bench_on):
+        return
+    from ..amp import debugging as _dbg
+
+    outs = res if isinstance(res, (tuple, list)) else (res,)
+    concrete = [
+        o for o in outs if isinstance(o, Tensor) and not isinstance(o._value, jax.core.Tracer)
+    ]  # under to_static/jit tracing the scans would break the trace — skip
+    if stats_on:
+        for o in outs:
+            if isinstance(o, Tensor):
+                _dbg._record_op(name, o._value.dtype)  # dtype is trace-safe
+                break
+    if nan_on and concrete and _dbg._should_check(name):
+        for o in concrete:
+            _dbg._check_op_output(name, o._value)
+    if bench_on:
+        for o in concrete:
+            o._value.block_until_ready()
+
+
+def _amp_stats_active() -> bool:
+    import sys
+
+    dbg = sys.modules.get("paddle_tpu.amp.debugging")
+    return bool(dbg and dbg._op_stats["active"])
 
 
 def _wrap(out, node):

@@ -13,7 +13,7 @@ import pickle
 import threading
 import time
 import uuid
-from concurrent.futures import Future, ThreadPoolExecutor
+from concurrent.futures import Future
 from typing import Optional
 
 from ...native.store import TCPStore
@@ -45,9 +45,12 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint="127.0.0.1:0"):
         world_size=world_size,
         running=True,
         serve_thread=None,
-        # bounded waiter pool: each thread holds one store connection, so an
-        # unbounded thread-per-call design would leak sockets with call count
-        waiters=ThreadPoolExecutor(max_workers=4, thread_name_prefix="rpc-wait"),
+        # one collector thread resolves ALL pending futures (one store
+        # connection total; a thread-per-call design leaks sockets and a
+        # bounded pool starves callers when more calls than threads pend)
+        pending={},
+        pending_lock=threading.Lock(),
+        collector=None,
     )
     store.set(f"rpc/worker/{rank}", name)
     # wait for all workers to register
@@ -78,12 +81,13 @@ def _serve_loop():
         except KeyError:
             continue
         served += 1
+        store.delete_key(key)  # consumed: the master's kv must not grow per call
         try:
             fn = req["fn"]
-            result = {"ok": fn(*req.get("args", ()), **req.get("kwargs", {}))}
-        except Exception as e:
-            result = {"err": f"{type(e).__name__}: {e}"}
-        store.set(f"rpc/result/{req['id']}", pickle.dumps(result))
+            payload = pickle.dumps({"ok": fn(*req.get("args", ()), **req.get("kwargs", {}))})
+        except Exception as e:  # incl. unpicklable results: report, don't die
+            payload = pickle.dumps({"err": f"{type(e).__name__}: {e}"})
+        store.set(f"rpc/result/{req['id']}", payload)
 
 
 def get_worker_info(name=None) -> Optional[WorkerInfo]:
@@ -115,20 +119,43 @@ def rpc_async(to, fn, args=(), kwargs=None, timeout=30.0) -> Future:
     seq = store.add(f"rpc/seq/{info.rank}", 1) - 1
     store.set(_inbox_key(info.rank, seq), pickle.dumps({"id": req_id, "fn": fn, "args": args, "kwargs": kwargs or {}}))
     fut: Future = Future()
-
-    def waiter():
-        try:
-            store.wait(f"rpc/result/{req_id}", timeout=timeout)
-            res = pickle.loads(store.get(f"rpc/result/{req_id}"))
-            if "err" in res:
-                fut.set_exception(RuntimeError(res["err"]))
-            else:
-                fut.set_result(res["ok"])
-        except Exception as e:
-            fut.set_exception(e)
-
-    _state["waiters"].submit(waiter)
+    with _state["pending_lock"]:
+        _state["pending"][req_id] = (fut, time.time() + timeout)
+        if _state["collector"] is None or not _state["collector"].is_alive():
+            c = threading.Thread(target=_collect_loop, daemon=True)
+            _state["collector"] = c
+            c.start()
     return fut
+
+
+def _collect_loop():
+    """Resolve pending futures by polling their result keys (single thread,
+    single store connection)."""
+    store: TCPStore = _state["store"]
+    while _state.get("running"):
+        with _state["pending_lock"]:
+            items = list(_state["pending"].items())
+        if not items:
+            time.sleep(0.02)
+            continue
+        for req_id, (fut, deadline) in items:
+            try:
+                store.wait(f"rpc/result/{req_id}", timeout=0.05)
+                res = pickle.loads(store.get(f"rpc/result/{req_id}"))
+                store.delete_key(f"rpc/result/{req_id}")
+                if "err" in res:
+                    fut.set_exception(RuntimeError(res["err"]))
+                else:
+                    fut.set_result(res["ok"])
+            except TimeoutError:
+                if time.time() > deadline:
+                    fut.set_exception(TimeoutError(f"rpc result {req_id} timed out"))
+                else:
+                    continue
+            except Exception as e:
+                fut.set_exception(e)
+            with _state["pending_lock"]:
+                _state["pending"].pop(req_id, None)
 
 
 def rpc_sync(to, fn, args=(), kwargs=None, timeout=30.0):
@@ -154,7 +181,7 @@ def shutdown():
     _state["running"] = False
     if _state.get("serve_thread"):
         _state["serve_thread"].join(timeout=2)
-    if _state.get("waiters"):
-        _state["waiters"].shutdown(wait=False)
+    if _state.get("collector") and _state["collector"].is_alive():
+        _state["collector"].join(timeout=2)
     store.close()
     _state.clear()

@@ -1,0 +1,46 @@
+"""Named stats counters.
+
+Reference parity: paddle/fluid/platform/monitor.cc (STAT_INT registry used
+for framework-internal counters) + python/paddle/distributed/metric's simple
+counters. Thread-safe int/float counters and gauges with a snapshot API.
+"""
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+
+_lock = threading.Lock()
+_counters: dict = defaultdict(int)
+_gauges: dict = {}
+
+
+def add(name: str, value=1):
+    with _lock:
+        _counters[name] += value
+
+
+def set_gauge(name: str, value):
+    with _lock:
+        _gauges[name] = value
+
+
+def get(name: str):
+    with _lock:
+        if name in _counters:
+            return _counters[name]
+        return _gauges.get(name)
+
+
+def snapshot():
+    with _lock:
+        return {"counters": dict(_counters), "gauges": dict(_gauges)}
+
+
+def reset(name: str = None):
+    with _lock:
+        if name is None:
+            _counters.clear()
+            _gauges.clear()
+        else:
+            _counters.pop(name, None)
+            _gauges.pop(name, None)

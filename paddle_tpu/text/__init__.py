@@ -46,7 +46,7 @@ class Conll05st(Dataset):
 
     def __init__(self, data_file=None, mode="train", seed=0, **kw):
         n = 128 if mode == "train" else 32
-        rng = np.random.RandomState(seed)
+        rng = np.random.RandomState(seed + (0 if mode == "train" else 1))
         self.words = rng.randint(1, self.VOCAB, (n, self.SEQ)).astype(np.int64)
         self.tags = rng.randint(0, self.NUM_TAGS, (n, self.SEQ)).astype(np.int64)
 
@@ -62,9 +62,10 @@ class UCIHousing(Dataset):
 
     def __init__(self, data_file=None, mode="train", seed=0):
         n = 404 if mode == "train" else 102
-        rng = np.random.RandomState(seed)
+        # same regression weights for both splits; independent x streams
+        w = np.random.RandomState(seed + 1234).randn(13, 1).astype("float32")
+        rng = np.random.RandomState(seed + (0 if mode == "train" else 1))
         self.x = rng.randn(n, 13).astype("float32")
-        w = rng.randn(13, 1).astype("float32")
         self.y = (self.x @ w + 0.1 * rng.randn(n, 1)).astype("float32")
 
     def __getitem__(self, idx):

@@ -1,0 +1,79 @@
+"""amp.debugging: check_numerics, op stats, tensor checker, compare_accuracy; monitor."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.amp import debugging as dbg
+from paddle_tpu.framework import monitor
+
+
+def test_check_numerics_counts_and_abort():
+    t = paddle.to_tensor(np.array([1.0, np.nan, np.inf, 0.0], "float32"))
+    n_nan, n_inf, n_zero = dbg.check_numerics(t, "op", "x", dbg.DebugMode.CHECK_NAN_INF)
+    assert (int(n_nan.numpy()), int(n_inf.numpy()), int(n_zero.numpy())) == (1, 1, 1)
+    with pytest.raises(RuntimeError, match="nan"):
+        dbg.check_numerics(t, "op", "x")  # abort mode default
+    ok = paddle.to_tensor(np.ones(3, "float32"))
+    dbg.check_numerics(ok, "op", "x")  # no raise
+
+
+def test_operator_stats_collection(capsys):
+    with dbg.collect_operator_stats():
+        a = paddle.ones([2, 2])
+        b = (a @ a).astype("bfloat16")
+        _ = b + b
+    out = capsys.readouterr().out
+    assert "op list" in out and "matmul" in out
+    counts = dbg.operator_stats()
+    assert any(k[0] == "matmul" for k in counts)
+    # outside the context: no recording
+    _ = paddle.ones([2]) * 2
+    assert dbg.operator_stats() == counts
+
+
+def test_tensor_checker_aborts_on_nan():
+    cfg = dbg.TensorCheckerConfig(enable=True)
+    dbg.enable_tensor_checker(cfg)
+    try:
+        bad = paddle.to_tensor(np.array([0.0], "float32"))
+        with pytest.raises(FloatingPointError):
+            bad / paddle.to_tensor(np.array([0.0], "float32"))  # 0/0 -> nan
+    finally:
+        dbg.disable_tensor_checker()
+    # disabled again: no raise
+    _ = paddle.to_tensor(np.array([0.0], "float32")) / paddle.to_tensor(np.array([0.0], "float32"))
+
+
+def test_tensor_checker_op_lists():
+    cfg = dbg.TensorCheckerConfig(enable=True, skipped_op_list=["divide"])
+    dbg.enable_tensor_checker(cfg)
+    try:
+        _ = paddle.to_tensor(np.array([0.0], "float32")) / paddle.to_tensor(np.array([0.0], "float32"))
+    finally:
+        dbg.disable_tensor_checker()
+
+
+def test_compare_accuracy(tmp_path):
+    a_dir, b_dir = str(tmp_path / "a"), str(tmp_path / "b")
+    x = np.ones((4,), "float32")
+    dbg.save_tensor_dump(a_dir, 0, "w", x)
+    dbg.save_tensor_dump(b_dir, 0, "w", x + 1e-6)
+    dbg.save_tensor_dump(a_dir, 1, "z", x)
+    dbg.save_tensor_dump(b_dir, 1, "z", x * 5)
+    rows = dbg.compare_accuracy(a_dir, b_dir, output_filename=str(tmp_path / "r.csv"))
+    status = {r["name"].split("_", 1)[1]: r["status"] for r in rows}
+    assert status["w.npz"] == "ok" and status["z.npz"] == "diff"
+    assert (tmp_path / "r.csv").exists()
+
+
+def test_monitor_counters():
+    monitor.reset()
+    monitor.add("steps")
+    monitor.add("steps", 2)
+    monitor.set_gauge("lr", 0.1)
+    assert monitor.get("steps") == 3
+    assert monitor.get("lr") == 0.1
+    snap = monitor.snapshot()
+    assert snap["counters"]["steps"] == 3
+    monitor.reset("steps")
+    assert monitor.get("steps") is None
