@@ -124,6 +124,8 @@ from .ops.linalg import (  # noqa: F401,E402
     cdist,
     cholesky,
     cholesky_solve,
+    corrcoef,
+    cov,
     dist,
     inverse,
     matmul,

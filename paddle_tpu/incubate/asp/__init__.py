@@ -1,0 +1,162 @@
+"""ASP — 2:4 structured sparsity.
+
+Reference parity: python/paddle/incubate/asp/ — `calculate_density`,
+`check_mask_2d/1d`, `create_mask`, `prune_model`, `decorate` (optimizer
+wrapper that re-applies masks after each step so pruned weights stay zero).
+TPU note: current TPU gens have no 2:4 sparse MXU mode, so pruning here
+yields model-compression semantics (zeros), with masks maintained exactly
+like the reference for portability of the workflow.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...core.tensor import Tensor
+from ...nn.layer import Layer
+
+# masks for the most recent prune_model call; decorate() snapshots them so
+# each decorated optimizer only ever touches the model it was built for
+_masks: dict = {}  # id(param) -> (param, mask ndarray)
+
+__all__ = [
+    "calculate_density",
+    "check_mask_1d",
+    "check_mask_2d",
+    "create_mask",
+    "prune_model",
+    "decorate",
+    "reset_excluded_layers",
+    "set_excluded_layers",
+]
+
+_excluded: set = set()
+
+
+def calculate_density(x) -> float:
+    v = x.numpy() if isinstance(x, Tensor) else np.asarray(x)
+    return float(np.count_nonzero(v)) / max(v.size, 1)
+
+
+def _mask_1d(mat, n=2, m=4):
+    """Keep the n largest-|w| of every m consecutive weights along rows."""
+    flat = mat.reshape(-1, m)
+    idx = np.argsort(-np.abs(flat), axis=1)[:, :n]
+    mask = np.zeros_like(flat, dtype=bool)
+    np.put_along_axis(mask, idx, True, axis=1)
+    return mask.reshape(mat.shape)
+
+
+def _mask_2d_greedy(mat, n=2, m=4):
+    """Greedy m x m block mask: pick the n largest per row subject to each
+    column keeping <= n (reference mask_2d_greedy semantics)."""
+    out = np.zeros_like(mat, dtype=bool)
+    for i in range(0, mat.shape[0], m):
+        for j in range(0, mat.shape[1], m):
+            blk = np.abs(mat[i : i + m, j : j + m])
+            col_used = np.zeros(blk.shape[1], dtype=int)
+            for r in np.argsort(-blk.max(axis=1)):  # strongest rows first
+                order = np.argsort(-blk[r])
+                picked = 0
+                for c in order:
+                    if picked == n:
+                        break
+                    if col_used[c] < n:
+                        out[i + r, j + c] = True
+                        col_used[c] += 1
+                        picked += 1
+    return out
+
+
+def create_mask(tensor, func_name="mask_1d", n=2, m=4):
+    v = tensor.numpy() if isinstance(tensor, Tensor) else np.asarray(tensor)
+    if v.ndim < 2 or v.shape[-1] % m:
+        return np.ones_like(v, dtype=bool)
+    if func_name in ("mask_2d_greedy", "mask_2d_best", "mask_2d"):
+        if v.ndim != 2 or v.shape[0] % m:
+            return np.ones_like(v, dtype=bool)
+        return _mask_2d_greedy(v, n, m)
+    if func_name not in ("mask_1d", "get_mask_1d"):
+        raise ValueError(f"unknown mask algorithm {func_name!r}")
+    return _mask_1d(v.reshape(-1, v.shape[-1]), n, m).reshape(v.shape)
+
+
+def check_mask_1d(mat, n=2, m=4) -> bool:
+    v = mat.numpy() if isinstance(mat, Tensor) else np.asarray(mat)
+    if v.shape[-1] % m:
+        return False
+    nz = (v.reshape(-1, m) != 0).sum(axis=1)
+    return bool((nz <= n).all())
+
+
+def check_mask_2d(mat, n=2, m=4) -> bool:
+    # reference's 2d check: every m x m block has <= n nonzeros per row and column
+    v = mat.numpy() if isinstance(mat, Tensor) else np.asarray(mat)
+    if v.ndim != 2 or v.shape[0] % m or v.shape[1] % m:
+        return False
+    for i in range(0, v.shape[0], m):
+        for j in range(0, v.shape[1], m):
+            blk = v[i : i + m, j : j + m] != 0
+            if (blk.sum(0) > n).any() or (blk.sum(1) > n).any():
+                return False
+    return True
+
+
+def set_excluded_layers(param_names, main_program=None):
+    _excluded.update(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    _excluded.clear()
+
+
+def prune_model(model: Layer, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Apply 2:4 masks to every eligible weight (>=2D, last dim % m == 0,
+    not excluded); registers masks so `decorate`d optimizers keep them."""
+    import jax.numpy as jnp
+
+    _masks.clear()  # masks belong to this model until the next prune
+    pruned = {}
+    for name, p in model.named_parameters():
+        if p.stop_gradient or len(p.shape) < 2 or int(p.shape[-1]) % m:
+            continue
+        if name in _excluded or (p.name and p.name in _excluded):
+            continue
+        mask = create_mask(p, func_name=mask_algo, n=n, m=m)
+        p._replace_value(p._value * jnp.asarray(mask, p._value.dtype))
+        if with_mask:
+            _masks[id(p)] = (p, mask)
+        pruned[name] = float(mask.mean())
+    return pruned
+
+
+class ASPOptimizer:
+    """Optimizer wrapper: after each step, re-zero pruned weights (the
+    reference's OptimizerWithSparsityGuarantee). Masks are restricted to the
+    parameters THIS optimizer owns, snapshotted at decorate() time."""
+
+    def __init__(self, optimizer):
+        self._inner = optimizer
+        own = {id(p) for _, p in optimizer._all_params()}
+        self._masks = {k: v for k, v in _masks.items() if k in own}
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def step(self):
+        import jax.numpy as jnp
+
+        self._inner.step()
+        for p, mask in self._masks.values():
+            p._replace_value(p._value * jnp.asarray(mask, p._value.dtype))
+
+    def minimize(self, loss, *a, **kw):
+        out = self._inner.minimize(loss, *a, **kw)
+        import jax.numpy as jnp
+
+        for p, mask in self._masks.values():
+            p._replace_value(p._value * jnp.asarray(mask, p._value.dtype))
+        return out
+
+
+def decorate(optimizer):
+    return ASPOptimizer(optimizer)

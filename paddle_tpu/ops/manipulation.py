@@ -607,3 +607,10 @@ def unfold(x, axis, size, step):
 
 def pad_sequences(*a, **k):
     raise NotImplementedError
+
+
+def unstack(x, axis=0, num=None):
+    """paddle.unstack = unbind (python/paddle/tensor/manipulation.py)."""
+    if num is not None and int(x.shape[axis]) != num:
+        raise ValueError(f"unstack: num={num} != size of axis {axis} ({int(x.shape[axis])})")
+    return unbind(x, axis)

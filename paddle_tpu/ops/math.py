@@ -439,3 +439,78 @@ def all(x, axis=None, keepdim=False, name=None):  # noqa: A001
 
 def broadcast_shape(x_shape, y_shape):
     return list(jnp.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    """paddle.std (python/paddle/tensor/stat.py): sample std, ddof=1 default."""
+    return apply(
+        "std",
+        lambda v: jnp.std(v, axis=_axes(axis), ddof=1 if unbiased else 0, keepdims=keepdim),
+        _t(x),
+    )
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply(
+        "var",
+        lambda v: jnp.var(v, axis=_axes(axis), ddof=1 if unbiased else 0, keepdims=keepdim),
+        _t(x),
+    )
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    """paddle.trapezoid (python/paddle/tensor/math.py)."""
+    if x is not None:
+        return apply("trapezoid", lambda yv, xv: jnp.trapezoid(yv, xv, axis=axis), _t(y), _t(x))
+    return apply("trapezoid", lambda yv: jnp.trapezoid(yv, dx=dx if dx is not None else 1.0, axis=axis), _t(y))
+
+
+def _cumtrap(yv, xv=None, dx=1.0, axis=-1):
+    yv = jnp.moveaxis(yv, axis, -1)
+    if xv is not None:
+        d = jnp.diff(jnp.moveaxis(xv, axis, -1) if xv.ndim == yv.ndim else xv)
+    else:
+        d = dx
+    avg = (yv[..., 1:] + yv[..., :-1]) / 2.0
+    return jnp.moveaxis(jnp.cumsum(avg * d, axis=-1), -1, axis)
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    if x is not None:
+        return apply("cumulative_trapezoid", lambda yv, xv: _cumtrap(yv, xv, axis=axis), _t(y), _t(x))
+    return apply("cumulative_trapezoid", lambda yv: _cumtrap(yv, dx=dx if dx is not None else 1.0, axis=axis), _t(y))
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """paddle.renorm: clamp the p-norm of each slice along `axis` to max_norm."""
+
+    def fn(v):
+        moved = jnp.moveaxis(v, axis, 0)
+        flat = moved.reshape(moved.shape[0], -1)
+        # sanitize BEFORE the power: d/dx (sum|x|^p)^(1/p) is nan at 0, and
+        # where() cannot stop reverse-mode nans from the untaken branch
+        # (zero rows appear routinely, e.g. ASP-pruned weights)
+        sumsq = jnp.sum(jnp.abs(flat) ** p, axis=1)
+        safe = jnp.maximum(sumsq, 1e-24)
+        norms = jnp.where(sumsq > 0, safe ** (1.0 / p), 0.0)
+        scale = jnp.where(norms > max_norm, max_norm / jnp.maximum(norms, 1e-12), 1.0)
+        out = flat * scale[:, None]
+        return jnp.moveaxis(out.reshape(moved.shape), 0, axis)
+
+    return apply("renorm", fn, _t(x))
+
+
+def vander(x, n=None, increasing=False, name=None):
+    def fn(v):
+        cols = v.shape[0] if n is None else n
+        out = jnp.vander(v, cols, increasing=increasing)
+        return out
+
+    return apply("vander", fn, _t(x))
+
+
+def tensordot(x, y, axes=2, name=None):
+    ax = axes
+    if isinstance(ax, (list, tuple)):
+        ax = tuple(tuple(a) if isinstance(a, (list, tuple)) else a for a in ax)
+    return apply("tensordot", lambda a, b: jnp.tensordot(a, b, axes=ax), _t(x), _t(y))

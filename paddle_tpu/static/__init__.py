@@ -7,6 +7,7 @@ instruction list over pure jax fns (program.py) and the executor is one
 jax.jit replay (executor.py) — see those modules for the design mapping.
 """
 from ..jit.api import cond  # noqa: F401
+from . import nn  # noqa: F401
 from .executor import Executor, append_backward, global_scope, scope_guard  # noqa: F401
 from .io import load_inference_model, save_inference_model  # noqa: F401
 from .program import (  # noqa: F401

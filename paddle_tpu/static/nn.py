@@ -1,0 +1,95 @@
+"""paddle.static.nn layer library.
+
+Reference parity: python/paddle/static/nn/common.py — functional layer
+builders used in static programs (fc, embedding, batch_norm, conv2d, ...).
+Each call creates the layer's parameters (visible via
+Program.all_parameters) and records its ops into the program being captured.
+"""
+from __future__ import annotations
+
+from ..core.tensor import Tensor
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None, activation=None, name=None):
+    from .. import nn
+
+    in_features = 1
+    for d in x.shape[num_flatten_dims:]:
+        if int(d) < 0:
+            raise ValueError(
+                "static.nn.fc: flattened dims must be static; got a dynamic (-1) "
+                f"dim in {list(x.shape)[num_flatten_dims:]} — declare them in static.data"
+            )
+        in_features *= int(d)
+    layer = nn.Linear(in_features, size, weight_attr=weight_attr, bias_attr=bias_attr)
+    xin = x
+    if len(x.shape) > num_flatten_dims + 1:
+        lead = [int(d) for d in x.shape[:num_flatten_dims]]
+        xin = x.reshape(lead + [in_features])
+    out = layer(xin)
+    if activation:
+        import paddle_tpu.nn.functional as F
+
+        out = getattr(F, activation)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None, param_attr=None, dtype="float32"):  # noqa: A002
+    from .. import nn
+
+    layer = nn.Embedding(size[0], size[1], padding_idx=padding_idx)
+    return layer(input)
+
+
+def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5, param_attr=None, bias_attr=None, data_layout="NCHW", is_test=False, name=None):  # noqa: A002
+    from .. import nn
+
+    c = int(input.shape[1 if data_layout == "NCHW" else -1])
+    layer = nn.BatchNorm2D(c, momentum=momentum, epsilon=epsilon, data_format=data_layout)
+    if is_test:
+        layer.eval()
+    out = layer(input)
+    if act:
+        import paddle_tpu.nn.functional as F
+
+        out = getattr(F, act)(out)
+    return out
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1, groups=1, param_attr=None, bias_attr=None, act=None, data_format="NCHW", name=None):  # noqa: A002
+    from .. import nn
+
+    c_in = int(input.shape[1 if data_format == "NCHW" else -1])
+    layer = nn.Conv2D(
+        c_in, num_filters, filter_size, stride=stride, padding=padding,
+        dilation=dilation, groups=groups, data_format=data_format,
+        bias_attr=bias_attr,
+    )
+    out = layer(input)
+    if act:
+        import paddle_tpu.nn.functional as F
+
+        out = getattr(F, act)(out)
+    return out
+
+
+def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
+    from .. import nn
+
+    if mode == "all":
+        num = 1
+    elif mode == "channel":
+        num = int(x.shape[1 if data_format == "NCHW" else -1])
+    elif mode == "element":
+        num = 1
+        for d in x.shape[1:]:
+            num *= int(d)
+    else:
+        raise ValueError(f"prelu mode must be all/channel/element, got {mode!r}")
+    return nn.PReLU(num_parameters=num, data_format=data_format)(x)
+
+
+def sequence_softmax(x, name=None):
+    import paddle_tpu.nn.functional as F
+
+    return F.softmax(x, axis=-1)

@@ -1,0 +1,67 @@
+"""incubate.asp 2:4 sparsity + static.nn layer builders."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+from paddle_tpu.incubate import asp
+
+
+def test_create_and_check_masks():
+    w = np.random.RandomState(0).randn(8, 16).astype("float32")
+    mask = asp.create_mask(w)
+    assert mask.shape == w.shape and mask.reshape(-1, 4).sum(1).max() == 2
+    pruned = w * mask
+    assert asp.check_mask_1d(pruned)
+    assert abs(asp.calculate_density(pruned) - 0.5) < 1e-6
+    assert not asp.check_mask_1d(w)  # dense fails the check
+
+
+def test_prune_model_and_decorated_optimizer_keeps_sparsity():
+    net = paddle.nn.Sequential(paddle.nn.Linear(16, 32), paddle.nn.ReLU(), paddle.nn.Linear(32, 4))
+    ratios = asp.prune_model(net)
+    assert ratios  # some weights pruned
+    w0 = net[0].weight.numpy()
+    assert asp.check_mask_1d(w0)
+    opt = asp.decorate(paddle.optimizer.SGD(0.1, parameters=net.parameters()))
+    x = paddle.to_tensor(np.random.RandomState(1).randn(4, 16).astype("float32"))
+    for _ in range(3):
+        net(x).sum().backward()
+        opt.step()
+        opt.clear_grad()
+    # sparsity pattern survives optimizer steps
+    assert asp.check_mask_1d(net[0].weight.numpy())
+    # and weights did train (nonzeros changed)
+    assert not np.allclose(net[0].weight.numpy(), w0)
+
+
+def test_check_mask_2d():
+    m = np.zeros((4, 4), "float32")
+    m[0, 0] = m[1, 1] = 1.0
+    assert asp.check_mask_2d(m)
+    m[2, 0] = m[3, 0] = m[0, 1] = 1.0  # column 0 now has 3 nonzeros
+    assert not asp.check_mask_2d(m)
+
+
+def test_static_nn_builders():
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        x = static.data("x", [-1, 8], "float32")
+        h = static.nn.fc(x, 16, activation="relu")
+        ids = static.data("ids", [-1, 4], "int64")
+        emb = static.nn.embedding(ids, size=[100, 8])
+        img = static.data("img", [2, 3, 8, 8], "float32")
+        bn = static.nn.batch_norm(static.nn.conv2d(img, 4, 3, padding=1), is_test=True)
+    exe = static.Executor()
+    out, e, b = exe.run(
+        main,
+        feed={
+            "x": np.ones((2, 8), "float32"),
+            "ids": np.zeros((2, 4), "int64"),
+            "img": np.zeros((2, 3, 8, 8), "float32"),
+        },
+        fetch_list=[h, emb, bn],
+    )
+    assert out.shape == (2, 16) and (out >= 0).all()
+    assert e.shape == (2, 4, 8)
+    assert b.shape == (2, 4, 8, 8)
