@@ -158,6 +158,9 @@ from . import audio  # noqa: E402,F401
 from . import text  # noqa: E402,F401
 from . import device  # noqa: E402,F401
 from . import onnx  # noqa: E402,F401
+from . import utils  # noqa: E402,F401
+from . import version  # noqa: E402,F401
+from . import sysconfig  # noqa: E402,F401
 from . import sparse  # noqa: E402,F401
 from . import fft  # noqa: E402,F401
 from . import signal  # noqa: E402,F401
@@ -186,3 +189,104 @@ def device_guard(*args, **kwargs):
     import contextlib
 
     return contextlib.nullcontext()
+
+
+def iinfo(dtype):
+    import numpy as _np
+
+    from .framework.dtype import convert_dtype
+
+    return _np.iinfo(_np.dtype(convert_dtype(dtype)))
+
+
+def finfo(dtype):
+    import numpy as _np
+    import ml_dtypes as _ml
+
+    from .framework.dtype import convert_dtype
+
+    d = convert_dtype(dtype)
+    try:
+        return _np.finfo(_np.dtype(d))
+    except Exception:  # bfloat16/f8: numpy needs ml_dtypes registration
+        return _ml.finfo(d)
+
+
+class LazyGuard:
+    """paddle.LazyGuard parity: the reference defers parameter materialization
+    to a later .apply(); here parameter init is already cheap/deferred-safe on
+    first use, so the guard is a transparent context manager."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def batch(reader, batch_size, drop_last=False):
+    """paddle.batch (legacy reader combinator)."""
+
+    def batched():
+        b = []
+        for item in reader():
+            b.append(item)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+
+    return batched
+
+
+def in_dynamic_or_pir_mode():
+    return True
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """paddle.flops (python/paddle/hapi/dynamic_flops.py): count MACs of
+    conv/linear layers via a shape-tracing forward."""
+    import numpy as _np
+
+    from .core.tensor import Tensor as _T
+
+    total = {"flops": 0}
+    hooks = []
+
+    def conv_hook(lyr, ins, outs):
+        w = lyr.weight
+        out_elems = 1
+        for d in outs.shape[2:]:
+            out_elems *= int(d)
+        k = 1
+        for d in w.shape[1:]:
+            k *= int(d)
+        total["flops"] += int(outs.shape[0]) * int(w.shape[0]) * k * out_elems
+
+    def linear_hook(lyr, ins, outs):
+        n = 1
+        for d in outs.shape[:-1]:
+            n *= int(d)
+        total["flops"] += n * int(lyr.weight.shape[0]) * int(lyr.weight.shape[1])
+
+    from .nn.layers.common import Linear as _Linear
+    from .nn.layers.conv import Conv2D as _Conv2D
+
+    for _, sub in net.named_sublayers(include_self=False):
+        if isinstance(sub, _Conv2D):
+            hooks.append(sub.register_forward_post_hook(conv_hook))
+        elif isinstance(sub, _Linear):
+            hooks.append(sub.register_forward_post_hook(linear_hook))
+    was_training = net.training
+    net.eval()
+    try:
+        net(_T(_np.zeros(input_size, _np.float32)))
+    finally:
+        for h in hooks:
+            h.remove()
+        if was_training:
+            net.train()
+    if print_detail:
+        print(f"Total FLOPs (MACs): {total['flops']:,}")
+    return total["flops"]

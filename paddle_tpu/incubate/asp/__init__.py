@@ -136,8 +136,15 @@ class ASPOptimizer:
 
     def __init__(self, optimizer):
         self._inner = optimizer
-        own = {id(p) for _, p in optimizer._all_params()}
-        self._masks = {k: v for k, v in _masks.items() if k in own}
+        self._own = {id(p) for _, p in optimizer._all_params()}
+        # masks may be registered AFTER decorate (reference order is
+        # decorate -> prune_model), so filter the registry lazily per step
+        self._snapshot = None
+
+    def _my_masks(self):
+        if self._snapshot is None and _masks:
+            self._snapshot = {k: v for k, v in _masks.items() if k in self._own}
+        return self._snapshot or {}
 
     def __getattr__(self, name):
         return getattr(self._inner, name)
@@ -146,14 +153,14 @@ class ASPOptimizer:
         import jax.numpy as jnp
 
         self._inner.step()
-        for p, mask in self._masks.values():
+        for p, mask in self._my_masks().values():
             p._replace_value(p._value * jnp.asarray(mask, p._value.dtype))
 
     def minimize(self, loss, *a, **kw):
         out = self._inner.minimize(loss, *a, **kw)
         import jax.numpy as jnp
 
-        for p, mask in self._masks.values():
+        for p, mask in self._my_masks().values():
             p._replace_value(p._value * jnp.asarray(mask, p._value.dtype))
         return out
 

@@ -44,3 +44,6 @@ from .layers.rnn import (  # noqa: F401
     BiRNN, GRU, GRUCell, LSTM, LSTMCell, RNN, RNNCellBase, SimpleRNN,
     SimpleRNNCell,
 )
+
+from .layers.common import PairwiseDistance  # noqa: F401,E402
+from . import utils  # noqa: F401,E402

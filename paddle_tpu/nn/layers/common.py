@@ -252,3 +252,25 @@ class Fold(Layer):
 
     def forward(self, x):
         return F.fold(x, self.output_sizes, *self.args)
+
+
+class PairwiseDistance(Layer):
+    """paddle.nn.PairwiseDistance (python/paddle/nn/layer/distance.py)."""
+
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p = p
+        self.epsilon = epsilon
+        self.keepdim = keepdim
+
+    def forward(self, x, y):
+        from ...core.apply import apply
+        from jax import numpy as jnp
+
+        return apply(
+            "pairwise_distance",
+            lambda a, b: jnp.sum(jnp.abs(a - b + self.epsilon) ** self.p, axis=-1, keepdims=self.keepdim)
+            ** (1.0 / self.p),
+            x,
+            y,
+        )

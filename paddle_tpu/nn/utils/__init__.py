@@ -1,0 +1,159 @@
+"""paddle.nn.utils (reference: python/paddle/nn/utils/): weight_norm,
+spectral_norm, parameters_to_vector/vector_to_parameters, clip helpers."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor
+from ..layer import Layer, Parameter
+
+__all__ = [
+    "weight_norm",
+    "remove_weight_norm",
+    "spectral_norm",
+    "parameters_to_vector",
+    "vector_to_parameters",
+    "clip_grad_norm_",
+    "clip_grad_value_",
+]
+
+
+def parameters_to_vector(parameters, name=None) -> Tensor:
+    vals = [p._value.reshape(-1) for p in parameters]
+    return Tensor(jnp.concatenate(vals))
+
+
+def vector_to_parameters(vec: Tensor, parameters, name=None):
+    off = 0
+    v = vec._value
+    for p in parameters:
+        n = int(np.prod(p.shape)) if p.shape else 1
+        p._replace_value(v[off : off + n].reshape(p._value.shape).astype(p._value.dtype))
+        off += n
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=False):
+    """torch-style in-place grad clip (reference: nn/utils/clip_grad_norm_.py)."""
+    params = [p for p in parameters if p.grad is not None]
+    if not params:
+        return Tensor(jnp.zeros(()))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(p.grad._value)) for p in params]))
+    else:
+        total = jnp.sum(
+            jnp.stack([jnp.sum(jnp.abs(p.grad._value.astype(jnp.float32)) ** norm_type) for p in params])
+        ) ** (1.0 / norm_type)
+    if error_if_nonfinite and not bool(jnp.isfinite(total)):
+        raise RuntimeError("grad norm is non-finite; cannot clip")
+    scale = jnp.minimum(1.0, max_norm / jnp.maximum(total, 1e-12))
+    for p in params:
+        p.grad._replace_value((p.grad._value.astype(jnp.float32) * scale).astype(p.grad._value.dtype))
+    return Tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    for p in parameters:
+        if p.grad is not None:
+            p.grad._replace_value(jnp.clip(p.grad._value, -clip_value, clip_value))
+
+
+# ---------------------------------------------------------------------------
+# weight norm: w = g * v / |v|  (reference: nn/utils/weight_norm_hook.py)
+# ---------------------------------------------------------------------------
+
+def _norm_except(v, dim):
+    if dim is None:
+        return jnp.sqrt(jnp.sum(jnp.square(v)))
+    axes = tuple(i for i in range(v.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(jnp.square(v), axis=axes, keepdims=True))
+
+
+def weight_norm(layer: Layer, name="weight", dim=0):
+    """Reparameterize layer.<name> as g * v/|v| via a forward-pre-hook."""
+    w = getattr(layer, name)
+    dim_ = dim
+    g0 = _norm_except(w._value, dim_)
+    v = Parameter(w._value, trainable=not w.stop_gradient, name=(w.name or name) + "_v")
+    g = Parameter(g0, trainable=not w.stop_gradient, name=(w.name or name) + "_g")
+    layer.add_parameter(name + "_v", v)
+    layer.add_parameter(name + "_g", g)
+    # the composed weight is a derived tensor, not a Parameter
+    if name in layer._parameters:
+        del layer._parameters[name]
+
+    def hook(lyr, inputs):
+        from ...core.apply import apply
+
+        def compose(vv, gg):
+            return gg * vv / jnp.maximum(_norm_except(vv, dim_), 1e-12)
+
+        object.__setattr__(lyr, name, apply("weight_norm", compose, v, g))
+        return None
+
+    handle = layer.register_forward_pre_hook(hook)
+    layer._weight_norm_handles = getattr(layer, "_weight_norm_handles", {})
+    layer._weight_norm_handles[name] = (handle, v, g, dim_)
+    hook(layer, None)  # materialize immediately so .weight is usable pre-call
+    return layer
+
+
+def remove_weight_norm(layer: Layer, name="weight"):
+    handles = getattr(layer, "_weight_norm_handles", {})
+    if name not in handles:
+        raise ValueError(f"no weight_norm on parameter {name!r}")
+    handle, v, g, dim_ = handles.pop(name)
+    handle.remove()
+    w = g._value * v._value / jnp.maximum(_norm_except(v._value, dim_), 1e-12)
+    del layer._parameters[name + "_v"]
+    del layer._parameters[name + "_g"]
+    # the hook stored the composed tensor in the instance __dict__, which
+    # shadows _parameters lookups — clear it or the restored weight never trains
+    layer.__dict__.pop(name, None)
+    layer.add_parameter(name, Parameter(w, trainable=not v.stop_gradient, name=name))
+    return layer
+
+
+def spectral_norm(layer: Layer, name="weight", n_power_iterations=1, eps=1e-12, dim=None):
+    """Reparameterize layer.<name> as w / sigma_max(w), sigma estimated by
+    power iteration (reference: nn/utils/spectral_norm_hook.py)."""
+    w = getattr(layer, name)
+    if dim is None:
+        dim = 0
+    shape = tuple(w.shape)
+    h = shape[dim]
+    rng = np.random.RandomState(0)
+    u = Tensor(jnp.asarray(rng.randn(h), jnp.float32))
+    layer.register_buffer(name + "_u", u, persistable=True)
+    orig = Parameter(w._value, trainable=not w.stop_gradient, name=(w.name or name) + "_orig")
+    layer.add_parameter(name + "_orig", orig)
+    if name in layer._parameters:
+        del layer._parameters[name]
+
+    def hook(lyr, inputs):
+        from ...core.apply import apply
+
+        def compose(wv, uv):
+            wm = jnp.moveaxis(wv, dim, 0).reshape(h, -1)
+            uu = uv
+            # n_power_iterations=0 is legal (reuse stored u): vv must exist
+            vv = wm.T @ uu
+            vv = vv / jnp.maximum(jnp.linalg.norm(vv), eps)
+            for _ in range(max(n_power_iterations - 1, 0)):
+                uu = wm @ vv
+                uu = uu / jnp.maximum(jnp.linalg.norm(uu), eps)
+                vv = wm.T @ uu
+                vv = vv / jnp.maximum(jnp.linalg.norm(vv), eps)
+            uu = wm @ vv
+            uu = uu / jnp.maximum(jnp.linalg.norm(uu), eps)
+            sigma = uu @ wm @ vv
+            return wv / jnp.maximum(sigma, eps), uu
+
+        wn, new_u = apply("spectral_norm", compose, orig, getattr(lyr, name + "_u"), n_outputs=2)
+        lyr._buffers[name + "_u"] = Tensor(new_u._value)  # persist power-iter state
+        object.__setattr__(lyr, name, wn)
+        return None
+
+    layer.register_forward_pre_hook(hook)
+    hook(layer, None)
+    return layer

@@ -255,3 +255,26 @@ def pca_lowrank(x, q=None, center=True, niter=2):
         return (u[..., :k], s[..., :k], jnp.swapaxes(vt, -1, -2)[..., :k])
 
     return apply("pca_lowrank", f, x)
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """Randomized low-rank SVD (python/paddle/tensor/linalg.py svd_lowrank)."""
+    from ..framework import random as random_mod
+
+    key = random_mod.next_key()
+
+    def fn(a, *rest):
+        av = a - rest[0] if rest else a
+        m, n = av.shape[-2], av.shape[-1]
+        k = min(q if q is not None else 6, m, n)  # reference: q=None -> min(6, m, n)
+        omega = jax.random.normal(key, av.shape[:-2] + (n, k), av.dtype)
+        y = av @ omega
+        for _ in range(niter):
+            y = av @ (jnp.swapaxes(av, -1, -2) @ y)
+        qmat, _ = jnp.linalg.qr(y)
+        b = jnp.swapaxes(qmat, -1, -2) @ av
+        u, s, vh = jnp.linalg.svd(b, full_matrices=False)
+        return qmat @ u, s, jnp.swapaxes(vh, -1, -2)
+
+    args = [_t(x)] + ([_t(M)] if M is not None else [])
+    return apply("svd_lowrank", fn, *args, n_outputs=3)

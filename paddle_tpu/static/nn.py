@@ -81,9 +81,18 @@ def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
     elif mode == "channel":
         num = int(x.shape[1 if data_format == "NCHW" else -1])
     elif mode == "element":
-        num = 1
-        for d in x.shape[1:]:
-            num *= int(d)
+        # per-element alpha: build directly (PReLU's flat vector reshapes
+        # onto the channel axis only, which cannot express element mode)
+        import numpy as _np
+
+        from ..core.apply import apply
+        from ..core.tensor import Tensor
+        from ..nn.layer import Parameter
+        from jax import numpy as jnp
+
+        shape = tuple(int(d) for d in x.shape[1:])
+        alpha = Parameter(_np.full(shape, 0.25, _np.float32), name="prelu_alpha")
+        return apply("prelu_element", lambda v, a: jnp.where(v >= 0, v, a[None] * v), x, alpha)
     else:
         raise ValueError(f"prelu mode must be all/channel/element, got {mode!r}")
     return nn.PReLU(num_parameters=num, data_format=data_format)(x)

@@ -1,0 +1,37 @@
+"""paddle.utils namespace (reference: python/paddle/utils/)."""
+from . import unique_name  # noqa: F401
+from .deprecated import deprecated  # noqa: F401
+from .lazy_import import try_import  # noqa: F401
+from . import download  # noqa: F401
+from . import cpp_extension  # noqa: F401
+
+
+def run_check():
+    """paddle.utils.run_check parity: verify the install can compute."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    x = paddle.to_tensor(np.ones((2, 2), "float32"))
+    y = (x @ x).numpy()
+    assert float(y.sum()) == 8.0
+    n = paddle.device.device_count()
+    print(f"PaddlePaddle(TPU) works! devices available: {n}")
+    return True
+
+
+def flatten(nest):
+    out = []
+
+    def rec(o):
+        if isinstance(o, (list, tuple)):
+            for i in o:
+                rec(i)
+        elif isinstance(o, dict):
+            for v in o.values():
+                rec(v)
+        else:
+            out.append(o)
+
+    rec(nest)
+    return out

@@ -1,0 +1,21 @@
+"""paddle.utils.cpp_extension surface.
+
+Reference: python/paddle/utils/cpp_extension/ builds user CUDA/C++ ops with
+pybind11+nvcc. The TPU-native custom-op path is (a) pure jax functions via
+`paddle_tpu.core.apply` and (b) Pallas kernels (see ops/pallas.py); C++ host
+extensions use ctypes against a plain C ABI like paddle_tpu/native.
+"""
+from __future__ import annotations
+
+
+def load(name, sources, **kwargs):
+    raise NotImplementedError(
+        "cpp_extension.load (pybind11/nvcc custom ops) does not apply on TPU. "
+        "Write the op as a jax/Pallas function and register it with "
+        "paddle_tpu.core.apply, or build a ctypes C ABI library like "
+        "paddle_tpu/native (see its __init__ for the g++ build recipe)."
+    )
+
+
+def setup(**kwargs):
+    raise NotImplementedError("see cpp_extension.load message")

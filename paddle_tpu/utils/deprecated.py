@@ -1,0 +1,32 @@
+"""@deprecated decorator (reference: python/paddle/utils/deprecated.py)."""
+from __future__ import annotations
+
+import functools
+import warnings
+
+
+def deprecated(update_to="", since="", reason="", level=1):
+    def decorator(fn):
+        msg = f"API {fn.__module__}.{fn.__name__} is deprecated"
+        if since:
+            msg += f" since {since}"
+        if update_to:
+            msg += f"; use {update_to} instead"
+        if reason:
+            msg += f". Reason: {reason}"
+        if level == 2:
+            @functools.wraps(fn)
+            def dead(*a, **kw):
+                raise RuntimeError(msg)
+
+            return dead
+
+        @functools.wraps(fn)
+        def wrapper(*a, **kw):
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*a, **kw)
+
+        wrapper.__doc__ = (fn.__doc__ or "") + f"\n\n.. deprecated:: {msg}"
+        return wrapper
+
+    return decorator

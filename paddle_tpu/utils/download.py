@@ -1,0 +1,28 @@
+"""get_weights_path_from_url (reference: python/paddle/utils/download.py).
+
+This image has no network egress; only already-cached local files resolve.
+"""
+from __future__ import annotations
+
+import os
+
+WEIGHTS_HOME = os.path.expanduser("~/.cache/paddle_tpu/weights")
+
+
+def get_weights_path_from_url(url, md5sum=None):
+    fname = os.path.basename(url)
+    path = os.path.join(WEIGHTS_HOME, fname)
+    if os.path.exists(path):
+        return path
+    raise RuntimeError(
+        f"no network egress in this environment and {path!r} is not cached; "
+        "place the weights file there manually"
+    )
+
+
+def get_path_from_url(url, root_dir, md5sum=None, check_exist=True):
+    fname = os.path.basename(url)
+    path = os.path.join(root_dir, fname)
+    if os.path.exists(path):
+        return path
+    raise RuntimeError(f"no network egress; expected {path!r} to exist locally")

@@ -1,0 +1,11 @@
+"""try_import (reference: python/paddle/utils/lazy_import.py)."""
+from __future__ import annotations
+
+import importlib
+
+
+def try_import(module_name, err_msg=None):
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(err_msg or f"require additional dependency {module_name!r}; it is not installed in this image")

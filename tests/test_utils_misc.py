@@ -1,0 +1,178 @@
+"""paddle.utils / nn.utils / version / flops / misc top-level APIs."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.nn import utils as nn_utils
+from paddle_tpu.utils import unique_name
+
+
+def test_unique_name_generate_and_guard():
+    a = unique_name.generate("fc")
+    b = unique_name.generate("fc")
+    assert a != b and a.startswith("fc_")
+    with unique_name.guard("prefix_"):
+        c = unique_name.generate("fc")
+        assert c.startswith("prefix_fc_")
+    d = unique_name.generate("fc")
+    assert not d.startswith("prefix_")
+
+
+def test_deprecated_decorator():
+    from paddle_tpu.utils import deprecated
+
+    @deprecated(update_to="paddle.new_api", since="2.0")
+    def old_api():
+        return 42
+
+    with pytest.warns(DeprecationWarning):
+        assert old_api() == 42
+
+
+def test_run_check_and_try_import():
+    assert paddle.utils.run_check()
+    np_mod = paddle.utils.try_import("numpy")
+    assert np_mod is np
+    with pytest.raises(ImportError):
+        paddle.utils.try_import("definitely_not_a_module_xyz")
+
+
+def test_parameters_vector_roundtrip():
+    net = paddle.nn.Linear(3, 4)
+    vec = nn_utils.parameters_to_vector(net.parameters())
+    assert tuple(vec.shape) == (16,)
+    new = paddle.to_tensor(np.arange(16, dtype="float32"))
+    nn_utils.vector_to_parameters(new, net.parameters())
+    np.testing.assert_allclose(net.weight.numpy().reshape(-1), np.arange(12))
+    np.testing.assert_allclose(net.bias.numpy(), [12, 13, 14, 15])
+
+
+def test_clip_grad_norm_inplace():
+    net = paddle.nn.Linear(4, 4)
+    (net(paddle.ones([2, 4])) * 100).sum().backward()
+    total = nn_utils.clip_grad_norm_(net.parameters(), max_norm=1.0)
+    assert float(total.numpy()) > 1.0  # pre-clip norm was large
+    g = np.concatenate([p.grad.numpy().reshape(-1) for p in net.parameters()])
+    assert np.linalg.norm(g) <= 1.0 + 1e-5
+
+
+def test_weight_norm_and_remove():
+    net = paddle.nn.Linear(4, 3)
+    w0 = net.weight.numpy().copy()
+    nn_utils.weight_norm(net, "weight", dim=0)
+    assert "weight_v" in dict(net.named_parameters(include_sublayers=False))
+    out = net(paddle.ones([1, 4]))
+    # composed weight equals original at init (g initialized to |v|)
+    np.testing.assert_allclose(net.weight.numpy(), w0, rtol=1e-5, atol=1e-6)
+    # g scales rows
+    out.sum().backward()
+    assert net.weight_g.grad is not None
+    nn_utils.remove_weight_norm(net, "weight")
+    np.testing.assert_allclose(net.weight.numpy(), w0, rtol=1e-5, atol=1e-6)
+
+
+def test_spectral_norm_limits_sigma():
+    net = paddle.nn.Linear(6, 6)
+    net.weight._replace_value(net.weight._value * 50.0)  # huge spectral norm
+    nn_utils.spectral_norm(net, "weight", n_power_iterations=5)
+    w = net.weight.numpy()
+    sigma = np.linalg.svd(w, compute_uv=False).max()
+    assert abs(sigma - 1.0) < 0.05
+
+
+def test_version_and_sysconfig():
+    assert paddle.version.full_version == paddle.__version__
+    assert paddle.version.cuda() == "False"
+    import os
+
+    assert os.path.isdir(paddle.sysconfig.get_include())
+
+
+def test_iinfo_finfo():
+    assert paddle.iinfo("int32").max == 2**31 - 1
+    assert paddle.finfo("float32").eps < 1e-6
+    assert paddle.finfo("bfloat16").bits == 16
+
+
+def test_batch_and_lazyguard():
+    def reader():
+        yield from range(7)
+
+    batches = list(paddle.batch(reader, 3)())
+    assert batches == [[0, 1, 2], [3, 4, 5], [6]]
+    assert list(paddle.batch(reader, 3, drop_last=True)()) == [[0, 1, 2], [3, 4, 5]]
+    with paddle.LazyGuard():
+        net = paddle.nn.Linear(2, 2)
+    assert net.weight is not None
+
+
+def test_flops_counts_macs():
+    net = paddle.nn.Sequential(paddle.nn.Conv2D(3, 8, 3, padding=1), paddle.nn.ReLU(), paddle.nn.Linear(8, 4))
+    # conv: 1*8*(3*3*3)*(8*8); run conv only via custom net to keep shapes simple
+    conv = paddle.nn.Conv2D(3, 8, 3, padding=1)
+    n = paddle.flops(paddle.nn.Sequential(conv), (1, 3, 8, 8))
+    assert n == 8 * 27 * 64
+
+
+def test_pairwise_distance_and_svd_lowrank():
+    pd = paddle.nn.PairwiseDistance(p=2.0)
+    a = paddle.to_tensor(np.array([[0.0, 0.0], [1.0, 1.0]], "float32"))
+    b = paddle.to_tensor(np.array([[3.0, 4.0], [1.0, 1.0]], "float32"))
+    d = pd(a, b).numpy()
+    np.testing.assert_allclose(d, [5.0, 0.0], atol=1e-4)
+
+    x = np.random.RandomState(0).randn(20, 10).astype("float32")
+    x = x @ np.diag([10, 5, 2] + [1e-3] * 7).astype("float32")  # approx rank 3
+    u, s, v = paddle.linalg.svd_lowrank(paddle.to_tensor(x), q=4)
+    full_s = np.linalg.svd(x, compute_uv=False)
+    np.testing.assert_allclose(s.numpy()[:3], full_s[:3], rtol=0.05)
+
+
+def test_asp_decorate_before_prune_order():
+    from paddle_tpu.incubate import asp
+
+    net = paddle.nn.Linear(16, 8)
+    opt = asp.decorate(paddle.optimizer.SGD(0.1, parameters=net.parameters()))
+    asp.prune_model(net)  # reference order: decorate first, prune second
+    net(paddle.ones([2, 16])).sum().backward()
+    opt.step()
+    opt.clear_grad()
+    assert asp.check_mask_1d(net.weight.numpy())
+
+
+def test_remove_weight_norm_weight_trains():
+    from paddle_tpu.nn import utils as nn_utils
+
+    net = paddle.nn.Linear(4, 3)
+    nn_utils.weight_norm(net, "weight")
+    nn_utils.remove_weight_norm(net, "weight")
+    opt = paddle.optimizer.SGD(0.5, parameters=net.parameters())
+    w0 = net.weight.numpy().copy()
+    net(paddle.ones([1, 4])).sum().backward()
+    opt.step()
+    assert not np.allclose(net.weight.numpy(), w0)  # restored weight trains
+
+
+def test_spectral_norm_zero_power_iters():
+    from paddle_tpu.nn import utils as nn_utils
+
+    net = paddle.nn.Linear(4, 4)
+    nn_utils.spectral_norm(net, "weight", n_power_iterations=0)
+    assert np.isfinite(net.weight.numpy()).all()
+
+
+def test_svd_lowrank_q_none():
+    x = paddle.to_tensor(np.random.RandomState(0).randn(8, 5).astype("float32"))
+    u, s, v = paddle.linalg.svd_lowrank(x, q=None)
+    assert s.shape[0] == 5
+
+
+def test_static_nn_prelu_element_mode():
+    from paddle_tpu import static
+
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        x = static.data("x", [2, 3, 4, 4], "float32")
+        y = static.nn.prelu(x, mode="element")
+    out = static.Executor().run(main, feed={"x": -np.ones((2, 3, 4, 4), "float32")}, fetch_list=[y])[0]
+    np.testing.assert_allclose(out, -0.25)
