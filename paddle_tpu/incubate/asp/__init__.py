@@ -14,9 +14,10 @@ import numpy as np
 from ...core.tensor import Tensor
 from ...nn.layer import Layer
 
-# masks for the most recent prune_model call; decorate() snapshots them so
-# each decorated optimizer only ever touches the model it was built for
+# masks for the most recent prune_model call; decorated optimizers filter
+# this registry for the params they own, re-reading whenever it changes
 _masks: dict = {}  # id(param) -> (param, mask ndarray)
+_masks_version = [0]  # bumped by prune_model so optimizers drop stale views
 
 __all__ = [
     "calculate_density",
@@ -115,6 +116,7 @@ def prune_model(model: Layer, n=2, m=4, mask_algo="mask_1d", with_mask=True):
     import jax.numpy as jnp
 
     _masks.clear()  # masks belong to this model until the next prune
+    _masks_version[0] += 1
     pruned = {}
     for name, p in model.named_parameters():
         if p.stop_gradient or len(p.shape) < 2 or int(p.shape[-1]) % m:
@@ -138,13 +140,16 @@ class ASPOptimizer:
         self._inner = optimizer
         self._own = {id(p) for _, p in optimizer._all_params()}
         # masks may be registered AFTER decorate (reference order is
-        # decorate -> prune_model), so filter the registry lazily per step
-        self._snapshot = None
+        # decorate -> prune_model) and re-registered by later prunes, so the
+        # view follows the registry's version rather than caching forever
+        self._snapshot = {}
+        self._seen_version = -1
 
     def _my_masks(self):
-        if self._snapshot is None and _masks:
+        if self._seen_version != _masks_version[0]:
             self._snapshot = {k: v for k, v in _masks.items() if k in self._own}
-        return self._snapshot or {}
+            self._seen_version = _masks_version[0]
+        return self._snapshot
 
     def __getattr__(self, name):
         return getattr(self._inner, name)

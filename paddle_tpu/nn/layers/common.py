@@ -267,10 +267,16 @@ class PairwiseDistance(Layer):
         from ...core.apply import apply
         from jax import numpy as jnp
 
-        return apply(
-            "pairwise_distance",
-            lambda a, b: jnp.sum(jnp.abs(a - b + self.epsilon) ** self.p, axis=-1, keepdims=self.keepdim)
-            ** (1.0 / self.p),
-            x,
-            y,
-        )
+        p, keepdim, eps = self.p, self.keepdim, self.epsilon
+
+        def fn(a, b):
+            d = jnp.abs(a - b + eps)
+            if p == float("inf"):
+                return jnp.max(d, axis=-1, keepdims=keepdim)
+            if p == float("-inf"):
+                return jnp.min(d, axis=-1, keepdims=keepdim)
+            if p == 0:
+                return jnp.sum((d != 0).astype(d.dtype), axis=-1, keepdims=keepdim)
+            return jnp.sum(d**p, axis=-1, keepdims=keepdim) ** (1.0 / p)
+
+        return apply("pairwise_distance", fn, x, y)

@@ -86,7 +86,6 @@ def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
         import numpy as _np
 
         from ..core.apply import apply
-        from ..core.tensor import Tensor
         from ..nn.layer import Parameter
         from jax import numpy as jnp
 

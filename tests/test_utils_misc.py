@@ -107,8 +107,7 @@ def test_batch_and_lazyguard():
 
 
 def test_flops_counts_macs():
-    net = paddle.nn.Sequential(paddle.nn.Conv2D(3, 8, 3, padding=1), paddle.nn.ReLU(), paddle.nn.Linear(8, 4))
-    # conv: 1*8*(3*3*3)*(8*8); run conv only via custom net to keep shapes simple
+    # conv MACs: out_c=8 * k=3*3*3 * out_hw=8*8
     conv = paddle.nn.Conv2D(3, 8, 3, padding=1)
     n = paddle.flops(paddle.nn.Sequential(conv), (1, 3, 8, 8))
     assert n == 8 * 27 * 64
@@ -176,3 +175,28 @@ def test_static_nn_prelu_element_mode():
         y = static.nn.prelu(x, mode="element")
     out = static.Executor().run(main, feed={"x": -np.ones((2, 3, 4, 4), "float32")}, fetch_list=[y])[0]
     np.testing.assert_allclose(out, -0.25)
+
+
+def test_asp_reprune_updates_optimizer_masks():
+    from paddle_tpu.incubate import asp
+
+    net = paddle.nn.Linear(16, 8)
+    opt = asp.decorate(paddle.optimizer.SGD(0.1, parameters=net.parameters()))
+    asp.prune_model(net)
+    net(paddle.ones([2, 16])).sum().backward()
+    opt.step(); opt.clear_grad()
+    mask1 = net.weight.numpy() != 0
+    # retrain dense-ish then re-prune: optimizer must follow the NEW mask
+    net.weight._replace_value(net.weight._value + 1.0)  # perturb pattern
+    asp.prune_model(net)
+    mask2 = net.weight.numpy() != 0
+    net(paddle.ones([2, 16])).sum().backward()
+    opt.step(); opt.clear_grad()
+    assert ((net.weight.numpy() != 0) == mask2).all()
+
+
+def test_pairwise_distance_inf_order():
+    pd = paddle.nn.PairwiseDistance(p=float("inf"), epsilon=0.0)
+    a = paddle.to_tensor(np.array([[0.0, 0.0], [1.0, 1.0]], "float32"))
+    b = paddle.to_tensor(np.array([[3.0, 4.0], [1.0, 1.0]], "float32"))
+    np.testing.assert_allclose(pd(a, b).numpy(), [4.0, 0.0], atol=1e-6)
