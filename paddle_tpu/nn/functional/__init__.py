@@ -74,3 +74,13 @@ from .pooling import (  # noqa: F401
     max_pool2d,
     max_pool3d,
 )
+
+from .common import (  # noqa: F401,E402
+    affine_grid,
+    grid_sample,
+    gumbel_softmax,
+    sequence_mask,
+    temporal_shift,
+)
+from .loss import dice_loss, npair_loss  # noqa: F401,E402
+from .search_ops_addendum import gather_tree  # noqa: F401,E402

@@ -342,3 +342,156 @@ def bilinear(x1, x2, weight, bias=None, name=None):
     if bias is not None:
         args.append(_t(bias))
     return apply("bilinear", f, *args)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    """python/paddle/nn/functional/activation.py gumbel_softmax."""
+    key = random_mod.next_key()
+
+    def fn(v):
+        u = jax.random.uniform(key, v.shape, jnp.float32, 1e-10, 1.0)
+        g = -jnp.log(-jnp.log(u))
+        y = jax.nn.softmax((v.astype(jnp.float32) + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            onehot = jnp.zeros_like(y)
+            onehot = jnp.put_along_axis(onehot, idx, 1.0, axis=axis, inplace=False)
+            # straight-through: hard forward, soft gradient
+            y = y + jax.lax.stop_gradient(onehot - y)
+        return y.astype(v.dtype)
+
+    return apply("gumbel_softmax", fn, _t(x))
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """python/paddle/nn/functional/extension.py sequence_mask: [..., maxlen]
+    with mask[..., j] = j < x[...]."""
+    from ...framework.dtype import convert_dtype
+
+    x = _t(x)
+    if maxlen is None:
+        import numpy as _np
+
+        # data-dependent output shape: must concretize on host (same
+        # constraint as the reference's dynamic-shape op); under tracing
+        # callers must pass maxlen explicitly
+        import jax.core as _jcore
+
+        if isinstance(x._value, _jcore.Tracer):
+            raise ValueError("sequence_mask: maxlen must be given under jit/to_static (output shape is data-dependent)")
+        maxlen = int(_np.asarray(jnp.max(x._value)))
+    m = int(maxlen)
+
+    def fn(v):
+        r = jnp.arange(m)
+        return (r[None, :] < v.reshape(-1, 1)).reshape(tuple(v.shape) + (m,)).astype(convert_dtype(dtype))
+
+    return apply("sequence_mask", fn, x)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
+    """python/paddle/nn/functional/extension.py temporal_shift (TSM)."""
+
+    def fn(v):
+        if data_format == "NHWC":
+            v = jnp.transpose(v, (0, 3, 1, 2))
+        nt, c, h, w = v.shape
+        n = nt // seg_num
+        v5 = v.reshape(n, seg_num, c, h, w)
+        c1 = int(c * shift_ratio)
+        c2 = int(c * 2 * shift_ratio)
+        fwd = jnp.pad(v5[:, 1:, :c1], ((0, 0), (0, 1), (0, 0), (0, 0), (0, 0)))
+        bwd = jnp.pad(v5[:, :-1, c1:c2], ((0, 0), (1, 0), (0, 0), (0, 0), (0, 0)))
+        keep = v5[:, :, c2:]
+        out = jnp.concatenate([fwd, bwd, keep], axis=2).reshape(nt, c, h, w)
+        if data_format == "NHWC":
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
+
+    return apply("temporal_shift", fn, _t(x))
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros", align_corners=True, name=None):
+    """python/paddle/nn/functional/vision.py grid_sample (NCHW, 4-D)."""
+
+    def fn(v, g):
+        n, c, h, w = v.shape
+        gx, gy = g[..., 0], g[..., 1]  # [-1, 1]
+        if align_corners:
+            fx = (gx + 1) * (w - 1) / 2
+            fy = (gy + 1) * (h - 1) / 2
+        else:
+            fx = ((gx + 1) * w - 1) / 2
+            fy = ((gy + 1) * h - 1) / 2
+        def reflect(coord, size):
+            # reflect about the edges (align_corners=True convention)
+            span = 2 * (size - 1) if size > 1 else 1
+            r = jnp.abs(jnp.mod(coord, span))
+            return jnp.where(r > size - 1, span - r, r)
+
+        if mode == "nearest":
+            xi = jnp.round(fx)
+            yi = jnp.round(fy)
+            valid = (xi >= 0) & (xi < w) & (yi >= 0) & (yi < h)
+            if padding_mode == "reflection":
+                xi = reflect(xi, w)
+                yi = reflect(yi, h)
+            xi = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+            yi = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+            out = jax.vmap(lambda img, yy, xx: img[:, yy, xx])(v, yi, xi)
+            if padding_mode == "zeros":
+                out = out * valid[:, None].astype(v.dtype)
+            return out
+        x0 = jnp.floor(fx)
+        y0 = jnp.floor(fy)
+        wx = fx - x0
+        wy = fy - y0
+
+        def tap(img, yy, xx):
+            valid = (xx >= 0) & (xx < w) & (yy >= 0) & (yy < h)
+            if padding_mode == "reflection":
+                yy = reflect(yy, h)
+                xx = reflect(xx, w)
+            yi = jnp.clip(yy.astype(jnp.int32), 0, h - 1)
+            xi = jnp.clip(xx.astype(jnp.int32), 0, w - 1)
+            s = img[:, yi, xi]  # [c, gh, gw]
+            if padding_mode == "zeros":
+                s = s * valid[None].astype(img.dtype)
+            return s
+
+        def one(img, yy0, xx0, wyy, wxx):
+            a = tap(img, yy0, xx0)
+            b = tap(img, yy0, xx0 + 1)
+            cc = tap(img, yy0 + 1, xx0)
+            d = tap(img, yy0 + 1, xx0 + 1)
+            return (
+                a * (1 - wyy) * (1 - wxx)
+                + b * (1 - wyy) * wxx
+                + cc * wyy * (1 - wxx)
+                + d * wyy * wxx
+            )
+
+        return jax.vmap(one)(v, y0, x0, wy, wx)
+
+    return apply("grid_sample", fn, _t(x), _t(grid))
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """python/paddle/nn/functional/vision.py affine_grid (2D)."""
+
+    def fn(t):
+        n, _, _ = t.shape
+        _, _, h, w = [int(d) for d in out_shape]
+        if align_corners:
+            ys = jnp.linspace(-1, 1, h)
+            xs = jnp.linspace(-1, 1, w)
+        else:
+            ys = (jnp.arange(h) * 2 + 1) / h - 1
+            xs = (jnp.arange(w) * 2 + 1) / w - 1
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1).reshape(-1, 3)  # [h*w, 3]
+        out = jnp.einsum("nij,pj->npi", t, base)  # [n, h*w, 2]
+        return out.reshape(n, h, w, 2)
+
+    return apply("affine_grid", fn, _t(theta))

@@ -345,3 +345,30 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0, reduction
         return _reduce(loss, reduction)
 
     return apply("ctc_loss", f, lp, lab, ilen, llen)
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):  # noqa: A002
+    """python/paddle/nn/functional/loss.py dice_loss."""
+
+    def fn(p, l):
+        lf = jax.nn.one_hot(l.squeeze(-1), p.shape[-1], dtype=p.dtype) if l.shape[-1] == 1 else l.astype(p.dtype)
+        reduce_dims = tuple(range(1, p.ndim))
+        inter = jnp.sum(p * lf, axis=reduce_dims)
+        union = jnp.sum(p, axis=reduce_dims) + jnp.sum(lf, axis=reduce_dims)
+        return jnp.mean(1 - 2 * inter / (union + epsilon))  # reference formula
+
+    return apply("dice_loss", fn, _t(input), _t(label))
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    """python/paddle/nn/functional/loss.py npair_loss."""
+
+    def fn(a, p, l):
+        reg = l2_reg * (jnp.sum(a * a) + jnp.sum(p * p)) / a.shape[0] * 0.25
+        sim = a @ p.T  # [B, B]
+        same = (l[:, None] == l[None, :]).astype(a.dtype)
+        tgt = same / jnp.sum(same, axis=1, keepdims=True)
+        ce = -jnp.sum(tgt * jax.nn.log_softmax(sim, axis=1), axis=1)
+        return jnp.mean(ce) + reg
+
+    return apply("npair_loss", fn, _t(anchor), _t(positive), _t(labels))

@@ -1,0 +1,28 @@
+"""gather_tree (reference: python/paddle/nn/functional/extension.py) — beam
+search ancestry walk as a reverse lax.scan."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.apply import apply_nograd
+from ...core.tensor import Tensor
+
+
+def gather_tree(ids, parents):
+    """[T, B, beam] step ids + parent indices -> full beam paths."""
+
+    def fn(idv, pv):
+        t, b, k = idv.shape
+        last = jnp.broadcast_to(jnp.arange(k)[None, :], (b, k))
+
+        def step(carry, xs):
+            id_t, par_t = xs
+            picked = jnp.take_along_axis(id_t, carry, axis=1)
+            nxt = jnp.take_along_axis(par_t, carry, axis=1)
+            return nxt, picked
+
+        _, ys = jax.lax.scan(step, last, (idv, pv), reverse=True)
+        return ys
+
+    return apply_nograd("gather_tree", fn, ids if isinstance(ids, Tensor) else Tensor(ids), parents if isinstance(parents, Tensor) else Tensor(parents))

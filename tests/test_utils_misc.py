@@ -200,3 +200,50 @@ def test_pairwise_distance_inf_order():
     a = paddle.to_tensor(np.array([[0.0, 0.0], [1.0, 1.0]], "float32"))
     b = paddle.to_tensor(np.array([[3.0, 4.0], [1.0, 1.0]], "float32"))
     np.testing.assert_allclose(pd(a, b).numpy(), [4.0, 0.0], atol=1e-6)
+
+
+def test_new_functional_ops():
+    import paddle_tpu.nn.functional as F
+
+    # gumbel_softmax: rows sum to 1; hard gives one-hot forward
+    paddle.seed(0)
+    logits = paddle.to_tensor(np.random.RandomState(0).randn(4, 5).astype("float32"))
+    g = F.gumbel_softmax(logits, temperature=0.5).numpy()
+    np.testing.assert_allclose(g.sum(-1), 1.0, rtol=1e-5)
+    gh = F.gumbel_softmax(logits, hard=True).numpy()
+    assert np.isclose(gh, 0.0).sum() == gh.size - gh.shape[0]  # one-hot rows
+    assert np.allclose(gh.max(-1), 1.0) and np.allclose(gh.sum(-1), 1.0)
+
+    # sequence_mask
+    m = F.sequence_mask(paddle.to_tensor(np.array([1, 3], "int64")), maxlen=4).numpy()
+    np.testing.assert_array_equal(m, [[1, 0, 0, 0], [1, 1, 1, 0]])
+
+    # grid_sample identity grid reproduces the image
+    img = paddle.to_tensor(np.random.RandomState(1).randn(1, 2, 5, 5).astype("float32"))
+    theta = paddle.to_tensor(np.array([[[1.0, 0, 0], [0, 1.0, 0]]], "float32"))
+    grid = F.affine_grid(theta, [1, 2, 5, 5], align_corners=True)
+    out = F.grid_sample(img, grid, align_corners=True)
+    np.testing.assert_allclose(out.numpy(), img.numpy(), atol=1e-5)
+
+    # dice loss perfect prediction ~ 0
+    pred = paddle.to_tensor(np.eye(4, dtype="float32")[None])
+    lbl = paddle.to_tensor(np.arange(4, dtype="int64")[None, :, None])
+    dl = float(F.dice_loss(pred, lbl).numpy())
+    assert dl < 0.01
+
+    # temporal_shift shape-preserving
+    x = paddle.to_tensor(np.random.RandomState(2).randn(4, 8, 3, 3).astype("float32"))
+    ts = F.temporal_shift(x, seg_num=2, shift_ratio=0.25)
+    assert tuple(ts.shape) == (4, 8, 3, 3)
+
+    # gather_tree walks parents
+    ids = paddle.to_tensor(np.array([[[2, 5]], [[3, 6]]], "int64"))  # [T=2,B=1,beam=2]
+    parents = paddle.to_tensor(np.array([[[0, 0]], [[1, 0]]], "int64"))
+    paths = F.gather_tree(ids, parents).numpy()
+    assert paths.shape == (2, 1, 2)
+
+    # npair loss runs and is finite
+    a = paddle.to_tensor(np.random.RandomState(3).randn(4, 8).astype("float32"))
+    p = paddle.to_tensor(np.random.RandomState(4).randn(4, 8).astype("float32"))
+    l = paddle.to_tensor(np.array([0, 0, 1, 1], "int64"))
+    assert np.isfinite(float(F.npair_loss(a, p, l).numpy()))
