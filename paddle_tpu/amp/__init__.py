@@ -190,6 +190,10 @@ class GradScaler:
         # pre-step value when inf was found. Equivalent to skipping the step
         # (paddle semantics) while staying fully traceable under capture —
         # no host sync on found_inf.
+        # force lazily-built state (fused buckets) into existence BEFORE the
+        # snapshot, so checkpoint-loaded values consumed by bucket creation
+        # inside step() are captured and restored on skip
+        getattr(optimizer, "_materialize_state", lambda: None)()
         params = [p for _, p in optimizer._all_params()]
         old_params = {id(p): p._value for p in params}
         old_accs = {
@@ -198,6 +202,8 @@ class GradScaler:
                 (n, {k: t._value for k, t in s.items()}) for n, s in optimizer._accumulators.items()
             )
         }
+        fused_entries = getattr(optimizer, "_fused_state_entries", lambda: [])()
+        old_fused = {id(t): t._value for t, _ in fused_entries}
         old_step = optimizer._step_count._value
         optimizer.step()
         found = self._found_inf._value
@@ -212,6 +218,12 @@ class GradScaler:
                     # accumulator born inside this step: pre-step value is its fill
                     old = jnp.full(t._value.shape, fill, t._value.dtype)
                 t._replace_value(jnp.where(found, old, t._value))
+        # fused flat buckets (possibly born inside this step)
+        for t, fill in getattr(optimizer, "_fused_state_entries", lambda: [])():
+            old = old_fused.get(id(t))
+            if old is None:
+                old = jnp.full(t._value.shape, fill, t._value.dtype)
+            t._replace_value(jnp.where(found, old, t._value))
         optimizer._step_count._replace_value(jnp.where(found, old_step, optimizer._step_count._value))
 
     def minimize(self, optimizer, scaled_loss):

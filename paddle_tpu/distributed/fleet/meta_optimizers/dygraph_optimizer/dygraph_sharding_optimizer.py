@@ -17,6 +17,8 @@ from ...meta_parallel.sharding import group_sharded_utils as utils
 class DygraphShardingOptimizer:
     def __init__(self, optimizer, hcg=None, **kw):
         self._inner_opt = optimizer
+        # ZeRO shards per-accumulator; the flat fused path would hide them
+        optimizer._fuse_allowed = False
         self._hcg = hcg
         if hcg is not None and "sharding" in hcg.mesh.shape:
             self._mesh, self._axis = hcg.mesh, "sharding"

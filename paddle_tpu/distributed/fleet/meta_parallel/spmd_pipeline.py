@@ -11,9 +11,18 @@ All stages stay busy once the pipeline fills (GPipe-style fill/drain of a
 circular schedule; 1F1B's memory benefit is obtained by jax.checkpoint on
 the stage function + reverse-mode through the scan).
 
-Requirements: every stage has the same structure (stage_fn), per-stage
-params stacked on a leading axis sharded over pp, activation shape = input
-micro-batch shape.
+Two schedules:
+- pipeline_spmd: one stage per pp rank, bubble = (pp-1)/(M+pp-1).
+- pipeline_spmd_interleave: the VPP analog (reference
+  PipelineParallelWithInterleave, pipeline_parallel.py:942) — v virtual
+  stage chunks per rank assigned round-robin (rank d owns chunks d, d+pp,
+  d+2*pp, ...), micro-batches wrap the ring v times. The per-wrap chunk is
+  1/v-th the work, so the fill/drain bubble time shrinks by ~v, the same
+  bubble economics that motivate VPP on GPUs.
+
+Requirements: every stage (chunk) has the same structure (stage_fn), with
+per-stage params stacked on a leading axis sharded over pp; activations may
+be arbitrary pytrees but each leaf keeps one shape across stage boundaries.
 """
 from __future__ import annotations
 
@@ -25,36 +34,48 @@ from jax import numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+def _tree_where(pred, a, b):
+    return jax.tree_util.tree_map(lambda x, y: jnp.where(pred, x, y), a, b)
+
+
+def _tree_index(tree, i):
+    return jax.tree_util.tree_map(lambda x: x[i], tree)
+
+
 def pipeline_spmd(stage_fn: Callable, mesh: Mesh, axis: str = "pp", checkpoint_stages: bool = True):
     """Build fn(stacked_params, microbatches) -> outputs.
 
-    stage_fn(params, x) -> y: one stage's computation, y.shape == x.shape.
+    stage_fn(params, x) -> y: one stage's computation; x/y are pytrees whose
+    leaves keep their shapes across stages.
     stacked_params: pytree with leading stage axis S (sharded over `axis`).
-    microbatches: [M, ...] micro-batch stream (replicated over `axis`).
-    Returns [M, ...] outputs of the final stage.
+    microbatches: pytree of [M, ...] micro-batch streams (replicated).
+    Returns the final stage's outputs, each leaf [M, ...].
     """
     S = mesh.shape[axis]
     fn = jax.checkpoint(stage_fn) if checkpoint_stages else stage_fn
 
     def per_device(params, mbs):
-        # params leaves: [1, ...] local stage slice; mbs: [M, ...] full stream
-        params = jax.tree_util.tree_map(lambda p: p[0], params)
+        # params leaves: [1, ...] local stage slice; mbs leaves: [M, ...]
+        params = _tree_index(params, 0)
         sidx = jax.lax.axis_index(axis)
-        M = mbs.shape[0]
+        leaves = jax.tree_util.tree_leaves(mbs)
+        M = leaves[0].shape[0]
         fwd_perm = [(s, (s + 1) % S) for s in range(S)]
 
         def step(carry, t):
             buf = carry
             # stage 0 ingests micro-batch t (clipped during drain)
-            feed = mbs[jnp.clip(t, 0, M - 1)]
-            x = jnp.where(sidx == 0, feed, buf)
+            feed = _tree_index(mbs, jnp.clip(t, 0, M - 1))
+            x = _tree_where(sidx == 0, feed, buf)
             y = fn(params, x)
-            shifted = jax.lax.ppermute(y, axis, fwd_perm)
+            shifted = jax.tree_util.tree_map(
+                lambda l: jax.lax.ppermute(l, axis, fwd_perm), y
+            )
             return shifted, y
 
-        init = jnp.zeros_like(mbs[0])
+        init = jax.tree_util.tree_map(jnp.zeros_like, _tree_index(mbs, 0))
         _, ys = jax.lax.scan(step, init, jnp.arange(M + S - 1))
-        return ys[None]  # [1, T, ...] per device -> [S, T, ...] global
+        return jax.tree_util.tree_map(lambda l: l[None], ys)  # [1, T, ...]
 
     sharded = jax.shard_map(
         per_device,
@@ -65,10 +86,98 @@ def pipeline_spmd(stage_fn: Callable, mesh: Mesh, axis: str = "pp", checkpoint_s
     )
 
     def run(stacked_params, microbatches):
-        M = microbatches.shape[0]
+        leaves = jax.tree_util.tree_leaves(microbatches)
+        M = leaves[0].shape[0]
         ys = sharded(stacked_params, microbatches)  # [S, M+S-1, ...]
         # final stage's outputs for micro-batch m appear at t = m + S - 1
-        return ys[S - 1, S - 1 : M + S - 1]
+        return jax.tree_util.tree_map(lambda l: l[S - 1, S - 1 : M + S - 1], ys)
+
+    return run
+
+
+def pipeline_spmd_interleave(
+    stage_fn: Callable,
+    mesh: Mesh,
+    num_virtual_stages: int,
+    axis: str = "pp",
+    checkpoint_stages: bool = True,
+):
+    """VPP circular schedule: S_total = v * pp stage chunks, chunk k lives on
+    rank k % pp (round-robin, the reference's interleave assignment,
+    pp_layers.py get_stage_from_index for interleave). A micro-batch hops the
+    ring v times; consecutive chunks are on consecutive ranks so every hop is
+    still one ppermute. Rank d selects its local chunk (k // pp) by how many
+    wraps the arriving activation has completed.
+
+    stacked_params: leading axis S_total in ROUND-ROBIN device order — use
+    stack_stage_params_interleave so chunk k % pp == its rank.
+    Returns the final chunk's outputs, each leaf [M, ...].
+    """
+    pp = mesh.shape[axis]
+    v = num_virtual_stages
+    S_total = v * pp
+    fn = jax.checkpoint(stage_fn) if checkpoint_stages else stage_fn
+
+    def per_device(params, mbs):
+        # params leaves: [v, ...] this rank's chunks (round-robin order:
+        # local index c is global chunk c*pp + d)
+        sidx = jax.lax.axis_index(axis)
+        leaves = jax.tree_util.tree_leaves(mbs)
+        M = leaves[0].shape[0]
+        fwd_perm = [(s, (s + 1) % pp) for s in range(pp)]
+        # group-synchronous circular schedule: micro-batches advance in
+        # groups of pp; group g's member m enters rank 0 / chunk 0 at
+        # t_ingest = g*pp*v + (m % pp) and hops one chunk per step, so a
+        # full batch takes T = M*v + pp - 1 steps — the fill/drain bubble is
+        # pp-1 chunk-steps, v times less wall-time than the non-interleaved
+        # schedule's (pp-1) full-stage steps.
+        T = M * v + pp - 1
+
+        def step(carry, t):
+            buf = carry
+            # the activation arriving at rank d at time t sits at global
+            # chunk k = d + pp*c with local wrap c = ((t - d) // pp) mod v
+            # (see t_ingest above: (t - t_ingest - d) / pp == c)
+            c = jnp.clip((t - sidx) // pp, 0, None) % v
+            g = t // (pp * v)
+            feed_idx = jnp.clip(g * pp + jnp.minimum(t % (pp * v), pp - 1), 0, M - 1)
+            feed = _tree_index(mbs, feed_idx)
+            # rank 0 ingests a fresh micro-batch while its wrap slot is 0
+            ingest = (sidx == 0) & (c == 0)
+            x = _tree_where(ingest, feed, buf)
+            local = _tree_index(params, c)
+            y = fn(local, x)
+            shifted = jax.tree_util.tree_map(
+                lambda l: jax.lax.ppermute(l, axis, fwd_perm), y
+            )
+            return shifted, y
+
+        init = jax.tree_util.tree_map(jnp.zeros_like, _tree_index(mbs, 0))
+        _, ys = jax.lax.scan(step, init, jnp.arange(T))
+        return jax.tree_util.tree_map(lambda l: l[None], ys)
+
+    sharded = jax.shard_map(
+        per_device,
+        mesh=mesh,
+        in_specs=(P(axis), P()),
+        out_specs=P(axis),
+        check_vma=False,
+    )
+
+    def run(stacked_params, microbatches):
+        leaves = jax.tree_util.tree_leaves(microbatches)
+        M = leaves[0].shape[0]
+        if M % pp != 0:
+            raise ValueError(
+                f"interleaved pipeline needs micro-batches ({M}) divisible by pp ({pp})"
+            )
+        ys = sharded(stacked_params, microbatches)  # [pp, T, ...]
+        # micro-batch m finishes chunk S_total-1 on rank pp-1 at
+        # t = t_ingest(m) + S_total - 1 (static schedule -> static gather)
+        finish = jnp.asarray(
+            [(m // pp) * pp * v + m % pp + S_total - 1 for m in range(M)]
+        )
+        return jax.tree_util.tree_map(lambda l: l[pp - 1, finish], ys)
 
     return run
 
@@ -76,9 +185,26 @@ def pipeline_spmd(stage_fn: Callable, mesh: Mesh, axis: str = "pp", checkpoint_s
 def stack_stage_params(param_trees, mesh: Mesh, axis: str = "pp"):
     """Stack S per-stage param pytrees on a new leading axis sharded over pp."""
     stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs, axis=0), *param_trees)
-    sh = NamedSharding(mesh, P(axis))
 
     def put(x):
+        return jax.device_put(x, NamedSharding(mesh, P(*([axis] + [None] * (x.ndim - 1)))))
+
+    return jax.tree_util.tree_map(put, stacked)
+
+
+def stack_stage_params_interleave(param_trees, mesh: Mesh, num_virtual_stages: int, axis: str = "pp"):
+    """Stack v*pp chunk param trees so that rank d's local [v, ...] block is
+    (chunk d, chunk d+pp, ...) — the round-robin VPP placement. The leading
+    axis is ordered rank-major: [d*v + c] = global chunk c*pp + d."""
+    pp = mesh.shape[axis]
+    v = num_virtual_stages
+    assert len(param_trees) == pp * v, (len(param_trees), pp, v)
+    order = [c * pp + d for d in range(pp) for c in range(v)]
+    reordered = [param_trees[k] for k in order]
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs, axis=0), *reordered)
+
+    def put(x):
+        # leading axis pp*v sharded over pp -> rank d holds rows [d*v, (d+1)*v)
         return jax.device_put(x, NamedSharding(mesh, P(*([axis] + [None] * (x.ndim - 1)))))
 
     return jax.tree_util.tree_map(put, stacked)

@@ -116,16 +116,20 @@ class ErnieForMaskedLM(nn.Layer):
     def forward(self, input_ids, token_type_ids=None, position_ids=None, attention_mask=None, labels=None):
         encoded, _ = self.ernie(input_ids, token_type_ids, position_ids, attention_mask)
         h = self.layer_norm(F.gelu(self.transform(encoded)))
-        # tied decoder: h @ E^T
-        logits = F.linear(h, self.ernie.embeddings.word_embeddings.weight.T) + self.decoder_bias
+        E = self.ernie.embeddings.word_embeddings.weight
         if labels is not None:
-            loss = F.cross_entropy(
-                manip.reshape(logits, [-1, logits.shape[-1]]),
-                manip.reshape(labels, [-1]),
-                ignore_index=-100,
+            # fused tied-decoder + CE: no [N, vocab] f32 logits materialized
+            # (incubate fused_linear_cross_entropy); logits not returned on
+            # the loss path — recompute without labels if they're needed
+            from ..incubate.nn import functional as IF
+
+            loss = IF.fused_linear_cross_entropy(
+                h, E, labels, bias=self.decoder_bias,
+                ignore_index=-100, transpose_weight=True,
             )
-            return loss, logits
-        return logits
+            return loss, None
+        # tied decoder: h @ E^T
+        return F.linear(h, E.T) + self.decoder_bias
 
 
 class ErnieForSequenceClassification(nn.Layer):

@@ -126,14 +126,15 @@ class LlamaForCausalLM(nn.Layer):
 
     def forward(self, input_ids, labels=None):
         h = self.llama(input_ids)
-        logits = self.lm_head(h)
         if labels is not None:
-            loss = F.cross_entropy(
-                manip.reshape(logits[:, :-1], [-1, logits.shape[-1]]),
-                manip.reshape(labels[:, 1:], [-1]),
+            # fused LM-head + shifted CE (no [N, vocab] f32 logits)
+            from ..incubate.nn import functional as IF
+
+            loss = IF.fused_linear_cross_entropy(
+                h[:, :-1], self.lm_head.weight, labels[:, 1:]
             )
-            return loss, logits
-        return logits
+            return loss, None
+        return self.lm_head(h)
 
 
 def llama_tiny(**kw):

@@ -37,6 +37,11 @@ class Optimizer:
         self._accumulator_fills: dict = {}  # name -> creation fill value
         self._pending_state: dict = {}  # loaded state awaiting lazy accumulator creation
         self._step_count = Tensor(jnp.zeros((), jnp.int64))
+        # fused flat accumulators: ids-tuple -> bucket dict (see _apply_fused)
+        self._fused_buckets: dict = {}
+        # wrappers that need per-param accumulators (shard_optimizer, ZeRO
+        # sharding) flip this off to force the per-param path
+        self._fuse_allowed = True
 
     # ---- param groups ----
     def _build_param_groups(self, parameters):
@@ -95,6 +100,13 @@ class Optimizer:
     def step(self):
         self._sync_lr()
         self._step_count._replace_value(self._step_count._value + 1)
+        for entries in self._collect_entries():
+            self._apply_entries(entries)
+
+    def _collect_entries(self):
+        """Per param-group: [(param, grad, weight_decay, lr_scale)] with
+        grad clip applied and per-param overrides resolved."""
+        out = []
         for group, params_grads in self._grouped_params_grads():
             if not params_grads:
                 continue
@@ -103,13 +115,32 @@ class Optimizer:
                 params_grads = clip(params_grads)
             wd = group.get("weight_decay", self._weight_decay)
             lr_scale = group.get("learning_rate", 1.0)
+            entries = []
             for p, g in params_grads:
                 if g is None:
                     continue
                 # per-param overrides: ParamAttr.learning_rate / regularizer
                 p_scale = lr_scale * getattr(p, "optimize_attr", {}).get("learning_rate", 1.0)
                 p_wd = getattr(p, "regularizer", None)
-                self._apply_one(p, g, p_wd if p_wd is not None else wd, p_scale)
+                entries.append((p, g, p_wd if p_wd is not None else wd, p_scale))
+            if entries:
+                out.append(entries)
+        return out
+
+    def _materialize_state(self):
+        """Force lazily-created optimizer state (fused buckets) into
+        existence for the CURRENT param/grad composition without updating
+        anything — so snapshot/restore consumers (GradScaler's branchless
+        skip) see every state tensor before the step mutates it."""
+        return None
+
+    def _apply_entries(self, entries):
+        """Per-param fallback; optimizers with a fused update override this
+        (the role of the reference's multi_tensor_adam /
+        fleet tensor_fusion_helper fused buffers — one elementwise XLA kernel
+        over a flat buffer instead of hundreds of small per-tensor kernels)."""
+        for p, g, wd, s in entries:
+            self._apply_one(p, g, wd, s)
 
     def _grouped_params_grads(self):
         for g in self._param_groups:
@@ -157,11 +188,71 @@ class Optimizer:
 
     clear_gradients = clear_grad
 
+    # ---- fused-bucket plumbing ----
+    # A bucket (one (weight_decay, lr_scale) combination) holds shape groups:
+    # params of identical shape stacked along a new leading axis. Stacking is
+    # layout-preserving on TPU (unlike ravel+concat, which forces a tiled->
+    # linear relayout of every tensor — measured 2x slower end to end), so
+    # the whole optimizer update runs as ~a dozen big elementwise kernels.
+    def _defuse_bucket(self, st):
+        """Dissolve one bucket's stacked state into per-param pending entries."""
+        for grp in st["groups"]:
+            for i, pid in enumerate(grp["ids"]):
+                for nm, stacked in grp["flat"].items():
+                    self._pending_state[(nm, pid)] = stacked._value[i]
+                for nm in st["scalars"]:
+                    self._pending_state[(nm, pid)] = st["scalars"][nm]._value
+
+    def _defuse_all(self):
+        """Dissolve fused stacked buffers back into per-param pending entries
+        so state_dict round-trips and bucket recomposition stay exact."""
+        for st in list(self._fused_buckets.values()):
+            self._defuse_bucket(st)
+        self._fused_buckets.clear()
+
+    def _accumulator_view(self):
+        """name -> {id(param): Tensor}, with fused buckets exposed as
+        per-param slices (state_dict format is fusion-agnostic)."""
+        view = {name: dict(store) for name, store in self._accumulators.items()}
+        for st in self._fused_buckets.values():
+            for grp in st["groups"]:
+                for i, pid in enumerate(grp["ids"]):
+                    for nm, stacked in grp["flat"].items():
+                        view.setdefault(nm, {})[pid] = Tensor(stacked._value[i])
+                    for nm, sc in st["scalars"].items():
+                        view.setdefault(nm, {})[pid] = sc
+        # loaded-but-not-yet-applied entries (set_state_dict before a step)
+        for (nm, pid), v in self._pending_state.items():
+            view.setdefault(nm, {}).setdefault(pid, Tensor(jnp.asarray(v)))
+        return view
+
+    def _pop_param_state(self, name, pid):
+        """Fetch a param's accumulator value for fused-bucket init: loaded
+        pending state first, then an existing per-param accumulator."""
+        v = self._pending_state.pop((name, pid), None)
+        if v is not None:
+            return v
+        t = self._accumulators.get(name, {}).pop(pid, None)
+        return t._value if t is not None else None
+
+    def _fused_state_entries(self):
+        """[(Tensor, fill)] for every fused-bucket state tensor — consumers
+        that snapshot/restore optimizer state (e.g. GradScaler's branchless
+        skip) must cover these alongside _accumulators."""
+        out = []
+        for st in self._fused_buckets.values():
+            for grp in st["groups"]:
+                for nm, t in grp["flat"].items():
+                    out.append((t, 0.0))
+            for nm, t in st["scalars"].items():
+                out.append((t, 1.0 if nm.endswith("_pow") else 0.0))
+        return out
+
     # ---- state dict ----
     def state_dict(self):
         sd = {}
         # accumulators keyed by (name, parameter order) for stable naming
-        for name, store in self._accumulators.items():
+        for name, store in self._accumulator_view().items():
             i = 0
             for _, p in self._all_params():
                 if id(p) in store:
@@ -178,6 +269,9 @@ class Optimizer:
         # _add_accumulator picks them up instead of zeros on the first step.
         import re
 
+        # dissolve fused buffers first: loaded per-param values overwrite the
+        # pending entries, and the next step rebuilds buckets from them
+        self._defuse_all()
         params = [p for _, p in self._all_params()]
         for key, v in sd.items():
             m = re.fullmatch(r"(.+)_(\d+)", key)
@@ -250,6 +344,149 @@ class Adam(Optimizer):
         self._eps = epsilon
         self._multi_precision = multi_precision
 
+    def _effective_wd(self, p, wd):
+        return wd
+
+    def _apply_entries(self, entries):
+        """Bucket homogeneous params and update each bucket with ONE fused
+        elementwise kernel over a flat buffer (reference's multi_tensor_adam,
+        paddle/phi/kernels/gpu/multi_tensor_adam_kernel.cu; the flat update
+        also shares one beta-pow pair per bucket instead of per-param scalars
+        — several hundred fewer tiny kernels per step on a 100M-param model)."""
+        buckets, rest = self._fuse_partition(entries)
+        for (wdv, s), plist in buckets.items():
+            if len(plist) == 1:
+                self._apply_one(plist[0][0], plist[0][1], wdv, s)
+            else:
+                self._apply_fused(plist, wdv, s)
+        for p, g, wd, s in rest:
+            self._apply_one(p, g, wd, s)
+
+    def _fuse_partition(self, entries):
+        """Split entries into fusable buckets keyed by (wd, lr_scale) and a
+        per-param remainder."""
+        from ..regularizer import L1Decay
+
+        buckets = defaultdict(list)
+        rest = []
+        if not getattr(self, "_fuse_allowed", True):
+            return buckets, [(p, g, self._effective_wd(p, wd), s) for p, g, wd, s in entries]
+        for p, g, wd, s in entries:
+            wd = self._effective_wd(p, wd)
+            fusable = (
+                not isinstance(wd, L1Decay)
+                and p._value.dtype == jnp.float32
+                and getattr(p, "_dist_attr", None) is None
+                and tuple(g.value.shape) == tuple(p._value.shape)
+            )
+            if fusable:
+                buckets[(_wd_value(wd), float(s))].append((p, g))
+            else:
+                rest.append((p, g, wd, s))
+        return buckets, rest
+
+    def _materialize_state(self):
+        for entries in self._collect_entries():
+            buckets, _ = self._fuse_partition(entries)
+            for plist in buckets.values():
+                if len(plist) > 1:
+                    ids = tuple(id(p) for p, _ in plist)
+                    if ids not in self._fused_buckets:
+                        self._build_bucket(plist)
+
+    def _apply_fused(self, plist, wdv, lr_scale):
+        ids = tuple(id(p) for p, _ in plist)
+        st = self._fused_buckets.get(ids)
+        if st is None:
+            st = self._build_bucket(plist)
+        b1, b2, eps = self._beta1, self._beta2, self._eps
+        lr = self._lr_value(lr_scale)
+        b1p, b2p = st["scalars"]["beta1_pow"], st["scalars"]["beta2_pow"]
+        b1p_new = b1p.value * b1
+        b2p_new = b2p.value * b2
+        c1 = 1 - b1p_new
+        c2 = 1 - b2p_new
+
+        by_id = {id(p): (p, g) for p, g in plist}
+        for grp in st["groups"]:
+            pgs = [by_id[pid] for pid in grp["ids"]]
+            G = jnp.stack([g.value for _, g in pgs]).astype(jnp.float32)
+            P = jnp.stack([p._value for p, _ in pgs])
+            m, v = grp["flat"]["moment1"], grp["flat"]["moment2"]
+            if self._wd_mode == "l2" and wdv:
+                G = G + wdv * P
+            m_new = b1 * m.value + (1 - b1) * G
+            v_new = b2 * v.value + (1 - b2) * G * G
+            upd = (m_new / c1) / (jnp.sqrt(v_new / c2) + eps)
+            if self._wd_mode == "decoupled" and wdv:
+                upd = upd + wdv * P
+            P2 = P - lr * upd
+            m._replace_value(m_new)
+            v._replace_value(v_new)
+            for i, (p, _) in enumerate(pgs):
+                p._replace_value(P2[i])
+                p.stop_gradient = False
+        b1p._replace_value(b1p_new)
+        b2p._replace_value(b2p_new)
+
+    def _build_bucket(self, plist):
+        ids = tuple(id(p) for p, _ in plist)
+        # composition changed (e.g. params frozen/unfrozen between steps):
+        # dissolve any bucket sharing params with this one so its per-param
+        # state lands in _pending_state and is inherited below, not zeroed
+        new_ids = set(ids)
+        for old_ids, old_st in list(self._fused_buckets.items()):
+            if new_ids.intersection(old_ids):
+                self._defuse_bucket(old_st)
+                del self._fused_buckets[old_ids]
+        by_shape = defaultdict(list)
+        for p, _ in plist:
+            by_shape[tuple(p._value.shape)].append(p)
+
+        def gather(name, group):
+            parts, have_any = [], False
+            for p in group:
+                prev = self._pop_param_state(name, id(p))
+                if prev is not None:
+                    have_any = True
+                    parts.append(jnp.asarray(prev, jnp.float32))
+                else:
+                    parts.append(jnp.zeros(p._value.shape, jnp.float32))
+            if not have_any:
+                return jnp.zeros((len(group),) + tuple(group[0]._value.shape), jnp.float32)
+            return jnp.stack(parts)
+
+        def gather_scalar(name, fill):
+            # pop every param's entry (no stale leftovers); the bucket shares
+            # one scalar — use the first loaded value
+            first = None
+            for p, _ in plist:
+                prev = self._pop_param_state(name, id(p))
+                if prev is not None and first is None:
+                    first = jnp.asarray(prev, jnp.float32).reshape(())
+            return first if first is not None else jnp.asarray(fill, jnp.float32)
+
+        groups = [
+            {
+                "ids": tuple(id(p) for p in group),
+                "shape": shape,
+                "flat": {
+                    "moment1": Tensor(gather("moment1", group)),
+                    "moment2": Tensor(gather("moment2", group)),
+                },
+            }
+            for shape, group in by_shape.items()
+        ]
+        st = {
+            "groups": groups,
+            "scalars": {
+                "beta1_pow": Tensor(gather_scalar("beta1_pow", 1.0)),
+                "beta2_pow": Tensor(gather_scalar("beta2_pow", 1.0)),
+            },
+        }
+        self._fused_buckets[ids] = st
+        return st
+
     def _apply_one(self, p, g, wd, lr_scale):
         m = self._add_accumulator("moment1", p)
         v = self._add_accumulator("moment2", p)
@@ -291,10 +528,10 @@ class AdamW(Adam):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters, weight_decay, grad_clip, lazy_mode, multi_precision, name)
         self._apply_decay_param_fun = apply_decay_param_fun
 
-    def _apply_one(self, p, g, wd, lr_scale):
+    def _effective_wd(self, p, wd):
         if self._apply_decay_param_fun is not None and not self._apply_decay_param_fun(p.name or ""):
-            wd = 0.0
-        super()._apply_one(p, g, wd, lr_scale)
+            return 0.0
+        return wd
 
 
 class Adagrad(Optimizer):

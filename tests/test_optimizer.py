@@ -130,10 +130,45 @@ def test_optimizer_state_dict_roundtrip():
     opt2.step(); opt2.clear_grad()  # materialize accumulators
     opt2.set_state_dict(sd)
     k = [k for k in sd if k.startswith("moment1")][0]
-    np.testing.assert_allclose(
-        opt2._accumulators["moment1"][id(m2.parameters()[0])].numpy(),
-        sd[k].numpy(),
-    )
+    np.testing.assert_allclose(opt2.state_dict()[k].numpy(), sd[k].numpy())
+    # and the loaded state actually drives the next update: stepping both
+    # optimizers from identical params+grads produces identical params
+    for p1, p2 in zip(m.parameters(), m2.parameters()):
+        p2._replace_value(p1._value)
+    m(paddle.ones([1, 2])).sum().backward()
+    m2(paddle.ones([1, 2])).sum().backward()
+    opt.step(); opt2.step()
+    for p1, p2 in zip(m.parameters(), m2.parameters()):
+        np.testing.assert_allclose(p1.numpy(), p2.numpy(), rtol=1e-6)
+
+
+def test_adamw_fused_matches_per_param():
+    """The flat fused Adam update (one kernel over a concat buffer, shared
+    beta-pow) must be bit-compatible with the per-param path."""
+    def build():
+        paddle.seed(42)
+        return nn.Sequential(nn.Linear(5, 7), nn.Tanh(), nn.Linear(7, 3))
+
+    def run(fused):
+        m = build()
+        opt = paddle.optimizer.AdamW(0.01, parameters=m.parameters(), weight_decay=0.02)
+        opt._fuse_allowed = fused
+        x = paddle.to_tensor(np.random.RandomState(0).randn(4, 5).astype(np.float32))
+        for _ in range(4):
+            m(x).mean().backward()
+            opt.step(); opt.clear_grad()
+        return [p.numpy() for p in m.parameters()], opt.state_dict()
+
+    pf, sdf = run(True)
+    pu, sdu = run(False)
+    for a, b in zip(pf, pu):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+    assert set(sdf) == set(sdu)
+    for k in sdu:
+        np.testing.assert_allclose(
+            np.asarray(sdf[k].numpy(), np.float32),
+            np.asarray(sdu[k].numpy(), np.float32), rtol=1e-6, atol=1e-7,
+        )
 
 
 def test_grad_scaler_fp16():
@@ -203,3 +238,65 @@ def test_explicit_unscale_then_step_not_double():
     scaler.step(opt)  # must NOT unscale again
     np.testing.assert_allclose(g, [1.0, 1.0], rtol=1e-6)
     np.testing.assert_allclose(p.numpy(), [0.9, 0.9], rtol=1e-5)
+
+
+def test_adamw_fused_bucket_survives_composition_change():
+    """Freezing a layer mid-training must not reset the surviving params'
+    fused moments/beta-pows (code-review round-2 finding)."""
+    paddle.seed(0)
+    m = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 3))
+    x = paddle.to_tensor(np.random.RandomState(0).randn(5, 4).astype(np.float32))
+
+    def steps(opt, model, n):
+        for _ in range(n):
+            model(x).mean().backward()
+            opt.step(); opt.clear_grad()
+
+    opt = paddle.optimizer.AdamW(0.01, parameters=m.parameters(), weight_decay=0.01)
+    steps(opt, m, 3)
+    b1p_before = float(opt.state_dict()["beta1_pow_0"].numpy())
+    m1_before = opt.state_dict()["moment1_0"].numpy().copy()
+    # freeze the second Linear -> bucket composition changes
+    for p in m[2].parameters():
+        p.stop_gradient = True
+    steps(opt, m, 1)
+    sd = opt.state_dict()
+    b1p_after = float(sd["beta1_pow_0"].numpy())
+    np.testing.assert_allclose(b1p_after, b1p_before * 0.9, rtol=1e-6)
+    assert not np.allclose(sd["moment1_0"].numpy(), 0.0)
+    assert np.abs(sd["moment1_0"].numpy() - m1_before).max() < 1.0  # evolved, not reset
+    assert len(opt._fused_buckets) == 1  # stale bucket dissolved, not leaked
+
+
+def test_grad_scaler_skip_preserves_loaded_state():
+    """An inf-grad skipped step right after set_state_dict must leave the
+    loaded optimizer state untouched (code-review round-2 finding)."""
+    paddle.seed(1)
+    m = nn.Sequential(nn.Linear(3, 5), nn.Tanh(), nn.Linear(5, 2))
+    x = paddle.to_tensor(np.random.RandomState(1).randn(4, 3).astype(np.float32))
+    opt = paddle.optimizer.AdamW(0.01, parameters=m.parameters())
+    for _ in range(3):
+        m(x).mean().backward()
+        opt.step(); opt.clear_grad()
+    sd = {k: (v.numpy().copy() if hasattr(v, "numpy") else v) for k, v in opt.state_dict().items()}
+
+    m2 = nn.Sequential(nn.Linear(3, 5), nn.Tanh(), nn.Linear(5, 2))
+    opt2 = paddle.optimizer.AdamW(0.01, parameters=m2.parameters())
+    opt2.set_state_dict({k: paddle.to_tensor(v) if isinstance(v, np.ndarray) else v for k, v in sd.items()})
+
+    scaler = paddle.amp.GradScaler(init_loss_scaling=2.0)
+    loss = m2(x).mean()
+    scaler.scale(loss).backward()
+    # poison one grad with inf -> the step must be skipped
+    p0 = m2[0].weight
+    p0.grad._replace_value(p0.grad._value * np.inf)
+    scaler.step(opt2)
+    scaler.update()
+    opt2.clear_grad()
+    sd2 = opt2.state_dict()
+    for k, v in sd.items():
+        if isinstance(v, np.ndarray) and (k.startswith("moment") or k.startswith("beta")):
+            np.testing.assert_allclose(
+                np.asarray(sd2[k].numpy(), np.float32), v, rtol=1e-6,
+                err_msg=f"{k} changed across a skipped step",
+            )
