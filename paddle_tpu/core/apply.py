@@ -62,14 +62,30 @@ def apply(name: str, fn: Callable, *args, n_outputs=None, **kwargs):
         _record_static(name, fn, args, kwargs, res)
         return res
 
-    def pure(*dvals):
-        vals = list(raw)
-        for p, v in zip(diff_pos, dvals):
-            vals[p] = v
-        return fn(*vals, **kwargs)
-
     primals = [raw[p] for p in diff_pos]
-    out, vjp_fn = jax.vjp(pure, *primals)
+
+    # ---- cached-linearization fast path ----
+    # jax.vjp re-traces the op on EVERY grad-tracked eager call (~ms); the
+    # reference's per-op path is generated C++ at us scale (eager_gen.py
+    # ad_funcs). Cache a jitted (fwd -> out+residuals, pullback) pair keyed
+    # on everything that determines behavior: op name, fn's code + closure
+    # constants, input avals, kwargs, AMP state. Unhashable closures/args
+    # (rng keys, arrays) fall back to the exact per-call vjp below.
+    key = _lin_key(name, fn, raw, tensor_pos, tuple(diff_pos), kwargs)
+    if key is not None:
+        entry = _lin_cache.get(key)
+        if entry is None:
+            entry = _LinEntry(fn, raw, tuple(diff_pos), tuple(tensor_pos), kwargs)
+            _lin_cache[key] = entry
+        out, vjp_fn = entry(primals, [raw[p] for p in tensor_pos if p not in diff_pos])
+    else:
+        def pure(*dvals):
+            vals = list(raw)
+            for p, v in zip(diff_pos, dvals):
+                vals[p] = v
+            return fn(*vals, **kwargs)
+
+        out, vjp_fn = jax.vjp(pure, *primals)
 
     single = not isinstance(out, (tuple, list))
     outs = (out,) if single else tuple(out)
@@ -87,6 +103,121 @@ def apply(name: str, fn: Callable, *args, n_outputs=None, **kwargs):
     res = _wrap(out, node=node)
     _record_static(name, fn, args, kwargs, res)
     return res
+
+
+_lin_cache: dict = {}
+_HASHABLE = (int, float, bool, str, bytes, type(None))
+
+
+def _closure_sig(fn, depth=0):
+    """Hashable signature of a function's behavior: code identity + default
+    args + closure cell contents (recursing one level into closed-over
+    functions). Returns None when any cell is not safely hashable (arrays,
+    rng keys, Tensors, mutable objects) — caller falls back to exact vjp."""
+    if depth > 3:
+        return None
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        return None
+    sig = [id(code)]
+    for v in (fn.__defaults__ or ()):
+        if isinstance(v, _HASHABLE):
+            sig.append(v)
+        else:
+            return None
+    for cell in (fn.__closure__ or ()):
+        v = cell.cell_contents
+        if isinstance(v, _HASHABLE):
+            sig.append(v)
+        elif isinstance(v, tuple) and all(isinstance(e, _HASHABLE) for e in v):
+            sig.append(v)
+        elif callable(v):
+            if getattr(v, "__closure__", None):
+                inner = _closure_sig(v, depth + 1)
+                if inner is None:
+                    return None
+                sig.append(inner)
+            else:
+                sig.append(getattr(v, "__qualname__", None) or repr(v))
+        else:
+            return None
+    return tuple(sig)
+
+
+def _lin_key(name, fn, raw, tensor_pos, diff_pos, kwargs):
+    fsig = _closure_sig(fn)
+    if fsig is None:
+        return None
+    tset = set(tensor_pos)
+    consts = []
+    for i, v in enumerate(raw):
+        if i in tset:
+            consts.append(
+                (tuple(v.shape), str(v.dtype)) if hasattr(v, "shape") else None
+            )
+        elif isinstance(v, _HASHABLE):
+            consts.append(("c", v))
+        elif isinstance(v, tuple) and all(isinstance(e, _HASHABLE) for e in v):
+            consts.append(("c", v))
+        else:
+            return None
+    for v in kwargs.values():
+        if not (isinstance(v, _HASHABLE) or (isinstance(v, tuple) and all(isinstance(e, _HASHABLE) for e in v))):
+            return None
+    amp = state.get_amp_state()
+    amp_key = (
+        (amp.level, str(amp.dtype), frozenset(amp.white), frozenset(amp.black))
+        if amp is not None
+        else None
+    )
+    return (name, fsig, tuple(consts), diff_pos, tuple(sorted(kwargs.items())), amp_key)
+
+
+class _LinEntry:
+    """One cached linearization: jitted forward (out + flat residuals) and
+    jitted pullback. The first call traces; subsequent calls are cached-jit
+    dispatches (~tens of us)."""
+
+    __slots__ = ("fwd", "bwd", "res_treedef")
+
+    def __init__(self, fn, raw_template, diff_pos, tensor_pos, kwargs):
+        nondiff_tensor_pos = tuple(p for p in tensor_pos if p not in diff_pos)
+        template = [
+            v if i not in set(tensor_pos) else None for i, v in enumerate(raw_template)
+        ]
+        entry = self
+
+        def fwd(primals, nondiff_vals):
+            vals = list(template)
+            for p, v in zip(nondiff_tensor_pos, nondiff_vals):
+                vals[p] = v
+
+            def pure(*dvals):
+                vv = list(vals)
+                for p, v in zip(diff_pos, dvals):
+                    vv[p] = v
+                return fn(*vv, **kwargs)
+
+            out, vjp_fn = jax.vjp(pure, *primals)
+            flat, treedef = jax.tree_util.tree_flatten(vjp_fn)
+            entry.res_treedef = treedef
+            return out, flat
+
+        def bwd(flat, cot):
+            vjp_fn = jax.tree_util.tree_unflatten(entry.res_treedef, flat)
+            return vjp_fn(cot)
+
+        self.fwd = jax.jit(fwd)
+        self.bwd = jax.jit(bwd)
+
+    def __call__(self, primals, nondiff_vals):
+        out, flat = self.fwd(primals, nondiff_vals)
+        bwd = self.bwd
+
+        def vjp_fn(cot):
+            return bwd(flat, cot)
+
+        return out, vjp_fn
 
 
 def _record_static(name, fn, args, kwargs, res):

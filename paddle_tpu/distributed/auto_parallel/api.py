@@ -177,7 +177,7 @@ def shard_optimizer(optimizer, shard_fn: Optional[Callable] = None):
     optimizer._add_accumulator = _add
     # sharded accumulators must exist per-param (each inherits its param's
     # placements) — the flat fused path would bypass the wrapper
-    optimizer._fuse_allowed = False
+    optimizer.disable_fusion()
     return optimizer
 
 

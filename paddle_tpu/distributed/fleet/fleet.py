@@ -121,11 +121,15 @@ def distributed_model(model):
     if not _state.initialized:
         init()
     hcg = _state.hcg
-    from .meta_parallel.pipeline_parallel import PipelineParallel
+    from .meta_parallel.pipeline_parallel import (
+        PipelineParallel,
+        PipelineParallelWithInterleave,
+    )
     from .meta_parallel.parallel_layers.pp_layers import PipelineLayer
 
     if hcg.get_pipe_parallel_world_size() > 1 and isinstance(model, PipelineLayer):
-        return PipelineParallel(model, hcg, _state.strategy)
+        cls = PipelineParallelWithInterleave if model._num_virtual > 1 else PipelineParallel
+        return cls(model, hcg, _state.strategy)
     if hcg.get_parallel_mode() == "data_parallel" and jax.device_count() > 1:
         return DataParallel(model)
     return model

@@ -18,7 +18,7 @@ class DygraphShardingOptimizer:
     def __init__(self, optimizer, hcg=None, **kw):
         self._inner_opt = optimizer
         # ZeRO shards per-accumulator; the flat fused path would hide them
-        optimizer._fuse_allowed = False
+        optimizer.disable_fusion()
         self._hcg = hcg
         if hcg is not None and "sharding" in hcg.mesh.shape:
             self._mesh, self._axis = hcg.mesh, "sharding"

@@ -151,6 +151,9 @@ class PipelineLayer(Layer):
         if num_stages is None:
             num_stages = hcg.get_pipe_parallel_world_size() if hcg else 1
         self._num_stages = num_stages
+        # VPP (reference :942): segment into num_stages * v chunks; chunk k
+        # is placed on pp rank k % num_stages (round-robin interleave)
+        self._num_virtual = int(num_virtual_pipeline_stages or 1)
         self._loss_fn = loss_fn
         self._recompute_interval = recompute_interval
         self._topology = topology
@@ -178,23 +181,69 @@ class PipelineLayer(Layer):
 
         seg = SegmentLayers(
             [layers[i] if isinstance(layers[i], LayerDesc) else built[i] for i in range(len(built))],
-            num_parts=num_stages,
+            num_parts=num_stages * self._num_virtual,
             method=seg_method,
         )
         self.segment_parts = seg.do_segment()
+        self._stage_modules: dict = {}
+        # set by PipelineParallel when pp_degree > 1: chunk k's device; the
+        # forward then hops activations stage-to-stage (tape-visible op)
+        self._stage_devices: Optional[list] = None
 
     @property
     def num_stages(self):
         return self._num_stages
 
+    @property
+    def num_chunks(self) -> int:
+        """Total stage chunks = num_stages * num_virtual (VPP)."""
+        return self._num_stages * self._num_virtual
+
     def get_stage_from_index(self, layer_idx: int) -> int:
-        for s in range(self._num_stages):
-            if self.segment_parts[s] <= layer_idx < self.segment_parts[s + 1]:
-                return s
+        """pp RANK owning the layer (chunk k lives on rank k % num_stages —
+        the reference's interleave placement)."""
+        for k in range(self.num_chunks):
+            if self.segment_parts[k] <= layer_idx < self.segment_parts[k + 1]:
+                return k % self._num_stages
         raise IndexError(layer_idx)
 
     def stage_layers(self, stage: int) -> List:
         return self.run_function[self.segment_parts[stage] : self.segment_parts[stage + 1]]
+
+    def stage_module(self, stage: int) -> "_PipelineStage":
+        """Stage chunk as a Layer (own state_dict) for functional staging."""
+        if stage not in self._stage_modules:
+            self._stage_modules[stage] = _PipelineStage(self, stage)
+        return self._stage_modules[stage]
+
+    def uniform_stages(self) -> bool:
+        """True when every stage chunk has the identical param/buffer
+        structure AND no cross-stage weight tying / bare callables — the
+        precondition for stacking per-stage params over the pp mesh axis
+        (spmd_pipeline compiled schedule)."""
+        if self._shared:
+            return False
+        if any(not isinstance(l, Layer) for l in self.run_function):
+            return False
+        sig0 = None
+        for k in range(self.num_chunks):
+            sd = self.stage_module(k).state_dict()
+            param_sig = tuple(
+                (name, tuple(t.shape), str(t._value.dtype))
+                for name, t in sorted(sd.items())
+            )
+            # layer types AND scalar config must match too — two chunks with
+            # identical param shapes but e.g. Tanh vs Sigmoid, or Dropout
+            # p=0.1 vs 0.5, would otherwise silently run chunk 0's functions
+            layer_sig = tuple(
+                (type(l).__name__, _scalar_config(l)) for l in self.stage_layers(k)
+            )
+            sig = (param_sig, layer_sig)
+            if sig0 is None:
+                sig0 = sig
+            elif sig != sig0:
+                return False
+        return True
 
     def forward_stage(self, x, stage: int):
         for i in range(self.segment_parts[stage], self.segment_parts[stage + 1]):
@@ -209,6 +258,42 @@ class PipelineLayer(Layer):
         return x
 
     def forward(self, x):
-        for s in range(self._num_stages):
+        for s in range(self.num_chunks):
+            if self._stage_devices is not None:
+                from ..pipeline_parallel import _to_device
+
+                x = _to_device(x, self._stage_devices[s])
             x = self.forward_stage(x, s)
         return x
+
+
+def _scalar_config(layer) -> tuple:
+    """Hashable signature of a Layer's scalar configuration (activation
+    choice lives in the type name; things like dropout p, eps, strides live
+    in plain attributes)."""
+    out = []
+    for k, v in sorted(vars(layer).items()):
+        if isinstance(v, (int, float, bool, str, type(None))):
+            out.append((k, v))
+        elif isinstance(v, (tuple, list)) and all(
+            isinstance(e, (int, float, bool, str)) for e in v
+        ):
+            out.append((k, tuple(v)))
+    return tuple(out)
+
+
+class _PipelineStage(Layer):
+    """One stage chunk of a PipelineLayer as a standalone Layer: registers
+    the chunk's sublayers (so state_dict covers exactly the chunk) and
+    forwards through them in order."""
+
+    def __init__(self, pipeline_layer: "PipelineLayer", stage: int):
+        super().__init__()
+        self._pl = [pipeline_layer]  # list: keep parent out of the sublayer tree
+        self._stage = stage
+        for j, l in enumerate(pipeline_layer.stage_layers(stage)):
+            if isinstance(l, Layer):
+                setattr(self, f"l{j}", l)
+
+    def forward(self, x):
+        return self._pl[0].forward_stage(x, self._stage)

@@ -5,28 +5,40 @@ Reference parity: python/paddle/distributed/fleet/meta_parallel/pipeline_paralle
 the P2P layer pp_utils/p2p_communication.py.
 
 TPU-native design: there is no NCCL send/recv between stage processes — the
-controller compiles the whole pipeline. Two execution paths:
+controller owns every stage and stage placement is a sharding concern.
+With pp_degree > 1 each stage chunk's parameters are PLACED on its pp rank's
+device (memory is genuinely distributed), and one of two schedules runs:
 
-1. General path (any stage structure): train_batch splits the batch into
-   micro-batches and accumulates gradients across them (identical numerics
-   and memory cadence to 1F1B — micro-batch b's backward runs right after
-   its forward, the eager tape frees its activations before micro-batch
-   b+1, which is precisely 1F1B's memory motivation). Stage-to-stage
-   "sends" are just dataflow inside the program.
+1. Compiled SPMD schedule (uniform stages): per-stage params are assembled
+   zero-copy into a [S, ...] pp-sharded stack
+   (jax.make_array_from_single_device_arrays over the already-placed per-
+   stage values) and the whole fill/drain pipeline compiles into one XLA
+   program — lax.scan over time, lax.ppermute stage hand-off
+   (spmd_pipeline.pipeline_spmd). Gradients come from jax.value_and_grad of
+   the scheduled program; each chunk's grad slice lands back on its rank.
+   PipelineParallelWithInterleave uses the circular VPP schedule
+   (pipeline_spmd_interleave, v chunks per rank round-robin, bubble /v).
 
-2. Uniform-stage SPMD path (spmd_pipeline.py): per-stage params stacked
-   over the mesh's pp axis, micro-batches rotated with lax.ppermute inside
-   a lax.scan — the compiled circular pipeline that keeps all pp devices
-   busy, used via `to_distributed`/PipelineLayer(seg_method=...) when every
-   stage has the same structure.
+2. General path (non-uniform stages): stages run in dataflow order with an
+   explicit cross-stage transfer op; micro-batch grad accumulation supplies
+   1F1B's numerics and memory cadence, and jax's async per-device dispatch
+   overlaps micro-batch m's stage s with micro-batch m+1's stage s-1 (the
+   actual pipelining — devices are independent executors).
 """
 from __future__ import annotations
 
 from typing import List, Optional
 
+import jax
+import numpy as np
+from jax import numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ....core.apply import apply as _apply_op
 from ....core.tensor import Tensor
 from ....nn.layer import Layer
 from .parallel_layers.pp_layers import PipelineLayer
+from .spmd_pipeline import pipeline_spmd, pipeline_spmd_interleave
 
 
 def _split_microbatches(t, n: int):
@@ -38,7 +50,19 @@ def _split_microbatches(t, n: int):
     return [t[i * m : (i + 1) * m] for i in range(n)]
 
 
+def _to_device(x, dev):
+    """Cross-stage activation transfer as a framework op (tape-visible; the
+    role of p2p_communication.py send/recv — here one ICI hop XLA manages)."""
+    if isinstance(x, (tuple, list)):
+        return type(x)(_to_device(e, dev) for e in x)
+    if not isinstance(x, Tensor):
+        return x
+    return _apply_op("pp_transfer", lambda v: jax.device_put(v, dev), x)
+
+
 class PipelineParallel(Layer):
+    _interleave = False
+
     def __init__(self, layers: PipelineLayer, hcg, strategy):
         super().__init__()
         if not isinstance(layers, PipelineLayer):
@@ -51,6 +75,160 @@ class PipelineParallel(Layer):
         self.accumulate_steps = int(cfg.get("accumulate_steps", 1))
         self.total_loss: Optional[Tensor] = None
 
+        self._pp_world = hcg.get_pipe_parallel_world_size() if hcg else 1
+        self._v = layers._num_virtual
+        if self._interleave and self._v < 2:
+            raise ValueError(
+                "PipelineParallelWithInterleave needs PipelineLayer("
+                "num_virtual_pipeline_stages >= 2)"
+            )
+        self._pp_mesh: Optional[Mesh] = None
+        self._spmd = False
+        self._train_fn = None
+        if self._pp_world > 1:
+            if layers.num_stages != self._pp_world:
+                raise ValueError(
+                    f"PipelineLayer has {layers.num_stages} stages but the "
+                    f"topology's pp degree is {self._pp_world} — they must "
+                    "match (the reference asserts this in PipelineLayer)"
+                )
+            self._pp_mesh = self._build_pp_submesh()
+            self._place_stage_params()
+            self._spmd = layers.uniform_stages()
+
+    # ---- placement ----
+    def _build_pp_submesh(self) -> Mesh:
+        m = self._hcg.mesh
+        idx = tuple(slice(None) if n == "pp" else 0 for n in m.axis_names)
+        devs = np.asarray(m.devices[idx]).reshape(-1)
+        return Mesh(devs, ("pp",))
+
+    def _stage_device(self, chunk: int):
+        return self._pp_mesh.devices.ravel()[chunk % self._pp_world]
+
+    def _place_stage_params(self):
+        """Put every chunk's params/buffers on its pp rank's device — the
+        memory distribution the reference gets from per-rank partial builds
+        (pp_layers.py get_stage_from_index gating)."""
+        for k in range(self._layers.num_chunks):
+            dev = self._stage_device(k)
+            for _, t in self._layers.stage_module(k).state_dict().items():
+                t._replace_value(jax.device_put(t._value, dev))
+        self._layers._stage_devices = [
+            self._stage_device(k) for k in range(self._layers.num_chunks)
+        ]
+
+    # ---- compiled SPMD schedule ----
+    def _gather_stacked(self) -> dict:
+        """Assemble per-chunk param values into [num_chunks, ...] pp-sharded
+        arrays ZERO-COPY (rank-major row order: row d*v + c = chunk c*pp+d,
+        matching the interleave schedule's local chunk indexing)."""
+        pp, v = self._pp_world, self._v
+        sds = [
+            {k2: t._value for k2, t in self._layers.stage_module(k).state_dict().items()}
+            for k in range(self._layers.num_chunks)
+        ]
+        out = {}
+        for name, v0 in sds[0].items():
+            inner = tuple(v0.shape)
+            sharding = NamedSharding(self._pp_mesh, P("pp", *([None] * len(inner))))
+            shards = []
+            for d in range(pp):
+                vals = [sds[c * pp + d][name] for c in range(v)]
+                shards.append(jnp.stack(vals) if v > 1 else vals[0].reshape((1,) + inner))
+            out[name] = jax.make_array_from_single_device_arrays(
+                (pp * v,) + inner, sharding, shards
+            )
+        return out
+
+    def _build_train_fn(self):
+        from ....jit.api import functional_call
+
+        template = self._layers.stage_module(0)
+        loss_fn_user = self._layers._loss_fn
+        mesh, v = self._pp_mesh, self._v
+
+        def stage_fn(ptree, x):
+            out = functional_call(template, ptree, Tensor(x))
+            return out._value if isinstance(out, Tensor) else jax.tree_util.tree_map(
+                lambda t: t._value if isinstance(t, Tensor) else t, out
+            )
+
+        run = (
+            pipeline_spmd_interleave(stage_fn, mesh, v)
+            if v > 1
+            else pipeline_spmd(stage_fn, mesh)
+        )
+
+        from ....framework import random as random_mod
+
+        gen = random_mod.default_generator()
+
+        def loss_fn(stacked, mbs, lbs, rng):
+            # rng threads in as a runtime input (like jit/api.py's replay) so
+            # stochastic layers get fresh keys per call instead of one key
+            # baked at trace time. Note: the scan body is traced once, so
+            # micro-batches within one batch share dropout masks (each mask
+            # still covers the whole micro-batch; fresh per train_batch call).
+            with gen.trace_scope(rng):
+                outs = run(stacked, mbs)  # [M, mb, ...] final-stage outputs
+                losses = jax.vmap(
+                    lambda o, l: loss_fn_user(Tensor(o), Tensor(l))._value
+                )(outs, lbs)
+                return jnp.mean(losses)
+
+        self._train_fn = jax.jit(jax.value_and_grad(loss_fn))
+        self._next_rng = random_mod.next_key
+
+    def _spmd_train_batch(self, inputs, labels, optimizer, lr_scheduler, scaler):
+        if isinstance(inputs, (tuple, list)) or isinstance(labels, (tuple, list)):
+            raise NotImplementedError(
+                "compiled pp schedule takes single input/label Tensors"
+            )
+        n = self.accumulate_steps
+        B = inputs.shape[0]
+        if B != self.micro_batch_size * n:
+            raise ValueError(
+                f"batch size {B} != micro_batch_size {self.micro_batch_size}"
+                f" * accumulate_steps {n} (reference pipeline_configs contract)"
+            )
+        mb = B // n
+        mbs = inputs._value.reshape((n, mb) + tuple(inputs.shape[1:]))
+        lbs = labels._value.reshape((n, mb) + tuple(labels.shape[1:]))
+        if self._train_fn is None:
+            self._build_train_fn()
+        stacked = self._gather_stacked()
+        loss, grads = self._train_fn(stacked, mbs, lbs, self._next_rng())
+        if scaler is not None:
+            scale = scaler._scale._value if hasattr(scaler, "_scale") else 1.0
+            grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
+        pp, v = self._pp_world, self._v
+        for k in range(self._layers.num_chunks):
+            d, c = k % pp, k // pp
+            row = d * v + c
+            dev = self._stage_device(k)
+            for name, t in self._layers.stage_module(k).state_dict().items():
+                if t.stop_gradient:
+                    continue
+                # the row's data already lives on rank d — pin the slice to
+                # that single device so the per-param update runs there
+                g = jax.device_put(grads[name][row], dev)
+                t.grad = Tensor(g) if t.grad is None else Tensor(t.grad._value + g)
+        # stacking params across ranks inside the optimizer would undo the
+        # placement — per-param updates run on each param's own device
+        optimizer.disable_fusion()
+        if scaler is not None:
+            scaler.step(optimizer)
+            scaler.update()
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        self.total_loss = Tensor(loss)
+        return self.total_loss
+
+    # ---- public API ----
     def forward(self, *args, **kwargs):
         return self._layers(*args, **kwargs)
 
@@ -59,14 +237,14 @@ class PipelineParallel(Layer):
         return self._layers
 
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None) -> Tensor:
-        """Run one global batch: 1F1B-equivalent micro-batch accumulation.
-
-        data: (inputs, labels) where inputs/labels may be Tensors or tuples.
-        Returns the averaged loss (reference train_batch semantics).
-        """
+        """Run one global batch. Compiled SPMD schedule when stages are
+        uniform; micro-batch accumulation over placed stages otherwise.
+        Returns the averaged loss (reference train_batch semantics)."""
         if self._layers._loss_fn is None:
             raise ValueError("PipelineLayer needs loss_fn for train_batch")
         inputs, labels = data
+        if self._spmd:
+            return self._spmd_train_batch(inputs, labels, optimizer, lr_scheduler, scaler)
         n = self.accumulate_steps
         first = inputs[0] if isinstance(inputs, (tuple, list)) else inputs
         batch = first.shape[0]
@@ -77,6 +255,10 @@ class PipelineParallel(Layer):
             )
         micro_inputs = _split_microbatches(inputs, n)
         micro_labels = _split_microbatches(labels, n)
+        if self._pp_mesh is not None:
+            # params live on different pp devices; a stacked fused update
+            # would pull them onto one device
+            optimizer.disable_fusion()
 
         total = None
         for mb_in, mb_lb in zip(micro_inputs, micro_labels):
@@ -109,7 +291,9 @@ class PipelineParallel(Layer):
 
 
 class PipelineParallelWithInterleave(PipelineParallel):
-    """VPP schedule (reference :942). Under a compiled pipeline the virtual
-    stage interleave is a scheduling detail of the SPMD path; the general
-    path's numerics are schedule-invariant, so this subclass shares
-    train_batch."""
+    """VPP schedule (reference :942): v virtual stage chunks per pp rank,
+    assigned round-robin, run by the circular compiled schedule
+    (spmd_pipeline.pipeline_spmd_interleave) — fill/drain bubble shrinks by
+    ~v, the same economics as the reference's interleaved 1F1B."""
+
+    _interleave = True

@@ -26,7 +26,7 @@ class GroupShardedOptimizerStage2:
     def __init__(self, params, optim, group=None, offload=False, device="tpu", **kw):
         self._inner_opt = optim
         # ZeRO shards per-accumulator; the flat fused path would hide them
-        optim._fuse_allowed = False
+        optim.disable_fusion()
         self._group = group
         self._mesh = utils.group_mesh(group)
         self._axis = utils.group_axis_name(group)

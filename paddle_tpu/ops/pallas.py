@@ -1,10 +1,19 @@
-"""Pallas TPU kernels (flash attention first; more hot ops over time).
+"""Pallas TPU kernels (flash attention; more hot ops over time).
 
-Reference parity: the role of paddle/phi/kernels/gpu/flash_attn_kernel.cu and
-the fused CUDA ops in paddle/fluid/operators/fused/ — but written as Pallas
-TPU kernels (MXU-tiled, VMEM-resident softmax accumulators) per
+Reference parity: the role of paddle/phi/kernels/gpu/flash_attn_kernel.cu
+(forward AND backward flash kernels) and the fused CUDA ops in
+paddle/fluid/operators/fused/ — but written as Pallas TPU kernels
+(MXU-tiled, VMEM-resident softmax accumulators) per
 /opt/skills/guides/pallas_guide.md. Falls back to the XLA-fused reference
 implementation when the platform or shapes don't fit the kernel grid.
+
+Shapes: [B, S, H, D] (paddle layout). Self- AND cross-attention are
+supported (kv length may differ from q length — the kv-cache prefill /
+encoder-decoder case); causal masking uses bottom-right alignment when
+kv is longer than q (flash-attn convention, matches the XLA reference
+chain below). The backward is the recompute-based O(S) flash backward:
+forward saves only (out, logsumexp); dq/dk/dv kernels recompute the
+probability tiles blockwise.
 """
 from __future__ import annotations
 
@@ -13,12 +22,18 @@ import math
 
 import jax
 from jax import numpy as jnp
+from jax.experimental import pallas as pl
 
 _BLOCK_Q = 128
 _BLOCK_K = 128
 
+# tests on the CPU mesh flip this to run kernels in pallas interpret mode
+_INTERPRET = False
+
 
 def _on_tpu() -> bool:
+    if _INTERPRET:
+        return True
     try:
         return jax.devices()[0].platform in ("tpu", "axon")
     except Exception:
@@ -26,24 +41,37 @@ def _on_tpu() -> bool:
 
 
 def flash_attention_usable(q, causal, dropout_p, k=None, v=None) -> bool:
-    """Kernel constraints: TPU platform, no dropout, self-attention shapes
-    (q==k==v layout), seq multiple of the block, head_dim <= 256. [B,S,H,D]."""
+    """Kernel constraints: TPU platform, no dropout, q seq and kv seq each a
+    multiple of the block, head_dim <= 256. Cross-attention / kv-cache
+    prefill (kv length != q length) is supported; only batch/heads/head_dim
+    must match. [B, S, H, D]."""
     if dropout_p > 0.0:
         return False
     if not _on_tpu():
         return False
     if q.ndim != 4:
         return False
+    b, sq, h, d = q.shape
+    if not (sq % _BLOCK_Q == 0 and d <= 256 and sq >= _BLOCK_Q):
+        return False
     for other in (k, v):
-        if other is not None and tuple(other.shape) != tuple(q.shape):
-            return False  # cross-attention / kv-cache: fall back to XLA chain
-    b, s, h, d = q.shape
-    return s % _BLOCK_Q == 0 and d <= 256 and s >= _BLOCK_Q
+        if other is None:
+            continue
+        ob, sk, oh, od = other.shape
+        if (ob, oh, od) != (b, h, d):
+            return False
+        if not (sk % _BLOCK_K == 0 and sk >= _BLOCK_K):
+            return False
+        if causal and sk < sq:
+            # bottom-right-aligned causal with kv shorter than q fully masks
+            # the leading q rows (0/0 in the kernel; the XLA chain's output
+            # for those rows is garbage-by-construction too) — fall back
+            return False
+    return True
 
 
 def _ref_attention_bshd(q, k, v, causal, sm_scale):
-    """XLA reference chain (used for the backward pass until the Pallas
-    backward kernel lands — flash backward recomputes anyway)."""
+    """XLA reference chain (fallback + numerics oracle in tests)."""
     qh = jnp.swapaxes(q, 1, 2)
     kh = jnp.swapaxes(k, 1, 2)
     vh = jnp.swapaxes(v, 1, 2)
@@ -59,76 +87,42 @@ def _ref_attention_bshd(q, k, v, causal, sm_scale):
     return jnp.swapaxes(out, 1, 2)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def flash_attention_bshd(q, k, v, causal=False, sm_scale=None):
-    return _flash_attention_fwd_impl(q, k, v, causal, sm_scale)
+# ---------------------------------------------------------------------------
+# forward kernel: online softmax over K blocks, emits out + logsumexp
+# ---------------------------------------------------------------------------
 
+def _fwd_kernels(sq, sk, d, causal, scale):
+    n_k = sk // _BLOCK_K
+    off = sk - sq  # causal bottom-right alignment offset
 
-def _flash_fwd(q, k, v, causal, sm_scale):
-    return _flash_attention_fwd_impl(q, k, v, causal, sm_scale), (q, k, v)
-
-
-def _flash_bwd(causal, sm_scale, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(lambda a, b, c: _ref_attention_bshd(a, b, c, causal, sm_scale), q, k, v)
-    return vjp(g)
-
-
-flash_attention_bshd.defvjp(_flash_fwd, _flash_bwd)
-
-
-def _flash_attention_fwd_impl(q, k, v, causal=False, sm_scale=None):
-    # Mosaic rejects i64 grid/index types, and the framework enables x64
-    # globally (paddle dtype semantics) — trace the kernel with x64 off.
-    # All kernel dtypes are explicit so numerics are unchanged.
-    with jax.enable_x64(False):
-        return _flash_attention_fwd_x32(q, k, v, causal, sm_scale)
-
-
-@functools.partial(jax.jit, static_argnames=("causal", "sm_scale"))
-def _flash_attention_fwd_x32(q, k, v, causal=False, sm_scale=None):
-    """Flash attention on [B, S, H, D]: online-softmax over K blocks.
-
-    Grid: (batch*heads, q_blocks); each program instance streams K/V blocks
-    through VMEM keeping the (m, l, acc) running softmax state — the standard
-    TPU flash pattern (pallas_guide.md)."""
-    from jax.experimental import pallas as pl
-
-    b, s, h, d = q.shape
-    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
-    # -> [B*H, S, D]
-    qr = jnp.swapaxes(q, 1, 2).reshape(b * h, s, d)
-    kr = jnp.swapaxes(k, 1, 2).reshape(b * h, s, d)
-    vr = jnp.swapaxes(v, 1, 2).reshape(b * h, s, d)
-
-    n_q = s // _BLOCK_Q
-
-    def kernel(q_ref, k_ref, v_ref, o_ref):
+    def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref):
         qi = pl.program_id(1)
         qb = q_ref[...].astype(jnp.float32) * scale
 
-        # (BQ, 1) 2-D running stats: Mosaic wants >=2-D vregs in loop carry
         m0 = jnp.full((_BLOCK_Q, 1), -1e30, jnp.float32)
         l0 = jnp.zeros((_BLOCK_Q, 1), jnp.float32)
         acc0 = jnp.zeros((_BLOCK_Q, d), jnp.float32)
 
-        n_k = s // _BLOCK_K
-        # NB: no traced floordiv here — x64 mode + pallas floor_divide
-        # recurses in promote_dtypes (jax 0.9); BLOCK_Q % BLOCK_K == 0 so a
-        # static ratio multiply is exact.
-        kmax = (qi + 1) * (_BLOCK_Q // _BLOCK_K) if causal else n_k
+        if causal:
+            # last k position visible to this q block: off + (qi+1)*BQ - 1
+            kmax_dyn = (off + (qi + 1) * _BLOCK_Q + _BLOCK_K - 1) // _BLOCK_K
+            kmax = jnp.minimum(jnp.asarray(kmax_dyn, jnp.int32), n_k)
+        else:
+            kmax = jnp.asarray(n_k, jnp.int32)
 
         def body(ki, carry):
             m, l, acc = carry
-            # all index math in i32: x64 mode makes fori_loop indices i64,
-            # which Mosaic's arith.muli/trunc legalization rejects
             ki = jnp.asarray(ki, jnp.int32)
             kb = k_ref[pl.dslice(ki * _BLOCK_K, _BLOCK_K), :].astype(jnp.float32)
             vb = v_ref[pl.dslice(ki * _BLOCK_K, _BLOCK_K), :].astype(jnp.float32)
-            logits = qb @ kb.T  # [BQ, BK] on MXU
+            logits = qb @ kb.T
             if causal:
-                qpos = qi * _BLOCK_Q + jax.lax.broadcasted_iota(jnp.int32, (_BLOCK_Q, _BLOCK_K), 0)
-                kpos = ki * _BLOCK_K + jax.lax.broadcasted_iota(jnp.int32, (_BLOCK_Q, _BLOCK_K), 1)
+                qpos = off + qi * _BLOCK_Q + jax.lax.broadcasted_iota(
+                    jnp.int32, (_BLOCK_Q, _BLOCK_K), 0
+                )
+                kpos = ki * _BLOCK_K + jax.lax.broadcasted_iota(
+                    jnp.int32, (_BLOCK_Q, _BLOCK_K), 1
+                )
                 logits = jnp.where(qpos >= kpos, logits, -1e30)
             m_new = jnp.maximum(m, jnp.max(logits, axis=-1, keepdims=True))
             p = jnp.exp(logits - m_new)
@@ -138,20 +132,233 @@ def _flash_attention_fwd_x32(q, k, v, causal=False, sm_scale=None):
             return m_new, l_new, acc_new
 
         m, l, acc = jax.lax.fori_loop(
-            jnp.asarray(0, jnp.int32), jnp.asarray(kmax, jnp.int32), body, (m0, l0, acc0)
+            jnp.asarray(0, jnp.int32), kmax, body, (m0, l0, acc0)
         )
         o_ref[...] = (acc / l).astype(o_ref.dtype)
+        lse_ref[...] = (m + jnp.log(l)).astype(jnp.float32)
 
-    out = pl.pallas_call(
-        kernel,
+    return kernel
+
+
+def _flash_fwd_impl(q, k, v, causal, sm_scale):
+    """[B, S, H, D] -> (out, lse[B*H, Sq, 1])."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+    qr = jnp.swapaxes(q, 1, 2).reshape(b * h, sq, d)
+    kr = jnp.swapaxes(k, 1, 2).reshape(b * h, sk, d)
+    vr = jnp.swapaxes(v, 1, 2).reshape(b * h, sk, d)
+    n_q = sq // _BLOCK_Q
+
+    out, lse = pl.pallas_call(
+        _fwd_kernels(sq, sk, d, causal, scale),
         grid=(b * h, n_q),
         in_specs=[
             pl.BlockSpec((None, _BLOCK_Q, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((None, s, d), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((None, s, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((None, sk, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((None, sk, d), lambda bh, qi: (bh, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, _BLOCK_Q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((None, _BLOCK_Q, 1), lambda bh, qi: (bh, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sq, 1), jnp.float32),
+        ],
+        interpret=_INTERPRET,
+    )(qr, kr, vr)
+    return jnp.swapaxes(out.reshape(b, h, sq, d), 1, 2), lse
+
+
+# ---------------------------------------------------------------------------
+# backward kernels: recompute-based (O(S) memory), FA2 formulation
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(sq, sk, d, causal, scale):
+    n_k = sk // _BLOCK_K
+    off = sk - sq
+
+    def kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref):
+        qi = pl.program_id(1)
+        qb = q_ref[...].astype(jnp.float32)
+        dob = do_ref[...].astype(jnp.float32)
+        lse = lse_ref[...].astype(jnp.float32)      # [BQ, 1]
+        delta = delta_ref[...].astype(jnp.float32)  # [BQ, 1]
+
+        if causal:
+            kmax_dyn = (off + (qi + 1) * _BLOCK_Q + _BLOCK_K - 1) // _BLOCK_K
+            kmax = jnp.minimum(jnp.asarray(kmax_dyn, jnp.int32), n_k)
+        else:
+            kmax = jnp.asarray(n_k, jnp.int32)
+
+        def body(ki, dq):
+            ki = jnp.asarray(ki, jnp.int32)
+            kb = k_ref[pl.dslice(ki * _BLOCK_K, _BLOCK_K), :].astype(jnp.float32)
+            vb = v_ref[pl.dslice(ki * _BLOCK_K, _BLOCK_K), :].astype(jnp.float32)
+            s = (qb @ kb.T) * scale
+            if causal:
+                qpos = off + qi * _BLOCK_Q + jax.lax.broadcasted_iota(
+                    jnp.int32, (_BLOCK_Q, _BLOCK_K), 0
+                )
+                kpos = ki * _BLOCK_K + jax.lax.broadcasted_iota(
+                    jnp.int32, (_BLOCK_Q, _BLOCK_K), 1
+                )
+                s = jnp.where(qpos >= kpos, s, -1e30)
+            p = jnp.exp(s - lse)
+            dp = dob @ vb.T
+            ds = p * (dp - delta) * scale
+            return dq + ds @ kb
+
+        dq = jax.lax.fori_loop(
+            jnp.asarray(0, jnp.int32), kmax, body, jnp.zeros((_BLOCK_Q, d), jnp.float32)
+        )
+        dq_ref[...] = dq.astype(dq_ref.dtype)
+
+    return kernel
+
+
+def _bwd_dkdv_kernel(sq, sk, d, causal, scale):
+    n_q = sq // _BLOCK_Q
+    off = sk - sq
+
+    def kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref):
+        ki = pl.program_id(1)
+        kb = k_ref[...].astype(jnp.float32)
+        vb = v_ref[...].astype(jnp.float32)
+
+        if causal:
+            # first q block whose last position sees this k block:
+            # need off + q_end > ki*BK  ->  q from (ki*BK - off) // BQ
+            qmin_dyn = jnp.maximum(ki * _BLOCK_K - off, 0) // _BLOCK_Q
+            qmin = jnp.asarray(qmin_dyn, jnp.int32)
+        else:
+            qmin = jnp.asarray(0, jnp.int32)
+
+        def body(qi, carry):
+            dk, dv = carry
+            qi = jnp.asarray(qi, jnp.int32)
+            qb = q_ref[pl.dslice(qi * _BLOCK_Q, _BLOCK_Q), :].astype(jnp.float32)
+            dob = do_ref[pl.dslice(qi * _BLOCK_Q, _BLOCK_Q), :].astype(jnp.float32)
+            lse = lse_ref[pl.dslice(qi * _BLOCK_Q, _BLOCK_Q), :].astype(jnp.float32)
+            delta = delta_ref[pl.dslice(qi * _BLOCK_Q, _BLOCK_Q), :].astype(jnp.float32)
+            s = (qb @ kb.T) * scale
+            if causal:
+                qpos = off + qi * _BLOCK_Q + jax.lax.broadcasted_iota(
+                    jnp.int32, (_BLOCK_Q, _BLOCK_K), 0
+                )
+                kpos = ki * _BLOCK_K + jax.lax.broadcasted_iota(
+                    jnp.int32, (_BLOCK_Q, _BLOCK_K), 1
+                )
+                s = jnp.where(qpos >= kpos, s, -1e30)
+            p = jnp.exp(s - lse)
+            dv2 = dv + p.T @ dob
+            dp = dob @ vb.T
+            ds = p * (dp - delta) * scale
+            dk2 = dk + ds.T @ qb
+            return dk2, dv2
+
+        dk, dv = jax.lax.fori_loop(
+            qmin,
+            jnp.asarray(n_q, jnp.int32),
+            body,
+            (jnp.zeros((_BLOCK_K, d), jnp.float32), jnp.zeros((_BLOCK_K, d), jnp.float32)),
+        )
+        dk_ref[...] = dk.astype(dk_ref.dtype)
+        dv_ref[...] = dv.astype(dv_ref.dtype)
+
+    return kernel
+
+
+def _flash_bwd_impl(q, k, v, out, lse, g, causal, sm_scale):
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+    qr = jnp.swapaxes(q, 1, 2).reshape(b * h, sq, d)
+    kr = jnp.swapaxes(k, 1, 2).reshape(b * h, sk, d)
+    vr = jnp.swapaxes(v, 1, 2).reshape(b * h, sk, d)
+    orr = jnp.swapaxes(out, 1, 2).reshape(b * h, sq, d)
+    gr = jnp.swapaxes(g, 1, 2).reshape(b * h, sq, d)
+    # delta_i = rowsum(dO * O) — cheap, XLA-fused
+    delta = jnp.sum(
+        gr.astype(jnp.float32) * orr.astype(jnp.float32), axis=-1, keepdims=True
+    )
+
+    n_q, n_k = sq // _BLOCK_Q, sk // _BLOCK_K
+    dq = pl.pallas_call(
+        _bwd_dq_kernel(sq, sk, d, causal, scale),
+        grid=(b * h, n_q),
+        in_specs=[
+            pl.BlockSpec((None, _BLOCK_Q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((None, sk, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((None, sk, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((None, _BLOCK_Q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((None, _BLOCK_Q, 1), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((None, _BLOCK_Q, 1), lambda bh, qi: (bh, qi, 0)),
         ],
         out_specs=pl.BlockSpec((None, _BLOCK_Q, d), lambda bh, qi: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
-    )(qr, kr, vr)
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        interpret=_INTERPRET,
+    )(qr, kr, vr, gr, lse, delta)
 
-    return jnp.swapaxes(out.reshape(b, h, s, d), 1, 2)
+    dk, dv = pl.pallas_call(
+        _bwd_dkdv_kernel(sq, sk, d, causal, scale),
+        grid=(b * h, n_k),
+        in_specs=[
+            pl.BlockSpec((None, sq, d), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((None, _BLOCK_K, d), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((None, _BLOCK_K, d), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((None, sq, d), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((None, sq, 1), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((None, sq, 1), lambda bh, ki: (bh, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, _BLOCK_K, d), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((None, _BLOCK_K, d), lambda bh, ki: (bh, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
+        ],
+        interpret=_INTERPRET,
+    )(qr, kr, vr, gr, lse, delta)
+
+    unshape = lambda a, s: jnp.swapaxes(a.reshape(b, h, s, d), 1, 2)
+    return unshape(dq, sq), unshape(dk, sk), unshape(dv, sk)
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp wiring
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_attention_bshd(q, k, v, causal=False, sm_scale=None):
+    out, _ = _flash_fwd_x32_wrap(q, k, v, causal, sm_scale)
+    return out
+
+
+def _flash_fwd(q, k, v, causal, sm_scale):
+    out, lse = _flash_fwd_x32_wrap(q, k, v, causal, sm_scale)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(causal, sm_scale, res, g):
+    q, k, v, out, lse = res
+    with jax.enable_x64(False):
+        return _flash_bwd_impl(q, k, v, out, lse, g, causal, sm_scale)
+
+
+flash_attention_bshd.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _flash_fwd_x32_wrap(q, k, v, causal, sm_scale):
+    # Mosaic rejects i64 grid/index types, and the framework enables x64
+    # globally (paddle dtype semantics) — trace the kernel with x64 off.
+    # All kernel dtypes are explicit so numerics are unchanged.
+    with jax.enable_x64(False):
+        return _flash_fwd_jit(q, k, v, causal, sm_scale)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "sm_scale"))
+def _flash_fwd_jit(q, k, v, causal=False, sm_scale=None):
+    return _flash_fwd_impl(q, k, v, causal, sm_scale)

@@ -103,15 +103,17 @@ class Optimizer:
         for entries in self._collect_entries():
             self._apply_entries(entries)
 
-    def _collect_entries(self):
+    def _collect_entries(self, apply_clip=True):
         """Per param-group: [(param, grad, weight_decay, lr_scale)] with
-        grad clip applied and per-param overrides resolved."""
+        grad clip applied (unless apply_clip=False — bucket-composition-only
+        consumers like _materialize_state skip the clip graph) and per-param
+        overrides resolved."""
         out = []
         for group, params_grads in self._grouped_params_grads():
             if not params_grads:
                 continue
             clip = group.get("grad_clip", self._grad_clip)
-            if clip is not None:
+            if clip is not None and apply_clip:
                 params_grads = clip(params_grads)
             wd = group.get("weight_decay", self._weight_decay)
             lr_scale = group.get("learning_rate", 1.0)
@@ -209,6 +211,13 @@ class Optimizer:
         for st in list(self._fused_buckets.values()):
             self._defuse_bucket(st)
         self._fused_buckets.clear()
+
+    def disable_fusion(self):
+        """Switch to per-param updates, preserving any state already living
+        in fused buckets (wrappers that need per-param accumulators —
+        shard_optimizer, ZeRO sharding, pipeline placement — call this)."""
+        self._fuse_allowed = False
+        self._defuse_all()
 
     def _accumulator_view(self):
         """name -> {id(param): Tensor}, with fused buckets exposed as
@@ -370,6 +379,10 @@ class Adam(Optimizer):
         buckets = defaultdict(list)
         rest = []
         if not getattr(self, "_fuse_allowed", True):
+            if self._fused_buckets:
+                # fusion was turned off by poking the flag: migrate bucket
+                # state to per-param instead of silently resetting moments
+                self._defuse_all()
             return buckets, [(p, g, self._effective_wd(p, wd), s) for p, g, wd, s in entries]
         for p, g, wd, s in entries:
             wd = self._effective_wd(p, wd)
@@ -386,7 +399,7 @@ class Adam(Optimizer):
         return buckets, rest
 
     def _materialize_state(self):
-        for entries in self._collect_entries():
+        for entries in self._collect_entries(apply_clip=False):
             buckets, _ = self._fuse_partition(entries)
             for plist in buckets.values():
                 if len(plist) > 1:

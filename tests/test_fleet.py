@@ -201,12 +201,26 @@ def test_train_batch_validates_micro_batch_contract():
     hcg = fleet.get_hybrid_communicate_group()
     strategy = fleet.DistributedStrategy()
     strategy.pipeline_configs = {"accumulate_steps": 4, "micro_batch_size": 3}
-    pipe = PipelineLayer(layers=[LayerDesc(nn.Linear, 4, 4)], num_stages=1, loss_fn=nn.MSELoss())
+    pipe = PipelineLayer(
+        layers=[LayerDesc(nn.Linear, 4, 4), LayerDesc(nn.Linear, 4, 4)],
+        num_stages=2, loss_fn=nn.MSELoss(),
+    )
     engine = PipelineParallel(pipe, hcg, strategy)
     opt = paddle.optimizer.SGD(0.1, parameters=pipe.parameters())
     xs = paddle.to_tensor(np.zeros((8, 4), np.float32))
     with pytest.raises(ValueError):
         engine.train_batch((xs, xs), opt)
+
+
+def test_pipeline_stage_world_mismatch_raises():
+    from paddle_tpu.distributed.fleet import LayerDesc, PipelineLayer, PipelineParallel
+
+    hcg = fleet.get_hybrid_communicate_group()  # pp degree 2
+    pipe = PipelineLayer(
+        layers=[LayerDesc(nn.Linear, 4, 4)], num_stages=1, loss_fn=nn.MSELoss()
+    )
+    with pytest.raises(ValueError, match="pp degree"):
+        PipelineParallel(pipe, hcg, fleet.DistributedStrategy())
 
 
 def test_pipeline_layer_segmentation():
@@ -302,3 +316,170 @@ def test_fleet_distributed_model_and_optimizer():
     opt.step()
     assert fleet.worker_num() >= 1
     assert fleet.is_first_worker()
+
+
+def test_pipeline_uniform_spmd_path_matches_single_device():
+    """Uniform stages: compiled SPMD schedule engages; stage params are
+    placed on their pp rank; loss + updated weights == single device
+    (reference test_dist_base.py:959 criterion)."""
+    from paddle_tpu.distributed.fleet import LayerDesc, PipelineLayer, PipelineParallel
+
+    hcg = fleet.get_hybrid_communicate_group()
+    strategy = fleet.DistributedStrategy()
+    strategy.pipeline_configs = {"accumulate_steps": 4, "micro_batch_size": 2}
+
+    def build():
+        paddle.seed(11)
+        return PipelineLayer(
+            layers=[LayerDesc(nn.Linear, 8, 8), LayerDesc(nn.Tanh),
+                    LayerDesc(nn.Linear, 8, 8), LayerDesc(nn.Tanh)],
+            num_stages=2,
+            loss_fn=nn.MSELoss(),
+        )
+
+    pipe = build()
+    engine = PipelineParallel(pipe, hcg, strategy)
+    assert engine._spmd, "uniform stages must take the compiled SPMD schedule"
+    # placement: the two stages' params live on different pp devices
+    d0 = next(iter(pipe.stage_module(0).state_dict().values()))._value.devices()
+    d1 = next(iter(pipe.stage_module(1).state_dict().values()))._value.devices()
+    assert d0 != d1, (d0, d1)
+
+    opt = paddle.optimizer.SGD(0.1, parameters=pipe.parameters())
+    xs = np.random.RandomState(0).randn(8, 8).astype(np.float32)
+    ys = np.random.RandomState(1).randn(8, 8).astype(np.float32)
+    loss = engine.train_batch((paddle.to_tensor(xs), paddle.to_tensor(ys)), opt)
+
+    ref = build()
+    ropt = paddle.optimizer.SGD(0.1, parameters=ref.parameters())
+    rloss = nn.MSELoss()(ref(paddle.to_tensor(xs)), paddle.to_tensor(ys))
+    rloss.backward()
+    ropt.step()
+    np.testing.assert_allclose(float(loss), float(rloss), rtol=1e-5)
+    for k in range(2):
+        for (n1, t1), (n2, t2) in zip(
+            sorted(pipe.stage_module(k).state_dict().items()),
+            sorted(ref.stage_module(k).state_dict().items()),
+        ):
+            np.testing.assert_allclose(t1.numpy(), t2.numpy(), rtol=1e-4, atol=1e-6, err_msg=n1)
+
+
+def test_pipeline_interleave_vpp_matches_single_device():
+    """VPP: 4 uniform chunks round-robin on 2 pp ranks (circular schedule)."""
+    from paddle_tpu.distributed.fleet import LayerDesc, PipelineLayer
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "pp_degree": 2}
+    strategy.pipeline_configs = {"accumulate_steps": 4, "micro_batch_size": 2}
+    fleet.init(is_collective=True, strategy=strategy)
+
+    def build(v):
+        paddle.seed(12)
+        return PipelineLayer(
+            layers=[LayerDesc(nn.Linear, 6, 6), LayerDesc(nn.Tanh),
+                    LayerDesc(nn.Linear, 6, 6), LayerDesc(nn.Tanh),
+                    LayerDesc(nn.Linear, 6, 6), LayerDesc(nn.Tanh),
+                    LayerDesc(nn.Linear, 6, 6), LayerDesc(nn.Tanh)],
+            num_stages=2,
+            loss_fn=nn.MSELoss(),
+            num_virtual_pipeline_stages=v,
+        )
+
+    try:
+        pipe = build(2)
+        engine = fleet.distributed_model(pipe)
+        from paddle_tpu.distributed.fleet.meta_parallel.pipeline_parallel import (
+            PipelineParallelWithInterleave,
+        )
+
+        assert isinstance(engine, PipelineParallelWithInterleave)
+        assert engine._spmd
+        # round-robin placement: chunks 0,2 on rank 0; chunks 1,3 on rank 1
+        devs = [next(iter(pipe.stage_module(k).state_dict().values()))._value.devices()
+                for k in range(4)]
+        assert devs[0] == devs[2] and devs[1] == devs[3] and devs[0] != devs[1]
+
+        opt = paddle.optimizer.SGD(0.1, parameters=pipe.parameters())
+        xs = np.random.RandomState(2).randn(8, 6).astype(np.float32)
+        ys = np.random.RandomState(3).randn(8, 6).astype(np.float32)
+        loss = engine.train_batch((paddle.to_tensor(xs), paddle.to_tensor(ys)), opt)
+
+        ref = build(1)  # single chunk stream, same layer stack
+        ropt = paddle.optimizer.SGD(0.1, parameters=ref.parameters())
+        rloss = nn.MSELoss()(ref(paddle.to_tensor(xs)), paddle.to_tensor(ys))
+        rloss.backward()
+        ropt.step()
+        np.testing.assert_allclose(float(loss), float(rloss), rtol=1e-5)
+        # updated weights must match layer-by-layer — a transposed grad-row
+        # mapping (row = c*pp+d vs d*v+c) would scramble chunk updates
+        for i in (0, 2, 4, 6):
+            np.testing.assert_allclose(
+                pipe.run_function[i].weight.numpy(),
+                ref.run_function[i].weight.numpy(),
+                rtol=1e-4, atol=1e-6, err_msg=f"layer {i} weight",
+            )
+    finally:
+        # restore module-level topology for later tests
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 2, "pp_degree": 2}
+        fleet.init(is_collective=True, strategy=strategy)
+
+
+def test_pipeline_nonuniform_places_stages():
+    """Non-uniform stages: general path still places params per pp rank and
+    matches single-device numerics (transfer op in the tape)."""
+    from paddle_tpu.distributed.fleet import LayerDesc, PipelineLayer, PipelineParallel
+
+    hcg = fleet.get_hybrid_communicate_group()
+    strategy = fleet.DistributedStrategy()
+    strategy.pipeline_configs = {"accumulate_steps": 2, "micro_batch_size": 3}
+
+    def build():
+        paddle.seed(13)
+        return PipelineLayer(
+            layers=[LayerDesc(nn.Linear, 5, 16), LayerDesc(nn.GELU), LayerDesc(nn.Linear, 16, 2)],
+            num_stages=2,
+            loss_fn=nn.MSELoss(),
+        )
+
+    pipe = build()
+    engine = PipelineParallel(pipe, hcg, strategy)
+    assert not engine._spmd
+    d0 = pipe.run_function[0].weight._value.devices()
+    d1 = pipe.run_function[2].weight._value.devices()
+    assert d0 != d1
+
+    opt = paddle.optimizer.AdamW(0.01, parameters=pipe.parameters())
+    xs = np.random.RandomState(4).randn(6, 5).astype(np.float32)
+    ys = np.random.RandomState(5).randn(6, 2).astype(np.float32)
+    loss = engine.train_batch((paddle.to_tensor(xs), paddle.to_tensor(ys)), opt)
+
+    ref = build()
+    ropt = paddle.optimizer.AdamW(0.01, parameters=ref.parameters())
+    rloss = nn.MSELoss()(ref(paddle.to_tensor(xs)), paddle.to_tensor(ys))
+    rloss.backward()
+    ropt.step()
+    np.testing.assert_allclose(float(loss), float(rloss), rtol=1e-5)
+    np.testing.assert_allclose(
+        pipe.run_function[2].weight.numpy(), ref.run_function[2].weight.numpy(),
+        rtol=1e-4, atol=1e-6,
+    )
+
+
+def test_uniform_stages_rejects_differing_activations():
+    """Same param shapes but different param-free layers must NOT take the
+    stacked SPMD path (would silently run chunk 0's functions everywhere)."""
+    from paddle_tpu.distributed.fleet import LayerDesc, PipelineLayer
+
+    pipe = PipelineLayer(
+        layers=[LayerDesc(nn.Linear, 8, 8), LayerDesc(nn.Tanh),
+                LayerDesc(nn.Linear, 8, 8), LayerDesc(nn.Sigmoid)],
+        num_stages=2, loss_fn=nn.MSELoss(),
+    )
+    assert not pipe.uniform_stages()
+    pipe2 = PipelineLayer(
+        layers=[LayerDesc(nn.Linear, 8, 8), LayerDesc(nn.Dropout, 0.1),
+                LayerDesc(nn.Linear, 8, 8), LayerDesc(nn.Dropout, 0.5)],
+        num_stages=2, loss_fn=nn.MSELoss(),
+    )
+    assert not pipe2.uniform_stages()

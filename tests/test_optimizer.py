@@ -300,3 +300,25 @@ def test_grad_scaler_skip_preserves_loaded_state():
                 np.asarray(sd2[k].numpy(), np.float32), v, rtol=1e-6,
                 err_msg=f"{k} changed across a skipped step",
             )
+
+
+def test_disable_fusion_preserves_moments():
+    """Switching an already-stepped AdamW to per-param updates (what the
+    pp/sharding wrappers do) must keep moments/beta-pows."""
+    paddle.seed(7)
+    m = nn.Sequential(nn.Linear(3, 6), nn.Tanh(), nn.Linear(6, 2))
+    opt = paddle.optimizer.AdamW(0.01, parameters=m.parameters())
+    x = paddle.to_tensor(np.random.RandomState(7).randn(4, 3).astype(np.float32))
+    for _ in range(3):
+        m(x).mean().backward()
+        opt.step(); opt.clear_grad()
+    sd_before = {k: np.asarray(v.numpy(), np.float32) for k, v in opt.state_dict().items()
+                 if k.startswith(("moment", "beta"))}
+    opt.disable_fusion()
+    m(x).mean().backward()
+    opt.step(); opt.clear_grad()
+    sd_after = opt.state_dict()
+    b1p = float(sd_after["beta1_pow_0"].numpy())
+    np.testing.assert_allclose(b1p, float(sd_before["beta1_pow_0"]) * 0.9, rtol=1e-6)
+    # moments evolved from the fused values, not from zero
+    assert not np.allclose(sd_after["moment2_0"].numpy(), 0.0)
