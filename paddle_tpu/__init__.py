@@ -80,6 +80,7 @@ from .ops.creation import (  # noqa: F401,E402
     arange,
     assign,
     bernoulli,
+    binomial,
     clone,
     complex,
     diag,
@@ -100,6 +101,7 @@ from .ops.creation import (  # noqa: F401,E402
     ones_like,
     poisson,
     polar,
+    standard_gamma,
     rand,
     randint,
     randint_like,
@@ -163,6 +165,7 @@ from . import version  # noqa: E402,F401
 from . import sysconfig  # noqa: E402,F401
 from . import sparse  # noqa: E402,F401
 from . import fft  # noqa: E402,F401
+from . import geometric  # noqa: E402,F401
 from . import signal  # noqa: E402,F401
 from . import callbacks  # noqa: E402,F401
 from .hapi import Model, summary  # noqa: E402,F401

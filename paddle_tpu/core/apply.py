@@ -132,13 +132,18 @@ def _closure_sig(fn, depth=0):
         elif isinstance(v, tuple) and all(isinstance(e, _HASHABLE) for e in v):
             sig.append(v)
         elif callable(v):
-            if getattr(v, "__closure__", None):
+            if getattr(v, "__code__", None) is not None:
+                # recurse: id(code) keys the definition site, so two
+                # closure-free lambdas from different lines never collide
+                # (qualname would be '<lambda>' for both)
                 inner = _closure_sig(v, depth + 1)
                 if inner is None:
                     return None
                 sig.append(inner)
-            else:
-                sig.append(getattr(v, "__qualname__", None) or repr(v))
+            else:  # C-level callable: module+qualname identifies it
+                sig.append(
+                    (getattr(v, "__module__", None), getattr(v, "__qualname__", None) or repr(v))
+                )
         else:
             return None
     return tuple(sig)

@@ -2,3 +2,4 @@
 from .prune import prune_configs  # noqa: F401
 from .search import GridSearch, search_space  # noqa: F401
 from .tuner import AutoTuner  # noqa: F401
+from .runners import CalibratedCostModel, MeshTrialRunner  # noqa: F401

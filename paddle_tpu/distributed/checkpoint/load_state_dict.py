@@ -40,16 +40,24 @@ def _read_metadata(path) -> Metadata:
     return merged
 
 
-def _fill_block(path, tm, offset, shape, dtype):
+def _fill_block(path, tm, offset, shape, dtype, mmap_cache=None):
     """Assemble the block [offset, offset+shape) of the global tensor from
-    the saved shards that overlap it."""
+    the saved shards that overlap it. `mmap_cache` (file_name -> mmap array)
+    bounds file opens to one per shard file per load call instead of
+    O(device-blocks x shards) (ADVICE r1)."""
     block = np.zeros(shape, dtype=dtype)
     filled = np.zeros(shape, dtype=bool) if tm.shards else None
     for sh in tm.shards:
         if not slices_overlap(offset, shape, sh.global_offset, sh.local_shape):
             continue
         ioff, ishape = intersection(offset, shape, sh.global_offset, sh.local_shape)
-        src = np.load(os.path.join(path, sh.file_name), mmap_mode="r")
+        if mmap_cache is not None:
+            src = mmap_cache.get(sh.file_name)
+            if src is None:
+                src = np.load(os.path.join(path, sh.file_name), mmap_mode="r")
+                mmap_cache[sh.file_name] = src
+        else:
+            src = np.load(os.path.join(path, sh.file_name), mmap_mode="r")
         src_sel = tuple(slice(o - go, o - go + s) for o, go, s in zip(ioff, sh.global_offset, ishape))
         dst_sel = tuple(slice(o - bo, o - bo + s) for o, bo, s in zip(ioff, offset, ishape))
         block[dst_sel] = src[src_sel]
@@ -65,6 +73,7 @@ def load_state_dict(state_dict, path, process_group=None, coordinator_rank=0):
     re-sharding as needed to each tensor's current placement."""
     meta = _read_metadata(path)
     flat = _flatten_state_dict(state_dict)
+    mmap_cache: dict = {}  # one open mmap per shard file for this call
     missing = []
     for name, t in flat.items():
         tm = meta.state_dict_metadata.get(name) or meta.state_dict_metadata.get(meta.flat_mapping.get(name, ""))
@@ -87,14 +96,14 @@ def load_state_dict(state_dict, path, process_group=None, coordinator_rank=0):
                     (sl.stop if sl.stop is not None else dim) - (sl.start or 0)
                     for sl, dim in zip(idx, tm.global_shape)
                 )
-                block = _fill_block(path, tm, offset, shape, dtype)
+                block = _fill_block(path, tm, offset, shape, dtype, mmap_cache)
                 per_device.append(jax.device_put(block.astype(t._value.dtype), dev))
                 devices.append(dev)
             new_val = jax.make_array_from_single_device_arrays(
                 tuple(tm.global_shape), sharding, per_device
             )
         else:  # scalar or fully-replicated trivial case
-            block = _fill_block(path, tm, (0,) * len(tm.global_shape), tuple(tm.global_shape), dtype)
+            block = _fill_block(path, tm, (0,) * len(tm.global_shape), tuple(tm.global_shape), dtype, mmap_cache)
             new_val = jax.device_put(block.astype(t._value.dtype), sharding)
         t._replace_value(new_val)
     if missing:

@@ -512,3 +512,31 @@ class _StreamNS:
 
 
 stream = _StreamNS()
+
+
+# ---- watchdog wiring (reference comm_task_manager.h) ----
+
+def _watched(fn):
+    """Wrap a collective entry point in a CommTask so a hung dispatch/compile
+    (e.g. wedged tunnel) is detected and aborted with diagnostics."""
+
+    @functools.wraps(fn)
+    def inner(*args, **kwargs):
+        from .comm_watchdog import comm_task
+
+        g = kwargs.get("group")
+        with comm_task(
+            f"collective.{fn.__name__}", ranks=tuple(getattr(g, "ranks", ()) or ()) or "world"
+        ):
+            return fn(*args, **kwargs)
+
+    return inner
+
+
+for _name in (
+    "all_reduce", "all_gather", "broadcast", "reduce", "reduce_scatter",
+    "scatter", "all_to_all", "all_to_all_single", "barrier",
+    "batch_isend_irecv", "wait",
+):
+    globals()[_name] = _watched(globals()[_name])
+del _name

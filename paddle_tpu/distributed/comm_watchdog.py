@@ -1,0 +1,176 @@
+"""Collective hang watchdog.
+
+Reference parity: paddle/phi/core/distributed/comm_task.h:36 (CommTask,
+IsTimeout :127) + comm_task_manager.h:37 (CommTaskManager — a background
+thread that detects hung/errored NCCL collectives and aborts the process
+with diagnostics).
+
+TPU-native design: compiled collectives are XLA program internals — a hang
+surfaces as a host thread blocked in dispatch/compile (tunnel) or in a
+blocking wait (store rendezvous, block_until_ready). So the watchdog tracks
+HOST-SIDE blocking sections: every eager collective dispatch and every store
+wait registers a CommTask; a daemon thread scans them and, past the
+deadline, emits a full diagnostic dump (op, group ranks, elapsed, every
+other in-flight task) and invokes the abort handler — by default
+`os._exit(1)` after printing, matching the reference's abort-on-hang
+semantics. Tests/graceful users install their own handler via
+`set_timeout_handler`.
+
+Config: FLAGS_enable_comm_watchdog (default True),
+FLAGS_comm_watchdog_timeout_s (default 600, the reference's default
+CommTask timeout scale), or per-task timeouts; DistributedStrategy maps
+its `comm_watchdog_timeout` hybrid config here (see fleet/fleet.py).
+"""
+from __future__ import annotations
+
+import itertools
+import os
+import sys
+import threading
+import time
+from typing import Callable, Optional
+
+from ..framework import flags as _flags
+
+_flags.define_flag("FLAGS_enable_comm_watchdog", True, "abort on hung collectives/store waits")
+_flags.define_flag("FLAGS_comm_watchdog_timeout_s", 600.0, "seconds before a comm task is declared hung")
+_flags.define_flag(
+    "FLAGS_comm_watchdog_margin_s", 30.0,
+    "extra grace added to a blocking call's OWN timeout before the watchdog "
+    "declares it stuck (a wait is only 'hung' once past its own deadline)",
+)
+
+
+class CommTask:
+    __slots__ = ("tid", "op", "info", "start", "timeout")
+
+    def __init__(self, tid, op, info, timeout):
+        self.tid = tid
+        self.op = op
+        self.info = info
+        self.start = time.monotonic()
+        self.timeout = timeout
+
+    def elapsed(self) -> float:
+        return time.monotonic() - self.start
+
+    def is_timeout(self) -> bool:
+        return self.elapsed() > self.timeout
+
+    def describe(self) -> str:
+        extra = ", ".join(f"{k}={v}" for k, v in self.info.items())
+        return f"CommTask[{self.tid}] op={self.op} elapsed={self.elapsed():.1f}s timeout={self.timeout:.0f}s {extra}"
+
+
+def _default_handler(task: CommTask, dump: str) -> None:
+    sys.stderr.write(
+        f"\n=== paddle_tpu comm watchdog: HUNG COLLECTIVE DETECTED ===\n"
+        f"{task.describe()}\n--- all in-flight comm tasks ---\n{dump}\n"
+        f"aborting process (reference CommTaskManager semantics)\n"
+    )
+    sys.stderr.flush()
+    os._exit(1)
+
+
+class CommTaskManager:
+    """Singleton scanning thread over in-flight comm tasks."""
+
+    _instance: Optional["CommTaskManager"] = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self):
+        self._tasks: dict = {}
+        self._lock = threading.Lock()
+        self._ids = itertools.count()
+        self._thread: Optional[threading.Thread] = None
+        self._handler: Callable = _default_handler
+        self._wake = threading.Event()
+
+    @classmethod
+    def instance(cls) -> "CommTaskManager":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    # ---- task lifecycle ----
+    def start_task(self, op: str, timeout: Optional[float] = None, **info) -> Optional[int]:
+        if not _flags.get_flag("FLAGS_enable_comm_watchdog"):
+            return None
+        if timeout is None:
+            timeout = float(_flags.get_flag("FLAGS_comm_watchdog_timeout_s"))
+        t = CommTask(next(self._ids), op, info, timeout)
+        with self._lock:
+            self._tasks[t.tid] = t
+            self._ensure_thread()
+        self._wake.set()
+        return t.tid
+
+    def end_task(self, tid: Optional[int]) -> None:
+        if tid is None:
+            return
+        with self._lock:
+            self._tasks.pop(tid, None)
+
+    def set_timeout_handler(self, fn: Optional[Callable]) -> Callable:
+        prev = self._handler
+        self._handler = fn or _default_handler
+        return prev
+
+    def active_tasks(self):
+        with self._lock:
+            return list(self._tasks.values())
+
+    # ---- scanner ----
+    def _ensure_thread(self):
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._scan_loop, name="paddle-tpu-comm-watchdog", daemon=True
+            )
+            self._thread.start()
+
+    def _scan_loop(self):
+        while True:
+            self._wake.wait(timeout=0.1)
+            self._wake.clear()
+            while True:
+                with self._lock:
+                    tasks = list(self._tasks.values())
+                if not tasks:
+                    break
+                for t in tasks:
+                    if t.is_timeout():
+                        dump = "\n".join(x.describe() for x in tasks)
+                        with self._lock:
+                            self._tasks.pop(t.tid, None)
+                        try:
+                            self._handler(t, dump)
+                        except Exception:
+                            pass
+                # scan at 1/10 of the smallest remaining margin, bounded
+                margin = min((t.timeout - t.elapsed() for t in tasks), default=0.5)
+                time.sleep(min(max(margin / 10, 0.02), 0.5))
+
+
+class comm_task:
+    """Context manager wrapping one blocking communication section."""
+
+    def __init__(self, op: str, timeout: Optional[float] = None, **info):
+        self._op = op
+        self._timeout = timeout
+        self._info = info
+        self._tid = None
+
+    def __enter__(self):
+        self._tid = CommTaskManager.instance().start_task(
+            self._op, self._timeout, **self._info
+        )
+        return self
+
+    def __exit__(self, *exc):
+        CommTaskManager.instance().end_task(self._tid)
+        return False
+
+
+def set_timeout_handler(fn: Optional[Callable]) -> Callable:
+    return CommTaskManager.instance().set_timeout_handler(fn)

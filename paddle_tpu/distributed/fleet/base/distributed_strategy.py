@@ -101,6 +101,28 @@ class DistributedStrategy:
         self._tensor_parallel_configs = _ConfigDict(_TENSOR_PARALLEL_DEFAULTS)
         self._gradient_merge_configs = _ConfigDict({"k_steps": 1, "avg": True})
         self.hybrid_parallel_order = list(_HYBRID_DEFAULTS["order"])
+        self._comm_watchdog_timeout = None  # None = keep the flag default
+
+    # ---- collective watchdog (reference comm_task_manager.h) ----
+    @property
+    def comm_watchdog_timeout(self):
+        return self._comm_watchdog_timeout
+
+    @comm_watchdog_timeout.setter
+    def comm_watchdog_timeout(self, seconds):
+        from ....framework import flags as _flags
+        from ...comm_watchdog import CommTaskManager  # noqa: F401 (define flags)
+
+        self._comm_watchdog_timeout = seconds
+        if seconds is None or seconds <= 0:
+            _flags.set_flags({"FLAGS_enable_comm_watchdog": False})
+        else:
+            _flags.set_flags(
+                {
+                    "FLAGS_enable_comm_watchdog": True,
+                    "FLAGS_comm_watchdog_timeout_s": float(seconds),
+                }
+            )
 
     # ---- config-dict accessors (reference setter semantics: merge) ----
     @property

@@ -33,10 +33,6 @@ class GroupShardedOptimizerStage2:
         self._offload = offload
         # stage 1 ("os"): only optimizer states shard, grads stay replicated
         self._stage1 = False
-        if offload:
-            raise NotImplementedError(
-                "offload: host offload on TPU should use jax.sharding memory kinds; not yet wired"
-            )
 
     # paddle code reaches for these
     @property
@@ -47,9 +43,13 @@ class GroupShardedOptimizerStage2:
         return getattr(self._inner_opt, name)
 
     def _shard_states(self):
+        # offload=True: accumulators live sharded in HOST memory (jax
+        # memory kinds) and XLA streams them through the update — the
+        # reference's offload cpu placement of optimizer states
+        kind = "pinned_host" if self._offload else None
         for name, by_param in self._inner_opt._accumulators.items():
             for t in by_param.values():
-                utils.place_sharded(t, self._mesh, self._axis)
+                utils.place_sharded(t, self._mesh, self._axis, memory_kind=kind)
 
     def step(self):
         # grads arrive from backward; reduce-scatter = sharded placement of

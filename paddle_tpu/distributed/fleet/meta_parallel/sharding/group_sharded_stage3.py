@@ -20,17 +20,54 @@ class GroupShardedStage3(Layer):
                  device="tpu", segment_size=2**20, pertrain_sync_models=True,
                  offload=False, sync_comm=False, dp_group=None, exclude_layer=None):
         super().__init__()
-        if offload:
-            raise NotImplementedError("offload: use jax host memory kinds; not yet wired")
         self._layers = layer
         self._optim = optimizer
+        self._offload = offload
         self._mesh = utils.group_mesh(group)
         self._axis = utils.group_axis_name(group)
+        if offload:
+            if optimizer is None:
+                raise ValueError(
+                    "GroupShardedStage3(offload=True) needs the optimizer: "
+                    "offload places optimizer states in host memory"
+                )
+            self._wrap_offload_accumulators(optimizer)
         self._shard_params()
 
     def _shard_params(self):
         for p in self._layers.parameters():
             utils.place_sharded(p, self._mesh, self._axis)
+
+    def _wrap_offload_accumulators(self, optimizer):
+        """New accumulators are placed sharded over the group in HOST memory
+        (jax memory kinds) — the reference's offload=True cpu placement of
+        optimizer states; XLA streams them through the update."""
+        optimizer.disable_fusion()
+        orig_add = optimizer._add_accumulator
+        mesh, axis = self._mesh, self._axis
+
+        def _add(name, param, *args, **kwargs):
+            fresh = id(param) not in optimizer._accumulators[name]
+            acc = orig_add(name, param, *args, **kwargs)
+            if fresh and acc._raw().ndim >= 1:
+                utils.place_sharded(acc, mesh, axis, memory_kind="pinned_host")
+            return acc
+
+        optimizer._add_accumulator = _add
+
+        # the update writes fresh device arrays into the accumulators —
+        # stream them back to host after each step (offload round trip)
+        orig_step = optimizer.step
+
+        def _step(*a, **kw):
+            out = orig_step(*a, **kw)
+            for _, by_param in optimizer._accumulators.items():
+                for t in by_param.values():
+                    if getattr(t._raw(), "ndim", 0) >= 1:
+                        utils.place_sharded(t, mesh, axis, memory_kind="pinned_host")
+            return out
+
+        optimizer.step = _step
 
     def forward(self, *args, **kwargs):
         return self._layers(*args, **kwargs)

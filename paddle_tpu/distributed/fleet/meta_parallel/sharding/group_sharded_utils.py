@@ -23,12 +23,16 @@ def shard_axis_spec(shape, n: int, axis_name: str) -> P:
     return P(*([None] * len(shape)))
 
 
-def place_sharded(t: Tensor, mesh: Mesh, axis_name: str) -> None:
-    """Re-place a Tensor's value sharded over `axis_name` (in-place)."""
+def place_sharded(t: Tensor, mesh: Mesh, axis_name: str, memory_kind=None) -> None:
+    """Re-place a Tensor's value sharded over `axis_name` (in-place).
+    memory_kind="pinned_host" implements offload: the shard lives in host
+    memory and XLA streams it to the device where used (the reference's
+    offload=True cpu placement, group_sharded_stage3.py)."""
     n = mesh.shape[axis_name]
     v = t._raw()
     spec = shard_axis_spec(v.shape, n, axis_name)
-    t._replace_value(jax.device_put(v, NamedSharding(mesh, spec)))
+    sh = NamedSharding(mesh, spec, memory_kind=memory_kind) if memory_kind else NamedSharding(mesh, spec)
+    t._replace_value(jax.device_put(v, sh))
 
 
 def place_replicated(t: Tensor, mesh: Mesh) -> None:

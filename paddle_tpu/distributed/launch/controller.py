@@ -36,6 +36,8 @@ class CollectiveController:
         self.ctx = ctx
         self.pod = Pod()
         self.master = None
+        self.elastic = None  # ElasticManager when elastic mode is on
+        self.elastic_restarts = 0
 
     # ---- topology ----
     def _rendezvous(self):
@@ -86,12 +88,56 @@ class CollectiveController:
             self.master.stop()
         return code
 
+    # ---- elastic (reference fleet/elastic/manager.py:124) ----
+    def enable_elastic(self, manager):
+        """Attach an ElasticManager: the watch loop consumes its scale
+        events, re-ranks and relaunches the pod on membership change."""
+        self.elastic = manager
+        # beat several times per staleness window or we age ourselves out
+        manager.register(interval=min(3.0, manager.timeout / 3.0))
+
+    def _elastic_restart(self):
+        """Membership changed: recompute node rank/world from the alive set
+        and relaunch every local worker with re-ranked envs (the reference's
+        scale-event -> relaunch-with-new-ranks flow)."""
+        nodes = self.elastic.alive_nodes()
+        if self.elastic.host not in nodes:
+            return False
+        args = self.ctx.args
+        args.nnodes = len(nodes)
+        args.node_rank = nodes.index(self.elastic.host)
+        self.elastic.np = len(nodes)
+        print(
+            f"[launch] elastic scale event: nodes={nodes} -> re-rank "
+            f"node_rank={args.node_rank} world={args.nnodes * args.nproc_per_node}",
+            file=sys.stderr,
+        )
+        self.pod.stop(force=True)
+        self.pod = Pod()
+        self.build_pod()
+        self.pod.deploy()
+        self.elastic_restarts += 1
+        return True
+
     def watch(self) -> int:
         """Poll container status (reference watcher.py): on failure either
         restart the whole pod (elastic, up to max_restart) or tear down."""
+        from ..fleet.elastic.manager import ElasticStatus
+
         args = self.ctx.args
         while True:
             time.sleep(args.poll_interval)
+            if self.elastic is not None:
+                st = self.elastic.watch()
+                if st == ElasticStatus.RESTART:
+                    if self._elastic_restart():
+                        continue
+                    self.pod.stop(force=True)
+                    return 2
+                if st == ElasticStatus.EXIT:
+                    print("[launch] elastic: this node aged out, exiting", file=sys.stderr)
+                    self.pod.stop(force=True)
+                    return 2
             if not self.pod.is_running():
                 failed = self.pod.failed_containers()
                 if not failed:

@@ -23,6 +23,11 @@ def parse_args(argv=None):
     p.add_argument("--log_dir", default=None)
     p.add_argument("--port", type=int, default=10071, help="coordinator port for single-node multi-proc")
     p.add_argument("--max_restart", type=int, default=0, help="elastic: restarts before giving up")
+    p.add_argument(
+        "--elastic_timeout", type=float, default=0,
+        help="> 0 enables elastic membership: heartbeat staleness (s) after "
+        "which a node is dead and the pod relaunches with new ranks",
+    )
     p.add_argument("--poll_interval", type=float, default=1.0)
     p.add_argument("--module", "-m", action="store_true", help="run script as a python module")
     p.add_argument("training_script")
@@ -33,7 +38,22 @@ def parse_args(argv=None):
 def launch(argv=None) -> int:
     args = parse_args(argv)
     ctx = Context(args)
-    return CollectiveController(ctx).run()
+    controller = CollectiveController(ctx)
+    if args.elastic_timeout > 0 and args.master:
+        import socket
+
+        from ..fleet.elastic.manager import ElasticManager
+
+        controller.enable_elastic(
+            ElasticManager(
+                endpoint=args.master.replace("http://", ""),
+                job_id=args.job_id,
+                np=args.nnodes,
+                host=socket.gethostname(),
+                timeout=args.elastic_timeout,
+            )
+        )
+    return controller.run()
 
 
 if __name__ == "__main__":

@@ -1,0 +1,201 @@
+"""Graph learning ops (message passing + segment reductions + reindex/sampling).
+
+Reference parity: python/paddle/geometric/ (send_u_recv/send_ue_recv/send_uv
+in message_passing/send_recv.py backed by
+paddle/phi/kernels/gpu/graph_send_recv_kernel.cu and
+graph_send_ue_recv_kernel.cu; segment_* in math.py backed by
+segment_pool_kernel; reindex_graph in reindex.py; sample_neighbors in
+sampling/). TPU-native design: gathers + jax segment reductions — XLA lowers
+scatter-reduce natively, no custom kernels needed; sampling/reindex are
+host-side graph bookkeeping on numpy (they produce new index sets, not
+differentiable device math).
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax import numpy as jnp
+
+from ..core.apply import apply, apply_nograd
+from ..core.tensor import Tensor
+
+__all__ = [
+    "segment_sum", "segment_mean", "segment_max", "segment_min",
+    "send_u_recv", "send_ue_recv", "send_uv",
+    "reindex_graph", "sample_neighbors",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def _nseg(segment_ids, out_size):
+    if out_size is not None:
+        return int(out_size)
+    ids = segment_ids._raw()
+    if isinstance(ids, jax.core.Tracer):
+        raise ValueError("out_size must be given under tracing (dynamic segment count)")
+    return int(np.asarray(ids).max()) + 1 if ids.size else 0
+
+
+def _segment_reduce(data, segment_ids, kind, out_size=None):
+    data, segment_ids = _t(data), _t(segment_ids)
+    n = _nseg(segment_ids, out_size)
+
+    def f(d, ids):
+        ids = ids.astype(jnp.int32)
+        if kind == "sum":
+            return jax.ops.segment_sum(d, ids, num_segments=n)
+        if kind == "mean":
+            s = jax.ops.segment_sum(d, ids, num_segments=n)
+            cnt = jax.ops.segment_sum(jnp.ones((d.shape[0],), d.dtype), ids, num_segments=n)
+            return s / jnp.maximum(cnt, 1).reshape((-1,) + (1,) * (d.ndim - 1))
+        if kind == "max":
+            r = jax.ops.segment_max(d, ids, num_segments=n)
+        else:
+            r = jax.ops.segment_min(d, ids, num_segments=n)
+        # empty segments: paddle fills 0 (not +-inf)
+        cnt = jax.ops.segment_sum(jnp.ones((d.shape[0],)), ids, num_segments=n)
+        return jnp.where((cnt > 0).reshape((-1,) + (1,) * (d.ndim - 1)), r, 0).astype(d.dtype)
+
+    return apply(f"segment_{kind}", f, data, segment_ids)
+
+
+def segment_sum(data, segment_ids, name=None):
+    """python/paddle/geometric/math.py:23."""
+    return _segment_reduce(data, segment_ids, "sum")
+
+
+def segment_mean(data, segment_ids, name=None):
+    return _segment_reduce(data, segment_ids, "mean")
+
+
+def segment_min(data, segment_ids, name=None):
+    return _segment_reduce(data, segment_ids, "min")
+
+
+def segment_max(data, segment_ids, name=None):
+    return _segment_reduce(data, segment_ids, "max")
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None, name=None):
+    """Gather x[src] -> reduce into dst slots (send_recv.py:36; kernel
+    graph_send_recv_kernel.cu). Output first dim = out_size or x.shape[0]."""
+    x, src_index, dst_index = _t(x), _t(src_index), _t(dst_index)
+    n = int(out_size) if out_size is not None else int(x._raw().shape[0])
+    if reduce_op not in ("sum", "mean", "max", "min"):
+        raise ValueError(f"unsupported reduce_op {reduce_op}")
+
+    def f(xv, si, di):
+        msgs = jnp.take(xv, si.astype(jnp.int32), axis=0)
+        ids = di.astype(jnp.int32)
+        if reduce_op == "sum":
+            return jax.ops.segment_sum(msgs, ids, num_segments=n)
+        if reduce_op == "mean":
+            s = jax.ops.segment_sum(msgs, ids, num_segments=n)
+            cnt = jax.ops.segment_sum(jnp.ones((msgs.shape[0],), xv.dtype), ids, num_segments=n)
+            return s / jnp.maximum(cnt, 1).reshape((-1,) + (1,) * (xv.ndim - 1))
+        red = jax.ops.segment_max if reduce_op == "max" else jax.ops.segment_min
+        r = red(msgs, ids, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones((msgs.shape[0],)), ids, num_segments=n)
+        return jnp.where((cnt > 0).reshape((-1,) + (1,) * (xv.ndim - 1)), r, 0).astype(xv.dtype)
+
+    return apply("send_u_recv", f, x, src_index, dst_index)
+
+
+_MESSAGE_OPS = {
+    "add": lambda a, b: a + b,
+    "sub": lambda a, b: a - b,
+    "mul": lambda a, b: a * b,
+    "div": lambda a, b: a / b,
+}
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add", reduce_op="sum", out_size=None, name=None):
+    """Node+edge message passing (send_recv.py send_ue_recv; kernel
+    graph_send_ue_recv_kernel.cu): message = x[src] (op) y[edge]."""
+    x, y, src_index, dst_index = _t(x), _t(y), _t(src_index), _t(dst_index)
+    n = int(out_size) if out_size is not None else int(x._raw().shape[0])
+    mop = _MESSAGE_OPS[message_op]
+
+    def f(xv, yv, si, di):
+        msgs = mop(jnp.take(xv, si.astype(jnp.int32), axis=0), yv)
+        ids = di.astype(jnp.int32)
+        if reduce_op == "sum":
+            return jax.ops.segment_sum(msgs, ids, num_segments=n)
+        if reduce_op == "mean":
+            s = jax.ops.segment_sum(msgs, ids, num_segments=n)
+            cnt = jax.ops.segment_sum(jnp.ones((msgs.shape[0],), msgs.dtype), ids, num_segments=n)
+            return s / jnp.maximum(cnt, 1).reshape((-1,) + (1,) * (msgs.ndim - 1))
+        red = jax.ops.segment_max if reduce_op == "max" else jax.ops.segment_min
+        r = red(msgs, ids, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones((msgs.shape[0],)), ids, num_segments=n)
+        return jnp.where((cnt > 0).reshape((-1,) + (1,) * (msgs.ndim - 1)), r, 0).astype(msgs.dtype)
+
+    return apply("send_ue_recv", f, x, y, src_index, dst_index)
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-edge message x[src] (op) y[dst] (send_recv.py send_uv)."""
+    x, y, src_index, dst_index = _t(x), _t(y), _t(src_index), _t(dst_index)
+    mop = _MESSAGE_OPS[message_op]
+
+    def f(xv, yv, si, di):
+        return mop(
+            jnp.take(xv, si.astype(jnp.int32), axis=0),
+            jnp.take(yv, di.astype(jnp.int32), axis=0),
+        )
+
+    return apply("send_uv", f, x, y, src_index, dst_index)
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None, name=None):
+    """Compact a sampled subgraph's node ids (reindex.py:25): x (target
+    nodes) + neighbors -> contiguous ids, x first. Host-side bookkeeping."""
+    xv = np.asarray(_t(x)._raw())
+    nb = np.asarray(_t(neighbors)._raw())
+    cnt = np.asarray(_t(count)._raw())
+    order = {}
+    for v in xv.tolist():
+        if v not in order:
+            order[v] = len(order)
+    for v in nb.tolist():
+        if v not in order:
+            order[v] = len(order)
+    reindex_src = np.array([order[v] for v in nb.tolist()], dtype=np.int64)
+    reindex_dst = np.repeat(np.arange(len(xv), dtype=np.int64), cnt)
+    out_nodes = np.array(list(order.keys()), dtype=xv.dtype)
+    return Tensor(jnp.asarray(reindex_src)), Tensor(jnp.asarray(reindex_dst)), Tensor(jnp.asarray(out_nodes))
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None, return_eids=False, perm_buffer=None, name=None):
+    """Uniform neighbor sampling on CSC (sampling/neighbors.py): for each
+    input node pick up to sample_size neighbors. Host-side (graph prep);
+    reproducible via paddle.seed (framework RNG)."""
+    from ..framework import random as random_mod
+
+    if return_eids and eids is None:
+        raise ValueError("return_eids=True needs eids")
+    r = np.asarray(_t(row)._raw())
+    cp = np.asarray(_t(colptr)._raw())
+    nodes = np.asarray(_t(input_nodes)._raw())
+    ev = np.asarray(_t(eids)._raw()) if eids is not None else None
+    seed = int(np.asarray(jax.random.randint(random_mod.next_key(), (), 0, 2**31 - 1)))
+    rng = np.random.default_rng(seed)
+    out_nb, out_cnt, out_eids = [], [], []
+    for v in nodes.tolist():
+        beg, end = int(cp[v]), int(cp[v + 1])
+        sel = np.arange(beg, end)
+        if sample_size >= 0 and sel.size > sample_size:
+            sel = rng.choice(sel, size=sample_size, replace=False)
+        out_nb.append(r[sel])
+        out_cnt.append(sel.size)
+        if return_eids:
+            out_eids.append(ev[sel])
+    neighbors = np.concatenate(out_nb) if out_nb else np.zeros((0,), r.dtype)
+    res = [Tensor(jnp.asarray(neighbors)), Tensor(jnp.asarray(np.array(out_cnt, np.int32)))]
+    if return_eids:
+        e = np.concatenate(out_eids) if out_eids else np.zeros((0,), np.int64)
+        res.append(Tensor(jnp.asarray(e)))
+    return tuple(res)

@@ -88,10 +88,24 @@ class TCPStore:
         return int(v)
 
     def wait(self, keys, timeout=30.0) -> None:
+        from ..distributed.comm_watchdog import comm_task
+
         if isinstance(keys, str):
             keys = [keys]
         for k in keys:
-            rc = self._lib.pt_store_wait(self._client, k.encode(), int(timeout * 1000))
+            # the native wait has its own timeout; the watchdog catches a
+            # STUCK wait (native timeout not firing: dead master, wedged
+            # socket) and aborts with diagnostics (reference
+            # comm_task_manager.h semantics). Its deadline is this call's
+            # OWN timeout plus a grace margin, so a long legitimate wait is
+            # never killed by the global default.
+            from ..framework import flags as _wd_flags
+
+            wd_timeout = timeout + float(_wd_flags.get_flag("FLAGS_comm_watchdog_margin_s"))
+            with comm_task(
+                "TCPStore.wait", timeout=wd_timeout, key=k, host=self._ip, port=self.port
+            ):
+                rc = self._lib.pt_store_wait(self._client, k.encode(), int(timeout * 1000))
             if rc != 0:
                 raise TimeoutError(f"TCPStore.wait timed out on key '{k}'")
 

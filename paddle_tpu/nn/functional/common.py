@@ -180,6 +180,33 @@ def interpolate(
     }[mode]
 
     def f(vv):
+        if mode == "area":
+            # adaptive average pooling (the reference's area interpolation
+            # is NOT anti-aliased linear resize): per output bin i along
+            # each axis, mean of input [floor(i*I/O), ceil((i+1)*I/O)) —
+            # exact for downscale, fractional, and upscale alike (cumsum
+            # segment sums)
+            import numpy as _np
+
+            r = vv
+            for d in spatial:
+                I, O = r.shape[d], out_shape[d]
+                if I == O:
+                    continue
+                starts = _np.floor(_np.arange(O) * I / O).astype(_np.int32)
+                ends = _np.ceil((_np.arange(O) + 1) * I / O).astype(_np.int32)
+                c = jnp.cumsum(r.astype(jnp.float32), axis=d)
+                zshape = list(r.shape)
+                zshape[d] = 1
+                c = jnp.concatenate([jnp.zeros(zshape, jnp.float32), c], axis=d)
+                seg = jnp.take(c, jnp.asarray(ends), axis=d) - jnp.take(
+                    c, jnp.asarray(starts), axis=d
+                )
+                counts = (ends - starts).astype(_np.float32)
+                cshape = [1] * r.ndim
+                cshape[d] = O
+                r = seg / jnp.asarray(counts).reshape(cshape)
+            return r.astype(vv.dtype)
         if mode == "nearest" or not align_corners:
             return jax.image.resize(vv, out_shape, method=method)
         # align_corners=True path: explicit coordinate map via map_coordinates
@@ -424,10 +451,18 @@ def grid_sample(x, grid, mode="bilinear", padding_mode="zeros", align_corners=Tr
             fx = ((gx + 1) * w - 1) / 2
             fy = ((gy + 1) * h - 1) / 2
         def reflect(coord, size):
-            # reflect about the edges (align_corners=True convention)
-            span = 2 * (size - 1) if size > 1 else 1
-            r = jnp.abs(jnp.mod(coord, span))
-            return jnp.where(r > size - 1, span - r, r)
+            if align_corners:
+                # reflect about the edge CENTERS (0 and size-1)
+                span = 2 * (size - 1) if size > 1 else 1
+                r = jnp.abs(jnp.mod(coord, span))
+                return jnp.where(r > size - 1, span - r, r)
+            # align_corners=False: reflect about the edge BORDERS
+            # (-0.5 and size-0.5) — the reference convention
+            span = 2 * size
+            r = jnp.mod(coord + 0.5, span)
+            r = jnp.abs(r)
+            r = jnp.where(r > size, span - r, r) - 0.5
+            return jnp.clip(r, 0, size - 1)
 
         if mode == "nearest":
             xi = jnp.round(fx)

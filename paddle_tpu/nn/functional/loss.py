@@ -372,3 +372,203 @@ def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
         return jnp.mean(ce) + reg
 
     return apply("npair_loss", fn, _t(anchor), _t(positive), _t(labels))
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5, margin3=0.0,
+                         scale=64.0, group=None, return_softmax=False,
+                         reduction="mean"):
+    """ArcFace/CosFace-family margin softmax CE (loss.py:2095; kernel
+    paddle/phi/kernels/gpu/margin_cross_entropy_kernel.cu):
+    target logit cos(theta) -> cos(m1*theta + m2) - m3, all scaled by s.
+    Under TP the class dim may be sharded (single-controller: arrays are
+    global, so `group` needs no special handling)."""
+    logits, label = _t(logits), _t(label)
+
+    def f(lg, lb):
+        n, c = lg.shape
+        onehot = jax.nn.one_hot(lb, c, dtype=lg.dtype)
+        cos = jnp.clip(lg, -1.0, 1.0)
+        theta = jnp.arccos(cos)
+        modified = jnp.cos(margin1 * theta + margin2) - margin3
+        out = jnp.where(onehot > 0, modified, lg) * scale
+        logp = jax.nn.log_softmax(out, axis=-1)
+        loss = -jnp.sum(onehot * logp, axis=-1, keepdims=True)
+        return _reduce(loss, reduction), jnp.exp(logp)
+
+    loss, softmax = apply("margin_cross_entropy", f, logits, label, n_outputs=2)
+    if return_softmax:
+        return loss, softmax
+    return loss
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """Partial-FC class-center sampling (loss.py class_center_sample; kernel
+    class_center_sample_kernel.cu): keep all positive classes + uniformly
+    sampled negatives, remap labels into the sampled index space."""
+    from ...framework import random as random_mod
+
+    lb = np.asarray(_t(label)._raw())
+    pos = np.unique(lb)
+    if pos.size >= num_samples:
+        sampled = pos
+    else:
+        neg_pool = np.setdiff1d(np.arange(num_classes), pos, assume_unique=True)
+        k = jax.random.permutation(random_mod.next_key(), neg_pool.size)[: num_samples - pos.size]
+        sampled = np.concatenate([pos, neg_pool[np.asarray(k)]])
+    remap = -np.ones(num_classes, np.int64)
+    remap[sampled] = np.arange(sampled.size)
+    return Tensor(jnp.asarray(remap[lb])), Tensor(jnp.asarray(sampled.astype(np.int64)))
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False, name=None):
+    """Hierarchical sigmoid (loss.py hsigmoid_loss; phi hsigmoid_loss_kernel
+    + funcs/matrix_bit_code.h SimpleCode): default complete binary tree with
+    code = label + num_classes; node index = (code >> (bit+1)) - 1, branch
+    bit = (code >> bit) & 1. Returns [N, 1] summed path BCE."""
+    input, label, weight = _t(input), _t(label), _t(weight)
+    if path_table is not None or path_code is not None:
+        pt = _t(path_table)
+        pc = _t(path_code)
+
+        def f(x, lb, w, *rest):
+            b = rest[0] if rest else None
+            tbl = pt._raw()[lb].astype(jnp.int32)   # [N, L]
+            code = pc._raw()[lb].astype(x.dtype)    # [N, L]
+            valid = tbl >= 0
+            wsel = w[jnp.clip(tbl, 0)]              # [N, L, D]
+            logit = jnp.einsum("nld,nd->nl", wsel, x)
+            if b is not None:
+                logit = logit + b[jnp.clip(tbl, 0)]
+            bce = jnp.maximum(logit, 0) - logit * code + jnp.log1p(jnp.exp(-jnp.abs(logit)))
+            return jnp.sum(jnp.where(valid, bce, 0.0), -1, keepdims=True)
+
+        args = [input, label, weight] + ([_t(bias)] if bias is not None else [])
+        return apply("hsigmoid_loss", f, *args)
+
+    max_len = int(np.floor(np.log2(max(2 * num_classes - 1, 2))))
+
+    def f(x, lb, w, *rest):
+        b = rest[0] if rest else None
+        code = (lb + num_classes).astype(jnp.int32)  # [N]
+        # FindLastSet - 1: path length per sample
+        length = jnp.floor(jnp.log2(code.astype(jnp.float32) + 0.5)).astype(jnp.int32) + 1 - 1
+        bits = jnp.arange(max_len)
+        valid = bits[None, :] < length[:, None]
+        idx = (code[:, None] >> (bits[None, :] + 1)) - 1     # [N, L]
+        bit = ((code[:, None] >> bits[None, :]) & 1).astype(x.dtype)
+        wsel = w[jnp.clip(idx, 0)]                           # [N, L, D]
+        logit = jnp.einsum("nld,nd->nl", wsel, x)
+        if b is not None:
+            logit = logit + b[jnp.clip(idx, 0)]
+        bce = jnp.maximum(logit, 0) - logit * bit + jnp.log1p(jnp.exp(-jnp.abs(logit)))
+        return jnp.sum(jnp.where(valid, bce, 0.0), -1, keepdims=True)
+
+    args = [input, label, weight] + ([_t(bias)] if bias is not None else [])
+    return apply("hsigmoid_loss", f, *args)
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.0, reduction="mean", name=None):
+    """RNN-T transducer loss (loss.py rnnt_loss; the role of warprnnt in
+    third_party): log-space forward DP alpha over (T, U) compiled as a
+    lax.scan over time — O(T*U) memory, MXU-free but fully vectorized over
+    batch and label positions."""
+    if fastemit_lambda:
+        raise NotImplementedError(
+            "rnnt_loss: FastEmit regularization needs the beta DP (occupancy"
+            " weighting); not implemented — pass fastemit_lambda=0"
+        )
+    input, label = _t(input), _t(label)
+    input_lengths, label_lengths = _t(input_lengths), _t(label_lengths)
+
+    def f(logits, lb, tl, ul):
+        B, T, U1, V = logits.shape
+        U = U1 - 1
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        blank_lp = logp[..., blank]                      # [B, T, U+1]
+        lbl = jnp.clip(lb, 0)
+        emit_lp = jnp.take_along_axis(
+            logp[:, :, :U, :], lbl[:, None, :, None], axis=-1
+        )[..., 0]                                        # [B, T, U]
+        neg_inf = jnp.float32(-1e30)
+        uidx = jnp.arange(U1)[None, :]
+
+        def chain_u(from_blank, emit_t):
+            # alpha_t[0] = from_blank[0];
+            # alpha_t[u] = logaddexp(from_blank[u], alpha_t[u-1] + emit_t[u-1])
+            def st(x_prev, inp):
+                fb_u, e_prev = inp
+                x = jnp.logaddexp(fb_u, x_prev + e_prev)
+                return x, x
+
+            x0 = from_blank[:, 0]
+            _, xs = jax.lax.scan(
+                st, x0, (from_blank[:, 1:].T, emit_t.T)
+            )  # over u = 1..U
+            return jnp.concatenate([x0[:, None], xs.T], axis=1)
+
+        init_fb = jnp.full((B, U1), neg_inf).at[:, 0].set(0.0)
+
+        def step(carry, t):
+            alpha_prev, ll = carry  # alpha at t-1
+            from_blank = jnp.where(
+                t == 0, init_fb, alpha_prev + blank_lp[:, jnp.maximum(t - 1, 0), :]
+            )
+            alpha_t = chain_u(from_blank, emit_lp[:, t, :])
+            alpha_t = jnp.where(uidx <= ul[:, None], alpha_t, neg_inf)
+            active = t < tl[:, None]
+            alpha_t = jnp.where(active, alpha_t, alpha_prev)
+            # termination: ll = alpha[tl-1, ul] + blank_lp[tl-1, ul]
+            final_now = (t == tl - 1)
+            end_alpha = jnp.take_along_axis(alpha_t, ul[:, None], axis=1)[:, 0]
+            end_blank = jnp.take_along_axis(blank_lp[:, t, :], ul[:, None], axis=1)[:, 0]
+            ll = jnp.where(final_now, end_alpha + end_blank, ll)
+            return (alpha_t, ll), None
+
+        (alpha, ll), _ = jax.lax.scan(
+            step, (init_fb, jnp.full((B,), neg_inf)), jnp.arange(T)
+        )
+        loss = -ll
+        if reduction == "mean":
+            return jnp.mean(loss)
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return loss
+
+    return apply("rnnt_loss", f, input, label, input_lengths, label_lengths)
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None, name=None):
+    """Levenshtein distance per sequence pair (loss.py:458; phi
+    edit_distance_kernel). Host-side DP (integer bookkeeping, not device
+    math). Returns (distances [N, 1] float, sequence_num [1])."""
+    a = np.asarray(_t(input)._raw())
+    b = np.asarray(_t(label)._raw())
+    il = None if input_length is None else np.asarray(_t(input_length)._raw())
+    ll = None if label_length is None else np.asarray(_t(label_length)._raw())
+    ign = set(ignored_tokens or ())
+    N = a.shape[0]
+    out = np.zeros((N, 1), np.float32)
+    for i in range(N):
+        s1 = a[i][: int(il[i])] if il is not None else a[i]
+        s2 = b[i][: int(ll[i])] if ll is not None else b[i]
+        s1 = [t for t in s1.tolist() if t not in ign]
+        s2 = [t for t in s2.tolist() if t not in ign]
+        m, n = len(s1), len(s2)
+        dp = np.arange(n + 1, dtype=np.int64)
+        for x_ in range(1, m + 1):
+            prev = dp.copy()
+            dp[0] = x_
+            for y_ in range(1, n + 1):
+                dp[y_] = min(
+                    prev[y_] + 1,
+                    dp[y_ - 1] + 1,
+                    prev[y_ - 1] + (s1[x_ - 1] != s2[y_ - 1]),
+                )
+        d = float(dp[n])
+        if normalized:
+            d = d / max(n, 1)
+        out[i, 0] = d
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(np.array([N], np.int64)))

@@ -228,3 +228,165 @@ def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0, ceil_mode=False
         return fsum(jnp.abs(v) ** p) ** (1.0 / p)
 
     return apply("lp_pool2d", f, x)
+
+
+# ---------------------------------------------------------------------------
+# max unpool (paddle/phi/kernels/unpool_kernel.h; nn/functional/pooling.py
+# max_unpool1d/2d/3d): scatter pooled values back by the pooling mask
+# ---------------------------------------------------------------------------
+
+def _max_unpool(x, indices, kernel_size, stride, padding, n, output_size, data_format):
+    x = _t(x)
+    indices = _t(indices)
+    kernel = _tuple(kernel_size, n)
+    stride_t = _tuple(stride if stride is not None else kernel_size, n)
+    pad = _tuple(padding, n)
+
+    def out_dim(i, in_s):
+        return (in_s - 1) * stride_t[i] - 2 * pad[i] + kernel[i]
+
+    def f(v, idx):
+        N, C = v.shape[0], v.shape[1]
+        in_spatial = v.shape[2:]
+        if output_size is not None:
+            out_spatial = tuple(int(s) for s in output_size[-n:])
+        else:
+            out_spatial = tuple(out_dim(i, in_spatial[i]) for i in range(n))
+        total = int(np.prod(out_spatial))
+        flat = jnp.zeros((N, C, total), v.dtype)
+        vi = v.reshape(N, C, -1)
+        ii = idx.reshape(N, C, -1).astype(jnp.int32)
+        b = jnp.arange(N)[:, None, None]
+        c = jnp.arange(C)[None, :, None]
+        flat = flat.at[b, c, ii].set(vi)
+        return flat.reshape((N, C) + out_spatial)
+
+    return apply(f"max_unpool{n}d", f, x, indices)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0, data_format="NCL", output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding, 1, output_size, data_format)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0, data_format="NCHW", output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding, 2, output_size, data_format)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0, data_format="NCDHW", output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding, 3, output_size, data_format)
+
+
+# ---------------------------------------------------------------------------
+# fractional max pooling (Graham 2015; reference formulas from
+# paddle/phi/kernels/funcs/pooling.h FractionalStartIndex/EndIndex,
+# mirrored in test_fractional_max_pool2d_op.py)
+# ---------------------------------------------------------------------------
+
+def _fractional_axis_windows(in_s, out_s, u, pool):
+    """Per-axis (starts, width, ends) with the reference's index math."""
+    alpha = in_s / out_s
+    if pool and pool > 0:
+        ur = u
+    else:
+        base = in_s // out_s
+        u_max1 = (base + 2) / alpha - 1
+        u_max2 = (in_s + 1 - base) / alpha - (out_s - 1)
+        ur = u * min(u_max1, u_max2)
+    starts = np.array([int((i + ur) * alpha) - int(ur * alpha) for i in range(out_s)])
+    if pool and pool > 0:
+        ends = starts + pool
+    else:
+        ends = np.array([int((i + 1 + ur) * alpha) - int(ur * alpha) for i in range(out_s)])
+    ends = np.minimum(ends, in_s)
+    width = int((ends - starts).max())
+    return starts, width, ends
+
+
+def _fractional_max_pool(x, output_size, kernel_size, random_u, return_mask, n, ndim_name):
+    x = _t(x)
+    v_shape = x._raw().shape
+    spatial_in = v_shape[2:]
+    out_sz = output_size if isinstance(output_size, (list, tuple)) else [output_size] * n
+    out_sz = tuple(
+        int(spatial_in[i]) if out_sz[i] is None else int(out_sz[i]) for i in range(n)
+    )
+    pools = _tuple(kernel_size, n) if kernel_size is not None else (0,) * n
+    if random_u is None:
+        from ...framework import random as random_mod
+        import jax as _jax
+
+        u = float(_jax.random.uniform(random_mod.next_key(), ()))
+    else:
+        u = float(random_u)
+    if not (0 < u < 1):
+        raise ValueError(f"fractional pool random_u must be in (0, 1), got {u}")
+
+    axes = [
+        _fractional_axis_windows(int(spatial_in[i]), out_sz[i], u, pools[i])
+        for i in range(n)
+    ]
+
+    def _gather_windows(v):
+        """Window grid per axis: [..., out_i, width_i, ...] with invalid
+        window slots masked to -inf (shared by the max and argmax paths)."""
+        g = v
+        win_axes = []
+        for i, (starts, width, ends) in enumerate(axes):
+            ax = 2 + i + len(win_axes)  # current position of this spatial axis
+            idx = np.minimum(starts[:, None] + np.arange(width)[None, :], int(spatial_in[i]) - 1)
+            valid = (starts[:, None] + np.arange(width)[None, :]) < ends[:, None]
+            g = jnp.take(g, jnp.asarray(idx.reshape(-1)), axis=ax)
+            new_shape = g.shape[:ax] + (out_sz[i], width) + g.shape[ax + 1 :]
+            g = g.reshape(new_shape)
+            mask_shape = [1] * len(new_shape)
+            mask_shape[ax], mask_shape[ax + 1] = out_sz[i], width
+            g = jnp.where(jnp.asarray(valid).reshape(mask_shape), g, -jnp.inf)
+            win_axes.append(ax + 1)
+        return g, win_axes
+
+    def f(v):
+        g, win_axes = _gather_windows(v)
+        return jnp.max(g, axis=tuple(win_axes)).astype(v.dtype)
+
+    out = apply(f"fractional_max_pool{n}d", f, x)
+    if not return_mask:
+        return out
+
+    def fidx(v):
+        g, win_axes = _gather_windows(v)
+        # move window axes last, flatten, argmax -> per-axis offsets
+        perm = [a for a in range(g.ndim) if a not in win_axes] + win_axes
+        gt = jnp.transpose(g, perm)
+        widths = [axes[i][1] for i in range(n)]
+        flat = gt.reshape(gt.shape[: -n] + (int(np.prod(widths)),))
+        am = jnp.argmax(flat, axis=-1)
+        offs = []
+        rem = am
+        for w_ in widths[::-1]:
+            offs.append(rem % w_)
+            rem = rem // w_
+        offs = offs[::-1]
+        # global flat index over the input spatial dims
+        strides_in = np.cumprod((list(spatial_in[1:]) + [1])[::-1])[::-1]
+        total = 0
+        for i in range(n):
+            starts_i = jnp.asarray(axes[i][0])
+            shape = [1] * am.ndim
+            shape[2 + i] = out_sz[i]
+            pos = starts_i.reshape(shape) + offs[i]
+            total = total + pos * int(strides_in[i])
+        return total.astype(jnp.int64)
+
+    from ...core.apply import apply_nograd
+
+    mask = apply_nograd(f"fractional_max_pool{n}d_mask", fidx, x)
+    return out, mask
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None, return_mask=False, name=None):
+    """Reference parity: python/paddle/nn/functional/pooling.py:2030."""
+    return _fractional_max_pool(x, output_size, kernel_size, random_u, return_mask, 2, "NCHW")
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None, return_mask=False, name=None):
+    return _fractional_max_pool(x, output_size, kernel_size, random_u, return_mask, 3, "NCDHW")

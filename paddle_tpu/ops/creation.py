@@ -326,3 +326,33 @@ def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1) -> Tensor:
         return y
 
     return apply("gumbel_softmax", f, x)
+
+
+def binomial(count, prob, name=None) -> Tensor:
+    """paddle.binomial (tensor/random.py binomial; phi binomial_kernel):
+    elementwise Binomial(count, prob) sampling. Implemented as a sum of
+    Bernoulli draws when count is small, else normal approximation clipped
+    (the standard device-friendly scheme)."""
+    count = _ensure_tensor(count)
+    prob = _ensure_tensor(prob)
+    c = count.value.astype(jnp.float32)
+    p = prob.value.astype(jnp.float32)
+    # under tracing the max count is unknowable -> normal approximation
+    # (valid for any count; exact Bernoulli-sum only for concrete small counts)
+    cmax = int(np.asarray(jnp.max(c))) if not isinstance(c, jax.core.Tracer) else None
+    if cmax is not None and cmax <= 64:
+        draws = jax.random.uniform(_key(), (max(int(cmax), 1),) + tuple(c.shape))
+        idx = jnp.arange(max(cmax, 1)).reshape((-1,) + (1,) * c.ndim)
+        out = jnp.sum((draws < p[None]) & (idx < c[None]), axis=0)
+    else:
+        mean = c * p
+        std = jnp.sqrt(jnp.maximum(c * p * (1 - p), 1e-9))
+        out = jnp.clip(jnp.round(mean + std * jax.random.normal(_key(), c.shape)), 0, c)
+    return Tensor(out.astype(jnp.int64))
+
+
+def standard_gamma(x, name=None) -> Tensor:
+    """paddle.standard_gamma (tensor/random.py): Gamma(alpha=x, scale=1)."""
+    x = _ensure_tensor(x)
+    v = x.value
+    return Tensor(jax.random.gamma(_key(), v.astype(jnp.float32)).astype(v.dtype))

@@ -278,3 +278,36 @@ def svd_lowrank(x, q=6, niter=2, M=None, name=None):
 
     args = [_t(x)] + ([_t(M)] if M is not None else [])
     return apply("svd_lowrank", fn, *args, n_outputs=3)
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """python/paddle/tensor/linalg.py lu_unpack: (lu_data, 1-based pivots)
+    -> (P, L unit-lower, U)."""
+    x, y = _t(x), _t(y)
+
+    def f(lu_, piv):
+        m, n = lu_.shape[-2], lu_.shape[-1]
+        k = min(m, n)
+        L = jnp.tril(lu_[..., :, :k], -1) + jnp.eye(m, k, dtype=lu_.dtype)
+        U = jnp.triu(lu_[..., :k, :])
+        # pivots: row i swapped with piv[i]-1, applied in order
+        def build_p(pv):
+            perm = jnp.arange(m)
+
+            def body(i, perm):
+                j = pv[i] - 1
+                pi, pj = perm[i], perm[j]
+                return perm.at[i].set(pj).at[j].set(pi)
+
+            perm = jax.lax.fori_loop(0, pv.shape[0], body, perm)
+            return jnp.eye(m, dtype=lu_.dtype)[:, perm]  # P with P @ L @ U = A
+
+        if piv.ndim == 1:
+            P = build_p(piv)
+        else:
+            P = jax.vmap(build_p)(piv.reshape(-1, piv.shape[-1])).reshape(
+                piv.shape[:-1] + (m, m)
+            )
+        return P, L, U
+
+    return apply("lu_unpack", f, x, y)

@@ -196,3 +196,33 @@ def bincount(x, weights=None, minlength=0):
 
 
 builtins_max = max
+
+
+def top_p_sampling(x, ps, threshold=None, seed=None, name=None):
+    """Nucleus sampling (python/paddle/tensor/search.py:1235; kernel
+    top_p_sampling kernel): keep the smallest prefix of desc-sorted probs
+    whose cumsum reaches ps, renormalize, sample one id per row.
+    Returns (sampled probs [N, 1], sampled ids [N, 1])."""
+    from ..framework import random as random_mod
+
+    x, ps = _t(x), _t(ps)
+    key = random_mod.next_key() if seed in (None, -1) else jax.random.PRNGKey(seed)
+
+    def f(v, p):
+        sv = jnp.sort(v, axis=-1)[:, ::-1]
+        si = jnp.argsort(v, axis=-1)[:, ::-1]
+        cum = jnp.cumsum(sv, axis=-1)
+        # keep entries whose PRECEDING cumsum < ps (always >= 1 kept)
+        keep = (cum - sv) < p[:, None]
+        if threshold is not None:
+            thr = threshold.value if isinstance(threshold, Tensor) else threshold
+            keep = keep & (sv >= thr)
+            keep = keep.at[:, 0].set(True)
+        probs = jnp.where(keep, sv, 0.0)
+        probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+        pos = jax.random.categorical(key, jnp.log(jnp.maximum(probs, 1e-38)), axis=-1)
+        ids = jnp.take_along_axis(si, pos[:, None], axis=-1)
+        val = jnp.take_along_axis(v, ids, axis=-1)
+        return val, ids.astype(jnp.int64)
+
+    return apply_nograd("top_p_sampling", f, x, ps)

@@ -67,7 +67,7 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None, prog
     specs = []
     si = 0
     for fv in feed_vars:
-        declared = program.feed_shapes.get(fv.name, tuple(fv.shape))
+        declared = program.feed_shapes.get(fv.name) or tuple(fv._raw().shape)
         dims = []
         dynamic = False
         for d in declared:

@@ -13,18 +13,25 @@ from ..core.tensor import Tensor
 def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None, activation=None, name=None):
     from .. import nn
 
+    # read raw dims (not x.shape — dynamic dims of a static.data placeholder
+    # hard-error there); dynamic LEAD dims are fine (reshaped as -1 below),
+    # flattened dims must be static
+    raw_dims = list(x._raw().shape)
+    dyn = getattr(x, "_dynamic_dims", None) or set()
     in_features = 1
-    for d in x.shape[num_flatten_dims:]:
-        if int(d) < 0:
+    for i in range(num_flatten_dims, len(raw_dims)):
+        if i in dyn:
             raise ValueError(
                 "static.nn.fc: flattened dims must be static; got a dynamic (-1) "
-                f"dim in {list(x.shape)[num_flatten_dims:]} — declare them in static.data"
+                f"dim at index {i} — declare it in static.data"
             )
-        in_features *= int(d)
+        in_features *= int(raw_dims[i])
     layer = nn.Linear(in_features, size, weight_attr=weight_attr, bias_attr=bias_attr)
     xin = x
-    if len(x.shape) > num_flatten_dims + 1:
-        lead = [int(d) for d in x.shape[:num_flatten_dims]]
+    if len(raw_dims) > num_flatten_dims + 1:
+        lead = [-1 if i in dyn else int(raw_dims[i]) for i in range(num_flatten_dims)]
+        if lead.count(-1) > 1:
+            raise ValueError("static.nn.fc: at most one dynamic lead dim supported")
         xin = x.reshape(lead + [in_features])
     out = layer(xin)
     if activation:

@@ -192,10 +192,10 @@ def data(name: str, shape, dtype="float32", lod_level=0) -> Tensor:
     carries zeros of the given shape (dims of -1/None become 1 for the
     eager dry-run; the Executor re-traces per concrete feed shape).
 
-    Caveat (same class of limitation as dy2static shape specialization):
     Python-level reads of a dynamic dim during capture (e.g.
-    ``x.reshape([x.shape[0], -1])``) bake the dry-run size 1 into the
-    program — pass -1 to reshape/view for batch-polymorphic programs."""
+    ``x.shape[0]``) HARD-ERROR — they would bake the dry-run size 1 into
+    the program (silent wrong answers for -1-batch programs). Pass -1 to
+    reshape/view, or use paddle.shape() for an in-graph read."""
     from ..framework.dtype import convert_dtype
 
     prog = state.get_program_capture()
@@ -203,6 +203,9 @@ def data(name: str, shape, dtype="float32", lod_level=0) -> Tensor:
         raise RuntimeError("static.data must be called under paddle.static.program_guard")
     dims = tuple(1 if d in (-1, None) else int(d) for d in shape)
     t = Tensor(np.zeros(dims, dtype=np.dtype(convert_dtype(dtype))), stop_gradient=True, name=name)
+    dyn = {i for i, d in enumerate(shape) if d in (-1, None)}
+    if dyn:
+        t._dynamic_dims = dyn
     prog.add_feed(name, t)
     prog.feed_shapes[name] = tuple(shape)
     return t

@@ -302,3 +302,428 @@ def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level, refer_
     order = np.concatenate(order) if order else np.zeros(0, np.int64)
     restore = np.argsort(order)
     return multi_rois, Tensor(jnp.asarray(restore, jnp.int32)), rois_num_per_level
+
+
+# ---------------------------------------------------------------------------
+# detection op family (reference python/paddle/vision/ops.py + phi kernels)
+# ---------------------------------------------------------------------------
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def _expand_aspect_ratios(aspect_ratios, flip):
+    """phi ExpandAspectRatios: 1.0 first, dedupe, flip adds 1/ar."""
+    out = [1.0]
+    for ar in aspect_ratios:
+        dup = any(abs(ar - o) < 1e-6 for o in out)
+        if not dup:
+            out.append(float(ar))
+            if flip:
+                out.append(1.0 / float(ar))
+    return out
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False,
+              name=None):
+    """SSD prior boxes (vision/ops.py:427; phi/kernels/cpu/prior_box_kernel.cc).
+    Returns (boxes [H, W, P, 4], variances [H, W, P, 4]) normalized xyxy."""
+    fh, fw = int(input._raw().shape[2]), int(input._raw().shape[3])
+    ih, iw = int(image._raw().shape[2]), int(image._raw().shape[3])
+    ars = _expand_aspect_ratios(aspect_ratios, flip)
+    step_w = steps[0] or iw / fw
+    step_h = steps[1] or ih / fh
+    min_sizes = [float(s) for s in min_sizes]
+    max_sizes = [float(s) for s in (max_sizes or [])]
+
+    cx = (np.arange(fw) + offset) * step_w
+    cy = (np.arange(fh) + offset) * step_h
+    whs = []
+    for i, ms in enumerate(min_sizes):
+        per = []
+        sq = [(ms / 2.0, ms / 2.0)]
+        mx = [(np.sqrt(ms * max_sizes[i]) / 2.0,) * 2] if max_sizes else []
+        arv = [
+            (ms * np.sqrt(ar) / 2.0, ms / np.sqrt(ar) / 2.0)
+            for ar in ars
+            if abs(ar - 1.0) >= 1e-6
+        ]
+        if min_max_aspect_ratios_order:
+            per = sq + mx + arv
+        else:
+            per = [
+                (ms * np.sqrt(ar) / 2.0, ms / np.sqrt(ar) / 2.0) for ar in ars
+            ] + mx
+        whs.extend(per)
+    whs = np.asarray(whs)  # [P, 2] half sizes
+    P = whs.shape[0]
+    gx, gy = np.meshgrid(cx, cy)  # [fh, fw]
+    boxes = np.stack(
+        [
+            (gx[..., None] - whs[None, None, :, 0]) / iw,
+            (gy[..., None] - whs[None, None, :, 1]) / ih,
+            (gx[..., None] + whs[None, None, :, 0]) / iw,
+            (gy[..., None] + whs[None, None, :, 1]) / ih,
+        ],
+        axis=-1,
+    ).astype(np.float32)
+    if clip:
+        boxes = np.clip(boxes, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, np.float32), boxes.shape).copy()
+    return Tensor(jnp.asarray(boxes)), Tensor(jnp.asarray(var))
+
+
+def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_size",
+              box_normalized=True, axis=0, name=None):
+    """Encode/decode boxes vs priors (vision/ops.py:573;
+    phi/kernels/cpu/box_coder_kernel.cc)."""
+    pb = _t(prior_box)
+    tb = _t(target_box)
+    var_t = prior_box_var if isinstance(prior_box_var, Tensor) else None
+    var_l = (
+        None
+        if prior_box_var is None
+        else (list(prior_box_var) if not isinstance(prior_box_var, Tensor) else None)
+    )
+    norm = 0.0 if box_normalized else 1.0
+
+    def dims(b):
+        w = b[..., 2] - b[..., 0] + norm
+        h = b[..., 3] - b[..., 1] + norm
+        cx = b[..., 0] + w / 2
+        cy = b[..., 1] + h / 2
+        return cx, cy, w, h
+
+    if code_type in ("encode_center_size", 0):
+        def f(pbv, tbv, *rest):
+            pcx, pcy, pw, ph = dims(pbv[None, :, :])  # [1, M, .]
+            tcx, tcy, tw, th = dims(tbv[:, None, :])  # [N, 1, .]
+            out = jnp.stack(
+                [
+                    (tcx - pcx) / pw,
+                    (tcy - pcy) / ph,
+                    jnp.log(jnp.abs(tw / pw)),
+                    jnp.log(jnp.abs(th / ph)),
+                ],
+                axis=-1,
+            )
+            if rest:
+                out = out / rest[0][None, :, :]
+            elif var_l is not None:
+                out = out / jnp.asarray(var_l, out.dtype)
+            return out
+
+        args = [pb, tb] + ([var_t] if var_t is not None else [])
+        return apply("box_coder_encode", f, *args)
+
+    # decode_center_size: target_box [N, M, 4] deltas, prior [M, 4]
+    def f(pbv, tbv, *rest):
+        pshape = (1, -1, 4) if axis == 0 else (-1, 1, 4)
+        pbb = pbv.reshape(pshape)
+        pcx, pcy, pw, ph = dims(pbb)
+        d = tbv
+        if rest:
+            v = rest[0].reshape(pshape)
+            d = d * v
+        elif var_l is not None:
+            d = d * jnp.asarray(var_l, d.dtype)
+        cx = d[..., 0] * pw + pcx
+        cy = d[..., 1] * ph + pcy
+        w = jnp.exp(d[..., 2]) * pw
+        h = jnp.exp(d[..., 3]) * ph
+        return jnp.stack(
+            [cx - w / 2, cy - h / 2, cx + w / 2 - norm, cy + h / 2 - norm], axis=-1
+        )
+
+    args = [pb, tb] + ([var_t] if var_t is not None else [])
+    return apply("box_coder_decode", f, *args)
+
+
+def _box_iou_xyxy(a, b, normalized=True):
+    """IoU of [..., 4] xyxy boxes, broadcasting."""
+    off = 0.0 if normalized else 1.0
+    ix1 = jnp.maximum(a[..., 0], b[..., 0])
+    iy1 = jnp.maximum(a[..., 1], b[..., 1])
+    ix2 = jnp.minimum(a[..., 2], b[..., 2])
+    iy2 = jnp.minimum(a[..., 3], b[..., 3])
+    iw = jnp.clip(ix2 - ix1 + off, 0)
+    ih = jnp.clip(iy2 - iy1 + off, 0)
+    inter = iw * ih
+    aa = (a[..., 2] - a[..., 0] + off) * (a[..., 3] - a[..., 1] + off)
+    ab = (b[..., 2] - b[..., 0] + off) * (b[..., 3] - b[..., 1] + off)
+    return inter / jnp.maximum(aa + ab - inter, 1e-10)
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
+             clip_bbox=True, name=None, scale_x_y=1.0, iou_aware=False,
+             iou_aware_factor=0.5):
+    """YOLOv3 box decode (vision/ops.py:266; phi yolo_box_kernel).
+    x: [N, C, H, W] -> boxes [N, A*H*W, 4] xyxy, scores [N, A*H*W, classes]."""
+    x = _t(x)
+    img_size = _t(img_size)
+    an = np.asarray(anchors, np.float32).reshape(-1, 2)
+    A = an.shape[0]
+    scale, bias = float(scale_x_y), -0.5 * (float(scale_x_y) - 1.0)
+
+    def f(v, imgs):
+        N, C, H, W = v.shape
+        attrs = 5 + class_num
+        if iou_aware:
+            iou_pred = jax.nn.sigmoid(v[:, :A].reshape(N, A, 1, H, W))
+            vb = v[:, A:].reshape(N, A, attrs, H, W)
+        else:
+            vb = v.reshape(N, A, attrs, H, W)
+        gx = jnp.arange(W).reshape(1, 1, 1, W)
+        gy = jnp.arange(H).reshape(1, 1, H, 1)
+        imw = imgs[:, 1].astype(v.dtype).reshape(N, 1, 1, 1)
+        imh = imgs[:, 0].astype(v.dtype).reshape(N, 1, 1, 1)
+        bx = (gx + jax.nn.sigmoid(vb[:, :, 0]) * scale + bias) * imw / W
+        by = (gy + jax.nn.sigmoid(vb[:, :, 1]) * scale + bias) * imh / H
+        bw = jnp.exp(vb[:, :, 2]) * an[:, 0].reshape(1, A, 1, 1) * imw / (downsample_ratio * W)
+        bh = jnp.exp(vb[:, :, 3]) * an[:, 1].reshape(1, A, 1, 1) * imh / (downsample_ratio * H)
+        conf = jax.nn.sigmoid(vb[:, :, 4])
+        if iou_aware:
+            conf = conf ** (1.0 - iou_aware_factor) * iou_pred[:, :, 0] ** iou_aware_factor
+        keep = conf >= conf_thresh
+        x1, y1 = bx - bw / 2, by - bh / 2
+        x2, y2 = bx + bw / 2, by + bh / 2
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0)
+            y1 = jnp.clip(y1, 0)
+            x2 = jnp.minimum(x2, imw - 1)
+            y2 = jnp.minimum(y2, imh - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], axis=-1) * keep[..., None]  # [N,A,H,W,4]
+        scores = jax.nn.sigmoid(vb[:, :, 5:]) * (conf * keep)[:, :, None]  # [N,A,cls,H,W]
+        boxes = boxes.reshape(N, A * H * W, 4)
+        scores = scores.transpose(0, 1, 3, 4, 2).reshape(N, A * H * W, class_num)
+        return boxes, scores
+
+    return apply("yolo_box", f, x, img_size)
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 loss (vision/ops.py:58; phi/kernels/cpu/yolo_loss_kernel.cc):
+    coord sce/l1 + class bce at matched cells, objectness bce with
+    ignore_thresh masking. Returns per-image loss [N]."""
+    x, gt_box, gt_label = _t(x), _t(gt_box), _t(gt_label)
+    an = np.asarray(anchors, np.float32).reshape(-1, 2)
+    mask = list(anchor_mask)
+    M = len(mask)
+    scale, bias = float(scale_x_y), -0.5 * (float(scale_x_y) - 1.0)
+    smooth = min(1.0 / class_num, 1.0 / 40) if use_label_smooth else 0.0
+    pos_l, neg_l = 1.0 - smooth, smooth
+
+    def sce(logit, label):
+        # SigmoidCrossEntropy as in the kernel
+        return jnp.maximum(logit, 0) - logit * label + jnp.log1p(jnp.exp(-jnp.abs(logit)))
+
+    def f(v, gtb, gtl, *rest):
+        N, C, H, W = v.shape
+        input_size = downsample_ratio * H
+        vb = v.reshape(N, M, 5 + class_num, H, W)
+        score = rest[0] if rest else jnp.ones(gtb.shape[:2], v.dtype)
+        valid = (gtb[..., 2] > 0) & (gtb[..., 3] > 0)  # [N, B]
+
+        # ---- pred boxes (normalized cxcywh) for ignore mask ----
+        gx = jnp.arange(W).reshape(1, 1, 1, W)
+        gy = jnp.arange(H).reshape(1, 1, H, 1)
+        man = an[mask]
+        px = (gx + jax.nn.sigmoid(vb[:, :, 0]) * scale + bias) / W
+        py = (gy + jax.nn.sigmoid(vb[:, :, 1]) * scale + bias) / H
+        pw = jnp.exp(vb[:, :, 2]) * man[:, 0].reshape(1, M, 1, 1) / input_size
+        ph = jnp.exp(vb[:, :, 3]) * man[:, 1].reshape(1, M, 1, 1) / input_size
+        pred = jnp.stack([px - pw / 2, py - ph / 2, px + pw / 2, py + ph / 2], -1)
+        g_xyxy = jnp.stack(
+            [gtb[..., 0] - gtb[..., 2] / 2, gtb[..., 1] - gtb[..., 3] / 2,
+             gtb[..., 0] + gtb[..., 2] / 2, gtb[..., 1] + gtb[..., 3] / 2], -1)
+        iou = _box_iou_xyxy(
+            pred[:, :, :, :, None, :], g_xyxy[:, None, None, None, :, :]
+        )  # [N, M, H, W, B]
+        iou = jnp.where(valid[:, None, None, None, :], iou, 0.0)
+        best_iou = iou.max(axis=-1)
+        ignore = best_iou > ignore_thresh  # [N, M, H, W]
+
+        # ---- per-gt best anchor (shifted IoU over ALL anchors) ----
+        ga = jnp.minimum(gtb[..., 2:3], an[:, 0] / input_size)  # [N, B, A]
+        gb = jnp.minimum(gtb[..., 3:4], an[:, 1] / input_size)
+        inter = ga * gb
+        union = gtb[..., 2:3] * gtb[..., 3:4] + (an[:, 0] / input_size) * (an[:, 1] / input_size) - inter
+        an_iou = inter / jnp.maximum(union, 1e-10)
+        best_n = jnp.argmax(an_iou, axis=-1)  # [N, B]
+        mask_arr = np.full(an.shape[0], -1, np.int32)
+        for mi, a_ in enumerate(mask):
+            mask_arr[a_] = mi
+        gtm = jnp.asarray(mask_arr)[best_n]  # [N, B] mask idx or -1
+        gtm = jnp.where(valid, gtm, -1)
+        matched = gtm >= 0
+        gi = jnp.clip((gtb[..., 0] * W).astype(jnp.int32), 0, W - 1)
+        gj = jnp.clip((gtb[..., 1] * H).astype(jnp.int32), 0, H - 1)
+
+        # ---- coord + class loss at matched cells ----
+        bidx = jnp.arange(N)[:, None]
+        midx = jnp.clip(gtm, 0)
+        sel = vb[bidx, midx, :, gj, gi]  # [N, B, 5+cls]
+        tx = gtb[..., 0] * W - gi
+        ty = gtb[..., 1] * H - gj
+        man_w = jnp.asarray(an[:, 0])[jnp.clip(best_n, 0)]
+        man_h = jnp.asarray(an[:, 1])[jnp.clip(best_n, 0)]
+        tw = jnp.log(jnp.maximum(gtb[..., 2] * input_size / man_w, 1e-9))
+        th = jnp.log(jnp.maximum(gtb[..., 3] * input_size / man_h, 1e-9))
+        box_scale = (2.0 - gtb[..., 2] * gtb[..., 3]) * score
+        coord = (
+            sce(sel[..., 0], tx) + sce(sel[..., 1], ty)
+            + jnp.abs(sel[..., 2] - tw) + jnp.abs(sel[..., 3] - th)
+        ) * box_scale
+        labels = jax.nn.one_hot(jnp.clip(gtl, 0), class_num) * (pos_l - neg_l) + neg_l
+        cls = jnp.sum(sce(sel[..., 5:], labels), -1) * score
+        per_gt = jnp.where(matched, coord + cls, 0.0)
+
+        # ---- objectness ----
+        obj_target = jnp.zeros((N, M, H, W), v.dtype)
+        obj_target = obj_target.at[bidx, midx, gj, gi].max(
+            jnp.where(matched, score, 0.0)
+        )
+        positive = obj_target > 1e-5
+        obj_logit = vb[:, :, 4]
+        obj_loss = jnp.where(
+            positive,
+            sce(obj_logit, 1.0) * obj_target,
+            jnp.where(ignore, 0.0, sce(obj_logit, 0.0)),
+        )
+        return per_gt.sum(-1) + obj_loss.sum((1, 2, 3))
+
+    args = [x, gt_box, gt_label] + ([_t(gt_score)] if gt_score is not None else [])
+    return apply("yolo_loss", f, *args)
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
+               keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=0, normalized=True, return_index=False,
+               return_rois_num=True, name=None):
+    """Matrix NMS (vision/ops.py:2236; phi/kernels/cpu/matrix_nms_kernel.cc).
+    Host-side (data-dependent output size, inference op)."""
+    bb = np.asarray(_t(bboxes)._raw())  # [N, M, 4]
+    sc = np.asarray(_t(scores)._raw())  # [N, C, M]
+    N, C, Mb = sc.shape
+    all_out, all_idx, rois_num = [], [], []
+    for i in range(N):
+        dets = []
+        for c in range(C):
+            if c == background_label:
+                continue
+            s = sc[i, c]
+            perm = np.where(s > score_threshold)[0]
+            if perm.size == 0:
+                continue
+            perm = perm[np.argsort(-s[perm], kind="stable")]
+            if nms_top_k > -1 and perm.size > nms_top_k:
+                perm = perm[:nms_top_k]
+            boxes_c = bb[i, perm]
+            n = perm.size
+            iou = np.asarray(
+                _box_iou_xyxy(
+                    jnp.asarray(boxes_c)[:, None, :], jnp.asarray(boxes_c)[None, :, :],
+                    normalized,
+                )
+            )
+            iou = np.tril(iou, -1)
+            iou_max = iou.max(axis=1)  # max overlap with higher-scored
+            if use_gaussian:
+                decay = np.exp((iou_max[None, :] ** 2 - iou ** 2) / gaussian_sigma)
+            else:
+                decay = (1.0 - iou) / np.maximum(1.0 - iou_max[None, :], 1e-10)
+            decay = np.where(np.tril(np.ones_like(iou), -1) > 0, decay, np.inf)
+            min_decay = np.minimum(decay.min(axis=1), 1.0)
+            ds = s[perm] * min_decay
+            keep = ds > post_threshold
+            for j in np.where(keep)[0]:
+                dets.append((float(ds[j]), c, perm[j], boxes_c[j]))
+        dets.sort(key=lambda d: -d[0])
+        if keep_top_k > -1:
+            dets = dets[:keep_top_k]
+        out = np.array(
+            [[d[1], d[0], *d[3]] for d in dets], np.float32
+        ).reshape(-1, 6)
+        idx = np.array([i * Mb + d[2] for d in dets], np.int64)
+        all_out.append(out)
+        all_idx.append(idx)
+        rois_num.append(len(dets))
+    out = Tensor(jnp.asarray(np.concatenate(all_out) if all_out else np.zeros((0, 6), np.float32)))
+    res = [out]
+    if return_index:
+        res.append(Tensor(jnp.asarray(np.concatenate(all_idx))))
+    if return_rois_num:
+        res.append(Tensor(jnp.asarray(np.array(rois_num, np.int32))))
+    return tuple(res) if len(res) > 1 else out
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000, nms_thresh=0.5,
+                       min_size=0.1, eta=1.0, pixel_offset=False,
+                       return_rois_num=False, name=None):
+    """RPN proposal generation (vision/ops.py:2038; phi
+    generate_proposals_kernel). Host-side (inference op): decode -> clip ->
+    filter small -> topk -> NMS -> topk."""
+    sc = np.asarray(_t(scores)._raw())       # [N, A, H, W]
+    bd = np.asarray(_t(bbox_deltas)._raw())  # [N, 4A, H, W]
+    ims = np.asarray(_t(img_size)._raw())    # [N, 2] (h, w)
+    anc = np.asarray(_t(anchors)._raw()).reshape(-1, 4)
+    var = np.asarray(_t(variances)._raw()).reshape(-1, 4)
+    N, A, H, W = sc.shape
+    off = 1.0 if pixel_offset else 0.0
+    rois, roi_probs, rois_num = [], [], []
+    for i in range(N):
+        s = sc[i].transpose(1, 2, 0).reshape(-1)           # HWA
+        d = bd[i].reshape(A, 4, H, W).transpose(2, 3, 0, 1).reshape(-1, 4)
+        order = np.argsort(-s, kind="stable")
+        if pre_nms_top_n > 0:
+            order = order[:pre_nms_top_n]
+        a = anc[order]
+        dd = d[order] * var[order]
+        aw = a[:, 2] - a[:, 0] + off
+        ah = a[:, 3] - a[:, 1] + off
+        acx = a[:, 0] + aw / 2
+        acy = a[:, 1] + ah / 2
+        cx = dd[:, 0] * aw + acx
+        cy = dd[:, 1] * ah + acy
+        w = np.exp(np.minimum(dd[:, 2], np.log(1000.0 / 16))) * aw
+        h = np.exp(np.minimum(dd[:, 3], np.log(1000.0 / 16))) * ah
+        props = np.stack(
+            [cx - w / 2, cy - h / 2, cx + w / 2 - off, cy + h / 2 - off], axis=1
+        )
+        imh, imw = ims[i, 0], ims[i, 1]
+        props[:, 0] = np.clip(props[:, 0], 0, imw - off)
+        props[:, 1] = np.clip(props[:, 1], 0, imh - off)
+        props[:, 2] = np.clip(props[:, 2], 0, imw - off)
+        props[:, 3] = np.clip(props[:, 3], 0, imh - off)
+        ss = s[order]
+        pw = props[:, 2] - props[:, 0] + off
+        ph = props[:, 3] - props[:, 1] + off
+        keep = (pw >= min_size) & (ph >= min_size)
+        props, ss = props[keep], ss[keep]
+        # greedy NMS
+        sel = []
+        idxs = np.arange(len(ss))
+        while idxs.size and (post_nms_top_n <= 0 or len(sel) < post_nms_top_n):
+            j = idxs[0]
+            sel.append(j)
+            if idxs.size == 1:
+                break
+            iou = np.asarray(
+                _box_iou_xyxy(jnp.asarray(props[j]), jnp.asarray(props[idxs[1:]]), not pixel_offset)
+            )
+            idxs = idxs[1:][iou <= nms_thresh]
+        rois.append(props[sel])
+        roi_probs.append(ss[sel].reshape(-1, 1))
+        rois_num.append(len(sel))
+    rois_t = Tensor(jnp.asarray(np.concatenate(rois).astype(np.float32)))
+    probs_t = Tensor(jnp.asarray(np.concatenate(roi_probs).astype(np.float32)))
+    if return_rois_num:
+        return rois_t, probs_t, Tensor(jnp.asarray(np.array(rois_num, np.int32)))
+    return rois_t, probs_t
+
+

@@ -265,3 +265,19 @@ def test_cached_linearization_dispatch_under_100us():
     np.testing.assert_allclose(
         a.grad.numpy(), np.ones((64, 64), np.float32) @ b.numpy().T, rtol=1e-4
     )
+
+
+def test_lin_cache_distinguishes_closure_free_lambdas():
+    """Two ops differing only by a closed-over closure-free lambda must not
+    share a cached linearization (code-review r2: '<lambda>' qualname
+    collision gave send_ue_recv(mul) the cached add results)."""
+    import paddle_tpu.geometric as G
+
+    x = paddle.to_tensor(np.arange(9, dtype=np.float32).reshape(3, 3))
+    x.stop_gradient = False
+    y = paddle.to_tensor(np.full((4, 1), 2.0, np.float32))
+    si = paddle.to_tensor(np.array([0, 1, 2, 0]))
+    di = paddle.to_tensor(np.array([1, 2, 1, 0]))
+    add = G.send_ue_recv(x, y, si, di, "add", "sum").numpy()
+    mul = G.send_ue_recv(x, y, si, di, "mul", "sum").numpy()
+    assert not np.allclose(add, mul)

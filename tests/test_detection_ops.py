@@ -1,0 +1,149 @@
+"""Detection op family numerics (reference phi kernels re-implemented as
+numpy oracles from paddle/phi/kernels/cpu/{yolo_box,box_coder,prior_box}_kernel.cc
+formulas)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.vision.ops as V
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def test_yolo_box_matches_naive():
+    rng = np.random.RandomState(0)
+    N, H, W, cls = 2, 4, 5, 3
+    anchors = [10, 13, 16, 30]
+    A = 2
+    x = rng.randn(N, A * (5 + cls), H, W).astype(np.float32)
+    img = np.array([[64, 96], [32, 48]], np.int32)
+    boxes, scores = V.yolo_box(
+        paddle.to_tensor(x), paddle.to_tensor(img), anchors, cls,
+        conf_thresh=0.01, downsample_ratio=8, clip_bbox=True,
+    )
+    bn, sn = boxes.numpy(), scores.numpy()
+    # naive per the kernel
+    v = x.reshape(N, A, 5 + cls, H, W)
+    for i in range(N):
+        imh, imw = img[i]
+        for j in range(A):
+            for k in range(H):
+                for l in range(W):
+                    conf = _sigmoid(v[i, j, 4, k, l])
+                    flat = j * H * W + k * W + l
+                    if conf < 0.01:
+                        assert np.all(bn[i, flat] == 0)
+                        continue
+                    bx = (l + _sigmoid(v[i, j, 0, k, l])) * imw / W
+                    by = (k + _sigmoid(v[i, j, 1, k, l])) * imh / H
+                    bw = np.exp(v[i, j, 2, k, l]) * anchors[2 * j] * imw / (8 * W)
+                    bh = np.exp(v[i, j, 3, k, l]) * anchors[2 * j + 1] * imh / (8 * H)
+                    x1 = max(bx - bw / 2, 0.0)
+                    y1 = max(by - bh / 2, 0.0)
+                    x2 = min(bx + bw / 2, imw - 1.0)
+                    y2 = min(by + bh / 2, imh - 1.0)
+                    np.testing.assert_allclose(bn[i, flat], [x1, y1, x2, y2], rtol=2e-5, atol=2e-5)
+                    want_s = conf * _sigmoid(v[i, j, 5:, k, l])
+                    np.testing.assert_allclose(sn[i, flat], want_s, rtol=2e-5, atol=2e-5)
+
+
+def test_box_coder_roundtrip():
+    rng = np.random.RandomState(1)
+    M, N = 6, 4
+    priors = np.sort(rng.rand(M, 4).astype(np.float32) * 50, axis=-1)
+    targets = np.sort(rng.rand(N, 4).astype(np.float32) * 50, axis=-1)
+    var = [0.1, 0.1, 0.2, 0.2]
+    enc = V.box_coder(paddle.to_tensor(priors), var, paddle.to_tensor(targets),
+                      code_type="encode_center_size").numpy()
+    assert enc.shape == (N, M, 4)
+    dec = V.box_coder(paddle.to_tensor(priors), var, paddle.to_tensor(enc),
+                      code_type="decode_center_size", axis=0).numpy()
+    # decoding the encodings reproduces the targets against every prior
+    for j in range(M):
+        np.testing.assert_allclose(dec[:, j], targets, rtol=1e-4, atol=1e-4)
+
+
+def test_prior_box_shapes_and_values():
+    feat = paddle.to_tensor(np.zeros((1, 8, 4, 4), np.float32))
+    image = paddle.to_tensor(np.zeros((1, 3, 32, 32), np.float32))
+    boxes, var = V.prior_box(feat, image, min_sizes=[8.0], max_sizes=[16.0],
+                             aspect_ratios=[2.0], variance=[0.1, 0.1, 0.2, 0.2])
+    # expanded ars = [1, 2] (+max) -> 3 priors
+    assert boxes.shape == [4, 4, 3, 4]
+    b = boxes.numpy()
+    # first prior at cell (0,0): min box centered at offset*step=4
+    np.testing.assert_allclose(b[0, 0, 0], [(4 - 4) / 32, (4 - 4) / 32, (4 + 4) / 32, (4 + 4) / 32], atol=1e-6)
+    np.testing.assert_allclose(var.numpy()[0, 0, 0], [0.1, 0.1, 0.2, 0.2])
+
+
+def test_yolo_loss_runs_and_grads():
+    rng = np.random.RandomState(2)
+    N, H, W, cls = 2, 4, 4, 3
+    anchors = [10, 13, 16, 30, 33, 23]
+    mask = [0, 1]
+    x = paddle.to_tensor(rng.randn(N, len(mask) * (5 + cls), H, W).astype(np.float32) * 0.1)
+    x.stop_gradient = False
+    gt = np.zeros((N, 5, 4), np.float32)
+    gt[:, 0] = [0.4, 0.4, 0.2, 0.3]
+    gt[:, 1] = [0.7, 0.2, 0.1, 0.1]
+    gl = np.zeros((N, 5), np.int64)
+    gl[:, 0], gl[:, 1] = 1, 2
+    loss = V.yolo_loss(x, paddle.to_tensor(gt), paddle.to_tensor(gl), anchors,
+                       mask, cls, ignore_thresh=0.7, downsample_ratio=8)
+    assert loss.shape == [N]
+    total = loss.sum()
+    total.backward()
+    g = x.grad.numpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+    # invalid gts (w/h <= 0) contribute only negative-objectness loss
+    loss0 = V.yolo_loss(x, paddle.to_tensor(np.zeros((N, 5, 4), np.float32)),
+                        paddle.to_tensor(gl), anchors, mask, cls, 0.7, 8)
+    assert float(loss0.sum()) > 0
+
+
+def test_matrix_nms_basic():
+    boxes = np.array([[[0, 0, 10, 10], [0.5, 0.5, 10.5, 10.5], [20, 20, 30, 30]]], np.float32)
+    scores = np.array([[[0.9, 0.8, 0.7]]], np.float32)  # one class
+    out, idx, num = V.matrix_nms(
+        paddle.to_tensor(boxes), paddle.to_tensor(scores),
+        score_threshold=0.1, post_threshold=0.0, nms_top_k=-1, keep_top_k=-1,
+        background_label=-1, return_index=True,
+    )
+    o = out.numpy()
+    assert int(num.numpy()[0]) == 3
+    # top box keeps its score; heavy-overlap second box decays
+    np.testing.assert_allclose(o[0, 1], 0.9, rtol=1e-6)
+    overlapped = o[np.argsort(-o[:, 1])][1:]
+    assert (overlapped[:, 1] < 0.9).all()
+    decayed = o[o[:, 2] == 0.5]
+    assert decayed.size and decayed[0, 1] < 0.8  # decayed below raw score
+
+
+def test_generate_proposals_shapes():
+    rng = np.random.RandomState(3)
+    N, A, H, W = 1, 3, 4, 4
+    scores = rng.rand(N, A, H, W).astype(np.float32)
+    deltas = rng.randn(N, 4 * A, H, W).astype(np.float32) * 0.1
+    anchors = np.stack(np.meshgrid(np.arange(H), np.arange(W), indexing="ij"), -1)
+    anc = np.zeros((H, W, A, 4), np.float32)
+    for a in range(A):
+        anc[..., a, 0] = anchors[..., 1] * 8
+        anc[..., a, 1] = anchors[..., 0] * 8
+        anc[..., a, 2] = anchors[..., 1] * 8 + 16 * (a + 1)
+        anc[..., a, 3] = anchors[..., 0] * 8 + 16 * (a + 1)
+    var = np.ones_like(anc)
+    rois, probs, num = V.generate_proposals(
+        paddle.to_tensor(scores), paddle.to_tensor(deltas),
+        paddle.to_tensor(np.array([[32.0, 32.0]], np.float32)),
+        paddle.to_tensor(anc), paddle.to_tensor(var),
+        pre_nms_top_n=20, post_nms_top_n=5, nms_thresh=0.7, min_size=1.0,
+        return_rois_num=True,
+    )
+    r, p = rois.numpy(), probs.numpy()
+    assert r.shape[0] == p.shape[0] == int(num.numpy()[0]) <= 5
+    assert (r[:, 2] >= r[:, 0]).all() and (r[:, 3] >= r[:, 1]).all()
+    assert (r >= 0).all() and (r <= 32).all()
+    # scores sorted descending
+    assert (np.diff(p[:, 0]) <= 1e-6).all()

@@ -1,0 +1,205 @@
+"""Round-2 op-gap closures: graph ops, losses, sampling, quantized linear,
+pooling extensions — numerics vs torch / numpy oracles."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+def test_segment_ops():
+    import paddle_tpu.geometric as G
+
+    data = paddle.to_tensor(np.array([[1, 2, 3], [3, 2, 1], [4, 5, 6]], np.float32))
+    ids = paddle.to_tensor(np.array([0, 0, 1]))
+    np.testing.assert_allclose(G.segment_sum(data, ids).numpy(), [[4, 4, 4], [4, 5, 6]])
+    np.testing.assert_allclose(G.segment_mean(data, ids).numpy(), [[2, 2, 2], [4, 5, 6]])
+    np.testing.assert_allclose(G.segment_max(data, ids).numpy(), [[3, 2, 3], [4, 5, 6]])
+    np.testing.assert_allclose(G.segment_min(data, ids).numpy(), [[1, 2, 1], [4, 5, 6]])
+    # grads flow through segment_sum
+    data.stop_gradient = False
+    G.segment_sum(data, ids).sum().backward()
+    np.testing.assert_allclose(data.grad.numpy(), np.ones((3, 3)))
+
+
+def test_send_ue_recv_and_send_uv():
+    import paddle_tpu.geometric as G
+
+    x = paddle.to_tensor(np.array([[0, 2, 3], [1, 4, 5], [2, 6, 7]], np.float32))
+    y = paddle.to_tensor(np.array([1.0, 2.0, 3.0, 4.0], np.float32).reshape(4, 1))
+    si = paddle.to_tensor(np.array([0, 1, 2, 0]))
+    di = paddle.to_tensor(np.array([1, 2, 1, 0]))
+    out = G.send_ue_recv(x, y, si, di, "add", "sum").numpy()
+    msgs = x.numpy()[[0, 1, 2, 0]] + y.numpy()
+    want = np.zeros((3, 3), np.float32)
+    for m, d in zip(msgs, [1, 2, 1, 0]):
+        want[d] += m
+    np.testing.assert_allclose(out, want)
+    uv = G.send_uv(x, x, si, di, "mul").numpy()
+    np.testing.assert_allclose(uv, x.numpy()[[0, 1, 2, 0]] * x.numpy()[[1, 2, 1, 0]])
+
+
+def test_margin_cross_entropy_reduces_to_ce():
+    # margins (1, 0, 0) make it plain scaled softmax CE on cosines
+    rng = np.random.RandomState(0)
+    logits = np.tanh(rng.randn(6, 10)).astype(np.float32)
+    label = rng.randint(0, 10, (6,))
+    lt = paddle.to_tensor(logits)
+    lt.stop_gradient = False
+    loss, sm = F.margin_cross_entropy(
+        lt, paddle.to_tensor(label), margin1=1.0, margin2=0.0, margin3=0.0,
+        scale=4.0, return_softmax=True,
+    )
+    ref = F.cross_entropy(paddle.to_tensor(logits * 4.0), paddle.to_tensor(label))
+    np.testing.assert_allclose(float(loss), float(ref), rtol=1e-5)
+    loss.backward()
+    assert np.isfinite(lt.grad.numpy()).all()
+    # arcface margin increases the loss (harder target)
+    loss2 = F.margin_cross_entropy(
+        paddle.to_tensor(logits), paddle.to_tensor(label), margin2=0.5, scale=4.0
+    )
+    assert float(loss2) > float(loss)
+
+
+def test_class_center_sample():
+    label = paddle.to_tensor(np.array([2, 5, 2, 7], np.int64))
+    remapped, sampled = F.class_center_sample(label, num_classes=20, num_samples=6)
+    s = sampled.numpy()
+    assert len(np.unique(s)) == len(s) == 6
+    assert {2, 5, 7}.issubset(set(s.tolist()))
+    r = remapped.numpy()
+    np.testing.assert_array_equal(s[r], label.numpy())
+
+
+def test_hsigmoid_loss_matches_torch_tree_semantics():
+    """Compare against a pure-numpy oracle of the SimpleCode tree."""
+    rng = np.random.RandomState(1)
+    N, D, C = 4, 5, 6
+    x = rng.randn(N, D).astype(np.float32)
+    lb = rng.randint(0, C, (N,))
+    w = rng.randn(C - 1, D).astype(np.float32) * 0.5
+    b = rng.randn(C - 1).astype(np.float32) * 0.5
+
+    def oracle():
+        out = np.zeros((N, 1), np.float32)
+        for i in range(N):
+            code = lb[i] + C
+            length = int(np.floor(np.log2(code)))
+            for j in range(length):
+                idx = (code >> (j + 1)) - 1
+                bit = (code >> j) & 1
+                z = w[idx] @ x[i] + b[idx]
+                out[i, 0] += max(z, 0) - z * bit + np.log1p(np.exp(-abs(z)))
+        return out
+
+    xt = paddle.to_tensor(x)
+    xt.stop_gradient = False
+    got = F.hsigmoid_loss(xt, paddle.to_tensor(lb), C, paddle.to_tensor(w), paddle.to_tensor(b))
+    np.testing.assert_allclose(got.numpy(), oracle(), rtol=1e-4, atol=1e-5)
+    got.sum().backward()
+    assert np.abs(xt.grad.numpy()).sum() > 0
+
+
+def test_rnnt_loss_matches_bruteforce():
+    """Brute-force transducer DP oracle (all alignments enumerated via DP)."""
+    rng = np.random.RandomState(2)
+    B, T, U, V = 2, 4, 2, 5
+    logits = rng.randn(B, T, U + 1, V).astype(np.float32)
+    labels = rng.randint(1, V, (B, U))
+    tl = np.array([4, 3], np.int32)
+    ul = np.array([2, 1], np.int32)
+
+    def oracle(i):
+        lp = logits[i] - np.log(np.exp(logits[i]).sum(-1, keepdims=True))
+        Ti, Ui = tl[i], ul[i]
+        alpha = np.full((Ti, Ui + 1), -np.inf)
+        alpha[0, 0] = 0.0
+        for t in range(Ti):
+            for u in range(Ui + 1):
+                if t == 0 and u == 0:
+                    pass
+                else:
+                    cands = []
+                    if t > 0:
+                        cands.append(alpha[t - 1, u] + lp[t - 1, u, 0])
+                    if u > 0:
+                        cands.append(alpha[t, u - 1] + lp[t, u - 1, labels[i, u - 1]])
+                    alpha[t, u] = np.logaddexp.reduce(cands)
+        return -(alpha[Ti - 1, Ui] + lp[Ti - 1, Ui, 0])
+
+    got = F.rnnt_loss(
+        paddle.to_tensor(logits), paddle.to_tensor(labels),
+        paddle.to_tensor(tl), paddle.to_tensor(ul), blank=0, reduction="none",
+    ).numpy()
+    want = np.array([oracle(0), oracle(1)])
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+    # grads
+    lt = paddle.to_tensor(logits)
+    lt.stop_gradient = False
+    F.rnnt_loss(lt, paddle.to_tensor(labels), paddle.to_tensor(tl),
+                paddle.to_tensor(ul), reduction="sum").backward()
+    assert np.isfinite(lt.grad.numpy()).all()
+
+
+def test_edit_distance():
+    a = paddle.to_tensor(np.array([[1, 2, 3, 4], [5, 6, 7, 8]], np.int64))
+    b = paddle.to_tensor(np.array([[1, 9, 3], [5, 6, 7]], np.int64))
+    il = paddle.to_tensor(np.array([4, 3], np.int64))
+    ll = paddle.to_tensor(np.array([3, 3], np.int64))
+    d, n = F.edit_distance(a, b, normalized=False, input_length=il, label_length=ll)
+    np.testing.assert_allclose(d.numpy(), [[2.0], [0.0]])
+    assert int(n.numpy()[0]) == 2
+    dn, _ = F.edit_distance(a, b, normalized=True, input_length=il, label_length=ll)
+    np.testing.assert_allclose(dn.numpy(), [[2.0 / 3], [0.0]])
+
+
+def test_top_p_sampling():
+    probs = np.array([[0.5, 0.3, 0.1, 0.1], [0.9, 0.05, 0.03, 0.02]], np.float32)
+    vals, ids = paddle.top_p_sampling(paddle.to_tensor(probs), paddle.to_tensor(np.array([0.7, 0.5], np.float32)))
+    i = ids.numpy()
+    assert i[0, 0] in (0, 1)  # nucleus of row 0 at p=0.7 is {0, 1}
+    assert i[1, 0] == 0       # row 1 nucleus at p=0.5 is {0}
+    np.testing.assert_allclose(vals.numpy()[1, 0], 0.9)
+
+
+def test_lu_unpack_reconstructs():
+    rng = np.random.RandomState(3)
+    A = rng.randn(5, 5).astype(np.float32)
+    lu, piv = paddle.linalg.lu(paddle.to_tensor(A))
+    P, L, U = paddle.linalg.lu_unpack(lu, piv)
+    rec = P.numpy() @ L.numpy() @ U.numpy()
+    np.testing.assert_allclose(rec, A, rtol=1e-4, atol=1e-5)
+
+
+def test_binomial_standard_gamma():
+    paddle.seed(0)
+    c = paddle.to_tensor(np.full((2000,), 10, np.int64))
+    p = paddle.to_tensor(np.full((2000,), 0.3, np.float32))
+    s = paddle.binomial(c, p).numpy()
+    assert s.min() >= 0 and s.max() <= 10
+    assert abs(s.mean() - 3.0) < 0.3
+    g = paddle.standard_gamma(paddle.to_tensor(np.full((2000,), 4.0, np.float32))).numpy()
+    assert abs(g.mean() - 4.0) < 0.5 and (g > 0).all()
+
+
+def test_weight_only_linear_int8_int4():
+    from paddle_tpu.nn import quant
+
+    rng = np.random.RandomState(4)
+    x = rng.randn(3, 16).astype(np.float32)
+    w = rng.randn(16, 8).astype(np.float32)
+    bias = rng.randn(8).astype(np.float32)
+    for algo, wd, tol in [("weight_only_int8", "int8", 2e-2), ("weight_only_int4", "int4", 2e-1)]:
+        qw, scale = quant.weight_quantize(paddle.to_tensor(w), algo=algo)
+        out = quant.weight_only_linear(
+            paddle.to_tensor(x), qw, paddle.to_tensor(bias), scale, weight_dtype=wd
+        ).numpy()
+        want = x @ w + bias
+        np.testing.assert_allclose(out, want, rtol=tol, atol=tol * np.abs(want).max())
+    # dequant roundtrip
+    qw, scale = quant.weight_quantize(paddle.to_tensor(w), algo="weight_only_int8")
+    wd = quant.weight_dequantize(qw, scale).numpy()
+    np.testing.assert_allclose(wd, w, atol=np.abs(w).max() / 100)
+    # llm.int8 path
+    out = quant.llm_int8_linear(paddle.to_tensor(x), qw, paddle.to_tensor(bias), scale).numpy()
+    np.testing.assert_allclose(out, x @ w + bias, rtol=2e-2, atol=2e-2 * np.abs(x @ w).max())

@@ -247,3 +247,42 @@ def test_new_functional_ops():
     p = paddle.to_tensor(np.random.RandomState(4).randn(4, 8).astype("float32"))
     l = paddle.to_tensor(np.array([0, 0, 1, 1], "int64"))
     assert np.isfinite(float(F.npair_loss(a, p, l).numpy()))
+
+
+def test_grid_sample_reflection_matches_torch_both_conventions():
+    """ADVICE r1: reflection must follow the align_corners convention
+    (centers for True, -0.5/size-0.5 borders for False)."""
+    torch = pytest.importorskip("torch")
+    import paddle_tpu.nn.functional as F
+
+    rng = np.random.RandomState(0)
+    img = rng.randn(2, 3, 5, 6).astype(np.float32)
+    # grid values beyond [-1, 1] so reflection actually engages
+    grid = (rng.rand(2, 4, 7, 2).astype(np.float32) * 3.0) - 1.5
+    for ac in (True, False):
+        for mode in ("bilinear", "nearest"):
+            want = torch.nn.functional.grid_sample(
+                torch.tensor(img), torch.tensor(grid),
+                mode=mode, padding_mode="reflection", align_corners=ac,
+            ).numpy()
+            got = F.grid_sample(
+                paddle.to_tensor(img), paddle.to_tensor(grid),
+                mode=mode, padding_mode="reflection", align_corners=ac,
+            ).numpy()
+            np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5,
+                                       err_msg=f"ac={ac} mode={mode}")
+
+
+def test_interpolate_area_matches_torch_adaptive_avg():
+    """ADVICE r1: mode='area' must be adaptive averaging, not linear resize."""
+    torch = pytest.importorskip("torch")
+    import paddle_tpu.nn.functional as F
+
+    rng = np.random.RandomState(1)
+    img = rng.randn(2, 3, 8, 12).astype(np.float32)
+    # integral downscale, fractional downscale, and upscale all follow
+    # torch's adaptive-average semantics (code-review r2 finding)
+    for size in [(4, 6), (5, 7), (11, 16)]:
+        want = torch.nn.functional.interpolate(torch.tensor(img), size=size, mode="area").numpy()
+        got = F.interpolate(paddle.to_tensor(img), size=list(size), mode="area").numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5, err_msg=str(size))
