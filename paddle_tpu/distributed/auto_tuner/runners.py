@@ -58,8 +58,15 @@ class MeshTrialRunner:
                                        "sharding_degree": dp}
         else:
             strategy.hybrid_configs = {"dp_degree": dp, "mp_degree": mp, "pp_degree": pp}
-        micro_bs = self.global_batch_size // max(self.global_batch_size // mb, 1)
-        acc = max(self.global_batch_size // micro_bs, 1)
+        if self.global_batch_size % mb:
+            # a silently-remapped micro batch would record this config's
+            # metric against numbers measured for a different config — the
+            # tuner records the raised error as a failed trial instead
+            raise ValueError(
+                f"micro_batch={mb} does not divide global_batch_size={self.global_batch_size}"
+            )
+        micro_bs = mb
+        acc = self.global_batch_size // micro_bs
         strategy.pipeline_configs = {"micro_batch_size": micro_bs, "accumulate_steps": acc}
         fleet.init(is_collective=True, strategy=strategy)
 

@@ -131,7 +131,9 @@ class CommTaskManager:
 
     def _scan_loop(self):
         while True:
-            self._wake.wait(timeout=0.1)
+            # block until a task registers (start_task sets the event) —
+            # zero idle wakeups when nothing is in flight
+            self._wake.wait()
             self._wake.clear()
             while True:
                 with self._lock:

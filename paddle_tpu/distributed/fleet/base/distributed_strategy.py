@@ -110,11 +110,19 @@ class DistributedStrategy:
 
     @comm_watchdog_timeout.setter
     def comm_watchdog_timeout(self, seconds):
+        # stored only; the process-global flags are applied by fleet.init so
+        # a throwaway strategy object never reconfigures the live watchdog
+        self._comm_watchdog_timeout = seconds
+
+    def _apply_comm_watchdog(self):
+        """Called by fleet.init with the ACTIVE strategy."""
         from ....framework import flags as _flags
         from ...comm_watchdog import CommTaskManager  # noqa: F401 (define flags)
 
-        self._comm_watchdog_timeout = seconds
-        if seconds is None or seconds <= 0:
+        seconds = self._comm_watchdog_timeout
+        if seconds is None:
+            return  # keep flag defaults
+        if seconds <= 0:
             _flags.set_flags({"FLAGS_enable_comm_watchdog": False})
         else:
             _flags.set_flags(

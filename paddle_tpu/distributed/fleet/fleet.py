@@ -46,6 +46,7 @@ def init(role_maker=None, is_collective: bool = False, strategy: Optional[Distri
     _state.strategy = strategy
     _state.is_collective = is_collective
     _state.initialized = True
+    strategy._apply_comm_watchdog()
 
     hybrid = strategy.hybrid_configs
     order = strategy.hybrid_parallel_order

@@ -453,3 +453,220 @@ def fused_linear_cross_entropy(
 
     args = [x, weight, labels] + ([bias] if bias is not None else [])
     return apply("fused_linear_cross_entropy", fn, *args)
+
+
+# ---------------------------------------------------------------------------
+# decode-time fused attention with kv cache (LLM serving path)
+# ---------------------------------------------------------------------------
+
+def masked_multihead_attention(
+    x,
+    cache_kv=None,
+    bias=None,
+    src_mask=None,
+    cum_offsets=None,
+    sequence_lengths=None,
+    rotary_tensor=None,
+    beam_cache_offset=None,
+    qkv_out_scale=None,
+    out_shift=None,
+    out_smooth=None,
+    seq_len=1,
+    rotary_emb_dims=0,
+    use_neox_rotary_style=False,
+    compute_dtype="default",
+    out_scale=-1,
+    quant_round_type=1,
+    quant_max_bound=127.0,
+    quant_min_bound=-127.0,
+):
+    """Single-step decode attention with kv-cache append (reference
+    incubate/nn/functional/masked_multihead_attention.py; CUDA kernel
+    phi/fusion/masked_multihead_attention). x is the current token's fused
+    qkv [B, 3*H*D]; cache_kv [2, B, H, max_seq, D]; sequence_lengths [B]
+    gives each sample's current cache fill. Returns (out [B, H*D],
+    cache_kv_out). Quant paths (qkv_out_scale/out_shift/...) are CUDA int8
+    serving tricks — not supported."""
+    for unsupported in (qkv_out_scale, out_shift, out_smooth, beam_cache_offset, cum_offsets):
+        if unsupported is not None:
+            raise NotImplementedError("masked_multihead_attention: quant/beam paths not supported")
+    from ....core.tensor import Tensor as _T
+
+    x = x if isinstance(x, _T) else _T(jnp.asarray(x))
+    cache = cache_kv if isinstance(cache_kv, _T) else _T(jnp.asarray(cache_kv))
+
+    def fn(xv, ckv, *rest):
+        r = list(rest)
+        bias_v = r.pop(0) if bias is not None else None
+        mask_v = r.pop(0) if src_mask is not None else None
+        seqlen_v = r.pop(0) if sequence_lengths is not None else None
+        rot_v = r.pop(0) if rotary_tensor is not None else None
+        _, B, H, S, D = ckv.shape
+        qkv = xv
+        if bias_v is not None:
+            qkv = qkv + bias_v
+        qkv = qkv.reshape(B, 3, H, D)
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]  # [B, H, D]
+        pos = (
+            seqlen_v.reshape(B).astype(jnp.int32)
+            if seqlen_v is not None
+            else jnp.zeros((B,), jnp.int32)
+        )
+        if rotary_emb_dims > 0 and rot_v is not None:
+            # rotary_tensor [2, B, 1, max_seq, D]: cos/sin at each position
+            cos = jnp.take_along_axis(
+                rot_v[0, :, 0], pos[:, None, None], axis=1
+            )  # [B, 1, D]
+            sin = jnp.take_along_axis(rot_v[1, :, 0], pos[:, None, None], axis=1)
+
+            def rope(t):
+                if use_neox_rotary_style:
+                    half = D // 2
+                    t1, t2 = t[..., :half], t[..., half:]
+                    rt = jnp.concatenate([-t2, t1], axis=-1)
+                else:
+                    t1 = t[..., 0::2]
+                    t2 = t[..., 1::2]
+                    rt = jnp.stack([-t2, t1], axis=-1).reshape(t.shape)
+                return t * cos + rt * sin
+
+            q, k = rope(q), rope(k)
+        # append k/v at each sample's position
+        bidx = jnp.arange(B)
+        new_k = ckv[0].at[bidx, :, pos, :].set(k)
+        new_v = ckv[1].at[bidx, :, pos, :].set(v)
+        scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))
+        logits = jnp.einsum("bhd,bhsd->bhs", q.astype(jnp.float32), new_k.astype(jnp.float32)) * scale
+        sidx = jnp.arange(S)[None, None, :]
+        valid = sidx <= pos[:, None, None]
+        logits = jnp.where(valid, logits, -1e30)
+        if mask_v is not None:
+            logits = logits + mask_v.reshape(B, 1, -1)[:, :, :S].astype(jnp.float32)
+        p = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum("bhs,bhsd->bhd", p.astype(new_v.dtype), new_v)
+        return out.reshape(B, H * D), jnp.stack([new_k, new_v])
+
+    args = [x, cache]
+    for t in (bias, src_mask, sequence_lengths, rotary_tensor):
+        if t is not None:
+            args.append(t if isinstance(t, Tensor) else Tensor(jnp.asarray(t)))
+    out, new_cache = apply("masked_multihead_attention", fn, *args, n_outputs=2)
+    # reference semantics: cache updated in place
+    cache._replace_value(new_cache._raw())
+    return out, cache
+
+
+def block_multihead_attention(
+    qkv,
+    key_cache,
+    value_cache,
+    seq_lens_encoder,
+    seq_lens_decoder,
+    seq_lens_this_time,
+    padding_offsets,
+    cum_offsets,
+    cu_seqlens_q,
+    cu_seqlens_k,
+    block_tables,
+    pre_key_cache=None,
+    pre_value_cache=None,
+    cache_k_quant_scales=None,
+    cache_v_quant_scales=None,
+    cache_k_dequant_scales=None,
+    cache_v_dequant_scales=None,
+    qkv_out_scale=None,
+    qkv_bias=None,
+    out_shift=None,
+    out_smooth=None,
+    max_enc_len_this_time=None,
+    max_dec_len_this_time=None,
+    rope_emb=None,
+    mask=None,
+    tgt_mask=None,
+    max_seq_len=-1,
+    block_size=64,
+    use_neox_style=False,
+    **quant_kwargs,
+):
+    """Paged-KV-cache attention (reference block_multihead_attention.py;
+    CUDA kernel phi/fusion/block_multi_head_attention). Host-orchestrated
+    TPU version: per sample, prefill (seq_lens_encoder > 0) runs causal
+    self-attention over the packed tokens and writes k/v into the sample's
+    cache pages via block_tables; decode (seq_lens_decoder > 0) appends one
+    token into the current page and attends over the gathered pages.
+    Quant/pre-cache paths are not supported. Returns (out, qkv, key_cache,
+    value_cache) like the reference (caches updated in place)."""
+    for unsupported in (
+        pre_key_cache, pre_value_cache, cache_k_quant_scales, cache_v_quant_scales,
+        cache_k_dequant_scales, cache_v_dequant_scales, qkv_out_scale, out_shift, out_smooth,
+        rope_emb, mask, tgt_mask,
+    ):
+        if unsupported is not None:
+            raise NotImplementedError(
+                "block_multihead_attention: quant/pre-cache/rope/mask paths not"
+                " supported — apply rotary embedding to qkv beforehand"
+                " (incubate fused_rotary_position_embedding)"
+            )
+    import numpy as np
+    from ....core.tensor import Tensor as _T
+
+    def _np(t):
+        return np.asarray(t._raw() if isinstance(t, _T) else t)
+
+    qkv_t = qkv if isinstance(qkv, _T) else _T(jnp.asarray(qkv))
+    qv = qkv_t._raw()
+    if qkv_bias is not None:
+        qv = qv + (qkv_bias._raw() if isinstance(qkv_bias, _T) else jnp.asarray(qkv_bias))
+    kc = key_cache._raw() if isinstance(key_cache, _T) else jnp.asarray(key_cache)
+    vc = value_cache._raw() if isinstance(value_cache, _T) else jnp.asarray(value_cache)
+    enc = _np(seq_lens_encoder).reshape(-1)
+    dec = _np(seq_lens_decoder).reshape(-1)
+    this = _np(seq_lens_this_time).reshape(-1)
+    tables = _np(block_tables)
+    B = enc.shape[0]
+    nb_heads, bs, hd = kc.shape[1], kc.shape[2], kc.shape[3]
+    H = nb_heads
+    token_dim = qv.shape[-1] // 3
+    D = token_dim // H
+    outs = []
+    tok = 0
+    scale = 1.0 / float(np.sqrt(D))
+    for i in range(B):
+        n = int(this[i])
+        if n == 0:
+            continue
+        cur = qv[tok : tok + n].reshape(n, 3, H, D)
+        q, k, v = cur[:, 0], cur[:, 1], cur[:, 2]  # [n, H, D]
+        if enc[i] > 0:
+            # prefill: causal self-attention over this sample's n tokens
+            lg = jnp.einsum("qhd,khd->hqk", q.astype(jnp.float32), k.astype(jnp.float32)) * scale
+            cm = jnp.tril(jnp.ones((n, n), bool))
+            lg = jnp.where(cm[None], lg, -1e30)
+            o = jnp.einsum("hqk,khd->qhd", jax.nn.softmax(lg, -1).astype(v.dtype), v)
+            # write k/v into cache pages
+            for t_ in range(n):
+                page = int(tables[i, t_ // bs])
+                slot = t_ % bs
+                kc = kc.at[page, :, slot, :].set(k[t_])
+                vc = vc.at[page, :, slot, :].set(v[t_])
+        else:
+            # decode: append one token at position dec[i], attend over cache
+            pos = int(dec[i])
+            page = int(tables[i, pos // bs])
+            slot = pos % bs
+            kc = kc.at[page, :, slot, :].set(k[0])
+            vc = vc.at[page, :, slot, :].set(v[0])
+            npages = pos // bs + 1
+            pages = tables[i, :npages].astype(np.int64)
+            ks = kc[jnp.asarray(pages)].transpose(1, 0, 2, 3).reshape(H, npages * bs, D)
+            vs = vc[jnp.asarray(pages)].transpose(1, 0, 2, 3).reshape(H, npages * bs, D)
+            ks, vs = ks[:, : pos + 1], vs[:, : pos + 1]
+            lg = jnp.einsum("qhd,hkd->hqk", q.astype(jnp.float32), ks.astype(jnp.float32)) * scale
+            o = jnp.einsum("hqk,hkd->qhd", jax.nn.softmax(lg, -1).astype(vs.dtype), vs)
+        outs.append(o.reshape(n, H * D))
+        tok += n
+    out = _T(jnp.concatenate(outs) if outs else jnp.zeros((0, token_dim), qv.dtype))
+    if isinstance(key_cache, _T):
+        key_cache._replace_value(kc)
+        value_cache._replace_value(vc)
+    return out, qkv_t, key_cache, value_cache

@@ -72,11 +72,14 @@ def _dequant(qw, scale, weight_dtype, group_size, out_dtype):
 
 
 def weight_dequantize(x, scale, algo="weight_only_int8", out_dtype="float16", group_size=-1):
+    from ...framework.dtype import convert_dtype
+
     x, scale = _t(x), _t(scale)
     wd = "int4" if algo == "weight_only_int4" else "int8"
+    odt = jnp.dtype(convert_dtype(out_dtype))
 
     def f(qw, s):
-        return _dequant(qw, s, wd, group_size, jnp.float32)
+        return _dequant(qw, s, wd, group_size, jnp.float32).astype(odt)
 
     return apply_nograd("weight_dequantize", f, x, scale)
 

@@ -337,6 +337,7 @@ def binomial(count, prob, name=None) -> Tensor:
     prob = _ensure_tensor(prob)
     c = count.value.astype(jnp.float32)
     p = prob.value.astype(jnp.float32)
+    c, p = jnp.broadcast_arrays(c, p)  # paddle allows broadcastable shapes
     # under tracing the max count is unknowable -> normal approximation
     # (valid for any count; exact Bernoulli-sum only for concrete small counts)
     cmax = int(np.asarray(jnp.max(c))) if not isinstance(c, jax.core.Tracer) else None

@@ -105,11 +105,23 @@ def test_collectives_register_tasks(capture_handler):
 def test_disable_via_strategy():
     from paddle_tpu.distributed import fleet
 
+    # setting the attribute alone must NOT touch the process flags (a
+    # throwaway strategy can't reconfigure the live watchdog) ...
+    before = _flags.get_flag("FLAGS_enable_comm_watchdog")
     s = fleet.DistributedStrategy()
-    s.comm_watchdog_timeout = 5.0
-    assert _flags.get_flag("FLAGS_enable_comm_watchdog")
-    assert _flags.get_flag("FLAGS_comm_watchdog_timeout_s") == 5.0
     s.comm_watchdog_timeout = 0
-    assert not _flags.get_flag("FLAGS_enable_comm_watchdog")
-    # restore defaults for other tests
-    s.comm_watchdog_timeout = 600.0
+    assert _flags.get_flag("FLAGS_enable_comm_watchdog") == before
+    # ... only fleet.init with the strategy applies it
+    try:
+        s.comm_watchdog_timeout = 5.0
+        fleet.init(is_collective=True, strategy=s)
+        assert _flags.get_flag("FLAGS_enable_comm_watchdog")
+        assert _flags.get_flag("FLAGS_comm_watchdog_timeout_s") == 5.0
+        s2 = fleet.DistributedStrategy()
+        s2.comm_watchdog_timeout = 0
+        fleet.init(is_collective=True, strategy=s2)
+        assert not _flags.get_flag("FLAGS_enable_comm_watchdog")
+    finally:
+        s3 = fleet.DistributedStrategy()
+        s3.comm_watchdog_timeout = 600.0
+        fleet.init(is_collective=True, strategy=s3)

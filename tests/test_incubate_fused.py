@@ -196,3 +196,78 @@ def test_fused_linear_cross_entropy_bf16_close():
     loss = IF.fused_linear_cross_entropy(xb, w, lt, transpose_weight=True)
     ref = F.cross_entropy(xb.astype("float32") @ w.numpy().T, lt)
     np.testing.assert_allclose(float(loss), float(ref), rtol=2e-2)
+
+
+def test_masked_multihead_attention_decode_matches_full():
+    """Step-by-step decode with kv cache must equal full causal attention."""
+    import jax.numpy as jnp
+    import paddle_tpu.incubate.nn.functional as IF
+
+    rng = np.random.RandomState(0)
+    B, H, D, S = 2, 3, 8, 5
+    tokens = rng.randn(S, B, 3 * H * D).astype(np.float32) * 0.5
+    cache = paddle.to_tensor(np.zeros((2, B, H, S, D), np.float32))
+    outs = []
+    for t in range(S):
+        seq = paddle.to_tensor(np.full((B,), t, np.int64))
+        out, cache = IF.masked_multihead_attention(
+            paddle.to_tensor(tokens[t]), cache_kv=cache, sequence_lengths=seq
+        )
+        outs.append(out.numpy())
+    got = np.stack(outs)  # [S, B, H*D]
+
+    qkv = tokens.reshape(S, B, 3, H, D)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [S, B, H, D]
+    for t in range(S):
+        for b in range(B):
+            lg = np.einsum("hd,shd->hs", q[t, b], k[: t + 1, b]) / np.sqrt(D)
+            p = np.exp(lg - lg.max(-1, keepdims=True))
+            p /= p.sum(-1, keepdims=True)
+            o = np.einsum("hs,shd->hd", p, v[: t + 1, b])
+            np.testing.assert_allclose(got[t, b], o.reshape(-1), rtol=2e-4, atol=2e-5)
+
+
+def test_block_multihead_attention_prefill_then_decode():
+    import paddle_tpu.incubate.nn.functional as IF
+
+    rng = np.random.RandomState(1)
+    B, H, D, bs = 1, 2, 4, 4
+    n_prefill = 6  # spans 2 pages of block_size 4
+    max_blocks = 4
+    kc = paddle.to_tensor(np.zeros((max_blocks, H, bs, D), np.float32))
+    vc = paddle.to_tensor(np.zeros((max_blocks, H, bs, D), np.float32))
+    tables = paddle.to_tensor(np.array([[0, 2, 1, 3]], np.int32))
+    qkv_pre = rng.randn(n_prefill, 3 * H * D).astype(np.float32) * 0.5
+
+    out_pre, _, kc, vc = IF.block_multihead_attention(
+        paddle.to_tensor(qkv_pre), kc, vc,
+        paddle.to_tensor(np.array([[n_prefill]], np.int32)),   # enc lens
+        paddle.to_tensor(np.array([[0]], np.int32)),           # dec lens
+        paddle.to_tensor(np.array([[n_prefill]], np.int32)),   # this time
+        None, None, None, None, tables, block_size=bs,
+    )
+    # oracle prefill: causal attention
+    cur = qkv_pre.reshape(n_prefill, 3, H, D)
+    q, k, v = cur[:, 0], cur[:, 1], cur[:, 2]
+    for t in range(n_prefill):
+        lg = np.einsum("hd,shd->hs", q[t], k[: t + 1]) / np.sqrt(D)
+        p = np.exp(lg - lg.max(-1, keepdims=True)); p /= p.sum(-1, keepdims=True)
+        o = np.einsum("hs,shd->hd", p, v[: t + 1])
+        np.testing.assert_allclose(out_pre.numpy()[t], o.reshape(-1), rtol=2e-4, atol=2e-5)
+
+    # decode one token at position 6 (page 1 -> table entry 2)
+    qkv_dec = rng.randn(1, 3 * H * D).astype(np.float32) * 0.5
+    out_dec, _, kc, vc = IF.block_multihead_attention(
+        paddle.to_tensor(qkv_dec), kc, vc,
+        paddle.to_tensor(np.array([[0]], np.int32)),
+        paddle.to_tensor(np.array([[n_prefill]], np.int32)),
+        paddle.to_tensor(np.array([[1]], np.int32)),
+        None, None, None, None, tables, block_size=bs,
+    )
+    cd = qkv_dec.reshape(1, 3, H, D)
+    k_all = np.concatenate([k, cd[:, 1]], 0)
+    v_all = np.concatenate([v, cd[:, 2]], 0)
+    lg = np.einsum("hd,shd->hs", cd[0, 0], k_all) / np.sqrt(D)
+    p = np.exp(lg - lg.max(-1, keepdims=True)); p /= p.sum(-1, keepdims=True)
+    o = np.einsum("hs,shd->hd", p, v_all)
+    np.testing.assert_allclose(out_dec.numpy()[0], o.reshape(-1), rtol=2e-4, atol=2e-5)
