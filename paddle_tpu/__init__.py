@@ -166,6 +166,7 @@ from . import sysconfig  # noqa: E402,F401
 from . import sparse  # noqa: E402,F401
 from . import fft  # noqa: E402,F401
 from . import geometric  # noqa: E402,F401
+from . import inference  # noqa: E402,F401
 from . import signal  # noqa: E402,F401
 from . import callbacks  # noqa: E402,F401
 from .hapi import Model, summary  # noqa: E402,F401

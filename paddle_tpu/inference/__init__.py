@@ -1,0 +1,224 @@
+"""Deployment inference surface — paddle.inference parity.
+
+Reference parity: paddle/fluid/inference/api (AnalysisPredictor,
+paddle_inference_api.h Config/Predictor/Tensor; Python surface
+python/paddle/inference/__init__.py). TPU-native design: the "analysis +
+IR pass pipeline + engine" stack collapses into XLA — a frozen model IS a
+serialized StableHLO program (jit.save / static.save_inference_model
+artifacts: .pdmodel blob + .pdmeta + optional .pdiparams), and the
+predictor is a thin handle-based wrapper that loads it once, caches the
+compiled executable, and runs feed->fetch. Config knobs that select CUDA
+engines (TensorRT, gpu memory pools, MKLDNN) are accepted and recorded but
+inert — XLA owns compilation on TPU.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Dict, List, Optional
+
+import numpy as np
+import jax
+from jax import export as jax_export
+from jax import numpy as jnp
+
+__all__ = ["Config", "Predictor", "Tensor", "create_predictor", "PrecisionType", "PlaceType"]
+
+
+class PrecisionType:
+    Float32 = 0
+    Half = 1
+    Bfloat16 = 2
+    Int8 = 3
+
+
+class PlaceType:
+    CPU = 0
+    GPU = 1
+    TPU = 1  # "the accelerator place"
+    XPU = 2
+    CUSTOM = 9
+
+
+class Config:
+    """paddle.inference.Config parity (paddle_analysis_config.h). Point it
+    at a saved prefix (`Config(prefix)`), an explicit model file pair
+    (`Config(model_file, params_file)`), or a directory containing exactly
+    one exported model."""
+
+    def __init__(self, model_arg: Optional[str] = None, params_file: Optional[str] = None):
+        self._prefix = None
+        self._params_file = params_file
+        if model_arg is not None:
+            if os.path.isdir(model_arg):
+                cands = [f for f in os.listdir(model_arg) if f.endswith(".pdmodel")]
+                if len(cands) != 1:
+                    raise ValueError(
+                        f"Config(model_dir): expected exactly one .pdmodel under {model_arg}, found {cands}"
+                    )
+                self._prefix = os.path.join(model_arg, cands[0][: -len(".pdmodel")])
+            else:
+                self._prefix = model_arg[: -len(".pdmodel")] if model_arg.endswith(".pdmodel") else model_arg
+        self._device = "tpu"
+        self._device_id = 0
+        self._inert: Dict[str, object] = {}
+
+    # ---- model paths ----
+    def set_model(self, model_arg, params_file=None):
+        self.__init__(model_arg, params_file)
+
+    def model_dir(self):
+        return os.path.dirname(self._prefix or "")
+
+    def prog_file(self):
+        return (self._prefix or "") + ".pdmodel"
+
+    def params_file(self):
+        return self._params_file or ((self._prefix or "") + ".pdiparams")
+
+    # ---- device selection ----
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0, precision=PrecisionType.Float32):
+        # "the accelerator": TPU here; memory pools are XLA-owned
+        self._device, self._device_id = "tpu", device_id
+
+    def enable_xpu(self, *a, **kw):
+        self._device = "tpu"
+
+    def enable_custom_device(self, device_type, device_id=0):
+        self._device, self._device_id = device_type, device_id
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def use_gpu(self):
+        return self._device != "cpu"
+
+    def gpu_device_id(self):
+        return self._device_id
+
+    # ---- accepted-but-inert engine knobs (CUDA/TRT/MKLDNN specific) ----
+    def enable_tensorrt_engine(self, *a, **kw):
+        self._inert["tensorrt"] = True
+
+    def enable_mkldnn(self):
+        self._inert["mkldnn"] = True
+
+    def switch_ir_optim(self, flag=True):
+        self._inert["ir_optim"] = flag
+
+    def enable_memory_optim(self, flag=True):
+        self._inert["memory_optim"] = flag
+
+    def set_cpu_math_library_num_threads(self, n):
+        self._inert["cpu_threads"] = n
+
+    def summary(self) -> str:
+        return (
+            f"model: {self.prog_file()}\ndevice: {self._device}:{self._device_id}\n"
+            f"inert knobs: {self._inert}"
+        )
+
+
+class Tensor:
+    """Predictor I/O handle (paddle_infer.Tensor): host-side staging buffer
+    with copy_from_cpu / copy_to_cpu."""
+
+    def __init__(self, name, shape=None, dtype=None):
+        self._name = name
+        self._declared_shape = shape
+        self._dtype = dtype
+        self._value = None
+
+    def name(self):
+        return self._name
+
+    def reshape(self, shape):
+        self._declared_shape = tuple(shape)
+
+    def copy_from_cpu(self, arr):
+        a = np.asarray(arr)
+        if self._dtype is not None:
+            a = a.astype(self._dtype)
+        self._value = a
+
+    def copy_to_cpu(self):
+        if self._value is None:
+            raise RuntimeError(f"output handle '{self._name}' has no data — call Predictor.run() first")
+        return np.asarray(self._value)
+
+    def shape(self):
+        if self._value is not None:
+            return list(np.asarray(self._value).shape)
+        return list(self._declared_shape or [])
+
+
+class Predictor:
+    """paddle_infer.Predictor parity over a frozen StableHLO program."""
+
+    def __init__(self, config: Config):
+        if config._prefix is None:
+            raise ValueError("Config has no model path")
+        self._config = config
+        with open(config.prog_file(), "rb") as f:
+            self._exported = jax_export.deserialize(f.read())
+        with open(config._prefix + ".pdmeta", "rb") as f:
+            self._meta = pickle.load(f)
+        # feed names: static artifacts record them; jit.save artifacts are
+        # positional — synthesize names
+        names = self._meta.get("feed_names")
+        if names is None:
+            names = [f"input_{i}" for i in range(len(self._meta.get("in_dtypes", [])))]
+        self._input_names = list(names)
+        n_out = self._meta.get("n_fetch", self._meta.get("n_outputs", 1))
+        self._output_names = [f"output_{i}" for i in range(n_out)]
+        dtypes = self._meta.get("in_dtypes")
+        self._inputs = {
+            n: Tensor(n, dtype=(dtypes[i] if dtypes else None))
+            for i, n in enumerate(self._input_names)
+        }
+        self._outputs = {n: Tensor(n) for n in self._output_names}
+
+    # ---- handles ----
+    def get_input_names(self) -> List[str]:
+        return list(self._input_names)
+
+    def get_output_names(self) -> List[str]:
+        return list(self._output_names)
+
+    def get_input_handle(self, name) -> Tensor:
+        return self._inputs[name]
+
+    def get_output_handle(self, name) -> Tensor:
+        return self._outputs[name]
+
+    # ---- run ----
+    def run(self, inputs: Optional[list] = None):
+        if inputs is not None:  # positional convenience (reference allows it)
+            for n, a in zip(self._input_names, inputs):
+                self._inputs[n].copy_from_cpu(a)
+        raw = []
+        for n in self._input_names:
+            if self._inputs[n]._value is None:
+                raise RuntimeError(f"input '{n}' not set — copy_from_cpu it first")
+            raw.append(jnp.asarray(self._inputs[n]._value))
+        out = self._exported.call(*raw)
+        outs = list(out) if isinstance(out, (tuple, list)) else [out]
+        for n, o in zip(self._output_names, outs):
+            self._outputs[n]._value = np.asarray(o)
+        if inputs is not None:
+            return [self._outputs[n].copy_to_cpu() for n in self._output_names]
+        return None
+
+    def clone(self) -> "Predictor":
+        return Predictor(self._config)
+
+    def clear_intermediate_tensor(self):
+        return None
+
+    def try_shrink_memory(self):
+        return None
+
+
+def create_predictor(config: Config) -> Predictor:
+    """paddle.inference.create_predictor."""
+    return Predictor(config)

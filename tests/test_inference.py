@@ -1,0 +1,71 @@
+"""paddle.inference-parity Predictor over frozen StableHLO artifacts."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static, nn
+from paddle_tpu.inference import Config, create_predictor
+
+
+def _export_static(tmp_path):
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        x = static.data("x", [-1, 4], "float32")
+        paddle.seed(0)
+        lin = nn.Linear(4, 3)
+        out = paddle.nn.functional.softmax(lin(x), axis=-1)
+    exe = static.Executor()
+    prefix = str(tmp_path / "model")
+    static.save_inference_model(prefix, [x], [out], exe, program=main)
+    return prefix, lin
+
+
+def test_predictor_static_artifact(tmp_path):
+    prefix, lin = _export_static(tmp_path)
+    cfg = Config(prefix)
+    pred = create_predictor(cfg)
+    assert pred.get_input_names() == ["x"]
+    assert len(pred.get_output_names()) == 1
+
+    xv = np.random.RandomState(0).randn(5, 4).astype(np.float32)
+    h = pred.get_input_handle("x")
+    h.copy_from_cpu(xv)
+    pred.run()
+    got = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    z = xv @ lin.weight.numpy() + lin.bias.numpy()
+    e = np.exp(z - z.max(-1, keepdims=True)); want = e / e.sum(-1, keepdims=True)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    # dynamic batch: another size through the same predictor
+    xv2 = np.random.RandomState(1).randn(2, 4).astype(np.float32)
+    (got2,) = pred.run([xv2])
+    assert got2.shape == (2, 3)
+
+
+def test_predictor_jit_artifact(tmp_path):
+    paddle.seed(1)
+    m = nn.Sequential(nn.Linear(6, 8), nn.ReLU(), nn.Linear(8, 2))
+    m.eval()
+    prefix = str(tmp_path / "jm")
+    paddle.jit.save(m, prefix, input_spec=[static.InputSpec([3, 6], "float32")])
+    pred = create_predictor(Config(prefix))
+    xv = np.random.RandomState(2).randn(3, 6).astype(np.float32)
+    (got,) = pred.run([xv])
+    want = m(paddle.to_tensor(xv)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    # clone shares the artifact
+    (got2,) = pred.clone().run([xv])
+    np.testing.assert_allclose(got2, got)
+
+
+def test_config_surface(tmp_path):
+    prefix, _ = _export_static(tmp_path)
+    cfg = Config(str(tmp_path))  # directory form
+    cfg.enable_use_gpu(100, 0)
+    cfg.enable_memory_optim()
+    cfg.switch_ir_optim(True)
+    assert cfg.use_gpu()
+    assert "model" in cfg.prog_file()
+    pred = create_predictor(cfg)
+    assert pred.get_input_names() == ["x"]
+    with pytest.raises(RuntimeError):
+        pred.run()  # inputs not set
