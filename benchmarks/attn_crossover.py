@@ -1,0 +1,76 @@
+"""Find the seq length where the Pallas flash kernel beats XLA's fused
+plain attention (fwd+bwd), to set the dispatch gate in
+ops/pallas.flash_attention_usable.
+
+Run: python benchmarks/attn_crossover.py
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops import pallas as pallas_ops
+
+
+def slope(fn, n1=6, n2=18):
+    fn(2)
+    t1 = fn(n1)
+    t2 = fn(n2)
+    return (t2 - t1) / (n2 - n1)
+
+
+def bench_attn(attn, q, k, v, w, tag):
+    # random cotangent w: a constant (ones) cotangent lets XLA algebraically
+    # collapse parts of the backward; all three grads feed the chain so none
+    # can be dead-code-eliminated
+    def loss(q, k, v):
+        return jnp.sum((attn(q, k, v) * w).astype(jnp.float32))
+
+    grad_fn = jax.grad(loss, argnums=(0, 1, 2))
+
+    @jax.jit
+    def chain(q, k, v, n):
+        # sequential data-dependent chain inside ONE program: per-iter time
+        # is honest even on deferred-execution backends
+        def body(i, x):
+            dq, dk, dv = grad_fn(x, k, v)
+            return x + (dq + dk + dv).astype(x.dtype) * jnp.bfloat16(1e-8)
+        out = jax.lax.fori_loop(0, n, body, q)
+        return jnp.sum(out.astype(jnp.float32))
+
+    def run(n):
+        t0 = time.perf_counter()
+        float(chain(q, k, v, n))
+        return time.perf_counter() - t0
+
+    return slope(run)
+
+
+def main():
+    # ERNIE-base-like head config, bf16, total tokens held ~constant
+    H, D = 12, 64
+    for S in [128, 256, 512, 1024, 2048, 4096]:
+        B = max(1, 8192 // S)
+        rng = np.random.RandomState(0)
+        q = jnp.asarray(rng.randn(B, S, H, D), jnp.bfloat16)
+        k = jnp.asarray(rng.randn(B, S, H, D), jnp.bfloat16)
+        v = jnp.asarray(rng.randn(B, S, H, D), jnp.bfloat16)
+        w = jnp.asarray(rng.randn(B, S, H, D), jnp.bfloat16)
+
+        t_flash = bench_attn(
+            lambda q, k, v: pallas_ops.flash_attention_bshd(q, k, v, causal=False),
+            q, k, v, w, "flash")
+        t_ref = bench_attn(
+            lambda q, k, v: pallas_ops._ref_attention_bshd(q, k, v, False, None),
+            q, k, v, w, "ref")
+        print(f"B={B:3d} S={S:5d}: flash {t_flash*1000:7.2f} ms  "
+              f"xla-ref {t_ref*1000:7.2f} ms  -> {'FLASH' if t_flash < t_ref else 'XLA'}")
+
+
+if __name__ == "__main__":
+    main()

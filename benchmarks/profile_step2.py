@@ -1,0 +1,145 @@
+"""Step decomposition round 2: where do the ms go inside the program?
+
+One model, one process. Measures, back-to-back:
+  A. peak (16k x 16k chained bf16 matmul)
+  S. achieved TFLOP/s for the model's ACTUAL matmul shapes (the shape-
+     limited ceiling the MFU metric is fighting)
+  1. fwd only (no_grad) slope
+  2. fwd+bwd, grads kept live (not cleared -> backward can't be DCE'd)
+  3. full step (fwd+bwd+AdamW)
+  4. full step, batch 128 (same model, retraced)
+
+Run: python benchmarks/profile_step2.py
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.models import ErnieForMaskedLM, ErnieModel
+
+
+def slope(fn, n1=8, n2=24):
+    fn(3)
+    t1 = fn(n1)
+    t2 = fn(n2)
+    return (t2 - t1) / (n2 - n1)
+
+
+def chain_rate(m, k, n, iters=30):
+    """Achieved TFLOP/s for an (m,k)@(k,n) bf16 matmul, chained in one jit."""
+    a = jnp.asarray(np.random.randn(m, k), jnp.bfloat16)
+    b = jnp.asarray(np.random.randn(k, n) * 0.01, jnp.bfloat16)
+
+    @jax.jit
+    def chain(a, b):
+        def body(i, acc):
+            c = a @ b          # (m, n)
+            return acc + jnp.sum(c[:1, :1].astype(jnp.float32)) * 1e-9
+        return jax.lax.fori_loop(0, iters, body, 0.0)
+
+    float(chain(a, b))
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        float(chain(a, b))
+        best = min(best, time.perf_counter() - t0)
+    return 2 * m * k * n * iters / best
+
+
+def main():
+    from bench import _measured_peak_flops
+    peak = _measured_peak_flops()
+    print(f"A. peak (16k cube): {peak/1e12:.1f} TF/s")
+
+    # model matmul shapes at batch 64 x seq 128 (tokens = 8192)
+    T = 8192
+    for (m, k, n, tag) in [
+        (T, 768, 768, "qkv/proj"),
+        (T, 768, 3072, "ffn up"),
+        (T, 3072, 768, "ffn down"),
+        (T, 768, 40000, "lm head"),
+    ]:
+        r = chain_rate(m, k, n)
+        print(f"S. ({m},{k})@({k},{n}) {tag}: {r/1e12:.1f} TF/s ({r/peak*100:.0f}% of peak)")
+
+    batch, seq = 64, 128
+    paddle.seed(0)
+    model = ErnieForMaskedLM(
+        ErnieModel(
+            vocab_size=40000, hidden_size=768, num_hidden_layers=12,
+            num_attention_heads=12, intermediate_size=3072,
+            hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        )
+    )
+    opt = paddle.optimizer.AdamW(1e-4, parameters=model.parameters(), weight_decay=0.01)
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, 40000, (batch, seq)).astype(np.int64))
+    labels = paddle.to_tensor(rng.randint(0, 40000, (batch, seq)).astype(np.int64))
+
+    n_params = sum(p.size for p in model.parameters())
+    pos = model.ernie.embeddings.position_embeddings.weight.size
+    tok = model.ernie.embeddings.token_type_embeddings.weight.size
+    fpt = 6 * (n_params - pos - tok)
+
+    def timed(stepfn, i, l):
+        def run(n):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                loss = stepfn(i, l)
+            float(loss.numpy())
+            return time.perf_counter() - t0
+        return run
+
+    @paddle.jit.to_static
+    def fwd_only(ids, labels):
+        with paddle.no_grad(), paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+            loss, _ = model(ids, labels=labels)
+        return loss
+
+    s1 = slope(timed(fwd_only, ids, labels))
+    print(f"1. fwd only: {s1*1000:.2f} ms (bound ~{fpt*batch*seq/3/peak*1000:.1f})")
+
+    @paddle.jit.to_static
+    def fwd_bwd(ids, labels):
+        with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+            loss, _ = model(ids, labels=labels)
+        loss.backward()
+        return loss
+
+    # grads accumulate across steps -> backward output is live every step
+    s2 = slope(timed(fwd_bwd, ids, labels))
+    for p in model.parameters():
+        p.clear_gradient()
+    print(f"2. fwd+bwd (grads live): {s2*1000:.2f} ms (bound ~{fpt*batch*seq/peak*1000:.1f})")
+
+    @paddle.jit.to_static
+    def full_step(ids, labels):
+        with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+            loss, _ = model(ids, labels=labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    s3 = slope(timed(full_step, ids, labels))
+    print(f"3. full step: {s3*1000:.2f} ms  (MFU {fpt*batch*seq/s3/peak:.3f})")
+
+    # batch 128: same model/opt, new inputs -> retrace
+    ids2 = paddle.to_tensor(rng.randint(0, 40000, (128, seq)).astype(np.int64))
+    labels2 = paddle.to_tensor(rng.randint(0, 40000, (128, seq)).astype(np.int64))
+    s4 = slope(timed(full_step, ids2, labels2), n1=5, n2=13)
+    print(f"4. full step batch=128: {s4*1000:.2f} ms  (MFU {fpt*128*seq/s4/peak:.3f})")
+
+    s3b = slope(timed(full_step, ids, labels))
+    print(f"3'. full step batch=64 again (drift): {s3b*1000:.2f} ms")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,79 @@
+"""A/B: is the Pallas flash-attention kernel the backward-time sink at
+seq=128? Same model, two compiled step variants, one process.
+
+Run: python benchmarks/profile_step3.py
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.models import ErnieForMaskedLM, ErnieModel
+from paddle_tpu.ops import pallas as pallas_ops
+
+
+def slope(fn, n1=8, n2=24):
+    fn(3)
+    t1 = fn(n1)
+    t2 = fn(n2)
+    return (t2 - t1) / (n2 - n1)
+
+
+def main():
+    batch, seq = 64, 128
+    paddle.seed(0)
+    model = ErnieForMaskedLM(
+        ErnieModel(
+            vocab_size=40000, hidden_size=768, num_hidden_layers=12,
+            num_attention_heads=12, intermediate_size=3072,
+            hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        )
+    )
+    opt = paddle.optimizer.AdamW(1e-4, parameters=model.parameters(), weight_decay=0.01)
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, 40000, (batch, seq)).astype(np.int64))
+    labels = paddle.to_tensor(rng.randint(0, 40000, (batch, seq)).astype(np.int64))
+
+    def make_step():
+        @paddle.jit.to_static
+        def full_step(ids, labels):
+            with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+                loss, _ = model(ids, labels=labels)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+        return full_step
+
+    def timed(stepfn):
+        def run(n):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                loss = stepfn(ids, labels)
+            float(loss.numpy())
+            return time.perf_counter() - t0
+        return run
+
+    step_flash = make_step()
+    s_flash = slope(timed(step_flash))
+    print(f"flash pallas:  {s_flash*1000:.2f} ms/step")
+
+    orig = pallas_ops.flash_attention_usable
+    pallas_ops.flash_attention_usable = lambda *a, **k: False
+    try:
+        step_ref = make_step()
+        s_ref = slope(timed(step_ref))
+        print(f"xla sdpa ref:  {s_ref*1000:.2f} ms/step")
+    finally:
+        pallas_ops.flash_attention_usable = orig
+
+    s_flash2 = slope(timed(step_flash))
+    print(f"flash again (drift): {s_flash2*1000:.2f} ms/step")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,106 @@
+"""Per-op device-time breakdown of the headline train step via the XLA
+profiler (works on the axon tunnel — device_duration_ps is populated).
+
+Prints total device time per HLO category and the top-N individual ops,
+so every millisecond of the step has a name (VERDICT r2 Weak #1).
+
+Run: python benchmarks/profile_xplane.py
+"""
+import glob
+import gzip
+import json
+import os
+import sys
+import tempfile
+from collections import defaultdict
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+
+import paddle_tpu as paddle
+from paddle_tpu.models import ErnieForMaskedLM, ErnieModel
+
+
+def main():
+    batch, seq = int(os.environ.get("BENCH_BATCH", 64)), 128
+    paddle.seed(0)
+    model = ErnieForMaskedLM(
+        ErnieModel(
+            vocab_size=40000, hidden_size=768, num_hidden_layers=12,
+            num_attention_heads=12, intermediate_size=3072,
+            hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        )
+    )
+    opt = paddle.optimizer.AdamW(1e-4, parameters=model.parameters(), weight_decay=0.01)
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, 40000, (batch, seq)).astype(np.int64))
+    labels = paddle.to_tensor(rng.randint(0, 40000, (batch, seq)).astype(np.int64))
+
+    @paddle.jit.to_static
+    def train_step(ids, labels):
+        with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+            loss, _ = model(ids, labels=labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    # warm + compile
+    for _ in range(4):
+        loss = train_step(ids, labels)
+    float(loss.numpy())
+
+    tdir = tempfile.mkdtemp(prefix="xplane_")
+    jax.profiler.start_trace(tdir)
+    NSTEP = 3
+    for _ in range(NSTEP):
+        loss = train_step(ids, labels)
+    float(loss.numpy())  # force execution inside the trace window
+    jax.profiler.stop_trace()
+
+    traces = glob.glob(f"{tdir}/**/*.trace.json.gz", recursive=True)
+    d = json.load(gzip.open(traces[0]))
+    evs = d["traceEvents"]
+
+    # find the device pid and its "XLA Ops" tid
+    dev_pid = next(e["pid"] for e in evs
+                   if e.get("ph") == "M" and e.get("name") == "process_name"
+                   and "TPU" in e["args"]["name"])
+    ops_tid = next(e["tid"] for e in evs
+                   if e.get("ph") == "M" and e.get("name") == "thread_name"
+                   and e["pid"] == dev_pid and e["args"]["name"] == "XLA Ops")
+
+    cat_time = defaultdict(float)
+    op_time = defaultdict(float)
+    op_src = {}
+    total = 0.0
+    for e in evs:
+        if e.get("ph") != "X" or e.get("pid") != dev_pid or e.get("tid") != ops_tid:
+            continue
+        a = e.get("args", {})
+        dur_ms = int(a.get("device_duration_ps", 0)) / 1e9
+        cat = a.get("hlo_category", "?")
+        cat_time[cat] += dur_ms
+        op_time[e["name"]] += dur_ms
+        if e["name"] not in op_src:
+            op_src[e["name"]] = (a.get("tf_op", ""), (a.get("source_stack", "").splitlines() or [""])[0],
+                                 a.get("shape_with_layout", ""), int(a.get("bytes_accessed", 0)),
+                                 a.get("long_name", "")[:200])
+        total += dur_ms
+
+    print(f"== device time over {NSTEP} steps: {total:.2f} ms ({total/NSTEP:.2f} ms/step) ==")
+    print("\n-- by HLO category --")
+    for cat, t in sorted(cat_time.items(), key=lambda kv: -kv[1]):
+        print(f"{t/NSTEP:9.3f} ms/step  {cat}")
+    print("\n-- top 20 ops --")
+    for name, t in sorted(op_time.items(), key=lambda kv: -kv[1])[:20]:
+        tf_op, src, shape, nbytes, long = op_src[name]
+        print(f"{t/NSTEP:9.3f} ms/step  {name[:40]:40s} {nbytes/1e6:9.1f} MB  {tf_op[:44]:44s} {src[:50]}")
+        print(f"           shape={shape[:110]}")
+        print(f"           {long[:160]}")
+
+
+if __name__ == "__main__":
+    main()

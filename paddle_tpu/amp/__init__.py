@@ -25,6 +25,12 @@ from ..framework import dtype as dtype_mod
 WHITE_LIST = {
     "matmul", "linear", "conv1d", "conv2d", "conv3d", "bmm", "mm", "einsum",
     "scaled_dot_product_attention",
+    # matmul-dominated fused LM head: its [N,V] intermediates must be bf16
+    # (the op computes lse/label-logit through f32-accumulated reductions
+    # internally — see _flce_fwd_impl); without this it inherits f32 from
+    # the preceding (blacklisted) layer_norm and materializes 2.6 GB/step
+    # of f32 logits+dlogits on a 40k vocab (measured: ~13 ms/step on v5e)
+    "fused_linear_cross_entropy",
 }
 BLACK_LIST = {
     "exp", "log", "log2", "log10", "log1p", "expm1", "pow", "square", "sqrt",

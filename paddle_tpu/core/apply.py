@@ -77,6 +77,10 @@ def apply(name: str, fn: Callable, *args, n_outputs=None, **kwargs):
         if entry is None:
             entry = _LinEntry(fn, raw, tuple(diff_pos), tuple(tensor_pos), kwargs)
             _lin_cache[key] = entry
+            if len(_lin_cache) > _LIN_CACHE_CAP:
+                _lin_cache.popitem(last=False)  # evict least-recently-used
+        else:
+            _lin_cache.move_to_end(key)
         out, vjp_fn = entry(primals, [raw[p] for p in tensor_pos if p not in diff_pos])
     else:
         def pure(*dvals):
@@ -105,7 +109,13 @@ def apply(name: str, fn: Callable, *args, n_outputs=None, **kwargs):
     return res
 
 
-_lin_cache: dict = {}
+from collections import OrderedDict
+
+# LRU: bounded so long-running processes with varying shapes can't grow it
+# without limit; keys HOLD their code objects (see _closure_sig) so a GC'd
+# function whose code address gets reused can never produce a stale hit.
+_lin_cache: "OrderedDict" = OrderedDict()
+_LIN_CACHE_CAP = 2048
 _HASHABLE = (int, float, bool, str, bytes, type(None))
 
 
@@ -119,7 +129,12 @@ def _closure_sig(fn, depth=0):
     code = getattr(fn, "__code__", None)
     if code is None:
         return None
-    sig = [id(code)]
+    # (id(code), code): code objects compare by VALUE (equal bytecode in two
+    # different modules with different globals compares equal!), so id()
+    # provides the identity semantics; holding the object itself keeps the
+    # address alive so a freed address can never be reused by a different
+    # function's code and alias its cached linearization
+    sig = [(id(code), code)]
     for v in (fn.__defaults__ or ()):
         if isinstance(v, _HASHABLE):
             sig.append(v)

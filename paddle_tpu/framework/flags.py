@@ -59,6 +59,15 @@ def get_flag(name: str):
 
 # Core flags (subset of paddle/common/flags.cc that is meaningful on TPU).
 define_flag("FLAGS_check_nan_inf", False, "scan op outputs for nan/inf (debug)")
+define_flag(
+    "FLAGS_to_static_donate",
+    True,
+    "donate state buffers (params/optimizer moments/grads) to to_static "
+    "compiled steps: saves the per-step state copy + halves the state "
+    "memory high-water mark; disable if you hold detach()-aliases of "
+    "parameters or param.grad array references across compiled steps "
+    "(donated arrays raise 'deleted' on read)",
+)
 define_flag("FLAGS_use_bf16_default", False, "prefer bfloat16 in AMP on TPU")
 define_flag("FLAGS_jit_guard_shapes", True, "retrace to_static programs on input shape change")
 define_flag("FLAGS_eager_delete_tensor_gb", 0.0, "no-op on TPU; XLA owns HBM")

@@ -155,6 +155,7 @@ class _CompiledEntry:
         self.grad_tensors = grad_tensors
         self.jitted = None
         self.out_rebuild = None
+        self.donated = False
 
     def _grad_inputs(self):
         """Incoming .grad values (accumulation pattern): mask + present values."""
@@ -210,11 +211,14 @@ class _CompiledEntry:
 
         state_vals = [t._value for t in self.state]
         outs, new_state, new_grads = self.jitted(raw_args, state_vals, rng, self._grad_inputs()[1])
-        # write back mutated state
+        # write back state. Donated runs must adopt EVERY entry's (aliased)
+        # output buffer — the input arrays are dead after the call. Without
+        # donation, touch only mutated entries so read-only state keeps its
+        # eager autograd wiring (_replace_value clears _grad_node).
         for t, mask, v in zip(self.state, self.mut_mask, new_state):
-            if mask:
+            if mask or self.donated:
                 t._replace_value(v)
-                if hasattr(t, "trainable"):
+                if mask and hasattr(t, "trainable"):
                     t.stop_gradient = not t.trainable
         for t, v in zip(self.grad_tensors, new_grads):
             t.grad = Tensor(v) if v is not None else None
@@ -266,7 +270,17 @@ class _CompiledEntry:
                 for t, g in zip(grad_ts, orig_grads):
                     t.grad = g
 
-        self.jitted = jax.jit(pure)
+        # Donate state + incoming grads: the write-back in run() adopts the
+        # output buffers, so the input copies XLA would otherwise keep alive
+        # (params + optimizer moments, ~3x param bytes for Adam) are saved —
+        # both the copy bandwidth and the memory high-water mark.
+        # FLAGS_to_static_donate=False restores copying semantics (needed if
+        # user code holds detach()-style aliases of parameters or `p.grad`
+        # array references across compiled steps).
+        from ..framework import flags as _flags
+
+        self.donated = bool(_flags.get_flag("FLAGS_to_static_donate"))
+        self.jitted = jax.jit(pure, donate_argnums=(1, 3) if self.donated else ())
 
     def _rebuild_out(self, out_raw):
         return _unflatten_output(out_raw, self.out_spec)

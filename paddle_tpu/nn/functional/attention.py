@@ -100,7 +100,7 @@ def scaled_dot_product_attention(
 
     else:
         def f(qv, kv, vv):
-            if pallas_ops.flash_attention_usable(qv, is_causal, dropout_p if training else 0.0, kv, vv):
+            if pallas_ops.flash_attention_profitable(qv, is_causal, dropout_p if training else 0.0, kv, vv):
                 return pallas_ops.flash_attention_bshd(qv, kv, vv, causal=is_causal)
             return _sdpa_ref(qv, kv, vv, None, is_causal, dropout_p, None, training, rng_key)
 

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 
 import jax
 from jax import numpy as jnp
@@ -26,6 +27,22 @@ from jax.experimental import pallas as pl
 
 _BLOCK_Q = 128
 _BLOCK_K = 128
+
+# Auto-dispatch threshold: below this kv length the XLA-fused plain-softmax
+# chain WINS — measured on TPU v5 lite (benchmarks/attn_crossover.py,
+# fwd+bwd, random cotangents): S=128: xla 0.0ms vs flash 3.5ms; S=2048:
+# 11.6 vs 13.8; S=4096: 25.6 vs 30.6. Flash's value below that point is
+# only the O(S) memory (no [B,H,S,S] logits buffer), which starts to
+# matter for HBM around S~4k (B*H*S^2 f32 logits ~1.6-3.2 GB). Explicit
+# flash_attention()/flash_attention_bshd() calls are NOT gated — only the
+# scaled_dot_product_attention auto-dispatch.
+try:
+    _FLASH_MIN_SK = int(os.environ.get("PADDLE_TPU_FLASH_MIN_SEQ", 4096))
+except ValueError:
+    import warnings
+
+    warnings.warn("PADDLE_TPU_FLASH_MIN_SEQ is not an integer; using 4096")
+    _FLASH_MIN_SK = 4096
 
 # tests on the CPU mesh flip this to run kernels in pallas interpret mode
 _INTERPRET = False
@@ -68,6 +85,16 @@ def flash_attention_usable(q, causal, dropout_p, k=None, v=None) -> bool:
             # for those rows is garbage-by-construction too) — fall back
             return False
     return True
+
+
+def flash_attention_profitable(q, causal, dropout_p, k=None, v=None) -> bool:
+    """Auto-dispatch gate: usable AND long enough that the O(S) memory of the
+    flash kernel pays for itself. Below _FLASH_MIN_SK the XLA-fused plain
+    chain is faster on this hardware (see _FLASH_MIN_SK comment)."""
+    if not flash_attention_usable(q, causal, dropout_p, k, v):
+        return False
+    sk = (k if k is not None else q).shape[1]
+    return sk >= _FLASH_MIN_SK
 
 
 def _ref_attention_bshd(q, k, v, causal, sm_scale):
