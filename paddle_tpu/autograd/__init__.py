@@ -26,6 +26,10 @@ __all__ = [
     "is_grad_enabled",
     "PyLayer",
     "PyLayerContext",
+    "jacobian",
+    "hessian",
+    "Jacobian",
+    "Hessian",
 ]
 
 
@@ -55,6 +59,11 @@ def grad(
         grad_outputs = [grad_outputs]
     if retain_graph is None:
         retain_graph = create_graph
+    if create_graph:
+        return _taped_grad(
+            outputs, inputs, grad_outputs, allow_unused,
+            {id(t) for t in (no_grad_vars or [])},
+        )
     collected: dict = {}
     no_grad_ids = {id(t) for t in (no_grad_vars or [])}
 
@@ -99,6 +108,137 @@ def grad(
             results.append(None)
         else:
             results.append(Tensor(c, stop_gradient=not create_graph))
+    return results
+
+
+def _taped_grad(outputs, inputs, grad_outputs, allow_unused, no_grad_ids):
+    """create_graph=True backward: the same reverse topological walk as
+    autograd_engine.run_backward, but every cotangent is a TENSOR and every
+    node's vjp re-applies jax.vjp over (primals, cotangents) THROUGH apply()
+    (GradNode.op_pure/op_primals), so the backward computation itself lands
+    on the tape with edges to the primal inputs. That is what makes
+    grad-of-grad (jacobian/hessian, gradient penalties) correct: residual
+    closures can't express d(backward)/d(primal); recompute-based taped ops
+    can — and XLA dedupes the recomputation under jit."""
+    eng = autograd_engine
+
+    holders: dict = {}
+    leaf_cots: dict = {}
+    watch_cots: dict = {}
+    roots = []
+
+    watches = {}
+    for t in inputs:
+        if t._grad_node is not None:
+            watches.setdefault((t._grad_node, t._out_index), []).append(id(t))
+
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    for t, g in zip(outputs, grad_outputs):
+        if g is None:
+            seed = Tensor(jnp.ones(t._value.shape, t._value.dtype))
+        else:
+            seed = g if isinstance(g, Tensor) else Tensor(jnp.asarray(g))
+        node = t._grad_node
+        if node is None:
+            if not t.stop_gradient:
+                leaf_cots[id(t)] = leaf_cots[id(t)] + seed if id(t) in leaf_cots else seed
+            continue
+        slots = holders.setdefault(node, [None] * len(node.out_avals))
+        slots[t._out_index] = seed if slots[t._out_index] is None else slots[t._out_index] + seed
+        roots.append(node)
+
+    # dependency counting (same scheme as run_backward)
+    indeg: dict = {}
+    visited = set()
+    stack = list(dict.fromkeys(roots))
+    order = list(stack)
+    while stack:
+        node = stack.pop()
+        if node in visited:
+            continue
+        visited.add(node)
+        for e in node.edges:
+            if e.node is not None:
+                indeg[e.node] = indeg.get(e.node, 0) + 1
+                if e.node not in visited:
+                    stack.append(e.node)
+
+    ready = [n for n in dict.fromkeys(order) if indeg.get(n, 0) == 0]
+    processed = set()
+    while ready:
+        node = ready.pop()
+        if node in processed:
+            continue
+        processed.add(node)
+        slots = holders.pop(node, None) or [None] * len(node.out_avals)
+        for si, s in enumerate(slots):
+            for tid in watches.get((node, si), ()):
+                if s is not None:
+                    watch_cots[tid] = watch_cots[tid] + s if tid in watch_cots else s
+        if node.op_pure is None:
+            raise RuntimeError(
+                f"create_graph backward through {node.name}: node carries no "
+                "re-differentiable op (built before r3, or a custom engine node)"
+            )
+
+        # cotangent tensors only for inexact outputs; float0 zeros for the
+        # rest are baked inside the op (jax.vjp requires them, Tensors can't
+        # carry float0)
+        inexact = [jnp.issubdtype(a.dtype, jnp.inexact) for a in node.out_avals]
+        cot_ts = [
+            s if s is not None else Tensor(jnp.zeros(a.shape, a.dtype))
+            for s, a, ix in zip(slots, node.out_avals, inexact)
+            if ix
+        ]
+        n_prim = len(node.op_primals)
+        avals = node.out_avals
+        single = node.single_output
+        op_pure = node.op_pure
+
+        def f(*vals, _np=n_prim, _avals=avals, _inexact=inexact, _single=single, _pure=op_pure):
+            prim = vals[:_np]
+            cot_vals = list(vals[_np:])
+            full = [
+                cot_vals.pop(0) if ix else eng._zeros_cotangent(a)
+                for a, ix in zip(_avals, _inexact)
+            ]
+            _, vjp_fn = jax.vjp(_pure, *prim)
+            res = vjp_fn(full[0] if _single else tuple(full))
+            return tuple(res) if len(res) > 1 else res[0]
+
+        in_cots = apply("grad::" + node.name, f, *node.op_primals, *cot_ts)
+        if isinstance(in_cots, Tensor):
+            in_cots = (in_cots,)
+        if len(in_cots) != len(node.edges):
+            raise RuntimeError(
+                f"taped vjp of {node.name}: {len(in_cots)} cotangents for {len(node.edges)} edges"
+            )
+        for e, c in zip(node.edges, in_cots):
+            if e.is_leaf():
+                if c is not None and not e.leaf.stop_gradient and id(e.leaf) not in no_grad_ids:
+                    tid = id(e.leaf)
+                    leaf_cots[tid] = leaf_cots[tid] + c if tid in leaf_cots else c
+            elif e.node is not None:
+                if c is not None:
+                    pslots = holders.setdefault(e.node, [None] * len(e.node.out_avals))
+                    pslots[e.slot] = c if pslots[e.slot] is None else pslots[e.slot] + c
+                indeg[e.node] -= 1
+                if indeg[e.node] == 0:
+                    ready.append(e.node)
+
+    results = []
+    for t in inputs:
+        c = leaf_cots.get(id(t)) if t._grad_node is None else watch_cots.get(id(t))
+        if c is None:
+            if not allow_unused:
+                raise RuntimeError(
+                    "One of the differentiated tensors appears unused in the graph; "
+                    "pass allow_unused=True to return None for it."
+                )
+            results.append(None)
+        else:
+            results.append(c)
     return results
 
 
@@ -220,3 +360,6 @@ class saved_tensors_hooks:
 
     def __exit__(self, *exc):
         return False
+
+
+from .functional import Hessian, Jacobian, hessian, jacobian  # noqa: E402,F401

@@ -1,0 +1,204 @@
+"""Functional autograd: jacobian / hessian with the reference's lazy API.
+
+Reference parity: python/paddle/autograd/autograd.py:30 (Jacobian), :183
+(Hessian), :450 (jacobian), :544 (hessian) — same lazy row-evaluated
+semantics and output layouts ((M, N) non-batched, (B, M, N) batch_axis=0).
+
+TPU-native design: a Jacobian row is one taped reverse pass
+(autograd.grad with create_graph=True — see _taped_backward in
+autograd/__init__.py), so rows are jax computations that remain
+differentiable: hessian = jacobian of the gradient, with each second-order
+row recomputing its op forwards (rematerialization) instead of holding a
+mutable double-backward graph.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import numpy as np
+from jax import numpy as jnp
+
+from ..core.tensor import Tensor
+from ..ops import manipulation
+
+
+def _as_tensors(x):
+    return (x,) if isinstance(x, Tensor) else tuple(x)
+
+
+def _flatten_cat(ts, batched):
+    ts = [t if isinstance(t, Tensor) else t for t in ts]
+    if batched:
+        flats = [manipulation.reshape(t, [t.shape[0], -1]) for t in ts]
+        return flats[0] if len(flats) == 1 else manipulation.concat(flats, axis=1)
+    flats = [manipulation.reshape(t, [-1]) for t in ts]
+    return flats[0] if len(flats) == 1 else manipulation.concat(flats, axis=0)
+
+
+class Jacobian:
+    """Lazily evaluated Jacobian of ys w.r.t. xs (autograd.py:30).
+
+    Rows (output components) evaluate on first access and are cached:
+    ``J[:]`` materializes everything; ``J[i, :]`` costs one reverse pass.
+    Non-batched shape: (M, N) (0-D ys -> (N,)); batched: (B, M, N).
+    """
+
+    def __init__(self, ys, xs, is_batched=False):
+        from . import grad as _grad
+
+        self._grad = _grad
+        self.is_batched = is_batched
+        self._xs = xs
+        self.original_ys_shape = list(ys.shape)
+        self.original_xs_shape = list(xs.shape) if isinstance(xs, Tensor) else None
+        if ys.ndim == 0 and not is_batched:
+            ys = manipulation.reshape(ys, [-1])
+        if ys.ndim == 1 and is_batched:
+            ys = manipulation.reshape(ys, [ys.shape[0], -1])
+        self._ys = ys
+        self._flat_ys = _flatten_cat([ys], is_batched)
+        self._flat_xs_width = self._flat_width(xs)
+        self._cache = {}
+        # shape reports the FLATTENED row/col counts (what J[:] actually
+        # returns) with 0-D ys/xs axes dropped — the reference's
+        # first-dim-only formula disagrees with its own data for >1-D
+        # inputs, which its docs sidestep by restricting to 0/1-D
+        if is_batched:
+            b = self._flat_ys.shape[0]
+            m = self._flat_ys.shape[1]
+            self.inner_shape = [b, m, self._flat_xs_width]
+            self.shape = [b]
+            if len(self.original_ys_shape) - 1 > 0:
+                self.shape.append(m)
+            if self.original_xs_shape is None or len(self.original_xs_shape) - 1 > 0:
+                self.shape.append(self._flat_xs_width)
+        else:
+            m = self._flat_ys.shape[0]
+            self.inner_shape = [m, self._flat_xs_width]
+            self.shape = []
+            if len(self.original_ys_shape) > 0:
+                self.shape.append(m)
+            if self.original_xs_shape is None or len(self.original_xs_shape) > 0:
+                self.shape.append(self._flat_xs_width)
+
+    # ---- internals ----
+    def _flat_width(self, xs):
+        ts = _as_tensors(xs)
+        if self.is_batched:
+            return sum(int(np.prod(t._value.shape[1:])) if t.ndim > 1 else 1 for t in ts)
+        return sum(int(np.prod(t._value.shape)) if t.ndim else 1 for t in ts)
+
+    def _row(self, i):
+        v = self._cache.get(i)
+        if v is None:
+            ys_i = self._flat_ys[i] if not self.is_batched else self._flat_ys[:, i]
+            gs = self._grad(
+                ys_i, list(_as_tensors(self._xs)),
+                create_graph=True, retain_graph=True, allow_unused=True,
+            )
+            gs = [
+                g if g is not None else Tensor(jnp.zeros(t._value.shape, t._value.dtype))
+                for g, t in zip(gs, _as_tensors(self._xs))
+            ]
+            v = _flatten_cat(gs, self.is_batched)
+            self._cache[i] = v
+        return v
+
+    def _lazy_len(self):
+        return self.inner_shape[1] if self.is_batched else self.inner_shape[0]
+
+    def _materialize(self, rows):
+        parts = [self._row(i) for i in rows]
+        if self.is_batched:
+            stacked = manipulation.stack(parts, axis=1)  # [B, rows, N]
+        else:
+            stacked = manipulation.stack(parts, axis=0)  # [rows, N]
+        return stacked
+
+    def __getitem__(self, indexes):
+        # user indexes address self.shape; inner_shape may carry extra
+        # singleton axes for 0-D ys / 0-D xs (reference: the index-remapping
+        # block of _Jacobian.__getitem__) — insert 0 for those.
+        user = list(indexes if isinstance(indexes, tuple) else (indexes,))
+        if any(ix is Ellipsis for ix in user):
+            raise IndexError("Ellipsis index currently is not supported.")
+        user = user + [slice(None)] * (len(self.shape) - len(user))
+
+        nb = 1 if self.is_batched else 0
+        inner_idx = []
+        if self.is_batched:
+            inner_idx.append(user.pop(0))
+        ys_degenerate = len(self.original_ys_shape) - nb == 0
+        inner_idx.append(0 if ys_degenerate else user.pop(0))
+        xs_degenerate = (
+            self.original_xs_shape is not None
+            and len(self.original_xs_shape) - nb == 0
+        )
+        inner_idx.append(0 if xs_degenerate else (user.pop(0) if user else slice(None)))
+
+        lazy_ax = 1 if self.is_batched else 0
+        idx = inner_idx[lazy_ax]
+        n = self._lazy_len()
+        if isinstance(idx, int):
+            rows = [idx % n]
+            row_sel = 0
+        else:
+            rows = list(range(*idx.indices(n)))
+            row_sel = slice(0, len(rows), 1)
+        part = self._materialize(rows)
+        sel = tuple(inner_idx[:lazy_ax]) + (row_sel,) + tuple(inner_idx[lazy_ax + 1:])
+        return part[sel]
+
+    def __repr__(self):
+        return f"{type(self).__name__}(shape={self.shape}, batched={self.is_batched})"
+
+
+class Hessian(Jacobian):
+    """Jacobian of a gradient (autograd.py:183)."""
+
+
+def jacobian(ys, xs, batch_axis: Optional[int] = None):
+    """paddle.autograd.jacobian (autograd.py:450): returns Jacobian /
+    tuple[Jacobian] / tuple[tuple[Jacobian]] matching the ys/xs nesting."""
+    if batch_axis is not None and batch_axis != 0:
+        raise ValueError("Only support batch_axis=0 yet.")
+    batched = batch_axis == 0
+    ys_t, xs_t = _as_tensors(ys), _as_tensors(xs)
+    mat = tuple(tuple(Jacobian(y, x, is_batched=batched) for x in xs_t) for y in ys_t)
+    if isinstance(ys, Tensor) and isinstance(xs, Tensor):
+        return mat[0][0]
+    if isinstance(ys, Tensor):
+        return mat[0]
+    if isinstance(xs, Tensor):
+        return tuple(row[0] for row in mat)
+    return mat
+
+
+def hessian(ys, xs, batch_axis: Optional[int] = None):
+    """paddle.autograd.hessian (autograd.py:544): d2 ys / d xs2 for a scalar
+    (or per-batch-scalar) ys, via jacobian of the create_graph gradient."""
+    from . import grad as _grad
+
+    if batch_axis is None:
+        if int(np.prod(ys._value.shape)) != 1:
+            raise ValueError(f"Only support ys.numel()({ys.numel()})==1 when batch_axis is None.")
+    elif isinstance(batch_axis, int):
+        if batch_axis != 0:
+            raise ValueError("Only support batch_axis=0 yet.")
+        per = int(np.prod(ys._value.shape[1:])) if ys.ndim > 1 else 1
+        if per != 1:
+            raise ValueError("Only support ys[0].numel()==1 when batch_axis is int")
+    else:
+        raise TypeError(f"batch_axis should be None or int, but got {type(batch_axis)}.")
+
+    xs_t = _as_tensors(xs)
+    gs = _grad(ys, list(xs_t), create_graph=True, retain_graph=True, allow_unused=True)
+    gs = [
+        g if g is not None else Tensor(jnp.zeros(t._value.shape, t._value.dtype))
+        for g, t in zip(gs, xs_t)
+    ]
+    batched = batch_axis == 0
+    mat = tuple(tuple(Hessian(g, x, is_batched=batched) for x in xs_t) for g in gs)
+    if isinstance(xs, Tensor):
+        return mat[0][0]
+    return mat

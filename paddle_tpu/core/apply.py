@@ -64,6 +64,14 @@ def apply(name: str, fn: Callable, *args, n_outputs=None, **kwargs):
 
     primals = [raw[p] for p in diff_pos]
 
+    def op_pure(*dvals):
+        # standalone (diff-args -> out) closure kept on the GradNode for the
+        # taped (create_graph) backward; nondiff inputs baked as constants
+        vals = list(raw)
+        for p, v in zip(diff_pos, dvals):
+            vals[p] = v
+        return fn(*vals, **kwargs)
+
     # ---- cached-linearization fast path ----
     # jax.vjp re-traces the op on EVERY grad-tracked eager call (~ms); the
     # reference's per-op path is generated C++ at us scale (eager_gen.py
@@ -103,7 +111,10 @@ def apply(name: str, fn: Callable, *args, n_outputs=None, **kwargs):
         else:
             edges.append(Edge(leaf=t))
 
-    node = GradNode(name, vjp_fn, edges, out_avals, single)
+    node = GradNode(
+        name, vjp_fn, edges, out_avals, single,
+        op_pure=op_pure, op_primals=[args[p] for p in diff_pos],
+    )
     res = _wrap(out, node=node)
     _record_static(name, fn, args, kwargs, res)
     return res

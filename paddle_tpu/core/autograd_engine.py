@@ -58,15 +58,26 @@ class GradNode:
         "out_avals",
         "single_output",
         "released",
+        "op_pure",
+        "op_primals",
     )
 
-    def __init__(self, name: str, vjp_fn: Callable, edges: List[Edge], out_avals, single_output: bool):
+    def __init__(self, name: str, vjp_fn: Callable, edges: List[Edge], out_avals, single_output: bool,
+                 op_pure=None, op_primals=None):
         self.name = name
         self.vjp_fn = vjp_fn
         self.edges = edges
         self.out_avals = out_avals  # list of jax.ShapeDtypeStruct
         self.single_output = single_output
         self.released = False
+        # higher-order support: the op's pure forward (diff-args only -> out)
+        # plus its primal input Tensors. The taped backward (autograd.grad
+        # create_graph=True) re-applies jax.vjp over these THROUGH apply(),
+        # so the backward computation itself lands on the tape with edges to
+        # the primals — residual-as-constant vjp closures can't express
+        # d(backward)/d(primal), this can. Recompute-based (jax-idiomatic).
+        self.op_pure = op_pure
+        self.op_primals = op_primals
 
     def __repr__(self):
         return f"GradNode({self.name}, n_in={len(self.edges)}, n_out={len(self.out_avals)})"
@@ -185,6 +196,11 @@ def run_backward(
         in_cots = node.vjp_fn(cot_struct)
         if not retain_graph:
             node.vjp_fn = None
+            # op_pure closes over the op's raw inputs and op_primals holds
+            # the input Tensors — release them too or every node pins its
+            # activation-sized buffers for the graph's lifetime
+            node.op_pure = None
+            node.op_primals = None
             node.released = True
         if not isinstance(in_cots, (tuple, list)):
             in_cots = (in_cots,)

@@ -272,8 +272,17 @@ class Tensor:
 
     def __repr__(self):
         grad_info = "" if self.stop_gradient else ", stop_gradient=False"
+        po = _print_options
         try:
-            data = np.array2string(self.numpy(), precision=6, separator=", ", threshold=60)
+            data = np.array2string(
+                self.numpy(),
+                precision=po["precision"],
+                separator=", ",
+                threshold=po["threshold"],
+                edgeitems=po["edgeitems"],
+                max_line_width=po["linewidth"],
+                suppress_small=not po["sci_mode"] if po["sci_mode"] is not None else None,
+            )
         except Exception:
             data = f"<traced {self._value}>"
         return (
@@ -398,3 +407,27 @@ class _DynShape(list):
 
     def __hash__(self):
         return id(self)
+
+
+# paddle.set_printoptions (reference python/paddle/tensor/to_string.py)
+_print_options = {
+    "precision": 6,
+    "threshold": 60,
+    "edgeitems": 3,
+    "sci_mode": None,
+    "linewidth": 80,
+}
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None, sci_mode=None, linewidth=None):
+    """Configure Tensor repr formatting (tensor/to_string.py:36)."""
+    if precision is not None:
+        _print_options["precision"] = int(precision)
+    if threshold is not None:
+        _print_options["threshold"] = int(threshold)
+    if edgeitems is not None:
+        _print_options["edgeitems"] = int(edgeitems)
+    if sci_mode is not None:
+        _print_options["sci_mode"] = bool(sci_mode)
+    if linewidth is not None:
+        _print_options["linewidth"] = int(linewidth)

@@ -1,0 +1,49 @@
+"""Small top-level compat APIs.
+
+Reference parity: python/paddle/utils/layers_utils.py:492 (check_shape),
+python/paddle/base/framework.py:824 (disable_signal_handler), and the
+device/cuda RNG-state surface (get/set_cuda_rng_state) — honest TPU-native
+mappings, same contracts.
+"""
+from __future__ import annotations
+
+from ..core.tensor import Tensor
+from . import random as random_mod
+
+
+def check_shape(shape):
+    """Validate a shape argument (list/tuple of non-negative ints or a
+    1-D integer Tensor) before creation ops."""
+    if isinstance(shape, Tensor):
+        import numpy as np
+
+        if not np.issubdtype(np.dtype(shape._value.dtype), np.integer):
+            raise TypeError("shape tensor must be int32/int64")
+        return
+    if isinstance(shape, (list, tuple)):
+        for ele in shape:
+            if isinstance(ele, Tensor):
+                continue
+            if not isinstance(ele, int):
+                raise TypeError("All elements in `shape` must be integers")
+            if ele < 0:
+                raise ValueError("All elements in `shape` must be positive")
+        return
+    raise TypeError(f"shape must be list/tuple/Tensor, got {type(shape)}")
+
+
+def disable_signal_handler():
+    """No-op: the reference installs C++ SIGSEGV handlers that python
+    extensions may conflict with; this runtime installs none."""
+    return None
+
+
+def get_cuda_rng_state():
+    """CUDA-compat RNG surface: returns the accelerator generator state as a
+    one-element list (the reference returns one state per GPU)."""
+    return [random_mod.get_rng_state()]
+
+
+def set_cuda_rng_state(state_list):
+    if state_list:
+        random_mod.set_rng_state(state_list[0])

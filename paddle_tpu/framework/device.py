@@ -142,3 +142,9 @@ def device_count(kind: str = None) -> int:
     if kind is None:
         return len(jax.devices())
     return len([d for d in jax.devices() if _device_kind(d) == kind])
+
+
+class CUDAPinnedPlace(CPUPlace):
+    """CUDA-compat pinned-host-memory place: on TPU the host staging role is
+    played by the native prefetch ring / XLA host memory kinds, so this is
+    the host place (reference phi/common/place.h CUDAPinnedPlace)."""

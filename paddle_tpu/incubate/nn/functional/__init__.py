@@ -597,18 +597,24 @@ def block_multihead_attention(
     self-attention over the packed tokens and writes k/v into the sample's
     cache pages via block_tables; decode (seq_lens_decoder > 0) appends one
     token into the current page and attends over the gathered pages.
-    Quant/pre-cache paths are not supported. Returns (out, qkv, key_cache,
-    value_cache) like the reference (caches updated in place)."""
-    for unsupported in (
-        pre_key_cache, pre_value_cache, cache_k_quant_scales, cache_v_quant_scales,
-        cache_k_dequant_scales, cache_v_dequant_scales, qkv_out_scale, out_shift, out_smooth,
-        rope_emb, mask, tgt_mask,
-    ):
+
+    Supported serving paths (r3): cachekv-int8 (uint8 caches, dynamic
+    per-(batch,head) scales computed at prefill and written back into the
+    quant/dequant scale tensors, or static caller-provided scales; the
+    +128-offset uint8 layout of the reference test oracle), rotary
+    embedding via `rope_emb` [2, B|1, max_seq, 1, D/2] (cos, sin; non-neox
+    interleaved pairs) or [..., D] (neox halves), additive prefill `mask`
+    [B, 1, S, S] and decode `tgt_mask`. Still rejected: pre-cache and the
+    int8-activation (qkv_out_scale/out_shift/out_smooth) epilogues.
+    Returns (out, qkv, key_cache, value_cache); caches + dynamic scales
+    updated in place."""
+    use_dynamic_cachekv_quant = quant_kwargs.pop("use_dynamic_cachekv_quant", False)
+    quant_max_bound = float(quant_kwargs.pop("quant_max_bound", 127.0) or 127.0)
+    for unsupported in (pre_key_cache, pre_value_cache, qkv_out_scale, out_shift, out_smooth):
         if unsupported is not None:
             raise NotImplementedError(
-                "block_multihead_attention: quant/pre-cache/rope/mask paths not"
-                " supported — apply rotary embedding to qkv beforehand"
-                " (incubate fused_rotary_position_embedding)"
+                "block_multihead_attention: pre-cache / int8-activation"
+                " epilogue paths not supported"
             )
     import numpy as np
     from ....core.tensor import Tensor as _T
@@ -631,6 +637,58 @@ def block_multihead_attention(
     H = nb_heads
     token_dim = qv.shape[-1] // 3
     D = token_dim // H
+
+    quant = kc.dtype == jnp.uint8
+    if quant:
+        kqs = jnp.asarray(_np(cache_k_quant_scales), jnp.float32) if cache_k_quant_scales is not None else None
+        vqs = jnp.asarray(_np(cache_v_quant_scales), jnp.float32) if cache_v_quant_scales is not None else None
+        kdq = jnp.asarray(_np(cache_k_dequant_scales), jnp.float32) if cache_k_dequant_scales is not None else None
+        vdq = jnp.asarray(_np(cache_v_dequant_scales), jnp.float32) if cache_v_dequant_scales is not None else None
+        if kqs is None or vqs is None:
+            raise ValueError("uint8 caches require cache_k/v_quant_scales")
+
+        def _quantize(x, qs_ih):  # away-from-zero round, +128 uint8 offset
+            q_ = jnp.sign(x.astype(jnp.float32)) * jnp.floor(
+                jnp.abs(x.astype(jnp.float32)) * qs_ih[:, None] + 0.5
+            )
+            return jnp.clip(q_ + 128.0, 0.0, 255.0).astype(jnp.uint8)
+
+        def _dequantize(x, dq_ih):
+            return (x.astype(jnp.float32) - 128.0) * dq_ih[:, None]
+
+    rope = None
+    if rope_emb is not None:
+        re_ = jnp.asarray(_np(rope_emb), jnp.float32)  # [2, B|1, S, 1, D/2 or D]
+        rope = (re_[0], re_[1])
+
+    def _apply_rope(x, positions):
+        """x [n, H, D]; positions len-n ints."""
+        cos, sin = rope
+        bsel = 0 if cos.shape[0] == 1 else None  # broadcast batch
+        c = cos[bsel if bsel is not None else i, np.asarray(positions), 0]  # [n, D/2|D]
+        s = sin[bsel if bsel is not None else i, np.asarray(positions), 0]
+        xf = x.astype(jnp.float32)
+        if c.shape[-1] == D // 2:
+            if use_neox_style:
+                c2 = jnp.concatenate([c, c], -1)[:, None, :]
+                s2 = jnp.concatenate([s, s], -1)[:, None, :]
+                x1, x2 = xf[..., : D // 2], xf[..., D // 2:]
+                rot = jnp.concatenate([-x2, x1], -1)
+                return (xf * c2 + rot * s2).astype(x.dtype)
+            xp = xf.reshape(x.shape[0], H, D // 2, 2)
+            x0, x1 = xp[..., 0], xp[..., 1]
+            c2, s2 = c[:, None, :], s[:, None, :]
+            o0 = x0 * c2 - x1 * s2
+            o1 = x1 * c2 + x0 * s2
+            return jnp.stack([o0, o1], -1).reshape(x.shape).astype(x.dtype)
+        c2, s2 = c[:, None, :], s[:, None, :]
+        x1, x2 = xf[..., : D // 2], xf[..., D // 2:]
+        rot = jnp.concatenate([-x2, x1], -1)
+        return (xf * c2 + rot * s2).astype(x.dtype)
+
+    mask_v = jnp.asarray(_np(mask), jnp.float32) if mask is not None else None
+    tgt_v = jnp.asarray(_np(tgt_mask), jnp.float32) if tgt_mask is not None else None
+
     outs = []
     tok = 0
     scale = 1.0 / float(np.sqrt(D))
@@ -641,30 +699,62 @@ def block_multihead_attention(
         cur = qv[tok : tok + n].reshape(n, 3, H, D)
         q, k, v = cur[:, 0], cur[:, 1], cur[:, 2]  # [n, H, D]
         if enc[i] > 0:
+            if rope is not None:
+                pos_ids = list(range(n))
+                q = _apply_rope(q, pos_ids)
+                k = _apply_rope(k, pos_ids)
             # prefill: causal self-attention over this sample's n tokens
             lg = jnp.einsum("qhd,khd->hqk", q.astype(jnp.float32), k.astype(jnp.float32)) * scale
-            cm = jnp.tril(jnp.ones((n, n), bool))
-            lg = jnp.where(cm[None], lg, -1e30)
+            if mask_v is not None:
+                lg = lg + mask_v[i, 0, :n, :n][None]
+            else:
+                cm = jnp.tril(jnp.ones((n, n), bool))
+                lg = jnp.where(cm[None], lg, -1e30)
             o = jnp.einsum("hqk,khd->qhd", jax.nn.softmax(lg, -1).astype(v.dtype), v)
-            # write k/v into cache pages
+            if quant:
+                if use_dynamic_cachekv_quant:
+                    kmax = jnp.maximum(jnp.max(jnp.abs(k.astype(jnp.float32)), axis=(0, 2)), 1e-6)
+                    vmax = jnp.maximum(jnp.max(jnp.abs(v.astype(jnp.float32)), axis=(0, 2)), 1e-6)
+                    kqs = kqs.at[i].set(quant_max_bound / kmax)
+                    vqs = vqs.at[i].set(quant_max_bound / vmax)
+                    kdq = kdq.at[i].set(kmax / quant_max_bound) if kdq is not None else None
+                    vdq = vdq.at[i].set(vmax / quant_max_bound) if vdq is not None else None
+                kq = _quantize(jnp.moveaxis(k, 1, 0).reshape(H, -1), kqs[i]).reshape(H, n, D)
+                vq = _quantize(jnp.moveaxis(v, 1, 0).reshape(H, -1), vqs[i]).reshape(H, n, D)
             for t_ in range(n):
                 page = int(tables[i, t_ // bs])
                 slot = t_ % bs
-                kc = kc.at[page, :, slot, :].set(k[t_])
-                vc = vc.at[page, :, slot, :].set(v[t_])
+                kc = kc.at[page, :, slot, :].set(kq[:, t_] if quant else k[t_])
+                vc = vc.at[page, :, slot, :].set(vq[:, t_] if quant else v[t_])
         else:
             # decode: append one token at position dec[i], attend over cache
             pos = int(dec[i])
+            if rope is not None:
+                q = _apply_rope(q, [pos])
+                k = _apply_rope(k, [pos])
             page = int(tables[i, pos // bs])
             slot = pos % bs
-            kc = kc.at[page, :, slot, :].set(k[0])
-            vc = vc.at[page, :, slot, :].set(v[0])
+            if quant:
+                kc = kc.at[page, :, slot, :].set(
+                    _quantize(k[0], kqs[i]))
+                vc = vc.at[page, :, slot, :].set(
+                    _quantize(v[0], vqs[i]))
+            else:
+                kc = kc.at[page, :, slot, :].set(k[0])
+                vc = vc.at[page, :, slot, :].set(v[0])
             npages = pos // bs + 1
             pages = tables[i, :npages].astype(np.int64)
             ks = kc[jnp.asarray(pages)].transpose(1, 0, 2, 3).reshape(H, npages * bs, D)
             vs = vc[jnp.asarray(pages)].transpose(1, 0, 2, 3).reshape(H, npages * bs, D)
             ks, vs = ks[:, : pos + 1], vs[:, : pos + 1]
+            if quant:
+                kd = kdq[i] if kdq is not None else 1.0 / kqs[i]
+                vd = vdq[i] if vdq is not None else 1.0 / vqs[i]
+                ks = _dequantize(ks.reshape(H, -1), kd).reshape(H, pos + 1, D).astype(v.dtype)
+                vs = _dequantize(vs.reshape(H, -1), vd).reshape(H, pos + 1, D).astype(v.dtype)
             lg = jnp.einsum("qhd,hkd->hqk", q.astype(jnp.float32), ks.astype(jnp.float32)) * scale
+            if tgt_v is not None:
+                lg = lg + tgt_v[i].reshape(-1)[: pos + 1][None, None, :]
             o = jnp.einsum("hqk,hkd->qhd", jax.nn.softmax(lg, -1).astype(vs.dtype), vs)
         outs.append(o.reshape(n, H * D))
         tok += n
@@ -672,4 +762,64 @@ def block_multihead_attention(
     if isinstance(key_cache, _T):
         key_cache._replace_value(kc)
         value_cache._replace_value(vc)
+    if quant and use_dynamic_cachekv_quant:
+        for t, vnew in (
+            (cache_k_quant_scales, kqs), (cache_v_quant_scales, vqs),
+            (cache_k_dequant_scales, kdq), (cache_v_dequant_scales, vdq),
+        ):
+            if isinstance(t, _T) and vnew is not None:
+                t._replace_value(vnew)
     return out, qkv_t, key_cache, value_cache
+
+
+def variable_length_memory_efficient_attention(
+    query, key, value, seq_lens, kv_seq_lens, mask=None, scale=None,
+    causal=False, pre_cache_length=0,
+):
+    """Variable-length batched attention (reference
+    incubate/nn/functional/variable_length_memory_efficient_attention.py —
+    the CUTLASS varlen kernel). TPU-native: one fully vectorized masked
+    attention over the padded [B, H, S, D] batch — padding positions are
+    masked at -inf and zeroed in the output, which XLA fuses without any
+    per-sample host loop.
+
+    query [B, H, Sq, D]; key/value [B, Hkv, Sk, D] (Hkv may divide H — GQA);
+    seq_lens / kv_seq_lens [B] or [B, 1]; mask [B, 1, Sq, Sk] additive.
+    """
+    if pre_cache_length:
+        raise NotImplementedError(
+            "variable_length_memory_efficient_attention: pre_cache_length != 0 "
+            "not supported — concatenate the pre-cache into key/value instead"
+        )
+    from ....core.tensor import Tensor as _T
+
+    q = query if isinstance(query, _T) else _T(jnp.asarray(query))
+    k = key if isinstance(key, _T) else _T(jnp.asarray(key))
+    v = value if isinstance(value, _T) else _T(jnp.asarray(value))
+    sl = seq_lens if isinstance(seq_lens, _T) else _T(jnp.asarray(seq_lens))
+    kvl = kv_seq_lens if isinstance(kv_seq_lens, _T) else _T(jnp.asarray(kv_seq_lens))
+    args = [q, k, v, sl, kvl] + ([mask if isinstance(mask, _T) else _T(jnp.asarray(mask))] if mask is not None else [])
+
+    def fn(qv, kv, vv, slv, kvlv, *rest):
+        B, H, Sq, D = qv.shape
+        Hkv, Sk = kv.shape[1], kv.shape[2]
+        if Hkv != H:  # GQA: repeat kv heads
+            rep = H // Hkv
+            kv = jnp.repeat(kv, rep, axis=1)
+            vv = jnp.repeat(vv, rep, axis=1)
+        sc = scale if scale is not None else 1.0 / math.sqrt(D)
+        lg = jnp.einsum("bhqd,bhkd->bhqk", qv.astype(jnp.float32), kv.astype(jnp.float32)) * sc
+        if rest:
+            lg = lg + rest[0].astype(jnp.float32)
+        kpos = jnp.arange(Sk)[None, None, None, :]
+        kvalid = kpos < kvlv.reshape(-1)[:, None, None, None]
+        lg = jnp.where(kvalid, lg, -jnp.inf)
+        if causal:
+            qpos = jnp.arange(Sq)[None, None, :, None]
+            lg = jnp.where(qpos + (Sk - Sq) >= kpos, lg, -jnp.inf)
+        p = jax.nn.softmax(lg, axis=-1)
+        out = jnp.einsum("bhqk,bhkd->bhqd", p.astype(vv.dtype), vv)
+        qvalid = jnp.arange(Sq)[None, None, :, None] < slv.reshape(-1)[:, None, None, None]
+        return jnp.where(qvalid, out, jnp.zeros((), out.dtype))
+
+    return apply("variable_length_memory_efficient_attention", fn, *args)

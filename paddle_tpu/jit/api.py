@@ -79,12 +79,21 @@ def _tensor_flatten(obj):
     return raw, tensor_idx, leaves, treedef, rebuild
 
 
+_CONCRETIZATION_ERRORS = (
+    jax.errors.ConcretizationTypeError,       # incl. TracerBoolConversionError
+    jax.errors.TracerArrayConversionError,    # sibling of, not child of, the above
+    jax.errors.TracerIntegerConversionError,
+    jax.errors.NonConcreteBooleanIndexError,
+)
+
+
 class StaticFunction:
     """The compiled-callable wrapper (analog of dy2static StaticFunction)."""
 
     def __init__(self, fn: Callable, build_strategy=None, full_graph=True):
         self._fn = fn
         self._cache: dict = {}
+        self._warned_fallback = False
         functools.update_wrapper(self, fn, updated=[])
 
     # guard key: arg structure + shapes/dtypes + global layer-mode epoch + grad mode
@@ -133,7 +142,43 @@ class StaticFunction:
         return None  # signal: output already computed by the recording run
 
     def _run_compiled(self, entry, args, kwargs):
-        return entry.run(args, kwargs)
+        if entry.fallback_eager:
+            return self._fn(*args, **kwargs)
+        try:
+            return entry.run(args, kwargs)
+        except _CONCRETIZATION_ERRORS as e:
+            # the SOT graph-break contract (reference python/paddle/jit/sot/):
+            # value-dependent Python control flow that cannot be captured
+            # falls back to eager for this function, loudly, once
+            entry.fallback_eager = True
+            if not self._warned_fallback:
+                self._warned_fallback = True
+                import warnings
+
+                warnings.warn(
+                    f"paddle.jit.to_static: {self._fn.__name__} "
+                    f"({self._source_site(e)}) uses value-dependent Python "
+                    "control flow that cannot be captured into one program; "
+                    "falling back to EAGER execution for this function. Use "
+                    "paddle.jit.cond / lax-style control flow to keep it "
+                    f"compiled. ({type(e).__name__})",
+                    stacklevel=3,
+                )
+            return self._fn(*args, **kwargs)
+
+    def _source_site(self, exc):
+        """file:line inside the user's function where tracing broke."""
+        import inspect
+        import traceback
+
+        try:
+            fn_file = inspect.getsourcefile(self._fn)
+            for fr in reversed(traceback.extract_tb(exc.__traceback__)):
+                if fr.filename == fn_file:
+                    return f"{fr.filename}:{fr.lineno}"
+            return fn_file or "<unknown>"
+        except Exception:
+            return "<unknown>"
 
     @property
     def code(self):
@@ -156,6 +201,7 @@ class _CompiledEntry:
         self.jitted = None
         self.out_rebuild = None
         self.donated = False
+        self.fallback_eager = False
 
     def _grad_inputs(self):
         """Incoming .grad values (accumulation pattern): mask + present values."""
@@ -184,6 +230,19 @@ class _CompiledEntry:
                     traced = self.jitted.trace(
                         raw_args, [t._value for t in self.state], rng, self._grad_inputs()[1]
                     )
+                except Exception:
+                    # failed mid-trace (e.g. concretization error): pure()'s
+                    # finally restored the KNOWN state; scrub any tensor
+                    # discovered only this iteration that still carries a
+                    # tracer, so the eager fallback starts from clean values
+                    for _tid, (t, orig) in rec.writes.items():
+                        if isinstance(t._value, jax.core.Tracer):
+                            t._value = orig
+                            t._grad_node = None
+                    for _tid, (t, orig_g) in rec.grad_writes.items():
+                        if t.grad is not None and isinstance(t.grad._value, jax.core.Tracer):
+                            t.grad = orig_g
+                    raise
                 finally:
                     core_state.set_recorder(prev)
                 known = {id(t) for t in self.state}

@@ -85,7 +85,11 @@ def save(layer, path, input_spec=None, **configs):
         out_meta["treedef"] = treedef
         return tuple(l._value if isinstance(l, Tensor) else jnp.asarray(l) for l in leaves)
 
-    exported = jax_export.export(jax.jit(pure))(*specs)
+    # export for BOTH host and accelerator lowerings: the deployment contract
+    # is save-in-train / load-in-serve across machines (the reference's
+    # analysis_predictor loads one artifact on any backend), and jax.export
+    # otherwise pins the artifact to the platform it was saved on
+    exported = jax_export.export(jax.jit(pure), platforms=("cpu", "tpu"))(*specs)
     blob = exported.serialize()
 
     d = os.path.dirname(path)

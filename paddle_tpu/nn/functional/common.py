@@ -530,3 +530,24 @@ def affine_grid(theta, out_shape, align_corners=True, name=None):
         return out.reshape(n, h, w, 2)
 
     return apply("affine_grid", fn, _t(theta))
+
+
+def pdist(x, p=2.0, name=None):
+    """Condensed pairwise p-norm distances of row vectors: [N(N-1)/2]
+    (reference nn/functional/distance.py:111). The index pairs are static
+    (depend only on N), so they bake in as a constant gather."""
+    x = _t(x)
+    n = x._value.shape[0]
+    iu = np.triu_indices(n, k=1)
+
+    def fn(v):
+        diff = v[iu[0]] - v[iu[1]]
+        if p == 2.0:
+            return jnp.sqrt(jnp.sum(diff * diff, axis=-1))
+        if p == float("inf"):
+            return jnp.max(jnp.abs(diff), axis=-1)
+        if p == 0.0:
+            return jnp.sum((diff != 0).astype(v.dtype), axis=-1)
+        return jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
+
+    return apply("pdist", fn, x)

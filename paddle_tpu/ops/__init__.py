@@ -2,3 +2,7 @@ from . import creation, einsum, linalg, logic, manipulation, math, search  # noq
 from ._patch import patch_tensor
 
 patch_tensor()
+
+from . import inplace  # noqa: F401,E402  (after patch_tensor: inplace variants become methods too)
+
+inplace.patch_tensor_inplace()

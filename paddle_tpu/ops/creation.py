@@ -357,3 +357,21 @@ def standard_gamma(x, name=None) -> Tensor:
     x = _ensure_tensor(x)
     v = x.value
     return Tensor(jax.random.gamma(_key(), v.astype(jnp.float32)).astype(v.dtype))
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False, default_initializer=None):
+    """Standalone learnable parameter (reference tensor/creation.py
+    create_parameter; LayerHelper-free TPU design reuses the initializer
+    resolution of Layer.create_parameter)."""
+    from ..nn.initializer import _resolve_attr
+    from ..nn.layer import Parameter
+    from ..framework import dtype as _dt
+
+    d = _dt.convert_dtype(dtype)
+    init, pname, trainable, lr, reg, need_clip = _resolve_attr(attr, is_bias, default_initializer)
+    value = init(tuple(int(s) for s in shape), d)
+    p = Parameter(value, trainable=trainable, name=pname or name)
+    p.optimize_attr = {"learning_rate": lr}
+    p.regularizer = reg
+    p.need_clip = need_clip
+    return p

@@ -1,0 +1,188 @@
+"""The `*_` inplace op family.
+
+Reference parity: python/paddle/tensor/math.py / manipulation.py /
+logic.py inplace variants (``abs_`` ... ``where_``), generated from the
+``@inplace_apis_in_dygraph_only`` wrappers there.
+
+TPU-native design: jax arrays are immutable, so "inplace" is a Tensor
+IDENTITY contract, not a buffer contract: ``x.op_()`` rebinds x's value to
+the op's result (``Tensor._become``) and returns x. Under ``to_static``
+capture the _become write is recorded as a state mutation, so compiled
+programs carry the update exactly like any other parameter write; XLA's
+buffer donation then makes it a true in-place buffer reuse on-device.
+
+Inplace comparison/logical variants change dtype (paddle semantics: the
+result REPLACES x, bool result included) — _become carries the new dtype.
+"""
+from __future__ import annotations
+
+import math as _pymath
+
+import numpy as np
+from jax import numpy as jnp
+
+from ..core.tensor import Tensor, _ensure_tensor
+from ..core.apply import apply
+from . import creation, linalg, logic, manipulation, math, search
+
+_MODULES = (math, manipulation, logic, search, creation, linalg)
+
+
+def _resolve(name):
+    for m in _MODULES:
+        fn = getattr(m, name, None)
+        if fn is not None:
+            return fn
+    raise AttributeError(f"inplace generator: no base op `{name}`")
+
+
+def _make_inplace(name):
+    base = _resolve(name)
+
+    def op_(x, *args, **kwargs):
+        x._become(base(x, *args, **kwargs))
+        return x
+
+    op_.__name__ = name + "_"
+    op_.__qualname__ = name + "_"
+    op_.__doc__ = (
+        f"Inplace variant of :func:`{name}` (rebinds x to the result and "
+        f"returns x; see module docstring for the TPU inplace contract)."
+    )
+    return op_
+
+
+# every name here has its base op in one of _MODULES; the variant is purely
+# mechanical. Ops whose inplace form needs custom argument order or has no
+# base (random fills) are defined explicitly below.
+_MECHANICAL = [
+    "abs", "acos", "acosh", "addmm", "asin", "asinh", "atan", "atanh",
+    "bitwise_and", "bitwise_left_shift", "bitwise_not", "bitwise_or",
+    "bitwise_right_shift", "bitwise_xor",
+    "cast", "copysign", "cos", "cosh", "cumprod", "cumsum",
+    "digamma", "divide", "equal", "erf", "expm1",
+    "flatten", "floor_divide", "floor_mod", "frac",
+    "gammainc", "gammaincc", "gammaln", "gcd",
+    "greater_equal", "greater_than", "hypot", "i0",
+    "index_add", "index_put", "lcm", "ldexp", "less_equal", "less_than",
+    "lgamma", "log", "log10", "log2",
+    "logical_and", "logical_not", "logical_or", "logical_xor", "logit",
+    "masked_scatter", "mod", "multigammaln", "multiply",
+    "nan_to_num", "neg", "polygamma", "pow", "remainder", "renorm",
+    "sin", "sinh", "square", "tan", "tanh", "tril", "triu", "trunc",
+]
+
+_g = globals()
+for _name in _MECHANICAL:
+    _g[_name + "_"] = _make_inplace(_name)
+
+
+def t_(x, name=None):
+    """Inplace transpose of a 0/1/2-D tensor (tensor/linalg.py t_)."""
+    x._become(manipulation.t(x))
+    return x
+
+
+def transpose_(x, perm, name=None):
+    """Inplace permute (tensor/manipulation.py transpose_)."""
+    x._become(manipulation.transpose(x, perm))
+    return x
+
+
+def where_(condition, x=None, y=None, name=None):
+    """Inplace select: x becomes where(condition, x, y) (tensor/search.py)."""
+    out = manipulation.where(condition, x, y)
+    x._become(out)
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    """Fill x with N(mean, std) samples (tensor/random.py normal_)."""
+    from ..framework import random as random_mod
+
+    shape = tuple(x._value.shape)
+
+    def fn(v):
+        import jax
+
+        key = random_mod.next_key()
+        return (jax.random.normal(key, shape, jnp.float32) * std + mean).astype(v.dtype)
+
+    x._become(apply("normal_", fn, x))
+    return x
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    """Fill x with U(min, max) samples (tensor/random.py uniform_)."""
+    from ..framework import random as random_mod
+
+    shape = tuple(x._value.shape)
+
+    def fn(v):
+        import jax
+
+        key = random_mod.next_key()
+        return jax.random.uniform(
+            key, shape, jnp.float32, minval=min, maxval=max
+        ).astype(v.dtype)
+
+    x._become(apply("uniform_", fn, x))
+    return x
+
+
+def cauchy_(x, loc=0, scale=1, name=None):
+    """Fill x with Cauchy(loc, scale) samples via inverse-CDF of a uniform
+    draw (tensor/creation.py:2892)."""
+    from ..framework import random as random_mod
+
+    shape = tuple(x._value.shape)
+
+    def fn(v):
+        import jax
+
+        key = random_mod.next_key()
+        u = jax.random.uniform(key, shape, jnp.float32, minval=1e-7, maxval=1.0 - 1e-7)
+        return (loc + scale * jnp.tan(_pymath.pi * (u - 0.5))).astype(v.dtype)
+
+    x._become(apply("cauchy_", fn, x))
+    return x
+
+
+def geometric_(x, probs, name=None):
+    """Fill x with Geometric(probs) samples (support {1, 2, ...}) via
+    inverse-CDF (tensor/creation.py:2926)."""
+    from ..framework import random as random_mod
+
+    shape = tuple(x._value.shape)
+    p = probs._value if isinstance(probs, Tensor) else probs
+
+    def fn(v):
+        import jax
+
+        key = random_mod.next_key()
+        u = jax.random.uniform(key, shape, jnp.float32, minval=1e-7, maxval=1.0 - 1e-7)
+        return jnp.ceil(jnp.log(u) / jnp.log1p(-jnp.asarray(p, jnp.float32))).astype(v.dtype)
+
+    x._become(apply("geometric_", fn, x))
+    return x
+
+
+__all__ = (
+    [n + "_" for n in _MECHANICAL]
+    + ["t_", "transpose_", "where_", "normal_", "uniform_", "cauchy_", "geometric_"]
+)
+
+
+def patch_tensor_inplace():
+    """Attach every inplace op as a Tensor method (reference: the
+    monkey-patch tables in tensor/__init__.py tensor_method_func)."""
+    for n in __all__:
+        fn = _g[n]
+        if n == "where_":
+            # method form: x.where_(y, condition) per tensor patch semantics
+            def m(self, y, condition, _fn=fn):
+                return _fn(condition, self, y)
+
+            setattr(Tensor, n, m)
+        else:
+            setattr(Tensor, n, fn)

@@ -514,3 +514,75 @@ def tensordot(x, y, axes=2, name=None):
     if isinstance(ax, (list, tuple)):
         ax = tuple(tuple(a) if isinstance(a, (list, tuple)) else a for a in ax)
     return apply("tensordot", lambda a, b: jnp.tensordot(a, b, axes=ax), _t(x), _t(y))
+
+
+# ---------------------------------------------------------------------------
+# r3 API-parity additions (VERDICT r2 Missing #1)
+# ---------------------------------------------------------------------------
+
+def add_n(inputs, name=None):
+    """Elementwise sum of a list of tensors (tensor/math.py:1920)."""
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+    ts = [_t(i) for i in inputs]
+
+    def fn(*vals):
+        out = vals[0]
+        for v in vals[1:]:
+            out = out + v
+        return out
+
+    return apply("add_n", fn, *ts)
+
+
+def gammaln(x, name=None):
+    """Log of the absolute gamma function (tensor/math.py gammaln)."""
+    return apply("gammaln", lambda v: jax.scipy.special.gammaln(v), _t(x))
+
+
+def gammainc(x, y, name=None):
+    """Regularized lower incomplete gamma P(x, y) (tensor/math.py:5152)."""
+    return apply("gammainc", lambda a, b: jax.scipy.special.gammainc(a, b), _t(x), _t(y))
+
+
+def gammaincc(x, y, name=None):
+    """Regularized upper incomplete gamma Q(x, y) (tensor/math.py:5091)."""
+    return apply("gammaincc", lambda a, b: jax.scipy.special.gammaincc(a, b), _t(x), _t(y))
+
+
+def multigammaln(x, p, name=None):
+    """Log multivariate gamma (tensor/math.py:5242)."""
+    return apply("multigammaln", lambda v: jax.scipy.special.multigammaln(v, p), _t(x))
+
+
+def frexp(x, name=None):
+    """Mantissa/exponent decomposition: x = m * 2**e (tensor/math.py:6504)."""
+    def fn(v):
+        m, e = jnp.frexp(v)
+        return m, e.astype(v.dtype)
+
+    return apply("frexp", fn, _t(x))
+
+
+def signbit(x, name=None):
+    """True where the sign bit is set (tensor/math.py:7596)."""
+    return apply_nograd("signbit", lambda v: jnp.signbit(v), _t(x))
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    """All r-length combinations of a 1-D tensor (tensor/math.py:7530).
+
+    The index set depends only on the (static) length, so it is computed
+    host-side with itertools and baked in as a constant gather — no
+    data-dependent shapes under jit."""
+    import itertools
+
+    x = _t(x)
+    n = x._value.shape[0]
+    picker = itertools.combinations_with_replacement if with_replacement else itertools.combinations
+    idx = np.asarray(list(picker(range(n), r)), dtype=np.int32).reshape(-1, r)
+
+    def fn(v):
+        return v[jnp.asarray(idx)]
+
+    return apply("combinations", fn, x)

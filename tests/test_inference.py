@@ -69,3 +69,42 @@ def test_config_surface(tmp_path):
     assert pred.get_input_names() == ["x"]
     with pytest.raises(RuntimeError):
         pred.run()  # inputs not set
+
+
+def test_cross_process_round_trip(tmp_path):
+    """The deployment contract (VERDICT r2 next-round #10): jit.save here,
+    create_predictor + run in a FRESH python process, outputs match —
+    mirrors the reference's save-in-train/load-in-serve split
+    (fluid/inference/api/analysis_predictor.cc)."""
+    import json
+    import subprocess
+    import sys
+
+    paddle.seed(7)
+    m = nn.Sequential(nn.Linear(5, 16), nn.GELU(), nn.Linear(16, 4))
+    m.eval()
+    prefix = str(tmp_path / "xproc")
+    paddle.jit.save(m, prefix, input_spec=[static.InputSpec([-1, 5], "float32")])
+
+    xv = np.random.RandomState(3).randn(6, 5).astype(np.float32)
+    want = m(paddle.to_tensor(xv)).numpy()
+    np.save(str(tmp_path / "x.npy"), xv)
+
+    child = f"""
+import json, sys
+sys.path.insert(0, {json.dumps(str(__import__('pathlib').Path(paddle.__file__).parent.parent))})
+import numpy as np
+from paddle_tpu.inference import Config, create_predictor
+pred = create_predictor(Config({json.dumps(prefix)}))
+x = np.load({json.dumps(str(tmp_path / 'x.npy'))})
+(out,) = pred.run([x])
+np.save({json.dumps(str(tmp_path / 'out.npy'))}, out)
+print("CHILD_OK")
+"""
+    r = subprocess.run([sys.executable, "-c", child], capture_output=True, text=True, timeout=300)
+    assert "CHILD_OK" in r.stdout, r.stdout + r.stderr
+    got = np.load(str(tmp_path / "out.npy"))
+    # the child runs on the real accelerator (no conftest CPU pin), where
+    # XLA's default f32 matmul precision is reduced (bf16 passes) — the
+    # contract is platform-precision equality, not bitwise equality
+    np.testing.assert_allclose(got, want, rtol=6e-2, atol=2e-3)
