@@ -1,0 +1,82 @@
+"""DataLoader worker-mode benchmark (VERDICT r2 next-round #8).
+
+Transform-heavy vision pipeline (PIL resize + jitter + normalize, batch
+256): thread+native-ring prefetch vs the r3 multiprocess worker mode.
+Python/PIL transforms hold the GIL, which is exactly why the reference
+ships shared-memory worker PROCESSES (io/dataloader/dataloader_iter.py).
+
+Run: python benchmarks/dataloader_bench.py
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.io import DataLoader, Dataset
+
+
+class VisionDataset(Dataset):
+    """PIL-backed transform pipeline: decode-ish + resize + flip + jitter +
+    normalize. Deliberately Python/GIL-bound like real vision pipelines."""
+
+    def __init__(self, n=2048, size=96):
+        self.n = n
+        self.size = size
+        rng = np.random.RandomState(0)
+        self.raw = rng.randint(0, 255, (64, 128, 128, 3), np.uint8)
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        from PIL import Image, ImageEnhance
+
+        img = Image.fromarray(self.raw[i % 64])
+        img = img.resize((self.size, self.size), Image.BILINEAR)
+        if i % 2:
+            img = img.transpose(Image.FLIP_LEFT_RIGHT)
+        img = ImageEnhance.Brightness(img).enhance(0.8 + (i % 7) * 0.05)
+        img = ImageEnhance.Contrast(img).enhance(0.9 + (i % 5) * 0.04)
+        a = np.asarray(img, np.float32) / 255.0
+        a = (a - np.array([0.485, 0.456, 0.406], np.float32)) / np.array(
+            [0.229, 0.224, 0.225], np.float32)
+        return a.transpose(2, 0, 1), np.int64(i % 10)
+
+
+def consume(it):
+    t0 = time.perf_counter()
+    n = 0
+    for batch in it:
+        n += 1
+    return n / (time.perf_counter() - t0)
+
+
+def main():
+    ds = VisionDataset()
+    batch = 256
+
+    # warm PIL etc.
+    _ = ds[0]
+
+    for workers in (4,):
+        dl_thread = DataLoader(ds, batch_size=batch, num_workers=workers,
+                               use_shared_memory=True)
+        # force the legacy thread/ring path regardless of routing
+        r_ring = consume(dl_thread._prefetch_iter())
+        print(f"thread+ring   (workers={workers}): {r_ring:6.2f} batches/s "
+              f"({r_ring * batch:7.0f} img/s)")
+
+        dl_mp = DataLoader(ds, batch_size=batch, num_workers=workers, persistent_workers=True)
+        consume(iter(dl_mp))          # epoch 1: pays worker spawn
+        r_mp = consume(iter(dl_mp))   # epoch 2: steady state
+        print(f"mp workers    (workers={workers}): {r_mp:6.2f} batches/s "
+              f"({r_mp * batch:7.0f} img/s, steady-state epoch)")
+        print(f"-> {'MP' if r_mp > r_ring else 'THREAD'} wins by {max(r_mp, r_ring) / min(r_mp, r_ring):.2f}x")
+
+
+if __name__ == "__main__":
+    main()

@@ -147,6 +147,8 @@ class CollectiveController:
                     for c in self.pod.containers:
                         c.terminate(force=True)
                         c.restarts += 1
+                    for c in self.pod.containers:
+                        c.wait(timeout=10)
                     self.pod.deploy()
                     continue
                 print(f"[launch] job failed: exit codes {self.pod.exit_codes()}", file=sys.stderr)
@@ -155,10 +157,25 @@ class CollectiveController:
             if failed:
                 restartable = args.max_restart > 0 and all(c.restarts < args.max_restart for c in failed)
                 if restartable:
-                    for c in failed:
-                        print(f"[launch] restarting rank {c.env['PADDLE_TRAINER_ID']}", file=sys.stderr)
+                    # restart the WHOLE pod, not just the dead rank: a
+                    # collective job's survivors are blocked on the dead
+                    # peer (the reference's NCCL jobs behave the same —
+                    # watchdog aborts the peers, launcher redeploys all);
+                    # workers resume from their distributed checkpoint
+                    print(
+                        f"[launch] rank(s) {[c.env['PADDLE_TRAINER_ID'] for c in failed]} "
+                        "failed, restarting pod",
+                        file=sys.stderr,
+                    )
+                    for c in self.pod.containers:
+                        c.terminate(force=True)
                         c.restarts += 1
-                        c.start()
+                    # reap before redeploy: a dying worker can still hold
+                    # the exclusive device lock, and an unreaped Popen is a
+                    # zombie — racing the relaunch against it burns restarts
+                    for c in self.pod.containers:
+                        c.wait(timeout=10)
+                    self.pod.deploy()
                 else:
                     print("[launch] container failed, stopping pod", file=sys.stderr)
                     self.pod.stop(force=True)

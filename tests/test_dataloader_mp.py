@@ -1,0 +1,50 @@
+"""Multiprocess (spawn, persistent) DataLoader workers (VERDICT r2
+next-round #8). Dataset lives at module scope so spawned children can
+unpickle it."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.io import DataLoader, Dataset
+
+
+class ModDS(Dataset):
+    def __len__(self):
+        return 48
+
+    def __getitem__(self, i):
+        return np.full((3,), i, np.float32), np.int64(i % 5)
+
+
+def test_persistent_mp_workers_two_epochs():
+    dl = DataLoader(ModDS(), batch_size=6, num_workers=2, persistent_workers=True)
+    e1 = [(float(x.numpy()[0, 0]), int(y.numpy()[0])) for x, y in dl]
+    pool1 = dl._mp_pool
+    e2 = [(float(x.numpy()[0, 0]), int(y.numpy()[0])) for x, y in dl]
+    assert dl._mp_pool is pool1          # workers reused across epochs
+    want = [(float(b * 6), b * 6 % 5) for b in range(8)]
+    assert e1 == want and e2 == want
+    pool1.shutdown()
+
+
+def test_mp_worker_exception_propagates():
+    class Boom(ModDS):
+        def __getitem__(self, i):
+            if i == 7:
+                raise ValueError("boom at 7")
+            return super().__getitem__(i)
+
+    # Boom is a local class -> unpicklable for spawn -> falls back to the
+    # thread path, which must still propagate the error
+    dl = DataLoader(Boom(), batch_size=4, num_workers=2, persistent_workers=True)
+    import pytest
+
+    with pytest.raises(ValueError, match="boom at 7"):
+        list(dl)
+
+
+def test_default_thread_route_unchanged():
+    dl = DataLoader(ModDS(), batch_size=6, num_workers=2)
+    assert getattr(dl, "_mp_pool", None) is None
+    batches = list(dl)
+    assert len(batches) == 8
+    assert getattr(dl, "_mp_pool", None) is None  # never spawned

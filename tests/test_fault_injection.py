@@ -1,0 +1,177 @@
+"""Fault-injection integration test (VERDICT r2 next-round #4).
+
+Real subprocess pattern of the reference's test_dist_base.py:959 fused with
+the elastic relaunch contract: the launcher spawns 2 REAL worker processes
+doing lockstep data-parallel SGD with gradient exchange over the native C++
+TCPStore and per-rank distributed checkpoint shards; the test SIGKILLs one
+worker mid-run; the controller relaunches the pod; workers resume from the
+latest complete checkpoint and the final loss equals an uninterrupted run's.
+"""
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.launch import CollectiveController, Context, parse_args
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = r'''
+import json, os, sys, time
+sys.path.insert(0, os.environ["FI_REPO"])
+import numpy as np
+
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+world = int(os.environ["PADDLE_TRAINERS_NUM"])
+host, port = os.environ["PADDLE_MASTER"].rsplit(":", 1)
+out = os.environ["FI_DIR"]
+TOTAL = int(os.environ["FI_STEPS"])
+LR = 0.2
+
+from paddle_tpu.native.store import TCPStore
+store = TCPStore(host, int(port), is_master=(rank == 0), world_size=world, timeout=60)
+
+# deterministic problem, sharded by rank
+rng = np.random.RandomState(0)
+w_true = np.array([[1.0], [-2.0], [3.0], [0.5]], np.float32)
+X = rng.randn(64, 4).astype(np.float32)
+Y = X @ w_true
+xs, ys = X[rank::world], Y[rank::world]
+
+w = np.zeros((4, 1), np.float32)
+
+# resume from the latest COMPLETE step (marker written only after every
+# rank's shard landed)
+ck = os.path.join(out, "ckpt")
+os.makedirs(ck, exist_ok=True)
+start = 0
+done_steps = sorted(
+    int(f.split("_")[1]) for f in os.listdir(ck) if f.startswith("complete_")
+)
+if done_steps:
+    s = done_steps[-1]
+    w = np.load(os.path.join(ck, f"shard_{s}_{rank}.npy"))
+    start = s + 1
+    with open(os.path.join(out, f"resumed.{rank}"), "a") as f:
+        f.write(f"{s}\n")
+
+for step in range(start, TOTAL):
+    pred = xs @ w
+    grad = 2.0 * xs.T @ (pred - ys) / xs.shape[0]   # [4,1]
+    store.set(f"g{step}_{rank}", grad.astype(np.float32).tobytes())
+    store.wait([f"g{step}_{r}" for r in range(world)], timeout=120.0)
+    gsum = np.zeros_like(grad)
+    for r in range(world):
+        gsum += np.frombuffer(store.get(f"g{step}_{r}"), np.float32).reshape(4, 1)
+    w = w - LR * gsum / world
+
+    # per-rank checkpoint shard, atomic
+    tmp = os.path.join(ck, f".tmp_{step}_{rank}.npy")
+    np.save(tmp, w)
+    os.replace(tmp, os.path.join(ck, f"shard_{step}_{rank}.npy"))
+    store.set(f"done{step}_{rank}", b"1")
+    store.wait([f"done{step}_{r}" for r in range(world)], timeout=120.0)
+    if rank == 0:
+        open(os.path.join(ck, f"complete_{step}_"), "w").close()
+
+    with open(os.path.join(out, f"progress.{rank}.tmp"), "w") as f:
+        f.write(str(step))
+    os.replace(os.path.join(out, f"progress.{rank}.tmp"), os.path.join(out, f"progress.{rank}"))
+    if os.environ.get("FI_STEP_DELAY"):
+        time.sleep(float(os.environ["FI_STEP_DELAY"]))
+
+if rank == 0:
+    loss = float(np.mean((X @ w - Y) ** 2))
+    with open(os.path.join(out, "final.tmp"), "w") as f:
+        json.dump({"loss": loss, "w": w.reshape(-1).tolist()}, f)
+    os.replace(os.path.join(out, "final.tmp"), os.path.join(out, "final.json"))
+'''
+
+
+def _free_port():
+    import socket
+
+    with socket.socket() as s:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def _run_pod(tmp_path, tag, steps, step_delay=None, kill_after_step=None):
+    out = tmp_path / tag
+    out.mkdir()
+    script = tmp_path / f"worker_{tag}.py"
+    script.write_text(WORKER)
+    env = {
+        "FI_REPO": REPO,
+        "FI_DIR": str(out),
+        "FI_STEPS": str(steps),
+    }
+    if step_delay:
+        env["FI_STEP_DELAY"] = str(step_delay)
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        args = parse_args([
+            "--nproc_per_node", "2", "--max_restart", "3",
+            "--poll_interval", "0.2", "--port", str(_free_port()), str(script),
+        ])
+        ctrl = CollectiveController(Context(args))
+        result = {}
+
+        def run():
+            result["code"] = ctrl.run()
+
+        th = threading.Thread(target=run, daemon=True)
+        th.start()
+
+        if kill_after_step is not None:
+            prog = out / "progress.1"
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                if prog.exists() and int(prog.read_text() or -1) >= kill_after_step:
+                    break
+                time.sleep(0.05)
+            else:
+                raise AssertionError("worker never reached the kill step")
+            pid = ctrl.pod.containers[1].proc.pid
+            os.kill(pid, signal.SIGKILL)
+
+        th.join(timeout=240)
+        assert not th.is_alive(), "launcher did not finish"
+        assert result["code"] == 0, f"pod exit code {result['code']}"
+        final = json.load(open(out / "final.json"))
+        return final, ctrl, out
+    finally:
+        for k, v in old.items():
+            os.environ.pop(k, None) if v is None else os.environ.__setitem__(k, v)
+
+
+def test_sigkill_midrun_relaunch_resumes_to_same_loss(tmp_path):
+    steps = 12
+    ref, _, _ = _run_pod(tmp_path, "ref", steps)
+
+    got, ctrl, out = _run_pod(
+        tmp_path, "faulty", steps, step_delay=0.25, kill_after_step=3)
+
+    # the pod actually restarted
+    assert all(c.restarts >= 1 for c in ctrl.pod.containers)
+    # workers actually resumed from a checkpoint (not from scratch)
+    resumed = (out / "resumed.0").read_text().strip().splitlines()
+    assert resumed and int(resumed[0]) >= 2
+
+    # training converged to the SAME result as the uninterrupted run
+    np.testing.assert_allclose(got["w"], ref["w"], rtol=1e-6, atol=1e-7)
+    assert got["loss"] == pytest.approx(ref["loss"], rel=1e-6)
+    assert ref["loss"] < 1e-2  # and it genuinely learned
+
+
+def test_uninterrupted_pod_trains(tmp_path):
+    final, ctrl, _ = _run_pod(tmp_path, "plain", 10)
+    assert final["loss"] < 0.05
+    assert all(c.restarts == 0 for c in ctrl.pod.containers)
