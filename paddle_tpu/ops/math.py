@@ -249,8 +249,11 @@ def trace(x, offset=0, axis1=0, axis2=1):
 
 
 def lerp(x, y, weight):
-    w = weight.value if isinstance(weight, Tensor) else weight
-    return apply("lerp", lambda a, b: a + w * (b - a), *_binary_promote(x, y))
+    if isinstance(weight, Tensor):
+        # weight is a differentiable input (reference lerp_grad computes
+        # dweight) — it must flow through apply, not be baked as a constant
+        return apply("lerp", lambda a, b, w: a + w * (b - a), *_binary_promote(x, y), weight)
+    return apply("lerp", lambda a, b: a + weight * (b - a), *_binary_promote(x, y))
 
 
 def nan_to_num(x, nan=0.0, posinf=None, neginf=None):
