@@ -153,6 +153,7 @@ from . import incubate  # noqa: E402,F401
 from .framework.io import load, save  # noqa: E402,F401
 from .jit import to_static  # noqa: E402,F401
 from . import hapi  # noqa: E402,F401
+from . import hub  # noqa: E402,F401
 from . import profiler  # noqa: E402,F401
 from . import distribution  # noqa: E402,F401
 from . import quantization  # noqa: E402,F401

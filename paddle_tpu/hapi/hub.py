@@ -1,0 +1,128 @@
+"""Model hub: list / help / load entry points from a hubconf.py.
+
+Reference parity: python/paddle/hapi/hub.py (github/gitee/local repos with
+a MODULE_HUBCONF entry-point module). TPU-native notes: the local-dir flow
+is fully supported; github/gitee archives resolve through
+utils.download.get_path_from_url (zero-egress sandboxes get the reference's
+own RuntimeError at download time). Entry points are plain callables in
+hubconf.py, dependency-checked via its `dependencies` list.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+MODULE_HUBCONF = "hubconf.py"
+VAR_DEPENDENCY = "dependencies"
+hub_dir = os.path.expanduser(os.environ.get("PADDLE_HUB_DIR", "~/.cache/paddle/hub"))
+
+
+def _import_hubconf(repo_dir):
+    path = os.path.join(repo_dir, MODULE_HUBCONF)
+    if not os.path.isfile(path):
+        raise RuntimeError(f"no {MODULE_HUBCONF} in {repo_dir}")
+    spec = importlib.util.spec_from_file_location("hubconf", path)
+    m = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, repo_dir)
+    try:
+        spec.loader.exec_module(m)
+    finally:
+        sys.path.remove(repo_dir)
+    deps = getattr(m, VAR_DEPENDENCY, [])
+    missing = []
+    for d in deps:
+        if importlib.util.find_spec(d) is None:
+            missing.append(d)
+    if missing:
+        raise RuntimeError(f"hub repo requires missing packages: {missing}")
+    return m
+
+
+def _git_archive_link(repo_owner, repo_name, branch, source):
+    if source == "github":
+        return f"https://github.com/{repo_owner}/{repo_name}/archive/{branch}.zip"
+    if source == "gitee":
+        return f"https://gitee.com/{repo_owner}/{repo_name}/repository/archive/{branch}.zip"
+    raise ValueError(f"unknown source {source}")
+
+
+def _parse_repo_info(repo, source):
+    branch = "main" if source == "github" else "master"
+    if ":" in repo:
+        repo, branch = repo.split(":")
+    owner, name = repo.split("/")
+    return owner, name, branch
+
+
+def _get_cache_or_reload(repo, force_reload, source):
+    import zipfile
+
+    from ..utils.download import get_path_from_url
+
+    owner, name, branch = _parse_repo_info(repo, source)
+    normalized = f"{owner}_{name}_{branch.replace('/', '_')}"
+    # per-repo download dir: the archive's basename is just "<branch>.zip",
+    # so caching it directly under hub_dir would collide across repos that
+    # share a branch name (and hand back the WRONG repo's code)
+    dl_dir = os.path.join(hub_dir, "_downloads", normalized)
+    repo_dir = os.path.join(hub_dir, normalized)
+    if os.path.isdir(repo_dir) and not force_reload:
+        return repo_dir
+    os.makedirs(dl_dir, exist_ok=True)
+    url = _git_archive_link(owner, name, branch, source)
+    if force_reload:
+        # drop any stale archive or the "refresh" silently re-extracts it
+        stale = os.path.join(dl_dir, os.path.basename(url))
+        if os.path.exists(stale):
+            os.remove(stale)
+    cached = get_path_from_url(url, dl_dir)
+    if zipfile.is_zipfile(cached):
+        with zipfile.ZipFile(cached) as z:
+            top = z.namelist()[0].split("/")[0]
+            z.extractall(dl_dir)
+        extracted = os.path.join(dl_dir, top)
+        if extracted != repo_dir:
+            if os.path.isdir(repo_dir):
+                import shutil
+
+                shutil.rmtree(repo_dir)
+            os.rename(extracted, repo_dir)
+    return repo_dir
+
+
+def _resolve(repo_dir, source, force_reload):
+    source = (source or "github").lower()
+    if source not in ("github", "gitee", "local"):
+        raise ValueError(f'source should be "github"/"gitee"/"local", got {source}')
+    if source == "local":
+        return repo_dir
+    return _get_cache_or_reload(repo_dir, force_reload, source)
+
+
+def list(repo_dir, source="github", force_reload=False):  # noqa: A001
+    """Entrypoint names exposed by the repo's hubconf.py (hapi/hub.py list)."""
+    m = _import_hubconf(_resolve(repo_dir, source, force_reload))
+    return [
+        f for f in dir(m)
+        if callable(getattr(m, f)) and not f.startswith("_")
+    ]
+
+
+def help(repo_dir, model, source="github", force_reload=False):  # noqa: A001
+    """Docstring of one entrypoint (hapi/hub.py help)."""
+    m = _import_hubconf(_resolve(repo_dir, source, force_reload))
+    return _entry(m, model).__doc__
+
+
+def load(repo_dir, model, source="github", force_reload=False, **kwargs):
+    """Instantiate an entrypoint (hapi/hub.py load)."""
+    m = _import_hubconf(_resolve(repo_dir, source, force_reload))
+    return _entry(m, model)(**kwargs)
+
+
+def _entry(m, name):
+    fn = getattr(m, name, None)
+    if fn is None or not callable(fn):
+        raise RuntimeError(f"Cannot find callable {name} in hubconf")
+    return fn

@@ -1,0 +1,51 @@
+"""paddle.hub list/help/load over a local hubconf repo (reference
+python/paddle/hapi/hub.py; VERDICT r2 Missing #7)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+@pytest.fixture
+def repo(tmp_path):
+    (tmp_path / "hubconf.py").write_text(
+        "dependencies = ['numpy']\n"
+        "import numpy as _np\n"
+        "import paddle_tpu as _p\n\n"
+        "def tiny_linear(out_features=3):\n"
+        "    '''A tiny Linear(4, out_features) test model.'''\n"
+        "    return _p.nn.Linear(4, out_features)\n\n"
+        "def _private():\n"
+        "    return None\n"
+    )
+    return str(tmp_path)
+
+
+def test_hub_list(repo):
+    assert paddle.hub.list(repo, source="local") == ["tiny_linear"]
+
+
+def test_hub_help(repo):
+    assert "tiny Linear" in paddle.hub.help(repo, "tiny_linear", source="local")
+
+
+def test_hub_load(repo):
+    m = paddle.hub.load(repo, "tiny_linear", source="local", out_features=2)
+    out = m(paddle.to_tensor(np.ones((5, 4), np.float32)))
+    assert tuple(out.shape) == (5, 2)
+
+
+def test_hub_errors(repo):
+    with pytest.raises(RuntimeError, match="Cannot find callable"):
+        paddle.hub.load(repo, "nope", source="local")
+    with pytest.raises(ValueError, match="source"):
+        paddle.hub.list(repo, source="svn")
+    with pytest.raises(RuntimeError, match="hubconf"):
+        paddle.hub.list("/nonexistent_dir_xyz", source="local")
+
+
+def test_hub_missing_dependency(tmp_path):
+    (tmp_path / "hubconf.py").write_text(
+        "dependencies = ['not_a_real_pkg_xyz']\n\ndef f():\n    return 1\n")
+    with pytest.raises(RuntimeError, match="missing packages"):
+        paddle.hub.list(str(tmp_path), source="local")
