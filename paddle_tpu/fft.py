@@ -108,3 +108,41 @@ def fftshift(x, axes=None, name=None):
 
 def ifftshift(x, axes=None, name=None):
     return apply("ifftshift", lambda v: jnp.fft.ifftshift(v, axes=axes), x)
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    """n-D FFT of a Hermitian-symmetric input -> real output (reference
+    fft.py hfftn). Composed as a complex FFT over the leading axes + a 1-D
+    hfft over the last: per-stage norm factors multiply to the full-size
+    factor for backward/forward/ortho alike."""
+    axes = tuple(axes) if axes is not None else tuple(range(-len(s), 0)) if s is not None else tuple(range(-x.ndim, 0))
+    lead, last = axes[:-1], axes[-1]
+    s_lead = list(s[:-1]) if s is not None else None
+    n_last = s[-1] if s is not None else None
+    out = x
+    if lead:
+        out = fftn(out, s=s_lead, axes=lead, norm=norm)
+    return hfft(out, n=n_last, axis=last, norm=norm)
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    """Inverse of hfftn: 1-D ihfft over the last axis + inverse complex FFT
+    over the leading axes (reference fft.py ihfftn)."""
+    axes = tuple(axes) if axes is not None else tuple(range(-len(s), 0)) if s is not None else tuple(range(-x.ndim, 0))
+    lead, last = axes[:-1], axes[-1]
+    s_lead = list(s[:-1]) if s is not None else None
+    n_last = s[-1] if s is not None else None
+    out = ihfft(x, n=n_last, axis=last, norm=norm)
+    if lead:
+        out = ifftn(out, s=s_lead, axes=lead, norm=norm)
+    return out
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    """2-D Hermitian FFT (reference fft.py hfft2)."""
+    return hfftn(x, s=s, axes=axes, norm=norm)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    """2-D inverse Hermitian FFT (reference fft.py ihfft2)."""
+    return ihfftn(x, s=s, axes=axes, norm=norm)

@@ -24,17 +24,22 @@ from .layers.activation import (  # noqa: F401
     CELU, ELU, GELU, GLU, Hardshrink, Hardsigmoid, Hardswish, Hardtanh,
     LeakyReLU, LogSigmoid, LogSoftmax, Maxout, Mish, PReLU, ReLU, ReLU6,
     RReLU, SELU, Sigmoid, Silu, Softmax, Softplus, Softshrink, Softsign,
-    Swish, Tanh, Tanhshrink, ThresholdedReLU,
+    Softmax2D, Swish, Tanh, Tanhshrink, ThresholdedReLU,
 )
 from .layers.pooling import (  # noqa: F401
     AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveAvgPool3D,
     AdaptiveMaxPool1D, AdaptiveMaxPool2D, AdaptiveMaxPool3D,
-    AvgPool1D, AvgPool2D, AvgPool3D, MaxPool1D, MaxPool2D, MaxPool3D,
+    AvgPool1D, AvgPool2D, AvgPool3D, FractionalMaxPool2D,
+    FractionalMaxPool3D, MaxPool1D, MaxPool2D, MaxPool3D, MaxUnPool1D,
+    MaxUnPool2D, MaxUnPool3D,
 )
 from .layers.loss import (  # noqa: F401
-    BCELoss, BCEWithLogitsLoss, CrossEntropyLoss, CTCLoss, KLDivLoss,
-    L1Loss, MarginRankingLoss, MSELoss, NLLLoss, SigmoidFocalLoss,
-    SmoothL1Loss, TripletMarginLoss,
+    BCELoss, BCEWithLogitsLoss, CosineEmbeddingLoss, CrossEntropyLoss,
+    CTCLoss, GaussianNLLLoss, HingeEmbeddingLoss, HSigmoidLoss, KLDivLoss,
+    L1Loss, MarginRankingLoss, MSELoss, MultiLabelSoftMarginLoss,
+    MultiMarginLoss, NLLLoss, PoissonNLLLoss, RNNTLoss, SigmoidFocalLoss,
+    SmoothL1Loss, SoftMarginLoss, TripletMarginLoss,
+    TripletMarginWithDistanceLoss,
 )
 from .layers.transformer import (  # noqa: F401
     MultiHeadAttention, Transformer, TransformerDecoder,
@@ -45,5 +50,6 @@ from .layers.rnn import (  # noqa: F401
     SimpleRNNCell,
 )
 
-from .layers.common import PairwiseDistance  # noqa: F401,E402
+from .layers.common import PairwiseDistance, Unflatten  # noqa: F401,E402
+from .decode import BeamSearchDecoder, dynamic_decode  # noqa: F401,E402
 from . import utils  # noqa: F401,E402

@@ -182,3 +182,27 @@ def rrelu(x, lower=0.125, upper=0.3333333, training=True, name=None):
         return apply("rrelu", f, x)
     mid = (lower + upper) / 2.0
     return apply("rrelu_eval", lambda v: jnp.where(v >= 0, v, mid * v), x)
+
+
+# ---- inplace activation variants (reference nn/functional/activation.py
+# tanh_/hardtanh_/leaky_relu_/thresholded_relu_: rebind-and-return, see
+# ops/inplace.py for the TPU inplace contract) ----
+
+def tanh_(x, name=None):
+    x._become(tanh(x))
+    return x
+
+
+def hardtanh_(x, min=-1.0, max=1.0, name=None):  # noqa: A002
+    x._become(hardtanh(x, min, max))
+    return x
+
+
+def leaky_relu_(x, negative_slope=0.01, name=None):
+    x._become(leaky_relu(x, negative_slope))
+    return x
+
+
+def thresholded_relu_(x, threshold=1.0, name=None):
+    x._become(thresholded_relu(x, threshold))
+    return x

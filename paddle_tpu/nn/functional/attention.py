@@ -135,3 +135,97 @@ def flash_attn_unpadded(*args, **kwargs):
 
 def multi_head_attention_forward(*args, **kwargs):
     raise NotImplementedError
+
+
+def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
+                     key_padding_mask=None, attn_mask=None, name=None):
+    """Block/CSR-sparse attention (reference
+    nn/functional/sparse_attention.py; CUDA sparse_attention kernel).
+    query/key/value [B, H, S, D]; offset [B, H, S+1], columns [B, H, nnz]
+    in CSR over the [S, S] score matrix; key_padding_mask [B, S] and
+    attn_mask [S, S] use the reference's 0-means-masked convention.
+
+    TPU-native: the CSR pattern expands to a boolean mask and runs through
+    the XLA-fused dense softmax chain — on the MXU, a dense masked matmul
+    beats gather-based sparse math until extreme sparsity, and the
+    semantics (including fully-masked-row zeros) match the kernel."""
+    args = [_t(query), _t(key), _t(value), _t(sparse_csr_offset), _t(sparse_csr_columns)]
+    if key_padding_mask is not None:
+        args.append(_t(key_padding_mask))
+    if attn_mask is not None:
+        args.append(_t(attn_mask))
+    has_kpm = key_padding_mask is not None
+    has_am = attn_mask is not None
+
+    def f(q, k, v, offs, cols, *rest):
+        B, H, S, D = q.shape
+        nnz = cols.shape[-1]
+
+        def one_mask(off_bh, col_bh):
+            # row of each nnz element: searchsorted over the offset vector
+            rows = jnp.searchsorted(off_bh, jnp.arange(nnz), side="right") - 1
+            m = jnp.zeros((S, S), bool)
+            valid = jnp.arange(nnz) < off_bh[-1]
+            rows = jnp.clip(rows, 0, S - 1)
+            return m.at[rows, jnp.clip(col_bh, 0, S - 1)].max(valid)
+
+        mask = jax.vmap(jax.vmap(one_mask))(
+            offs.astype(jnp.int32), cols.astype(jnp.int32)
+        )  # [B, H, S, S]
+        ri = iter(rest)
+        if has_kpm:
+            kpm = next(ri)
+            mask = mask & (kpm[:, None, None, :] != 0)
+        if has_am:
+            am = next(ri)
+            mask = mask & (am[None, None, :, :] != 0)
+        scale = 1.0 / _math.sqrt(D)
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)) * scale
+        logits = jnp.where(mask, logits, -jnp.inf)
+        p = jax.nn.softmax(logits, axis=-1)
+        p = jnp.where(jnp.any(mask, -1, keepdims=True), p, 0.0)  # fully-masked rows -> 0
+        return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
+
+    return apply("sparse_attention", f, *args)
+
+
+def flash_attention_with_sparse_mask(query, key, value, attn_mask_start_row_indices,
+                                     attn_mask_start_row=0, dropout_p=0.0,
+                                     is_causal=False, training=True, name=None):
+    """Attention with a per-column row-start sparse mask (reference
+    nn/functional/flash_attention.py:547): for score column j, rows
+    i >= attn_mask_start_row_indices[b, h, j] are masked out (the packed-
+    sequence causal-block pattern). query/key/value [B, S, H, D]; indices
+    [B, H, S] int32. Runs through the XLA-fused masked chain; dropout uses
+    the framework RNG."""
+    from ...framework import random as random_mod
+
+    rng_key = random_mod.next_key() if (dropout_p > 0.0 and training) else None
+
+    def f(q, k, v, start_rows):
+        B, S, H, D = q.shape
+        qh = jnp.swapaxes(q, 1, 2)
+        kh = jnp.swapaxes(k, 1, 2)
+        vh = jnp.swapaxes(v, 1, 2)
+        scale = 1.0 / _math.sqrt(D)
+        logits = jnp.einsum("bhqd,bhkd->bhqk", qh.astype(jnp.float32), kh.astype(jnp.float32)) * scale
+        rows = jnp.arange(S)[None, None, :, None]
+        keep = rows < start_rows.astype(jnp.int32)[:, :, None, :]  # [B,H,S,S]
+        if is_causal:
+            cols = jnp.arange(S)[None, None, None, :]
+            keep = keep & (cols <= rows)
+        logits = jnp.where(keep, logits, -jnp.inf)
+        p = jax.nn.softmax(logits, axis=-1)
+        p = jnp.where(jnp.any(keep, -1, keepdims=True), p, 0.0)
+        if rng_key is not None:
+            import jax as _jax
+
+            mask = _jax.random.bernoulli(rng_key, 1.0 - dropout_p, p.shape)
+            p = jnp.where(mask, p / (1.0 - dropout_p), 0.0)
+        out = jnp.einsum("bhqk,bhkd->bhqd", p.astype(vh.dtype), vh)
+        return jnp.swapaxes(out, 1, 2)
+
+    return apply(
+        "flash_attention_with_sparse_mask", f,
+        _t(query), _t(key), _t(value), _t(attn_mask_start_row_indices),
+    )

@@ -473,12 +473,13 @@ def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
     """RNN-T transducer loss (loss.py rnnt_loss; the role of warprnnt in
     third_party): log-space forward DP alpha over (T, U) compiled as a
     lax.scan over time — O(T*U) memory, MXU-free but fully vectorized over
-    batch and label positions."""
-    if fastemit_lambda:
-        raise NotImplementedError(
-            "rnnt_loss: FastEmit regularization needs the beta DP (occupancy"
-            " weighting); not implemented — pass fastemit_lambda=0"
-        )
+    batch and label positions.
+
+    FastEmit (arXiv:2010.11148, the warp-transducer fork's semantics): the
+    LOSS VALUE is the standard -log p(y|x); the regularization scales the
+    label-arc (emit) gradients by (1+lambda) while blank-arc gradients are
+    untouched — realized here as a custom_vjp whose backward scales the
+    cotangent entries at the label positions of the logits."""
     input, label = _t(input), _t(label)
     input_lengths, label_lengths = _t(input_lengths), _t(label_lengths)
 
@@ -536,7 +537,38 @@ def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
             return jnp.sum(loss)
         return loss
 
-    return apply("rnnt_loss", f, input, label, input_lengths, label_lengths)
+    if not fastemit_lambda:
+        return apply("rnnt_loss", f, input, label, input_lengths, label_lengths)
+
+    lam = float(fastemit_lambda)
+
+    @jax.custom_vjp
+    def fe(logits, lb, tl, ul):
+        return f(logits, lb, tl, ul)
+
+    def fe_fwd(logits, lb, tl, ul):
+        out, vjp_fn = jax.vjp(lambda lg: f(lg, lb, tl, ul), logits)
+        return out, (vjp_fn, lb, logits.shape)
+
+    def fe_bwd(res, g):
+        vjp_fn, lb, shape = res
+        (dlogits,) = vjp_fn(g)
+        B, T, U1, V = shape
+        U = U1 - 1
+        # scale the emit-arc entries: position (b, t, u<U, v==label[b,u])
+        lbl = jnp.clip(lb, 0).astype(jnp.int32)          # [B, U]
+        onehot = jax.nn.one_hot(lbl, V, dtype=dlogits.dtype)  # [B, U, V]
+        scale = 1.0 + lam * onehot[:, None, :, :]        # [B, 1, U, V]
+        scale = jnp.concatenate(
+            [scale, jnp.ones((B, 1, 1, V), dlogits.dtype)], axis=2)  # u = U row
+        return (dlogits * scale, None, None, None)
+
+    fe.defvjp(fe_fwd, fe_bwd)
+    return apply(
+        "rnnt_loss_fastemit",
+        lambda lg, lb, tl, ul: fe(lg, lb, tl, ul),
+        input, label, input_lengths, label_lengths,
+    )
 
 
 def edit_distance(input, label, normalized=True, ignored_tokens=None,
@@ -572,3 +604,119 @@ def edit_distance(input, label, normalized=True, ignored_tokens=None,
             d = d / max(n, 1)
         out[i, 0] = d
     return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(np.array([N], np.int64)))
+
+
+# ---------------------------------------------------------------------------
+# r3 loss-surface completion (namespace parity audit)
+# ---------------------------------------------------------------------------
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6, reduction="mean", name=None):  # noqa: A002
+    """Gaussian negative log likelihood (reference nn/functional/loss.py
+    gaussian_nll_loss): 0.5*(log(max(var,eps)) + (x-y)^2/max(var,eps))."""
+    def f(x, y, var):
+        var = jnp.maximum(var, epsilon)
+        per = 0.5 * (jnp.log(var) + (x - y) ** 2 / var)
+        if full:
+            per = per + 0.5 * float(np.log(2 * np.pi))
+        return _reduce(per, reduction)
+
+    return apply("gaussian_nll_loss", f, _t(input), _t(label), _t(variance))
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8, reduction="mean", name=None):  # noqa: A002
+    """Poisson NLL (reference poisson_nll_loss): exp(x)-y*x (log-space input)
+    or x - y*log(x+eps); `full` adds the Stirling approximation."""
+    def f(x, y):
+        if log_input:
+            per = jnp.exp(x) - y * x
+        else:
+            per = x - y * jnp.log(x + epsilon)
+        if full:
+            stirling = y * jnp.log(y) - y + 0.5 * jnp.log(2 * np.pi * y)
+            per = per + jnp.where(y > 1, stirling, 0.0)
+        return _reduce(per, reduction)
+
+    return apply("poisson_nll_loss", f, _t(input), _t(label))
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    """log(1 + exp(-y*x)) (reference soft_margin_loss)."""
+    def f(x, y):
+        z = -y.astype(x.dtype) * x
+        per = jnp.log1p(jnp.exp(-jnp.abs(z))) + jnp.maximum(z, 0.0)  # stable log1p(exp(z))
+        return _reduce(per, reduction)
+
+    return apply("soft_margin_loss", f, _t(input), _t(label))
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean", name=None):  # noqa: A002
+    """Per-class sigmoidal BCE averaged over classes (reference
+    multi_label_soft_margin_loss)."""
+    args = [_t(input), _t(label)] + ([_t(weight)] if weight is not None else [])
+
+    def f(x, y, *rest):
+        logsig = jax.nn.log_sigmoid
+        per = -(y * logsig(x) + (1 - y) * logsig(-x))
+        if rest:
+            per = per * rest[0]
+        per = jnp.mean(per, axis=-1)
+        return _reduce(per, reduction)
+
+    return apply("multi_label_soft_margin_loss", f, *args)
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None, reduction="mean", name=None):  # noqa: A002
+    """Multi-class margin hinge (reference multi_margin_loss):
+    sum_j!=y max(0, margin - x_y + x_j)^p / C."""
+    args = [_t(input), _t(label)] + ([_t(weight)] if weight is not None else [])
+
+    def f(x, y, *rest):
+        n, c = x.shape
+        xy = jnp.take_along_axis(x, y[:, None].astype(jnp.int32), axis=1)  # [N,1]
+        m = jnp.maximum(0.0, margin - xy + x) ** p
+        onehot = jax.nn.one_hot(y, c, dtype=x.dtype)
+        m = m * (1 - onehot)
+        if rest:
+            m = m * rest[0][y][:, None]
+        per = jnp.sum(m, axis=1) / c
+        return _reduce(per, reduction)
+
+    return apply("multi_margin_loss", f, *args)
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    """||x - y + eps||_p along the last axis (reference
+    nn/functional/distance.py pairwise_distance)."""
+    def f(a, b):
+        d = a - b + epsilon
+        if p == float("inf"):
+            out = jnp.max(jnp.abs(d), axis=-1, keepdims=keepdim)
+        elif p == float("-inf"):
+            out = jnp.min(jnp.abs(d), axis=-1, keepdims=keepdim)
+        elif p == 0:
+            out = jnp.sum((d != 0).astype(a.dtype), axis=-1, keepdims=keepdim)
+        else:
+            out = jnp.sum(jnp.abs(d) ** p, axis=-1, keepdims=keepdim) ** (1.0 / p)
+        return out
+
+    return apply("pairwise_distance", f, _t(x), _t(y))
+
+
+def triplet_margin_with_distance_loss(input, positive, negative, distance_function=None, margin=1.0, swap=False, reduction="mean", name=None):  # noqa: A002
+    """Triplet loss with a caller-supplied distance (reference
+    triplet_margin_with_distance_loss); default distance = pairwise L2."""
+    dist = distance_function if distance_function is not None else (
+        lambda a, b: pairwise_distance(a, b, p=2.0)
+    )
+    a, pos, neg = _t(input), _t(positive), _t(negative)
+    dp = _t(dist(a, pos))
+    dn = _t(dist(a, neg))
+    if swap:
+        from ...ops import math as _m
+
+        dn = _m.minimum(dn, _t(dist(pos, neg)))
+
+    def f(dpv, dnv):
+        return _reduce(jnp.maximum(dpv - dnv + margin, 0.0), reduction)
+
+    return apply("triplet_margin_with_distance_loss", f, dp, dn)

@@ -75,3 +75,16 @@ class PReLU(Layer):
 
     def forward(self, x):
         return F.prelu(x, self.weight, data_format=self._data_format)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel axis of NCHW / CHW inputs
+    (reference nn/layer/activation.py Softmax2D)."""
+
+    def __init__(self, name=None):
+        super().__init__()
+
+    def forward(self, x):
+        if x.ndim not in (3, 4):
+            raise ValueError(f"Softmax2D expects 3-D or 4-D input, got {x.ndim}-D")
+        return F.softmax(x, axis=-3)

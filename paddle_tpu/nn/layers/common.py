@@ -264,19 +264,17 @@ class PairwiseDistance(Layer):
         self.keepdim = keepdim
 
     def forward(self, x, y):
-        from ...core.apply import apply
-        from jax import numpy as jnp
+        return F.pairwise_distance(x, y, self.p, self.epsilon, self.keepdim)
 
-        p, keepdim, eps = self.p, self.keepdim, self.epsilon
 
-        def fn(a, b):
-            d = jnp.abs(a - b + eps)
-            if p == float("inf"):
-                return jnp.max(d, axis=-1, keepdims=keepdim)
-            if p == float("-inf"):
-                return jnp.min(d, axis=-1, keepdims=keepdim)
-            if p == 0:
-                return jnp.sum((d != 0).astype(d.dtype), axis=-1, keepdims=keepdim)
-            return jnp.sum(d**p, axis=-1, keepdims=keepdim) ** (1.0 / p)
+class Unflatten(Layer):
+    """Expand one axis into a shape (reference nn/layer/common.py Unflatten)."""
 
-        return apply("pairwise_distance", fn, x, y)
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self.axis, self.shape_arg = axis, shape
+
+    def forward(self, x):
+        from ...ops.manipulation import unflatten as _unflatten
+
+        return _unflatten(x, self.axis, self.shape_arg)

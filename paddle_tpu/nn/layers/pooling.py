@@ -48,3 +48,55 @@ AdaptiveAvgPool3D = _adaptive_pool_layer("adaptive_avg_pool3d")
 AdaptiveMaxPool1D = _adaptive_pool_layer("adaptive_max_pool1d")
 AdaptiveMaxPool2D = _adaptive_pool_layer("adaptive_max_pool2d")
 AdaptiveMaxPool3D = _adaptive_pool_layer("adaptive_max_pool3d")
+
+
+# ---------------------------------------------------------------------------
+# r3 pooling layers (namespace parity audit; reference nn/layer/pooling.py)
+# ---------------------------------------------------------------------------
+
+class MaxUnPool1D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, data_format="NCL", output_size=None, name=None):
+        super().__init__()
+        self.args = (kernel_size, stride, padding, data_format, output_size)
+
+    def forward(self, x, indices):
+        k, s, p, df, osz = self.args
+        return F.max_unpool1d(x, indices, k, s, p, data_format=df, output_size=osz)
+
+
+class MaxUnPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, data_format="NCHW", output_size=None, name=None):
+        super().__init__()
+        self.args = (kernel_size, stride, padding, data_format, output_size)
+
+    def forward(self, x, indices):
+        k, s, p, df, osz = self.args
+        return F.max_unpool2d(x, indices, k, s, p, data_format=df, output_size=osz)
+
+
+class MaxUnPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, data_format="NCDHW", output_size=None, name=None):
+        super().__init__()
+        self.args = (kernel_size, stride, padding, data_format, output_size)
+
+    def forward(self, x, indices):
+        k, s, p, df, osz = self.args
+        return F.max_unpool3d(x, indices, k, s, p, data_format=df, output_size=osz)
+
+
+class FractionalMaxPool2D(Layer):
+    def __init__(self, output_size, kernel_size=None, random_u=None, return_mask=False, name=None):
+        super().__init__()
+        self.args = (output_size, kernel_size, random_u, return_mask)
+
+    def forward(self, x):
+        return F.fractional_max_pool2d(x, *self.args)
+
+
+class FractionalMaxPool3D(Layer):
+    def __init__(self, output_size, kernel_size=None, random_u=None, return_mask=False, name=None):
+        super().__init__()
+        self.args = (output_size, kernel_size, random_u, return_mask)
+
+    def forward(self, x):
+        return F.fractional_max_pool3d(x, *self.args)

@@ -227,3 +227,28 @@ def test_sparse_cast_index_dtype():
     c = paddle.sparse.cast(s, index_dtype="int32", value_dtype="float64")
     assert str(c._mat.indices.dtype) == "int32"
     assert c.values().numpy().dtype == np.float64
+
+
+def test_hermitian_fft_2d_nd_vs_torch():
+    """hfft2/hfftn/ihfft2/ihfftn vs the torch oracle, all norms (r3
+    namespace-parity: reference python/paddle/fft.py)."""
+    import torch
+
+    rng = np.random.RandomState(0)
+    x = (rng.randn(4, 5) + 1j * rng.randn(4, 5)).astype(np.complex64)
+    for norm in ("backward", "forward", "ortho"):
+        ours = paddle.fft.hfft2(paddle.to_tensor(x), norm=norm).numpy()
+        ref = torch.fft.hfft2(torch.from_numpy(x), norm=norm).numpy()
+        np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-4)
+
+        o2 = paddle.fft.ihfft2(paddle.to_tensor(ref), norm=norm).numpy()
+        r2 = torch.fft.ihfft2(torch.from_numpy(ref), norm=norm).numpy()
+        np.testing.assert_allclose(o2, r2, rtol=1e-4, atol=1e-4)
+
+        o3 = paddle.fft.hfftn(paddle.to_tensor(x), norm=norm).numpy()
+        r3 = torch.fft.hfftn(torch.from_numpy(x), norm=norm).numpy()
+        np.testing.assert_allclose(o3, r3, rtol=1e-4, atol=1e-4)
+
+        o4 = paddle.fft.ihfftn(paddle.to_tensor(r3.astype(np.float32)), norm=norm).numpy()
+        r4 = torch.fft.ihfftn(torch.from_numpy(r3.astype(np.float32)), norm=norm).numpy()
+        np.testing.assert_allclose(o4, r4, rtol=1e-4, atol=1e-4)

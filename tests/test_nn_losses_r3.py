@@ -1,0 +1,222 @@
+"""r3 loss-surface completion vs the torch oracle (namespace parity audit;
+reference python/paddle/nn/functional/loss.py + nn/layer/loss.py)."""
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+R = np.random.RandomState(3)
+X = R.randn(5, 7).astype("float32")
+Y = R.randn(5, 7).astype("float32")
+BIN = (R.rand(5, 7) > 0.5).astype("float32")
+SGN = np.where(R.rand(5, 7) > 0.5, 1.0, -1.0).astype("float32")
+LBL = R.randint(0, 7, (5,)).astype("int64")
+
+
+def _chk(ours, theirs, rtol=1e-4, atol=1e-5):
+    np.testing.assert_allclose(float(ours.numpy()), float(theirs.numpy()), rtol=rtol, atol=atol)
+
+
+@pytest.mark.parametrize("reduction", ["mean", "sum"])
+def test_gaussian_nll_loss(reduction):
+    var = (np.abs(Y) + 0.1).astype("float32")
+    for full in (False, True):
+        ours = F.gaussian_nll_loss(paddle.to_tensor(X), paddle.to_tensor(Y),
+                                   paddle.to_tensor(var), full=full, reduction=reduction)
+        ref = torch.nn.functional.gaussian_nll_loss(
+            torch.from_numpy(X), torch.from_numpy(Y), torch.from_numpy(var),
+            full=full, reduction=reduction)
+        _chk(ours, ref)
+
+
+@pytest.mark.parametrize("log_input", [True, False])
+def test_poisson_nll_loss(log_input):
+    tgt = np.abs(Y).astype("float32") + 0.5
+    for full in (False, True):
+        ours = F.poisson_nll_loss(paddle.to_tensor(X), paddle.to_tensor(tgt),
+                                  log_input=log_input, full=full)
+        ref = torch.nn.functional.poisson_nll_loss(
+            torch.from_numpy(X), torch.from_numpy(tgt), log_input=log_input, full=full)
+        _chk(ours, ref)
+
+
+def test_soft_margin_loss():
+    ours = F.soft_margin_loss(paddle.to_tensor(X), paddle.to_tensor(SGN))
+    ref = torch.nn.functional.soft_margin_loss(torch.from_numpy(X), torch.from_numpy(SGN))
+    _chk(ours, ref)
+    layer = nn.SoftMarginLoss(reduction="sum")
+    ours2 = layer(paddle.to_tensor(X), paddle.to_tensor(SGN))
+    ref2 = torch.nn.functional.soft_margin_loss(torch.from_numpy(X), torch.from_numpy(SGN), reduction="sum")
+    _chk(ours2, ref2)
+
+
+def test_multi_label_soft_margin_loss():
+    ours = F.multi_label_soft_margin_loss(paddle.to_tensor(X), paddle.to_tensor(BIN))
+    ref = torch.nn.functional.multilabel_soft_margin_loss(torch.from_numpy(X), torch.from_numpy(BIN))
+    _chk(ours, ref)
+
+
+@pytest.mark.parametrize("p", [1, 2])
+def test_multi_margin_loss(p):
+    ours = F.multi_margin_loss(paddle.to_tensor(X), paddle.to_tensor(LBL), p=p)
+    ref = torch.nn.functional.multi_margin_loss(torch.from_numpy(X), torch.from_numpy(LBL), p=p)
+    _chk(ours, ref)
+
+
+def test_pairwise_distance():
+    ours = F.pairwise_distance(paddle.to_tensor(X), paddle.to_tensor(Y))
+    ref = torch.nn.functional.pairwise_distance(torch.from_numpy(X), torch.from_numpy(Y))
+    np.testing.assert_allclose(ours.numpy(), ref.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_triplet_margin_with_distance_loss():
+    a, pos, neg = X, Y, R.randn(5, 7).astype("float32")
+    ours = F.triplet_margin_with_distance_loss(
+        paddle.to_tensor(a), paddle.to_tensor(pos), paddle.to_tensor(neg))
+    ref = torch.nn.functional.triplet_margin_with_distance_loss(
+        torch.from_numpy(a), torch.from_numpy(pos), torch.from_numpy(neg))
+    _chk(ours, ref)
+    # custom distance + swap, against a hand-rolled oracle
+    ours2 = F.triplet_margin_with_distance_loss(
+        paddle.to_tensor(a), paddle.to_tensor(pos), paddle.to_tensor(neg),
+        distance_function=lambda u, v: ((u - v) ** 2).sum(-1), swap=True)
+    dp = ((a - pos) ** 2).sum(-1)
+    dn = np.minimum(((a - neg) ** 2).sum(-1), ((pos - neg) ** 2).sum(-1))
+    want = np.maximum(dp - dn + 1.0, 0).mean()
+    np.testing.assert_allclose(float(ours2.numpy()), want, rtol=1e-4)
+
+
+def test_loss_layers_smoke_and_grad():
+    lay = nn.GaussianNLLLoss()
+    x = paddle.to_tensor(X)
+    x.stop_gradient = False
+    var = paddle.to_tensor((np.abs(Y) + 0.1).astype("float32"))
+    loss = lay(x, paddle.to_tensor(Y), var)
+    loss.backward()
+    assert x.grad is not None and np.isfinite(x.grad.numpy()).all()
+
+    for layer, args in [
+        (nn.PoissonNLLLoss(), (paddle.to_tensor(X), paddle.to_tensor(np.abs(Y) + 0.5))),
+        (nn.HingeEmbeddingLoss(), (paddle.to_tensor(X), paddle.to_tensor(SGN))),
+        (nn.CosineEmbeddingLoss(), (paddle.to_tensor(X), paddle.to_tensor(Y), paddle.to_tensor(SGN[:, 0]))),
+        (nn.MultiLabelSoftMarginLoss(), (paddle.to_tensor(X), paddle.to_tensor(BIN))),
+        (nn.MultiMarginLoss(), (paddle.to_tensor(X), paddle.to_tensor(LBL))),
+        (nn.TripletMarginWithDistanceLoss(), (paddle.to_tensor(X), paddle.to_tensor(Y), paddle.to_tensor(Y + 1))),
+    ]:
+        out = layer(*args)
+        assert np.isfinite(float(out.numpy()))
+
+
+def test_hsigmoid_rnnt_layers():
+    paddle.seed(0)
+    lay = nn.HSigmoidLoss(feature_size=7, num_classes=6)
+    assert tuple(lay.weight.shape) == (5, 7) and tuple(lay.bias.shape) == (5, 1)
+    out = lay(paddle.to_tensor(X), paddle.to_tensor(LBL % 6))
+    assert out.shape[0] == 5 and np.isfinite(out.numpy()).all()
+
+    B, T, U, V = 2, 4, 3, 5
+    logits = R.randn(B, T, U, V).astype("float32")
+    labels = R.randint(1, V, (B, U - 1)).astype("int32")
+    lay2 = nn.RNNTLoss()
+    loss = lay2(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                paddle.to_tensor(np.full((B,), T, "int32")),
+                paddle.to_tensor(np.full((B,), U - 1, "int32")))
+    assert np.isfinite(float(loss.numpy()))
+
+
+def test_pool_unpool_layers_roundtrip():
+    x = paddle.to_tensor(R.randn(1, 2, 6, 6).astype("float32"))
+    pooled, idx = F.max_pool2d(x, 2, 2, return_mask=True)
+    unpool = nn.MaxUnPool2D(2, 2)
+    rec = unpool(pooled, idx)
+    assert tuple(rec.shape) == (1, 2, 6, 6)
+    # every pooled max lands back; everything else zero
+    assert np.allclose(np.sort(rec.numpy()[rec.numpy() != 0]), np.sort(pooled.numpy().ravel()))
+
+    fr = nn.FractionalMaxPool2D(output_size=3)
+    out = fr(x)
+    assert tuple(out.shape) == (1, 2, 3, 3)
+
+
+def test_softmax2d_unflatten_layers():
+    x = paddle.to_tensor(R.randn(2, 3, 4, 5).astype("float32"))
+    out = nn.Softmax2D()(x)
+    np.testing.assert_allclose(out.numpy().sum(1), np.ones((2, 4, 5)), rtol=1e-5)
+    with pytest.raises(ValueError):
+        nn.Softmax2D()(paddle.to_tensor(X))
+
+    u = nn.Unflatten(1, [2, 2])(paddle.to_tensor(R.randn(3, 4).astype("float32")))
+    assert tuple(u.shape) == (3, 2, 2)
+
+
+def test_inplace_functional_activations():
+    x = paddle.to_tensor(X.copy())
+    r = F.tanh_(x)
+    assert r is x
+    np.testing.assert_allclose(x.numpy(), np.tanh(X), rtol=1e-6)
+    x2 = paddle.to_tensor(X.copy())
+    F.leaky_relu_(x2, 0.1)
+    np.testing.assert_allclose(x2.numpy(), np.where(X > 0, X, 0.1 * X), rtol=1e-6)
+    x3 = paddle.to_tensor(X.copy())
+    F.hardtanh_(x3)
+    np.testing.assert_allclose(x3.numpy(), np.clip(X, -1, 1), rtol=1e-6)
+    x4 = paddle.to_tensor(X.copy())
+    F.thresholded_relu_(x4, 0.5)
+    np.testing.assert_allclose(x4.numpy(), np.where(X > 0.5, X, 0.0), rtol=1e-6)
+
+
+def test_sparse_attention_vs_dense_oracle():
+    B, H, S, D = 1, 2, 6, 4
+    q = R.randn(B, H, S, D).astype("float32")
+    k = R.randn(B, H, S, D).astype("float32")
+    v = R.randn(B, H, S, D).astype("float32")
+    # banded CSR: row i attends to {i-1, i}
+    offs, cols = [], []
+    for h in range(H):
+        off, col = [0], []
+        for i in range(S):
+            cs = [j for j in (i - 1, i) if j >= 0]
+            col += cs
+            off.append(len(col))
+        offs.append(off)
+        cols.append(col)
+    offs = np.asarray([offs], np.int32)
+    cols = np.asarray([cols], np.int32)
+
+    out = F.sparse_attention(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+        paddle.to_tensor(offs), paddle.to_tensor(cols)).numpy()
+
+    for h in range(H):
+        lg = q[0, h] @ k[0, h].T / np.sqrt(D)
+        mask = np.zeros((S, S), bool)
+        for i in range(S):
+            for j in (i - 1, i):
+                if j >= 0:
+                    mask[i, j] = True
+        lg = np.where(mask, lg, -np.inf)
+        p = np.exp(lg - lg.max(-1, keepdims=True)); p /= p.sum(-1, keepdims=True)
+        np.testing.assert_allclose(out[0, h], p @ v[0, h], rtol=2e-4, atol=2e-5)
+
+
+def test_flash_attention_with_sparse_mask_semantics():
+    B, S, H, D = 1, 5, 2, 4
+    q = R.randn(B, S, H, D).astype("float32")
+    k = R.randn(B, S, H, D).astype("float32")
+    v = R.randn(B, S, H, D).astype("float32")
+    # column j visible to rows < start[j]
+    start = np.asarray([[[3, 4, 5, 2, 5], [5, 5, 1, 5, 5]]], np.int32)  # [B,H,S]
+    out = F.flash_attention_with_sparse_mask(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+        paddle.to_tensor(start)).numpy()
+    for h in range(2):
+        lg = q[0, :, h] @ k[0, :, h].T / np.sqrt(D)
+        keep = np.arange(S)[:, None] < start[0, h][None, :]
+        lg = np.where(keep, lg, -np.inf)
+        with np.errstate(invalid="ignore"):
+            p = np.exp(lg - lg.max(-1, keepdims=True))
+            p = np.nan_to_num(p / p.sum(-1, keepdims=True))
+        np.testing.assert_allclose(out[0, :, h], p @ v[0, :, h], rtol=2e-4, atol=2e-5)
