@@ -1,0 +1,166 @@
+"""InceptionV3 (reference python/paddle/vision/models/inceptionv3.py).
+
+The five inception block families (A, B, C, D, E) with the reference's
+channel tables; aux head omitted at inference parity (the reference only
+uses it in training-with-aux configs, default off).
+"""
+from __future__ import annotations
+
+from ... import concat, nn
+
+
+class _ConvBN(nn.Layer):
+    def __init__(self, c_in, c_out, k, stride=1, padding=0):
+        super().__init__()
+        self.conv = nn.Conv2D(c_in, c_out, k, stride=stride, padding=padding, bias_attr=False)
+        self.bn = nn.BatchNorm2D(c_out)
+
+    def forward(self, x):
+        return nn.functional.relu(self.bn(self.conv(x)))
+
+
+class _InceptionA(nn.Layer):
+    def __init__(self, c_in, pool_features):
+        super().__init__()
+        self.b1 = _ConvBN(c_in, 64, 1)
+        self.b5_1 = _ConvBN(c_in, 48, 1)
+        self.b5_2 = _ConvBN(48, 64, 5, padding=2)
+        self.b3_1 = _ConvBN(c_in, 64, 1)
+        self.b3_2 = _ConvBN(64, 96, 3, padding=1)
+        self.b3_3 = _ConvBN(96, 96, 3, padding=1)
+        self.pool = nn.AvgPool2D(3, stride=1, padding=1)
+        self.bp = _ConvBN(c_in, pool_features, 1)
+
+    def forward(self, x):
+        return concat([
+            self.b1(x),
+            self.b5_2(self.b5_1(x)),
+            self.b3_3(self.b3_2(self.b3_1(x))),
+            self.bp(self.pool(x)),
+        ], axis=1)
+
+
+class _InceptionB(nn.Layer):
+    def __init__(self, c_in):
+        super().__init__()
+        self.b3 = _ConvBN(c_in, 384, 3, stride=2)
+        self.b3d_1 = _ConvBN(c_in, 64, 1)
+        self.b3d_2 = _ConvBN(64, 96, 3, padding=1)
+        self.b3d_3 = _ConvBN(96, 96, 3, stride=2)
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return concat([
+            self.b3(x), self.b3d_3(self.b3d_2(self.b3d_1(x))), self.pool(x)
+        ], axis=1)
+
+
+class _InceptionC(nn.Layer):
+    def __init__(self, c_in, c7):
+        super().__init__()
+        self.b1 = _ConvBN(c_in, 192, 1)
+        self.b7_1 = _ConvBN(c_in, c7, 1)
+        self.b7_2 = _ConvBN(c7, c7, (1, 7), padding=(0, 3))
+        self.b7_3 = _ConvBN(c7, 192, (7, 1), padding=(3, 0))
+        self.b7d_1 = _ConvBN(c_in, c7, 1)
+        self.b7d_2 = _ConvBN(c7, c7, (7, 1), padding=(3, 0))
+        self.b7d_3 = _ConvBN(c7, c7, (1, 7), padding=(0, 3))
+        self.b7d_4 = _ConvBN(c7, c7, (7, 1), padding=(3, 0))
+        self.b7d_5 = _ConvBN(c7, 192, (1, 7), padding=(0, 3))
+        self.pool = nn.AvgPool2D(3, stride=1, padding=1)
+        self.bp = _ConvBN(c_in, 192, 1)
+
+    def forward(self, x):
+        return concat([
+            self.b1(x),
+            self.b7_3(self.b7_2(self.b7_1(x))),
+            self.b7d_5(self.b7d_4(self.b7d_3(self.b7d_2(self.b7d_1(x))))),
+            self.bp(self.pool(x)),
+        ], axis=1)
+
+
+class _InceptionD(nn.Layer):
+    def __init__(self, c_in):
+        super().__init__()
+        self.b3_1 = _ConvBN(c_in, 192, 1)
+        self.b3_2 = _ConvBN(192, 320, 3, stride=2)
+        self.b7_1 = _ConvBN(c_in, 192, 1)
+        self.b7_2 = _ConvBN(192, 192, (1, 7), padding=(0, 3))
+        self.b7_3 = _ConvBN(192, 192, (7, 1), padding=(3, 0))
+        self.b7_4 = _ConvBN(192, 192, 3, stride=2)
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return concat([
+            self.b3_2(self.b3_1(x)),
+            self.b7_4(self.b7_3(self.b7_2(self.b7_1(x)))),
+            self.pool(x),
+        ], axis=1)
+
+
+class _InceptionE(nn.Layer):
+    def __init__(self, c_in):
+        super().__init__()
+        self.b1 = _ConvBN(c_in, 320, 1)
+        self.b3_1 = _ConvBN(c_in, 384, 1)
+        self.b3_2a = _ConvBN(384, 384, (1, 3), padding=(0, 1))
+        self.b3_2b = _ConvBN(384, 384, (3, 1), padding=(1, 0))
+        self.b3d_1 = _ConvBN(c_in, 448, 1)
+        self.b3d_2 = _ConvBN(448, 384, 3, padding=1)
+        self.b3d_3a = _ConvBN(384, 384, (1, 3), padding=(0, 1))
+        self.b3d_3b = _ConvBN(384, 384, (3, 1), padding=(1, 0))
+        self.pool = nn.AvgPool2D(3, stride=1, padding=1)
+        self.bp = _ConvBN(c_in, 192, 1)
+
+    def forward(self, x):
+        b3 = self.b3_1(x)
+        b3d = self.b3d_2(self.b3d_1(x))
+        return concat([
+            self.b1(x),
+            concat([self.b3_2a(b3), self.b3_2b(b3)], axis=1),
+            concat([self.b3d_3a(b3d), self.b3d_3b(b3d)], axis=1),
+            self.bp(self.pool(x)),
+        ], axis=1)
+
+
+class InceptionV3(nn.Layer):
+    """reference inceptionv3.py InceptionV3."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            _ConvBN(3, 32, 3, stride=2),
+            _ConvBN(32, 32, 3),
+            _ConvBN(32, 64, 3, padding=1),
+            nn.MaxPool2D(3, stride=2),
+            _ConvBN(64, 80, 1),
+            _ConvBN(80, 192, 3),
+            nn.MaxPool2D(3, stride=2),
+        )
+        self.blocks = nn.Sequential(
+            _InceptionA(192, 32), _InceptionA(256, 64), _InceptionA(288, 64),
+            _InceptionB(288),
+            _InceptionC(768, 128), _InceptionC(768, 160),
+            _InceptionC(768, 160), _InceptionC(768, 192),
+            _InceptionD(768),
+            _InceptionE(1280), _InceptionE(2048),
+        )
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.5)
+            self.fc = nn.Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(x.flatten(1)))
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    return InceptionV3(**kwargs)

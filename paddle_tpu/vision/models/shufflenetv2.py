@@ -13,36 +13,36 @@ def channel_shuffle(x, groups):
     return reshape(x, [b, c, h, w])
 
 
-def _conv_bn(c_in, c_out, k, stride=1, padding=0, groups=1, act=True):
+def _conv_bn(c_in, c_out, k, stride=1, padding=0, groups=1, act=True, act_name="relu"):
     layers = [
         nn.Conv2D(c_in, c_out, k, stride=stride, padding=padding, groups=groups, bias_attr=False),
         nn.BatchNorm2D(c_out),
     ]
     if act:
-        layers.append(nn.ReLU())
+        layers.append(nn.Swish() if act_name == "swish" else nn.ReLU())
     return nn.Sequential(*layers)
 
 
 class InvertedResidual(nn.Layer):
-    def __init__(self, c_in, c_out, stride):
+    def __init__(self, c_in, c_out, stride, act="relu"):
         super().__init__()
         self.stride = stride
         branch = c_out // 2
         if stride == 1:
             self.branch2 = nn.Sequential(
-                _conv_bn(c_in // 2, branch, 1),
+                _conv_bn(c_in // 2, branch, 1, act_name=act),
                 _conv_bn(branch, branch, 3, stride, 1, groups=branch, act=False),
-                _conv_bn(branch, branch, 1),
+                _conv_bn(branch, branch, 1, act_name=act),
             )
         else:
             self.branch1 = nn.Sequential(
                 _conv_bn(c_in, c_in, 3, stride, 1, groups=c_in, act=False),
-                _conv_bn(c_in, branch, 1),
+                _conv_bn(c_in, branch, 1, act_name=act),
             )
             self.branch2 = nn.Sequential(
-                _conv_bn(c_in, branch, 1),
+                _conv_bn(c_in, branch, 1, act_name=act),
                 _conv_bn(branch, branch, 3, stride, 1, groups=branch, act=False),
-                _conv_bn(branch, branch, 1),
+                _conv_bn(branch, branch, 1, act_name=act),
             )
 
     def forward(self, x):
@@ -58,6 +58,7 @@ class InvertedResidual(nn.Layer):
 
 _STAGE_OUT = {
     0.25: [24, 24, 48, 96, 512],
+    0.33: [24, 32, 64, 128, 512],
     0.5: [24, 48, 96, 192, 1024],
     1.0: [24, 116, 232, 464, 1024],
     1.5: [24, 176, 352, 704, 1024],
@@ -70,17 +71,17 @@ class ShuffleNetV2(nn.Layer):
         super().__init__()
         stage_repeats = [4, 8, 4]
         stage_out = _STAGE_OUT[scale]
-        self.conv1 = _conv_bn(3, stage_out[0], 3, 2, 1)
+        self.conv1 = _conv_bn(3, stage_out[0], 3, 2, 1, act_name=act)
         self.maxpool = nn.MaxPool2D(3, stride=2, padding=1)
         blocks = []
         c_in = stage_out[0]
         for stage_i, repeats in enumerate(stage_repeats):
             c_out = stage_out[stage_i + 1]
             for i in range(repeats):
-                blocks.append(InvertedResidual(c_in, c_out, stride=2 if i == 0 else 1))
+                blocks.append(InvertedResidual(c_in, c_out, stride=2 if i == 0 else 1, act=act))
                 c_in = c_out
         self.blocks = nn.Sequential(*blocks)
-        self.conv5 = _conv_bn(c_in, stage_out[-1], 1)
+        self.conv5 = _conv_bn(c_in, stage_out[-1], 1, act_name=act)
         self.with_pool = with_pool
         self.num_classes = num_classes
         if with_pool:
@@ -117,3 +118,11 @@ def shufflenet_v2_x1_5(pretrained=False, **kwargs):
 
 def shufflenet_v2_x2_0(pretrained=False, **kwargs):
     return ShuffleNetV2(scale=2.0, **kwargs)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=0.33, **kwargs)
+
+
+def shufflenet_v2_swish(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=1.0, act="swish", **kwargs)

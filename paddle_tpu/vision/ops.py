@@ -727,3 +727,140 @@ def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
     return rois_t, probs_t
 
 
+
+
+# ---------------------------------------------------------------------------
+# r3 vision-ops completion (namespace parity audit)
+# ---------------------------------------------------------------------------
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """Position-sensitive RoI pooling (reference vision/ops.py psroi_pool;
+    R-FCN): input channels C = out_c * ph * pw; output bin (i, j) average-
+    pools its OWN channel group over the bin's spatial window."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    bn = _v(boxes_num) if boxes_num is not None else None
+
+    def fn(xv, bv):
+        n, c, h, w = xv.shape
+        if c % (ph * pw):
+            raise ValueError(
+                f"psroi_pool: input channels ({c}) must be divisible by "
+                f"output_size^2 ({ph}*{pw})")
+        out_c = c // (ph * pw)
+        r = bv.shape[0]
+        if bn is not None:
+            img_idx = jnp.repeat(jnp.arange(n), np.asarray(bn), total_repeat_length=r)
+        else:
+            img_idx = jnp.zeros((r,), jnp.int32)
+
+        def one(roi, ii):
+            x1, y1, x2, y2 = roi * spatial_scale
+            rh = jnp.maximum(y2 - y1, 0.1) / ph
+            rw = jnp.maximum(x2 - x1, 0.1) / pw
+            img = xv[ii]                                    # [C, H, W]
+            grid = img.reshape(out_c, ph, pw, h, w)
+            ys = jnp.arange(h, dtype=jnp.float32)[:, None]
+            xs = jnp.arange(w, dtype=jnp.float32)[None, :]
+            outs = []
+            for i in range(ph):
+                for j in range(pw):
+                    y_lo = y1 + i * rh
+                    y_hi = y1 + (i + 1) * rh
+                    x_lo = x1 + j * rw
+                    x_hi = x1 + (j + 1) * rw
+                    m = ((ys >= jnp.floor(y_lo)) & (ys < jnp.ceil(y_hi))
+                         & (xs >= jnp.floor(x_lo)) & (xs < jnp.ceil(x_hi)))
+                    denom = jnp.maximum(jnp.sum(m), 1.0)
+                    outs.append(jnp.sum(grid[:, i, j] * m[None], axis=(-2, -1)) / denom)
+            return jnp.stack(outs, -1).reshape(out_c, ph, pw)
+
+        return jax.vmap(one)(bv.astype(jnp.float32), img_idx)
+
+    return apply("psroi_pool", fn, _t(x), _t(boxes))
+
+
+from ..nn.layer import Layer as _Layer  # noqa: E402  (nn.layer has no import cycle with ops)
+
+
+class RoIAlign(_Layer):
+    """Layer form of roi_align (reference vision/ops.py RoIAlign)."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num, aligned=True):
+        return roi_align(x, boxes, boxes_num, self.output_size, self.spatial_scale, aligned=aligned)
+
+
+class RoIPool(_Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self.output_size, self.spatial_scale)
+
+
+class PSRoIPool(_Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self.output_size, self.spatial_scale)
+
+
+class DeformConv2D(_Layer):
+    """Layer form of deform_conv2d owning weight/bias (reference
+    vision/ops.py DeformConv2D). A real nn.Layer: its parameters register
+    with parent layers, optimizers and state_dict."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 dilation=1, deformable_groups=1, groups=1, weight_attr=None, bias_attr=None):
+        super().__init__()
+        k = kernel_size if isinstance(kernel_size, (list, tuple)) else (kernel_size, kernel_size)
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, k[0], k[1]], attr=weight_attr)
+        self.bias = (
+            None if bias_attr is False
+            else self.create_parameter([out_channels], attr=bias_attr, is_bias=True)
+        )
+        self.args = (stride, padding, dilation, deformable_groups, groups)
+
+    def forward(self, x, offset, mask=None):
+        s, p, d, dg, g = self.args
+        return deform_conv2d(x, offset, self.weight, self.bias, s, p, d, dg, g, mask)
+
+
+def read_file(filename, name=None):
+    """Raw file bytes as a uint8 tensor (reference vision/ops.py read_file)."""
+    with open(filename, "rb") as f:
+        data = np.frombuffer(f.read(), np.uint8)
+    return Tensor(jnp.asarray(data))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Decode a JPEG byte tensor to [C, H, W] uint8 (reference
+    vision/ops.py decode_jpeg; the nvjpeg op's role, PIL-backed on host)."""
+    import io
+
+    from PIL import Image
+
+    data = bytes(np.asarray(_v(x), np.uint8))
+    img = Image.open(io.BytesIO(data))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(jnp.asarray(arr))

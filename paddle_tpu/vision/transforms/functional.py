@@ -199,3 +199,94 @@ def to_grayscale(img, num_output_channels=1):
     if num_output_channels == 3:
         gray = np.repeat(gray, 3, axis=-1)
     return np.clip(gray, 0, 255).astype(np.uint8) if _np(img).dtype == np.uint8 else gray
+
+
+def _inverse_warp(arr, inv_matrix, oh=None, ow=None, fill=0):
+    """Nearest-neighbor inverse warp: output (y, x) samples input at
+    inv_matrix @ [x, y, 1] (host-side numpy, like rotate above)."""
+    h, w = arr.shape[:2]
+    oh = oh if oh is not None else h
+    ow = ow if ow is not None else w
+    yy, xx = np.meshgrid(np.arange(oh), np.arange(ow), indexing="ij")
+    ones = np.ones_like(xx, np.float64)
+    pts = np.stack([xx, yy, ones], 0).reshape(3, -1).astype(np.float64)
+    m = np.asarray(inv_matrix, np.float64)
+    src = m @ pts
+    if m.shape[0] == 3:  # projective: divide by w
+        src = src[:2] / np.maximum(np.abs(src[2:3]), 1e-9) * np.sign(src[2:3])
+    xs = src[0].reshape(oh, ow)
+    ys = src[1].reshape(oh, ow)
+    yi = np.round(ys).astype(int)
+    xi = np.round(xs).astype(int)
+    valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+    out = np.full((oh, ow) + arr.shape[2:], fill, arr.dtype)
+    out[valid] = arr[np.clip(yi, 0, h - 1)[valid], np.clip(xi, 0, w - 1)[valid]]
+    return out
+
+
+def affine(img, angle, translate, scale, shear, interpolation="nearest", center=None, fill=0):
+    """Affine warp (reference vision/transforms/functional.py affine):
+    rotation + translation + isotropic scale + shear, about `center`."""
+    arr = _np(img)
+    h, w = arr.shape[:2]
+    cy, cx = ((h - 1) / 2, (w - 1) / 2) if center is None else (center[1], center[0])
+    rot = -np.deg2rad(angle)  # positive angle = counter-clockwise (rotate() convention)
+    sx, sy = (np.deg2rad(s) for s in (shear if isinstance(shear, (list, tuple)) else (shear, 0.0)))
+    # forward matrix (x, y): T(center) R S Shear T(-center) + translate
+    a = np.cos(rot - sy) / np.cos(sy)
+    b = -np.cos(rot - sy) * np.tan(sx) / np.cos(sy) - np.sin(rot)
+    c = np.sin(rot - sy) / np.cos(sy)
+    d = -np.sin(rot - sy) * np.tan(sx) / np.cos(sy) + np.cos(rot)
+    m = np.array([[a, b, 0.0], [c, d, 0.0]], np.float64) * scale
+    # inverse mapping about center with translation
+    full = np.eye(3)
+    full[:2, :2] = m[:, :2]
+    full[0, 2] = cx + translate[0] - (full[0, 0] * cx + full[0, 1] * cy)
+    full[1, 2] = cy + translate[1] - (full[1, 0] * cx + full[1, 1] * cy)
+    inv = np.linalg.inv(full)
+    return _inverse_warp(arr, inv[:2], fill=fill)
+
+
+def _perspective_coeffs(startpoints, endpoints):
+    """Solve the 8-dof homography mapping endpoints -> startpoints."""
+    a = []
+    b = []
+    for (sx, sy), (ex, ey) in zip(startpoints, endpoints):
+        a.append([ex, ey, 1, 0, 0, 0, -sx * ex, -sx * ey])
+        a.append([0, 0, 0, ex, ey, 1, -sy * ex, -sy * ey])
+        b += [sx, sy]
+    coeffs = np.linalg.lstsq(np.asarray(a, np.float64), np.asarray(b, np.float64), rcond=None)[0]
+    return np.concatenate([coeffs, [1.0]]).reshape(3, 3)
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest", fill=0):
+    """Projective warp mapping startpoints -> endpoints (reference
+    functional.perspective; points are [[x, y], ...] corners)."""
+    arr = _np(img)
+    inv = _perspective_coeffs(startpoints, endpoints)
+    return _inverse_warp(arr, inv, fill=fill)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    """Erase the [i:i+h, j:j+w] region with value(s) v (reference
+    functional.erase). Tensor images are CHW (erased on-device); arrays/PIL
+    are HWC host-side."""
+    if isinstance(img, Tensor):
+        from ...core.apply import apply
+        from jax import numpy as jnp
+
+        vv = v._value if isinstance(v, Tensor) else jnp.asarray(v)
+
+        def f(x):
+            region = jnp.broadcast_to(vv.astype(x.dtype), x[..., i:i + h, j:j + w].shape)
+            return x.at[..., i:i + h, j:j + w].set(region)
+
+        out = apply("erase", f, img)
+        if inplace:
+            img._become(out)
+            return img
+        return out
+    arr = _np(img)
+    out = arr if inplace else arr.copy()
+    out[i:i + h, j:j + w] = np.asarray(v, out.dtype)
+    return out

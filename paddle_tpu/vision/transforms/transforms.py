@@ -239,3 +239,136 @@ class RandomErasing(BaseTransform):
                     arr[top : top + eh, left : left + ew] = self.value
                 break
         return arr
+
+
+# ---------------------------------------------------------------------------
+# r3 transform completion (vision namespace parity audit)
+# ---------------------------------------------------------------------------
+
+class BrightnessTransform(BaseTransform):
+    """Random brightness in [max(0, 1-value), 1+value] (reference
+    transforms.BrightnessTransform)."""
+
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        if value < 0:
+            raise ValueError("brightness value should be non-negative")
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        factor = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return F.adjust_brightness(img, factor)
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        if value < 0:
+            raise ValueError("contrast value should be non-negative")
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        factor = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return F.adjust_contrast(img, factor)
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        if value < 0:
+            raise ValueError("saturation value should be non-negative")
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        factor = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return F.adjust_saturation(img, factor)
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value should be in [0, 0.5]")
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        factor = np.random.uniform(-self.value, self.value)
+        return F.adjust_hue(img, factor)
+
+
+class RandomAffine(BaseTransform):
+    """Random rotation + translation + scale + shear (reference
+    transforms.RandomAffine)."""
+
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        super().__init__(keys)
+        if isinstance(degrees, numbers.Number):
+            degrees = (-degrees, degrees)
+        self.degrees = degrees
+        self.translate = translate
+        self.scale = scale
+        self.shear = shear
+        self.interpolation = interpolation
+        self.fill = fill
+        self.center = center
+
+    def _apply_image(self, img):
+        arr = F._np(img)
+        h, w = arr.shape[:2]
+        angle = np.random.uniform(*self.degrees)
+        tx = ty = 0.0
+        if self.translate is not None:
+            tx = np.random.uniform(-self.translate[0], self.translate[0]) * w
+            ty = np.random.uniform(-self.translate[1], self.translate[1]) * h
+        scale = np.random.uniform(*self.scale) if self.scale is not None else 1.0
+        if self.shear is not None:
+            sh = self.shear if isinstance(self.shear, (list, tuple)) else (-self.shear, self.shear)
+            if len(sh) == 2:
+                shear = (np.random.uniform(sh[0], sh[1]), 0.0)
+            else:
+                shear = (np.random.uniform(sh[0], sh[1]), np.random.uniform(sh[2], sh[3]))
+        else:
+            shear = (0.0, 0.0)
+        return F.affine(img, angle, (tx, ty), scale, shear,
+                        interpolation=self.interpolation, center=self.center, fill=self.fill)
+
+
+class RandomPerspective(BaseTransform):
+    """Random projective distortion (reference transforms.RandomPerspective)."""
+
+    def __init__(self, prob=0.5, distortion_scale=0.5, interpolation="nearest", fill=0, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.interpolation = interpolation
+        self.fill = fill
+
+    def _get_params(self, w, h):
+        d = self.distortion_scale
+        hd = int(d * h / 2)
+        wd = int(d * w / 2)
+        start = [[0, 0], [w - 1, 0], [w - 1, h - 1], [0, h - 1]]
+        end = [
+            [np.random.randint(0, wd + 1), np.random.randint(0, hd + 1)],
+            [w - 1 - np.random.randint(0, wd + 1), np.random.randint(0, hd + 1)],
+            [w - 1 - np.random.randint(0, wd + 1), h - 1 - np.random.randint(0, hd + 1)],
+            [np.random.randint(0, wd + 1), h - 1 - np.random.randint(0, hd + 1)],
+        ]
+        return start, end
+
+    def _apply_image(self, img):
+        if np.random.rand() >= self.prob:
+            return img
+        arr = F._np(img)
+        h, w = arr.shape[:2]
+        start, end = self._get_params(w, h)
+        return F.perspective(img, start, end, interpolation=self.interpolation, fill=self.fill)

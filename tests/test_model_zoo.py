@@ -160,3 +160,45 @@ def test_ctc_loss_matches_torch():
         )
     )
     assert abs(ours - want) < 1e-3
+
+
+def test_alexnet_mobilenetv3_shufflenet_variants():
+    """r3 model-zoo completion (vision/models parity audit)."""
+    import numpy as np
+    from paddle_tpu.vision import models as M
+
+    x = paddle.to_tensor(np.random.RandomState(0).randn(1, 3, 224, 224).astype("float32"))
+    m = M.alexnet(num_classes=10)
+    m.eval()
+    assert tuple(m(x).shape) == (1, 10)
+
+    for fac in (M.mobilenet_v3_small, M.mobilenet_v3_large):
+        m = fac(num_classes=7)
+        m.eval()
+        assert tuple(m(x).shape) == (1, 7)
+
+    m = M.shufflenet_v2_x0_33(num_classes=5)
+    m.eval()
+    assert tuple(m(x).shape) == (1, 5)
+    m = M.shufflenet_v2_swish(num_classes=5)
+    m.eval()
+    assert tuple(m(x).shape) == (1, 5)
+    # swish variant really uses swish activations
+    names = [type(l).__name__ for l in m.sublayers()]
+    assert "Swish" in names and "ReLU" not in names
+
+    m = M.resnext50_64x4d(num_classes=4)
+    m.eval()
+    assert tuple(m(x).shape) == (1, 4)
+
+
+def test_inception_v3():
+    import numpy as np
+    from paddle_tpu.vision import models as M
+
+    m = M.inception_v3(num_classes=6)
+    m.eval()
+    x = paddle.to_tensor(np.random.RandomState(1).randn(1, 3, 299, 299).astype("float32"))
+    assert tuple(m(x).shape) == (1, 6)
+    n_params = sum(p.size for p in m.parameters())
+    assert 20e6 < n_params < 30e6  # ~23.8M reference param count

@@ -1,0 +1,106 @@
+"""r3 vision namespace completion: transforms (affine/perspective/erase +
+random transform classes), ops (psroi_pool, layers, decode_jpeg/read_file)."""
+import io
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as V
+from paddle_tpu.vision import transforms as T
+
+
+def test_affine_identity_and_translate():
+    img = np.arange(48, dtype=np.uint8).reshape(4, 4, 3)
+    out = T.affine(img, angle=0, translate=(0, 0), scale=1.0, shear=0)
+    np.testing.assert_array_equal(out, img)
+    out = T.affine(img, angle=0, translate=(1, 0), scale=1.0, shear=0, fill=0)
+    np.testing.assert_array_equal(out[:, 1:], img[:, :3])  # shifted right
+    assert (out[:, 0] == 0).all()
+
+
+def test_affine_rotate90_matches_rot90():
+    img = np.arange(25, dtype=np.float32).reshape(5, 5, 1)
+    out = T.affine(img, angle=90, translate=(0, 0), scale=1.0, shear=0)
+    np.testing.assert_allclose(out[..., 0], np.rot90(img[..., 0], 1), atol=1e-6)
+
+
+def test_perspective_identity_and_roundtrip():
+    img = np.random.RandomState(0).randint(0, 255, (8, 8, 3)).astype(np.uint8)
+    corners = [[0, 0], [7, 0], [7, 7], [0, 7]]
+    out = T.perspective(img, corners, corners)
+    np.testing.assert_array_equal(out, img)
+
+
+def test_erase_array_and_tensor():
+    img = np.ones((6, 6, 3), np.float32)
+    out = T.erase(img, 1, 2, 3, 2, v=0.0)
+    assert out[1:4, 2:4].sum() == 0 and out.sum() == img.sum() - 3 * 2 * 3
+
+    t = paddle.to_tensor(np.ones((3, 6, 6), np.float32))
+    out_t = T.erase(t, 0, 0, 2, 2, v=paddle.to_tensor(np.zeros((3, 2, 2), np.float32)))
+    assert float(out_t.numpy()[:, :2, :2].sum()) == 0.0
+
+
+def test_random_transform_classes():
+    np.random.seed(0)
+    img = np.random.RandomState(1).randint(0, 255, (16, 16, 3)).astype(np.uint8)
+    for cls, arg in [(T.BrightnessTransform, 0.4), (T.ContrastTransform, 0.4),
+                     (T.SaturationTransform, 0.4), (T.HueTransform, 0.2)]:
+        out = cls(arg)(img)
+        assert out.shape == img.shape
+        assert cls(0)(img) is img or (np.asarray(cls(0)(img)) == img).all()
+    out = T.RandomAffine(degrees=20, translate=(0.1, 0.1), scale=(0.8, 1.2), shear=5)(img)
+    assert out.shape == img.shape
+    out = T.RandomPerspective(prob=1.0, distortion_scale=0.3)(img)
+    assert out.shape == img.shape
+    with pytest.raises(ValueError):
+        T.HueTransform(0.9)
+
+
+def test_psroi_pool_uniform_box():
+    # constant per-group channels: pooled output must equal the group value
+    N, out_c, ph, pw, H, W = 1, 2, 2, 2, 8, 8
+    C = out_c * ph * pw
+    x = np.zeros((N, C, H, W), np.float32)
+    for ch in range(C):
+        x[0, ch] = ch  # constant plane per channel
+    boxes = np.array([[0.0, 0.0, 8.0, 8.0]], np.float32)
+    out = V.psroi_pool(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                       paddle.to_tensor(np.array([1], np.int32)), (ph, pw)).numpy()
+    assert out.shape == (1, out_c, ph, pw)
+    # channel layout: group (c, i, j) reads plane c*ph*pw + i*pw + j
+    for c in range(out_c):
+        for i in range(ph):
+            for j in range(pw):
+                assert out[0, c, i, j] == pytest.approx(c * ph * pw + i * pw + j)
+
+
+def test_roi_layers_and_deform_layer():
+    x = paddle.to_tensor(np.random.RandomState(0).randn(1, 4, 8, 8).astype(np.float32))
+    boxes = paddle.to_tensor(np.array([[0.0, 0.0, 4.0, 4.0]], np.float32))
+    bn = paddle.to_tensor(np.array([1], np.int32))
+    out = V.RoIAlign(2)(x, boxes, bn)
+    assert tuple(out.shape) == (1, 4, 2, 2)
+    out = V.RoIPool(2)(x, boxes, bn)
+    assert tuple(out.shape) == (1, 4, 2, 2)
+
+    paddle.seed(0)
+    dc = V.DeformConv2D(4, 6, 3, padding=1)
+    offset = paddle.to_tensor(np.zeros((1, 18, 8, 8), np.float32))
+    out = dc(x, offset)
+    assert tuple(out.shape) == (1, 6, 8, 8)
+    assert len(dc.parameters()) == 2
+
+
+def test_read_file_decode_jpeg(tmp_path):
+    from PIL import Image
+
+    img = np.random.RandomState(0).randint(0, 255, (10, 12, 3)).astype(np.uint8)
+    path = str(tmp_path / "t.jpg")
+    Image.fromarray(img).save(path, quality=95)
+    data = V.read_file(path)
+    assert data.dtype == np.dtype("uint8") and data.numpy().size > 100
+    dec = V.decode_jpeg(data).numpy()
+    assert dec.shape == (3, 10, 12)
+    assert np.abs(dec.astype(int).mean() - img.transpose(2, 0, 1).astype(int).mean()) < 10
