@@ -69,6 +69,32 @@ from .auto_parallel import (  # noqa: F401
     unshard_dtensor,
 )
 from . import checkpoint  # noqa: F401,E402
+from .checkpoint import load_state_dict, save_state_dict  # noqa: F401,E402
+from . import launch  # noqa: F401,E402
+from . import io  # noqa: F401,E402
+from .compat import (  # noqa: F401,E402
+    CountFilterEntry,
+    DistAttr,
+    InMemoryDataset,
+    ParallelMode,
+    ProbabilityEntry,
+    QueueDataset,
+    ReduceType,
+    ShowClickEntry,
+    gloo_barrier,
+    gloo_init_parallel_env,
+    gloo_release,
+)
+from .auto_parallel.api import (  # noqa: F401,E402
+    DistModel,
+    ShardingStage1,
+    ShardingStage2,
+    ShardingStage3,
+    Strategy,
+    shard_scaler,
+    to_static,
+)
+from .collective import alltoall_single, gather  # noqa: F401,E402
 from . import auto_tuner  # noqa: F401,E402
 from . import rpc  # noqa: F401,E402
 

@@ -540,3 +540,22 @@ for _name in (
 ):
     globals()[_name] = _watched(globals()[_name])
 del _name
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    """paddle.distributed.gather (reference communication/gather.py):
+    collect every rank's tensor into gather_list. Single-controller
+    convention (like reduce/scatter in this module): the op executes for
+    ANY dst — the controller holds the global view, so "only dst receives"
+    collapses to filling the caller's list; gating on process rank would
+    desynchronize multi-host SPMD programs."""
+    if gather_list is None:
+        raise ValueError("gather: pass gather_list to receive the parts")
+    tmp: list = []
+    task = all_gather(tmp, tensor, group, sync_op)
+    gather_list.extend(tmp)
+    return task
+
+
+# reference exports all_to_all_single under this name too
+alltoall_single = all_to_all_single

@@ -1,0 +1,57 @@
+"""paddle.distributed.io (reference python/paddle/distributed/io.py):
+persistable save/load around the static executor. TPU-native: persistables
+are the Program's parameter tensors; the distributed variants collapse to
+the single-program save because GSPMD keeps a global view of sharded
+tensors (no per-rank split files needed)."""
+from __future__ import annotations
+
+import os
+
+from ..framework import io as fio
+
+
+def is_persistable(var):
+    """reference io.py:357: parameters and persistable buffers persist;
+    temporaries don't. Keyed on Parameter identity / the persistable flag —
+    NOT stop_gradient (a frozen param persists; a tape temporary doesn't)."""
+    from ..nn.layer import Parameter
+
+    return isinstance(var, Parameter) or bool(getattr(var, "persistable", False))
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    """reference io.py:392: save every persistable of the program."""
+    from ..static import default_main_program
+
+    prog = main_program or default_main_program()
+    params = prog.all_parameters()
+    state = {
+        (p.name or f"param_{i}"): p for i, p in enumerate(params)
+    }
+    os.makedirs(dirname, exist_ok=True)
+    path = os.path.join(dirname, filename or "__persistables__")
+    fio.save(state, path)
+    return path
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    """reference io.py:132."""
+    from ..static import default_main_program
+
+    prog = main_program or default_main_program()
+    path = os.path.join(dirname, filename or "__persistables__")
+    state = fio.load(path)
+    params = prog.all_parameters()
+    by_name = {(p.name or f"param_{i}"): p for i, p in enumerate(params)}
+    for name, value in state.items():
+        if name in by_name:
+            by_name[name].set_value(value)
+    return state
+
+
+def load_inference_model_distributed(dirname, executor, **kwargs):
+    """reference io.py:464: the distributed variant of
+    static.load_inference_model — one artifact here (global-view tensors)."""
+    from ..static import load_inference_model
+
+    return load_inference_model(dirname, executor, **kwargs)
