@@ -45,3 +45,10 @@ __all__ = [
     "kl_divergence",
     "register_kl",
 ]
+from .more_r3 import (  # noqa: F401,E402
+    Binomial,
+    Cauchy,
+    ContinuousBernoulli,
+    ExponentialFamily,
+    MultivariateNormal,
+)

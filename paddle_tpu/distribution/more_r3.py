@@ -1,0 +1,275 @@
+"""r3 distribution families: Binomial, Cauchy, ContinuousBernoulli,
+ExponentialFamily, MultivariateNormal (reference python/paddle/distribution/
+binomial.py, cauchy.py, continuous_bernoulli.py, exponential_family.py,
+multivariate_normal.py)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _as_value, _key, _wrap
+
+
+class ExponentialFamily(Distribution):
+    """Base for exponential-family distributions (reference
+    exponential_family.py): subclasses expose natural parameters and the
+    log-normalizer; entropy comes from the Bregman identity (autodiff of
+    the log-normalizer — jax.grad plays the reference's double-grad role)."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        raise NotImplementedError
+
+    def entropy(self):
+        nat = [jnp.asarray(p) for p in self._natural_parameters]
+        lg = self._log_normalizer(*nat)
+        grads = jax.grad(lambda *ps: jnp.sum(self._log_normalizer(*ps)), argnums=tuple(range(len(nat))))(*nat)
+        # H = A(eta) - <eta, grad A> + E[-log h(x)]  (mean carrier measure)
+        ent = lg + self._mean_carrier_measure
+        for p, g in zip(nat, grads):
+            ent = ent - p * g
+        return _wrap(ent)
+
+
+class Binomial(Distribution):
+    """Binomial(total_count, probs) (reference binomial.py)."""
+
+    def __init__(self, total_count, probs):
+        self.total_count = _as_value(total_count)
+        self.probs = _as_value(probs)
+        super().__init__(batch_shape=jnp.broadcast_shapes(
+            jnp.shape(self.total_count), jnp.shape(self.probs)))
+
+    @property
+    def mean(self):
+        return _wrap(self.total_count * self.probs)
+
+    @property
+    def variance(self):
+        return _wrap(self.total_count * self.probs * (1 - self.probs))
+
+    def sample(self, shape=()):
+        shp = self._extend_shape(shape)
+        n = jnp.broadcast_to(self.total_count, self.batch_shape)
+        p = jnp.broadcast_to(self.probs, self.batch_shape)
+        nmax = int(jnp.max(n))
+        u = jax.random.uniform(_key(), shp + (nmax,))
+        trial = (u < p[..., None]).astype(jnp.float32)
+        mask = jnp.arange(nmax) < n[..., None]
+        return _wrap(jnp.sum(trial * mask, -1))
+
+    def log_prob(self, value):
+        v = _as_value(value)
+        n, p = self.total_count, self.probs
+        logc = (jax.scipy.special.gammaln(n + 1)
+                - jax.scipy.special.gammaln(v + 1)
+                - jax.scipy.special.gammaln(n - v + 1))
+        return _wrap(logc + v * jnp.log(p) + (n - v) * jnp.log1p(-p))
+
+    def entropy(self):
+        # sum over the support (exact, like the reference)
+        n = int(jnp.max(self.total_count))
+        ks = jnp.arange(n + 1, dtype=jnp.float32)
+        lp = self.log_prob(ks.reshape((n + 1,) + (1,) * len(self.batch_shape)))
+        lpv = _as_value(lp)
+        valid = ks.reshape((n + 1,) + (1,) * len(self.batch_shape)) <= self.total_count
+        return _wrap(-jnp.sum(jnp.where(valid, jnp.exp(lpv) * lpv, 0.0), 0))
+
+
+class Cauchy(Distribution):
+    """Cauchy(loc, scale) (reference cauchy.py)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _as_value(loc)
+        self.scale = _as_value(scale)
+        super().__init__(batch_shape=jnp.broadcast_shapes(
+            jnp.shape(self.loc), jnp.shape(self.scale)))
+
+    @property
+    def mean(self):
+        raise ValueError("Cauchy distribution has no mean")
+
+    @property
+    def variance(self):
+        raise ValueError("Cauchy distribution has no variance")
+
+    @property
+    def stddev(self):
+        raise ValueError("Cauchy distribution has no stddev")
+
+    def sample(self, shape=(), name=None):
+        shp = self._extend_shape(shape)
+        u = jax.random.uniform(_key(), shp, minval=1e-7, maxval=1 - 1e-7)
+        return _wrap(self.loc + self.scale * jnp.tan(math.pi * (u - 0.5)))
+
+    def rsample(self, shape=(), name=None):
+        return self.sample(shape)
+
+    def log_prob(self, value):
+        v = _as_value(value)
+        z = (v - self.loc) / self.scale
+        return _wrap(-math.log(math.pi) - jnp.log(self.scale) - jnp.log1p(z * z))
+
+    def cdf(self, value):
+        v = _as_value(value)
+        return _wrap(jnp.arctan((v - self.loc) / self.scale) / math.pi + 0.5)
+
+    def entropy(self):
+        return _wrap(jnp.broadcast_to(
+            jnp.log(4 * math.pi * self.scale), self.batch_shape))
+
+    def kl_divergence(self, other):
+        # closed form (Chyzak & Nielsen 2019), same as the reference
+        s1, s2 = self.scale, other.scale
+        l1, l2 = self.loc, other.loc
+        return _wrap(jnp.log(((s1 + s2) ** 2 + (l1 - l2) ** 2) / (4 * s1 * s2)))
+
+
+class ContinuousBernoulli(Distribution):
+    """ContinuousBernoulli(probs) (reference continuous_bernoulli.py):
+    support [0, 1] with the log-normalizing constant C(p)."""
+
+    def __init__(self, probs, lims=(0.499, 0.501)):
+        self.probs = _as_value(probs)
+        self._lims = lims
+        super().__init__(batch_shape=jnp.shape(self.probs))
+
+    def _outside(self):
+        return (self.probs < self._lims[0]) | (self.probs > self._lims[1])
+
+    def _log_norm(self):
+        p = self.probs
+        safe = jnp.where(self._outside(), p, 0.25)
+        val = jnp.log((jnp.log1p(-safe) - jnp.log(safe)) / (1 - 2 * safe))
+        taylor = math.log(2.0) + 4 / 3 * (p - 0.5) ** 2  # expansion at 1/2
+        return jnp.where(self._outside(), val, taylor)
+
+    @property
+    def mean(self):
+        p = self.probs
+        safe = jnp.where(self._outside(), p, 0.25)
+        val = safe / (2 * safe - 1) + 1 / (2 * jnp.arctanh(1 - 2 * safe))
+        taylor = 0.5 + (p - 0.5) / 3
+        return _wrap(jnp.where(self._outside(), val, taylor))
+
+    @property
+    def variance(self):
+        p = self.probs
+        safe = jnp.where(self._outside(), p, 0.25)
+        val = safe * (safe - 1) / (1 - 2 * safe) ** 2 + 1 / (2 * jnp.arctanh(1 - 2 * safe)) ** 2
+        taylor = 1 / 12 - (p - 0.5) ** 2 / 5
+        return _wrap(jnp.where(self._outside(), val, taylor))
+
+    def sample(self, shape=()):
+        shp = self._extend_shape(shape)
+        u = jax.random.uniform(_key(), shp, minval=1e-6, maxval=1 - 1e-6)
+        p = self.probs
+        safe = jnp.where(self._outside(), p, 0.25)
+        # invert CDF(x) = (p^x (1-p)^{1-x} + p - 1)/(2p - 1):
+        # x = log1p(u (2p-1)/(1-p)) / log(p/(1-p))
+        icdf = jnp.log1p(u * (2 * safe - 1) / (1 - safe)) / (
+            jnp.log(safe) - jnp.log1p(-safe))
+        # at p ~ 1/2 the icdf tends to u
+        return _wrap(jnp.where(self._outside(), icdf, u))
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def log_prob(self, value):
+        v = _as_value(value)
+        p = self.probs
+        return _wrap(v * jnp.log(p) + (1 - v) * jnp.log1p(-p) + self._log_norm())
+
+    def entropy(self):
+        lp = self.log_prob(self.mean)
+        # E[-log p(x)] has closed form: -(log_norm + mean*log(p) + (1-mean)*log(1-p))
+        p = self.probs
+        m = _as_value(self.mean)
+        return _wrap(-(m * jnp.log(p) + (1 - m) * jnp.log1p(-p) + self._log_norm()))
+
+    def cdf(self, value):
+        v = _as_value(value)
+        p = self.probs
+        safe = jnp.where(self._outside(), p, 0.25)
+        num = safe ** v * (1 - safe) ** (1 - v) + safe - 1
+        val = num / (2 * safe - 1)
+        return _wrap(jnp.clip(jnp.where(self._outside(), val, v), 0.0, 1.0))
+
+
+class MultivariateNormal(Distribution):
+    """MultivariateNormal(loc, covariance_matrix=...) (reference
+    multivariate_normal.py); cholesky-parameterized math."""
+
+    def __init__(self, loc, covariance_matrix=None, precision_matrix=None, scale_tril=None):
+        self.loc = _as_value(loc)
+        if sum(x is not None for x in (covariance_matrix, precision_matrix, scale_tril)) != 1:
+            raise ValueError("Specify exactly one of covariance_matrix / precision_matrix / scale_tril")
+        if covariance_matrix is not None:
+            cov = _as_value(covariance_matrix)
+            self._chol = jnp.linalg.cholesky(cov)
+        elif precision_matrix is not None:
+            prec = _as_value(precision_matrix)
+            self._chol = jnp.linalg.cholesky(jnp.linalg.inv(prec))
+        else:
+            self._chol = _as_value(scale_tril)
+        d = self.loc.shape[-1]
+        super().__init__(batch_shape=self.loc.shape[:-1], event_shape=(d,))
+
+    @property
+    def mean(self):
+        return _wrap(self.loc)
+
+    @property
+    def covariance_matrix(self):
+        return _wrap(self._chol @ jnp.swapaxes(self._chol, -1, -2))
+
+    @property
+    def variance(self):
+        return _wrap(jnp.sum(self._chol ** 2, axis=-1))
+
+    @property
+    def stddev(self):
+        return _wrap(jnp.sqrt(jnp.sum(self._chol ** 2, axis=-1)))
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        shp = tuple(shape) + self.batch_shape + self.event_shape
+        eps = jax.random.normal(_key(), shp)
+        return _wrap(self.loc + jnp.einsum("...ij,...j->...i", self._chol, eps))
+
+    def log_prob(self, value):
+        v = _as_value(value)
+        diff = v - self.loc
+        sol = jax.scipy.linalg.solve_triangular(self._chol, diff[..., None], lower=True)[..., 0]
+        m = jnp.sum(sol ** 2, -1)
+        d = self.event_shape[0]
+        logdet = jnp.sum(jnp.log(jnp.diagonal(self._chol, axis1=-2, axis2=-1)), -1)
+        return _wrap(-0.5 * (d * math.log(2 * math.pi) + m) - logdet)
+
+    def entropy(self):
+        d = self.event_shape[0]
+        logdet = jnp.sum(jnp.log(jnp.diagonal(self._chol, axis1=-2, axis2=-1)), -1)
+        return _wrap(0.5 * d * (1 + math.log(2 * math.pi)) + logdet)
+
+    def kl_divergence(self, other):
+        d = self.event_shape[0]
+        c1, c2 = self._chol, other._chol
+        logdet = (jnp.sum(jnp.log(jnp.diagonal(c2, axis1=-2, axis2=-1)), -1)
+                  - jnp.sum(jnp.log(jnp.diagonal(c1, axis1=-2, axis2=-1)), -1))
+        a = jax.scipy.linalg.solve_triangular(c2, c1, lower=True)
+        tr = jnp.sum(a ** 2, (-2, -1))
+        diff = other.loc - self.loc
+        sol = jax.scipy.linalg.solve_triangular(c2, diff[..., None], lower=True)[..., 0]
+        m = jnp.sum(sol ** 2, -1)
+        return _wrap(logdet + 0.5 * (tr + m - d))

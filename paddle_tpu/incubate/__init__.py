@@ -8,3 +8,190 @@ from . import autotune  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import distributed  # noqa: F401
 from . import nn  # noqa: F401
+
+# ---------------------------------------------------------------------------
+# r3 incubate top-level surface (reference python/paddle/incubate/__init__.py)
+# ---------------------------------------------------------------------------
+from ..geometric import (  # noqa: F401,E402  (graph ops graduated to paddle.geometric)
+    segment_max,
+    segment_mean,
+    segment_min,
+    segment_sum,
+)
+from ..geometric import send_u_recv as graph_send_recv  # noqa: F401,E402
+from ..geometric import reindex_graph as graph_reindex  # noqa: F401,E402
+from ..geometric import sample_neighbors as graph_sample_neighbors  # noqa: F401,E402
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes, sorted_eids=None,
+                       return_eids=False, name=None):
+    """Multi-hop neighbor sampling (reference incubate/operators/
+    graph_khop_sampler.py): chains geometric.sample_neighbors per hop and
+    reindexes. Returns (edge_src, edge_dst, sample_index, reindex_nodes)
+    like the reference: reindexed edges, the unique original node ids, and
+    the renumbered seed nodes."""
+    import numpy as np
+
+    from ..core.tensor import Tensor as _T
+    from jax import numpy as jnp
+    from ..geometric import sample_neighbors as _sample
+
+    if return_eids:
+        raise NotImplementedError("graph_khop_sampler: eids not supported")
+    srcs, dsts = [], []
+    frontier = input_nodes
+    for k in sample_sizes:
+        neigh, count = _sample(row, colptr, frontier, sample_size=k)[:2]
+        cnt = np.asarray(count.numpy()).astype(np.int64)
+        fr = np.asarray(frontier.numpy()).astype(np.int64)
+        srcs.append(np.asarray(neigh.numpy()).astype(np.int64))
+        dsts.append(np.repeat(fr, cnt))
+        frontier = neigh
+    src = np.concatenate(srcs) if len(srcs) > 1 else srcs[0]
+    dst = np.concatenate(dsts) if len(dsts) > 1 else dsts[0]
+    seeds = np.asarray(input_nodes.numpy()).astype(np.int64)
+    # renumber: seeds first, then newly-seen nodes in order of appearance
+    order = {int(n): i for i, n in enumerate(dict.fromkeys(
+        np.concatenate([seeds, src, dst]).tolist()))}
+    remap = np.vectorize(order.__getitem__)
+    return (
+        _T(jnp.asarray(remap(src), jnp.int64)),
+        _T(jnp.asarray(remap(dst), jnp.int64)),
+        _T(jnp.asarray(np.asarray(list(order.keys()), np.int64))),
+        _T(jnp.asarray(remap(seeds), jnp.int64)),
+    )
+
+
+def identity_loss(x, reduction="none"):
+    """reference incubate/operators/identity_loss.py: mark x as a loss
+    (IPU concept); numerically sum/mean/none reduction of x. Reduction codes
+    follow the reference: 0/"sum", 1/"mean", 2/"none" — anything else
+    raises."""
+    from .. import mean as _mean, sum as _sum
+
+    if isinstance(reduction, str):
+        reduction = reduction.lower()
+    if reduction in (0, "sum"):
+        return _sum(x)
+    if reduction in (1, "mean"):
+        return _mean(x)
+    if reduction in (2, "none"):
+        return x
+    raise ValueError(f"Unsupported reduction type: {reduction!r}")
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """reference incubate/operators/softmax_mask_fuse.py: softmax(x + mask)
+    fused — XLA fuses the chain on its own."""
+    from ..core.apply import apply
+    from ..core.tensor import _ensure_tensor
+    import jax
+
+    return apply(
+        "softmax_mask_fuse",
+        lambda xv, mv: jax.nn.softmax(xv + mv.astype(xv.dtype), axis=-1),
+        _ensure_tensor(x), _ensure_tensor(mask),
+    )
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    """reference softmax_mask_fuse_upper_triangle: causal-masked softmax
+    (scores [B, H, S, S]; upper triangle masked out)."""
+    from ..core.apply import apply
+    from ..core.tensor import _ensure_tensor
+    import jax
+    from jax import numpy as jnp
+
+    def f(xv):
+        s = xv.shape[-1]
+        cm = jnp.tril(jnp.ones((s, s), bool))
+        return jax.nn.softmax(jnp.where(cm, xv, -1e4), axis=-1)
+
+    return apply("softmax_mask_fuse_upper_triangle", f, _ensure_tensor(x))
+
+
+class LookAhead:
+    """Lookahead optimizer wrapper (reference incubate/optimizer/lookahead.py):
+    fast optimizer steps k times, then slow weights interpolate toward the
+    fast weights with ratio alpha."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        self._step = 0
+        self._slow = None
+
+    def _params(self):
+        return [p for _g, p in self.inner_optimizer._all_params()]
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._step += 1
+        if self._slow is None:
+            self._slow = [p._value for p in self._params()]
+        if self._step % self.k == 0:
+            for p, slow in zip(self._params(), self._slow):
+                new_slow = slow + self.alpha * (p._value - slow)
+                p._replace_value(new_slow.astype(p._value.dtype))
+                p.stop_gradient = False
+            self._slow = [p._value for p in self._params()]
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    def minimize(self, loss):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+    def state_dict(self):
+        return self.inner_optimizer.state_dict()
+
+    def set_state_dict(self, sd):
+        return self.inner_optimizer.set_state_dict(sd)
+
+
+class ModelAverage:
+    """Exponential/windowed parameter averaging (reference
+    incubate/optimizer/modelaverage.py): accumulates running parameter sums;
+    apply() swaps averaged weights in, restore() swaps back."""
+
+    def __init__(self, average_window_rate, parameters=None, min_average_window=10000,
+                 max_average_window=10000, name=None):
+        if parameters is None:
+            raise ValueError("ModelAverage requires parameters")
+        self._params = list(parameters)
+        self._rate = average_window_rate
+        self._min_w = min_average_window
+        self._max_w = max_average_window
+        self._sum = [p._value * 0 for p in self._params]
+        self._num = 0
+        self._backup = None
+
+    def step(self):
+        for i, p in enumerate(self._params):
+            self._sum[i] = self._sum[i] + p._value
+        self._num += 1
+        window = max(self._min_w, min(self._max_w, int(self._num * self._rate) or 1))
+        if self._num > window:
+            # slide: decay old contributions (reference restart trick)
+            for i in range(len(self._sum)):
+                self._sum[i] = self._sum[i] * (window / self._num)
+            self._num = window
+
+    def apply(self, executor=None, need_restore=True):
+        if self._num == 0:
+            return
+        self._backup = [p._value for p in self._params]
+        for p, s in zip(self._params, self._sum):
+            p._replace_value((s / self._num).astype(p._value.dtype))
+            p.stop_gradient = False
+
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        for p, b in zip(self._params, self._backup):
+            p._replace_value(b)
+            p.stop_gradient = False
+        self._backup = None

@@ -823,3 +823,169 @@ def variable_length_memory_efficient_attention(
         return jnp.where(qvalid, out, jnp.zeros((), out.dtype))
 
     return apply("variable_length_memory_efficient_attention", fn, *args)
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False, name=None):
+    """reference fused_matmul_bias.py: matmul + bias epilogue (XLA fuses)."""
+    def fn(xv, yv, *rest):
+        a = jnp.swapaxes(xv, -1, -2) if transpose_x else xv
+        b = jnp.swapaxes(yv, -1, -2) if transpose_y else yv
+        out = a @ b
+        return out + rest[0] if rest else out
+
+    args = [x, y] + ([bias] if bias is not None else [])
+    return apply("fused_matmul_bias", fn, *args)
+
+
+def fused_bias_dropout_residual_layer_norm(
+    x, residual, bias=None, ln_scale=None, ln_bias=None, dropout_rate=0.5,
+    ln_epsilon=1e-5, training=True, mode="upscale_in_train", name=None,
+):
+    """reference fused_transformer.py fused_bias_dropout_residual_layer_norm:
+    layer_norm(residual + dropout(x + bias))."""
+    from ....nn.functional.common import dropout as _dropout
+    from ....nn.functional.norm import layer_norm as _layer_norm
+    from ....ops import math as _m
+
+    h = x if bias is None else _m.add(x, bias)
+    h = _dropout(h, p=dropout_rate, training=training, mode=mode)
+    h = _m.add(h, residual)
+    d = int(h.shape[-1])
+    return _layer_norm(h, d, ln_scale, ln_bias, ln_epsilon)
+
+
+def fused_ec_moe(x, gate, bmm0_weight, bmm0_bias, bmm1_weight, bmm1_bias, act_type):
+    """reference fused_ec_moe.py: dense-evaluated MoE FFN — every expert's
+    FFN over every token, combined with softmax gate weights. On the MXU a
+    dense einsum over a modest expert count beats gather/scatter routing."""
+    if act_type not in ("gelu", "relu"):
+        raise ValueError("fused_ec_moe act_type must be gelu or relu")
+
+    def fn(xv, gv, w0, b0, w1, b1):
+        act = jax.nn.gelu if act_type == "gelu" else jax.nn.relu
+        # h[e, b, s, f] = act(x @ w0[e] + b0[e])
+        h = jnp.einsum("bsd,edf->ebsf", xv, w0) + b0[:, None]
+        h = act(h)
+        # fixed reference layout: bmm1_weight [E, FF, D]
+        out_e = jnp.einsum("ebsf,efd->ebsd", h, w1) + b1[:, None]
+        probs = jax.nn.softmax(gv.astype(jnp.float32), axis=-1).astype(xv.dtype)
+        return jnp.einsum("ebsd,bse->bsd", out_e, probs)
+
+    return apply("fused_ec_moe", fn, x, gate, bmm0_weight, bmm0_bias, bmm1_weight, bmm1_bias)
+
+
+def fused_multi_transformer(
+    x, ln_scales, ln_biases, qkv_weights, qkv_biases, linear_weights,
+    linear_biases, ffn_ln_scales, ffn_ln_biases, ffn1_weights, ffn1_biases,
+    ffn2_weights, ffn2_biases, pre_layer_norm=True, epsilon=1e-5,
+    cache_kvs=None, pre_caches=None, seq_lens=None, rotary_embs=None,
+    time_step=None, attn_mask=None, dropout_rate=0.0, rotary_emb_dims=0,
+    activation="gelu", training=False, mode="upscale_in_train",
+    trans_qkvw=True, ring_id=-1, name=None,
+):
+    """reference fused_transformer.py:964 — N fused transformer layers in
+    one call (the serving fast path). Standard-precision path with optional
+    decode kv caches (cache layout [2, B, H, max_seq, D], time_step = write
+    position); rotary/pre_cache paths raise loudly. One XLA program does the
+    fusing the CUDA mega-kernel does by hand."""
+    for unsupported, what in (
+        (rotary_embs, "rotary_embs"), (pre_caches, "pre_caches"),
+        (seq_lens, "seq_lens (mask padded positions via attn_mask instead)"),
+    ):
+        if unsupported is not None:
+            raise NotImplementedError(f"fused_multi_transformer: {what} not supported")
+    from ....nn.functional.common import dropout as _dropout
+    from ....nn.functional.norm import layer_norm as _layer_norm
+    from ....ops import math as _m, manipulation as _mp
+    from ....core.tensor import Tensor as _T
+    import math as _pm
+
+    n_layers = len(qkv_weights)
+    out = x
+    new_caches = []
+    ts = int(time_step.numpy()) if isinstance(time_step, _T) else time_step
+
+    for i in range(n_layers):
+        residual = out
+        h = _layer_norm(out, int(out.shape[-1]), ln_scales[i], ln_biases[i], epsilon) if pre_layer_norm else out
+
+        def attn_fn(hv, qkvw, *rest):
+            b, s, d = hv.shape
+            qkvb = rest[0] if qkv_biases is not None and qkv_biases[i] is not None else None
+            w = qkvw
+            if trans_qkvw:  # [3, H, Dh, d] -> project via einsum
+                three, H, Dh, _ = w.shape
+                qkv = jnp.einsum("bsd,thed->bsthe", hv, w)
+            else:           # [d, 3, H, Dh]
+                _, three, H, Dh = w.shape
+                qkv = jnp.einsum("bsd,dthe->bsthe", hv, w)
+            if qkvb is not None:
+                qkv = qkv + qkvb.reshape(1, 1, 3, H, Dh)
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]     # [B,S,H,Dh]
+            qh, kh, vh = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))  # [B,H,S,Dh]
+            cache = rest[-1] if cache_kvs is not None else None
+            if cache is not None and ts is not None:
+                # decode: append this step at position ts, attend over cache
+                ck = cache[0].astype(kh.dtype)
+                cv = cache[1].astype(vh.dtype)
+                ck = jax.lax.dynamic_update_slice(ck, kh, (0, 0, ts, 0))
+                cv = jax.lax.dynamic_update_slice(cv, vh, (0, 0, ts, 0))
+                kh2, vh2 = ck[:, :, : ts + 1], cv[:, :, : ts + 1]
+                new_cache = jnp.stack([ck, cv])
+            else:
+                kh2, vh2 = kh, vh
+                new_cache = None
+                if cache is not None:  # prefill into the cache
+                    ck = jax.lax.dynamic_update_slice(
+                        cache[0].astype(kh.dtype), kh, (0, 0, 0, 0))
+                    cv = jax.lax.dynamic_update_slice(
+                        cache[1].astype(vh.dtype), vh, (0, 0, 0, 0))
+                    new_cache = jnp.stack([ck, cv])
+            scale = 1.0 / _pm.sqrt(q.shape[-1])
+            logits = jnp.einsum("bhqd,bhkd->bhqk", qh.astype(jnp.float32), kh2.astype(jnp.float32)) * scale
+            if attn_mask is not None:
+                mv = attn_mask._raw() if isinstance(attn_mask, _T) else jnp.asarray(attn_mask)
+                logits = logits + mv[:, :, :logits.shape[2], :logits.shape[3]].astype(jnp.float32)
+            elif cache is None or ts is None:
+                sq, sk = logits.shape[-2], logits.shape[-1]
+                cm = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+                logits = jnp.where(cm, logits, -1e30)
+            p = jax.nn.softmax(logits, -1).astype(vh2.dtype)
+            o = jnp.einsum("bhqk,bhkd->bhqd", p, vh2)
+            o = jnp.swapaxes(o, 1, 2).reshape(b, s, -1)
+            return (o, new_cache) if new_cache is not None else o
+
+        args = [h, qkv_weights[i]]
+        if qkv_biases is not None and qkv_biases[i] is not None:
+            args.append(qkv_biases[i])
+        if cache_kvs is not None:
+            args.append(cache_kvs[i])
+        attn_out = apply(f"fmt_attn_{i}", attn_fn, *args,
+                         n_outputs=2 if cache_kvs is not None else None)
+        if cache_kvs is not None:
+            attn_out, cache_out = attn_out
+            new_caches.append(cache_out)
+
+        proj = fused_linear(attn_out, linear_weights[i],
+                            linear_biases[i] if linear_biases is not None else None)
+        proj = _dropout(proj, p=dropout_rate, training=training, mode=mode)
+        out = _m.add(residual, proj)
+        if not pre_layer_norm:
+            out = _layer_norm(out, int(out.shape[-1]), ln_scales[i], ln_biases[i], epsilon)
+
+        residual = out
+        h = _layer_norm(out, int(out.shape[-1]), ffn_ln_scales[i], ffn_ln_biases[i], epsilon) if pre_layer_norm else out
+        h = fused_linear(h, ffn1_weights[i], ffn1_biases[i] if ffn1_biases is not None else None)
+        h = fused_bias_act(h, act_method=activation)
+        h = fused_linear(h, ffn2_weights[i], ffn2_biases[i] if ffn2_biases is not None else None)
+        h = _dropout(h, p=dropout_rate, training=training, mode=mode)
+        out = _m.add(residual, h)
+        if not pre_layer_norm:
+            out = _layer_norm(out, int(out.shape[-1]), ffn_ln_scales[i], ffn_ln_biases[i], epsilon)
+
+    if cache_kvs is not None:
+        for c, nc in zip(cache_kvs, new_caches):
+            if isinstance(c, _T) and nc is not None:
+                c._replace_value(nc._raw() if isinstance(nc, _T) else nc)
+        return out, cache_kvs
+    return out

@@ -1,3 +1,29 @@
 """paddle.jit namespace (python/paddle/jit/__init__.py)."""
 from .api import StaticFunction, cond, ignore_module, not_to_static, to_static  # noqa: F401
 from .save_load import TranslatedLayer, load, save  # noqa: F401
+
+
+# ---- r3: to_static global switch + dy2static logging controls ----
+# (reference jit/api.py enable_to_static, jit/dy2static/logging_utils.py)
+
+def enable_to_static(enable_to_static_bool):
+    """Globally enable/disable to_static compilation: when off, every
+    StaticFunction runs its original eager function (the reference's
+    ProgramTranslator.enable switch)."""
+    from . import api as _api
+
+    _api._TO_STATIC_ENABLED[0] = bool(enable_to_static_bool)
+
+
+_VERBOSITY = [0]
+_CODE_LEVEL = [0]
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """dy2static transform logging verbosity (logging_utils.set_verbosity)."""
+    _VERBOSITY[0] = int(level)
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """dy2static transformed-code dump level (logging_utils.set_code_level)."""
+    _CODE_LEVEL[0] = int(level)

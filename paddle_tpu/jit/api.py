@@ -87,6 +87,9 @@ _CONCRETIZATION_ERRORS = (
 )
 
 
+_TO_STATIC_ENABLED = [True]  # paddle.jit.enable_to_static global switch
+
+
 class StaticFunction:
     """The compiled-callable wrapper (analog of dy2static StaticFunction)."""
 
@@ -116,6 +119,8 @@ class StaticFunction:
         )
 
     def __call__(self, *args, **kwargs):
+        if not _TO_STATIC_ENABLED[0]:
+            return self._fn(*args, **kwargs)
         key = self._guard_key(args, kwargs)
         entry = self._cache.get(key)
         if entry is None:

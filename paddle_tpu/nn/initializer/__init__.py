@@ -229,3 +229,27 @@ def calculate_gain(nonlinearity, param=None):
 def set_global_initializer(weight_init, bias_init=None):
     """paddle.nn.initializer.set_global_initializer — no-op placeholder."""
     raise NotImplementedError
+
+
+class Bilinear(Initializer):
+    """Bilinear-interpolation kernel init for transposed-conv upsampling
+    (reference nn/initializer/Bilinear): weight [C_out, C_in, K, K] gets the
+    separable triangle kernel."""
+
+    def __call__(self, shape, dtype):
+        import numpy as np
+
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer expects a 4-D conv weight")
+        k = shape[-1]
+        if shape[-2] != k:
+            raise ValueError("Bilinear initializer expects square kernels")
+        f = int(np.ceil(k / 2.0))
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        og = np.ogrid[:k, :k]
+        filt = (1 - np.abs(og[0] / f - c)) * (1 - np.abs(og[1] / f - c))
+        w = np.zeros(shape, np.float32)
+        for i in range(shape[0]):
+            for j in range(shape[1]):
+                w[i, j] = filt
+        return jnp.asarray(w, dtype)

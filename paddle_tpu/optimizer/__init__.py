@@ -3,4 +3,7 @@ from . import lr  # noqa: F401
 from .optimizer import (  # noqa: F401
     Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb, Momentum, Optimizer,
     RMSProp, SGD,
+    ASGD,
+    LBFGS,
+    Rprop,
 )

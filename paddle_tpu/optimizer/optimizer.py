@@ -666,3 +666,157 @@ class Lamb(Optimizer):
         b2p._replace_value(b2p_new)
         p._replace_value((pv - lr * trust * r).astype(p._value.dtype))
         p.stop_gradient = False
+
+
+class ASGD(Optimizer):
+    """Averaged SGD (reference optimizer/asgd.py): plain SGD steps plus a
+    running average of the iterates; `d` and `y` buffers follow the
+    reference's recursive-average formulation averaged over the last n
+    gradients."""
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._n = max(int(batch_num), 1)
+
+    def _apply_one(self, p, g, wd, lr_scale):
+        d = self._add_accumulator("d", p)       # running gradient sum
+        ys = self._add_accumulator("ys", p, shape=(self._n,) + tuple(p._value.shape))
+        step = self._add_accumulator("step", p, shape=(), dtype=jnp.int32)
+        lr = self._lr_value(lr_scale)
+        gv = self._decayed_grad(p, g.value, wd).astype(d._value.dtype)
+        idx = (step.value % self._n).astype(jnp.int32)
+        old = ys.value[idx]
+        d_new = d.value - old + gv
+        ys._replace_value(ys.value.at[idx].set(gv))
+        d._replace_value(d_new)
+        step._replace_value(step.value + 1)
+        # denom = number of gradients currently held = min(step, n)
+        denom = jnp.minimum(step.value, self._n).astype(d_new.dtype)
+        p._replace_value((p._value.astype(d_new.dtype) - lr * d_new / denom).astype(p._value.dtype))
+        p.stop_gradient = False
+
+
+class Rprop(Optimizer):
+    """Resilient backprop (reference optimizer/rprop.py): per-element step
+    sizes grown/shrunk by gradient sign agreement; updates use sign only."""
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50.0),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._lr_min, self._lr_max = learning_rate_range
+        self._eta_neg, self._eta_pos = etas
+
+    def _apply_one(self, p, g, wd, lr_scale):
+        prev = self._add_accumulator("prev_grad", p)
+        lrs = self._add_accumulator("step_sizes", p, fill=float(self._lr_value(lr_scale)))
+        gv = g.value.astype(lrs._value.dtype)
+        sign = jnp.sign(gv * prev.value)
+        scale = jnp.where(sign > 0, self._eta_pos, jnp.where(sign < 0, self._eta_neg, 1.0))
+        lr_new = jnp.clip(lrs.value * scale, self._lr_min, self._lr_max)
+        # where the sign flipped, skip the update (classic Rprop-)
+        g_eff = jnp.where(sign < 0, 0.0, gv)
+        p._replace_value((p._value.astype(gv.dtype) - lr_new * jnp.sign(g_eff)).astype(p._value.dtype))
+        prev._replace_value(g_eff)
+        lrs._replace_value(lr_new)
+        p.stop_gradient = False
+
+
+class LBFGS(Optimizer):
+    """Limited-memory BFGS with strong-Wolfe-free backtracking closure line
+    search (reference optimizer/lbfgs.py contract: step(closure) re-evaluates
+    the loss). History is kept host-side as device arrays; the two-loop
+    recursion runs as jnp ops."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9, history_size=100,
+                 line_search_fn=None, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._max_iter = max_iter
+        self._tol_grad = tolerance_grad
+        self._tol_change = tolerance_change
+        self._hist = history_size
+        self._line_search = line_search_fn
+        self._s, self._y = [], []
+        self._prev_flat_grad = None
+        self.disable_fusion()
+
+    def _flat(self, arrs):
+        return jnp.concatenate([a.reshape(-1) for a in arrs])
+
+    def _gather(self):
+        params = [p for p in self._param_list() if p.grad is not None]
+        flat_g = self._flat([p.grad._value.astype(jnp.float32) for p in params])
+        return params, flat_g
+
+    def _param_list(self):
+        return [p for _g, p in self._all_params()]
+
+    def _direction(self, flat_g):
+        q = flat_g
+        alphas = []
+        for s, y in zip(reversed(self._s), reversed(self._y)):
+            rho = 1.0 / jnp.maximum(jnp.vdot(y, s), 1e-10)
+            a = rho * jnp.vdot(s, q)
+            alphas.append((a, rho, s, y))
+            q = q - a * y
+        if self._y:
+            y_last, s_last = self._y[-1], self._s[-1]
+            gamma = jnp.vdot(s_last, y_last) / jnp.maximum(jnp.vdot(y_last, y_last), 1e-10)
+            q = q * gamma
+        for a, rho, s, y in reversed(alphas):
+            b = rho * jnp.vdot(y, q)
+            q = q + s * (a - b)
+        return -q
+
+    def step(self, closure=None):
+        if closure is None:
+            raise ValueError("LBFGS.step requires a closure re-evaluating the loss")
+        loss = closure()
+        params, flat_g = self._gather()
+        shapes = [tuple(p._value.shape) for p in params]
+        import numpy as _np
+
+        sizes = [int(_np.prod(s)) if s else 1 for s in shapes]
+        lr = float(self._lr_value(1.0))
+
+        for _ in range(self._max_iter):
+            if float(jnp.max(jnp.abs(flat_g))) <= self._tol_grad:
+                break
+            d = self._direction(flat_g)
+            flat_p = self._flat([p._value.astype(jnp.float32) for p in params])
+            t = lr
+            t_applied = t
+            # backtracking on the closure
+            for _ls in range(10):
+                t_applied = t
+                new_flat = flat_p + t * d
+                off = 0
+                for p, shp, n in zip(params, shapes, sizes):
+                    p._replace_value(new_flat[off:off + n].reshape(shp).astype(p._value.dtype))
+                    p.stop_gradient = False
+                    off += n
+                new_loss = closure()
+                if float(new_loss.numpy()) <= float(loss.numpy()) + 1e-4 * t * float(jnp.vdot(flat_g, d)):
+                    break
+                t *= 0.5
+            t = t_applied  # the step actually in the params (s must match it)
+            _, new_g = self._gather()
+            s = (t * d).astype(jnp.float32)
+            yv = new_g - flat_g
+            if float(jnp.vdot(s, yv)) > 1e-10:
+                self._s.append(s)
+                self._y.append(yv)
+                if len(self._s) > self._hist:
+                    self._s.pop(0)
+                    self._y.pop(0)
+            if float(jnp.max(jnp.abs(t * d))) <= self._tol_change:
+                loss = new_loss
+                flat_g = new_g
+                break
+            loss = new_loss
+            flat_g = new_g
+        self.clear_grad()
+        return loss

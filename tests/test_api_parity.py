@@ -179,6 +179,17 @@ NAMESPACE_MODULES = [
     ("vision/transforms/__init__.py", "paddle_tpu.vision.transforms"),
     ("vision/ops.py", "paddle_tpu.vision.ops"),
     ("distributed/__init__.py", "paddle_tpu.distributed"),
+    ("optimizer/__init__.py", "paddle_tpu.optimizer"),
+    ("optimizer/lr.py", "paddle_tpu.optimizer.lr"),
+    ("amp/__init__.py", "paddle_tpu.amp"),
+    ("jit/__init__.py", "paddle_tpu.jit"),
+    ("io/__init__.py", "paddle_tpu.io"),
+    ("nn/initializer/__init__.py", "paddle_tpu.nn.initializer"),
+    ("metric/__init__.py", "paddle_tpu.metric"),
+    ("autograd/__init__.py", "paddle_tpu.autograd"),
+    ("incubate/__init__.py", "paddle_tpu.incubate"),
+    ("incubate/nn/functional/__init__.py", "paddle_tpu.incubate.nn.functional"),
+    ("distribution/__init__.py", "paddle_tpu.distribution"),
 ]
 
 

@@ -1,0 +1,124 @@
+"""r3 distribution families vs scipy/torch oracles (reference
+python/paddle/distribution/{binomial,cauchy,continuous_bernoulli,
+exponential_family,multivariate_normal}.py)."""
+import numpy as np
+import pytest
+import scipy.stats as st
+import torch
+
+import paddle_tpu as paddle
+from paddle_tpu.distribution import (
+    Binomial, Cauchy, ContinuousBernoulli, ExponentialFamily, MultivariateNormal,
+)
+
+
+def _f(x):
+    return paddle.to_tensor(np.float32(x))
+
+
+def test_binomial():
+    paddle.seed(0)
+    b = Binomial(_f(10), _f(0.3))
+    for k in (0, 3, 7, 10):
+        np.testing.assert_allclose(
+            float(b.log_prob(_f(k)).numpy()), st.binom.logpmf(k, 10, 0.3), rtol=6e-4)
+    assert float(b.mean.numpy()) == pytest.approx(3.0)
+    assert float(b.variance.numpy()) == pytest.approx(2.1)
+    s = b.sample([4000]).numpy()
+    assert abs(s.mean() - 3.0) < 0.15 and s.min() >= 0 and s.max() <= 10
+    np.testing.assert_allclose(float(b.entropy().numpy()), st.binom.entropy(10, 0.3), rtol=2e-3)
+
+
+def test_cauchy():
+    c = Cauchy(_f(1.0), _f(2.0))
+    np.testing.assert_allclose(float(c.log_prob(_f(0.5)).numpy()),
+                               st.cauchy.logpdf(0.5, 1.0, 2.0), rtol=1e-5)
+    np.testing.assert_allclose(float(c.cdf(_f(2.0)).numpy()),
+                               st.cauchy.cdf(2.0, 1.0, 2.0), rtol=1e-5)
+    np.testing.assert_allclose(float(c.entropy().numpy()),
+                               st.cauchy.entropy(1.0, 2.0), rtol=1e-5)
+    with pytest.raises(ValueError):
+        _ = c.mean
+    c2 = Cauchy(_f(0.0), _f(1.0))
+    t1 = torch.distributions.Cauchy(torch.tensor(1.0), torch.tensor(2.0))
+    t2 = torch.distributions.Cauchy(torch.tensor(0.0), torch.tensor(1.0))
+    np.testing.assert_allclose(float(c.kl_divergence(c2).numpy()),
+                               float(torch.distributions.kl_divergence(t1, t2)), rtol=1e-5)
+    paddle.seed(1)
+    med = float(np.median(c.sample([8001]).numpy()))
+    assert abs(med - 1.0) < 0.25
+
+
+@pytest.mark.parametrize("p", [0.2, 0.5, 0.85])
+def test_continuous_bernoulli_vs_torch(p):
+    cb = ContinuousBernoulli(_f(p))
+    t = torch.distributions.ContinuousBernoulli(probs=torch.tensor(p))
+    np.testing.assert_allclose(float(cb.log_prob(_f(0.7)).numpy()),
+                               float(t.log_prob(torch.tensor(0.7))), rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(float(cb.mean.numpy()), float(t.mean), rtol=1e-3)
+    np.testing.assert_allclose(float(cb.variance.numpy()), float(t.variance), rtol=2e-3)
+    np.testing.assert_allclose(float(cb.cdf(_f(0.4)).numpy()),
+                               float(t.cdf(torch.tensor(0.4))), rtol=1e-3, atol=1e-4)
+    paddle.seed(2)
+    s = cb.sample([4000]).numpy()
+    assert abs(s.mean() - float(t.mean)) < 0.03
+
+
+def test_multivariate_normal():
+    rng = np.random.RandomState(0)
+    A = rng.randn(3, 3).astype(np.float32)
+    cov = (A @ A.T + 3 * np.eye(3)).astype(np.float32)
+    mu = rng.randn(3).astype(np.float32)
+    mvn = MultivariateNormal(paddle.to_tensor(mu), covariance_matrix=paddle.to_tensor(cov))
+    x = rng.randn(3).astype(np.float32)
+    np.testing.assert_allclose(float(mvn.log_prob(paddle.to_tensor(x)).numpy()),
+                               st.multivariate_normal.logpdf(x, mu, cov), rtol=1e-4)
+    np.testing.assert_allclose(float(mvn.entropy().numpy()),
+                               st.multivariate_normal.entropy(mu, cov), rtol=1e-4)
+    np.testing.assert_allclose(mvn.covariance_matrix.numpy(), cov, rtol=1e-4)
+
+    mvn2 = MultivariateNormal(paddle.to_tensor(mu + 1),
+                              covariance_matrix=paddle.to_tensor(cov * 2))
+    t1 = torch.distributions.MultivariateNormal(torch.from_numpy(mu), torch.from_numpy(cov))
+    t2 = torch.distributions.MultivariateNormal(torch.from_numpy(mu + 1), torch.from_numpy(cov * 2))
+    np.testing.assert_allclose(float(mvn.kl_divergence(mvn2).numpy()),
+                               float(torch.distributions.kl_divergence(t1, t2)), rtol=1e-4)
+
+    paddle.seed(3)
+    s = mvn.sample([6000]).numpy()
+    np.testing.assert_allclose(s.mean(0), mu, atol=0.12)
+    np.testing.assert_allclose(np.cov(s.T), cov, atol=0.45)
+
+    # precision-matrix construction agrees
+    mvp = MultivariateNormal(paddle.to_tensor(mu),
+                             precision_matrix=paddle.to_tensor(np.linalg.inv(cov).astype(np.float32)))
+    np.testing.assert_allclose(float(mvp.log_prob(paddle.to_tensor(x)).numpy()),
+                               st.multivariate_normal.logpdf(x, mu, cov), rtol=1e-3)
+    with pytest.raises(ValueError):
+        MultivariateNormal(paddle.to_tensor(mu))
+
+
+def test_exponential_family_entropy_bregman():
+    # Normal as an exponential family: entropy via the Bregman identity must
+    # match the closed form
+    class _NormalEF(ExponentialFamily):
+        def __init__(self, loc, scale):
+            self.loc, self.scale = np.float32(loc), np.float32(scale)
+            super().__init__(batch_shape=())
+
+        @property
+        def _natural_parameters(self):
+            return (self.loc / self.scale ** 2, -0.5 / self.scale ** 2)
+
+        def _log_normalizer(self, n1, n2):
+            import jax.numpy as jnp
+
+            return -(n1 ** 2) / (4 * n2) - 0.5 * jnp.log(-2 * n2)
+
+        @property
+        def _mean_carrier_measure(self):
+            return 0.5 * np.log(2 * np.pi)
+
+    ef = _NormalEF(1.0, 2.0)
+    want = st.norm.entropy(1.0, 2.0)
+    np.testing.assert_allclose(float(ef.entropy().numpy()), want, rtol=1e-5)

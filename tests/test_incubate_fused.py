@@ -423,3 +423,146 @@ def test_variable_length_memory_efficient_attention():
     p = np.exp(lg - lg.max(-1, keepdims=True)); p /= p.sum(-1, keepdims=True)
     o = np.einsum("hqk,hkd->hqd", p, np.repeat(v1[b, :, :L], H, 0))
     np.testing.assert_allclose(out_c[b, :, :L], o, rtol=2e-4, atol=2e-5)
+
+
+def test_fused_matmul_bias_and_bias_dropout_residual_ln():
+    import paddle_tpu.incubate.nn.functional as IF
+    import paddle_tpu.nn.functional as F
+
+    rng = np.random.RandomState(6)
+    x = paddle.to_tensor(rng.randn(4, 5).astype(np.float32))
+    y = paddle.to_tensor(rng.randn(5, 3).astype(np.float32))
+    b = paddle.to_tensor(rng.randn(3).astype(np.float32))
+    np.testing.assert_allclose(
+        IF.fused_matmul_bias(x, y, b).numpy(),
+        x.numpy() @ y.numpy() + b.numpy(), rtol=1e-5)
+    np.testing.assert_allclose(
+        IF.fused_matmul_bias(x, paddle.to_tensor(y.numpy().T), transpose_y=True).numpy(),
+        x.numpy() @ y.numpy(), rtol=1e-5)
+
+    res = paddle.to_tensor(rng.randn(4, 8).astype(np.float32))
+    h = paddle.to_tensor(rng.randn(4, 8).astype(np.float32))
+    scale = paddle.to_tensor(np.ones(8, np.float32))
+    bias = paddle.to_tensor(np.zeros(8, np.float32))
+    out = IF.fused_bias_dropout_residual_layer_norm(
+        h, res, ln_scale=scale, ln_bias=bias, dropout_rate=0.0).numpy()
+    ref = F.layer_norm(paddle.to_tensor(h.numpy() + res.numpy()), 8, scale, bias).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_fused_ec_moe_vs_loop_oracle():
+    import paddle_tpu.incubate.nn.functional as IF
+    import scipy.special as sps
+
+    rng = np.random.RandomState(7)
+    B, S, D, E, FF = 2, 3, 8, 4, 16
+    x = rng.randn(B, S, D).astype(np.float32)
+    gate = rng.randn(B, S, E).astype(np.float32)
+    w0 = rng.randn(E, D, FF).astype(np.float32) * 0.1
+    b0 = rng.randn(E, 1, FF).astype(np.float32) * 0.1
+    w1 = rng.randn(E, FF, D).astype(np.float32) * 0.1
+    b1 = rng.randn(E, 1, D).astype(np.float32) * 0.1
+
+    out = IF.fused_ec_moe(*[paddle.to_tensor(a) for a in (x, gate, w0, b0, w1, b1)],
+                          act_type="relu").numpy()
+
+    probs = sps.softmax(gate, -1)
+    want = np.zeros_like(x)
+    for e in range(E):
+        h = np.maximum(x @ w0[e] + b0[e], 0)
+        oe = h @ w1[e] + b1[e]
+        want += probs[..., e:e + 1] * oe
+    np.testing.assert_allclose(out, want, rtol=2e-4, atol=2e-5)
+
+
+def test_fused_multi_transformer_vs_layer_oracle():
+    import paddle_tpu.incubate.nn.functional as IF
+    import paddle_tpu.nn.functional as F
+    import scipy.special as sps
+
+    rng = np.random.RandomState(8)
+    B, S, H, Dh, L = 1, 4, 2, 4, 2
+    D = H * Dh
+    FF = 3 * D
+    x = rng.randn(B, S, D).astype(np.float32)
+
+    ln_s = [paddle.to_tensor(np.ones(D, np.float32)) for _ in range(L)]
+    ln_b = [paddle.to_tensor(np.zeros(D, np.float32)) for _ in range(L)]
+    qkv_w = [paddle.to_tensor(rng.randn(3, H, Dh, D).astype(np.float32) * 0.2) for _ in range(L)]
+    qkv_b = [paddle.to_tensor(rng.randn(3, H, Dh).astype(np.float32) * 0.1) for _ in range(L)]
+    lin_w = [paddle.to_tensor(rng.randn(D, D).astype(np.float32) * 0.2) for _ in range(L)]
+    lin_b = [paddle.to_tensor(np.zeros(D, np.float32)) for _ in range(L)]
+    f_ln_s = [paddle.to_tensor(np.ones(D, np.float32)) for _ in range(L)]
+    f_ln_b = [paddle.to_tensor(np.zeros(D, np.float32)) for _ in range(L)]
+    ff1_w = [paddle.to_tensor(rng.randn(D, FF).astype(np.float32) * 0.2) for _ in range(L)]
+    ff1_b = [paddle.to_tensor(np.zeros(FF, np.float32)) for _ in range(L)]
+    ff2_w = [paddle.to_tensor(rng.randn(FF, D).astype(np.float32) * 0.2) for _ in range(L)]
+    ff2_b = [paddle.to_tensor(np.zeros(D, np.float32)) for _ in range(L)]
+
+    out = IF.fused_multi_transformer(
+        paddle.to_tensor(x), ln_s, ln_b, qkv_w, qkv_b, lin_w, lin_b,
+        f_ln_s, f_ln_b, ff1_w, ff1_b, ff2_w, ff2_b,
+        pre_layer_norm=True, activation="gelu", training=False).numpy()
+
+    def np_ln(v):
+        mu = v.mean(-1, keepdims=True)
+        return (v - mu) / np.sqrt(v.var(-1, keepdims=True) + 1e-5)
+
+    def np_gelu(v):
+        import scipy.special as sp
+        return 0.5 * v * (1 + sp.erf(v / np.sqrt(2)))
+
+    h = x
+    for i in range(L):
+        res = h
+        ln = np_ln(h)
+        qkv = np.einsum("bsd,thed->bsthe", ln, qkv_w[i].numpy()) + qkv_b[i].numpy()[None, None]
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        qh, kh, vh = (np.swapaxes(t, 1, 2) for t in (q, k, v))
+        lg = np.einsum("bhqd,bhkd->bhqk", qh, kh) / np.sqrt(Dh)
+        cm = np.tril(np.ones((S, S), bool))
+        lg = np.where(cm, lg, -1e30)
+        p = sps.softmax(lg, -1)
+        att = np.swapaxes(np.einsum("bhqk,bhkd->bhqd", p, vh), 1, 2).reshape(B, S, D)
+        h = res + (att @ lin_w[i].numpy() + lin_b[i].numpy())
+        res = h
+        ff = np_gelu(np_ln(h) @ ff1_w[i].numpy() + ff1_b[i].numpy())
+        h = res + (ff @ ff2_w[i].numpy() + ff2_b[i].numpy())
+
+    np.testing.assert_allclose(out, h, rtol=2e-4, atol=2e-4)
+
+
+def test_fused_multi_transformer_decode_cache():
+    """Prefill then one decode step through the fused stack must equal a
+    full-length forward over the concatenated sequence."""
+    import paddle_tpu.incubate.nn.functional as IF
+
+    rng = np.random.RandomState(9)
+    B, S, H, Dh, L, MAX = 1, 3, 2, 4, 1, 8
+    D = H * Dh
+    FF = 2 * D
+    mk = lambda *shape, scale=0.2: paddle.to_tensor(rng.randn(*shape).astype(np.float32) * scale)
+    ln_s = [paddle.to_tensor(np.ones(D, np.float32))]
+    ln_b = [paddle.to_tensor(np.zeros(D, np.float32))]
+    args = dict(
+        ln_scales=ln_s, ln_biases=ln_b,
+        qkv_weights=[mk(3, H, Dh, D)], qkv_biases=[mk(3, H, Dh, scale=0.1)],
+        linear_weights=[mk(D, D)], linear_biases=[paddle.to_tensor(np.zeros(D, np.float32))],
+        ffn_ln_scales=[paddle.to_tensor(np.ones(D, np.float32))],
+        ffn_ln_biases=[paddle.to_tensor(np.zeros(D, np.float32))],
+        ffn1_weights=[mk(D, FF)], ffn1_biases=[paddle.to_tensor(np.zeros(FF, np.float32))],
+        ffn2_weights=[mk(FF, D)], ffn2_biases=[paddle.to_tensor(np.zeros(D, np.float32))],
+        pre_layer_norm=True, activation="gelu", training=False,
+    )
+    xs = rng.randn(B, S + 1, D).astype(np.float32)
+
+    # oracle: full causal forward over S+1 tokens
+    full = IF.fused_multi_transformer(paddle.to_tensor(xs), **args).numpy()
+
+    # prefill S tokens into the cache, then decode token S
+    cache = [paddle.to_tensor(np.zeros((2, B, H, MAX, Dh), np.float32))]
+    out_pre, cache = IF.fused_multi_transformer(
+        paddle.to_tensor(xs[:, :S]), cache_kvs=cache, **args)
+    out_dec, cache = IF.fused_multi_transformer(
+        paddle.to_tensor(xs[:, S:]), cache_kvs=cache, time_step=S, **args)
+    np.testing.assert_allclose(out_dec.numpy()[:, 0], full[:, S], rtol=2e-4, atol=2e-4)

@@ -322,3 +322,51 @@ def test_disable_fusion_preserves_moments():
     np.testing.assert_allclose(b1p, float(sd_before["beta1_pow_0"]) * 0.9, rtol=1e-6)
     # moments evolved from the fused values, not from zero
     assert not np.allclose(sd_after["moment2_0"].numpy(), 0.0)
+
+
+def test_asgd_rprop_converge():
+    """r3: ASGD and Rprop (reference optimizer/asgd.py, rprop.py)."""
+    for name, lr, steps in (("ASGD", 0.05, 300), ("Rprop", 0.05, 120)):
+        paddle.seed(0)
+        w = paddle.to_tensor(np.array([3.0, -2.0], np.float32))
+        w.stop_gradient = False
+        opt = getattr(paddle.optimizer, name)(learning_rate=lr, parameters=[w])
+        for _ in range(steps):
+            loss = (w ** 2).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert float(loss.numpy()) < 1e-2, (name, float(loss.numpy()))
+
+
+def test_lbfgs_quadratic_exact():
+    """LBFGS with closure (reference optimizer/lbfgs.py): quadratic with
+    known minimum 0.5 at w=(0.5, 0)."""
+    paddle.seed(0)
+    w = paddle.to_tensor(np.array([3.0, -2.0], np.float32))
+    w.stop_gradient = False
+    opt = paddle.optimizer.LBFGS(learning_rate=1.0, parameters=[w], max_iter=10)
+
+    def closure():
+        opt.clear_grad()
+        loss = (w ** 2).sum() + (w[0] - 1) ** 2
+        loss.backward()
+        return loss
+
+    for _ in range(3):
+        loss = opt.step(closure)
+    assert float(loss.numpy()) == pytest.approx(0.5, abs=1e-4)
+    np.testing.assert_allclose(w.numpy(), [0.5, 0.0], atol=1e-3)
+    with pytest.raises(ValueError):
+        opt.step()
+
+
+def test_linear_lr():
+    sch = paddle.optimizer.lr.LinearLR(0.1, total_steps=10, start_factor=0.5)
+    vals = []
+    for _ in range(12):
+        vals.append(sch.last_lr)
+        sch.step()
+    assert vals[0] == pytest.approx(0.05)
+    assert vals[5] == pytest.approx(0.075)
+    assert vals[10] == pytest.approx(0.1) and vals[11] == pytest.approx(0.1)
