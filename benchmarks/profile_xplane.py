@@ -24,13 +24,16 @@ from paddle_tpu.models import ErnieForMaskedLM, ErnieModel
 
 
 def main():
-    batch, seq = int(os.environ.get("BENCH_BATCH", 64)), 128
+    batch = int(os.environ.get("BENCH_BATCH", 64))
+    seq = int(os.environ.get("BENCH_SEQ", 128))
+    heads = int(os.environ.get("BENCH_HEADS", 12))
     paddle.seed(0)
     model = ErnieForMaskedLM(
         ErnieModel(
             vocab_size=40000, hidden_size=768, num_hidden_layers=12,
-            num_attention_heads=12, intermediate_size=3072,
+            num_attention_heads=heads, intermediate_size=3072,
             hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+            max_position_embeddings=max(512, seq),
         )
     )
     opt = paddle.optimizer.AdamW(1e-4, parameters=model.parameters(), weight_decay=0.01)

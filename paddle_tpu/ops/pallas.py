@@ -24,28 +24,70 @@ import os
 import jax
 from jax import numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
-_BLOCK_Q = 128
-_BLOCK_K = 128
+# Shape granularity accepted by the kernel (usable() gate): seq lengths
+# must be multiples of this. Actual block sizes are picked per call by
+# _pick_block — measured on TPU v5 lite, 512x512 blocks run the S=4096
+# fwd+bwd ~5x faster than 128x128 (6.0 vs 32.7 ms; loop/revisit overhead
+# dominates small blocks), so use the largest divisor <= 512.
+_MIN_BLOCK = 128
+_MAX_BLOCK_Q = 512
+_MAX_BLOCK_K = 512
+
+
+def _pick_block(s, cap):
+    for b in (512, 384, 256, 128):
+        if b <= cap and s % b == 0:
+            return b
+    return _MIN_BLOCK
+
+
+def _dot_nt(a, b):
+    """a @ b.T with f32 accumulation, inputs kept in their storage dtype so
+    the MXU runs at the bf16 rate (casting to f32 first quarters it)."""
+    return jax.lax.dot_general(
+        a, b, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+
+def _dot_nn(a, b):
+    """a @ b with f32 accumulation (see _dot_nt)."""
+    return jax.lax.dot_general(
+        a, b, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+
+def _dot_tn(a, b):
+    """a.T @ b with f32 accumulation (see _dot_nt)."""
+    return jax.lax.dot_general(
+        a, b, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
 
 # Auto-dispatch threshold: below this kv length the XLA-fused plain-softmax
-# chain WINS — measured on TPU v5 lite (benchmarks/attn_crossover.py,
-# fwd+bwd, random cotangents): S=128: xla 0.0ms vs flash 3.5ms; S=2048:
-# 11.6 vs 13.8; S=4096: 25.6 vs 30.6. Flash's value below that point is
-# only the O(S) memory (no [B,H,S,S] logits buffer), which starts to
-# matter for HBM around S~4k (B*H*S^2 f32 logits ~1.6-3.2 GB). Explicit
-# flash_attention()/flash_attention_bshd() calls are NOT gated — only the
+# chain WINS — measured on TPU v5 lite with the r4 tuned kernel (bf16 MXU
+# inputs + 512x512 blocks; benchmarks/attn_crossover.py, fwd+bwd, random
+# cotangents, tokens held constant at B*S=8192): S=128: xla 0.65ms vs
+# flash 1.69; S=256: 1.10 vs 1.88; S=512: 2.10 vs 1.64; S=1024: 3.93 vs
+# 2.69; S=4096: 22.6 vs 4-6. Explicit flash_attention()/
+# flash_attention_bshd() calls are NOT gated — only the
 # scaled_dot_product_attention auto-dispatch.
 try:
-    _FLASH_MIN_SK = int(os.environ.get("PADDLE_TPU_FLASH_MIN_SEQ", 4096))
+    _FLASH_MIN_SK = int(os.environ.get("PADDLE_TPU_FLASH_MIN_SEQ", 512))
 except ValueError:
     import warnings
 
-    warnings.warn("PADDLE_TPU_FLASH_MIN_SEQ is not an integer; using 4096")
-    _FLASH_MIN_SK = 4096
+    warnings.warn("PADDLE_TPU_FLASH_MIN_SEQ is not an integer; using 512")
+    _FLASH_MIN_SK = 512
 
 # tests on the CPU mesh flip this to run kernels in pallas interpret mode
 _INTERPRET = False
+
+# every grid axis is an independent (bh, block) tile — declaring them
+# parallel lets Mosaic pipeline HBM->VMEM copies across grid steps
+_COMPILER_PARAMS = pltpu.CompilerParams(
+    dimension_semantics=("parallel", "parallel")
+)
 
 
 def _on_tpu() -> bool:
@@ -69,7 +111,7 @@ def flash_attention_usable(q, causal, dropout_p, k=None, v=None) -> bool:
     if q.ndim != 4:
         return False
     b, sq, h, d = q.shape
-    if not (sq % _BLOCK_Q == 0 and d <= 256 and sq >= _BLOCK_Q):
+    if not (sq % _MIN_BLOCK == 0 and d <= 256 and sq >= _MIN_BLOCK):
         return False
     for other in (k, v):
         if other is None:
@@ -77,7 +119,7 @@ def flash_attention_usable(q, causal, dropout_p, k=None, v=None) -> bool:
         ob, sk, oh, od = other.shape
         if (ob, oh, od) != (b, h, d):
             return False
-        if not (sk % _BLOCK_K == 0 and sk >= _BLOCK_K):
+        if not (sk % _MIN_BLOCK == 0 and sk >= _MIN_BLOCK):
             return False
         if causal and sk < sq:
             # bottom-right-aligned causal with kv shorter than q fully masks
@@ -95,6 +137,24 @@ def flash_attention_profitable(q, causal, dropout_p, k=None, v=None) -> bool:
         return False
     sk = (k if k is not None else q).shape[1]
     return sk >= _FLASH_MIN_SK
+
+
+def _mask_boundary(logits, off, qi, ki, bq, bk):
+    """Causal mask for one (qi, ki) tile, applied ONLY when the tile
+    straddles the diagonal — fully-visible tiles skip the iota/select VPU
+    work entirely (fully-hidden tiles are never visited: the kmax/qmin loop
+    bounds exclude them). A tile is fully visible iff its smallest q
+    position sees its largest k position: off + qi*bq >= ki*bk + bk - 1."""
+    qi = jnp.asarray(qi, jnp.int32)
+    ki = jnp.asarray(ki, jnp.int32)
+
+    def apply(l):
+        qpos = off + qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        kpos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        return jnp.where(qpos >= kpos, l, -1e30)
+
+    full = off + qi * bq >= ki * bk + bk - 1
+    return jax.lax.cond(full, lambda l: l, apply, logits)
 
 
 def _ref_attention_bshd(q, k, v, causal, sm_scale):
@@ -118,21 +178,21 @@ def _ref_attention_bshd(q, k, v, causal, sm_scale):
 # forward kernel: online softmax over K blocks, emits out + logsumexp
 # ---------------------------------------------------------------------------
 
-def _fwd_kernels(sq, sk, d, causal, scale):
-    n_k = sk // _BLOCK_K
+def _fwd_kernels(sq, sk, d, causal, scale, bq, bk):
+    n_k = sk // bk
     off = sk - sq  # causal bottom-right alignment offset
 
     def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref):
         qi = pl.program_id(1)
-        qb = q_ref[...].astype(jnp.float32) * scale
+        qb = q_ref[...]  # storage dtype — bf16 in, MXU at bf16 rate
 
-        m0 = jnp.full((_BLOCK_Q, 1), -1e30, jnp.float32)
-        l0 = jnp.zeros((_BLOCK_Q, 1), jnp.float32)
-        acc0 = jnp.zeros((_BLOCK_Q, d), jnp.float32)
+        m0 = jnp.full((bq, 1), -1e30, jnp.float32)
+        l0 = jnp.zeros((bq, 1), jnp.float32)
+        acc0 = jnp.zeros((bq, d), jnp.float32)
 
         if causal:
             # last k position visible to this q block: off + (qi+1)*BQ - 1
-            kmax_dyn = (off + (qi + 1) * _BLOCK_Q + _BLOCK_K - 1) // _BLOCK_K
+            kmax_dyn = (off + (qi + 1) * bq + bk - 1) // bk
             kmax = jnp.minimum(jnp.asarray(kmax_dyn, jnp.int32), n_k)
         else:
             kmax = jnp.asarray(n_k, jnp.int32)
@@ -140,22 +200,18 @@ def _fwd_kernels(sq, sk, d, causal, scale):
         def body(ki, carry):
             m, l, acc = carry
             ki = jnp.asarray(ki, jnp.int32)
-            kb = k_ref[pl.dslice(ki * _BLOCK_K, _BLOCK_K), :].astype(jnp.float32)
-            vb = v_ref[pl.dslice(ki * _BLOCK_K, _BLOCK_K), :].astype(jnp.float32)
-            logits = qb @ kb.T
+            kb = k_ref[pl.dslice(ki * bk, bk), :]
+            vb = v_ref[pl.dslice(ki * bk, bk), :]
+            logits = _dot_nt(qb, kb) * scale
             if causal:
-                qpos = off + qi * _BLOCK_Q + jax.lax.broadcasted_iota(
-                    jnp.int32, (_BLOCK_Q, _BLOCK_K), 0
-                )
-                kpos = ki * _BLOCK_K + jax.lax.broadcasted_iota(
-                    jnp.int32, (_BLOCK_Q, _BLOCK_K), 1
-                )
-                logits = jnp.where(qpos >= kpos, logits, -1e30)
+                logits = _mask_boundary(logits, off, qi, ki, bq, bk)
             m_new = jnp.maximum(m, jnp.max(logits, axis=-1, keepdims=True))
             p = jnp.exp(logits - m_new)
             alpha = jnp.exp(m - m_new)
             l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-            acc_new = acc * alpha + p @ vb
+            # p cast to the storage dtype before the MXU matmul — the same
+            # precision the XLA fallback uses (softmax.astype(q.dtype) @ v)
+            acc_new = acc * alpha + _dot_nn(p.astype(vb.dtype), vb)
             return m_new, l_new, acc_new
 
         m, l, acc = jax.lax.fori_loop(
@@ -175,24 +231,27 @@ def _flash_fwd_impl(q, k, v, causal, sm_scale):
     qr = jnp.swapaxes(q, 1, 2).reshape(b * h, sq, d)
     kr = jnp.swapaxes(k, 1, 2).reshape(b * h, sk, d)
     vr = jnp.swapaxes(v, 1, 2).reshape(b * h, sk, d)
-    n_q = sq // _BLOCK_Q
+    bq = _pick_block(sq, _MAX_BLOCK_Q)
+    bk = _pick_block(sk, _MAX_BLOCK_K)
+    n_q = sq // bq
 
     out, lse = pl.pallas_call(
-        _fwd_kernels(sq, sk, d, causal, scale),
+        _fwd_kernels(sq, sk, d, causal, scale, bq, bk),
         grid=(b * h, n_q),
         in_specs=[
-            pl.BlockSpec((None, _BLOCK_Q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((None, bq, d), lambda bh, qi: (bh, qi, 0)),
             pl.BlockSpec((None, sk, d), lambda bh, qi: (bh, 0, 0)),
             pl.BlockSpec((None, sk, d), lambda bh, qi: (bh, 0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((None, _BLOCK_Q, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((None, _BLOCK_Q, 1), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((None, bq, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((None, bq, 1), lambda bh, qi: (bh, qi, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
             jax.ShapeDtypeStruct((b * h, sq, 1), jnp.float32),
         ],
+        compiler_params=_COMPILER_PARAMS,
         interpret=_INTERPRET,
     )(qr, kr, vr)
     return jnp.swapaxes(out.reshape(b, h, sq, d), 1, 2), lse
@@ -202,62 +261,56 @@ def _flash_fwd_impl(q, k, v, causal, sm_scale):
 # backward kernels: recompute-based (O(S) memory), FA2 formulation
 # ---------------------------------------------------------------------------
 
-def _bwd_dq_kernel(sq, sk, d, causal, scale):
-    n_k = sk // _BLOCK_K
+def _bwd_dq_kernel(sq, sk, d, causal, scale, bq, bk):
+    n_k = sk // bk
     off = sk - sq
 
     def kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref):
         qi = pl.program_id(1)
-        qb = q_ref[...].astype(jnp.float32)
-        dob = do_ref[...].astype(jnp.float32)
+        qb = q_ref[...]
+        dob = do_ref[...]
         lse = lse_ref[...].astype(jnp.float32)      # [BQ, 1]
         delta = delta_ref[...].astype(jnp.float32)  # [BQ, 1]
 
         if causal:
-            kmax_dyn = (off + (qi + 1) * _BLOCK_Q + _BLOCK_K - 1) // _BLOCK_K
+            kmax_dyn = (off + (qi + 1) * bq + bk - 1) // bk
             kmax = jnp.minimum(jnp.asarray(kmax_dyn, jnp.int32), n_k)
         else:
             kmax = jnp.asarray(n_k, jnp.int32)
 
         def body(ki, dq):
             ki = jnp.asarray(ki, jnp.int32)
-            kb = k_ref[pl.dslice(ki * _BLOCK_K, _BLOCK_K), :].astype(jnp.float32)
-            vb = v_ref[pl.dslice(ki * _BLOCK_K, _BLOCK_K), :].astype(jnp.float32)
-            s = (qb @ kb.T) * scale
+            kb = k_ref[pl.dslice(ki * bk, bk), :]
+            vb = v_ref[pl.dslice(ki * bk, bk), :]
+            s = _dot_nt(qb, kb) * scale
             if causal:
-                qpos = off + qi * _BLOCK_Q + jax.lax.broadcasted_iota(
-                    jnp.int32, (_BLOCK_Q, _BLOCK_K), 0
-                )
-                kpos = ki * _BLOCK_K + jax.lax.broadcasted_iota(
-                    jnp.int32, (_BLOCK_Q, _BLOCK_K), 1
-                )
-                s = jnp.where(qpos >= kpos, s, -1e30)
+                s = _mask_boundary(s, off, qi, ki, bq, bk)
             p = jnp.exp(s - lse)
-            dp = dob @ vb.T
+            dp = _dot_nt(dob, vb)
             ds = p * (dp - delta) * scale
-            return dq + ds @ kb
+            return dq + _dot_nn(ds.astype(kb.dtype), kb)
 
         dq = jax.lax.fori_loop(
-            jnp.asarray(0, jnp.int32), kmax, body, jnp.zeros((_BLOCK_Q, d), jnp.float32)
+            jnp.asarray(0, jnp.int32), kmax, body, jnp.zeros((bq, d), jnp.float32)
         )
         dq_ref[...] = dq.astype(dq_ref.dtype)
 
     return kernel
 
 
-def _bwd_dkdv_kernel(sq, sk, d, causal, scale):
-    n_q = sq // _BLOCK_Q
+def _bwd_dkdv_kernel(sq, sk, d, causal, scale, bq, bk):
+    n_q = sq // bq
     off = sk - sq
 
     def kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref):
         ki = pl.program_id(1)
-        kb = k_ref[...].astype(jnp.float32)
-        vb = v_ref[...].astype(jnp.float32)
+        kb = k_ref[...]
+        vb = v_ref[...]
 
         if causal:
             # first q block whose last position sees this k block:
             # need off + q_end > ki*BK  ->  q from (ki*BK - off) // BQ
-            qmin_dyn = jnp.maximum(ki * _BLOCK_K - off, 0) // _BLOCK_Q
+            qmin_dyn = jnp.maximum(ki * bk - off, 0) // bq
             qmin = jnp.asarray(qmin_dyn, jnp.int32)
         else:
             qmin = jnp.asarray(0, jnp.int32)
@@ -265,31 +318,25 @@ def _bwd_dkdv_kernel(sq, sk, d, causal, scale):
         def body(qi, carry):
             dk, dv = carry
             qi = jnp.asarray(qi, jnp.int32)
-            qb = q_ref[pl.dslice(qi * _BLOCK_Q, _BLOCK_Q), :].astype(jnp.float32)
-            dob = do_ref[pl.dslice(qi * _BLOCK_Q, _BLOCK_Q), :].astype(jnp.float32)
-            lse = lse_ref[pl.dslice(qi * _BLOCK_Q, _BLOCK_Q), :].astype(jnp.float32)
-            delta = delta_ref[pl.dslice(qi * _BLOCK_Q, _BLOCK_Q), :].astype(jnp.float32)
-            s = (qb @ kb.T) * scale
+            qb = q_ref[pl.dslice(qi * bq, bq), :]
+            dob = do_ref[pl.dslice(qi * bq, bq), :]
+            lse = lse_ref[pl.dslice(qi * bq, bq), :].astype(jnp.float32)
+            delta = delta_ref[pl.dslice(qi * bq, bq), :].astype(jnp.float32)
+            s = _dot_nt(qb, kb) * scale
             if causal:
-                qpos = off + qi * _BLOCK_Q + jax.lax.broadcasted_iota(
-                    jnp.int32, (_BLOCK_Q, _BLOCK_K), 0
-                )
-                kpos = ki * _BLOCK_K + jax.lax.broadcasted_iota(
-                    jnp.int32, (_BLOCK_Q, _BLOCK_K), 1
-                )
-                s = jnp.where(qpos >= kpos, s, -1e30)
+                s = _mask_boundary(s, off, qi, ki, bq, bk)
             p = jnp.exp(s - lse)
-            dv2 = dv + p.T @ dob
-            dp = dob @ vb.T
+            dv2 = dv + _dot_tn(p.astype(dob.dtype), dob)
+            dp = _dot_nt(dob, vb)
             ds = p * (dp - delta) * scale
-            dk2 = dk + ds.T @ qb
+            dk2 = dk + _dot_tn(ds.astype(qb.dtype), qb)
             return dk2, dv2
 
         dk, dv = jax.lax.fori_loop(
             qmin,
             jnp.asarray(n_q, jnp.int32),
             body,
-            (jnp.zeros((_BLOCK_K, d), jnp.float32), jnp.zeros((_BLOCK_K, d), jnp.float32)),
+            (jnp.zeros((bk, d), jnp.float32), jnp.zeros((bk, d), jnp.float32)),
         )
         dk_ref[...] = dk.astype(dk_ref.dtype)
         dv_ref[...] = dv.astype(dv_ref.dtype)
@@ -311,42 +358,46 @@ def _flash_bwd_impl(q, k, v, out, lse, g, causal, sm_scale):
         gr.astype(jnp.float32) * orr.astype(jnp.float32), axis=-1, keepdims=True
     )
 
-    n_q, n_k = sq // _BLOCK_Q, sk // _BLOCK_K
+    bq = _pick_block(sq, _MAX_BLOCK_Q)
+    bk = _pick_block(sk, _MAX_BLOCK_K)
+    n_q, n_k = sq // bq, sk // bk
     dq = pl.pallas_call(
-        _bwd_dq_kernel(sq, sk, d, causal, scale),
+        _bwd_dq_kernel(sq, sk, d, causal, scale, bq, bk),
         grid=(b * h, n_q),
         in_specs=[
-            pl.BlockSpec((None, _BLOCK_Q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((None, bq, d), lambda bh, qi: (bh, qi, 0)),
             pl.BlockSpec((None, sk, d), lambda bh, qi: (bh, 0, 0)),
             pl.BlockSpec((None, sk, d), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((None, _BLOCK_Q, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((None, _BLOCK_Q, 1), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((None, _BLOCK_Q, 1), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((None, bq, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((None, bq, 1), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((None, bq, 1), lambda bh, qi: (bh, qi, 0)),
         ],
-        out_specs=pl.BlockSpec((None, _BLOCK_Q, d), lambda bh, qi: (bh, qi, 0)),
+        out_specs=pl.BlockSpec((None, bq, d), lambda bh, qi: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        compiler_params=_COMPILER_PARAMS,
         interpret=_INTERPRET,
     )(qr, kr, vr, gr, lse, delta)
 
     dk, dv = pl.pallas_call(
-        _bwd_dkdv_kernel(sq, sk, d, causal, scale),
+        _bwd_dkdv_kernel(sq, sk, d, causal, scale, bq, bk),
         grid=(b * h, n_k),
         in_specs=[
             pl.BlockSpec((None, sq, d), lambda bh, ki: (bh, 0, 0)),
-            pl.BlockSpec((None, _BLOCK_K, d), lambda bh, ki: (bh, ki, 0)),
-            pl.BlockSpec((None, _BLOCK_K, d), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((None, bk, d), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((None, bk, d), lambda bh, ki: (bh, ki, 0)),
             pl.BlockSpec((None, sq, d), lambda bh, ki: (bh, 0, 0)),
             pl.BlockSpec((None, sq, 1), lambda bh, ki: (bh, 0, 0)),
             pl.BlockSpec((None, sq, 1), lambda bh, ki: (bh, 0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((None, _BLOCK_K, d), lambda bh, ki: (bh, ki, 0)),
-            pl.BlockSpec((None, _BLOCK_K, d), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((None, bk, d), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((None, bk, d), lambda bh, ki: (bh, ki, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
             jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
         ],
+        compiler_params=_COMPILER_PARAMS,
         interpret=_INTERPRET,
     )(qr, kr, vr, gr, lse, delta)
 
