@@ -42,8 +42,10 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 
-def _build(batch, seq, heads, max_pos, steps):
-    """Build model+opt+data and return a timed runner for one config."""
+def build_train_step(batch, seq, heads, max_pos=None):
+    """The benchmark workload: ERNIE-3.0-base dims MLM + AdamW, bf16 AMP,
+    to_static. Shared with benchmarks/profile_xplane.py so the profiled
+    model is BY CONSTRUCTION the benchmarked model."""
     import numpy as np
 
     import paddle_tpu as paddle
@@ -55,7 +57,7 @@ def _build(batch, seq, heads, max_pos, steps):
             vocab_size=40000, hidden_size=768, num_hidden_layers=12,
             num_attention_heads=heads, intermediate_size=3072,
             hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
-            max_position_embeddings=max_pos,
+            max_position_embeddings=max_pos if max_pos is not None else max(512, seq),
         )
     )
     opt = paddle.optimizer.AdamW(1e-4, parameters=model.parameters(), weight_decay=0.01)
@@ -72,6 +74,13 @@ def _build(batch, seq, heads, max_pos, steps):
         opt.step()
         opt.clear_grad()
         return loss
+
+    return model, train_step, ids, labels
+
+
+def _build(batch, seq, heads, max_pos, steps):
+    """Build one config and return its measured stats."""
+    model, train_step, ids, labels = build_train_step(batch, seq, heads, max_pos)
 
     def run(n):
         """n steps ending in a host fetch (forces the whole chain)."""

@@ -20,35 +20,16 @@ import numpy as np
 import jax
 
 import paddle_tpu as paddle
-from paddle_tpu.models import ErnieForMaskedLM, ErnieModel
 
 
 def main():
+    from bench import build_train_step
+
     batch = int(os.environ.get("BENCH_BATCH", 64))
     seq = int(os.environ.get("BENCH_SEQ", 128))
     heads = int(os.environ.get("BENCH_HEADS", 12))
-    paddle.seed(0)
-    model = ErnieForMaskedLM(
-        ErnieModel(
-            vocab_size=40000, hidden_size=768, num_hidden_layers=12,
-            num_attention_heads=heads, intermediate_size=3072,
-            hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
-            max_position_embeddings=max(512, seq),
-        )
-    )
-    opt = paddle.optimizer.AdamW(1e-4, parameters=model.parameters(), weight_decay=0.01)
-    rng = np.random.RandomState(0)
-    ids = paddle.to_tensor(rng.randint(0, 40000, (batch, seq)).astype(np.int64))
-    labels = paddle.to_tensor(rng.randint(0, 40000, (batch, seq)).astype(np.int64))
-
-    @paddle.jit.to_static
-    def train_step(ids, labels):
-        with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
-            loss, _ = model(ids, labels=labels)
-        loss.backward()
-        opt.step()
-        opt.clear_grad()
-        return loss
+    # same builder as bench.py: the profiled model IS the benchmarked model
+    model, train_step, ids, labels = build_train_step(batch, seq, heads)
 
     # warm + compile
     for _ in range(4):

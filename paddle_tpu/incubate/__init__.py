@@ -4,6 +4,7 @@ Reference: python/paddle/incubate/ (MoE expert parallelism, fused ops,
 autotune, auto-checkpoint). Subpackages are populated as they land.
 """
 from . import asp  # noqa: F401
+from . import autograd  # noqa: F401
 from . import autotune  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import distributed  # noqa: F401

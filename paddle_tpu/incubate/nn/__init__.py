@@ -1,8 +1,23 @@
 """paddle.incubate.nn namespace (reference: python/paddle/incubate/nn/)."""
 from . import functional  # noqa: F401
 from .layer import (  # noqa: F401
+    FusedBiasDropoutResidualLayerNorm,
+    FusedDropoutAdd,
+    FusedEcMoe,
     FusedFeedForward,
     FusedLinear,
     FusedMultiHeadAttention,
+    FusedMultiTransformer,
     FusedTransformerEncoderLayer,
 )
+
+__all__ = [
+    'FusedMultiHeadAttention',
+    'FusedFeedForward',
+    'FusedTransformerEncoderLayer',
+    'FusedMultiTransformer',
+    'FusedLinear',
+    'FusedBiasDropoutResidualLayerNorm',
+    'FusedEcMoe',
+    'FusedDropoutAdd',
+]

@@ -193,3 +193,177 @@ class FusedTransformerEncoderLayer(Layer):
     def forward(self, src, src_mask=None, cache=None):
         out = self.fused_attn(src, attn_mask=src_mask)
         return self.ffn(out)
+
+
+class FusedDropoutAdd(Layer):
+    """dropout(x) + y in one op (reference incubate/nn/layer/
+    fused_dropout_add.py:19 over fused_dropout_add)."""
+
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p = p
+        self.mode = mode
+        self.name = name
+
+    def forward(self, x, y):
+        return F.fused_dropout_add(
+            x, y, p=self.p, training=self.training, mode=self.mode,
+        )
+
+    def extra_repr(self):
+        return f"p={self.p}, mode={self.mode}"
+
+
+class FusedEcMoe(Layer):
+    """Expert-capacity-free MoE FFN over batched expert matmuls (reference
+    incubate/nn/layer/fused_ec_moe.py:19; weights [E, d, inter] so the
+    expert dimension rides one bmm on the MXU)."""
+
+    def __init__(self, hidden_size, inter_size, num_experts, act_type,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        if act_type not in ("gelu", "relu"):
+            raise NotImplementedError("Currently only support `gelu`, `relu`. ")
+        self.act_type = act_type
+        self.bmm_weight0 = self.create_parameter(
+            (num_experts, hidden_size, inter_size), attr=weight_attr)
+        self.bmm_bias0 = self.create_parameter(
+            (num_experts, 1, inter_size), attr=bias_attr, is_bias=True)
+        self.bmm_weight1 = self.create_parameter(
+            (num_experts, inter_size, hidden_size), attr=weight_attr)
+        self.bmm_bias1 = self.create_parameter(
+            (num_experts, 1, hidden_size), attr=bias_attr, is_bias=True)
+
+    def forward(self, x, gate):
+        return F.fused_ec_moe(
+            x, gate, self.bmm_weight0, self.bmm_bias0,
+            self.bmm_weight1, self.bmm_bias1, self.act_type,
+        )
+
+
+class FusedBiasDropoutResidualLayerNorm(Layer):
+    """layer_norm(residual + dropout(x + bias)) (reference
+    incubate/nn/layer/fused_transformer.py:116)."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
+                 bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        assert embed_dim > 0, (
+            "Expected embed_dim to be greater than 0, "
+            f"but received {embed_dim}"
+        )
+        self.embed_dim = embed_dim
+        self.dropout_rate = dropout_rate
+        self._epsilon = epsilon
+        self.name = name
+        from ...nn.initializer import Constant
+        self.linear_bias = self.create_parameter(
+            (embed_dim,), attr=bias_attr, is_bias=True)
+        self.ln_scale = self.create_parameter(
+            (embed_dim,), attr=weight_attr, default_initializer=Constant(1.0))
+        self.ln_bias = self.create_parameter(
+            (embed_dim,), attr=bias_attr, is_bias=True)
+
+    def forward(self, x, residual):
+        return F.fused_bias_dropout_residual_layer_norm(
+            x, residual, bias=self.linear_bias, ln_scale=self.ln_scale,
+            ln_bias=self.ln_bias, dropout_rate=self.dropout_rate,
+            ln_epsilon=self._epsilon, training=self.training,
+        )
+
+    def extra_repr(self):
+        return f"embed_dim={self.embed_dim}, dropout_rate={self.dropout_rate}"
+
+
+class FusedMultiTransformer(Layer):
+    """N fused transformer layers in one call — the serving fast path
+    (reference incubate/nn/layer/fused_transformer.py:994 over
+    fused_multi_transformer; parameter layouts match the reference's fused
+    shapes, qkv_weight [3, H, Dh, E] when trans_qkvw, so state_dicts port
+    over)."""
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward,
+                 dropout_rate=0.0, activation="gelu", normalize_before=True,
+                 ln_scale_attrs=None, ln_bias_attrs=None,
+                 qkv_weight_attrs=None, qkv_bias_attrs=None,
+                 linear_weight_attrs=None, linear_bias_attrs=None,
+                 ffn_ln_scale_attrs=None, ffn_ln_bias_attrs=None,
+                 ffn1_weight_attrs=None, ffn1_bias_attrs=None,
+                 ffn2_weight_attrs=None, ffn2_bias_attrs=None,
+                 epsilon=1e-5, num_layers=-1, nranks=1, trans_qkvw=True,
+                 ring_id=-1, name=None):
+        super().__init__()
+        assert embed_dim > 0 and num_heads > 0 and dim_feedforward > 0
+        assert embed_dim % num_heads == 0, "embed_dim must be divisible by num_heads"
+        if isinstance(qkv_weight_attrs, (list, tuple)):
+            num_layers = len(qkv_weight_attrs)
+        assert num_layers > 0
+        if nranks > 1:
+            assert ring_id != -1
+        assert num_heads % nranks == 0 and dim_feedforward % nranks == 0
+        self.normalize_before = normalize_before
+        self._epsilon = epsilon
+        self._trans_qkvw = trans_qkvw
+        self._ring_id = ring_id
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.activation = activation
+        self.dropout_rate = dropout_rate
+        self.name = name
+        heads = num_heads // nranks
+        dff = dim_feedforward // nranks
+        self._dim_feedforward = dff
+
+        def attr(attrs, i):
+            if isinstance(attrs, (list, tuple)):
+                assert len(attrs) == num_layers
+                return attrs[i]
+            return attrs
+
+        from ...nn.initializer import Constant
+        self.ln_scales, self.ln_biases = [], []
+        self.qkv_weights, self.qkv_biases = [], []
+        self.linear_weights, self.linear_biases = [], []
+        self.ffn_ln_scales, self.ffn_ln_biases = [], []
+        self.ffn1_weights, self.ffn1_biases = [], []
+        self.ffn2_weights, self.ffn2_biases = [], []
+        qkv_shape = ((3, heads, self.head_dim, embed_dim) if trans_qkvw
+                     else (embed_dim, 3, heads, self.head_dim))
+        for i in range(num_layers):
+            mk = self.create_parameter
+            specs = [
+                (self.ln_scales, f"ln_scale_{i}", (embed_dim,), attr(ln_scale_attrs, i), False, Constant(1.0)),
+                (self.ln_biases, f"ln_bias_{i}", (embed_dim,), attr(ln_bias_attrs, i), True, None),
+                (self.qkv_weights, f"qkv_weight_{i}", qkv_shape, attr(qkv_weight_attrs, i), False, None),
+                (self.qkv_biases, f"qkv_bias_{i}", (3, heads, self.head_dim), attr(qkv_bias_attrs, i), True, None),
+                (self.linear_weights, f"linear_weight_{i}", (heads * self.head_dim, embed_dim), attr(linear_weight_attrs, i), False, None),
+                (self.linear_biases, f"linear_bias_{i}", (embed_dim,), attr(linear_bias_attrs, i), True, None),
+                (self.ffn_ln_scales, f"ffn_ln_scale_{i}", (embed_dim,), attr(ffn_ln_scale_attrs, i), False, Constant(1.0)),
+                (self.ffn_ln_biases, f"ffn_ln_bias_{i}", (embed_dim,), attr(ffn_ln_bias_attrs, i), True, None),
+                (self.ffn1_weights, f"ffn1_weight_{i}", (embed_dim, dff), attr(ffn1_weight_attrs, i), False, None),
+                (self.ffn1_biases, f"ffn1_bias_{i}", (dff,), attr(ffn1_bias_attrs, i), True, None),
+                (self.ffn2_weights, f"ffn2_weight_{i}", (dff, embed_dim), attr(ffn2_weight_attrs, i), False, None),
+                (self.ffn2_biases, f"ffn2_bias_{i}", (embed_dim,), attr(ffn2_bias_attrs, i), True, None),
+            ]
+            for lst, pname, shape, a, is_bias, init in specs:
+                p = mk(shape, attr=a, is_bias=is_bias, default_initializer=init)
+                lst.append(p)
+                setattr(self, pname, p)  # register on the layer
+
+    def forward(self, src, attn_mask=None, caches=None, pre_caches=None,
+                rotary_embs=None, rotary_emb_dims=0, seq_lens=None,
+                time_step=None):
+        return F.fused_multi_transformer(
+            src, self.ln_scales, self.ln_biases, self.qkv_weights,
+            self.qkv_biases, self.linear_weights, self.linear_biases,
+            self.ffn_ln_scales, self.ffn_ln_biases, self.ffn1_weights,
+            self.ffn1_biases, self.ffn2_weights, self.ffn2_biases,
+            pre_layer_norm=self.normalize_before, epsilon=self._epsilon,
+            cache_kvs=caches, pre_caches=pre_caches, seq_lens=seq_lens,
+            rotary_embs=rotary_embs, time_step=time_step,
+            attn_mask=attn_mask, dropout_rate=self.dropout_rate,
+            rotary_emb_dims=rotary_emb_dims, activation=self.activation,
+            training=self.training, trans_qkvw=self._trans_qkvw,
+            ring_id=self._ring_id, name=self.name,
+        )

@@ -123,6 +123,10 @@ class Layer:
 
         dtype = dtype_mod.convert_dtype(dtype or self._dtype)
         init, name, trainable, lr, reg, need_clip = _resolve_attr(attr, is_bias, default_initializer)
+        if init is None:
+            # attr=False => no parameter (reference layers.py: bias_attr
+            # False skips the bias entirely and forward receives None)
+            return None
         value = init(tuple(shape), dtype)
         p = Parameter(value, trainable=trainable, name=name)
         p.optimize_attr = {"learning_rate": lr}

@@ -189,6 +189,8 @@ NAMESPACE_MODULES = [
     ("autograd/__init__.py", "paddle_tpu.autograd"),
     ("incubate/__init__.py", "paddle_tpu.incubate"),
     ("incubate/nn/functional/__init__.py", "paddle_tpu.incubate.nn.functional"),
+    ("incubate/nn/__init__.py", "paddle_tpu.incubate.nn"),
+    ("incubate/autograd/__init__.py", "paddle_tpu.incubate.autograd"),
     ("distribution/__init__.py", "paddle_tpu.distribution"),
 ]
 

@@ -1,0 +1,202 @@
+"""paddle.incubate.autograd (jvp/vjp/Jacobian/Hessian/forward_grad) and the
+r4 incubate.nn fused Layer wrappers.
+
+Reference: python/paddle/incubate/autograd/functional.py (vjp:22, jvp:80,
+Jacobian:170, Hessian:257), primapi.py (forward_grad:25, grad:108),
+incubate/nn/__init__.py (FusedMultiTransformer, FusedEcMoe, FusedDropoutAdd,
+FusedBiasDropoutResidualLayerNorm).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate import autograd as iag
+
+
+def _t(a, sg=False):
+    t = paddle.to_tensor(np.asarray(a, dtype=np.float32))
+    t.stop_gradient = sg
+    return t
+
+
+class TestVjpJvp:
+    def test_vjp_matmul_ones(self):
+        # reference doc example: func(x) = x @ x, x = ones(2,2) -> vjp = 4s
+        x = _t(np.ones((2, 2)))
+        _, g = iag.vjp(lambda x: paddle.matmul(x, x), x)
+        np.testing.assert_allclose(g.numpy(), np.full((2, 2), 4.0), rtol=1e-6)
+
+    def test_vjp_custom_cotangent(self):
+        x = _t(np.ones((2, 2)))
+        v = _t([[1.0, 0.0], [0.0, 0.0]])
+        _, g = iag.vjp(lambda x: paddle.matmul(x, x), x, v)
+        np.testing.assert_allclose(g.numpy(), [[2.0, 1.0], [1.0, 0.0]], rtol=1e-6)
+
+    def test_jvp_matmul_ones(self):
+        x = _t(np.ones((2, 2)))
+        _, j = iag.jvp(lambda x: paddle.matmul(x, x), x)
+        np.testing.assert_allclose(j.numpy(), np.full((2, 2), 4.0), rtol=1e-6)
+
+    def test_jvp_fd_verification(self):
+        # finite-difference check on a nonlinear multi-input func
+        rng = np.random.RandomState(0)
+        a0, b0 = rng.randn(3, 4).astype(np.float32), rng.randn(4, 2).astype(np.float32)
+        va, vb = rng.randn(3, 4).astype(np.float32), rng.randn(4, 2).astype(np.float32)
+
+        def f(a, b):
+            return paddle.tanh(paddle.matmul(a, b))
+
+        _, j = iag.jvp(f, [_t(a0), _t(b0)], [_t(va), _t(vb)])
+        eps = 1e-3
+        f_p = np.tanh((a0 + eps * va) @ (b0 + eps * vb))
+        f_m = np.tanh((a0 - eps * va) @ (b0 - eps * vb))
+        fd = (f_p - f_m) / (2 * eps)
+        np.testing.assert_allclose(j.numpy(), fd, rtol=1e-2, atol=1e-3)
+
+    def test_jvp_vjp_transpose_identity(self):
+        # <v, J u> == <J^T v, u> ties forward and reverse modes together
+        rng = np.random.RandomState(1)
+        x0 = rng.randn(5).astype(np.float32)
+        u = rng.randn(5).astype(np.float32)
+
+        def f(x):
+            return paddle.sin(x) * x
+
+        _, ju = iag.jvp(f, _t(x0), _t(u))
+        v = rng.randn(5).astype(np.float32)
+        _, jtv = iag.vjp(f, _t(x0), _t(v))
+        lhs = float(np.sum(v * ju.numpy()))
+        rhs = float(np.sum(jtv.numpy() * u))
+        assert abs(lhs - rhs) < 1e-4
+
+    def test_jvp_multi_output(self):
+        x = _t(np.ones((2,)))
+        ys, js = iag.jvp(lambda x: (x * x, x + 1.0), x)
+        assert isinstance(js, tuple) and len(js) == 2
+        np.testing.assert_allclose(js[0].numpy(), [2.0, 2.0], rtol=1e-6)
+        np.testing.assert_allclose(js[1].numpy(), [1.0, 1.0], rtol=1e-6)
+
+
+class TestForwardGrad:
+    def test_forward_grad_matches_jvp(self):
+        rng = np.random.RandomState(2)
+        x0 = rng.randn(4).astype(np.float32)
+        v = rng.randn(4).astype(np.float32)
+        x = _t(x0)
+        y = paddle.exp(paddle.sin(x))
+        fg = iag.forward_grad(y, x, _t(v))
+        expected = np.exp(np.sin(x0)) * np.cos(x0) * v
+        np.testing.assert_allclose(fg.numpy(), expected, rtol=1e-4, atol=1e-5)
+
+    def test_grad_api(self):
+        x = _t(np.array([1.0, 2.0]))
+        y = x * x
+        g = iag.grad(y, x)
+        np.testing.assert_allclose(g.numpy(), [2.0, 4.0], rtol=1e-6)
+
+    def test_prim_flags(self):
+        from paddle_tpu.incubate.autograd import prim_enabled
+        assert not prim_enabled()
+        iag.enable_prim()
+        assert prim_enabled()
+        iag.disable_prim()
+        assert not prim_enabled()
+
+
+class TestJacobianHessian:
+    def test_jacobian_full(self):
+        # reference doc example: func(x, y) = matmul(x, y) at x = [[1,2],[3,4]]
+        x = _t([[1.0, 2.0], [3.0, 4.0]])
+        J = iag.Jacobian(lambda a, b: paddle.matmul(a, b), [x, x])
+        full = J[:, :]
+        assert tuple(full.shape) == (4, 8)
+        expected_row0 = [1., 3., 0., 0., 1., 0., 2., 0.]
+        np.testing.assert_allclose(full.numpy()[0], expected_row0, rtol=1e-6)
+
+    def test_hessian_quadratic(self):
+        # f(x) = x^T A x has Hessian A + A^T
+        rng = np.random.RandomState(3)
+        A = rng.randn(4, 4).astype(np.float32)
+        At = paddle.to_tensor(A)
+
+        def f(x):
+            return paddle.sum(x * paddle.matmul(At, x))
+
+        x = _t(rng.randn(4).astype(np.float32))
+        H = iag.Hessian(f, x)
+        np.testing.assert_allclose(H[:, :].numpy(), A + A.T, rtol=1e-4, atol=1e-5)
+
+    def test_hessian_rejects_vector_output(self):
+        x = _t(np.ones((3,)))
+        with pytest.raises(ValueError):
+            iag.Hessian(lambda x: x * x, x)
+
+
+class TestFusedLayers:
+    def test_fused_dropout_add_eval(self):
+        from paddle_tpu.incubate.nn import FusedDropoutAdd
+        layer = FusedDropoutAdd(p=0.5)
+        layer.eval()
+        x = _t(np.ones((2, 3)))
+        y = _t(np.full((2, 3), 2.0))
+        np.testing.assert_allclose(layer(x, y).numpy(), np.full((2, 3), 3.0), rtol=1e-6)
+
+    def test_fused_dropout_add_train_p0(self):
+        from paddle_tpu.incubate.nn import FusedDropoutAdd
+        layer = FusedDropoutAdd(p=0.0)
+        x = _t(np.ones((2, 3)))
+        y = _t(np.zeros((2, 3)))
+        np.testing.assert_allclose(layer(x, y).numpy(), np.ones((2, 3)), rtol=1e-6)
+
+    def test_fused_ec_moe_matches_functional(self):
+        from paddle_tpu.incubate.nn import FusedEcMoe
+        from paddle_tpu.incubate.nn import functional as IF
+        paddle.seed(0)
+        layer = FusedEcMoe(8, 16, 4, act_type="gelu")
+        # weights init to nonzero for a meaningful check
+        rng = np.random.RandomState(0)
+        layer.bmm_weight0.set_value(paddle.to_tensor(rng.randn(4, 8, 16).astype(np.float32)))
+        layer.bmm_weight1.set_value(paddle.to_tensor(rng.randn(4, 16, 8).astype(np.float32)))
+        x = _t(rng.randn(2, 5, 8).astype(np.float32))
+        gate = _t(rng.randn(2, 5, 4).astype(np.float32))
+        out = layer(x, gate)
+        ref = IF.fused_ec_moe(x, gate, layer.bmm_weight0, layer.bmm_bias0,
+                              layer.bmm_weight1, layer.bmm_bias1, "gelu")
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-6)
+        assert out.shape == [2, 5, 8]
+
+    def test_fused_bias_dropout_residual_layer_norm(self):
+        from paddle_tpu.incubate.nn import FusedBiasDropoutResidualLayerNorm
+        paddle.seed(0)
+        layer = FusedBiasDropoutResidualLayerNorm(8, dropout_rate=0.0)
+        layer.eval()
+        rng = np.random.RandomState(0)
+        x = _t(rng.randn(2, 4, 8).astype(np.float32))
+        res = _t(rng.randn(2, 4, 8).astype(np.float32))
+        out = layer(x, res)
+        # oracle: layer_norm(x + bias + residual), bias/ln defaults 0/1
+        h = x.numpy() + res.numpy()
+        mu = h.mean(-1, keepdims=True)
+        var = h.var(-1, keepdims=True)
+        expected = (h - mu) / np.sqrt(var + 1e-5)
+        np.testing.assert_allclose(out.numpy(), expected, rtol=1e-4, atol=1e-5)
+
+    def test_fused_multi_transformer_runs_and_matches_functional(self):
+        from paddle_tpu.incubate.nn import FusedMultiTransformer
+        paddle.seed(0)
+        layer = FusedMultiTransformer(
+            embed_dim=16, num_heads=2, dim_feedforward=32, num_layers=2,
+        )
+        layer.eval()
+        assert len(layer.qkv_weights) == 2
+        assert tuple(layer.qkv_weights[0].shape) == (3, 2, 8, 16)
+        rng = np.random.RandomState(0)
+        src = _t(rng.randn(2, 6, 16).astype(np.float32))
+        out = layer(src)
+        assert out.shape == [2, 6, 16]
+        assert np.isfinite(out.numpy()).all()
+        # grads flow to every parameter family
+        loss = paddle.sum(out * out)
+        loss.backward()
+        for fam in (layer.qkv_weights, layer.ffn1_weights, layer.ln_scales):
+            assert fam[0].grad is not None
