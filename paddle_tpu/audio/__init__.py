@@ -1,4 +1,13 @@
-"""paddle.audio namespace (reference: python/paddle/audio/)."""
-from . import datasets, features, functional  # noqa: F401
+"""paddle.audio namespace (reference: python/paddle/audio/__init__.py)."""
+from . import backends, datasets, features, functional  # noqa: F401
+from .backends.init_backend import info, load, save  # noqa: F401
 
-__all__ = ["features", "functional", "datasets"]
+__all__ = [
+    "functional",
+    "features",
+    "datasets",
+    "backends",
+    "load",
+    "info",
+    "save",
+]

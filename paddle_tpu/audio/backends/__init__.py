@@ -1,0 +1,13 @@
+"""paddle.audio.backends (reference: python/paddle/audio/backends/)."""
+from . import wave_backend  # noqa: F401
+from .init_backend import (  # noqa: F401
+    get_current_backend,
+    list_available_backends,
+    set_backend,
+)
+
+__all__ = [
+    "get_current_backend",
+    "list_available_backends",
+    "set_backend",
+]

@@ -1,0 +1,51 @@
+"""Audio backend registry.
+
+Reference parity: python/paddle/audio/backends/init_backend.py
+(list_available_backends:37, get_current_backend:95, set_backend:139). The
+builtin backend is the stdlib "wave_backend"; paddleaudio-style plugins can
+register by appending to _BACKENDS before set_backend.
+"""
+from __future__ import annotations
+
+from . import wave_backend
+
+_BACKENDS = {"wave_backend": wave_backend}
+_current = "wave_backend"
+
+
+def list_available_backends():
+    """All registered backend names (init_backend.py:37)."""
+    return sorted(_BACKENDS)
+
+
+def get_current_backend() -> str:
+    """The active backend name (init_backend.py:95)."""
+    return _current
+
+
+def set_backend(backend_name: str):
+    """Switch the active backend (init_backend.py:139); load/save/info
+    dispatch through it."""
+    global _current
+    if backend_name not in _BACKENDS:
+        raise NotImplementedError(
+            f"backend {backend_name!r} not registered; "
+            f"available: {list_available_backends()}"
+        )
+    _current = backend_name
+
+
+def _active():
+    return _BACKENDS[_current]
+
+
+def load(*args, **kwargs):
+    return _active().load(*args, **kwargs)
+
+
+def save(*args, **kwargs):
+    return _active().save(*args, **kwargs)
+
+
+def info(*args, **kwargs):
+    return _active().info(*args, **kwargs)

@@ -1,0 +1,115 @@
+"""WAV file I/O over the stdlib wave module.
+
+Reference parity: python/paddle/audio/backends/wave_backend.py (info:37,
+load:89, save:168) and backend.py:21 (AudioInfo). Same contract: PCM16 WAV
+only; load returns float32 normalized to (-1, 1) by default (int16 raw
+otherwise), channels_first layout; save writes float32 as PCM16.
+"""
+from __future__ import annotations
+
+import wave
+
+import numpy as np
+
+
+class AudioInfo:
+    """Audio info, return type of backend info function (backend.py:21)."""
+
+    def __init__(self, sample_rate, num_samples, num_channels,
+                 bits_per_sample, encoding):
+        self.sample_rate = sample_rate
+        self.num_samples = num_samples
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+        self.encoding = encoding
+
+
+def _error_message():
+    return (
+        "only PCM16 WAV supported by the wave backend; "
+        "convert the file or install a soundfile-style backend"
+    )
+
+
+def info(filepath) -> AudioInfo:
+    """Signal information of an audio file (wave_backend.py:37)."""
+    if hasattr(filepath, "read"):
+        file_obj = filepath
+    else:
+        file_obj = open(filepath, "rb")
+    try:
+        f = wave.open(file_obj)
+    except wave.Error:
+        file_obj.seek(0)
+        file_obj.close()
+        raise NotImplementedError(_error_message())
+    channels = f.getnchannels()
+    sample_rate = f.getframerate()
+    sample_frames = f.getnframes()
+    bits_per_sample = f.getsampwidth() * 8
+    file_obj.close()
+    return AudioInfo(sample_rate, sample_frames, channels, bits_per_sample,
+                     "PCM_S")
+
+
+def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
+         channels_first=True):
+    """Load audio data -> (Tensor, sample_rate) (wave_backend.py:89)."""
+    from ... import to_tensor
+    from ...ops import manipulation
+
+    if hasattr(filepath, "read"):
+        file_obj = filepath
+    else:
+        file_obj = open(filepath, "rb")
+    try:
+        f = wave.open(file_obj)
+    except wave.Error:
+        file_obj.seek(0)
+        file_obj.close()
+        raise NotImplementedError(_error_message())
+    channels = f.getnchannels()
+    sample_rate = f.getframerate()
+    if f.getsampwidth() != 2:
+        file_obj.close()
+        raise NotImplementedError(_error_message())
+    frames = f.readframes(f.getnframes())
+    file_obj.close()
+    data = np.frombuffer(frames, dtype="<h").reshape(-1, channels)
+    if normalize:
+        waveform = data.astype(np.float32) / (2 ** 15)
+    else:
+        waveform = data
+    if num_frames != -1:
+        waveform = waveform[frame_offset: frame_offset + num_frames, :]
+    elif frame_offset:
+        waveform = waveform[frame_offset:, :]
+    t = to_tensor(np.ascontiguousarray(waveform))
+    if channels_first:
+        t = manipulation.transpose(t, perm=[1, 0])
+    return t, sample_rate
+
+
+def save(filepath, src, sample_rate, channels_first=True, encoding=None,
+         bits_per_sample=16):
+    """Save a 2-D audio tensor as PCM16 WAV (wave_backend.py:168)."""
+    assert src.ndim == 2, "Expected 2D tensor"
+    audio_numpy = src.numpy()
+    if channels_first:
+        audio_numpy = np.transpose(audio_numpy)
+    channels = audio_numpy.shape[1]
+    if bits_per_sample not in (None, 16):
+        raise ValueError("Invalid bits_per_sample, only support 16 bit")
+    sample_width = 2
+    if audio_numpy.dtype != np.int16:
+        # clip: the reference wraps at exactly +/-1.0 (int16 overflow);
+        # clipping to the int16 range is strictly better and differs by at
+        # most 1 LSB for in-range signals
+        scaled = np.clip(audio_numpy.astype(np.float32) * (2 ** 15),
+                         -32768, 32767)
+        audio_numpy = scaled.astype("<h")
+    with wave.open(filepath, "w") as f:
+        f.setnchannels(channels)
+        f.setsampwidth(sample_width)
+        f.setframerate(sample_rate)
+        f.writeframes(np.ascontiguousarray(audio_numpy).tobytes())

@@ -101,3 +101,61 @@ class stream_guard:
     def __exit__(self, *exc):
         set_stream(self._prev)
         return False
+
+
+# ---------------------------------------------------------------------------
+# r4: honest compiled-with predicates (reference device/__init__.py __all__).
+# This build targets TPU via jax/XLA; every CUDA/ROCm/XPU/IPU/CINN predicate
+# answers False truthfully rather than pretending.
+# ---------------------------------------------------------------------------
+
+def is_compiled_with_cuda():
+    """False: TPU build (reference framework.core.is_compiled_with_cuda)."""
+    return False
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_ipu():
+    return False
+
+
+def is_compiled_with_cinn():
+    """False: the graph compiler here is XLA, not CINN (PARITY.md §2.1)."""
+    return False
+
+
+def is_compiled_with_distribute():
+    """True: the distributed stack (XLA collectives + TCPStore) is built in."""
+    return True
+
+
+def is_compiled_with_custom_device(device_type):
+    """jax PJRT plugins play the role of PaddleCustomDevice: True only for
+    registered plugin device types, never the built-in cpu/tpu platforms
+    (reference returns True only for PaddleCustomDevice plugins)."""
+    return device_type in get_all_custom_device_type()
+
+
+def get_cudnn_version():
+    """None on non-CUDA builds (reference returns None when CUDA absent)."""
+    return None
+
+
+class XPUPlace(Place):
+    """Unavailable in the TPU build — constructing raises, matching a
+    paddle build without XPU support."""
+
+    def __init__(self, dev_id=0):
+        raise RuntimeError("XPUPlace is not available in the TPU build")
+
+
+class IPUPlace(Place):
+    def __init__(self):
+        raise RuntimeError("IPUPlace is not available in the TPU build")

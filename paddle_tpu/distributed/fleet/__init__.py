@@ -41,3 +41,18 @@ from .meta_parallel import (  # noqa: F401
     VocabParallelEmbedding,
     get_rng_state_tracker,
 )
+
+# r4 sweep: role makers, util, data generators (reference fleet __all__)
+from .base.role_maker import (  # noqa: F401
+    PaddleCloudRoleMaker,
+    Role,
+    UserDefinedRoleMaker,
+)
+from .base.util_factory import UtilBase  # noqa: F401
+from .data_generator import (  # noqa: F401
+    DataGenerator,
+    MultiSlotDataGenerator,
+    MultiSlotStringDataGenerator,
+)
+
+util = UtilBase()

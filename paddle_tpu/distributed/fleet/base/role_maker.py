@@ -1,0 +1,98 @@
+"""Role makers: who am I in the job?
+
+Reference parity: python/paddle/distributed/fleet/base/role_maker.py —
+Role (:34), PaddleCloudRoleMaker (:542), UserDefinedRoleMaker (:1204).
+TPU-native scope: collective mode only (every process is a WORKER; the
+SERVER/HETER roles belong to the decision-absent parameter-server mode,
+PARITY.md §2.1) reading the same PADDLE_* environment contract the
+launcher exports.
+"""
+from __future__ import annotations
+
+import os
+import warnings
+
+
+class Role:
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+    COORDINATOR = 5
+
+
+class RoleMakerBase:
+    def __init__(self):
+        self._role = Role.WORKER
+
+    def _is_worker(self):
+        return self._role == Role.WORKER
+
+    def _is_server(self):
+        return self._role == Role.SERVER
+
+    def _is_first_worker(self):
+        return self._is_worker() and self._worker_index() == 0
+
+    def _worker_index(self):
+        raise NotImplementedError
+
+    def _worker_num(self):
+        raise NotImplementedError
+
+    # public aliases used by fleet.UtilBase and user code
+    def is_worker(self):
+        return self._is_worker()
+
+    def is_server(self):
+        return self._is_server()
+
+    def is_first_worker(self):
+        return self._is_first_worker()
+
+    def worker_index(self):
+        return self._worker_index()
+
+    def worker_num(self):
+        return self._worker_num()
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    """Role from the launcher's environment (reference role_maker.py:542):
+    PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_TRAINER_ENDPOINTS."""
+
+    def __init__(self, is_collective=True, **kwargs):
+        super().__init__()
+        if not is_collective:
+            warnings.warn(
+                "parameter-server mode is a documented decision-absent "
+                "(PARITY.md §2.1); PaddleCloudRoleMaker runs collective"
+            )
+        self._is_collective = True
+        self._rank = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+        self._size = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        self._endpoints = eps.split(",") if eps else []
+
+    def _worker_index(self):
+        return self._rank
+
+    def _worker_num(self):
+        return self._size
+
+    def _get_trainer_endpoints(self):
+        return self._endpoints
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    """Explicit role assignment (reference role_maker.py:1204): current_id /
+    worker_num passed by the user instead of read from env."""
+
+    def __init__(self, is_collective=True, init_gloo=False, **kwargs):
+        super().__init__(is_collective=is_collective)
+        if "current_id" in kwargs:
+            self._rank = int(kwargs["current_id"])
+        if "worker_num" in kwargs:
+            self._size = int(kwargs["worker_num"])
+        if "worker_endpoints" in kwargs:
+            self._endpoints = list(kwargs["worker_endpoints"])

@@ -1,0 +1,152 @@
+"""Data generators for slot-formatted training data.
+
+Reference parity: python/paddle/distributed/fleet/data_generator/
+data_generator.py — DataGenerator (:20, run_from_stdin/run_from_memory
+pipeline over a user generate_sample), MultiSlotStringDataGenerator (:232)
+and MultiSlotDataGenerator (:277) emitting the MultiSlotDataFeed text
+format `ids_num id1 id2 ...` per slot.
+"""
+from __future__ import annotations
+
+import sys
+
+
+class DataGenerator:
+    """Base class: users override generate_sample(line) (and optionally
+    generate_batch) to yield [(slot_name, [values...]), ...] records."""
+
+    def __init__(self):
+        self._proto_info = None
+        self.batch_size_ = 32
+
+    def set_batch(self, batch_size):
+        self.batch_size_ = batch_size
+
+    def run_from_memory(self):
+        """Generate data without input (reference :59): generate_sample(None)
+        repeatedly, batched through generate_batch, written to stdout."""
+        batch_samples = []
+        line_iter = self.generate_sample(None)
+        for user_parsed_line in line_iter():
+            if user_parsed_line is None:
+                continue
+            batch_samples.append(user_parsed_line)
+            if len(batch_samples) == self.batch_size_:
+                batch_iter = self.generate_batch(batch_samples)
+                for sample in batch_iter():
+                    sys.stdout.write(self._gen_str(sample))
+                batch_samples = []
+        if batch_samples:
+            batch_iter = self.generate_batch(batch_samples)
+            for sample in batch_iter():
+                sys.stdout.write(self._gen_str(sample))
+
+    def run_from_stdin(self):
+        """One record per stdin line (reference :93)."""
+        batch_samples = []
+        for line in sys.stdin:
+            line_iter = self.generate_sample(line)
+            for user_parsed_line in line_iter():
+                if user_parsed_line is None:
+                    continue
+                batch_samples.append(user_parsed_line)
+                if len(batch_samples) == self.batch_size_:
+                    batch_iter = self.generate_batch(batch_samples)
+                    for sample in batch_iter():
+                        sys.stdout.write(self._gen_str(sample))
+                    batch_samples = []
+        if batch_samples:
+            batch_iter = self.generate_batch(batch_samples)
+            for sample in batch_iter():
+                sys.stdout.write(self._gen_str(sample))
+
+    def _gen_str(self, line):
+        raise NotImplementedError(
+            "pls use MultiSlotDataGenerator or MultiSlotStringDataGenerator"
+        )
+
+    def generate_sample(self, line):
+        raise NotImplementedError(
+            "Please rewrite this function to return a list or tuple: "
+            "[(name, [feasign, ...]), ...]"
+        )
+
+    def generate_batch(self, samples):
+        def local_iter():
+            for sample in samples:
+                yield sample
+
+        return local_iter
+
+
+class MultiSlotStringDataGenerator(DataGenerator):
+    def _gen_str(self, line):
+        """[(name, [str, ...]), ...] -> 'len v1 v2 ... len v1 ...'
+        (reference :232)."""
+        if isinstance(line, zip):
+            line = list(line)
+        if not isinstance(line, (list, tuple)):
+            raise ValueError(
+                "the output of process() must be in list or tuple type"
+                "Examples: [('words', ['1926', '08', '17']), ('label', ['1'])]"
+            )
+        output = ""
+        for name, elements in line:
+            if output:
+                output += " "
+            output += " ".join([str(len(elements))] + list(elements))
+        return output + "\n"
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    def _gen_str(self, line):
+        """[(name, [int|float, ...]), ...] -> slot text format, tracking the
+        per-slot dtype in _proto_info and enforcing it is stable across
+        lines (reference :277)."""
+        if isinstance(line, zip):
+            line = list(line)
+        if not isinstance(line, (list, tuple)):
+            raise ValueError(
+                "the output of process() must be in list or tuple type"
+                "Example: [('words', [1926, 8, 17]), ('label', [1])]"
+            )
+        output = ""
+        if self._proto_info is None:
+            self._proto_info = []
+            first = True
+        else:
+            first = False
+            if len(line) != len(self._proto_info):
+                raise ValueError(
+                    f"the complete field set of two given line are inconsistent: "
+                    f"{len(line)} vs {len(self._proto_info)}"
+                )
+        for i, (name, elements) in enumerate(line):
+            if not isinstance(name, str):
+                raise ValueError(f"name{type(name)} must be in str type")
+            if not isinstance(elements, list):
+                raise ValueError(f"elements{type(elements)} must be in list type")
+            if not elements:
+                raise ValueError(
+                    "the elements of each field can not be empty, you need "
+                    "padding it in process()."
+                )
+            if first:
+                self._proto_info.append((name, "uint64"))
+            elif name != self._proto_info[i][0]:
+                raise ValueError(
+                    f"the field name of two given line are not match: "
+                    f"{name} vs {self._proto_info[i][0]}"
+                )
+            if output:
+                output += " "
+            output += str(len(elements))
+            for elem in elements:
+                if isinstance(elem, float):
+                    self._proto_info[i] = (name, "float")
+                elif not isinstance(elem, int):
+                    raise ValueError(
+                        f"the type of element{type(elem)} must be in int or float"
+                    )
+                output += " " + str(elem)
+        return output + "\n"

@@ -1,2 +1,6 @@
 from ..recompute.recompute import recompute, recompute_sequential  # noqa: F401
 from . import sequence_parallel_utils  # noqa: F401
+from .fs import FS, HDFSClient, LocalFS  # noqa: F401
+from .ps_util import DistributedInfer  # noqa: F401
+
+__all__ = ["LocalFS", "recompute", "DistributedInfer", "HDFSClient"]

@@ -1,0 +1,251 @@
+"""File-system tools for fleet checkpoint/data staging.
+
+Reference parity: python/paddle/distributed/fleet/utils/fs.py — FS base
+(:40), LocalFS (:114, real local implementation), HDFSClient (:474, shells
+out to the hadoop client the same way the reference does; raises a clear
+error if no hadoop binary is installed).
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+
+
+class ExecuteError(Exception):
+    pass
+
+
+class FSFileExistsError(Exception):
+    pass
+
+
+class FSFileNotExistsError(Exception):
+    pass
+
+
+class FSTimeOut(Exception):
+    pass
+
+
+class FSShellCmdAborted(ExecuteError):
+    pass
+
+
+class FS:
+    """Abstract FS interface (reference fs.py:40)."""
+
+    def ls_dir(self, fs_path):
+        raise NotImplementedError
+
+    def is_file(self, fs_path):
+        raise NotImplementedError
+
+    def is_dir(self, fs_path):
+        raise NotImplementedError
+
+    def is_exist(self, fs_path):
+        raise NotImplementedError
+
+    def upload(self, local_path, fs_path):
+        raise NotImplementedError
+
+    def download(self, fs_path, local_path):
+        raise NotImplementedError
+
+    def mkdirs(self, fs_path):
+        raise NotImplementedError
+
+    def delete(self, fs_path):
+        raise NotImplementedError
+
+    def need_upload_download(self):
+        raise NotImplementedError
+
+    def rename(self, fs_src_path, fs_dst_path):
+        raise NotImplementedError
+
+    def mv(self, fs_src_path, fs_dst_path, overwrite=False, test_exists=False):
+        raise NotImplementedError
+
+    def upload_dir(self, local_dir, dest_dir):
+        raise NotImplementedError
+
+    def list_dirs(self, fs_path):
+        raise NotImplementedError
+
+    def touch(self, fs_path, exist_ok=True):
+        raise NotImplementedError
+
+    def cat(self, fs_path=None):
+        raise NotImplementedError
+
+
+class LocalFS(FS):
+    """Local file system tool (reference fs.py:114)."""
+
+    def ls_dir(self, fs_path):
+        if not self.is_exist(fs_path):
+            return [], []
+        dirs, files = [], []
+        for f in os.listdir(fs_path):
+            if os.path.isdir(os.path.join(fs_path, f)):
+                dirs.append(f)
+            else:
+                files.append(f)
+        return dirs, files
+
+    def mkdirs(self, fs_path):
+        assert not os.path.isfile(fs_path), f"{fs_path} is already a file"
+        os.makedirs(fs_path, exist_ok=True)
+
+    def rename(self, fs_src_path, fs_dst_path):
+        os.rename(fs_src_path, fs_dst_path)
+
+    def _rmr(self, fs_path):
+        shutil.rmtree(fs_path)
+
+    def _rm(self, fs_path):
+        os.remove(fs_path)
+
+    def delete(self, fs_path):
+        if not self.is_exist(fs_path):
+            return
+        if os.path.isfile(fs_path):
+            return self._rm(fs_path)
+        return self._rmr(fs_path)
+
+    def need_upload_download(self):
+        return False
+
+    def is_file(self, fs_path):
+        return os.path.isfile(fs_path)
+
+    def is_dir(self, fs_path):
+        return os.path.isdir(fs_path)
+
+    def is_exist(self, fs_path):
+        return os.path.exists(fs_path)
+
+    def touch(self, fs_path, exist_ok=True):
+        if self.is_exist(fs_path):
+            if exist_ok:
+                return
+            raise FSFileExistsError
+        with open(fs_path, "a"):
+            pass
+
+    def mv(self, src_path, dst_path, overwrite=False, test_exists=False):
+        if not self.is_exist(src_path):
+            raise FSFileNotExistsError
+        if overwrite and self.is_exist(dst_path):
+            self.delete(dst_path)
+        if self.is_exist(dst_path):
+            raise FSFileExistsError
+        return self.rename(src_path, dst_path)
+
+    def list_dirs(self, fs_path):
+        """Only return the directories under fs_path."""
+        if not self.is_exist(fs_path):
+            return []
+        return [
+            f for f in os.listdir(fs_path)
+            if os.path.isdir(os.path.join(fs_path, f))
+        ]
+
+    def cat(self, fs_path=None):
+        with open(fs_path, "r") as f:
+            return f.read().rstrip("\n")
+
+
+class HDFSClient(FS):
+    """HDFS tool shelling out to the hadoop client (reference fs.py:474 —
+    same transport: `hadoop fs -<cmd>`). Requires a hadoop binary on PATH;
+    every operation raises ExecuteError with the shell output otherwise."""
+
+    def __init__(self, hadoop_home=None, configs=None, time_out=5 * 60 * 1000,
+                 sleep_inter=1000):
+        self._base = []
+        if hadoop_home:
+            self._base.append(os.path.join(hadoop_home, "bin", "hadoop"))
+        else:
+            self._base.append("hadoop")
+        self._base.append("fs")
+        for k, v in (configs or {}).items():
+            self._base.extend(["-D", f"{k}={v}"])
+        self._time_out = time_out
+
+    def _run(self, *args, check=True):
+        try:
+            p = subprocess.run(
+                self._base + list(args), capture_output=True, text=True,
+                timeout=self._time_out / 1000.0,
+            )
+        except FileNotFoundError:
+            raise ExecuteError(
+                "no hadoop client on PATH — HDFSClient needs a hadoop "
+                "installation (pass hadoop_home=...)"
+            )
+        except subprocess.TimeoutExpired:
+            raise FSTimeOut(f"hadoop fs {' '.join(args)} timed out")
+        if check and p.returncode != 0:
+            raise ExecuteError(f"hadoop fs {' '.join(args)}: {p.stderr}")
+        return p
+
+    def ls_dir(self, fs_path):
+        p = self._run("-ls", fs_path)
+        dirs, files = [], []
+        for line in p.stdout.splitlines():
+            parts = line.split()
+            if len(parts) < 8:
+                continue
+            name = os.path.basename(parts[-1])
+            (dirs if parts[0].startswith("d") else files).append(name)
+        return dirs, files
+
+    def list_dirs(self, fs_path):
+        return self.ls_dir(fs_path)[0]
+
+    def is_exist(self, fs_path):
+        return self._run("-test", "-e", fs_path, check=False).returncode == 0
+
+    def is_dir(self, fs_path):
+        return self._run("-test", "-d", fs_path, check=False).returncode == 0
+
+    def is_file(self, fs_path):
+        return self._run("-test", "-f", fs_path, check=False).returncode == 0
+
+    def upload(self, local_path, fs_path, multi_processes=1, overwrite=False):
+        self._run("-put", local_path, fs_path)
+
+    def download(self, fs_path, local_path, multi_processes=1, overwrite=False):
+        self._run("-get", fs_path, local_path)
+
+    def mkdirs(self, fs_path):
+        self._run("-mkdir", "-p", fs_path)
+
+    def delete(self, fs_path):
+        self._run("-rm", "-r", fs_path)
+
+    def rename(self, fs_src_path, fs_dst_path):
+        self._run("-mv", fs_src_path, fs_dst_path)
+
+    def mv(self, fs_src_path, fs_dst_path, overwrite=False, test_exists=True):
+        if test_exists and not self.is_exist(fs_src_path):
+            raise FSFileNotExistsError
+        if overwrite and self.is_exist(fs_dst_path):
+            self.delete(fs_dst_path)
+        self.rename(fs_src_path, fs_dst_path)
+
+    def touch(self, fs_path, exist_ok=True):
+        if self.is_exist(fs_path):
+            if exist_ok:
+                return
+            raise FSFileExistsError
+        self._run("-touchz", fs_path)
+
+    def cat(self, fs_path=None):
+        return self._run("-cat", fs_path).stdout.rstrip("\n")
+
+    def need_upload_download(self):
+        return True

@@ -1,0 +1,41 @@
+"""Distributed inference helper.
+
+Reference parity: python/paddle/distributed/fleet/utils/ps_util.py:24
+(DistributedInfer). The reference rewrites a static program so sparse
+lookups pull from parameter-server tables; PS mode is a documented
+decision-absent here (PARITY.md §2.1), so this class supports the
+collective path: it holds the program pair and returns it unmodified —
+dense inference runs exactly as trained, matching the reference's behavior
+when no sparse PS tables exist.
+"""
+from __future__ import annotations
+
+
+class DistributedInfer:
+    """Utility class for distributed infer (reference ps_util.py:24)."""
+
+    def __init__(self, main_program=None, startup_program=None):
+        from ....static import default_main_program, default_startup_program
+
+        self.origin_main_program = (
+            main_program if main_program is not None else default_main_program()
+        )
+        self.origin_startup_program = (
+            startup_program if startup_program is not None
+            else default_startup_program()
+        )
+        self.sparse_table_maps = {}
+
+    def init_distributed_infer_env(self, exe, loss, role_maker=None,
+                                   dirname=None):
+        """No PS tables to pull in the collective build — load persistables
+        from ``dirname`` if given, else nothing to do."""
+        if dirname is not None:
+            from ....static import load
+
+            load(self.origin_main_program, dirname, exe)
+
+    def get_dist_infer_program(self):
+        """Without sparse PS tables the trained program IS the inference
+        program (the reference returns the rewritten clone)."""
+        return self.origin_main_program

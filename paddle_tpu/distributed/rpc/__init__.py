@@ -103,6 +103,11 @@ def get_worker_info(name=None) -> Optional[WorkerInfo]:
     return None
 
 
+def get_current_worker_info() -> WorkerInfo:
+    """This process's WorkerInfo (reference rpc.py:364)."""
+    return WorkerInfo(_state["name"], _state["rank"])
+
+
 def get_all_worker_infos():
     return [
         WorkerInfo(_state["store"].get(f"rpc/worker/{r}").decode(), r)

@@ -199,3 +199,79 @@ def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None, return
         e = np.concatenate(out_eids) if out_eids else np.zeros((0,), np.int64)
         res.append(Tensor(jnp.asarray(e)))
     return tuple(res)
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None, index_buffer=None, name=None):
+    """Reindex over neighbors from MULTIPLE graphs sharing one id map
+    (reference geometric/reindex.py:139): x first, then first-seen order
+    across all graphs' neighbor lists; per-graph edges are concatenated."""
+    xv = np.asarray(_t(x)._raw())
+    nbs = [np.asarray(_t(n)._raw()) for n in neighbors]
+    cnts = [np.asarray(_t(c)._raw()) for c in count]
+    order = {}
+    for v in xv.tolist():
+        if v not in order:
+            order[v] = len(order)
+    for nb in nbs:
+        for v in nb.tolist():
+            if v not in order:
+                order[v] = len(order)
+    srcs, dsts = [], []
+    for nb, cnt in zip(nbs, cnts):
+        srcs.append(np.array([order[v] for v in nb.tolist()], dtype=np.int64))
+        dsts.append(np.repeat(np.arange(len(xv), dtype=np.int64), cnt))
+    reindex_src = np.concatenate(srcs) if srcs else np.zeros((0,), np.int64)
+    reindex_dst = np.concatenate(dsts) if dsts else np.zeros((0,), np.int64)
+    out_nodes = np.array(list(order.keys()), dtype=xv.dtype)
+    return (
+        Tensor(jnp.asarray(reindex_src)),
+        Tensor(jnp.asarray(reindex_dst)),
+        Tensor(jnp.asarray(out_nodes)),
+    )
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None, return_eids=False,
+                              name=None):
+    """Weighted neighbor sampling on CSC (reference
+    geometric/sampling/neighbors.py:172): selection probability is
+    proportional to edge weight; without replacement, like the reference's
+    weighted reservoir sampling. Host-side graph prep, paddle.seed-driven."""
+    from ..framework import random as random_mod
+
+    if return_eids and eids is None:
+        raise ValueError("return_eids=True needs eids")
+    r = np.asarray(_t(row)._raw())
+    cp = np.asarray(_t(colptr)._raw())
+    w = np.asarray(_t(edge_weight)._raw()).astype(np.float64)
+    nodes = np.asarray(_t(input_nodes)._raw())
+    ev = np.asarray(_t(eids)._raw()) if eids is not None else None
+    seed = int(np.asarray(jax.random.randint(random_mod.next_key(), (), 0, 2**31 - 1)))
+    rng = np.random.default_rng(seed)
+    out_nb, out_cnt, out_eids = [], [], []
+    for v in nodes.tolist():
+        beg, end = int(cp[v]), int(cp[v + 1])
+        sel = np.arange(beg, end)
+        if sample_size >= 0 and sel.size > sample_size:
+            p = w[sel]
+            # fewer positive-weight edges than sample_size (masked edges)
+            # would make without-replacement sampling impossible — shift all
+            # weights so every edge is selectable, preserving the ordering
+            # (the reference's weighted reservoir also returns sample_size)
+            if (p > 0).sum() < sample_size:
+                p = p + (p[p > 0].min() * 1e-6 if (p > 0).any() else 1.0)
+            p = p / p.sum()
+            sel = rng.choice(sel, size=sample_size, replace=False, p=p)
+        out_nb.append(r[sel])
+        out_cnt.append(sel.size)
+        if return_eids:
+            out_eids.append(ev[sel])
+    neighbors = np.concatenate(out_nb) if out_nb else np.zeros((0,), r.dtype)
+    res = [Tensor(jnp.asarray(neighbors)), Tensor(jnp.asarray(np.array(out_cnt, np.int32)))]
+    if return_eids:
+        e = np.concatenate(out_eids) if out_eids else np.zeros((0,), np.int64)
+        res.append(Tensor(jnp.asarray(e)))
+    return tuple(res)
+
+
+__all__ += ["reindex_heter_graph", "weighted_sample_neighbors"]

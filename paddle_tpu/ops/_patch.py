@@ -100,6 +100,43 @@ def patch_tensor():
         ("zero_", lambda self: creation.zeros_like(self)),
     ]:
         setattr(T, name, _inplace(fn))
+    # the rest of the reference tensor_method_func inplace family comes from
+    # inplace.py's _MECHANICAL table (erfinv_, lerp_, log1p_, not_equal_,
+    # put_along_axis_, sigmoid_, ... — one table generates function AND
+    # method forms via patch_tensor_inplace)
+
+    # non-method-module functions the reference patches as methods
+    # (tensor/__init__.py tensor_method_func): creation views + signal
+    for name, fn in [
+        ("diag", creation.diag),
+        ("diagonal", creation.diagonal),
+        ("diagflat", creation.diagflat),
+        ("diag_embed", creation.diag_embed),
+        ("tril", creation.tril),
+        ("triu", creation.triu),
+        ("polar", creation.polar),
+        ("multinomial", creation.multinomial),
+    ]:
+        if not hasattr(T, name):
+            setattr(T, name, _method(fn))
+
+    # stft/istft live in paddle.signal, which imports ops — bind lazily to
+    # avoid the import cycle at patch time
+    def _signal_method(name):
+        def m(self, *args, **kwargs):
+            from .. import signal as signal_mod
+
+            return getattr(signal_mod, name)(self, *args, **kwargs)
+
+        m.__name__ = name
+        return m
+
+    T.stft = _signal_method("stft")
+    T.istft = _signal_method("istft")
+    # create_parameter/create_tensor are patched verbatim in the reference
+    # (first arg is shape/dtype, not self) — same binding here
+    T.create_parameter = staticmethod(creation.create_parameter)
+    T.create_tensor = staticmethod(creation.create_tensor)
 
     T.mean = _method(math.mean)
     T.sum = _method(math.sum)

@@ -375,3 +375,14 @@ def create_parameter(shape, dtype, name=None, attr=None, is_bias=False, default_
     p.regularizer = reg
     p.need_clip = need_clip
     return p
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    """Uninitialized variable holder (reference tensor/creation.py
+    create_tensor — a 0-size LoDTensor to be written later; here an empty
+    jax array of the dtype, filled by assign/set_value)."""
+    from ..framework import dtype as _dt
+
+    t = Tensor(jnp.zeros((0,), _dt.convert_dtype(dtype)), name=name)
+    t.persistable = persistable
+    return t

@@ -62,13 +62,14 @@ _MECHANICAL = [
     "cast", "copysign", "cos", "cosh", "cumprod", "cumsum",
     "digamma", "divide", "equal", "erf", "expm1",
     "flatten", "floor_divide", "floor_mod", "frac",
-    "gammainc", "gammaincc", "gammaln", "gcd",
+    "erfinv", "gammainc", "gammaincc", "gammaln", "gcd",
     "greater_equal", "greater_than", "hypot", "i0",
-    "index_add", "index_put", "lcm", "ldexp", "less_equal", "less_than",
-    "lgamma", "log", "log10", "log2",
+    "index_add", "index_put", "lcm", "ldexp", "lerp", "less_equal", "less_than",
+    "lgamma", "log", "log10", "log1p", "log2",
     "logical_and", "logical_not", "logical_or", "logical_xor", "logit",
     "masked_scatter", "mod", "multigammaln", "multiply",
-    "nan_to_num", "neg", "polygamma", "pow", "remainder", "renorm",
+    "nan_to_num", "neg", "not_equal", "polygamma", "pow",
+    "put_along_axis", "remainder", "renorm", "sigmoid",
     "sin", "sinh", "square", "tan", "tanh", "tril", "triu", "trunc",
 ]
 
@@ -169,8 +170,26 @@ def geometric_(x, probs, name=None):
 
 __all__ = (
     [n + "_" for n in _MECHANICAL]
-    + ["t_", "transpose_", "where_", "normal_", "uniform_", "cauchy_", "geometric_"]
+    + ["t_", "transpose_", "where_", "normal_", "uniform_", "cauchy_", "geometric_", "exponential_"]
 )
+
+
+def exponential_(x, lam=1.0, name=None):
+    """Fill x with Exponential(lam) samples via inverse-CDF
+    (tensor/random patch family; reference Tensor.exponential_)."""
+    from ..framework import random as random_mod
+
+    shape = tuple(x._value.shape)
+
+    def fn(v):
+        import jax
+
+        key = random_mod.next_key()
+        u = jax.random.uniform(key, shape, jnp.float32, minval=1e-7, maxval=1.0 - 1e-7)
+        return (-jnp.log1p(-u) / lam).astype(v.dtype)
+
+    x._become(apply("exponential_", fn, x))
+    return x
 
 
 def patch_tensor_inplace():

@@ -3,6 +3,7 @@ from .profiler import (  # noqa: F401
     Profiler,
     ProfilerState,
     ProfilerTarget,
+    SummaryView,
     export_chrome_tracing,
     export_protobuf,
     load_profiler_result,
@@ -16,6 +17,7 @@ __all__ = [
     "Profiler",
     "ProfilerState",
     "ProfilerTarget",
+    "SummaryView",
     "make_scheduler",
     "export_chrome_tracing",
     "export_protobuf",

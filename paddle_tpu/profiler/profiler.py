@@ -20,6 +20,21 @@ from .utils import TracerEventType, _disable_host_tracer, _enable_host_tracer, R
 from .profiler_statistic import StatisticData, SortedKeys, _build_summary_table
 
 
+class SummaryView(Enum):
+    """Summary view selector (reference profiler.py:46); accepted by
+    Profiler.summary(views=...) to filter which tables print."""
+
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+    MemoryManipulationView = 7
+    UDFView = 8
+
+
 class ProfilerState(Enum):
     CLOSED = 0
     READY = 1
@@ -261,9 +276,20 @@ class Profiler:
         with open(path, "w") as f:
             json.dump(self.profiler_result.to_chrome_trace(), f)
 
-    def summary(self, sorted_by=SortedKeys.CPUTotal, op_detail=True, thread_sep=False, time_unit="ms"):
+    def summary(self, sorted_by=SortedKeys.CPUTotal, op_detail=True, thread_sep=False, time_unit="ms", views=None):
+        """Print summary tables (reference profiler.py:849). ``views``
+        filters which tables print (SummaryView or list of them); this
+        tracer produces the operator/kernel table, so any selection that
+        includes OperatorView/KernelView/OverView prints it."""
         if self.profiler_result is None:
             return
+        if views is not None:
+            if isinstance(views, SummaryView):
+                views = [views]
+            wanted = {SummaryView.OperatorView, SummaryView.KernelView,
+                      SummaryView.OverView}
+            if not wanted.intersection(views):
+                return
         print(_build_summary_table(self.profiler_result, sorted_by=sorted_by, time_unit=time_unit))
 
 

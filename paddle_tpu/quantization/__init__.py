@@ -9,15 +9,23 @@ which is how XLA consumes quantization anyway (scale annotations, not int
 kernels, on current TPU gens).
 """
 from .config import QuantConfig  # noqa: F401
-from .observers import AbsmaxObserver, AVGObserver  # noqa: F401
+from .observers import AbsmaxObserver, AVGObserver, BaseObserver  # noqa: F401
 from .ptq import PTQ  # noqa: F401
 from .qat import QAT  # noqa: F401
-from .quanters import FakeQuanterWithAbsMaxObserver  # noqa: F401
+from .quanters import (  # noqa: F401
+    BaseQuanter,
+    FakeQuanterWithAbsMaxObserver,
+    QuanterFactory,
+    quanter,
+)
 
 __all__ = [
     "QuantConfig",
     "QAT",
     "PTQ",
+    "BaseQuanter",
+    "BaseObserver",
+    "quanter",
     "FakeQuanterWithAbsMaxObserver",
     "AbsmaxObserver",
     "AVGObserver",

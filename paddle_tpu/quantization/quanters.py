@@ -72,3 +72,53 @@ class FakeQuanterWithAbsMaxObserver:
 
     def _instance(self, layer=None):
         return FakeQuanterWithAbsMaxObserverLayer(layer, **self.kwargs)
+
+
+class QuanterFactory:
+    """Holds quanter class + construction args; creates per-layer instances
+    (reference quantization/factory.py:46). ``quanter(name)`` builds
+    subclasses of this for user-defined quanters."""
+
+    layer_class = None
+
+    def __init__(self, *args, **kwargs):
+        self.args = args
+        self.kwargs = kwargs
+
+    def _instance(self, layer=None):
+        return type(self).layer_class(layer, *self.args, **self.kwargs)
+
+    def __repr__(self):
+        parts = [repr(a) for a in self.args]
+        parts += [f"{k}={v!r}" for k, v in self.kwargs.items()]
+        return f"{type(self).__name__}({', '.join(parts)})"
+
+
+def quanter(class_name):
+    """Decorator declaring a factory class for a customized quanter
+    (reference quantization/factory.py:76): decorating a BaseQuanter
+    subclass publishes ``class_name`` — a QuanterFactory whose instances
+    carry the constructor args and build the quanter per layer — into the
+    defining module. Same contract, without the reference's exec-based
+    class synthesis."""
+    import sys
+
+    caller_name = sys._getframe(1).f_globals.get("__name__")
+
+    def wrapper(target_class):
+        factory = type(
+            class_name, (QuanterFactory,), {"layer_class": target_class}
+        )
+        for mod_name in {target_class.__module__, caller_name}:
+            mod = sys.modules.get(mod_name) if mod_name else None
+            if mod is None:
+                continue
+            setattr(mod, class_name, factory)
+            if hasattr(mod, "__all__") and class_name not in mod.__all__:
+                try:
+                    mod.__all__.append(class_name)
+                except AttributeError:
+                    pass
+        return target_class
+
+    return wrapper

@@ -140,3 +140,134 @@ class ViterbiDecoder(Layer):
 
     def forward(self, potentials, lengths=None):
         return viterbi_decode(potentials, self.transitions, lengths, self.include_bos_eos_tag)
+
+
+class Imikolov(Dataset):
+    """Synthetic imikolov (PTB)-shaped LM dataset (reference
+    text/datasets/imikolov.py:29): NGRAM mode yields window_size-grams of
+    token ids; SEQ mode yields (src, trg) shifted sequences."""
+
+    VOCAB = 2000
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=-1,
+                 mode="train", min_word_num=50, seed=0):
+        assert data_type.upper() in ("NGRAM", "SEQ"), (
+            "data_type should be 'NGRAM' or 'SEQ'"
+        )
+        self.data_type = data_type.upper()
+        if self.data_type == "NGRAM":
+            assert window_size > 0, "window_size should be a positive number"
+        n = 256 if mode == "train" else 64
+        rng = np.random.RandomState(seed + (0 if mode == "train" else 1))
+        self.data = []
+        if self.data_type == "NGRAM":
+            for _ in range(n):
+                self.data.append(tuple(
+                    rng.randint(1, self.VOCAB, window_size).astype(np.int64)
+                ))
+        else:
+            for _ in range(n):
+                seq = rng.randint(1, self.VOCAB, 21).astype(np.int64)
+                self.data.append((seq[:-1], seq[1:]))
+
+    def __getitem__(self, idx):
+        return tuple(np.array(d) for d in self.data[idx])
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Movielens(Dataset):
+    """Synthetic Movielens-1M-shaped dataset (reference
+    text/datasets/movielens.py): (user_id, gender, age, job, movie_id,
+    title_ids, category_ids, rating) per row."""
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0):
+        n = 512 if mode == "train" else 64
+        rng = np.random.RandomState(rand_seed + (0 if mode == "train" else 1))
+        self.data = []
+        for _ in range(n):
+            self.data.append((
+                rng.randint(1, 6041),                          # user id
+                rng.randint(0, 2),                             # gender
+                rng.choice([1, 18, 25, 35, 45, 50, 56]),       # age bucket
+                rng.randint(0, 21),                            # job
+                rng.randint(1, 3953),                          # movie id
+                rng.randint(1, 5175, 8).astype(np.int64),      # title ids
+                rng.randint(0, 18, 3).astype(np.int64),        # categories
+                float(rng.randint(1, 6)),                      # rating
+            ))
+
+    def __getitem__(self, idx):
+        return tuple(np.array(d) for d in self.data[idx])
+
+    def __len__(self):
+        return len(self.data)
+
+
+class _SyntheticWMT(Dataset):
+    """(src_ids, trg_ids, trg_ids_next) triples (reference
+    text/datasets/wmt14.py:183 / wmt16.py)."""
+
+    def __init__(self, n, dict_size, seed):
+        rng = np.random.RandomState(seed)
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        for _ in range(n):
+            slen = int(rng.randint(5, 30))
+            tlen = int(rng.randint(5, 30))
+            src = rng.randint(3, dict_size, slen).astype(np.int64)
+            trg = rng.randint(3, dict_size, tlen).astype(np.int64)
+            # <s> trg </s> convention: ids 0/1 bracket the target stream
+            self.src_ids.append(src)
+            self.trg_ids.append(np.concatenate([[0], trg]))
+            self.trg_ids_next.append(np.concatenate([trg, [1]]))
+
+    def __getitem__(self, idx):
+        return (
+            np.array(self.src_ids[idx]),
+            np.array(self.trg_ids[idx]),
+            np.array(self.trg_ids_next[idx]),
+        )
+
+    def __len__(self):
+        return len(self.src_ids)
+
+    def get_dict(self, reverse=False):
+        d = {f"tok{i}": i for i in range(self._dict_size)}
+        return {v: k for k, v in d.items()} if reverse else d
+
+
+class WMT14(_SyntheticWMT):
+    """Synthetic WMT14 en-fr-shaped dataset (reference
+    text/datasets/wmt14.py)."""
+
+    def __init__(self, data_file=None, mode="train", dict_size=30000, seed=0):
+        assert mode in ("train", "test", "gen")
+        self._dict_size = dict_size if dict_size > 0 else 30000
+        super().__init__(
+            256 if mode == "train" else 64, self._dict_size,
+            seed + {"train": 0, "test": 1, "gen": 2}[mode],
+        )
+
+
+class WMT16(_SyntheticWMT):
+    """Synthetic WMT16 multimodal-task-shaped dataset (reference
+    text/datasets/wmt16.py); lang selects the (synthetic) source side."""
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=10000,
+                 trg_dict_size=10000, lang="en", seed=0):
+        assert mode in ("train", "test", "val")
+        self.lang = lang
+        self._dict_size = src_dict_size if src_dict_size > 0 else 10000
+        super().__init__(
+            256 if mode == "train" else 64, self._dict_size,
+            seed + {"train": 0, "test": 1, "val": 2}[mode] + (7 if lang != "en" else 0),
+        )
+
+    def get_dict(self, lang="en", reverse=False):
+        d = {f"{lang}_tok{i}": i for i in range(self._dict_size)}
+        return {v: k for k, v in d.items()} if reverse else d
+
+
+__all__ += ["Imikolov", "Movielens", "WMT14", "WMT16"]

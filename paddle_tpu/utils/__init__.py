@@ -35,3 +35,49 @@ def flatten(nest):
 
     rec(nest)
     return out
+
+
+def require_version(min_version, max_version=None):
+    """Assert the installed framework version is within [min_version,
+    max_version] (reference base/framework.py:486). No return when
+    satisfied; raises otherwise."""
+    import re
+
+    from .. import version as _version
+
+    if not isinstance(min_version, str):
+        raise TypeError(
+            "The type of 'min_version' in require_version must be str, "
+            f"but received {type(min_version)}."
+        )
+    if not isinstance(max_version, (str, type(None))):
+        raise TypeError(
+            "The type of 'max_version' in require_version must be str or "
+            f"type(None), but received {type(max_version)}."
+        )
+    fmt = r"\d+(\.\d+){0,3}"
+    for label, v in (("min_version", min_version), ("max_version", max_version)):
+        if v is None:
+            continue
+        m = re.match(fmt, v)
+        if m is None or m.group() != v:
+            raise ValueError(
+                f"The value of '{label}' in require_version must be in "
+                f"format '\\d+(\\.\\d+){{0,3}}', like '1.5.2.0', but received {v}"
+            )
+
+    def parts(v):
+        p = [int(x) for x in v.split(".")]
+        return p + [0] * (4 - len(p))
+
+    installed = parts(_version.full_version)
+    if parts(min_version) > installed:
+        raise Exception(
+            f"PaddlePaddle version {_version.full_version} is installed, "
+            f"but require_version needs at least {min_version}"
+        )
+    if max_version is not None and parts(max_version) < installed:
+        raise Exception(
+            f"PaddlePaddle version {_version.full_version} is installed, "
+            f"but require_version allows at most {max_version}"
+        )

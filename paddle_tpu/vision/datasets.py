@@ -150,3 +150,39 @@ class ImageFolder(Dataset):
 
     def __len__(self):
         return len(self.samples)
+
+
+class VOC2012(Dataset):
+    """Synthetic VOC2012-shaped segmentation dataset (reference
+    vision/datasets/voc2012.py: (image HWC uint8, label mask HW uint8 with
+    class ids 0..20 + 255 ignore))."""
+
+    IMAGE_SHAPE = (64, 64, 3)
+    NUM_CLASSES = 21
+    TRAIN_N = 128
+    TEST_N = 32
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend="numpy", seed=0):
+        assert mode in ("train", "valid", "test"), (
+            f"mode must be train/valid/test, got {mode}"
+        )
+        self.mode = mode
+        self.transform = transform
+        n = self.TRAIN_N if mode == "train" else self.TEST_N
+        rng = np.random.RandomState(seed + {"train": 0, "valid": 1, "test": 2}[mode])
+        self.images = rng.randint(0, 256, (n,) + self.IMAGE_SHAPE, dtype=np.uint8)
+        masks = rng.randint(0, self.NUM_CLASSES, (n,) + self.IMAGE_SHAPE[:2])
+        border = rng.rand(n, *self.IMAGE_SHAPE[:2]) < 0.05
+        masks = np.where(border, 255, masks)
+        self.labels = masks.astype(np.uint8)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        label = self.labels[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self.images)

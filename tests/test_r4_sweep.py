@@ -1,0 +1,283 @@
+"""Round-4 stray-name sweep: functional tests for the real capabilities
+added (audio WAV I/O, datasets, fleet fs/util/data generators, geometric
+weighted sampling + heter reindex, tensor method strays).
+
+Reference: VERDICT r3 "What's missing" #5-8.
+"""
+import io
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestAudioIO:
+    def test_wav_save_load_info_roundtrip(self, tmp_path):
+        sr = 16000
+        n = 8000
+        wav = np.linspace(-1.0, 1.0, n).astype(np.float32) * 0.1
+        waveform = paddle.to_tensor(np.tile(wav, (2, 1)))  # [C=2, T]
+        p = str(tmp_path / "t.wav")
+        paddle.audio.save(p, waveform, sr)
+
+        inf = paddle.audio.info(p)
+        assert inf.sample_rate == sr
+        assert inf.num_channels == 2
+        assert inf.num_samples == n
+        assert inf.bits_per_sample == 16
+        assert inf.encoding == "PCM_S"
+
+        loaded, sr2 = paddle.audio.load(p)
+        assert sr2 == sr
+        assert tuple(loaded.shape) == (2, n)
+        np.testing.assert_allclose(loaded.numpy(), waveform.numpy(), atol=2e-4)
+
+        # frame windowing + raw int16 + channels_last
+        part, _ = paddle.audio.load(p, frame_offset=100, num_frames=50,
+                                    normalize=False, channels_first=False)
+        assert tuple(part.shape) == (50, 2)
+        assert part.numpy().dtype == np.int16
+
+    def test_backend_registry(self):
+        assert "wave_backend" in paddle.audio.backends.list_available_backends()
+        assert paddle.audio.backends.get_current_backend() == "wave_backend"
+        with pytest.raises(NotImplementedError):
+            paddle.audio.backends.set_backend("soundfile")
+
+    def test_non_wav_rejected(self, tmp_path):
+        p = str(tmp_path / "t.mp3")
+        with open(p, "wb") as f:
+            f.write(b"ID3\x00 not a wav")
+        with pytest.raises(NotImplementedError):
+            paddle.audio.info(p)
+
+
+class TestDatasets:
+    def test_imikolov(self):
+        d = paddle.text.Imikolov(data_type="NGRAM", window_size=5)
+        assert len(d) > 0
+        item = d[0]
+        assert len(item) == 5
+        d2 = paddle.text.Imikolov(data_type="SEQ", mode="test")
+        src, trg = d2[0]
+        assert len(src) == len(trg)
+        with pytest.raises(AssertionError):
+            paddle.text.Imikolov(data_type="NGRAM", window_size=-1)
+
+    def test_movielens(self):
+        d = paddle.text.Movielens()
+        row = d[0]
+        assert len(row) == 8
+        assert 1 <= row[-1] <= 5  # rating
+
+    def test_wmt(self):
+        for cls in (paddle.text.WMT14, paddle.text.WMT16):
+            d = cls(mode="train")
+            src, trg, trg_next = d[0]
+            assert len(trg) == len(trg_next)
+            assert trg[0] == 0 and trg_next[-1] == 1  # <s> ... </s>
+            assert len(d.get_dict()) > 0
+
+    def test_voc2012(self):
+        d = paddle.vision.datasets.VOC2012(mode="train")
+        img, label = d[0]
+        assert img.shape == (64, 64, 3) and img.dtype == np.uint8
+        assert label.shape == (64, 64)
+        ids = np.unique(label)
+        assert ids.max() == 255 or ids.max() < 21  # classes + ignore
+
+
+class TestFleetUtils:
+    def test_localfs(self, tmp_path):
+        from paddle_tpu.distributed.fleet.utils import LocalFS
+
+        fs = LocalFS()
+        d = str(tmp_path / "a")
+        fs.mkdirs(d)
+        assert fs.is_dir(d) and fs.is_exist(d)
+        f = os.path.join(d, "x.txt")
+        fs.touch(f)
+        assert fs.is_file(f)
+        with open(f, "w") as h:
+            h.write("hello")
+        assert fs.cat(f) == "hello"
+        dirs, files = fs.ls_dir(d)
+        assert files == ["x.txt"]
+        fs.mv(f, os.path.join(d, "y.txt"))
+        assert fs.is_file(os.path.join(d, "y.txt"))
+        assert not fs.need_upload_download()
+        fs.delete(d)
+        assert not fs.is_exist(d)
+
+    def test_hdfs_client_no_hadoop(self):
+        from paddle_tpu.distributed.fleet.utils import HDFSClient
+        from paddle_tpu.distributed.fleet.utils.fs import ExecuteError
+
+        c = HDFSClient(hadoop_home="/nonexistent")
+        with pytest.raises(ExecuteError):
+            c.ls_dir("/tmp")
+
+    def test_role_maker_and_util(self, monkeypatch):
+        import paddle_tpu.distributed.fleet as fleet
+
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "1")
+        monkeypatch.setenv("PADDLE_TRAINERS_NUM", "2")
+        rm = fleet.PaddleCloudRoleMaker()
+        assert rm.worker_index() == 1 and rm.worker_num() == 2
+        assert rm.is_worker() and not rm.is_first_worker()
+
+        urm = fleet.UserDefinedRoleMaker(current_id=0, worker_num=2)
+        assert urm.is_first_worker()
+
+        util = fleet.UtilBase()
+        util._set_role_maker(rm)
+        # worker 1 of 2, 5 files -> [a b c] / [d e]
+        shard = util.get_file_shard(["a", "b", "c", "d", "e"])
+        assert shard == ["d", "e"]
+        with pytest.raises(TypeError):
+            util.get_file_shard("not-a-list")
+
+    def test_data_generators(self, capsys):
+        import paddle_tpu.distributed.fleet as fleet
+
+        g = fleet.MultiSlotDataGenerator()
+        s = g._gen_str([("words", [1926, 8, 17]), ("label", [1])])
+        assert s == "3 1926 8 17 1 1\n"
+        assert g._proto_info == [("words", "uint64"), ("label", "uint64")]
+        s2 = g._gen_str([("words", [1.5]), ("label", [2])])
+        assert g._proto_info[0] == ("words", "float")
+        with pytest.raises(ValueError):
+            g._gen_str([("oops", [1])])  # inconsistent field count
+
+        gs = fleet.MultiSlotStringDataGenerator()
+        assert gs._gen_str([("w", ["a", "b"]), ("l", ["1"])]) == "2 a b 1 1\n"
+
+        class G(fleet.MultiSlotDataGenerator):
+            def generate_sample(self, line):
+                def it():
+                    yield [("v", [1, 2])]
+                return it
+
+        gg = G()
+        gg.set_batch(1)
+        gg.run_from_memory()
+        out = capsys.readouterr().out
+        assert "2 1 2" in out
+
+    def test_distributed_infer(self):
+        from paddle_tpu.distributed.fleet.utils import DistributedInfer
+
+        di = DistributedInfer()
+        assert di.get_dist_infer_program() is di.origin_main_program
+
+
+class TestGeometricR4:
+    def test_weighted_sample_neighbors(self):
+        paddle.seed(7)
+        # star graph: node 0 has neighbors 1..9; weight concentrated on 5
+        row = paddle.to_tensor(np.arange(1, 10, dtype=np.int64))
+        colptr = paddle.to_tensor(np.array([0, 9, 9, 9, 9, 9, 9, 9, 9, 9, 9], np.int64))
+        w = np.full((9,), 1e-6, np.float32)
+        w[4] = 1.0  # neighbor id 5
+        nbr, cnt = paddle.geometric.weighted_sample_neighbors(
+            row, colptr, paddle.to_tensor(w),
+            paddle.to_tensor(np.array([0], np.int64)), sample_size=1)
+        assert cnt.numpy().tolist() == [1]
+        assert nbr.numpy()[0] == 5  # overwhelmingly-weighted neighbor wins
+
+        # sample_size=-1 returns all
+        nbr, cnt = paddle.geometric.weighted_sample_neighbors(
+            row, colptr, paddle.to_tensor(w),
+            paddle.to_tensor(np.array([0], np.int64)), sample_size=-1)
+        assert cnt.numpy().tolist() == [9]
+
+    def test_reindex_heter_graph_doc_example(self):
+        # the reference docstring example (reindex.py:151)
+        x = paddle.to_tensor(np.array([0, 1, 2], np.int64))
+        nA = paddle.to_tensor(np.array([8, 9, 0, 4, 7, 6, 7], np.int64))
+        cA = paddle.to_tensor(np.array([2, 3, 2], np.int64))
+        nB = paddle.to_tensor(np.array([0, 2, 3, 5, 1], np.int64))
+        cB = paddle.to_tensor(np.array([1, 3, 1], np.int64))
+        src, dst, out_nodes = paddle.geometric.reindex_heter_graph(
+            x, [nA, nB], [cA, cB])
+        assert src.numpy().tolist() == [3, 4, 0, 5, 6, 7, 6, 0, 2, 8, 9, 1]
+        assert dst.numpy().tolist() == [0, 0, 1, 1, 1, 2, 2, 0, 1, 1, 1, 2]
+        assert out_nodes.numpy().tolist() == [0, 1, 2, 8, 9, 4, 7, 6, 3, 5]
+
+
+class TestMiscStrays:
+    def test_device_predicates(self):
+        assert paddle.device.is_compiled_with_cuda() is False
+        assert paddle.device.is_compiled_with_rocm() is False
+        assert paddle.device.is_compiled_with_distribute() is True
+        assert paddle.device.get_cudnn_version() is None
+        with pytest.raises(RuntimeError):
+            paddle.device.XPUPlace(0)
+
+    def test_require_version(self):
+        paddle.utils.require_version("0.0.1")
+        paddle.utils.require_version("0.0.1", "99.0")
+        with pytest.raises(Exception):
+            paddle.utils.require_version("99.0.0")
+        with pytest.raises(TypeError):
+            paddle.utils.require_version(1)
+        with pytest.raises(ValueError):
+            paddle.utils.require_version("not-a-version")
+
+    def test_summary_view(self):
+        from paddle_tpu.profiler import SummaryView
+
+        assert SummaryView.KernelView.value == 4
+
+    def test_quanter_decorator(self):
+        from paddle_tpu import quantization as Q
+
+        @Q.quanter("TestQuanterFactory")
+        class TestQuanterLayer(Q.BaseQuanter):
+            def __init__(self, layer=None, k=2.0):
+                super().__init__()
+                self.k = k
+
+            def forward(self, x):
+                return x * self.k
+
+            def scales(self):
+                return None
+
+            def zero_points(self):
+                return None
+
+        import sys
+
+        factory_cls = getattr(sys.modules[__name__], "TestQuanterFactory")
+        inst = factory_cls(k=4.0)._instance(None)
+        out = inst(paddle.to_tensor(np.array([2.0], np.float32)))
+        assert out.numpy()[0] == 8.0
+
+    def test_tensor_method_strays(self):
+        x = paddle.to_tensor(np.random.randn(4, 4).astype(np.float32))
+        np.testing.assert_allclose(x.tril().numpy(), np.tril(x.numpy()))
+        np.testing.assert_allclose(x.triu().numpy(), np.triu(x.numpy()))
+        np.testing.assert_allclose(x.diag().numpy(), np.diag(x.numpy()))
+        v = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        assert tuple(v.diagflat().shape) == (2, 2)
+        y = paddle.to_tensor(np.array([0.5, 0.8], np.float32))
+        y.sigmoid_()
+        np.testing.assert_allclose(
+            y.numpy(), 1 / (1 + np.exp(-np.array([0.5, 0.8]))), rtol=1e-5)
+        z = paddle.to_tensor(np.zeros((2000,), np.float32))
+        paddle.seed(11)
+        z.exponential_(2.0)
+        assert z.numpy().min() >= 0
+        assert abs(z.numpy().mean() - 0.5) < 0.1  # E[Exp(2)] = 0.5
+        # stft as a method
+        sig = paddle.to_tensor(np.sin(np.linspace(0, 100, 512)).astype(np.float32))
+        spec = sig.stft(n_fft=64, center=True)
+        assert spec.ndim >= 2
+
+    def test_rpc_worker_info_name(self):
+        from paddle_tpu.distributed import rpc
+
+        assert hasattr(rpc, "get_current_worker_info")
