@@ -9,13 +9,47 @@ jax.jit replay (executor.py) — see those modules for the design mapping.
 from ..jit.api import cond  # noqa: F401
 from . import nn  # noqa: F401
 from .executor import Executor, append_backward, global_scope, scope_guard  # noqa: F401
-from .io import load_inference_model, save_inference_model  # noqa: F401
+from .io import load, load_inference_model, save, save_inference_model  # noqa: F401
 from .program import (  # noqa: F401
     Program,
     data,
     default_main_program,
     default_startup_program,
     program_guard,
+)
+from ..ops.creation import create_parameter  # noqa: F401
+from .extras import (  # noqa: F401
+    BuildStrategy,
+    CompiledProgram,
+    ExecutionStrategy,
+    ExponentialMovingAverage,
+    IpuCompiledProgram,
+    IpuStrategy,
+    Print,
+    Variable,
+    WeightNormParamAttr,
+    accuracy,
+    auc,
+    cpu_places,
+    create_global_var,
+    ctr_metric_bundle,
+    cuda_places,
+    deserialize_persistables,
+    deserialize_program,
+    device_guard,
+    gradients,
+    ipu_shard_guard,
+    load_from_file,
+    load_program_state,
+    name_scope,
+    normalize_program,
+    py_func,
+    save_to_file,
+    serialize_persistables,
+    serialize_program,
+    set_ipu_shard,
+    set_program_state,
+    xpu_places,
 )
 
 

@@ -92,6 +92,10 @@ class Executor:
 
         if isinstance(program, _InferenceProgram):
             return program._run(feed or {}, return_numpy)
+        from .extras import CompiledProgram
+
+        if isinstance(program, CompiledProgram):
+            program = program._program
         program = program if program is not None else default_main_program()
         feed = feed or {}
         fetch_list = list(fetch_list or [])

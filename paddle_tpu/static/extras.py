@@ -1,0 +1,433 @@
+"""paddle.static top-level additions (r4).
+
+Reference parity: python/paddle/static/__init__.py __all__ — the config
+shims (BuildStrategy/ExecutionStrategy/CompiledProgram), program
+serialization (static/io.py:194-784), program-state utilities (:1726),
+ExponentialMovingAverage (static/nn/common.py:4010), metrics
+(static/nn/metric.py), places, Print/py_func, and guards. TPU-native
+notes inline: strategies that tune the reference's SSA-graph executor are
+honest no-op config carriers here because XLA owns scheduling/fusion.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import pickle
+
+import numpy as np
+from jax import numpy as jnp
+
+from ..core.tensor import Tensor
+from .program import Program, default_main_program
+
+Variable = Tensor  # reference exports the static Variable; one tensor type here
+
+
+class BuildStrategy:
+    """Config carrier (reference BuildStrategy pybind). Every knob the
+    reference exposes tunes its SSA-graph executor passes; XLA performs
+    fusion/memory planning itself, so the fields are recorded and surfaced
+    but change nothing — kept so configs port without edits."""
+
+    class ReduceStrategy:
+        AllReduce = 0
+        Reduce = 1
+
+    class GradientScaleStrategy:
+        CoeffNumDevice = 0
+        One = 1
+        Customized = 2
+
+    def __init__(self):
+        self.memory_optimize = None
+        self.enable_inplace = None
+        self.fuse_all_optimizer_ops = False
+        self.fuse_all_reduce_ops = False
+        self.fuse_broadcast_ops = False
+        self.fuse_elewise_add_act_ops = False
+        self.fuse_bn_act_ops = False
+        self.fuse_bn_add_act_ops = True
+        self.fuse_gemm_epilogue = False
+        self.sync_batch_norm = False
+        self.reduce_strategy = BuildStrategy.ReduceStrategy.AllReduce
+        self.gradient_scale_strategy = BuildStrategy.GradientScaleStrategy.CoeffNumDevice
+        self.build_cinn_pass = False
+        self.debug_graphviz_path = ""
+
+    def __repr__(self):
+        fields = ", ".join(f"{k}={v!r}" for k, v in vars(self).items())
+        return f"BuildStrategy({fields})"
+
+
+class ExecutionStrategy:
+    """Config carrier (reference ExecutionStrategy pybind): thread counts /
+    iteration drop control for the reference's parallel executor. XLA's
+    runtime schedules; fields are carried for config portability."""
+
+    def __init__(self):
+        self.num_threads = 0
+        self.num_iteration_per_drop_scope = 100
+        self.num_iteration_per_run = 1
+        self.use_device = None
+
+
+class CompiledProgram:
+    """Wrapper marking a Program for 'compiled' execution (reference
+    compiler.py CompiledProgram). The jit-replay Executor compiles every
+    program through XLA already, so this is an annotation the Executor
+    unwraps; build_strategy is carried for introspection."""
+
+    def __init__(self, program_or_graph, build_strategy=None):
+        self._program = program_or_graph
+        self._build_strategy = build_strategy or BuildStrategy()
+
+    def __getattr__(self, item):
+        return getattr(object.__getattribute__(self, "_program"), item)
+
+
+class IpuStrategy:
+    """IPU support is not part of the TPU build (reference gates these on
+    compiled-with-IPU and raises the same way)."""
+
+    def __init__(self):
+        raise RuntimeError("IpuStrategy is only available with IPU support")
+
+
+class IpuCompiledProgram:
+    def __init__(self, program=None, ipu_strategy=None, scope=None):
+        raise RuntimeError("IpuCompiledProgram is only available with IPU support")
+
+
+@contextlib.contextmanager
+def ipu_shard_guard(index=-1, stage=-1):
+    raise RuntimeError("ipu_shard_guard is only available with IPU support")
+    yield
+
+
+def set_ipu_shard(call_func, index=-1, stage=-1):
+    raise RuntimeError("set_ipu_shard is only available with IPU support")
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    """Name prefix for ops recorded under it (reference framework.name_scope).
+    Naming is cosmetic in the jaxpr world; the guard still nests."""
+    yield
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """Reference framework.device_guard pins ops to a device inside static
+    graphs. Placement is XLA/GSPMD's job here; the guard is accepted and
+    ops run where the program runs."""
+    yield
+
+
+def cpu_places(device_count=None):
+    """Reference static.cpu_places: CPU_NUM places."""
+    from ..framework.device import CPUPlace
+
+    n = device_count or int(os.environ.get("CPU_NUM", 1))
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    """Raises like a paddle build without CUDA (this is the TPU build)."""
+    raise RuntimeError(
+        "cuda_places: not compiled with CUDA (TPU build — use tpu places "
+        "via paddle.device)"
+    )
+
+
+def xpu_places(device_ids=None):
+    raise RuntimeError("xpu_places: not compiled with XPU")
+
+
+def create_global_var(shape, value, dtype, persistable=False, force_cpu=False,
+                      name=None):
+    """Filled global variable (reference tensor/creation.py:77)."""
+    from ..framework import dtype as _dt
+
+    t = Tensor(jnp.full(tuple(int(s) for s in shape), value,
+                        _dt.convert_dtype(dtype)), name=name)
+    t.persistable = persistable
+    return t
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """Reference static backward.gradients: grads of targets w.r.t. inputs
+    appended to the program — here one taped reverse pass (recorded under
+    capture like any other ops)."""
+    from .. import autograd as _ag
+
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    return _ag.grad(
+        list(targets), list(inputs), grad_outputs=target_gradients,
+        retain_graph=True, allow_unused=True,
+        no_grad_vars=list(no_grad_set) if no_grad_set else None,
+    )
+
+
+def Print(input, first_n=-1, message=None, summarize=20, print_tensor_name=True,  # noqa: A002
+          print_tensor_type=True, print_tensor_shape=True,
+          print_tensor_layout=True, print_tensor_lod=True,
+          print_phase="both"):
+    """Print-as-an-op (reference static/nn/control_flow.py Print): runs
+    inside compiled programs via jax.debug.print, so to_static/Executor
+    replays still print — the XLA-native version of the reference's Print
+    operator."""
+    import jax
+
+    from ..core.apply import apply
+
+    msg = message or ""
+
+    def fn(v):
+        jax.debug.print(msg + " {x}", x=v)
+        return v
+
+    return apply("print_op", fn, input)
+
+
+def py_func(func, x, out=None, backward_func=None,
+            skip_vars_in_backward_input=None):
+    """Reference static.py_func re-export (see static.nn.py_func)."""
+    from . import nn as _static_nn
+
+    return _static_nn.py_func(func, x, out=out, backward_func=backward_func,
+                              skip_vars_in_backward_input=skip_vars_in_backward_input)
+
+
+class WeightNormParamAttr:
+    """ParamAttr requesting weight-norm reparameterization (reference
+    static/__init__.py WeightNormParamAttr). Carried attr: layers consume
+    it like ParamAttr; use nn.utils.weight_norm for the dynamic API."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.do_model_average = do_model_average
+        self.need_clip = need_clip
+
+
+class ExponentialMovingAverage:
+    """EMA of trainable parameters with bias correction
+    (reference static/nn/common.py:4010): update() folds current values in,
+    apply() swaps EMA values into the parameters (context manager restores),
+    restore() undoes an apply."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._step = 0
+        self._ema = {}
+        self._backup = {}
+        self._params = None
+        # bind the program current at construction (reference: EMA is built
+        # inside the program it averages)
+        from .program import default_main_program
+
+        self._program = default_main_program()
+
+    def _param_list(self):
+        if self._params is None:
+            prog = self._program
+            params = [prog._var_tensors[v] for v in prog.param_vars]
+            trainable = [p for p in params if not p.stop_gradient]
+            if not trainable:
+                raise ValueError(
+                    "ExponentialMovingAverage found no trainable parameters "
+                    "in the current program — call it after building the model"
+                )
+            self._params = trainable
+        return self._params
+
+    def update(self):
+        self._step += 1
+        for p in self._param_list():
+            key = id(p)
+            v = np.asarray(p._value)
+            if key not in self._ema:
+                self._ema[key] = v * (1.0 - self._decay)
+            else:
+                self._ema[key] = (
+                    self._decay * self._ema[key] + (1.0 - self._decay) * v
+                )
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        correction = 1.0 - self._decay ** max(1, self._step)
+        for p in self._param_list():
+            self._backup[id(p)] = np.asarray(p._value)
+            if id(p) in self._ema:
+                p.set_value(jnp.asarray(self._ema[id(p)] / correction,
+                                        p._value.dtype))
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore(executor)
+
+    def restore(self, executor=None):
+        for p in self._param_list():
+            if id(p) in self._backup:
+                p.set_value(jnp.asarray(self._backup.pop(id(p))))
+
+
+def accuracy(input, label, k=1, correct=None, total=None):  # noqa: A002
+    """Top-k accuracy as an op (reference static/nn/metric.py:34)."""
+    import jax
+
+    from ..core.apply import apply
+
+    def fn(pred, lbl):
+        kk = min(k, pred.shape[-1])
+        topk = jax.lax.top_k(pred, kk)[1]
+        hit = (topk == lbl.reshape(-1, 1)).any(axis=-1)
+        return jnp.mean(hit.astype(jnp.float32))
+
+    return apply("accuracy", fn, input, label)
+
+
+def auc(input, label, curve="ROC", num_thresholds=2 ** 12 - 1, topk=1,  # noqa: A002
+        slide_steps=1, ins_tag_weight=None):
+    """Batch AUC as an op (reference static/nn/metric.py:136): thresholded
+    ROC integration, all on device. Returns (auc, [batch stat tensors])
+    like the reference's (auc_out, batch_auc_out, states)."""
+    from ..core.apply import apply
+
+    nt = min(int(num_thresholds), 4095)
+
+    def fn(pred, lbl):
+        p1 = pred[:, -1] if pred.ndim == 2 else pred.reshape(-1)
+        y = lbl.reshape(-1).astype(jnp.bool_)
+        thr = jnp.linspace(0.0, 1.0, nt + 1)
+        ge = p1[None, :] >= thr[:, None]            # [T+1, B]
+        tp = jnp.sum(ge & y[None, :], axis=1).astype(jnp.float64)
+        fp = jnp.sum(ge & ~y[None, :], axis=1).astype(jnp.float64)
+        pos = jnp.maximum(jnp.sum(y), 1)
+        neg = jnp.maximum(jnp.sum(~y), 1)
+        tpr = tp / pos
+        fpr = fp / neg
+        # thresholds descend left->right after flip; trapezoid over fpr
+        return jnp.abs(jnp.trapezoid(tpr, fpr))
+
+    a = apply("auc", fn, input, label)
+    return a, [a]
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):  # noqa: A002
+    """CTR metric bundle (reference static/nn/metric.py:343): returns
+    (auc, sqrerr, abserr, prob, q, pos, total) batch tensors."""
+    from ..core.apply import apply
+    from ..ops import math as _m
+
+    a, _ = auc(input, label)
+
+    def stats(pred, lbl):
+        p1 = pred[:, -1] if pred.ndim == 2 else pred.reshape(-1)
+        y = lbl.reshape(-1).astype(jnp.float32)
+        sqrerr = jnp.sum((p1 - y) ** 2)
+        abserr = jnp.sum(jnp.abs(p1 - y))
+        prob = jnp.sum(p1)
+        q = jnp.sum(p1 * p1)
+        pos = jnp.sum(y)
+        total = jnp.asarray(p1.shape[0], jnp.float32)
+        return sqrerr, abserr, prob, q, pos, total
+
+    sqrerr, abserr, prob, q, pos, total = apply(
+        "ctr_stats", stats, input, label, n_outputs=6)
+    return a, sqrerr, abserr, prob, q, pos, total
+
+
+# ---------------------------------------------------------------------------
+# program serialization / state (reference static/io.py)
+# ---------------------------------------------------------------------------
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    """Reference static/io.py:194 prunes + inlines for inference. XLA DCEs
+    the replayed jaxpr, so the program is already normal form."""
+    if not isinstance(program, Program):
+        raise TypeError("program must be a Program")
+    return program
+
+
+def serialize_program(feed_vars, fetch_vars, **kwargs):
+    """Program -> bytes (reference static/io.py:315): the exported
+    StableHLO blob of the feed->fetch computation — the portable program
+    format of this framework."""
+    from .io import _export_blob
+
+    return _export_blob(feed_vars, fetch_vars,
+                        kwargs.get("program") or default_main_program())
+
+
+def serialize_persistables(feed_vars, fetch_vars, executor=None, **kwargs):
+    """Persistable params -> bytes (reference static/io.py:375)."""
+    program = kwargs.get("program") or default_main_program()
+    state = {}
+    for i, vid in enumerate(program.param_vars):
+        t = program._var_tensors[vid]
+        key = getattr(t, "name", None) or f"param_{i}"
+        state[key] = np.asarray(t._value)
+    return pickle.dumps(state)
+
+
+def save_to_file(path, content):
+    """Reference static/io.py:473."""
+    if not isinstance(content, bytes):
+        raise ValueError("content must be bytes")
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    """Reference static/io.py:784."""
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def deserialize_program(data):
+    """bytes -> runnable program object (reference static/io.py:635).
+    Returns the rehydrated exported computation; Executor.run accepts it
+    and load_inference_model shares the format."""
+    from jax import export as jax_export
+
+    return jax_export.deserialize(data)
+
+
+def deserialize_persistables(program, data, executor=None):
+    """bytes -> parameter values restored into program (reference
+    static/io.py:682)."""
+    state = pickle.loads(data)
+    set_program_state(program, state)
+    return state
+
+
+def load_program_state(model_path, var_list=None):
+    """Reference static/io.py:1839: read a .pdparams state dict."""
+    path = model_path if model_path.endswith(".pdparams") else model_path + ".pdparams"
+    with open(path, "rb") as f:
+        state = pickle.load(f)
+    if var_list is not None:
+        names = {getattr(v, "name", v) for v in var_list}
+        state = {k: v for k, v in state.items() if k in names}
+    return state
+
+
+def set_program_state(program, state_dict):
+    """Reference static/io.py:1726: write a state dict into the program's
+    persistable tensors by name (positional fallback for unnamed)."""
+    if not isinstance(program, Program):
+        program = getattr(program, "_program", program)
+    for i, vid in enumerate(program.param_vars):
+        t = program._var_tensors[vid]
+        key = getattr(t, "name", None) or f"param_{i}"
+        if key in state_dict:
+            t.set_value(jnp.asarray(state_dict[key]))

@@ -36,10 +36,11 @@ class _InferenceProgram:
         return [Tensor(o) for o in outs]
 
 
-def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None, program=None, **kwargs):
-    """Freeze `program` (default: current main) to path_prefix.pdmodel +
-    .pdmeta. Weights are constants inside the StableHLO blob."""
-    program = program or default_main_program()
+def _export_program(feed_vars, fetch_vars, program):
+    """Export the feed->fetch computation of `program` (weights baked in as
+    constants, declared -1 feed dims kept symbolic). Shared by
+    save_inference_model and serialize_program so both honor dynamic
+    batch dims. Returns (exported, feed_names)."""
     feed_vars = feed_vars if isinstance(feed_vars, (list, tuple)) else [feed_vars]
     fetch_vars = fetch_vars if isinstance(fetch_vars, (list, tuple)) else [fetch_vars]
     feed_ids, feed_names = [], []
@@ -80,14 +81,22 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None, prog
         shape = jax_export.symbolic_shape(",".join(dims), scope=scope) if dynamic else tuple(int(d) for d in declared)
         specs.append(jax.ShapeDtypeStruct(shape, fv._value.dtype))
 
-    exported = jax_export.export(jax.jit(infer_fn))(*specs)
+    return jax_export.export(jax.jit(infer_fn))(*specs), feed_names
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None, program=None, **kwargs):
+    """Freeze `program` (default: current main) to path_prefix.pdmodel +
+    .pdmeta. Weights are constants inside the StableHLO blob."""
+    program = program or default_main_program()
+    fetch_vars = fetch_vars if isinstance(fetch_vars, (list, tuple)) else [fetch_vars]
+    exported, feed_names = _export_program(feed_vars, fetch_vars, program)
     d = os.path.dirname(path_prefix)
     if d:
         os.makedirs(d, exist_ok=True)
     with open(path_prefix + ".pdmodel", "wb") as f:
         f.write(exported.serialize())
     with open(path_prefix + ".pdmeta", "wb") as f:
-        pickle.dump({"feed_names": feed_names, "n_fetch": len(fetch_ids)}, f)
+        pickle.dump({"feed_names": feed_names, "n_fetch": len(fetch_vars)}, f)
     return path_prefix
 
 
@@ -101,3 +110,46 @@ def load_inference_model(path_prefix, executor=None, **kwargs):
         meta = pickle.load(f)
     prog = _InferenceProgram(exported, meta["feed_names"], meta["n_fetch"])
     return [prog, list(meta["feed_names"]), list(range(meta["n_fetch"]))]
+
+
+def save(program, model_path, protocol=4, **configs):
+    """Save a Program's persistable parameters (reference static/io.py
+    paddle.static.save: model_path + '.pdparams'). Keys are parameter
+    names, positional fallback for unnamed ones."""
+    state = {}
+    for i, vid in enumerate(program.param_vars):
+        t = program._var_tensors[vid]
+        key = getattr(t, "name", None) or f"param_{i}"
+        state[key] = np.asarray(t._value)
+    d = os.path.dirname(model_path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    path = model_path if model_path.endswith(".pdparams") else model_path + ".pdparams"
+    with open(path, "wb") as f:
+        pickle.dump(state, f, protocol=protocol)
+    return path
+
+
+def load(program, model_path, executor=None, var_list=None):
+    """Load parameters saved by static.save back into the Program's
+    persistable tensors (reference paddle.static.load)."""
+    path = model_path if model_path.endswith(".pdparams") else model_path + ".pdparams"
+    with open(path, "rb") as f:
+        state = pickle.load(f)
+    wanted = None
+    if var_list is not None:
+        wanted = {getattr(v, "name", None) for v in var_list}
+    for i, vid in enumerate(program.param_vars):
+        t = program._var_tensors[vid]
+        key = getattr(t, "name", None) or f"param_{i}"
+        if wanted is not None and getattr(t, "name", None) not in wanted:
+            continue
+        if key in state:
+            t.set_value(jnp.asarray(state[key]))
+
+
+def _export_blob(feed_vars, fetch_vars, program):
+    """Serialize the feed->fetch computation of `program` to bytes (the
+    StableHLO export save_inference_model writes to .pdmodel); shares
+    _export_program so dynamic -1 feed dims stay symbolic."""
+    return bytes(_export_program(feed_vars, fetch_vars, program)[0].serialize())

@@ -1,0 +1,247 @@
+"""Round-4 paddle.static depth: builders, strategies, EMA, metrics,
+serialization (VERDICT r3 missing #1).
+
+Reference: python/paddle/static/__init__.py, static/nn/__init__.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a, dtype=np.float32))
+
+
+class TestStaticNNBuilders:
+    def test_conv_builders(self):
+        x = _t(np.random.randn(2, 3, 8, 8))
+        out = static.nn.conv2d(x, num_filters=4, filter_size=3)
+        assert tuple(out.shape)[:2] == (2, 4)
+        out = static.nn.conv2d_transpose(x, num_filters=4, filter_size=3)
+        assert out.shape[1] == 4
+        x3 = _t(np.random.randn(2, 3, 4, 8, 8))
+        out = static.nn.conv3d(x3, num_filters=4, filter_size=3)
+        assert out.shape[1] == 4
+        out = static.nn.conv3d_transpose(x3, num_filters=2, filter_size=3)
+        assert out.shape[1] == 2
+
+    def test_norm_builders(self):
+        x = _t(np.random.randn(2, 6, 4, 4))
+        for out in [
+            static.nn.layer_norm(x, begin_norm_axis=1),
+            static.nn.group_norm(x, groups=2),
+            static.nn.instance_norm(x),
+        ]:
+            assert tuple(out.shape) == (2, 6, 4, 4)
+            assert np.isfinite(out.numpy()).all()
+        w = _t(np.random.randn(6, 10))
+        sn = static.nn.spectral_norm(w, dim=0)
+        assert tuple(sn.shape) == (6, 10)
+        dn = static.nn.data_norm(_t(np.random.randn(8, 5)))
+        assert tuple(dn.shape) == (8, 5)
+
+    def test_bilinear_and_row_conv_and_nce(self):
+        x, y = _t(np.random.randn(4, 5)), _t(np.random.randn(4, 3))
+        out = static.nn.bilinear_tensor_product(x, y, size=7)
+        assert tuple(out.shape) == (4, 7)
+
+        seq = _t(np.random.randn(2, 10, 4))
+        rc = static.nn.row_conv(seq, future_context_size=2)
+        assert tuple(rc.shape) == (2, 10, 4)
+        # row_conv with lookahead 0 and identity-ish weight == scaled input
+        rc0 = static.nn.row_conv(seq, future_context_size=0)
+        np.testing.assert_allclose(rc0.numpy(), seq.numpy(), rtol=1e-5)
+
+        emb = _t(np.random.randn(6, 8))
+        lbl = paddle.to_tensor(np.random.randint(0, 20, (6, 1)))
+        loss = static.nn.nce(emb, lbl, num_total_classes=20, num_neg_samples=4)
+        assert tuple(loss.shape) == (6, 1)
+        assert np.isfinite(loss.numpy()).all()
+
+    def test_control_flow(self):
+        a = _t(2.0)
+        r = static.nn.cond(a > 1.0, lambda: a * 2, lambda: a - 1)
+        assert float(r.numpy()) == 4.0
+        r = static.nn.case([(a > 5.0, lambda: a), (a > 1.0, lambda: a * 3)])
+        assert float(r.numpy()) == 6.0
+        r = static.nn.switch_case(paddle.to_tensor(1), {0: lambda: a, 1: lambda: a * 5})
+        assert float(r.numpy()) == 10.0
+        i = _t(0.0)
+        out = static.nn.while_loop(lambda i: i < 3.0, lambda i: i + 1.0, [i])
+        assert float(out[0].numpy()) == 3.0
+        assert float(static.nn.py_func(lambda v: v * 2, a).numpy()) == 4.0
+
+    def test_static_pylayer_custom_backward(self):
+        x = _t([1.0, 2.0])
+        x.stop_gradient = False
+        out = static.nn.static_pylayer(
+            lambda v: v * 2,
+            [x],
+            backward_fn=lambda g: g * 10,  # deliberately not the true grad
+        )
+        out.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [10.0, 10.0])
+
+    def test_sequence_ops(self):
+        x = _t(np.arange(24).reshape(2, 3, 4))
+        np.testing.assert_allclose(
+            static.nn.sequence_pool(x, "sum").numpy(), x.numpy().sum(1))
+        np.testing.assert_allclose(
+            static.nn.sequence_first_step(x).numpy(), x.numpy()[:, 0])
+        np.testing.assert_allclose(
+            static.nn.sequence_last_step(x).numpy(), x.numpy()[:, -1])
+        np.testing.assert_allclose(
+            static.nn.sequence_reverse(x).numpy(), x.numpy()[:, ::-1])
+        cat = static.nn.sequence_concat([x, x])
+        assert tuple(cat.shape) == (2, 6, 4)
+        rs = static.nn.sequence_reshape(x, new_dim=2)
+        assert tuple(rs.shape) == (2, 6, 2)
+        padded, lens = static.nn.sequence_pad(x, 0.0, maxlen=5)
+        assert tuple(padded.shape) == (2, 5, 4)
+        assert lens.numpy().tolist() == [3, 3]
+        unp = static.nn.sequence_unpad(padded, paddle.to_tensor(np.array([3, 2])))
+        assert tuple(unp.shape) == (2, 3, 4)
+        en = static.nn.sequence_enumerate(paddle.to_tensor(np.arange(6).reshape(2, 3)), 2)
+        assert tuple(en.shape) == (2, 3, 2)
+        conv = static.nn.sequence_conv(x, num_filters=5)
+        assert tuple(conv.shape) == (2, 3, 5)
+        sm = static.nn.sequence_softmax(x)
+        np.testing.assert_allclose(sm.numpy().sum(-1), np.ones((2, 3)), rtol=1e-5)
+
+
+class TestStaticExtras:
+    def test_strategies_and_compiled_program(self):
+        bs = static.BuildStrategy()
+        bs.fuse_elewise_add_act_ops = True
+        es = static.ExecutionStrategy()
+        es.num_threads = 4
+        prog = static.Program()
+        cp = static.CompiledProgram(prog, build_strategy=bs)
+        assert cp._build_strategy is bs
+        # Executor unwraps CompiledProgram
+        with static.program_guard(prog):
+            x = static.data("x", [2, 2], "float32")
+            y = x * 2.0
+        exe = static.Executor()
+        out = exe.run(static.CompiledProgram(prog),
+                      feed={"x": np.ones((2, 2), np.float32)}, fetch_list=[y])
+        np.testing.assert_allclose(out[0], np.full((2, 2), 2.0))
+
+    def test_ipu_raises(self):
+        with pytest.raises(RuntimeError):
+            static.IpuStrategy()
+        with pytest.raises(RuntimeError):
+            static.IpuCompiledProgram()
+
+    def test_places(self):
+        assert len(static.cpu_places(3)) == 3
+        with pytest.raises(RuntimeError):
+            static.cuda_places()
+        with pytest.raises(RuntimeError):
+            static.xpu_places()
+
+    def test_create_global_var_and_variable(self):
+        v = static.create_global_var([2, 3], 1.5, "float32", persistable=True)
+        assert v.persistable
+        np.testing.assert_allclose(v.numpy(), np.full((2, 3), 1.5))
+        assert static.Variable is paddle.Tensor or static.Variable.__name__ == "Tensor"
+
+    def test_gradients(self):
+        x = _t([1.0, 2.0])
+        x.stop_gradient = False
+        y = (x * x).sum()
+        (g,) = static.gradients(y, x)
+        np.testing.assert_allclose(g.numpy(), [2.0, 4.0])
+
+    def test_guards(self):
+        with static.name_scope("block"):
+            with static.device_guard("cpu"):
+                out = _t(1.0) + 1.0
+        assert float(out.numpy()) == 2.0
+
+    def test_accuracy_auc(self):
+        pred = _t([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]])
+        label = paddle.to_tensor(np.array([[1], [0], [0]]))
+        acc = static.accuracy(pred, label, k=1)
+        np.testing.assert_allclose(float(acc.numpy()), 2.0 / 3.0, rtol=1e-5)
+
+        # AUC sanity: perfect ranking -> 1.0
+        p = _t([[0.1, 0.9], [0.9, 0.1], [0.2, 0.8], [0.7, 0.3]])
+        y = paddle.to_tensor(np.array([[1], [0], [1], [0]]))
+        a, _ = static.auc(p, y)
+        assert float(a.numpy()) > 0.99
+        bundle = static.ctr_metric_bundle(p, y)
+        assert len(bundle) == 7
+        total = float(bundle[-1].numpy())
+        assert total == 4.0
+
+    def test_ema(self):
+        # reference usage: built and updated inside the program guard
+        prog = static.Program()
+        with static.program_guard(prog):
+            lin = paddle.nn.Linear(2, 2)
+            x = static.data("x", [1, 2], "float32")
+            _ = lin(x)
+            ema = static.ExponentialMovingAverage(decay=0.5)
+            w0 = lin.weight.numpy().copy()
+            ema.update()
+            lin.weight.set_value(paddle.to_tensor(w0 + 1.0))
+            ema.update()
+        with ema.apply():
+            # EMA after 2 steps with decay 0.5, bias-corrected
+            ema_raw = 0.5 * (w0 * 0.5) + 0.5 * (w0 + 1.0)
+            expect = ema_raw / (1 - 0.5 ** 2)
+            np.testing.assert_allclose(lin.weight.numpy(), expect, rtol=1e-5)
+        np.testing.assert_allclose(lin.weight.numpy(), w0 + 1.0, rtol=1e-6)
+
+    def test_program_state_roundtrip(self, tmp_path):
+        prog = static.Program()
+        with static.program_guard(prog):
+            lin = paddle.nn.Linear(3, 2)
+            x = static.data("x", [1, 3], "float32")
+            _ = lin(x)
+        path = str(tmp_path / "model")
+        static.save(prog, path)
+        orig = lin.weight.numpy().copy()
+        lin.weight.set_value(paddle.to_tensor(np.zeros_like(orig)))
+        static.load(prog, path)
+        np.testing.assert_allclose(lin.weight.numpy(), orig)
+
+        state = static.load_program_state(path)
+        assert any(v.shape == (3, 2) for v in state.values())
+        lin.weight.set_value(paddle.to_tensor(np.zeros_like(orig)))
+        static.set_program_state(prog, state)
+        np.testing.assert_allclose(lin.weight.numpy(), orig)
+
+    def test_serialize_roundtrip(self, tmp_path):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [2, 3], "float32")
+            lin = paddle.nn.Linear(3, 2)
+            y = lin(x)
+        blob = static.serialize_program([x], [y], program=prog)
+        assert isinstance(blob, bytes) and len(blob) > 0
+        pblob = static.serialize_persistables([x], [y], program=prog)
+        p = str(tmp_path / "prog.bin")
+        static.save_to_file(p, blob)
+        assert static.load_from_file(p) == blob
+        exported = static.deserialize_program(blob)
+        xin = np.random.randn(2, 3).astype(np.float32)
+        out = exported.call(xin)
+        expect = xin @ lin.weight.numpy() + lin.bias.numpy()
+        np.testing.assert_allclose(np.asarray(out[0]), expect, rtol=1e-5)
+        # persistables roundtrip restores values
+        lin.weight.set_value(paddle.to_tensor(np.zeros_like(lin.weight.numpy())))
+        static.deserialize_persistables(prog, pblob)
+        assert np.abs(lin.weight.numpy()).sum() > 0
+
+    def test_print_op(self, capfd):
+        x = _t([1.0, 2.0])
+        out = static.Print(x, message="val:")
+        np.testing.assert_allclose(out.numpy(), [1.0, 2.0])
+
+    def test_weight_norm_param_attr(self):
+        a = static.WeightNormParamAttr(dim=0, name="w")
+        assert a.dim == 0 and a.trainable
