@@ -28,6 +28,10 @@ class SparseTensor(Tensor):
         super().__init__(jnp.zeros((), jnp.float32), stop_gradient=stop_gradient, name=name)
         self._sparse_kind = kind
         self._dense_cache = None
+        # autograd threading: sparse.nn ops store their output values as a
+        # TAPE-CONNECTED Tensor here, so chained sparse layers backprop
+        # through values() like dense ops do
+        self._grad_values = None
 
     @property
     def value(self):
@@ -35,7 +39,7 @@ class SparseTensor(Tensor):
         # through this property: densify so mixed sparse/dense arithmetic is
         # numerically correct (the sparse.* functions use ._mat fast paths)
         if self._dense_cache is None:
-            self._dense_cache = self._mat.todense()
+            self._dense_cache = _todense(self._mat)
         return self._dense_cache
 
     # shape/dtype reflect the sparse payload
@@ -63,6 +67,8 @@ class SparseTensor(Tensor):
         return Tensor(self._mat.indices.T)  # paddle layout: [ndim, nnz]
 
     def values(self):
+        if self._grad_values is not None:
+            return self._grad_values
         return Tensor(self._mat.data)
 
     def crows(self):
@@ -79,7 +85,7 @@ class SparseTensor(Tensor):
         return int(self._mat.nse)
 
     def to_dense(self) -> Tensor:
-        return Tensor(self._mat.todense())
+        return Tensor(_todense(self._mat))
 
     def to_sparse_csr(self) -> "SparseTensor":
         if self._sparse_kind == "csr":
@@ -93,10 +99,22 @@ class SparseTensor(Tensor):
         return SparseTensor(jsparse.BCOO.fromdense(self._mat.todense()), kind="coo")
 
     def numpy(self):
-        return np.asarray(self._mat.todense())
+        return np.asarray(_todense(self._mat))
 
     def __repr__(self):
         return f"SparseTensor({self._sparse_kind}, shape={self.shape}, nnz={self.nnz()})"
+
+
+def _todense(mat):
+    """BCOO/BCSR -> dense; bool payloads densify via int8 (jax scatter-add
+    rejects bool) and cast back."""
+    if mat.data.dtype == jnp.bool_:
+        if isinstance(mat, jsparse.BCSR):
+            m = jsparse.BCSR((mat.data.astype(jnp.int8), mat.indices, mat.indptr), shape=mat.shape)
+        else:
+            m = jsparse.BCOO((mat.data.astype(jnp.int8), mat.indices), shape=mat.shape)
+        return m.todense() != 0
+    return mat.todense()
 
 
 def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None, stop_gradient=True):
@@ -248,3 +266,214 @@ def transpose(x, perm):
 
 def is_same_shape(x, y):
     return list(x.shape) == list(y.shape)
+
+
+# ---------------------------------------------------------------------------
+# r4: second half of the reference sparse op surface (VERDICT r3 missing #2)
+# ---------------------------------------------------------------------------
+
+def sinh(x):
+    return _coo_unary(x, jnp.sinh)
+
+
+def tan(x):
+    return _coo_unary(x, jnp.tan)
+
+
+def asin(x):
+    return _coo_unary(x, jnp.arcsin)
+
+
+def atan(x):
+    return _coo_unary(x, jnp.arctan)
+
+
+def asinh(x):
+    return _coo_unary(x, jnp.arcsinh)
+
+
+def atanh(x):
+    return _coo_unary(x, jnp.arctanh)
+
+
+def square(x):
+    return _coo_unary(x, jnp.square)
+
+
+def log1p(x):
+    return _coo_unary(x, jnp.log1p)
+
+
+def expm1(x):
+    return _coo_unary(x, jnp.expm1)
+
+
+def deg2rad(x):
+    return _coo_unary(x, jnp.deg2rad)
+
+
+def rad2deg(x):
+    return _coo_unary(x, jnp.rad2deg)
+
+
+def isnan(x):
+    """Elementwise isnan over stored values (isnan(0) == False, so the
+    zero-preserving sparse fast path is exact)."""
+    return _coo_unary(x, jnp.isnan)
+
+
+def coalesce(x, name=None):
+    """Merge duplicate COO coordinates by summation (reference
+    sparse/unary.py coalesce over phi CoalesceKernel)."""
+    if not (isinstance(x, SparseTensor) and x.is_sparse_coo()):
+        raise ValueError("coalesce expects a sparse COO tensor")
+    # no nse pin: let sum_duplicates compute the true post-merge count
+    # (eager op on concrete data), so nnz/indices/values carry no padding
+    mat = x._mat.sum_duplicates()
+    return SparseTensor(mat, kind="coo")
+
+
+def mv(x, vec, name=None):
+    """sparse matrix @ dense vector -> dense vector (reference
+    sparse/binary.py mv)."""
+    v = vec._value if isinstance(vec, Tensor) else jnp.asarray(vec)
+    if isinstance(x, SparseTensor):
+        return Tensor(x._mat @ v)
+    return Tensor(_dense_of(x) @ v)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):  # noqa: A002
+    """beta * input + alpha * (x @ y) (reference sparse/binary.py addmm);
+    x sparse [M, K], y dense [K, N], input dense [M, N]."""
+    prod = matmul(x, y)
+    return Tensor(beta * _dense_of(input) + alpha * _dense_of(prod))
+
+
+def reshape(x, shape, name=None):
+    """COO reshape via linearized coordinates — stays sparse, no densify
+    (reference sparse/unary.py reshape)."""
+    if not (isinstance(x, SparseTensor) and x.is_sparse_coo()):
+        return Tensor(jnp.reshape(_dense_of(x), shape))
+    mat = x._mat
+    old_shape = mat.shape
+    n_sparse = mat.indices.shape[1]
+    # resolve -1 with the same validation dense reshape performs
+    shape = list(shape)
+    total = int(np.prod(old_shape))
+    if shape.count(-1) > 1:
+        raise ValueError("sparse reshape: at most one -1 dim")
+    if -1 in shape:
+        i = shape.index(-1)
+        known = int(np.prod([s for s in shape if s != -1]))
+        if known == 0 or total % known != 0:
+            raise ValueError(
+                f"sparse reshape: cannot infer -1 — {total} elements do not "
+                f"divide by {known}")
+        shape[i] = total // known
+    if int(np.prod(shape)) != total:
+        raise ValueError(
+            f"sparse reshape: new shape {shape} has {int(np.prod(shape))} "
+            f"elements, input has {total}")
+    dense_tail = old_shape[n_sparse:]
+    n_tail = int(np.prod(dense_tail)) if dense_tail else 1
+    new_sparse_nd = len(shape) - len(dense_tail)
+    if tuple(shape[new_sparse_nd:]) != tuple(dense_tail):
+        raise ValueError(
+            "sparse reshape keeps the dense (trailing) dims unchanged; "
+            f"got dense dims {dense_tail} -> {shape[new_sparse_nd:]}"
+        )
+    strides = np.cumprod([1] + list(old_shape[:n_sparse][::-1]))[::-1][1:]
+    lin = (mat.indices * jnp.asarray(strides.copy(), mat.indices.dtype)).sum(-1)
+    new_sp_shape = shape[:new_sparse_nd]
+    new_strides = np.cumprod([1] + list(new_sp_shape[::-1]))[::-1][1:]
+    new_idx = []
+    rem = lin
+    for s in new_strides:
+        new_idx.append(rem // int(s))
+        rem = rem % int(s)
+    idx = jnp.stack(new_idx, -1).astype(mat.indices.dtype)
+    out = jsparse.BCOO((mat.data, idx), shape=tuple(shape))
+    return SparseTensor(out, kind="coo")
+
+
+def slice(x, axes, starts, ends, name=None):  # noqa: A001
+    """Slice a sparse tensor along axes (reference sparse/unary.py slice):
+    COO indices are filtered and shifted — stays sparse."""
+    if not isinstance(x, SparseTensor):
+        raise ValueError("sparse.slice expects a sparse tensor")
+    mat = x._mat if x.is_sparse_coo() else x.to_sparse_coo()._mat
+    idx = np.asarray(mat.indices)  # host: data-dependent nnz (eager op)
+    data = mat.data
+    shape = list(mat.shape)
+    n_sparse = idx.shape[1]
+    keep = np.ones(idx.shape[0], bool)
+    shift = np.zeros(n_sparse, np.int64)
+    for ax, st, en in zip(axes, starts, ends):
+        ax = int(ax) % len(shape)
+        st = int(st) if st >= 0 else int(st) + shape[ax]
+        en = min(int(en) if en >= 0 else int(en) + shape[ax], shape[ax])
+        if ax >= n_sparse:
+            raise ValueError("sparse.slice on dense trailing dims is unsupported")
+        keep &= (idx[:, ax] >= st) & (idx[:, ax] < en)
+        shift[ax] = st
+        shape[ax] = en - st
+    sel = np.nonzero(keep)[0]
+    new_idx = jnp.asarray(idx[sel] - shift[None, :])
+    out = jsparse.BCOO((data[jnp.asarray(sel)], new_idx),
+                       shape=tuple(shape[:n_sparse]) + tuple(shape[n_sparse:]))
+    return SparseTensor(out, kind="coo")
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Randomized PCA (reference sparse/multiary.py pca_lowrank — the
+    torch.pca_lowrank algorithm): returns (U, S, V) with A ~= U diag(S) V^T.
+    The power iterations are sparse-dense matmuls — exactly the MXU-friendly
+    part; only the final small QR/SVD runs dense."""
+    from ..framework import random as random_mod
+
+    if isinstance(x, SparseTensor) and x.is_sparse_csr():
+        x = x.to_sparse_coo()  # transpose()'s sparse fast path is COO-only
+    is_sp = isinstance(x, SparseTensor)
+    m, n = (x.shape if is_sp else _dense_of(x).shape)[-2:]
+    if q is None:
+        q = min(6, m, n)
+    key = random_mod.next_key()
+
+    def mm(a, b):
+        return (a._mat @ b) if is_sp else (_dense_of(a) @ b)
+
+    def rmm(a, b):  # a.T @ b
+        if is_sp:
+            return transpose(a, [1, 0])._mat @ b
+        return _dense_of(a).T @ b
+
+    if center:
+        ones = jnp.ones((m, 1), jnp.float32)
+        c = rmm(x, ones).reshape(1, n) / m  # column means
+    else:
+        c = jnp.zeros((1, n), jnp.float32)
+
+    g = jax.random.normal(key, (n, q), jnp.float32)
+    y = mm(x, g) - jnp.ones((m, 1)) @ (c @ g)
+    qmat, _ = jnp.linalg.qr(y)
+    for _ in range(niter):
+        y = rmm(x, qmat) - c.T @ (jnp.ones((1, m)) @ qmat)
+        qmat2, _ = jnp.linalg.qr(y)
+        y = mm(x, qmat2) - jnp.ones((m, 1)) @ (c @ qmat2)
+        qmat, _ = jnp.linalg.qr(y)
+    b = rmm(x, qmat).T - (qmat.T @ jnp.ones((m, 1))) @ c  # [q, n]
+    u_hat, s, vt = jnp.linalg.svd(b, full_matrices=False)
+    u = qmat @ u_hat
+    return Tensor(u), Tensor(s), Tensor(vt.T)
+
+
+from . import nn  # noqa: F401,E402
+
+__all__ = [
+    'sparse_coo_tensor', 'sparse_csr_tensor',
+    'sin', 'tan', 'asin', 'atan', 'sinh', 'tanh', 'asinh', 'atanh',
+    'sqrt', 'square', 'log1p', 'abs', 'pow', 'pca_lowrank', 'cast', 'neg',
+    'deg2rad', 'rad2deg', 'expm1', 'mv', 'matmul', 'masked_matmul', 'addmm',
+    'add', 'subtract', 'transpose', 'sum', 'multiply', 'divide', 'coalesce',
+    'is_same_shape', 'reshape', 'isnan', 'slice',
+]

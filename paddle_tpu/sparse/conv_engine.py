@@ -1,0 +1,151 @@
+"""Sparse convolution engine: rulebook gather -> MXU matmul -> scatter-add.
+
+Reference parity: paddle/phi/kernels/sparse/gpu/conv_kernel.cu (+
+submanifold variant) behind python/paddle/sparse/nn/functional/conv.py.
+
+TPU-native design (VERDICT r3 next-round #3): the reference builds its
+rulebook (per-kernel-offset input/output pair lists) inside a CUDA kernel
+with hash tables; here the rulebook is built host-side over the concrete
+COO coordinates as DENSE int32 index tables, and the device work is the
+part TPUs are good at — one [pairs_k, Cin] x [Cin, Cout] matmul per
+kernel offset on the MXU, accumulated by scatter-add (XLA lowers
+segment-sum natively). Eager-mode op by design: coordinates are data, so
+the rulebook is data-dependent — the same reason the reference's static
+graph runs it as a device kernel with dynamic output shapes. Under jit
+tracing we raise with guidance instead of silently densifying.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def _triple(v, n):
+    if isinstance(v, (list, tuple)):
+        assert len(v) == n
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+def _check_concrete(arr, what):
+    if isinstance(arr, jax.core.Tracer):
+        raise NotImplementedError(
+            f"sparse conv: {what} is a tracer — the rulebook is built from "
+            "concrete coordinates (data-dependent output structure), so "
+            "sparse convolutions run eagerly; keep them outside jit/to_static "
+            "regions (the reference's static graph runs them as dynamic-shape "
+            "device kernels for the same reason)"
+        )
+
+
+def build_rulebook(coords, spatial_shape, kernel, stride, padding, dilation,
+                   subm):
+    """Build (out_coords, pairs, out_spatial_shape).
+
+    coords: [nnz, 1+nd] int array (batch, spatial...) — concrete.
+    pairs: list over kernel offsets of (in_idx, out_idx) int32 arrays; the
+    dense gather/scatter tables the device loop consumes.
+    """
+    nd = len(spatial_shape)
+    kernel = _triple(kernel, nd)
+    stride = _triple(stride, nd)
+    padding = _triple(padding, nd)
+    dilation = _triple(dilation, nd)
+
+    coords = np.asarray(coords)
+    nnz = coords.shape[0]
+    offsets = np.stack(
+        np.meshgrid(*[np.arange(k) for k in kernel], indexing="ij"), -1
+    ).reshape(-1, nd)
+
+    key_of = lambda arr: [tuple(c) for c in arr.tolist()]
+    in_map = {k: i for i, k in enumerate(key_of(coords))}
+
+    if subm:
+        # submanifold: output sites ARE the input sites (stride must be 1);
+        # same-padding so the site grid is unchanged
+        out_coords = coords
+        out_map = in_map
+        out_spatial = tuple(spatial_shape)
+        center = [k // 2 for k in kernel]
+        pairs = []
+        for off in offsets:
+            rel = (off - center) * np.asarray(dilation)
+            nb = coords.copy()
+            nb[:, 1:] = coords[:, 1:] + rel  # neighbor feeding each out site
+            ii, oi = [], []
+            for out_i, k in enumerate(key_of(nb)):
+                in_i = in_map.get(k)
+                if in_i is not None:
+                    ii.append(in_i)
+                    oi.append(out_i)
+            pairs.append((np.asarray(ii, np.int32), np.asarray(oi, np.int32)))
+        return out_coords, pairs, out_spatial
+
+    out_spatial = tuple(
+        (spatial_shape[i] + 2 * padding[i] - dilation[i] * (kernel[i] - 1) - 1)
+        // stride[i] + 1
+        for i in range(nd)
+    )
+    # candidate output site per (input site, offset):
+    #   out*stride = in + pad - off*dilation, must divide & be in range
+    out_index = {}
+    out_list = []
+    raw_pairs = []
+    for off in offsets:
+        shifted = coords[:, 1:] + np.asarray(padding) - off * np.asarray(dilation)
+        ok = np.ones(nnz, bool)
+        for i in range(nd):
+            ok &= shifted[:, i] % stride[i] == 0
+        out_sp = shifted // np.asarray(stride)
+        for i in range(nd):
+            ok &= (out_sp[:, i] >= 0) & (out_sp[:, i] < out_spatial[i])
+        ii, oi = [], []
+        idx_ok = np.nonzero(ok)[0]
+        cand = np.concatenate([coords[idx_ok, :1], out_sp[idx_ok]], axis=1)
+        for in_i, k in zip(idx_ok.tolist(), key_of(cand)):
+            out_i = out_index.get(k)
+            if out_i is None:
+                out_i = len(out_list)
+                out_index[k] = out_i
+                out_list.append(k)
+            ii.append(in_i)
+            oi.append(out_i)
+        raw_pairs.append((np.asarray(ii, np.int32), np.asarray(oi, np.int32)))
+    out_coords = np.asarray(out_list, np.int64).reshape(-1, 1 + nd)
+    return out_coords, raw_pairs, out_spatial
+
+
+def conv_values(feats, weight, pairs, n_out, bias=None):
+    """Device compute over the rulebook: for each kernel offset k,
+    out[out_idx_k] += feats[in_idx_k] @ W_k. Pure jnp (feats/weight may be
+    tracers — the rulebook tables are static constants by then)."""
+    nk = len(pairs)
+    cout = weight.shape[-1]
+    wk = weight.reshape(nk, weight.shape[-2], cout)
+    out = jnp.zeros((n_out, cout), feats.dtype)
+    for k, (ii, oi) in enumerate(pairs):
+        if len(ii) == 0:
+            continue
+        contrib = jax.lax.dot_general(
+            feats[jnp.asarray(ii)], wk[k],
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ).astype(feats.dtype)
+        out = out.at[jnp.asarray(oi)].add(contrib)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def pool_values(feats, pairs, n_out):
+    """Scatter-max over the rulebook (sparse max_pool: only active sites
+    participate, matching the reference's sparse maxpool kernel)."""
+    neg = jnp.finfo(feats.dtype).min
+    out = jnp.full((n_out, feats.shape[-1]), neg, feats.dtype)
+    for ii, oi in pairs:
+        if len(ii) == 0:
+            continue
+        out = out.at[jnp.asarray(oi)].max(feats[jnp.asarray(ii)])
+    return jnp.where(out == neg, jnp.zeros_like(out), out)

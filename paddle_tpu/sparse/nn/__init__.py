@@ -1,0 +1,233 @@
+"""paddle.sparse.nn — layers over sparse COO/CSR tensors.
+
+Reference parity: python/paddle/sparse/nn/__init__.py (layer/conv.py
+Conv2D/Conv3D/SubmConv2D/SubmConv3D, layer/norm.py BatchNorm/SyncBatchNorm,
+layer/activation.py, layer/pooling.py MaxPool3D) — the point-cloud / 3-D
+detection stack. Convolutions run the TPU rulebook engine
+(sparse/conv_engine.py); normalizations run over the [nnz, C] values
+matrix exactly like the reference (its BatchNorm reshapes values through
+BatchNorm1D).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...nn.layer import Layer
+from .. import SparseTensor
+from . import functional  # noqa: F401
+from . import functional as F
+
+__all__ = [
+    'ReLU',
+    'ReLU6',
+    'LeakyReLU',
+    'Softmax',
+    'BatchNorm',
+    'SyncBatchNorm',
+    'Conv2D',
+    'Conv3D',
+    'SubmConv2D',
+    'SubmConv3D',
+    'MaxPool3D',
+]
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return F.relu(x)
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        return F.relu6(x)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01, name=None):
+        super().__init__()
+        self._negative_slope = negative_slope
+
+    def forward(self, x):
+        return F.leaky_relu(x, self._negative_slope)
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, x):
+        return F.softmax(x, self._axis)
+
+
+class _ConvNd(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride,
+                 padding, dilation, groups, subm, nd, padding_mode,
+                 weight_attr, bias_attr, data_format):
+        super().__init__()
+        if padding_mode != "zeros":
+            raise NotImplementedError("sparse conv: only zeros padding_mode")
+        self._in_channels = in_channels
+        self._out_channels = out_channels
+        ks = kernel_size if isinstance(kernel_size, (list, tuple)) else (kernel_size,) * nd
+        self._kernel_size = tuple(int(k) for k in ks)
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._groups = groups
+        self._subm = subm
+        self._nd = nd
+        self._data_format = data_format
+        # reference sparse conv weight layout: [*kernel, Cin/groups, Cout]
+        from ...nn.initializer import XavierUniform
+
+        self.weight = self.create_parameter(
+            self._kernel_size + (in_channels // groups, out_channels),
+            attr=weight_attr, default_initializer=XavierUniform())
+        self.bias = self.create_parameter(
+            (out_channels,), attr=bias_attr, is_bias=True
+        ) if bias_attr is not False else None
+
+    def forward(self, x):
+        fn = {
+            (2, False): F.conv2d, (2, True): F.subm_conv2d,
+            (3, False): F.conv3d, (3, True): F.subm_conv3d,
+        }[(self._nd, self._subm)]
+        return fn(x, self.weight, self.bias, stride=self._stride,
+                  padding=self._padding, dilation=self._dilation,
+                  groups=self._groups, data_format=self._data_format)
+
+    def extra_repr(self):
+        return (f"{self._in_channels}, {self._out_channels}, "
+                f"kernel_size={self._kernel_size}, subm={self._subm}")
+
+
+class Conv3D(_ConvNd):
+    """Sparse 3-D conv over [N, D, H, W, C] COO input (reference
+    sparse/nn/layer/conv.py:235)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NDHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, False, 3, padding_mode,
+                         weight_attr, bias_attr, data_format)
+
+
+class SubmConv3D(_ConvNd):
+    """Submanifold sparse 3-D conv: active sites preserved (reference
+    sparse/nn/layer/conv.py SubmConv3D)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 key=None, weight_attr=None, bias_attr=None,
+                 data_format="NDHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, True, 3, padding_mode,
+                         weight_attr, bias_attr, data_format)
+
+
+class Conv2D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, False, 2, padding_mode,
+                         weight_attr, bias_attr, data_format)
+
+
+class SubmConv2D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 key=None, weight_attr=None, bias_attr=None,
+                 data_format="NHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, True, 2, padding_mode,
+                         weight_attr, bias_attr, data_format)
+
+
+class MaxPool3D(Layer):
+    """Sparse max pool over active sites (reference sparse/nn/layer/
+    pooling.py)."""
+
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 return_mask=False, data_format="NDHWC", name=None):
+        super().__init__()
+        if return_mask:
+            raise NotImplementedError("sparse MaxPool3D: return_mask unsupported")
+        self._kernel_size = kernel_size
+        self._stride = stride
+        self._padding = padding
+        self._ceil_mode = ceil_mode
+        self._data_format = data_format
+
+    def forward(self, x):
+        return F.max_pool3d(x, self._kernel_size, self._stride,
+                            self._padding, self._ceil_mode, self._data_format)
+
+
+class BatchNorm(Layer):
+    """BatchNorm over the [nnz, C] values matrix (reference
+    sparse/nn/layer/norm.py BatchNorm — it routes values through a dense
+    BatchNorm1D the same way)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        from ...nn import BatchNorm1D
+
+        if data_format not in ("NDHWC", "NHWC"):
+            raise ValueError("sparse BatchNorm requires channels-last layout")
+        self._bn = BatchNorm1D(num_features, momentum=momentum,
+                               epsilon=epsilon, weight_attr=weight_attr,
+                               bias_attr=bias_attr,
+                               use_global_stats=use_global_stats)
+
+    def forward(self, x):
+        from jax.experimental import sparse as jsparse
+        import jax.numpy as jnp
+
+        out_vals = self._bn(x.values())
+        mat = x._mat
+        st = SparseTensor(
+            jsparse.BCOO((out_vals._value, mat.indices), shape=mat.shape),
+            kind="coo")
+        st._grad_values = out_vals
+        return st
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-replica BatchNorm over values (reference sparse/nn/layer/
+    norm.py SyncBatchNorm): under a multi-device process group the wrapped
+    norm syncs batch statistics with collectives."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 name=None):
+        super().__init__(num_features, momentum=momentum, epsilon=epsilon,
+                         weight_attr=weight_attr, bias_attr=bias_attr,
+                         data_format=data_format)
+        from ...nn import SyncBatchNorm as _DenseSync
+
+        try:
+            self._bn = _DenseSync(num_features, momentum=momentum,
+                                  epsilon=epsilon, weight_attr=weight_attr,
+                                  bias_attr=bias_attr)
+        except Exception:
+            pass  # keep the local BatchNorm1D when no process group exists
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        """Recursively replace sparse BatchNorm sublayers with SyncBatchNorm
+        (reference API). The old layer's parameters/running stats carry over
+        into the SYNC norm (replacing the module but keeping the local norm
+        would defeat the conversion)."""
+        if isinstance(layer, BatchNorm) and not isinstance(layer, SyncBatchNorm):
+            c = int(layer._bn.weight.shape[0])
+            new = SyncBatchNorm(c)
+            new._bn.set_state_dict(layer._bn.state_dict())
+            return new
+        for name, sub in layer.named_children():
+            setattr(layer, name, cls.convert_sync_batchnorm(sub))
+        return layer

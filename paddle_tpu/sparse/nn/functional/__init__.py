@@ -1,0 +1,233 @@
+"""paddle.sparse.nn.functional.
+
+Reference parity: python/paddle/sparse/nn/functional/__init__.py (conv.py
+conv2d:413 / conv3d:195 / subm_conv2d:517 / subm_conv3d:301, pooling.py
+max_pool3d, activation.py, transformer.py attention) over
+paddle/phi/kernels/sparse/. Convs run the rulebook engine
+(sparse/conv_engine.py): host-built dense int32 gather/scatter tables,
+one MXU matmul per kernel offset. Ops thread tape-connected values
+Tensors (SparseTensor._grad_values) so sparse nets train end-to-end.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ....core.apply import apply
+from ....core.tensor import Tensor
+from ... import SparseTensor
+from ...conv_engine import build_rulebook, conv_values, pool_values, _check_concrete
+
+__all__ = [
+    'conv2d',
+    'conv3d',
+    'subm_conv2d',
+    'subm_conv3d',
+    'max_pool3d',
+    'relu',
+    'relu6',
+    'leaky_relu',
+    'softmax',
+    'attention',
+]
+
+
+def _coo(x):
+    if not isinstance(x, SparseTensor) or not x.is_sparse_coo():
+        raise ValueError("expected a sparse COO tensor (NDHWC/NHWC layout)")
+    return x._mat
+
+
+def _wrap_with_values(indices, values_t, shape):
+    st = SparseTensor(
+        jsparse.BCOO((values_t._value, jnp.asarray(indices)), shape=tuple(shape)),
+        kind="coo",
+    )
+    st._grad_values = values_t
+    return st
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, subm, nd, name):
+    if groups != 1:
+        raise NotImplementedError("sparse conv: only groups=1 is supported")
+    mat = _coo(x)
+    _check_concrete(mat.indices, "indices")
+    coords = np.asarray(mat.indices)
+    spatial = tuple(int(s) for s in x.shape[1:1 + nd])
+    w = weight if isinstance(weight, Tensor) else Tensor(jnp.asarray(weight))
+    kernel = tuple(int(k) for k in w.shape[:nd])
+    if subm and (stride not in (1, [1] * nd, tuple([1] * nd))):
+        raise ValueError("submanifold conv requires stride 1")
+    out_coords, pairs, out_spatial = build_rulebook(
+        coords, spatial, kernel, stride, padding, dilation, subm)
+    n_out = len(out_coords)
+    feats = x.values()
+    cout = int(w.shape[-1])
+
+    args = [feats, w] + ([bias] if bias is not None else [])
+
+    def fn(f, wv, *rest):
+        return conv_values(f, wv, pairs, n_out, rest[0] if rest else None)
+
+    out_vals = apply(name, fn, *args)
+    out_shape = (int(x.shape[0]),) + tuple(out_spatial) + (cout,)
+    return _wrap_with_values(out_coords, out_vals, out_shape)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NDHWC", name=None):
+    """Sparse 3-D convolution (reference functional/conv.py:195)."""
+    if data_format != "NDHWC":
+        raise ValueError("sparse conv3d only supports NDHWC")
+    return _conv(x, weight, bias, stride, padding, dilation, groups,
+                 subm=False, nd=3, name="sparse_conv3d")
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NDHWC", key=None, name=None):
+    """Submanifold sparse 3-D conv (reference functional/conv.py:301):
+    output active sites == input active sites."""
+    if data_format != "NDHWC":
+        raise ValueError("sparse subm_conv3d only supports NDHWC")
+    return _conv(x, weight, bias, stride, padding, dilation, groups,
+                 subm=True, nd=3, name="sparse_subm_conv3d")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NHWC", name=None):
+    """Sparse 2-D convolution (reference functional/conv.py:413)."""
+    if data_format != "NHWC":
+        raise ValueError("sparse conv2d only supports NHWC")
+    return _conv(x, weight, bias, stride, padding, dilation, groups,
+                 subm=False, nd=2, name="sparse_conv2d")
+
+
+def subm_conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NHWC", key=None, name=None):
+    """Submanifold sparse 2-D conv (reference functional/conv.py:517)."""
+    if data_format != "NHWC":
+        raise ValueError("sparse subm_conv2d only supports NHWC")
+    return _conv(x, weight, bias, stride, padding, dilation, groups,
+                 subm=True, nd=2, name="sparse_subm_conv2d")
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               data_format="NDHWC", name=None):
+    """Sparse max pooling (reference functional/pooling.py): only active
+    sites participate — scatter-max over the same rulebook tables."""
+    if data_format != "NDHWC":
+        raise ValueError("sparse max_pool3d only supports NDHWC")
+    if ceil_mode:
+        raise NotImplementedError("sparse max_pool3d: ceil_mode not supported")
+    mat = _coo(x)
+    _check_concrete(mat.indices, "indices")
+    coords = np.asarray(mat.indices)
+    spatial = tuple(int(s) for s in x.shape[1:4])
+    stride = stride if stride is not None else kernel_size
+    out_coords, pairs, out_spatial = build_rulebook(
+        coords, spatial, kernel_size, stride, padding, 1, subm=False)
+    n_out = len(out_coords)
+    feats = x.values()
+    out_vals = apply("sparse_max_pool3d",
+                     lambda f: pool_values(f, pairs, n_out), feats)
+    out_shape = (int(x.shape[0]),) + tuple(out_spatial) + (int(x.shape[-1]),)
+    return _wrap_with_values(out_coords, out_vals, out_shape)
+
+
+def _unary(x, fn, name):
+    """Zero-preserving activation over stored values, tape-threaded."""
+    mat = x._mat
+    v = x.values()
+    out_vals = apply(name, fn, v)
+    if isinstance(mat, jsparse.BCSR):
+        st = SparseTensor(
+            jsparse.BCSR((out_vals._value, mat.indices, mat.indptr), shape=mat.shape),
+            kind="csr")
+    else:
+        st = SparseTensor(
+            jsparse.BCOO((out_vals._value, mat.indices), shape=mat.shape),
+            kind="coo")
+    st._grad_values = out_vals
+    return st
+
+
+def relu(x, name=None):
+    return _unary(x, jax.nn.relu, "sparse_relu")
+
+
+def relu6(x, name=None):
+    return _unary(x, lambda v: jnp.clip(v, 0.0, 6.0), "sparse_relu6")
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return _unary(x, lambda v: jnp.where(v >= 0, v, negative_slope * v),
+                  "sparse_leaky_relu")
+
+
+def softmax(x, axis=-1, name=None):
+    """Sparse softmax over the last axis (reference functional/
+    activation.py softmax): zeros are -inf — only stored values in each row
+    participate. CSR rows via indptr segments; 2-D COO via row segment-ids
+    (segment reductions lower to one XLA scatter, TPU-friendly)."""
+    if axis != -1:
+        raise ValueError("sparse softmax only supports axis=-1")
+    mat = x._mat
+    v = x.values()
+    if isinstance(mat, jsparse.BCSR):
+        nrows = int(mat.shape[-2])
+        counts = jnp.diff(mat.indptr)
+        seg = jnp.repeat(jnp.arange(nrows), counts,
+                         total_repeat_length=int(mat.nse))
+
+        def fn(vals):
+            mx = jax.ops.segment_max(vals, seg, num_segments=nrows)
+            e = jnp.exp(vals - mx[seg])
+            s = jax.ops.segment_sum(e, seg, num_segments=nrows)
+            return e / s[seg]
+
+        out_vals = apply("sparse_softmax_csr", fn, v)
+        st = SparseTensor(
+            jsparse.BCSR((out_vals._value, mat.indices, mat.indptr), shape=mat.shape),
+            kind="csr")
+        st._grad_values = out_vals
+        return st
+    # COO: segment = all dims but the last
+    idx = mat.indices
+    lead_shape = mat.shape[:-1]
+    strides = np.cumprod([1] + list(lead_shape[::-1]))[::-1][1:]
+    seg = (idx[:, :-1] * jnp.asarray(np.asarray(strides), idx.dtype)).sum(-1)
+    nseg = int(np.prod(lead_shape))
+
+    def fn(vals):
+        mx = jax.ops.segment_max(vals, seg, num_segments=nseg)
+        e = jnp.exp(vals - mx[seg])
+        s = jax.ops.segment_sum(e, seg, num_segments=nseg)
+        return e / s[seg]
+
+    out_vals = apply("sparse_softmax_coo", fn, v)
+    st = SparseTensor(jsparse.BCOO((out_vals._value, idx), shape=mat.shape),
+                      kind="coo")
+    st._grad_values = out_vals
+    return st
+
+
+def attention(query, key, value, sparse_mask, key_padding_mask=None,
+              attn_mask=None, name=None):
+    """Sparse-mask attention (reference functional/transformer.py:
+    attention over phi sparse fused_attention): softmax(QK^T/sqrt(d) +
+    masks) evaluated at sparse_mask's CSR nonzeros, then @ V. Delegates to
+    the CSR sparse_attention kernel path (nn/functional/attention.py)."""
+    from ....nn.functional.attention import sparse_attention
+
+    b, h, s, d = (int(v) for v in query.shape)
+    offset = sparse_mask.crows()
+    columns = sparse_mask.cols()
+    from ....ops import manipulation as _mp
+
+    off = _mp.reshape(offset, [b, h, -1]) if offset.ndim == 1 else offset
+    col = _mp.reshape(columns, [b, h, -1]) if columns.ndim == 1 else columns
+    return sparse_attention(query, key, value, off, col,
+                            key_padding_mask=key_padding_mask,
+                            attn_mask=attn_mask)
