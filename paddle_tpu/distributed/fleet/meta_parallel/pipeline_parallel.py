@@ -84,6 +84,7 @@ class PipelineParallel(Layer):
             )
         self._pp_mesh: Optional[Mesh] = None
         self._spmd = False
+        self._spmd_hetero = False
         self._train_fn = None
         if self._pp_world > 1:
             if layers.num_stages != self._pp_world:
@@ -95,6 +96,13 @@ class PipelineParallel(Layer):
             self._pp_mesh = self._build_pp_submesh()
             self._place_stage_params()
             self._spmd = layers.uniform_stages()
+            # r4: non-uniform stages (embedding-first / LM-head-last) also
+            # compile — flat-padded param superstructure + lax.switch over
+            # stage bodies (spmd_pipeline.pipeline_spmd_hetero). VPP with
+            # non-uniform chunks stays on the eager engine.
+            self._spmd_hetero = (not self._spmd) and self._v == 1
+            if self._spmd_hetero:
+                self._spmd = True
 
     # ---- placement ----
     def _build_pp_submesh(self) -> Mesh:
@@ -180,6 +188,81 @@ class PipelineParallel(Layer):
         self._train_fn = jax.jit(jax.value_and_grad(loss_fn))
         self._next_rng = random_mod.next_key
 
+    # ---- non-uniform (hetero) compiled schedule ----
+    def _gather_stacked_hetero(self):
+        from jax.flatten_util import ravel_pytree
+        from .spmd_pipeline import stack_stage_params_hetero
+
+        trees = [
+            {n: t._value for n, t in self._layers.stage_module(k).state_dict().items()}
+            for k in range(self._pp_world)
+        ]
+        stacked, unravels, sizes = stack_stage_params_hetero(trees, self._pp_mesh)
+        self._hetero_unravels = unravels
+        self._hetero_sizes = sizes
+        return stacked
+
+    def _build_train_fn_hetero(self, sample_mb):
+        from ....jit.api import functional_call
+        from .spmd_pipeline import pipeline_spmd_hetero
+
+        S = self._pp_world
+        mods = [self._layers.stage_module(k) for k in range(S)]
+        loss_fn_user = self._layers._loss_fn
+        # eager probe: inter-stage activation + final output shapes (the
+        # carry union {"h": mid, "out": final} every switch branch emits)
+        x = Tensor(sample_mb)
+        acts = []
+        for k, m in enumerate(mods):
+            x = _to_device(x, self._stage_device(k))  # probe hops the ring too
+            x = m(x)
+            acts.append(x)
+        mids = acts[:-1]
+        mid_shape = tuple(mids[0]._value.shape)
+        mid_dtype = mids[0]._value.dtype
+        for a in mids:
+            if tuple(a._value.shape) != mid_shape:
+                raise NotImplementedError(
+                    "hetero compiled pipeline needs a uniform inter-stage "
+                    f"activation shape; got {tuple(a._value.shape)} vs {mid_shape}"
+                )
+        out_shape = tuple(acts[-1]._value.shape)
+        out_dtype = acts[-1]._value.dtype
+
+        sizes = self._hetero_sizes
+        unravels = self._hetero_unravels
+
+        def make_fn(k):
+            mod, unravel, size = mods[k], unravels[k], sizes[k]
+
+            def fn(flat, carry, feed):
+                ptree = unravel(flat[:size])
+                xin = Tensor(feed) if k == 0 else Tensor(carry["h"])
+                out = functional_call(mod, ptree, xin)
+                ov = out._value if isinstance(out, Tensor) else out
+                if k < S - 1:
+                    return {"h": ov, "out": jnp.zeros(out_shape, out_dtype)}
+                return {"h": jnp.zeros(mid_shape, mid_dtype), "out": ov}
+
+            return fn
+
+        run = pipeline_spmd_hetero([make_fn(k) for k in range(S)], self._pp_mesh)
+
+        from ....framework import random as random_mod
+
+        gen = random_mod.default_generator()
+
+        def loss_fn(stacked, mbs, lbs, rng):
+            with gen.trace_scope(rng):
+                outs = run(stacked, mbs)["out"]
+                losses = jax.vmap(
+                    lambda o, l: loss_fn_user(Tensor(o), Tensor(l))._value
+                )(outs, lbs)
+                return jnp.mean(losses)
+
+        self._train_fn = jax.jit(jax.value_and_grad(loss_fn))
+        self._next_rng = random_mod.next_key
+
     def _spmd_train_batch(self, inputs, labels, optimizer, lr_scheduler, scaler):
         if isinstance(inputs, (tuple, list)) or isinstance(labels, (tuple, list)):
             raise NotImplementedError(
@@ -195,6 +278,33 @@ class PipelineParallel(Layer):
         mb = B // n
         mbs = inputs._value.reshape((n, mb) + tuple(inputs.shape[1:]))
         lbs = labels._value.reshape((n, mb) + tuple(labels.shape[1:]))
+        if self._spmd_hetero:
+            stacked = self._gather_stacked_hetero()
+            if self._train_fn is None:
+                self._build_train_fn_hetero(mbs[0])
+            loss, gflat = self._train_fn(stacked, mbs, lbs, self._next_rng())
+            if scaler is not None:
+                scale = scaler._scale._value if hasattr(scaler, "_scale") else 1.0
+                gflat = gflat * scale
+            for k in range(self._pp_world):
+                gtree = self._hetero_unravels[k](gflat[k, : self._hetero_sizes[k]])
+                dev = self._stage_device(k)
+                for name, t in self._layers.stage_module(k).state_dict().items():
+                    if t.stop_gradient:
+                        continue
+                    g = jax.device_put(gtree[name].astype(t._value.dtype), dev)
+                    t.grad = Tensor(g) if t.grad is None else Tensor(t.grad._value + g)
+            optimizer.disable_fusion()
+            if scaler is not None:
+                scaler.step(optimizer)
+                scaler.update()
+            else:
+                optimizer.step()
+            optimizer.clear_grad()
+            if lr_scheduler is not None:
+                lr_scheduler.step()
+            self.total_loss = Tensor(loss)
+            return self.total_loss
         if self._train_fn is None:
             self._build_train_fn()
         stacked = self._gather_stacked()
@@ -244,7 +354,21 @@ class PipelineParallel(Layer):
             raise ValueError("PipelineLayer needs loss_fn for train_batch")
         inputs, labels = data
         if self._spmd:
-            return self._spmd_train_batch(inputs, labels, optimizer, lr_scheduler, scaler)
+            if self._spmd_hetero:
+                # the hetero compiled schedule has contracts the eager
+                # engine doesn't (uniform mid-stage activation shape,
+                # single input/label tensors): demote to eager on the
+                # first NotImplementedError instead of hard-failing a
+                # config that worked before r4
+                try:
+                    return self._spmd_train_batch(
+                        inputs, labels, optimizer, lr_scheduler, scaler)
+                except NotImplementedError:
+                    self._spmd = False
+                    self._spmd_hetero = False
+                    self._train_fn = None
+            else:
+                return self._spmd_train_batch(inputs, labels, optimizer, lr_scheduler, scaler)
         n = self.accumulate_steps
         first = inputs[0] if isinstance(inputs, (tuple, list)) else inputs
         batch = first.shape[0]

@@ -181,7 +181,8 @@ def Print(input, first_n=-1, message=None, summarize=20, print_tensor_name=True,
 
     from ..core.apply import apply
 
-    msg = message or ""
+    # escape braces: user text must not be treated as format placeholders
+    msg = (message or "").replace("{", "{{").replace("}", "}}")
 
     def fn(v):
         jax.debug.print(msg + " {x}", x=v)
@@ -303,6 +304,8 @@ def auc(input, label, curve="ROC", num_thresholds=2 ** 12 - 1, topk=1,  # noqa: 
     from ..core.apply import apply
 
     nt = min(int(num_thresholds), 4095)
+    if curve not in ("ROC", "PR"):
+        raise ValueError("curve must be 'ROC' or 'PR'")
 
     def fn(pred, lbl):
         p1 = pred[:, -1] if pred.ndim == 2 else pred.reshape(-1)
@@ -314,6 +317,13 @@ def auc(input, label, curve="ROC", num_thresholds=2 ** 12 - 1, topk=1,  # noqa: 
         pos = jnp.maximum(jnp.sum(y), 1)
         neg = jnp.maximum(jnp.sum(~y), 1)
         tpr = tp / pos
+        if curve == "PR":
+            # convention: precision = 1 at thresholds where nothing is
+            # predicted positive (the recall->0 endpoint of the PR curve)
+            precision = jnp.where(tp + fp > 0,
+                                  tp / jnp.maximum(tp + fp, 1e-12), 1.0)
+            # integrate precision over recall (= tpr)
+            return jnp.abs(jnp.trapezoid(precision, tpr))
         fpr = fp / neg
         # thresholds descend left->right after flip; trapezoid over fpr
         return jnp.abs(jnp.trapezoid(tpr, fpr))
@@ -370,12 +380,10 @@ def serialize_program(feed_vars, fetch_vars, **kwargs):
 
 def serialize_persistables(feed_vars, fetch_vars, executor=None, **kwargs):
     """Persistable params -> bytes (reference static/io.py:375)."""
+    from .io import named_program_params
+
     program = kwargs.get("program") or default_main_program()
-    state = {}
-    for i, vid in enumerate(program.param_vars):
-        t = program._var_tensors[vid]
-        key = getattr(t, "name", None) or f"param_{i}"
-        state[key] = np.asarray(t._value)
+    state = {k: np.asarray(t._value) for k, t in named_program_params(program)}
     return pickle.dumps(state)
 
 
@@ -394,9 +402,10 @@ def load_from_file(path):
 
 
 def deserialize_program(data):
-    """bytes -> runnable program object (reference static/io.py:635).
-    Returns the rehydrated exported computation; Executor.run accepts it
-    and load_inference_model shares the format."""
+    """bytes -> the rehydrated exported computation (reference
+    static/io.py:635). Invoke it directly via .call(*feeds); for an
+    Executor-runnable artifact use save/load_inference_model, whose
+    .pdmeta carries the feed-name metadata this bare blob lacks."""
     from jax import export as jax_export
 
     return jax_export.deserialize(data)
@@ -424,10 +433,10 @@ def load_program_state(model_path, var_list=None):
 def set_program_state(program, state_dict):
     """Reference static/io.py:1726: write a state dict into the program's
     persistable tensors by name (positional fallback for unnamed)."""
+    from .io import named_program_params
+
     if not isinstance(program, Program):
         program = getattr(program, "_program", program)
-    for i, vid in enumerate(program.param_vars):
-        t = program._var_tensors[vid]
-        key = getattr(t, "name", None) or f"param_{i}"
+    for key, t in named_program_params(program):
         if key in state_dict:
             t.set_value(jnp.asarray(state_dict[key]))

@@ -112,15 +112,20 @@ def load_inference_model(path_prefix, executor=None, **kwargs):
     return [prog, list(meta["feed_names"]), list(range(meta["n_fetch"]))]
 
 
-def save(program, model_path, protocol=4, **configs):
-    """Save a Program's persistable parameters (reference static/io.py
-    paddle.static.save: model_path + '.pdparams'). Keys are parameter
-    names, positional fallback for unnamed ones."""
-    state = {}
+def named_program_params(program):
+    """(key, tensor) for every persistable param — THE naming contract all
+    state save/load/serialize paths share (parameter name, positional
+    param_{i} fallback for unnamed ones)."""
     for i, vid in enumerate(program.param_vars):
         t = program._var_tensors[vid]
-        key = getattr(t, "name", None) or f"param_{i}"
-        state[key] = np.asarray(t._value)
+        yield (getattr(t, "name", None) or f"param_{i}"), t
+
+
+def save(program, model_path, protocol=4, **configs):
+    """Save a Program's persistable parameters (reference static/io.py
+    paddle.static.save: model_path + '.pdparams'). Keys from
+    named_program_params."""
+    state = {k: np.asarray(t._value) for k, t in named_program_params(program)}
     d = os.path.dirname(model_path)
     if d:
         os.makedirs(d, exist_ok=True)
@@ -136,13 +141,14 @@ def load(program, model_path, executor=None, var_list=None):
     path = model_path if model_path.endswith(".pdparams") else model_path + ".pdparams"
     with open(path, "rb") as f:
         state = pickle.load(f)
-    wanted = None
+    # var_list entries may be tensors (matched by identity — names are often
+    # unset) or key strings
+    wanted_ids = wanted_keys = None
     if var_list is not None:
-        wanted = {getattr(v, "name", None) for v in var_list}
-    for i, vid in enumerate(program.param_vars):
-        t = program._var_tensors[vid]
-        key = getattr(t, "name", None) or f"param_{i}"
-        if wanted is not None and getattr(t, "name", None) not in wanted:
+        wanted_ids = {id(v) for v in var_list if not isinstance(v, str)}
+        wanted_keys = {v for v in var_list if isinstance(v, str)}
+    for key, t in named_program_params(program):
+        if var_list is not None and id(t) not in wanted_ids and key not in wanted_keys:
             continue
         if key in state:
             t.set_value(jnp.asarray(state[key]))

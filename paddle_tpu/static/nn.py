@@ -227,14 +227,20 @@ def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
     from ..core.apply import apply
     from ..nn.layer import Parameter
 
-    c = int(input.shape[-1 if data_layout != "NCHW" or input.ndim == 2 else 1])
+    channels_first = data_layout == "NCHW" and input.ndim > 2
+    c = int(input.shape[1 if channels_first else -1])
     batch_size = Parameter(_np.full((c,), 1e4, _np.float32), name="dn_size")
     batch_sum = Parameter(_np.zeros((c,), _np.float32), name="dn_sum")
     batch_sq = Parameter(_np.full((c,), 1e4, _np.float32), name="dn_sq")
+    # broadcast shape putting C on the channel axis of the input layout
+    bshape = ([1, c] + [1] * (input.ndim - 2)) if channels_first else None
 
     def fn(x, n, s, sq):
         mean = s / n
         scale = jnp.sqrt(n / jnp.maximum(sq - s * mean, epsilon))
+        if bshape is not None:
+            mean = mean.reshape(bshape)
+            scale = scale.reshape(bshape)
         return (x - mean) * scale
 
     out = apply("data_norm", fn, input, batch_size, batch_sum, batch_sq)
@@ -254,17 +260,18 @@ def deform_conv2d(input, offset, mask, num_filters, filter_size, stride=1,
                   padding=0, dilation=1, groups=1, deformable_groups=1,
                   im2col_step=1, param_attr=None, bias_attr=None,
                   name=None):  # noqa: A002
-    import numpy as _np
-    from ..nn.layer import Parameter
+    from ..ops.creation import create_parameter as _create_parameter
     from ..vision.ops import deform_conv2d as _dc
 
     c_in = int(input.shape[1])
     ks = filter_size if isinstance(filter_size, (list, tuple)) else (filter_size, filter_size)
-    fan = c_in * ks[0] * ks[1]
-    w = Parameter(
-        (_np.random.RandomState(0).randn(num_filters, c_in // groups, ks[0], ks[1])
-         * _np.sqrt(2.0 / fan)).astype(_np.float32), name="deform_w")
-    b = Parameter(_np.zeros((num_filters,), _np.float32), name="deform_b") if bias_attr is not False else None
+    # framework initializer machinery: param_attr honored, default Xavier
+    # seeded by the global RNG (not a fixed constant per call)
+    w = _create_parameter((num_filters, c_in // groups, ks[0], ks[1]),
+                          "float32", attr=param_attr)
+    b = (_create_parameter((num_filters,), "float32", attr=bias_attr,
+                           is_bias=True)
+         if bias_attr is not False else None)
     return _dc(input, offset, w, bias=b, stride=stride, padding=padding,
                dilation=dilation, deformable_groups=deformable_groups,
                groups=groups, mask=mask)
@@ -303,17 +310,18 @@ def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
     """Noise-contrastive estimation loss (reference static/nn/common.py
     nce over the nce CUDA kernel): binary logistic loss over the true
     class + num_neg_samples uniform noise classes per row."""
-    import numpy as _np
     from jax import numpy as jnp
     from ..core.apply import apply
     from ..framework import random as random_mod
-    from ..nn.layer import Parameter
+    from ..nn.initializer import Normal
+    from ..ops.creation import create_parameter as _create_parameter
 
     d = int(input.shape[-1])
     k = num_neg_samples or 10
-    w = Parameter((_np.random.RandomState(seed or 0).randn(num_total_classes, d)
-                   * 0.01).astype(_np.float32), name="nce_w")
-    b = Parameter(_np.zeros((num_total_classes,), _np.float32), name="nce_b")
+    w = _create_parameter((num_total_classes, d), "float32", attr=param_attr,
+                          default_initializer=Normal(0.0, 0.01))
+    b = _create_parameter((num_total_classes,), "float32", attr=bias_attr,
+                          is_bias=True)
     key = random_mod.next_key()
 
     def fn(x, lbl, wv, bv):
@@ -555,9 +563,11 @@ def sequence_reshape(input, new_dim):  # noqa: A002
 
 
 def sequence_scatter(input, index, updates, name=None):  # noqa: A002
+    """Reference sequence_lod.py:1199: ADDS updates at the indexed
+    positions (out[i][idx] = input[i][idx] + updates)."""
     from ..ops import manipulation as _mp
 
-    return _mp.put_along_axis(input, index, updates, axis=1)
+    return _mp.put_along_axis(input, index, updates, axis=1, reduce="add")
 
 
 def sequence_enumerate(input, win_size, pad_value=0, name=None):  # noqa: A002

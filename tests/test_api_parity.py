@@ -206,6 +206,9 @@ NAMESPACE_MODULES = [
     ("distributed/fleet/utils/__init__.py", "paddle_tpu.distributed.fleet.utils"),
     ("static/__init__.py", "paddle_tpu.static"),
     ("static/nn/__init__.py", "paddle_tpu.static.nn"),
+    ("sparse/__init__.py", "paddle_tpu.sparse"),
+    ("sparse/nn/__init__.py", "paddle_tpu.sparse.nn"),
+    ("sparse/nn/functional/__init__.py", "paddle_tpu.sparse.nn.functional"),
 ]
 
 

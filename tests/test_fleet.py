@@ -426,8 +426,9 @@ def test_pipeline_interleave_vpp_matches_single_device():
 
 
 def test_pipeline_nonuniform_places_stages():
-    """Non-uniform stages: general path still places params per pp rank and
-    matches single-device numerics (transfer op in the tape)."""
+    """Non-uniform stages: r4 — they now take the COMPILED hetero schedule
+    (flat-padded superstructure + lax.switch), params still placed per pp
+    rank, numerics still == single device."""
     from paddle_tpu.distributed.fleet import LayerDesc, PipelineLayer, PipelineParallel
 
     hcg = fleet.get_hybrid_communicate_group()
@@ -444,7 +445,7 @@ def test_pipeline_nonuniform_places_stages():
 
     pipe = build()
     engine = PipelineParallel(pipe, hcg, strategy)
-    assert not engine._spmd
+    assert engine._spmd and engine._spmd_hetero
     d0 = pipe.run_function[0].weight._value.devices()
     d1 = pipe.run_function[2].weight._value.devices()
     assert d0 != d1

@@ -1,0 +1,135 @@
+"""Round-4 pipeline: non-uniform (hetero) compiled schedule and the
+dp/tp/ZeRO-3 hybrid compositions of pipeline_spmd (VERDICT r3 next-round
+#4/#5).
+
+Reference: paddle/fluid/distributed/fleet_executor/task_node.h
+(heterogeneous TaskNode graphs), fleet/meta_parallel/pipeline_parallel.py.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed import fleet
+
+
+WORLD = 8
+
+
+class CE(nn.Layer):
+    def forward(self, logits, labels):
+        return nn.functional.cross_entropy(logits, labels)
+
+
+def _build_hetero(world, V=64, D=16, seed=5):
+    from paddle_tpu.distributed.fleet import LayerDesc, PipelineLayer
+
+    paddle.seed(seed)
+    descs = [LayerDesc(nn.Embedding, V, D)]
+    for _ in range(world - 2):
+        descs += [LayerDesc(nn.Linear, D, D)]
+    descs += [LayerDesc(nn.Linear, D, V)]
+    return PipelineLayer(layers=descs, num_stages=world, loss_fn=CE())
+
+
+@pytest.fixture(autouse=True)
+def _dist():
+    import paddle_tpu.distributed as dist
+
+    dist.init_parallel_env()
+    yield
+
+
+def test_hetero_pipeline_compiles_and_matches_single():
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "pp_degree": WORLD}
+    strategy.pipeline_configs = {"accumulate_steps": WORLD, "micro_batch_size": 2}
+    fleet.init(is_collective=True, strategy=strategy)
+
+    pipe = _build_hetero(WORLD)
+    engine = fleet.distributed_model(pipe)
+    assert engine._spmd and engine._spmd_hetero, (
+        "embedding-first/LM-head-last stages must take the compiled path"
+    )
+    opt = fleet.distributed_optimizer(
+        paddle.optimizer.SGD(0.05, parameters=pipe.parameters()))
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 64, (2 * WORLD, 8)).astype(np.int64)
+    labels = rng.randint(0, 64, (2 * WORLD, 8)).astype(np.int64)
+    loss = engine.train_batch((paddle.to_tensor(ids), paddle.to_tensor(labels)), opt)
+
+    ref = _build_hetero(WORLD)
+    ref_loss = CE()(ref(paddle.to_tensor(ids)), paddle.to_tensor(labels))
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+
+    # optimizer actually moved the params: second step shrinks the loss
+    loss2 = engine.train_batch((paddle.to_tensor(ids), paddle.to_tensor(labels)), opt)
+    assert float(loss2) < float(loss)
+
+
+def test_pipeline_spmd_data_axis_and_tp():
+    from paddle_tpu.distributed.fleet.meta_parallel.spmd_pipeline import pipeline_spmd
+
+    mesh3 = Mesh(np.array(jax.devices()[:8]).reshape(2, 2, 2), ("dp", "tp", "pp"))
+    D, H, S, M, B = 8, 16, 2, 4, 4
+    rng = np.random.RandomState(3)
+    w1 = rng.randn(S, D, H).astype(np.float32) * 0.3
+    w2 = rng.randn(S, H, D).astype(np.float32) * 0.3
+    mbs = rng.randn(M, B, D).astype(np.float32)
+
+    def stage(params, x):
+        lw1, lw2 = params
+        return jax.lax.psum(jnp.tanh(x @ lw1) @ lw2, "tp")
+
+    run = pipeline_spmd(stage, mesh3, data_axis="dp",
+                        param_specs=(P("pp", None, "tp"), P("pp", "tp", None)))
+    out = run((jnp.asarray(w1), jnp.asarray(w2)), jnp.asarray(mbs))
+    ref = mbs.copy()
+    for s in range(S):
+        ref = np.tanh(ref @ w1[s]) @ w2[s]
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_pipeline_spmd_zero3_weights():
+    from paddle_tpu.distributed.fleet.meta_parallel.spmd_pipeline import pipeline_spmd
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(4, 2), ("dp", "pp"))
+    D, S, M, B = 8, 2, 4, 8
+    rng = np.random.RandomState(4)
+    w = rng.randn(S, D, D).astype(np.float32) * 0.3
+    mbs = rng.randn(M, B, D).astype(np.float32)
+
+    def stage(w_local, x):
+        full = jax.lax.all_gather(w_local, "dp", axis=0, tiled=True)
+        return jnp.tanh(x @ full)
+
+    run = pipeline_spmd(stage, mesh, data_axis="dp", param_specs=P("pp", "dp"))
+    out = run(jnp.asarray(w), jnp.asarray(mbs))
+    ref = mbs.copy()
+    for s in range(S):
+        ref = np.tanh(ref @ w[s])
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_hetero_stack_roundtrip():
+    from paddle_tpu.distributed.fleet.meta_parallel.spmd_pipeline import (
+        stack_stage_params_hetero,
+    )
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("pp",))
+    trees = [
+        {"w": jnp.ones((3, 2)), "b": jnp.zeros((2,))},
+        {"big": jnp.full((5, 5), 2.0)},
+        {"v": jnp.arange(4.0)},
+        {"x": jnp.ones((1,))},
+    ]
+    stacked, unravels, sizes = stack_stage_params_hetero(trees, mesh)
+    assert stacked.shape == (4, 25)
+    for k, tree in enumerate(trees):
+        rt = unravels[k](stacked[k, : sizes[k]])
+        for key in tree:
+            np.testing.assert_allclose(np.asarray(rt[key]), np.asarray(tree[key]))

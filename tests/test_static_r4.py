@@ -172,6 +172,10 @@ class TestStaticExtras:
         y = paddle.to_tensor(np.array([[1], [0], [1], [0]]))
         a, _ = static.auc(p, y)
         assert float(a.numpy()) > 0.99
+        a_pr, _ = static.auc(p, y, curve="PR")
+        assert float(a_pr.numpy()) > 0.99
+        with pytest.raises(ValueError):
+            static.auc(p, y, curve="XYZ")
         bundle = static.ctr_metric_bundle(p, y)
         assert len(bundle) == 7
         total = float(bundle[-1].numpy())
