@@ -246,7 +246,11 @@ class PipelineParallel(Layer):
 
             return fn
 
-        run = pipeline_spmd_hetero([make_fn(k) for k in range(S)], self._pp_mesh)
+        # only the hidden state rides the ring; the vocab-sized "out" slot
+        # is collected from ys, so shipping it every hop would multiply ICI
+        # traffic by ~V/D
+        run = pipeline_spmd_hetero([make_fn(k) for k in range(S)],
+                                   self._pp_mesh, carry_shift_keys=("h",))
 
         from ....framework import random as random_mod
 

@@ -226,7 +226,8 @@ def stack_stage_params_interleave(param_trees, mesh: Mesh, num_virtual_stages: i
 
 
 def pipeline_spmd_hetero(stage_fns, mesh: Mesh, axis: str = "pp",
-                         checkpoint_stages: bool = True):
+                         checkpoint_stages: bool = True,
+                         carry_shift_keys=None):
     """Compiled schedule for NON-uniform stages (VERDICT r3 next-round #5:
     embedding-first / LM-head-last models). Per-stage param trees differ, so
     each stage's params ravel into a flat f32-promoted vector zero-padded to
@@ -240,6 +241,10 @@ def pipeline_spmd_hetero(stage_fns, mesh: Mesh, axis: str = "pp",
     stage_fns[s](flat_local, carry, feed) -> carry'; feed is that device's
     time-aligned micro-batch element (stage s at step t sees micro-batch
     t - s — stage 0 consumes it as input, later stages may read labels).
+    carry_shift_keys: when the carry is a dict, the subset of keys the NEXT
+    stage actually reads — only those ride the ppermute ring (e.g. ship the
+    hidden state but not a vocab-sized output slot that is only collected
+    from ys); None ships everything.
     Returns run(stacked_flat, feeds) -> final-stage outputs [M, ...].
     """
     S = mesh.shape[axis]
@@ -256,9 +261,21 @@ def pipeline_spmd_hetero(stage_fns, mesh: Mesh, axis: str = "pp",
             m = jnp.clip(t - sidx, 0, M - 1)
             feed = _tree_index(feeds, m)
             y = jax.lax.switch(sidx, fns, p, carry, feed)
-            shifted = jax.tree_util.tree_map(
-                lambda l: jax.lax.ppermute(l, axis, fwd_perm), y
-            )
+            if carry_shift_keys is not None and isinstance(y, dict):
+                shifted = {
+                    key: (
+                        jax.tree_util.tree_map(
+                            lambda l: jax.lax.ppermute(l, axis, fwd_perm), val
+                        )
+                        if key in carry_shift_keys
+                        else jax.tree_util.tree_map(jnp.zeros_like, val)
+                    )
+                    for key, val in y.items()
+                }
+            else:
+                shifted = jax.tree_util.tree_map(
+                    lambda l: jax.lax.ppermute(l, axis, fwd_perm), y
+                )
             return shifted, y
 
         # carry template: zeros with the structure stage 0 emits

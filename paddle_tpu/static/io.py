@@ -141,12 +141,17 @@ def load(program, model_path, executor=None, var_list=None):
     path = model_path if model_path.endswith(".pdparams") else model_path + ".pdparams"
     with open(path, "rb") as f:
         state = pickle.load(f)
-    # var_list entries may be tensors (matched by identity — names are often
-    # unset) or key strings
+    # var_list entries may be tensors (matched by identity, or by name when
+    # set — tensors from a rebuilt program carry names but new ids) or key
+    # strings
     wanted_ids = wanted_keys = None
     if var_list is not None:
         wanted_ids = {id(v) for v in var_list if not isinstance(v, str)}
         wanted_keys = {v for v in var_list if isinstance(v, str)}
+        wanted_keys |= {
+            getattr(v, "name", None) for v in var_list
+            if not isinstance(v, str) and getattr(v, "name", None)
+        }
     for key, t in named_program_params(program):
         if var_list is not None and id(t) not in wanted_ids and key not in wanted_keys:
             continue

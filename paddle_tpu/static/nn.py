@@ -320,25 +320,31 @@ def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
     k = num_neg_samples or 10
     w = _create_parameter((num_total_classes, d), "float32", attr=param_attr,
                           default_initializer=Normal(0.0, 0.01))
-    b = _create_parameter((num_total_classes,), "float32", attr=bias_attr,
-                          is_bias=True)
+    b = (_create_parameter((num_total_classes,), "float32", attr=bias_attr,
+                           is_bias=True)
+         if bias_attr is not False else None)
     key = random_mod.next_key()
 
-    def fn(x, lbl, wv, bv):
+    def fn(x, lbl, wv, *rest):
         import jax as _jax
 
+        bv = rest[0] if rest else None
         bsz = x.shape[0]
         lbl = lbl.reshape(bsz)
         noise = _jax.random.randint(key, (bsz, k), 0, num_total_classes)
-        pos_logit = jnp.sum(x * wv[lbl], -1) + bv[lbl]
-        neg_logit = jnp.einsum("bd,bkd->bk", x, wv[noise]) + bv[noise]
+        pos_logit = jnp.sum(x * wv[lbl], -1)
+        neg_logit = jnp.einsum("bd,bkd->bk", x, wv[noise])
+        if bv is not None:
+            pos_logit = pos_logit + bv[lbl]
+            neg_logit = neg_logit + bv[noise]
         # NCE with uniform noise: P_n = 1/C constant shifts cancel into the
         # bias; binary logistic on pos vs sampled negatives
         pos_loss = _jax.nn.softplus(-pos_logit)
         neg_loss = jnp.sum(_jax.nn.softplus(neg_logit), -1)
         return (pos_loss + neg_loss).reshape(bsz, 1)
 
-    return apply("nce", fn, input, label, w, b)
+    args = (input, label, w) + ((b,) if b is not None else ())
+    return apply("nce", fn, *args)
 
 
 def sparse_embedding(input, size, padding_idx=None, is_test=False,

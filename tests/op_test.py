@@ -68,3 +68,50 @@ def check_grad(op_fn, inputs, kwargs=None, rtol=1e-4, atol=1e-5, reduce_to_scala
     oracle = jax.grad(pure, argnums=tuple(range(len(vals))))(*[jnp.asarray(v) for v in vals])
     for name, got, want in zip(names, tape_grads, oracle):
         np.testing.assert_allclose(got, np.asarray(want), rtol=rtol, atol=atol, err_msg=f"grad({name}) of {op_fn}")
+
+
+def check_grad_bf16(op_fn, inputs, kwargs=None, rtol=6e-2, atol=6e-2):
+    """bf16 gradient check (the training dtype): the eager tape runs with
+    bfloat16 inputs; the oracle is jax.grad of the same computation in f32.
+    Tolerances are bf16-scale (reference: test/white_list/
+    op_accuracy_white_list.py loosens per-op in the same way)."""
+    import jax
+    import jax.numpy as jnp
+    import ml_dtypes
+
+    kwargs = kwargs or {}
+    names = list(inputs.keys())
+    vals = [np.asarray(v, dtype=np.float32) for v in inputs.values()]
+
+    ts = [paddle.to_tensor(v.astype(ml_dtypes.bfloat16)) for v in vals]
+    for t in ts:
+        t.stop_gradient = False
+    out = op_fn(*ts, **kwargs)
+    outs = out if isinstance(out, (tuple, list)) else [out]
+    loss = None
+    for o in outs:
+        s = o.sum() if o.size > 1 else o
+        loss = s if loss is None else loss + s
+    loss.backward()
+    tape_grads = [
+        np.asarray(t.grad.numpy(), dtype=np.float32) if t.grad is not None
+        else np.zeros_like(v)
+        for t, v in zip(ts, vals)
+    ]
+
+    def pure(*raw):
+        ts2 = [paddle.to_tensor(r) for r in raw]
+        with paddle.no_grad():
+            o = op_fn(*ts2, **kwargs)
+        os_ = o if isinstance(o, (tuple, list)) else [o]
+        acc = 0.0
+        for oo in os_:
+            acc = acc + jnp.sum(oo._value)
+        return acc
+
+    oracle = jax.grad(pure, argnums=tuple(range(len(vals))))(
+        *[jnp.asarray(v) for v in vals])
+    for name, got, want in zip(names, tape_grads, oracle):
+        np.testing.assert_allclose(
+            got, np.asarray(want, dtype=np.float32), rtol=rtol, atol=atol,
+            err_msg=f"bf16 grad({name}) of {op_fn}")

@@ -310,6 +310,57 @@ BF16_TOL = {
     "tan": (8e-2, 8e-2),
 }
 
+# r4 (VERDICT r3 next-round #6): bf16 coverage is now the POLICY — every
+# table op with float inputs and a closed-form reference sweeps bf16 —
+# rather than a hand-picked "b" flag. Exclusions are documented, not
+# silent:
+BF16_EXCLUDE = {
+    # precision-structured ops: the op's DEFINITION needs more than 8
+    # mantissa bits at these operating points
+    "isclose": "compares at 1e-9 — below bf16 resolution by construction",
+    "nextafter": "ULP-stepping is dtype-bit-specific; bf16 ULP != f32 ULP",
+    "frexp": "mantissa/exponent decomposition is dtype-bit-specific",
+    "erfinv": "diverges near +/-1; bf16 rounding of inputs crosses poles",
+    "logit": "diverges near 0/1; input rounding crosses poles",
+    # special functions whose jax lowerings are f32-internal but whose
+    # magnitude spans overflow bf16's range at our operating points
+    "multigammaln": "output magnitude ~1e2 with cancellation",
+    "polygamma": "series cancellation below bf16 resolution",
+    "gammainc": "continued-fraction cancellation",
+    "gammaincc": "continued-fraction cancellation",
+    # decompositions: XLA lowers them f32-only; inputs round-trip through
+    # bf16 but conditioning amplifies the 2^-8 input error past any
+    # meaningful tolerance
+    "cholesky": "conditioning amplifies bf16 input rounding",
+    "qr": "conditioning amplifies bf16 input rounding",
+    "svdvals": "conditioning amplifies bf16 input rounding",
+    "eigvalsh": "conditioning amplifies bf16 input rounding",
+    "inv": "conditioning amplifies bf16 input rounding",
+    "pinv": "conditioning amplifies bf16 input rounding",
+    "solve": "conditioning amplifies bf16 input rounding",
+    "triangular_solve": "conditioning amplifies bf16 input rounding",
+    "lstsq": "conditioning amplifies bf16 input rounding",
+    "matrix_power": "repeated products amplify bf16 rounding",
+    "det": "product of n values: error compounds past tolerance",
+    "slogdet": "lu cancellation",
+    "matrix_rank": "rank thresholding flips under input rounding",
+    "cond": "ratio of extreme singular values",
+    "householder_product": "orthogonality degrades past tolerance",
+    "cond2": "ratio of extreme singular values (p=2 path)",
+    # discontinuous ops: bf16 input rounding crosses the discontinuity
+    "mod": "jump at multiples of the divisor; rounding flips the branch",
+    "remainder": "jump at multiples of the divisor",
+    # dtype-structural
+    "as_complex": "complex pairs have no bfloat16 dtype",
+}
+
+
+def _bf16_eligible(t):
+    name, op, ref, arrays, kwargs, flags = t
+    if ref is None or name in BF16_EXCLUDE:
+        return False
+    return all(np.issubdtype(np.asarray(a).dtype, np.floating) for a in arrays)
+
 
 @pytest.mark.parametrize("name,op,ref,arrays,kwargs,flags", T, ids=[t[0] for t in T])
 def test_forward(name, op, ref, arrays, kwargs, flags):
@@ -337,7 +388,7 @@ def test_grad(name, op, ref, arrays, kwargs, flags):
     check_grad(op, {f"x{i}": a for i, a in enumerate(arrays)}, kwargs)
 
 
-BF16_ROWS = [t for t in T if "b" in t[5]]
+BF16_ROWS = [t for t in T if _bf16_eligible(t)]
 
 
 @pytest.mark.parametrize("name,op,ref,arrays,kwargs,flags", BF16_ROWS, ids=[t[0] for t in BF16_ROWS])
@@ -359,6 +410,33 @@ def test_bf16_forward(name, op, ref, arrays, kwargs, flags):
             rtol=rtol, atol=atol, err_msg=f"bf16 {name}")
 
 
+BF16_GRAD_TOL = {}
+
+BF16_GRAD_EXCLUDE = {
+    # grads whose formula divides by op-output or (1-x^2)-style terms:
+    # bf16 input rounding lands near the pole
+    "asin": "grad 1/sqrt(1-x^2) near |x|->1",
+    "acos": "grad -1/sqrt(1-x^2) near |x|->1",
+    "tan": "grad 1/cos^2 blows past bf16 tolerance away from 0",
+    "prod": "grad prod/x_i: divides by near-zero bf16-rounded values",
+}
+
+BF16_GRAD_ROWS = [
+    t for t in BF16_ROWS if "g" in t[5] and t[0] not in BF16_GRAD_EXCLUDE
+]
+
+
+@pytest.mark.parametrize("name,op,ref,arrays,kwargs,flags", BF16_GRAD_ROWS, ids=[t[0] for t in BF16_GRAD_ROWS])
+def test_bf16_grad(name, op, ref, arrays, kwargs, flags):
+    """Gradients in the TRAINING dtype: tape runs bf16, oracle is f32
+    jax.grad (VERDICT r3 next-round #6 — the low-precision grad axis)."""
+    from op_test import check_grad_bf16
+
+    rtol, atol = BF16_GRAD_TOL.get(name, (6e-2, 6e-2))
+    check_grad_bf16(op, {f"x{i}": a for i, a in enumerate(arrays)}, kwargs,
+                    rtol=rtol, atol=atol)
+
+
 def test_table_scale():
     """The r3 table + the r2 table must together cover 250+ distinct ops
     (VERDICT: 'grow the numeric table ~3-4x')."""
@@ -369,4 +447,5 @@ def test_table_scale():
     assert len(names2) >= 180, len(names2)
     assert len(names1 | names2) >= 230, len(names1 | names2)
     assert len(GRAD_ROWS) >= 70, len(GRAD_ROWS)
-    assert len(BF16_ROWS) >= 30, len(BF16_ROWS)
+    assert len(BF16_ROWS) >= 110, len(BF16_ROWS)
+    assert len(BF16_GRAD_ROWS) >= 55, len(BF16_GRAD_ROWS)

@@ -281,3 +281,65 @@ class TestMiscStrays:
         from paddle_tpu.distributed import rpc
 
         assert hasattr(rpc, "get_current_worker_info")
+
+
+class TestSparseAttentionMemory:
+    def _csr_random(self, B, H, S, keep=8, seed=0):
+        rng = np.random.RandomState(seed)
+        offs = np.zeros((B, H, S + 1), np.int32)
+        cols_l = []
+        for b in range(B):
+            for h in range(H):
+                cols_bh = []
+                for r in range(S):
+                    c = np.sort(rng.choice(S, size=keep, replace=False))
+                    cols_bh.append(c)
+                    offs[b, h, r + 1] = offs[b, h, r] + keep
+                cols_l.append(np.concatenate(cols_bh))
+        cols = np.stack(cols_l).reshape(B, H, -1).astype(np.int32)
+        return offs, cols
+
+    @pytest.mark.parametrize("S", [256, 200])  # 200: non-block-aligned
+    def test_blocked_matches_dense(self, monkeypatch, S):
+        from paddle_tpu.nn.functional import attention as attn_mod
+
+        B, H, D = 1, 2, 16
+        rng = np.random.RandomState(1)
+        q = paddle.to_tensor(rng.randn(B, H, S, D).astype(np.float32))
+        k = paddle.to_tensor(rng.randn(B, H, S, D).astype(np.float32))
+        v = paddle.to_tensor(rng.randn(B, H, S, D).astype(np.float32))
+        offs, cols = self._csr_random(B, H, S)
+        dense = attn_mod.sparse_attention(
+            q, k, v, paddle.to_tensor(offs), paddle.to_tensor(cols))
+        monkeypatch.setenv("PADDLE_TPU_SPARSE_ATTN_DENSE_MAX_SEQ", "128")
+        # block 128: S=200 pads the last block (the non-aligned case),
+        # S=256 tiles exactly
+        monkeypatch.setenv("PADDLE_TPU_SPARSE_ATTN_BLOCK", "128")
+        blocked = attn_mod.sparse_attention(
+            q, k, v, paddle.to_tensor(offs), paddle.to_tensor(cols))
+        np.testing.assert_allclose(blocked.numpy(), dense.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_s4096_under_memory_bound(self):
+        """S=4096 runs the blocked path; compiled temp memory must stay FAR
+        below the dense path's [B,H,S,S] f32 logits (VERDICT r3 #10)."""
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.nn.functional.attention import _sparse_attention_blocked
+
+        B, H, S, D = 1, 1, 4096, 32
+        rng = np.random.RandomState(2)
+        q = jnp.asarray(rng.randn(B, H, S, D), jnp.float32)
+        offs, cols = self._csr_random(B, H, S, keep=4, seed=3)
+
+        def f(q, k, v, offs, cols):
+            return _sparse_attention_blocked((q, k, v, offs, cols), False, False)
+
+        lowered = jax.jit(f).lower(q, q, q, jnp.asarray(offs), jnp.asarray(cols))
+        mem = lowered.compile().memory_analysis()
+        dense_logits_bytes = B * H * S * S * 4
+        assert mem.temp_size_in_bytes < dense_logits_bytes / 2, (
+            f"temp {mem.temp_size_in_bytes} vs dense logits {dense_logits_bytes}"
+        )
+        out = jax.jit(f)(q, q, q, jnp.asarray(offs), jnp.asarray(cols))
+        assert np.isfinite(np.asarray(out)).all()
