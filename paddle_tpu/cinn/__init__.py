@@ -1,0 +1,8 @@
+"""paddle.cinn namespace shim.
+
+Reference parity: python/paddle/cinn/ — the CINN tensor-compiler frontend.
+DECISION (PARITY.md §2.1): the graph compiler of this framework is XLA;
+CINN's roles (fusion, schedule search, codegen) are subsumed. These modules
+keep the import surface importable and fail loudly on use.
+"""
+from . import auto_schedule, compiler, runtime  # noqa: F401

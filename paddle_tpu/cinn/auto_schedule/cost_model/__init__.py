@@ -1,0 +1,21 @@
+"""CINN auto-schedule cost models (reference cinn/auto_schedule/cost_model).
+Schedule search is XLA's job here; constructing these raises with that
+pointer."""
+
+
+class CostModel:
+    def __init__(self, *a, **k):
+        raise RuntimeError(
+            "CINN cost models are subsumed by XLA's scheduling "
+            "(PARITY.md §2.1 CINN row)")
+
+
+class XgbCostModel(CostModel):
+    pass
+
+
+class CostModelType:
+    XGB = 1
+
+
+__all__ = ["CostModel", "CostModelType", "XgbCostModel"]

@@ -1,0 +1,14 @@
+"""reference cinn/runtime: low-level IR jit hooks; XLA owns codegen here."""
+
+
+class CinnLowerLevelIrJit:
+    def __init__(self, *a, **k):
+        raise RuntimeError("CINN runtime is subsumed by XLA")
+
+
+class Module:
+    def __init__(self, *a, **k):
+        raise RuntimeError("CINN runtime is subsumed by XLA")
+
+
+__all__ = ["CinnLowerLevelIrJit", "Module"]

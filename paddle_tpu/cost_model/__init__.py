@@ -1,0 +1,17 @@
+"""paddle.cost_model (reference: python/paddle/cost_model/cost_model.py):
+static-program op cost profiling. Here profiling is the XLA device profile
+(paddle_tpu.profiler / benchmarks/profile_xplane.py); this API reports that
+pointer on use."""
+
+
+class CostModel:
+    def __init__(self):
+        pass
+
+    def profile_measure(self, *a, **k):
+        raise RuntimeError(
+            "per-op cost profiling runs through paddle_tpu.profiler "
+            "(XLA xplane device profile), not a static-graph cost model")
+
+
+__all__ = ['CostModel']

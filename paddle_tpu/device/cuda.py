@@ -86,3 +86,19 @@ def current_stream(device=None):
     from . import current_stream as _cs
 
     return _cs(device)
+
+
+def __getattr__(name):
+    # reference device/cuda/__init__.py exports Stream/Event here too — the
+    # ordering no-ops from paddle_tpu.device (XLA's dispatch queue orders
+    # work). Lazy: this module imports before the parent finishes defining
+    # them.
+    if name in ("Stream", "Event"):
+        import paddle_tpu.device as _d
+
+        return getattr(_d, name)
+    raise AttributeError(name)
+
+
+def __dir__():
+    return sorted(list(globals()) + ["Stream", "Event"])

@@ -1,0 +1,10 @@
+"""paddle.device.xpu (reference: python/paddle/device/xpu/__init__.py).
+XPU is not part of the TPU build; synchronize exists and raises like a
+paddle build without XPU support."""
+
+
+def synchronize(device=None):
+    raise RuntimeError("synchronize for XPU: not compiled with XPU (TPU build)")
+
+
+__all__ = ['synchronize']

@@ -1,0 +1,2 @@
+"""paddle.distributed.communication (reference package path)."""
+from . import stream  # noqa: F401

@@ -1,0 +1,87 @@
+"""paddle.distributed.communication.stream — stream-variant collectives.
+
+Reference parity: python/paddle/distributed/communication/stream/ — the
+same collectives as paddle.distributed with explicit sync_op /
+use_calc_stream control. TPU-native: XLA's async dispatch queue IS the
+stream; each call delegates to the framework collective and returns its
+task handle (wait() is the synchronization point), so the
+use_calc_stream=False (separate comm stream) request maps onto jax's
+asynchronous dispatch — the semantics the reference's extra stream buys.
+"""
+from __future__ import annotations
+
+from ... import collective as _c
+
+
+def all_reduce(tensor, op=None, group=None, sync_op=True, use_calc_stream=False):
+    return _c.all_reduce(tensor, op=op if op is not None else _c.ReduceOp.SUM,
+                         group=group, sync_op=sync_op or use_calc_stream)
+
+
+def all_gather(tensor_or_tensor_list, tensor, group=None, sync_op=True,
+               use_calc_stream=False):
+    return _c.all_gather(tensor_or_tensor_list, tensor, group=group,
+                         sync_op=sync_op or use_calc_stream)
+
+
+def alltoall(out_tensor_or_tensor_list, in_tensor_or_tensor_list, group=None,
+             sync_op=True, use_calc_stream=False):
+    # stream API leads with OUT (reference stream/all_to_all.py:127);
+    # the base collective keeps paddle's legacy (in, out) order
+    return _c.alltoall(in_tensor_or_tensor_list, out_tensor_or_tensor_list,
+                       group=group, sync_op=sync_op or use_calc_stream)
+
+
+def alltoall_single(out_tensor, in_tensor, out_split_sizes=None,
+                    in_split_sizes=None, group=None, sync_op=True,
+                    use_calc_stream=False):
+    return _c.all_to_all_single(out_tensor, in_tensor,
+                                in_split_sizes=in_split_sizes,
+                                out_split_sizes=out_split_sizes, group=group,
+                                sync_op=sync_op or use_calc_stream)
+
+
+def broadcast(tensor, src, group=None, sync_op=True, use_calc_stream=False):
+    return _c.broadcast(tensor, src, group=group,
+                        sync_op=sync_op or use_calc_stream)
+
+
+def reduce(tensor, dst=0, op=None, group=None, sync_op=True,
+           use_calc_stream=False):
+    return _c.reduce(tensor, dst, op=op if op is not None else _c.ReduceOp.SUM,
+                     group=group, sync_op=sync_op or use_calc_stream)
+
+
+def reduce_scatter(tensor, tensor_or_tensor_list, op=None, group=None,
+                   sync_op=True, use_calc_stream=False):
+    return _c.reduce_scatter(tensor, tensor_or_tensor_list,
+                             op=op if op is not None else _c.ReduceOp.SUM,
+                             group=group, sync_op=sync_op or use_calc_stream)
+
+
+def scatter(tensor, tensor_or_tensor_list=None, src=0, group=None,
+            sync_op=True, use_calc_stream=False):
+    return _c.scatter(tensor, tensor_or_tensor_list, src=src, group=group,
+                      sync_op=sync_op or use_calc_stream)
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True,
+           use_calc_stream=False):
+    return _c.gather(tensor, gather_list=gather_list, dst=dst, group=group,
+                     sync_op=sync_op or use_calc_stream)
+
+
+def send(tensor, dst=0, group=None, sync_op=True, use_calc_stream=False):
+    return _c.send(tensor, dst=dst, group=group,
+                   sync_op=sync_op or use_calc_stream)
+
+
+def recv(tensor, src=0, group=None, sync_op=True, use_calc_stream=False):
+    return _c.recv(tensor, src=src, group=group,
+                   sync_op=sync_op or use_calc_stream)
+
+
+__all__ = [
+    "all_gather", "all_reduce", "alltoall", "alltoall_single", "broadcast",
+    "reduce", "reduce_scatter", "recv", "scatter", "send", "gather",
+]

@@ -1,0 +1,61 @@
+"""paddle.distributed.passes — static-program optimization passes.
+
+Reference parity: python/paddle/distributed/passes/__init__.py
+(new_pass, PassManager, PassContext over ~40 C++/python program passes).
+DECISION: those passes rewrite the reference's SSA graph (fusion, AMP
+insertion, gradient merge...); XLA performs the equivalent rewrites on the
+jaxpr/HLO here, so a pass is an honest no-op marker whose application is
+recorded for introspection.
+"""
+from __future__ import annotations
+
+
+class PassContext:
+    def __init__(self):
+        self._applied = []
+
+    @property
+    def passes(self):
+        return list(self._applied)
+
+
+class _Pass:
+    def __init__(self, name, attrs=None):
+        self.name = name
+        self._attrs = dict(attrs or {})
+
+    def apply(self, main_programs=None, startup_programs=None, context=None):
+        """Record application; the rewrite itself is XLA's job (fusion,
+        buffer assignment, collective scheduling happen at jit time)."""
+        if context is not None:
+            context._applied.append(self.name)
+        return context
+
+    def __repr__(self):
+        return f"Pass({self.name})"
+
+
+def new_pass(name, pass_attrs=None):
+    """Reference passes/__init__.py new_pass."""
+    return _Pass(name, pass_attrs)
+
+
+class PassManager:
+    def __init__(self, passes=None):
+        self._passes = list(passes or [])
+        self._context = PassContext()
+
+    def append(self, p):
+        self._passes.append(p)
+
+    def apply(self, main_programs=None, startup_programs=None):
+        for p in self._passes:
+            p.apply(main_programs, startup_programs, self._context)
+        return self._context
+
+    @property
+    def names(self):
+        return [p.name for p in self._passes]
+
+
+__all__ = ['new_pass', 'PassManager', 'PassContext']

@@ -112,8 +112,20 @@ def reset_excluded_layers(main_program=None):
 
 def prune_model(model: Layer, n=2, m=4, mask_algo="mask_1d", with_mask=True):
     """Apply 2:4 masks to every eligible weight (>=2D, last dim % m == 0,
-    not excluded); registers masks so `decorate`d optimizers keep them."""
+    not excluded); registers masks so `decorate`d optimizers keep them.
+    Custom pruning functions registered via add_supported_layer apply to
+    parameters owned by layers of that type (signature:
+    fn(weight_np, m, n, mask_algo, param_name) -> (pruned_np, mask_np),
+    the reference's contract)."""
     import jax.numpy as jnp
+    import numpy as _np
+
+    # map each parameter to its owning layer's type name so registered
+    # custom pruning functions apply
+    owner_type = {}
+    for _, layer in model.named_sublayers(include_self=True):
+        for _, p in layer._parameters.items():
+            owner_type[id(p)] = type(layer).__name__
 
     _masks.clear()  # masks belong to this model until the next prune
     _masks_version[0] += 1
@@ -123,8 +135,14 @@ def prune_model(model: Layer, n=2, m=4, mask_algo="mask_1d", with_mask=True):
             continue
         if name in _excluded or (p.name and p.name in _excluded):
             continue
-        mask = create_mask(p, func_name=mask_algo, n=n, m=m)
-        p._replace_value(p._value * jnp.asarray(mask, p._value.dtype))
+        custom = _supported_layers_and_prune_func_map.get(owner_type.get(id(p)))
+        if custom is not None:
+            w_pruned, mask = custom(_np.asarray(p.numpy()), m, n, mask_algo, name)
+            mask = _np.asarray(mask)
+            p._replace_value(jnp.asarray(w_pruned, p._value.dtype))
+        else:
+            mask = create_mask(p, func_name=mask_algo, n=n, m=m)
+            p._replace_value(p._value * jnp.asarray(mask, p._value.dtype))
         if with_mask:
             _masks[id(p)] = (p, mask)
         pruned[name] = float(mask.mean())
@@ -172,3 +190,16 @@ class ASPOptimizer:
 
 def decorate(optimizer):
     return ASPOptimizer(optimizer)
+
+
+def add_supported_layer(layer, pruning_func=None):
+    """Register a layer type (or name) as ASP-prunable with an optional
+    custom pruning function (reference incubate/asp/supported_layer_list.py:80)."""
+    name = layer if isinstance(layer, str) else getattr(layer, "__name__", str(layer))
+    _supported_layers_and_prune_func_map[name] = pruning_func
+
+
+_supported_layers_and_prune_func_map = {"Linear": None, "Conv2D": None}
+
+if "add_supported_layer" not in __all__:
+    __all__.append("add_supported_layer")

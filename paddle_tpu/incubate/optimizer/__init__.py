@@ -1,0 +1,8 @@
+"""paddle.incubate.optimizer (reference: python/paddle/incubate/optimizer/).
+
+LBFGS graduated to paddle.optimizer; re-exported here like the reference.
+"""
+from ...optimizer import LBFGS  # noqa: F401
+from . import functional  # noqa: F401
+
+__all__ = ['LBFGS']

@@ -109,3 +109,28 @@ def llm_int8_linear(x, weight, bias=None, weight_scale=None, threshold=6.0):
     higher precision — on TPU one fused dequant matmul delivers that
     directly."""
     return weight_only_linear(x, weight, bias, weight_scale, weight_dtype="int8")
+
+
+from ..layer import Layer as _Layer
+
+
+class Stub(_Layer):
+    """Placeholder layer replaced by an observer before PTQ/QAT (reference
+    nn/quant/stub.py:20): identity in forward; conversion passes match it
+    BY TYPE (isinstance) and swap in the configured observer so
+    functional-API inputs get observed."""
+
+    def __init__(self, observer=None):
+        super().__init__()
+        self._observer = observer
+
+    def forward(self, x):
+        if self._observer is not None and hasattr(self._observer, "_instance"):
+            # an installed observer factory observes in-place
+            if not hasattr(self, "_observer_layer"):
+                self._observer_layer = self._observer._instance(self)
+            return self._observer_layer(x)
+        return x
+
+
+__all__.append("Stub")

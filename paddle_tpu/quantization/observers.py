@@ -67,3 +67,53 @@ class AVGObserver:
 
     def _instance(self, layer=None):
         return AVGObserverLayer(layer, **self.kwargs)
+
+
+class GroupWiseWeightObserverLayer(BaseObserver):
+    """Per-group max-abs weight observer (reference quantization/observers/
+    groupwise.py:23): scales computed over groups of `group_size` rows.
+    Group scales are consumed by the weight-only path
+    (nn.quant.weight_quantize group_size) — PTQ.convert's per-tensor
+    fake-quant broadcasts them against the padded row groups."""
+
+    def __init__(self, layer=None, quant_bits=8, group_size=128):
+        super().__init__()
+        import jax.numpy as jnp
+        from ..core.tensor import Tensor
+
+        self.quant_bits = quant_bits
+        self.group_size = group_size
+        self.register_buffer("scale", Tensor(jnp.zeros((1,), jnp.float32)))
+
+    def forward(self, x):
+        import jax.numpy as jnp
+        from ..core.tensor import Tensor
+
+        v = x._value if hasattr(x, "_value") else jnp.asarray(x)
+        n = v.shape[0]
+        g = max(1, min(self.group_size, n))
+        pad = (-n) % g
+        vp = jnp.pad(v, [(0, pad)] + [(0, 0)] * (v.ndim - 1))
+        grouped = jnp.abs(vp).reshape((vp.shape[0] // g, g) + vp.shape[1:])
+        self.scale = Tensor(grouped.max(axis=1))
+        return x
+
+    def scales(self):
+        return self.scale
+
+    def bit_length(self):
+        return self.quant_bits
+
+    def quant_axis(self):
+        return 0
+
+    def zero_points(self):
+        return None
+
+
+class GroupWiseWeightObserver:
+    def __init__(self, quant_bits=8, group_size=128):
+        self.kwargs = dict(quant_bits=quant_bits, group_size=group_size)
+
+    def _instance(self, layer=None):
+        return GroupWiseWeightObserverLayer(layer, **self.kwargs)

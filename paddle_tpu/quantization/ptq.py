@@ -8,6 +8,8 @@ from __future__ import annotations
 
 import copy
 
+import numpy as np
+
 from ..nn.layer import Layer
 from .qat import _QAT_WRAPPERS, _materialize_layer_configs, _walk_and_replace
 from .quanted_layers import QuantedConv2D, QuantedLinear
@@ -48,7 +50,9 @@ class PTQ:
                 wq = layer.weight_quanter
                 if wq is not None:
                     scale = wq.scales()
-                    if float(scale.numpy()) <= 1e-8:
+                    # group/channel-wise observers emit vector scales; the
+                    # calibration check is their max
+                    if float(np.abs(np.asarray(scale.numpy())).max()) <= 1e-8:
                         import warnings
 
                         warnings.warn(

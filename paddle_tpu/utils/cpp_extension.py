@@ -19,3 +19,37 @@ def load(name, sources, **kwargs):
 
 def setup(**kwargs):
     raise NotImplementedError("see cpp_extension.load message")
+
+
+def CppExtension(sources, *args, **kwargs):
+    """Build spec for a C++ custom-op extension (reference
+    utils/cpp_extension/cpp_extension.py). Returns a setuptools Extension —
+    the native toolchain path this framework uses for its own runtime
+    (paddle_tpu/native); the paddle custom-op registration headers are not
+    part of the TPU build, so ops should bind via ctypes/cffi like
+    native/store.py does."""
+    from setuptools import Extension
+
+    name = kwargs.pop("name", "paddle_tpu_cpp_ext")
+    return Extension(name, sources, *args, **kwargs)
+
+
+def CUDAExtension(sources, *args, **kwargs):
+    raise NotImplementedError(
+        "CUDAExtension: not compiled with CUDA (TPU build — device kernels "
+        "are Pallas/XLA; host-side native code uses CppExtension)"
+    )
+
+
+def get_build_directory(verbose=False):
+    """Reference get_build_directory: the extension build root
+    (PADDLE_EXTENSION_DIR or a default under ~/.cache)."""
+    import os
+
+    root = os.environ.get(
+        "PADDLE_EXTENSION_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu_extensions"),
+    )
+    if verbose:
+        print(f"paddle_tpu extension build directory: {root}")
+    return root

@@ -3,3 +3,52 @@ from . import datasets  # noqa: F401
 from . import models  # noqa: F401
 from . import ops  # noqa: F401
 from . import transforms  # noqa: F401
+
+# ---------------------------------------------------------------------------
+# image backend registry (reference python/paddle/vision/image.py)
+# ---------------------------------------------------------------------------
+_image_backend = "pil"
+
+
+def set_image_backend(backend):
+    """Select the image-loading backend consumed by image_load (reference
+    vision/image.py:24): 'pil' or 'cv2' ('cv2' yields numpy arrays — OpenCV
+    is not in the TPU image; 'tensor' accepted for transforms). The bundled
+    datasets are synthetic (no image files), so only image_load and
+    DatasetFolder-style user code read this setting."""
+    global _image_backend
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(
+            f"Expected backend are one of ['pil', 'cv2', 'tensor'], but got {backend}"
+        )
+    _image_backend = backend
+
+
+def get_image_backend():
+    """Reference vision/image.py:91."""
+    return _image_backend
+
+
+def image_load(path, backend=None):
+    """Load an image via the selected backend (reference vision/image.py:112):
+    PIL Image for 'pil', HWC uint8 ndarray for 'cv2'/'tensor'."""
+    import numpy as _np
+
+    backend = backend or _image_backend
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(
+            f"Expected backend are one of ['pil', 'cv2', 'tensor'], but got {backend}"
+        )
+    if str(path).endswith(".npy"):
+        arr = _np.load(path)
+        if backend == "pil":
+            from PIL import Image
+
+            return Image.fromarray(arr)
+        return arr
+    from PIL import Image
+
+    img = Image.open(path)
+    if backend == "pil":
+        return img
+    return _np.asarray(img)

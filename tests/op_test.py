@@ -32,8 +32,11 @@ def _assert_close(out, ref, rtol, atol, op_fn):
         np.testing.assert_allclose(np.asarray(out.numpy()), ref, rtol=rtol, atol=atol, err_msg=str(op_fn))
 
 
-def check_grad(op_fn, inputs, kwargs=None, rtol=1e-4, atol=1e-5, reduce_to_scalar=True):
-    """Check eager-tape gradients against jax.grad of the same computation."""
+def check_grad(op_fn, inputs, kwargs=None, rtol=1e-4, atol=1e-5, reduce_to_scalar=True,
+               input_dtype=None):
+    """Check eager-tape gradients against jax.grad of the same computation.
+    input_dtype: run the TAPE in this dtype (e.g. ml_dtypes.bfloat16) while
+    the oracle stays f32 — the low-precision training-dtype check."""
     import jax
     import jax.numpy as jnp
 
@@ -41,49 +44,9 @@ def check_grad(op_fn, inputs, kwargs=None, rtol=1e-4, atol=1e-5, reduce_to_scala
     names = list(inputs.keys())
     vals = [np.asarray(v, dtype=np.float32) for v in inputs.values()]
 
-    # eager tape path
-    ts = [paddle.to_tensor(v) for v in vals]
-    for t in ts:
-        t.stop_gradient = False
-    out = op_fn(*ts, **kwargs)
-    outs = out if isinstance(out, (tuple, list)) else [out]
-    loss = None
-    for o in outs:
-        s = o.sum() if o.size > 1 else o
-        loss = s if loss is None else loss + s
-    loss.backward()
-    tape_grads = [t.grad.numpy() if t.grad is not None else np.zeros_like(v) for t, v in zip(ts, vals)]
-
-    # jax.grad oracle over raw values through the same op_fn
-    def pure(*raw):
-        ts2 = [paddle.to_tensor(r) for r in raw]
-        with paddle.no_grad():
-            o = op_fn(*ts2, **kwargs)
-        os_ = o if isinstance(o, (tuple, list)) else [o]
-        acc = 0.0
-        for oo in os_:
-            acc = acc + jnp.sum(oo._value)
-        return acc
-
-    oracle = jax.grad(pure, argnums=tuple(range(len(vals))))(*[jnp.asarray(v) for v in vals])
-    for name, got, want in zip(names, tape_grads, oracle):
-        np.testing.assert_allclose(got, np.asarray(want), rtol=rtol, atol=atol, err_msg=f"grad({name}) of {op_fn}")
-
-
-def check_grad_bf16(op_fn, inputs, kwargs=None, rtol=6e-2, atol=6e-2):
-    """bf16 gradient check (the training dtype): the eager tape runs with
-    bfloat16 inputs; the oracle is jax.grad of the same computation in f32.
-    Tolerances are bf16-scale (reference: test/white_list/
-    op_accuracy_white_list.py loosens per-op in the same way)."""
-    import jax
-    import jax.numpy as jnp
-    import ml_dtypes
-
-    kwargs = kwargs or {}
-    names = list(inputs.keys())
-    vals = [np.asarray(v, dtype=np.float32) for v in inputs.values()]
-
-    ts = [paddle.to_tensor(v.astype(ml_dtypes.bfloat16)) for v in vals]
+    # eager tape path (optionally in a low-precision dtype)
+    ts = [paddle.to_tensor(v if input_dtype is None else v.astype(input_dtype))
+          for v in vals]
     for t in ts:
         t.stop_gradient = False
     out = op_fn(*ts, **kwargs)
@@ -99,6 +62,7 @@ def check_grad_bf16(op_fn, inputs, kwargs=None, rtol=6e-2, atol=6e-2):
         for t, v in zip(ts, vals)
     ]
 
+    # jax.grad oracle over raw values through the same op_fn
     def pure(*raw):
         ts2 = [paddle.to_tensor(r) for r in raw]
         with paddle.no_grad():
@@ -109,9 +73,18 @@ def check_grad_bf16(op_fn, inputs, kwargs=None, rtol=6e-2, atol=6e-2):
             acc = acc + jnp.sum(oo._value)
         return acc
 
-    oracle = jax.grad(pure, argnums=tuple(range(len(vals))))(
-        *[jnp.asarray(v) for v in vals])
+    oracle = jax.grad(pure, argnums=tuple(range(len(vals))))(*[jnp.asarray(v) for v in vals])
     for name, got, want in zip(names, tape_grads, oracle):
         np.testing.assert_allclose(
             got, np.asarray(want, dtype=np.float32), rtol=rtol, atol=atol,
-            err_msg=f"bf16 grad({name}) of {op_fn}")
+            err_msg=f"grad({name}) of {op_fn}")
+
+
+def check_grad_bf16(op_fn, inputs, kwargs=None, rtol=6e-2, atol=6e-2):
+    """bf16 gradient check (the training dtype): thin wrapper over
+    check_grad with the tape in bfloat16 and bf16-scale tolerances
+    (reference: test/white_list/op_accuracy_white_list.py pattern)."""
+    import ml_dtypes
+
+    check_grad(op_fn, inputs, kwargs, rtol=rtol, atol=atol,
+               input_dtype=ml_dtypes.bfloat16)
