@@ -30,14 +30,26 @@ from jax.experimental.pallas import tpu as pltpu
 # must be multiples of this. Actual block sizes are picked per call by
 # _pick_block — measured on TPU v5 lite, 512x512 blocks run the S=4096
 # fwd+bwd ~5x faster than 128x128 (6.0 vs 32.7 ms; loop/revisit overhead
-# dominates small blocks), so use the largest divisor <= 512.
+# dominates small blocks). At head_dim 128 the tiles are MXU-full-width
+# and 1024x1024 is another ~10% faster (3.2 -> 2.85 ms measured); at
+# head_dim 64 the 1024 tiling exceeds the 16MB VMEM stack, so the cap is
+# head-dim-conditional (_block_cap: exactly 128 gets the wide tiles).
 _MIN_BLOCK = 128
 _MAX_BLOCK_Q = 512
 _MAX_BLOCK_K = 512
+_MAX_BLOCK_WIDE = 1024  # head_dim == 128 exactly (the validated point)
+
+
+def _block_cap(d, base):
+    """1024 tiles only at head_dim 128 — the configuration measured to fit
+    VMEM and run ~10% faster; d=64 at 1024 overflows the 16MB VMEM stack
+    and d in (128, 256] is unvalidated (usable() admits it), so both keep
+    the 512 cap and larger heads never compile-fail without a fallback."""
+    return _MAX_BLOCK_WIDE if d == 128 else base
 
 
 def _pick_block(s, cap):
-    for b in (512, 384, 256, 128):
+    for b in (1024, 512, 384, 256, 128):
         if b <= cap and s % b == 0:
             return b
     return _MIN_BLOCK
@@ -66,7 +78,11 @@ def _dot_tn(a, b):
 
 # Auto-dispatch threshold: below this kv length the XLA-fused plain-softmax
 # chain WINS — measured on TPU v5 lite with the r4 tuned kernel (bf16 MXU
-# inputs + 512x512 blocks; benchmarks/attn_crossover.py, fwd+bwd, random
+# inputs + 512x512 blocks at head_dim 64; head_dim 128 additionally runs
+# 1024x1024 tiles above S=1024, measured ~10% faster than its 512 config —
+# the gate itself was derived at d=64, the conservative point, since flash
+# only gets FASTER with the wide tiling; benchmarks/attn_crossover.py,
+# fwd+bwd, random
 # cotangents, tokens held constant at B*S=8192): S=128: xla 0.65ms vs
 # flash 1.69; S=256: 1.10 vs 1.88; S=512: 2.10 vs 1.64; S=1024: 3.93 vs
 # 2.69; S=4096: 22.6 vs 4-6. Explicit flash_attention()/
@@ -231,8 +247,8 @@ def _flash_fwd_impl(q, k, v, causal, sm_scale):
     qr = jnp.swapaxes(q, 1, 2).reshape(b * h, sq, d)
     kr = jnp.swapaxes(k, 1, 2).reshape(b * h, sk, d)
     vr = jnp.swapaxes(v, 1, 2).reshape(b * h, sk, d)
-    bq = _pick_block(sq, _MAX_BLOCK_Q)
-    bk = _pick_block(sk, _MAX_BLOCK_K)
+    bq = _pick_block(sq, _block_cap(d, _MAX_BLOCK_Q))
+    bk = _pick_block(sk, _block_cap(d, _MAX_BLOCK_K))
     n_q = sq // bq
 
     out, lse = pl.pallas_call(
@@ -358,8 +374,8 @@ def _flash_bwd_impl(q, k, v, out, lse, g, causal, sm_scale):
         gr.astype(jnp.float32) * orr.astype(jnp.float32), axis=-1, keepdims=True
     )
 
-    bq = _pick_block(sq, _MAX_BLOCK_Q)
-    bk = _pick_block(sk, _MAX_BLOCK_K)
+    bq = _pick_block(sq, _block_cap(d, _MAX_BLOCK_Q))
+    bk = _pick_block(sk, _block_cap(d, _MAX_BLOCK_K))
     n_q, n_k = sq // bq, sk // bk
     dq = pl.pallas_call(
         _bwd_dq_kernel(sq, sk, d, causal, scale, bq, bk),
@@ -378,8 +394,13 @@ def _flash_bwd_impl(q, k, v, out, lse, g, causal, sm_scale):
         interpret=_INTERPRET,
     )(qr, kr, vr, gr, lse, delta)
 
+    # dkdv holds the WHOLE q/do streams VMEM-resident on top of its tiles —
+    # at 1024-wide tiles that overflows the 16MB VMEM stack inside fused
+    # programs, so its q-loop tile caps at 512 (the k tile keeps the wide
+    # pick; measured: fwd/dq at 1024 + dkdv q-tile 512 retains the win)
+    bq_kv = min(bq, _MAX_BLOCK_Q)
     dk, dv = pl.pallas_call(
-        _bwd_dkdv_kernel(sq, sk, d, causal, scale, bq, bk),
+        _bwd_dkdv_kernel(sq, sk, d, causal, scale, bq_kv, bk),
         grid=(b * h, n_k),
         in_specs=[
             pl.BlockSpec((None, sq, d), lambda bh, ki: (bh, 0, 0)),
