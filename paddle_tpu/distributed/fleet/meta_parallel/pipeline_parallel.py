@@ -98,9 +98,9 @@ class PipelineParallel(Layer):
             self._spmd = layers.uniform_stages()
             # r4: non-uniform stages (embedding-first / LM-head-last) also
             # compile — flat-padded param superstructure + lax.switch over
-            # stage bodies (spmd_pipeline.pipeline_spmd_hetero). VPP with
-            # non-uniform chunks stays on the eager engine.
-            self._spmd_hetero = (not self._spmd) and self._v == 1
+            # stage bodies (spmd_pipeline.pipeline_spmd_hetero /
+            # _hetero_interleave for VPP).
+            self._spmd_hetero = not self._spmd
             if self._spmd_hetero:
                 self._spmd = True
 
@@ -190,23 +190,36 @@ class PipelineParallel(Layer):
 
     # ---- non-uniform (hetero) compiled schedule ----
     def _gather_stacked_hetero(self):
-        from jax.flatten_util import ravel_pytree
         from .spmd_pipeline import stack_stage_params_hetero
 
+        # ROW ORDER: row d*v + c = global chunk c*pp + d (round-robin, the
+        # same convention as _gather_stacked) so shard_map's per-device
+        # slice [d*v:(d+1)*v] holds rank d's chunks with local index c
+        pp, v = self._pp_world, self._v
+        row_chunks = [c * pp + d for d in range(pp) for c in range(v)]
         trees = [
             {n: t._value for n, t in self._layers.stage_module(k).state_dict().items()}
-            for k in range(self._pp_world)
+            for k in row_chunks
         ]
-        stacked, unravels, sizes = stack_stage_params_hetero(trees, self._pp_mesh)
-        self._hetero_unravels = unravels
-        self._hetero_sizes = sizes
+        stacked, unravels_rows, sizes_rows = stack_stage_params_hetero(trees, self._pp_mesh)
+        # re-index unravels/sizes by GLOBAL chunk id
+        self._hetero_unravels = {}
+        self._hetero_sizes = {}
+        self._hetero_rows = {}
+        for row, k in enumerate(row_chunks):
+            self._hetero_unravels[k] = unravels_rows[row]
+            self._hetero_sizes[k] = sizes_rows[row]
+            self._hetero_rows[k] = row
         return stacked
 
     def _build_train_fn_hetero(self, sample_mb):
         from ....jit.api import functional_call
-        from .spmd_pipeline import pipeline_spmd_hetero
+        from .spmd_pipeline import (
+            pipeline_spmd_hetero,
+            pipeline_spmd_hetero_interleave,
+        )
 
-        S = self._pp_world
+        S = self._pp_world * self._v  # total chunks
         mods = [self._layers.stage_module(k) for k in range(S)]
         loss_fn_user = self._layers._loss_fn
         # eager probe: inter-stage activation + final output shapes (the
@@ -214,7 +227,8 @@ class PipelineParallel(Layer):
         x = Tensor(sample_mb)
         acts = []
         for k, m in enumerate(mods):
-            x = _to_device(x, self._stage_device(k))  # probe hops the ring too
+            # probe hops the ring too (chunk k lives on rank k % pp)
+            x = _to_device(x, self._stage_device(k))
             x = m(x)
             acts.append(x)
         mids = acts[:-1]
@@ -249,8 +263,13 @@ class PipelineParallel(Layer):
         # only the hidden state rides the ring; the vocab-sized "out" slot
         # is collected from ys, so shipping it every hop would multiply ICI
         # traffic by ~V/D
-        run = pipeline_spmd_hetero([make_fn(k) for k in range(S)],
-                                   self._pp_mesh, carry_shift_keys=("h",))
+        fns = [make_fn(k) for k in range(S)]
+        if self._v > 1:
+            run = pipeline_spmd_hetero_interleave(
+                fns, self._pp_mesh, self._v, carry_shift_keys=("h",))
+        else:
+            run = pipeline_spmd_hetero(fns, self._pp_mesh,
+                                       carry_shift_keys=("h",))
 
         from ....framework import random as random_mod
 
@@ -290,8 +309,9 @@ class PipelineParallel(Layer):
             if scaler is not None:
                 scale = scaler._scale._value if hasattr(scaler, "_scale") else 1.0
                 gflat = gflat * scale
-            for k in range(self._pp_world):
-                gtree = self._hetero_unravels[k](gflat[k, : self._hetero_sizes[k]])
+            for k in range(self._layers.num_chunks):
+                row = self._hetero_rows[k]
+                gtree = self._hetero_unravels[k](gflat[row, : self._hetero_sizes[k]])
                 dev = self._stage_device(k)
                 for name, t in self._layers.stage_module(k).state_dict().items():
                     if t.stop_gradient:

@@ -42,6 +42,37 @@ def _tree_index(tree, i):
     return jax.tree_util.tree_map(lambda x: x[i], tree)
 
 
+def _shift_carry(y, axis, fwd_perm, carry_shift_keys):
+    """Hand the carry to the next stage: ppermute every leaf, or — when
+    carry_shift_keys names a subset of a dict carry — only those keys
+    (others reset to zeros so e.g. a vocab-sized output slot never rides
+    the ring; it is collected from the scan ys instead)."""
+    if carry_shift_keys is not None and isinstance(y, dict):
+        return {
+            key: (
+                jax.tree_util.tree_map(
+                    lambda l: jax.lax.ppermute(l, axis, fwd_perm), val
+                )
+                if key in carry_shift_keys
+                else jax.tree_util.tree_map(jnp.zeros_like, val)
+            )
+            for key, val in y.items()
+        }
+    return jax.tree_util.tree_map(
+        lambda l: jax.lax.ppermute(l, axis, fwd_perm), y
+    )
+
+
+def _interleave_finish(M, pp, v):
+    """Time step at which micro-batch m finishes the last chunk on rank
+    pp-1 under the group-synchronous circular schedule (static schedule ->
+    static gather indices)."""
+    S_total = v * pp
+    return jnp.asarray(
+        [(m // pp) * pp * v + m % pp + S_total - 1 for m in range(M)]
+    )
+
+
 def pipeline_spmd(stage_fn: Callable, mesh: Mesh, axis: str = "pp", checkpoint_stages: bool = True,
                   data_axis: str = None, param_specs=None):
     """Build fn(stacked_params, microbatches) -> outputs.
@@ -189,9 +220,7 @@ def pipeline_spmd_interleave(
         ys = sharded(stacked_params, microbatches)  # [pp, T, ...]
         # micro-batch m finishes chunk S_total-1 on rank pp-1 at
         # t = t_ingest(m) + S_total - 1 (static schedule -> static gather)
-        finish = jnp.asarray(
-            [(m // pp) * pp * v + m % pp + S_total - 1 for m in range(M)]
-        )
+        finish = _interleave_finish(M, pp, v)
         return jax.tree_util.tree_map(lambda l: l[pp - 1, finish], ys)
 
     return run
@@ -261,22 +290,7 @@ def pipeline_spmd_hetero(stage_fns, mesh: Mesh, axis: str = "pp",
             m = jnp.clip(t - sidx, 0, M - 1)
             feed = _tree_index(feeds, m)
             y = jax.lax.switch(sidx, fns, p, carry, feed)
-            if carry_shift_keys is not None and isinstance(y, dict):
-                shifted = {
-                    key: (
-                        jax.tree_util.tree_map(
-                            lambda l: jax.lax.ppermute(l, axis, fwd_perm), val
-                        )
-                        if key in carry_shift_keys
-                        else jax.tree_util.tree_map(jnp.zeros_like, val)
-                    )
-                    for key, val in y.items()
-                }
-            else:
-                shifted = jax.tree_util.tree_map(
-                    lambda l: jax.lax.ppermute(l, axis, fwd_perm), y
-                )
-            return shifted, y
+            return _shift_carry(y, axis, fwd_perm, carry_shift_keys), y
 
         # carry template: zeros with the structure stage 0 emits
         init = _hetero_init(fns[0], p, _tree_index(feeds, 0))
@@ -323,16 +337,27 @@ def stack_stage_params_hetero(param_trees, mesh: Mesh, axis: str = "pp"):
         sizes.append(int(f.shape[0]))
     pmax = max(sizes)
     # per-stage params live on their own pp rank's device (the engine's
-    # placement) — pad each row in place and assemble the sharded stack
-    # zero-copy, like _gather_stacked does for uniform stages
+    # placement; with v chunks/rank the caller orders rows rank-major so a
+    # rank's rows are contiguous) — pad + stack each rank's group on ITS
+    # device and assemble the sharded stack zero-copy, like _gather_stacked
+    # does for uniform stages
     rows = [
         (jnp.pad(f, (0, pmax - s)) if s < pmax else f).reshape(1, pmax)
         for f, s in zip(flats, sizes)
     ]
+    n_rows = len(rows)
+    pp = mesh.shape[axis]
     sharding = NamedSharding(mesh, P(axis, None))
     try:
+        if n_rows % pp != 0:
+            raise ValueError("rows not evenly groupable over the mesh axis")
+        g = n_rows // pp
+        shards = [
+            jnp.concatenate(rows[d * g:(d + 1) * g], axis=0) if g > 1 else rows[d * g]
+            for d in range(pp)
+        ]
         stacked = jax.make_array_from_single_device_arrays(
-            (len(rows), pmax), sharding, rows
+            (n_rows, pmax), sharding, shards
         )
     except ValueError:
         # rows not pre-placed on their mesh devices (caller-built trees on
@@ -345,3 +370,70 @@ def stack_stage_params_hetero(param_trees, mesh: Mesh, axis: str = "pp"):
             sharding,
         )
     return stacked, unravels, sizes
+
+
+def pipeline_spmd_hetero_interleave(stage_fns, mesh: Mesh, num_virtual_stages,
+                                    axis: str = "pp",
+                                    checkpoint_stages: bool = True,
+                                    carry_shift_keys=None):
+    """VPP circular schedule for NON-uniform chunks: the interleave timing
+    of pipeline_spmd_interleave (v chunks per rank round-robin, bubble /v)
+    with the flat-padded superstructure + lax.switch bodies of
+    pipeline_spmd_hetero. stacked_flat rows are in ROUND-ROBIN order (row
+    d*v + c = global chunk c*pp + d, matching stack_stage_params_hetero
+    applied per-rank); the switch selects the GLOBAL chunk function
+    k = c*pp + d at each step.
+
+    stage_fns[k](flat_local, carry, feed) -> carry'; k in [0, v*pp).
+    """
+    pp = mesh.shape[axis]
+    v = num_virtual_stages
+    S_total = v * pp
+    assert len(stage_fns) == S_total, (len(stage_fns), S_total)
+    fns = [jax.checkpoint(f) if checkpoint_stages else f for f in stage_fns]
+
+    def per_device(flat_params, feeds):
+        # flat_params: [v, Pmax] this rank's chunks (round-robin rows)
+        sidx = jax.lax.axis_index(axis)
+        M = jax.tree_util.tree_leaves(feeds)[0].shape[0]
+        fwd_perm = [(s, (s + 1) % pp) for s in range(pp)]
+        T = M * v + pp - 1
+
+        def step(carry, t):
+            # same timing as pipeline_spmd_interleave: local wrap c and the
+            # micro-batch group feed index
+            c = jnp.clip((t - sidx) // pp, 0, None) % v
+            g = t // (pp * v)
+            feed_idx = jnp.clip(
+                g * pp + jnp.minimum(t % (pp * v), pp - 1), 0, M - 1)
+            feed = _tree_index(feeds, feed_idx)
+            local = flat_params[c]
+            k = c * pp + sidx  # global chunk id -> stage function
+            # chunk 0 ignores its carry and consumes the feed; other chunks
+            # read the carry — both behaviors live INSIDE the stage fns
+            # (k == 0 reads feed), so no _tree_where blend is needed here
+            y = jax.lax.switch(k, fns, local, carry, feed)
+            return _shift_carry(y, axis, fwd_perm, carry_shift_keys), y
+
+        init = _hetero_init(fns[0], flat_params[0], _tree_index(feeds, 0))
+        _, ys = jax.lax.scan(step, init, jnp.arange(T))
+        return jax.tree_util.tree_map(lambda l: l[None], ys)
+
+    sharded = jax.shard_map(
+        per_device, mesh=mesh, in_specs=(P(axis), P()), out_specs=P(axis),
+        check_vma=False,
+    )
+
+    def run(stacked_flat, feeds):
+        M = jax.tree_util.tree_leaves(feeds)[0].shape[0]
+        if M % pp != 0:
+            # NotImplementedError, not ValueError: the engine's demote-to-
+            # eager contract catches this and falls back
+            raise NotImplementedError(
+                f"interleaved pipeline needs micro-batches ({M}) divisible by pp ({pp})"
+            )
+        ys = sharded(stacked_flat, feeds)  # [pp, T, ...]
+        finish = _interleave_finish(M, pp, v)
+        return jax.tree_util.tree_map(lambda l: l[pp - 1, finish], ys)
+
+    return run
