@@ -132,8 +132,18 @@ def main():
 
     res_b = None
     if not skip_4096:
-        res_b = _build(batch=2, seq=4096, heads=6, max_pos=4096,
-                       steps=max(10, steps // 2))
+        # batch 3 fits the tunnel's HBM today (measured: MFU ~0.70 vs ~0.68
+        # at batch 2 — the fixed AdamW/copy costs amortize over 1.5x
+        # tokens), but headroom varies run to run on the shared tunnel, so
+        # fall back to batch 2 on OOM instead of failing the bench
+        for b4096 in (3, 2):
+            try:
+                res_b = _build(batch=b4096, seq=4096, heads=6, max_pos=4096,
+                               steps=max(10, steps // 2))
+                break
+            except Exception as e:  # jax RESOURCE_EXHAUSTED surfaces as RuntimeError
+                if b4096 == 2 or "RESOURCE_EXHAUSTED" not in str(e):
+                    raise
         peaks.append(_measured_peak_flops())
 
     def mfu(res, peak_pair):

@@ -63,6 +63,24 @@ def _shift_carry(y, axis, fwd_perm, carry_shift_keys):
     )
 
 
+def _wrap_index(t, sidx, pp, v):
+    """Local chunk wrap c at time t on rank sidx (global chunk is
+    c*pp + sidx) under the group-synchronous circular schedule."""
+    return jnp.clip((t - sidx) // pp, 0, None) % v
+
+
+def _aligned_feed(t, j, pp, v, M):
+    """Index of the micro-batch sitting at global chunk j at time t:
+    micro-batch m enters chunk 0 at t_in = (m//pp)*pp*v + m%pp and reaches
+    chunk j at t_in + j, so m = ((t-j)//(pp*v))*pp + (t-j)%(pp*v) with the
+    remainder in [0, pp) during valid steps (clamped during fill/drain).
+    This is what lets ANY chunk read its own micro-batch's feed (labels in
+    the last chunk, ids in the first) — the hetero stage contract."""
+    tp = jnp.clip(t - j, 0, None)
+    g = tp // (pp * v)
+    return jnp.clip(g * pp + jnp.minimum(tp % (pp * v), pp - 1), 0, M - 1)
+
+
 def _interleave_finish(M, pp, v):
     """Time step at which micro-batch m finishes the last chunk on rank
     pp-1 under the group-synchronous circular schedule (static schedule ->
@@ -184,10 +202,8 @@ def pipeline_spmd_interleave(
             # the activation arriving at rank d at time t sits at global
             # chunk k = d + pp*c with local wrap c = ((t - d) // pp) mod v
             # (see t_ingest above: (t - t_ingest - d) / pp == c)
-            c = jnp.clip((t - sidx) // pp, 0, None) % v
-            g = t // (pp * v)
-            feed_idx = jnp.clip(g * pp + jnp.minimum(t % (pp * v), pp - 1), 0, M - 1)
-            feed = _tree_index(mbs, feed_idx)
+            c = _wrap_index(t, sidx, pp, v)
+            feed = _tree_index(mbs, _aligned_feed(t, 0, pp, v, M))
             # rank 0 ingests a fresh micro-batch while its wrap slot is 0
             ingest = (sidx == 0) & (c == 0)
             x = _tree_where(ingest, feed, buf)
@@ -400,15 +416,14 @@ def pipeline_spmd_hetero_interleave(stage_fns, mesh: Mesh, num_virtual_stages,
         T = M * v + pp - 1
 
         def step(carry, t):
-            # same timing as pipeline_spmd_interleave: local wrap c and the
-            # micro-batch group feed index
-            c = jnp.clip((t - sidx) // pp, 0, None) % v
-            g = t // (pp * v)
-            feed_idx = jnp.clip(
-                g * pp + jnp.minimum(t % (pp * v), pp - 1), 0, M - 1)
-            feed = _tree_index(feeds, feed_idx)
-            local = flat_params[c]
+            # same timing as pipeline_spmd_interleave, but the feed is
+            # aligned PER CHUNK: chunk j at time t reads ITS micro-batch's
+            # feed element (t - j timing inversion in _aligned_feed), so
+            # later chunks may read labels just like pipeline_spmd_hetero
+            c = _wrap_index(t, sidx, pp, v)
             k = c * pp + sidx  # global chunk id -> stage function
+            feed = _tree_index(feeds, _aligned_feed(t, k, pp, v, M))
+            local = flat_params[c]
             # chunk 0 ignores its carry and consumes the feed; other chunks
             # read the carry — both behaviors live INSIDE the stage fns
             # (k == 0 reads feed), so no _tree_where blend is needed here

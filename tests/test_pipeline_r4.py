@@ -173,3 +173,35 @@ def test_hetero_vpp_interleave_matches_single():
 
     loss2 = engine.train_batch((paddle.to_tensor(ids), paddle.to_tensor(labels)), opt)
     assert float(loss2) < float(loss)
+
+
+def test_hetero_vpp_feed_alignment():
+    """Every chunk reads ITS micro-batch's feed element under the
+    interleave schedule: the last chunk echoes the feed, and the pipeline
+    output must equal the input micro-batches in order."""
+    from paddle_tpu.distributed.fleet.meta_parallel.spmd_pipeline import (
+        pipeline_spmd_hetero_interleave,
+    )
+
+    pp, v, M, B = 4, 2, 8, 2
+    mesh = Mesh(np.array(jax.devices()[:pp]), ("pp",))
+    S_total = pp * v
+
+    def make_fn(k):
+        def fn(flat, carry, feed):
+            if k == 0:
+                return {"h": feed, "out": jnp.zeros_like(feed)}
+            if k == S_total - 1:
+                # echo THIS chunk's aligned feed — only correct if the
+                # schedule hands chunk k its own micro-batch's element
+                return {"h": jnp.zeros_like(feed), "out": feed}
+            return {"h": carry["h"], "out": jnp.zeros_like(carry["h"])}
+        return fn
+
+    run = pipeline_spmd_hetero_interleave(
+        [make_fn(k) for k in range(S_total)], mesh, v,
+        checkpoint_stages=False, carry_shift_keys=("h",))
+    flat = jnp.zeros((S_total, 4))
+    feeds = jnp.arange(M * B, dtype=jnp.float32).reshape(M, B)
+    out = run(flat, feeds)["out"]
+    np.testing.assert_allclose(np.asarray(out), np.asarray(feeds))
