@@ -1,0 +1,92 @@
+"""Per-chunk cost of ring attention: Pallas-kernel path vs einsum path.
+
+Ring attention's wall-clock is (ring steps) x (per-chunk attention cost) —
+the ppermute neighbor exchange overlaps with compute. This bench measures
+the per-chunk cost at the operating point where sep is actually used
+(S_local = 4096, head_dim 128) by running the ring on a 1-device mesh
+(n=1: the causal diagonal chunk — the dominant chunk shape) on the real
+chip, slope-timed inside one compiled fori_loop chain.
+
+Run: python benchmarks/ring_flash_bench.py
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from paddle_tpu.ops import ring_attention as ra
+
+
+def bench(fn, q, k, v, w):
+    grad_fn = jax.grad(
+        lambda q, k, v: jnp.sum((fn(q, k, v) * w).astype(jnp.float32)),
+        argnums=(0, 1, 2),
+    )
+
+    @jax.jit
+    def chain(q, k, v, n):
+        def body(i, carry):
+            x, kk, vv = carry
+            dq, dk, dv = grad_fn(x, kk, vv)
+            eps = jnp.bfloat16(1e-8)
+            return (
+                x + dq.astype(x.dtype) * eps,
+                kk + dk.astype(kk.dtype) * eps,
+                vv + dv.astype(vv.dtype) * eps,
+            )
+        x, _, _ = jax.lax.fori_loop(0, n, body, (q, k, v))
+        return jnp.sum(x.astype(jnp.float32))
+
+    def run(n):
+        t0 = time.perf_counter()
+        float(chain(q, k, v, n))
+        return time.perf_counter() - t0
+
+    run(2)
+    t1, t2 = run(4), run(12)
+    return (t2 - t1) / 8
+
+
+def main():
+    mesh = Mesh(np.array(jax.devices()[:1]), ("sep",))
+    B, S, H, D = 1, 4096, 6, 128
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(B, S, H, D), jnp.bfloat16)
+    k = jnp.asarray(rng.randn(B, S, H, D), jnp.bfloat16)
+    v = jnp.asarray(rng.randn(B, S, H, D), jnp.bfloat16)
+    w = jnp.asarray(rng.randn(B, S, H, D), jnp.bfloat16)
+
+    t_flash = bench(
+        lambda q, k, v: ra.ring_attention(q, k, v, mesh=mesh, causal=True),
+        q, k, v, w,
+    )
+
+    # force the einsum path by raising the gate above the chunk size
+    import paddle_tpu.ops.pallas as pk
+
+    old_min = pk._FLASH_MIN_SK
+    pk._FLASH_MIN_SK = 1 << 30
+    jax.clear_caches()
+    try:
+        t_einsum = bench(
+            lambda q, k, v: ra.ring_attention(q, k, v, mesh=mesh, causal=True),
+            q, k, v, w,
+        )
+    finally:
+        pk._FLASH_MIN_SK = old_min
+
+    print(
+        f"per-chunk fwd+bwd @ S_local={S}, d={D}: "
+        f"flash {t_flash*1000:.2f} ms  einsum {t_einsum*1000:.2f} ms  "
+        f"-> {t_einsum/t_flash:.2f}x"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -54,10 +54,9 @@ class LlamaAttention(nn.Layer):
 
         qk = apply("rope", lambda qv, kv: _rope(qv, kv), q, k)
         q, k = qk
-        if self.num_kv_heads != self.num_heads:
-            rep = self.num_heads // self.num_kv_heads
-            k = manip.repeat_interleave(k, rep, axis=2)
-            v = manip.repeat_interleave(v, rep, axis=2)
+        # GQA: k/v go in at num_kv_heads — the flash kernel maps q-head
+        # groups to their kv head natively (no repeated-KV materialization;
+        # the dense fallback repeats inside the dispatched op)
         out = F.scaled_dot_product_attention(q, k, v, is_causal=True, training=self.training)
         out = manip.reshape(out, [b, s, self.num_heads * self.head_dim])
         return self.o_proj(out)

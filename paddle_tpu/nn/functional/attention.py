@@ -25,6 +25,12 @@ def _t(x):
 def _sdpa_ref(q, k, v, mask, causal, dropout_p, scale, training, key=None):
     """Canonical attention in bnsd layout with f32 softmax accumulation."""
     # [B, S, H, D] -> [B, H, S, D]
+    if k.shape[2] != q.shape[2]:  # GQA: the dense chain repeats kv heads
+        from ...ops.pallas import repeat_kv
+
+        rep = q.shape[2] // k.shape[2]
+        k = repeat_kv(k, rep)
+        v = repeat_kv(v, rep)
     q = jnp.swapaxes(q, 1, 2)
     k = jnp.swapaxes(k, 1, 2)
     v = jnp.swapaxes(v, 1, 2)
@@ -48,6 +54,20 @@ def _sdpa_ref(q, k, v, mask, causal, dropout_p, scale, training, key=None):
     return jnp.swapaxes(out, 1, 2)  # -> [B, S, H, D]
 
 
+def _env_int(name: str, default: int) -> int:
+    """Guarded env-int parse (same contract as pallas._FLASH_MIN_SK): a
+    malformed value warns and falls back instead of raising on every call."""
+    import os as _os
+
+    try:
+        return int(_os.environ.get(name, default))
+    except ValueError:
+        import warnings
+
+        warnings.warn(f"{name} is not an integer; using {default}")
+        return default
+
+
 def _sep_degree() -> int:
     """Context-parallel degree of the active hybrid topology (0 if none)."""
     try:
@@ -66,7 +86,15 @@ def scaled_dot_product_attention(
     shapes allow, else the XLA-fused reference chain. When the hybrid
     topology has sep_degree > 1 (context parallelism) and there is no mask or
     dropout, routes through the exact ring-attention kernel so the sequence
-    stays sharded over the sep axis."""
+    stays sharded over the sep axis.
+
+    Extensions over the reference signature (both mirror the reference's own
+    flash path, python/paddle/nn/functional/flash_attention.py:151 +
+    flash_attn_utils.h:140): key/value may carry FEWER heads than query
+    (GQA/MQA, h_kv | h_q — the kernel never materializes repeated KV; the
+    dense fallback repeats), and dropout_p > 0 runs IN-KERNEL on the flash
+    path via a stateless position-hash mask (identical semantics on the
+    fallback — same hash)."""
     q, k, v = _t(query), _t(key), _t(value)
     sep = _sep_degree()
     if (
@@ -98,11 +126,31 @@ def scaled_dot_product_attention(
         def f(qv, kv, vv, mv):
             return _sdpa_ref(qv, kv, vv, mv, is_causal, dropout_p, None, training, rng_key)
 
+        return apply("scaled_dot_product_attention", f, *args)
+
+    p_drop = float(dropout_p) if training else 0.0
+    if p_drop > 0.0:
+        # one int32 seed per call (fresh each step; trace-aware under
+        # to_static) drives the SAME position-hash dropout mask in the
+        # Pallas kernel and the XLA fallback — passed as an op ARG, not a
+        # closure, so the cached-linearization fast path stays warm
+        seed = jax.random.randint(rng_key, (), 0, 2**31 - 1, dtype=jnp.int32)
+        args.append(_t(seed))
+
+        def f(qv, kv, vv, seedv):
+            if pallas_ops.flash_attention_profitable(qv, is_causal, p_drop, kv, vv):
+                return pallas_ops.flash_attention_bshd(
+                    qv, kv, vv, causal=is_causal, dropout_p=p_drop, dropout_seed=seedv
+                )
+            return pallas_ops._ref_attention_bshd(
+                qv, kv, vv, is_causal, None, dropout_p=p_drop, seed=seedv
+            )
+
     else:
         def f(qv, kv, vv):
-            if pallas_ops.flash_attention_profitable(qv, is_causal, dropout_p if training else 0.0, kv, vv):
+            if pallas_ops.flash_attention_profitable(qv, is_causal, 0.0, kv, vv):
                 return pallas_ops.flash_attention_bshd(qv, kv, vv, causal=is_causal)
-            return _sdpa_ref(qv, kv, vv, None, is_causal, dropout_p, None, training, rng_key)
+            return pallas_ops._ref_attention_bshd(qv, kv, vv, is_causal, None)
 
     return apply("scaled_dot_product_attention", f, *args)
 
@@ -166,7 +214,7 @@ def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
 
     import os as _os
 
-    dense_max = int(_os.environ.get("PADDLE_TPU_SPARSE_ATTN_DENSE_MAX_SEQ", 2048))
+    dense_max = _env_int("PADDLE_TPU_SPARSE_ATTN_DENSE_MAX_SEQ", 2048)
     if int(query.shape[-2]) > dense_max:
         return apply(
             "sparse_attention_blocked",
@@ -211,10 +259,8 @@ def _sparse_attention_blocked(raw, has_kpm, has_am, block=None):
     Per scan step the live intermediates are the [S, block] block mask and
     logits — never the [S, S] matrix. Numerics match the dense path
     (f32 logits, softmax zeros on fully-masked rows)."""
-    import os as _os
-
     if block is None:
-        block = int(_os.environ.get("PADDLE_TPU_SPARSE_ATTN_BLOCK", 512))
+        block = _env_int("PADDLE_TPU_SPARSE_ATTN_BLOCK", 512)
     ri = iter(raw)
     q, k, v, offs, cols = (next(ri) for _ in range(5))
     kpm = next(ri) if has_kpm else None

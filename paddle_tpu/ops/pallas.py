@@ -14,6 +14,25 @@ kv is longer than q (flash-attn convention, matches the XLA reference
 chain below). The backward is the recompute-based O(S) flash backward:
 forward saves only (out, logsumexp); dq/dk/dv kernels recompute the
 probability tiles blockwise.
+
+Round 5 capabilities (reference bar:
+python/paddle/nn/functional/flash_attention.py:151 `dropout`,
+paddle/phi/kernels/gpu/flash_attn_utils.h:140 `num_heads_k`):
+
+- **Attention dropout in-kernel.** The keep/drop decision is a STATELESS
+  hash of (seed, q-head index, absolute q position, absolute k position)
+  — a murmur3-style integer mix computed on the VPU per logits tile. No
+  mask is ever materialized in HBM, and because the hash depends only on
+  absolute positions, the dq and dk/dv kernels regenerate the exact same
+  mask even though they tile the score matrix differently. Semantics are
+  upscale-in-train: kept probabilities are scaled by 1/(1-p); the softmax
+  normalizer (and the saved logsumexp) stay dropout-free, matching
+  dropout(softmax(s)) @ v.
+- **Native GQA/MQA.** k/v carry their own head count h_kv | h_q; the
+  kernel grids map each q head to its kv head via index arithmetic
+  (q head j reads kv head j // (h_q // h_kv) — the reference repeat_kv
+  ordering) so repeated K/V are never materialized. dk/dv accumulate
+  over the q heads of a group in-VMEM via a group-innermost grid axis.
 """
 from __future__ import annotations
 
@@ -22,6 +41,8 @@ import math
 import os
 
 import jax
+import numpy as np
+from jax import lax
 from jax import numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
@@ -99,10 +120,23 @@ except ValueError:
 # tests on the CPU mesh flip this to run kernels in pallas interpret mode
 _INTERPRET = False
 
+# The wide-tile (1024-block, d=128) configs need ~16.8MB of scoped VMEM —
+# just over the compiler's 16MB default budget (physical VMEM on v5e is
+# much larger); raise the per-kernel budget so the tuned tiles compile.
+_VMEM_LIMIT = 40 * 1024 * 1024
+
 # every grid axis is an independent (bh, block) tile — declaring them
 # parallel lets Mosaic pipeline HBM->VMEM copies across grid steps
 _COMPILER_PARAMS = pltpu.CompilerParams(
-    dimension_semantics=("parallel", "parallel")
+    dimension_semantics=("parallel", "parallel"),
+    vmem_limit_bytes=_VMEM_LIMIT,
+)
+# dkdv grid is (b*h_kv, n_k, group): the group axis REVISITS the same
+# dk/dv block on consecutive steps (in-VMEM accumulation), so it must be
+# sequential ("arbitrary"), not parallel
+_COMPILER_PARAMS_3D = pltpu.CompilerParams(
+    dimension_semantics=("parallel", "parallel", "arbitrary"),
+    vmem_limit_bytes=_VMEM_LIMIT,
 )
 
 
@@ -115,26 +149,101 @@ def _on_tpu() -> bool:
         return False
 
 
+# ---------------------------------------------------------------------------
+# dropout: stateless position hash (murmur3-style fmix32 on the VPU)
+# ---------------------------------------------------------------------------
+
+def _i32(x):
+    """uint32 literal -> the int32 with the same bit pattern."""
+    return np.uint32(x & 0xFFFFFFFF).astype(np.int32)
+
+
+_C_Q = _i32(0x9E3779B1)   # golden-ratio odd constants: distinct per input
+_C_K = _i32(0x85EBCA77)
+_C_BH = _i32(0x27D4EB2F)
+_C_M1 = _i32(0x85EBCA6B)  # murmur3 fmix32 multipliers
+_C_M2 = _i32(0xC2B2AE35)
+_DROP_BITS = 23           # dropout probability resolution: 2^-23
+
+
+def _keep_threshold(dropout_p: float) -> int:
+    return int(round((1.0 - float(dropout_p)) * (1 << _DROP_BITS)))
+
+
+def _hash_keep(seed, bh, qpos, kpos, thresh):
+    """keep-mask for absolute score positions (qpos, kpos) — both int32
+    arrays of the same shape — under (seed, q-head bh). Pure int32 VPU ops,
+    identical algebra in-kernel and in the jnp reference path, so every
+    tiling of the score matrix regenerates the same mask."""
+    _16 = np.int32(16)
+    _13 = np.int32(13)
+    u = (qpos * _C_Q) ^ (kpos * _C_K) ^ (seed + bh * _C_BH)
+    u = u ^ lax.shift_right_logical(u, _16)
+    u = u * _C_M1
+    u = u ^ lax.shift_right_logical(u, _13)
+    u = u * _C_M2
+    u = u ^ lax.shift_right_logical(u, _16)
+    return (u & _i32((1 << _DROP_BITS) - 1)) < np.int32(thresh)
+
+
+def _tile_keep(seed, bh, q0, k0, bq, bk, thresh):
+    """keep-mask for one (bq, bk) logits tile whose top-left score position
+    is (q0, k0)."""
+    qpos = q0 + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    kpos = k0 + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return _hash_keep(seed, bh, qpos, kpos, thresh)
+
+
+def dropout_keep_reference(seed, n_bh, sq, sk, dropout_p):
+    """[n_bh, sq, sk] bool keep-mask — the exact mask the kernels apply
+    (oracle for tests and for the XLA fallback path, which therefore has
+    bitwise-identical dropout semantics to the kernel)."""
+    thresh = _keep_threshold(dropout_p)
+    seed = jnp.asarray(seed, jnp.int32).reshape(())
+
+    def one(bh):
+        return _tile_keep(seed, bh, np.int32(0), np.int32(0), sq, sk, thresh)
+
+    return jax.vmap(one)(jnp.arange(n_bh, dtype=jnp.int32))
+
+
+def _as_seed(dropout_seed):
+    """Normalize the user seed to the (1,) int32 scalar-prefetch operand."""
+    if dropout_seed is None:
+        return jnp.zeros((1,), jnp.int32)
+    return jnp.asarray(dropout_seed, jnp.int32).reshape((1,))
+
+
+# ---------------------------------------------------------------------------
+# dispatch gates
+# ---------------------------------------------------------------------------
+
 def flash_attention_usable(q, causal, dropout_p, k=None, v=None) -> bool:
-    """Kernel constraints: TPU platform, no dropout, q seq and kv seq each a
-    multiple of the block, head_dim <= 256. Cross-attention / kv-cache
-    prefill (kv length != q length) is supported; only batch/heads/head_dim
-    must match. [B, S, H, D]."""
-    if dropout_p > 0.0:
-        return False
+    """Kernel constraints: TPU platform, q seq and kv seq each a multiple of
+    the block, head_dim <= 256. Cross-attention / kv-cache prefill (kv
+    length != q length) is supported; GQA/MQA is supported natively (kv
+    heads must divide q heads — reference flash_attn_utils.h:140
+    num_heads_k); dropout is supported in-kernel (reference
+    flash_attention.py:151). [B, S, H, D]."""
     if not _on_tpu():
+        return False
+    if not (0.0 <= dropout_p < 1.0):
         return False
     if q.ndim != 4:
         return False
     b, sq, h, d = q.shape
     if not (sq % _MIN_BLOCK == 0 and d <= 256 and sq >= _MIN_BLOCK):
         return False
+    kv_heads = set()
     for other in (k, v):
         if other is None:
             continue
         ob, sk, oh, od = other.shape
-        if (ob, oh, od) != (b, h, d):
+        if (ob, od) != (b, d):
             return False
+        if oh > h or h % oh != 0:
+            return False
+        kv_heads.add(int(oh))
         if not (sk % _MIN_BLOCK == 0 and sk >= _MIN_BLOCK):
             return False
         if causal and sk < sq:
@@ -142,6 +251,8 @@ def flash_attention_usable(q, causal, dropout_p, k=None, v=None) -> bool:
             # the leading q rows (0/0 in the kernel; the XLA chain's output
             # for those rows is garbage-by-construction too) — fall back
             return False
+    if len(kv_heads) > 1:  # k and v must agree on head count
+        return False
     return True
 
 
@@ -173,8 +284,14 @@ def _mask_boundary(logits, off, qi, ki, bq, bk):
     return jax.lax.cond(full, lambda l: l, apply, logits)
 
 
-def _ref_attention_bshd(q, k, v, causal, sm_scale):
-    """XLA reference chain (fallback + numerics oracle in tests)."""
+def _ref_attention_bshd(q, k, v, causal, sm_scale, dropout_p=0.0, seed=None):
+    """XLA reference chain (fallback + numerics oracle in tests). GQA kv is
+    repeated here (the fallback pays the HBM cost the kernel avoids); the
+    dropout mask is the SAME position hash the kernel applies."""
+    h, hkv = q.shape[2], k.shape[2]
+    if hkv != h:
+        k = repeat_kv(k, h // hkv)
+        v = repeat_kv(v, h // hkv)
     qh = jnp.swapaxes(q, 1, 2)
     kh = jnp.swapaxes(k, 1, 2)
     vh = jnp.swapaxes(v, 1, 2)
@@ -185,7 +302,13 @@ def _ref_attention_bshd(q, k, v, causal, sm_scale):
         ql, kl = logits.shape[-2], logits.shape[-1]
         cm = jnp.tril(jnp.ones((ql, kl), bool), k=kl - ql)
         logits = jnp.where(cm, logits, -1e30)
-    p = jax.nn.softmax(logits, axis=-1).astype(qh.dtype)
+    p = jax.nn.softmax(logits, axis=-1)
+    if dropout_p > 0.0:
+        b, _, sq, sk = logits.shape
+        keep = dropout_keep_reference(seed, b * h, sq, sk, dropout_p)
+        keep = keep.reshape(b, h, sq, sk)
+        p = jnp.where(keep, p / (1.0 - dropout_p), 0.0)
+    p = p.astype(qh.dtype)
     out = jnp.einsum("bhqk,bhkd->bhqd", p, vh)
     return jnp.swapaxes(out, 1, 2)
 
@@ -194,12 +317,17 @@ def _ref_attention_bshd(q, k, v, causal, sm_scale):
 # forward kernel: online softmax over K blocks, emits out + logsumexp
 # ---------------------------------------------------------------------------
 
-def _fwd_kernels(sq, sk, d, causal, scale, bq, bk):
+def _fwd_kernels(sq, sk, d, causal, scale, bq, bk, dropout_p):
     n_k = sk // bk
     off = sk - sq  # causal bottom-right alignment offset
+    use_drop = dropout_p > 0.0
+    thresh = _keep_threshold(dropout_p)
+    inv_keep = np.float32(1.0 / (1.0 - dropout_p)) if use_drop else None
 
-    def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref):
+    def kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref):
+        bh = pl.program_id(0)
         qi = pl.program_id(1)
+        seed = seed_ref[0]
         qb = q_ref[...]  # storage dtype — bf16 in, MXU at bf16 rate
 
         m0 = jnp.full((bq, 1), -1e30, jnp.float32)
@@ -224,10 +352,17 @@ def _fwd_kernels(sq, sk, d, causal, scale, bq, bk):
             m_new = jnp.maximum(m, jnp.max(logits, axis=-1, keepdims=True))
             p = jnp.exp(logits - m_new)
             alpha = jnp.exp(m - m_new)
+            # the softmax normalizer is dropout-free (dropout applies to the
+            # normalized probabilities) — l accumulates the full p sum
             l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+            if use_drop:
+                keep = _tile_keep(seed, bh, qi * bq, ki * bk, bq, bk, thresh)
+                p_acc = jnp.where(keep, p, 0.0) * inv_keep
+            else:
+                p_acc = p
             # p cast to the storage dtype before the MXU matmul — the same
             # precision the XLA fallback uses (softmax.astype(q.dtype) @ v)
-            acc_new = acc * alpha + _dot_nn(p.astype(vb.dtype), vb)
+            acc_new = acc * alpha + _dot_nn(p_acc.astype(vb.dtype), vb)
             return m_new, l_new, acc_new
 
         m, l, acc = jax.lax.fori_loop(
@@ -239,37 +374,54 @@ def _fwd_kernels(sq, sk, d, causal, scale, bq, bk):
     return kernel
 
 
-def _flash_fwd_impl(q, k, v, causal, sm_scale):
-    """[B, S, H, D] -> (out, lse[B*H, Sq, 1])."""
+def _flash_fwd_impl(q, k, v, seed, causal, sm_scale, dropout_p):
+    """[B, S, H, D] -> (out, lse[B*Hq, Sq, 1]). k/v may carry fewer heads
+    (GQA): q head j reads kv head j // group."""
     b, sq, h, d = q.shape
-    sk = k.shape[1]
+    sk, hkv = k.shape[1], k.shape[2]
+    group = h // hkv
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
     qr = jnp.swapaxes(q, 1, 2).reshape(b * h, sq, d)
-    kr = jnp.swapaxes(k, 1, 2).reshape(b * h, sk, d)
-    vr = jnp.swapaxes(v, 1, 2).reshape(b * h, sk, d)
+    kr = jnp.swapaxes(k, 1, 2).reshape(b * hkv, sk, d)
+    vr = jnp.swapaxes(v, 1, 2).reshape(b * hkv, sk, d)
     bq = _pick_block(sq, _block_cap(d, _MAX_BLOCK_Q))
     bk = _pick_block(sk, _block_cap(d, _MAX_BLOCK_K))
     n_q = sq // bq
 
-    out, lse = pl.pallas_call(
-        _fwd_kernels(sq, sk, d, causal, scale, bq, bk),
+    # group == 1 keeps the identity index map — the kv_of arithmetic is
+    # algebraically bh there, and spelling it plainly preserves the r4
+    # kernel's exact VMEM footprint (the tuned wide-tile configs sit within
+    # ~2% of the 16MB scoped-vmem budget)
+    if group == 1:
+        kv_of = lambda bh: bh
+    else:
+        def kv_of(bh):
+            # q-head grid index -> kv-head row of kr/vr
+            return (bh // h) * hkv + (bh % h) // group
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
         grid=(b * h, n_q),
         in_specs=[
-            pl.BlockSpec((None, bq, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((None, sk, d), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((None, sk, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((None, bq, d), lambda bh, qi, *_: (bh, qi, 0)),
+            pl.BlockSpec((None, sk, d), lambda bh, qi, *_: (kv_of(bh), 0, 0)),
+            pl.BlockSpec((None, sk, d), lambda bh, qi, *_: (kv_of(bh), 0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((None, bq, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((None, bq, 1), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((None, bq, d), lambda bh, qi, *_: (bh, qi, 0)),
+            pl.BlockSpec((None, bq, 1), lambda bh, qi, *_: (bh, qi, 0)),
         ],
+    )
+    out, lse = pl.pallas_call(
+        _fwd_kernels(sq, sk, d, causal, scale, bq, bk, dropout_p),
+        grid_spec=grid_spec,
         out_shape=[
             jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
             jax.ShapeDtypeStruct((b * h, sq, 1), jnp.float32),
         ],
         compiler_params=_COMPILER_PARAMS,
         interpret=_INTERPRET,
-    )(qr, kr, vr)
+    )(seed, qr, kr, vr)
     return jnp.swapaxes(out.reshape(b, h, sq, d), 1, 2), lse
 
 
@@ -277,12 +429,17 @@ def _flash_fwd_impl(q, k, v, causal, sm_scale):
 # backward kernels: recompute-based (O(S) memory), FA2 formulation
 # ---------------------------------------------------------------------------
 
-def _bwd_dq_kernel(sq, sk, d, causal, scale, bq, bk):
+def _bwd_dq_kernel(sq, sk, d, causal, scale, bq, bk, dropout_p):
     n_k = sk // bk
     off = sk - sq
+    use_drop = dropout_p > 0.0
+    thresh = _keep_threshold(dropout_p)
+    inv_keep = np.float32(1.0 / (1.0 - dropout_p)) if use_drop else None
 
-    def kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref):
+    def kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref):
+        bh = pl.program_id(0)
         qi = pl.program_id(1)
+        seed = seed_ref[0]
         qb = q_ref[...]
         dob = do_ref[...]
         lse = lse_ref[...].astype(jnp.float32)      # [BQ, 1]
@@ -302,7 +459,10 @@ def _bwd_dq_kernel(sq, sk, d, causal, scale, bq, bk):
             if causal:
                 s = _mask_boundary(s, off, qi, ki, bq, bk)
             p = jnp.exp(s - lse)
-            dp = _dot_nt(dob, vb)
+            dp = _dot_nt(dob, vb)  # = d(dropped P) for the dropout case
+            if use_drop:
+                keep = _tile_keep(seed, bh, qi * bq, ki * bk, bq, bk, thresh)
+                dp = jnp.where(keep, dp, 0.0) * inv_keep
             ds = p * (dp - delta) * scale
             return dq + _dot_nn(ds.astype(kb.dtype), kb)
 
@@ -314,12 +474,22 @@ def _bwd_dq_kernel(sq, sk, d, causal, scale, bq, bk):
     return kernel
 
 
-def _bwd_dkdv_kernel(sq, sk, d, causal, scale, bq, bk):
+def _bwd_dkdv_kernel(sq, sk, d, causal, scale, bq, bk, dropout_p, h, hkv):
     n_q = sq // bq
     off = sk - sq
+    group = h // hkv
+    use_drop = dropout_p > 0.0
+    thresh = _keep_threshold(dropout_p)
+    inv_keep = np.float32(1.0 / (1.0 - dropout_p)) if use_drop else None
 
-    def kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref):
+    def kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref):
+        kv = pl.program_id(0)
         ki = pl.program_id(1)
+        gi = pl.program_id(2)
+        seed = seed_ref[0]
+        # the q-head identity of this grid step (drives the dropout hash —
+        # it must match the bh the fwd/dq kernels hashed with)
+        bh_q = (kv // hkv) * h + (kv % hkv) * group + gi
         kb = k_ref[...]
         vb = v_ref[...]
 
@@ -342,8 +512,16 @@ def _bwd_dkdv_kernel(sq, sk, d, causal, scale, bq, bk):
             if causal:
                 s = _mask_boundary(s, off, qi, ki, bq, bk)
             p = jnp.exp(s - lse)
-            dv2 = dv + _dot_tn(p.astype(dob.dtype), dob)
+            if use_drop:
+                keep = _tile_keep(seed, bh_q, qi * bq, ki * bk, bq, bk, thresh)
+                z = jnp.where(keep, inv_keep, 0.0)
+                pd = p * z
+            else:
+                pd = p
+            dv2 = dv + _dot_tn(pd.astype(dob.dtype), dob)
             dp = _dot_nt(dob, vb)
+            if use_drop:
+                dp = dp * z
             ds = p * (dp - delta) * scale
             dk2 = dk + _dot_tn(ds.astype(qb.dtype), qb)
             return dk2, dv2
@@ -354,110 +532,207 @@ def _bwd_dkdv_kernel(sq, sk, d, causal, scale, bq, bk):
             body,
             (jnp.zeros((bk, d), jnp.float32), jnp.zeros((bk, d), jnp.float32)),
         )
-        dk_ref[...] = dk.astype(dk_ref.dtype)
-        dv_ref[...] = dv.astype(dv_ref.dtype)
+        if group > 1:
+            # accumulate over the q heads of this kv group: the (kv, ki)
+            # output block stays VMEM-resident across consecutive gi steps
+            # (grid axis 2 is sequential)
+            @pl.when(gi == 0)
+            def _init():
+                dk_ref[...] = jnp.zeros_like(dk_ref)
+                dv_ref[...] = jnp.zeros_like(dv_ref)
+
+            dk_ref[...] += dk.astype(dk_ref.dtype)
+            dv_ref[...] += dv.astype(dv_ref.dtype)
+        else:
+            dk_ref[...] = dk.astype(dk_ref.dtype)
+            dv_ref[...] = dv.astype(dv_ref.dtype)
 
     return kernel
 
 
-def _flash_bwd_impl(q, k, v, out, lse, g, causal, sm_scale):
+def _flash_bwd_impl(q, k, v, out, lse, g, g_lse, seed, causal, sm_scale, dropout_p):
     b, sq, h, d = q.shape
-    sk = k.shape[1]
+    sk, hkv = k.shape[1], k.shape[2]
+    group = h // hkv
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
     qr = jnp.swapaxes(q, 1, 2).reshape(b * h, sq, d)
-    kr = jnp.swapaxes(k, 1, 2).reshape(b * h, sk, d)
-    vr = jnp.swapaxes(v, 1, 2).reshape(b * h, sk, d)
+    kr = jnp.swapaxes(k, 1, 2).reshape(b * hkv, sk, d)
+    vr = jnp.swapaxes(v, 1, 2).reshape(b * hkv, sk, d)
     orr = jnp.swapaxes(out, 1, 2).reshape(b * h, sq, d)
     gr = jnp.swapaxes(g, 1, 2).reshape(b * h, sq, d)
-    # delta_i = rowsum(dO * O) — cheap, XLA-fused
+    # delta_i = rowsum(dO * O) — cheap, XLA-fused. The lse output's
+    # cotangent folds in exactly here: d lse_i has score-gradient
+    # g_lse_i * P_ij, i.e. ds = p * (zdp - (delta - g_lse)) — so delta
+    # simply absorbs -g_lse and the kernels stay unchanged.
     delta = jnp.sum(
         gr.astype(jnp.float32) * orr.astype(jnp.float32), axis=-1, keepdims=True
     )
+    if g_lse is not None:
+        delta = delta - g_lse.astype(jnp.float32).reshape(b * h, sq, 1)
 
     bq = _pick_block(sq, _block_cap(d, _MAX_BLOCK_Q))
     bk = _pick_block(sk, _block_cap(d, _MAX_BLOCK_K))
     n_q, n_k = sq // bq, sk // bk
-    dq = pl.pallas_call(
-        _bwd_dq_kernel(sq, sk, d, causal, scale, bq, bk),
+
+    def kv_of(bh):
+        return (bh // h) * hkv + (bh % h) // group
+
+    dq_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
         grid=(b * h, n_q),
         in_specs=[
-            pl.BlockSpec((None, bq, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((None, sk, d), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((None, sk, d), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((None, bq, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((None, bq, 1), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((None, bq, 1), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((None, bq, d), lambda bh, qi, *_: (bh, qi, 0)),
+            pl.BlockSpec((None, sk, d), lambda bh, qi, *_: (kv_of(bh), 0, 0)),
+            pl.BlockSpec((None, sk, d), lambda bh, qi, *_: (kv_of(bh), 0, 0)),
+            pl.BlockSpec((None, bq, d), lambda bh, qi, *_: (bh, qi, 0)),
+            pl.BlockSpec((None, bq, 1), lambda bh, qi, *_: (bh, qi, 0)),
+            pl.BlockSpec((None, bq, 1), lambda bh, qi, *_: (bh, qi, 0)),
         ],
-        out_specs=pl.BlockSpec((None, bq, d), lambda bh, qi: (bh, qi, 0)),
+        out_specs=pl.BlockSpec((None, bq, d), lambda bh, qi, *_: (bh, qi, 0)),
+    )
+    dq = pl.pallas_call(
+        _bwd_dq_kernel(sq, sk, d, causal, scale, bq, bk, dropout_p),
+        grid_spec=dq_spec,
         out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
         compiler_params=_COMPILER_PARAMS,
         interpret=_INTERPRET,
-    )(qr, kr, vr, gr, lse, delta)
+    )(seed, qr, kr, vr, gr, lse, delta)
 
     # dkdv holds the WHOLE q/do streams VMEM-resident on top of its tiles —
     # at 1024-wide tiles that overflows the 16MB VMEM stack inside fused
     # programs, so its q-loop tile caps at 512 (the k tile keeps the wide
     # pick; measured: fwd/dq at 1024 + dkdv q-tile 512 retains the win)
     bq_kv = min(bq, _MAX_BLOCK_Q)
-    dk, dv = pl.pallas_call(
-        _bwd_dkdv_kernel(sq, sk, d, causal, scale, bq_kv, bk),
-        grid=(b * h, n_k),
+
+    def qh_of(kv, g):
+        # kv-head grid index + in-group position -> q-head row of qr/gr/lse
+        return (kv // hkv) * h + (kv % hkv) * group + g
+
+    # group > 1 accumulates dk/dv across grid steps in the output block —
+    # keep that accumulation in f32 (bf16 += over 4-8 partials loses bits),
+    # cast to the storage dtype outside the kernel
+    acc_dtype = jnp.float32 if group > 1 else k.dtype
+    dkdv_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b * hkv, n_k, group),
         in_specs=[
-            pl.BlockSpec((None, sq, d), lambda bh, ki: (bh, 0, 0)),
-            pl.BlockSpec((None, bk, d), lambda bh, ki: (bh, ki, 0)),
-            pl.BlockSpec((None, bk, d), lambda bh, ki: (bh, ki, 0)),
-            pl.BlockSpec((None, sq, d), lambda bh, ki: (bh, 0, 0)),
-            pl.BlockSpec((None, sq, 1), lambda bh, ki: (bh, 0, 0)),
-            pl.BlockSpec((None, sq, 1), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((None, sq, d), lambda kv, ki, g, *_: (qh_of(kv, g), 0, 0)),
+            pl.BlockSpec((None, bk, d), lambda kv, ki, g, *_: (kv, ki, 0)),
+            pl.BlockSpec((None, bk, d), lambda kv, ki, g, *_: (kv, ki, 0)),
+            pl.BlockSpec((None, sq, d), lambda kv, ki, g, *_: (qh_of(kv, g), 0, 0)),
+            pl.BlockSpec((None, sq, 1), lambda kv, ki, g, *_: (qh_of(kv, g), 0, 0)),
+            pl.BlockSpec((None, sq, 1), lambda kv, ki, g, *_: (qh_of(kv, g), 0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((None, bk, d), lambda bh, ki: (bh, ki, 0)),
-            pl.BlockSpec((None, bk, d), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((None, bk, d), lambda kv, ki, g, *_: (kv, ki, 0)),
+            pl.BlockSpec((None, bk, d), lambda kv, ki, g, *_: (kv, ki, 0)),
         ],
+    )
+    dk, dv = pl.pallas_call(
+        _bwd_dkdv_kernel(sq, sk, d, causal, scale, bq_kv, bk, dropout_p, h, hkv),
+        grid_spec=dkdv_spec,
         out_shape=[
-            jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
-            jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
+            jax.ShapeDtypeStruct((b * hkv, sk, d), acc_dtype),
+            jax.ShapeDtypeStruct((b * hkv, sk, d), acc_dtype),
         ],
-        compiler_params=_COMPILER_PARAMS,
+        compiler_params=_COMPILER_PARAMS_3D,
         interpret=_INTERPRET,
-    )(qr, kr, vr, gr, lse, delta)
+    )(seed, qr, kr, vr, gr, lse, delta)
 
-    unshape = lambda a, s: jnp.swapaxes(a.reshape(b, h, s, d), 1, 2)
-    return unshape(dq, sq), unshape(dk, sk), unshape(dv, sk)
+    unshape = lambda a, s, hh, dt: jnp.swapaxes(
+        a.reshape(b, hh, s, d), 1, 2
+    ).astype(dt)
+    return (
+        unshape(dq, sq, h, q.dtype),
+        unshape(dk, sk, hkv, k.dtype),
+        unshape(dv, sk, hkv, v.dtype),
+    )
 
 
 # ---------------------------------------------------------------------------
 # custom_vjp wiring
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def flash_attention_bshd(q, k, v, causal=False, sm_scale=None):
-    out, _ = _flash_fwd_x32_wrap(q, k, v, causal, sm_scale)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash_core(q, k, v, seed, causal, sm_scale, dropout_p):
+    """(out [B,Sq,H,D], lse [B,H,Sq]) — both differentiable outputs."""
+    out, lse = _flash_fwd_x32_wrap(q, k, v, seed, causal, sm_scale, dropout_p)
+    b, sq, h, _ = q.shape
+    return out, lse.reshape(b, h, sq)
+
+
+def _core_fwd(q, k, v, seed, causal, sm_scale, dropout_p):
+    out, lse = _flash_fwd_x32_wrap(q, k, v, seed, causal, sm_scale, dropout_p)
+    b, sq, h, _ = q.shape
+    return (out, lse.reshape(b, h, sq)), (q, k, v, seed, out, lse)
+
+
+def _core_bwd(causal, sm_scale, dropout_p, res, g):
+    q, k, v, seed, out, lse = res
+    g_out, g_lse = g
+    with jax.enable_x64(False):
+        dq, dk, dv = _flash_bwd_impl(
+            q, k, v, out, lse, g_out, g_lse, seed, causal, sm_scale, dropout_p
+        )
+    seed_ct = np.zeros(np.shape(seed), jax.dtypes.float0)
+    return dq, dk, dv, seed_ct
+
+
+_flash_core.defvjp(_core_fwd, _core_bwd)
+
+
+def _check_heads(q, k, v):
+    h, hk, hv = q.shape[2], k.shape[2], v.shape[2]
+    if hk != hv or h % hk != 0:
+        raise ValueError(
+            f"flash attention GQA needs k/v heads equal and dividing q heads; "
+            f"got q={h}, k={hk}, v={hv}"
+        )
+
+
+def repeat_kv(k, n_rep: int):
+    """GQA: repeat kv heads to match q heads, [B, S, Hkv, D] -> [B, S, H, D]
+    (kv head i serves q heads [i*n_rep, (i+1)*n_rep) — the ordering the
+    kernel's head-group index maps use). Shared by every dense fallback."""
+    if n_rep == 1:
+        return k
+    return jnp.repeat(k, n_rep, axis=2)
+
+
+def flash_attention_bshd(
+    q, k, v, causal=False, sm_scale=None, dropout_p=0.0, dropout_seed=None
+):
+    """Flash attention, paddle [B, S, H, D] layout. k/v may carry fewer
+    heads than q (GQA/MQA, h_kv | h_q); dropout_p > 0 applies in-kernel
+    upscale-in-train attention dropout keyed by `dropout_seed` (an int32
+    scalar; pass a fresh value per step)."""
+    _check_heads(q, k, v)
+    seed = _as_seed(dropout_seed)
+    out, _ = _flash_core(q, k, v, seed, causal, sm_scale, float(dropout_p))
     return out
 
 
-def _flash_fwd(q, k, v, causal, sm_scale):
-    out, lse = _flash_fwd_x32_wrap(q, k, v, causal, sm_scale)
-    return out, (q, k, v, out, lse)
+def flash_attention_bshd_lse(
+    q, k, v, causal=False, sm_scale=None, dropout_p=0.0, dropout_seed=None
+):
+    """Like flash_attention_bshd but also returns the per-row logsumexp
+    [B, H, Sq] (f32) — the ingredient ring attention needs to merge chunk
+    outputs across devices. Differentiable in both outputs."""
+    _check_heads(q, k, v)
+    seed = _as_seed(dropout_seed)
+    return _flash_core(q, k, v, seed, causal, sm_scale, float(dropout_p))
 
 
-def _flash_bwd(causal, sm_scale, res, g):
-    q, k, v, out, lse = res
-    with jax.enable_x64(False):
-        return _flash_bwd_impl(q, k, v, out, lse, g, causal, sm_scale)
-
-
-flash_attention_bshd.defvjp(_flash_fwd, _flash_bwd)
-
-
-def _flash_fwd_x32_wrap(q, k, v, causal, sm_scale):
+def _flash_fwd_x32_wrap(q, k, v, seed, causal, sm_scale, dropout_p):
     # Mosaic rejects i64 grid/index types, and the framework enables x64
     # globally (paddle dtype semantics) — trace the kernel with x64 off.
     # All kernel dtypes are explicit so numerics are unchanged.
     with jax.enable_x64(False):
-        return _flash_fwd_jit(q, k, v, causal, sm_scale)
+        return _flash_fwd_jit(q, k, v, seed, causal, sm_scale, dropout_p)
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "sm_scale"))
-def _flash_fwd_jit(q, k, v, causal=False, sm_scale=None):
-    return _flash_fwd_impl(q, k, v, causal, sm_scale)
+@functools.partial(
+    jax.jit, static_argnames=("causal", "sm_scale", "dropout_p")
+)
+def _flash_fwd_jit(q, k, v, seed, causal=False, sm_scale=None, dropout_p=0.0):
+    return _flash_fwd_impl(q, k, v, seed, causal, sm_scale, dropout_p)

@@ -91,10 +91,156 @@ def test_usable_gate():
     q = jnp.zeros((2, 256, 4, 64))
     k = jnp.zeros((2, 512, 4, 64))
     assert pk.flash_attention_usable(q, False, 0.0, k, k)      # cross-attn ok
-    assert not pk.flash_attention_usable(q, False, 0.1)        # dropout
+    assert pk.flash_attention_usable(q, False, 0.1)            # dropout in-kernel (r5)
+    assert not pk.flash_attention_usable(q, False, 1.0)        # degenerate p
     assert not pk.flash_attention_usable(q[:, :100], False, 0.0)  # not block-multiple
-    k_bad = jnp.zeros((2, 512, 2, 64))
-    assert not pk.flash_attention_usable(q, False, 0.0, k_bad)  # head mismatch
+    k_gqa = jnp.zeros((2, 512, 2, 64))
+    assert pk.flash_attention_usable(q, False, 0.0, k_gqa, k_gqa)  # GQA native (r5)
+    k_bad = jnp.zeros((2, 512, 3, 64))
+    assert not pk.flash_attention_usable(q, False, 0.0, k_bad, k_bad)  # 3 does not divide 4
+    assert not pk.flash_attention_usable(q, False, 0.0, k_gqa, k)  # k/v heads disagree
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("hkv", [1, 2])
+def test_flash_gqa_matches_repeated_reference(causal, hkv):
+    """Native GQA/MQA (reference flash_attn_utils.h:140 num_heads_k): the
+    kernel with h_kv < h_q matches the repeat-KV dense oracle, forward and
+    all three gradients."""
+    b, sq, sk, h, d = 2, 256, 384, 4, 64
+    q = _rand((b, sq, h, d), 0)
+    k = _rand((b, sk, hkv, d), 1)
+    v = _rand((b, sk, hkv, d), 2)
+    g = _rand((b, sq, h, d), 3)
+    assert pk.flash_attention_usable(q, causal, 0.0, k, v)
+
+    f = lambda q, k, v: pk.flash_attention_bshd(q, k, v, causal=causal)
+    fr = lambda q, k, v: pk._ref_attention_bshd(q, k, v, causal, None)
+    out, vjp = jax.vjp(f, q, k, v)
+    ref, vjpr = jax.vjp(fr, q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
+    for got, want, nm in zip(vjp(g), vjpr(g), "qkv"):
+        assert got.shape == want.shape  # dk/dv stay at h_kv heads
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-3, atol=5e-5, err_msg=f"d{nm}"
+        )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_dropout_matches_hash_oracle(causal):
+    """In-kernel attention dropout (reference flash_attention.py:151): the
+    kernel's stateless position-hash mask is regenerated exactly by the jnp
+    oracle, so forward AND backward match it to kernel-roundoff."""
+    b, s, h, d = 2, 256, 3, 64
+    p_drop = 0.1
+    q = _rand((b, s, h, d), 0)
+    k = _rand((b, s, h, d), 1)
+    v = _rand((b, s, h, d), 2)
+    g = _rand((b, s, h, d), 3)
+    seed = jnp.asarray(1234, jnp.int32)
+    assert pk.flash_attention_usable(q, causal, p_drop, k, v)
+
+    f = lambda q, k, v: pk.flash_attention_bshd(
+        q, k, v, causal=causal, dropout_p=p_drop, dropout_seed=seed
+    )
+    fr = lambda q, k, v: pk._ref_attention_bshd(
+        q, k, v, causal, None, dropout_p=p_drop, seed=seed
+    )
+    out, vjp = jax.vjp(f, q, k, v)
+    ref, vjpr = jax.vjp(fr, q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=5e-5)
+    for got, want, nm in zip(vjp(g), vjpr(g), "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-3, atol=1e-4, err_msg=f"d{nm}"
+        )
+
+
+def test_flash_dropout_semantics():
+    """Mask rate ~= 1-p; upscale-in-train preserves the attention row mean
+    in expectation; fixed seed is deterministic; different seeds differ."""
+    b, s, h, d = 2, 256, 4, 64
+    q = _rand((b, s, h, d), 0)
+    k = _rand((b, s, h, d), 1)
+    v = _rand((b, s, h, d), 2)
+    for p_drop in (0.1, 0.5):
+        keep = pk.dropout_keep_reference(jnp.asarray(7, jnp.int32), b * h, s, s, p_drop)
+        assert abs(float(keep.mean()) - (1.0 - p_drop)) < 0.01
+    s1 = jnp.asarray(7, jnp.int32)
+    a = pk.flash_attention_bshd(q, k, v, dropout_p=0.1, dropout_seed=s1)
+    b_ = pk.flash_attention_bshd(q, k, v, dropout_p=0.1, dropout_seed=s1)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b_))
+    c = pk.flash_attention_bshd(q, k, v, dropout_p=0.1, dropout_seed=jnp.asarray(8, jnp.int32))
+    assert np.abs(np.asarray(a) - np.asarray(c)).max() > 1e-4
+    # expectation: E[dropout(P)] = P, so averaging over many seeds approaches
+    # the dropout-free output
+    outs = [
+        np.asarray(
+            pk.flash_attention_bshd(q, k, v, dropout_p=0.5, dropout_seed=jnp.asarray(i, jnp.int32))
+        )
+        for i in range(24)
+    ]
+    base = np.asarray(pk.flash_attention_bshd(q, k, v))
+    err_mean = np.abs(np.mean(outs, axis=0) - base).mean()
+    assert err_mean < 0.05, err_mean
+
+
+def test_flash_dropout_finite_diff():
+    """FD check of the custom VJP through the dropout path (the mask is a
+    fixed function of positions, so the loss is differentiable a.e.)."""
+    b, s, h, d = 1, 128, 1, 64
+    q = _rand((b, s, h, d), 4)
+    k = _rand((b, s, h, d), 5)
+    v = _rand((b, s, h, d), 6)
+    seed = jnp.asarray(42, jnp.int32)
+
+    def loss(q):
+        return jnp.mean(
+            pk.flash_attention_bshd(q, k, v, causal=True, dropout_p=0.2, dropout_seed=seed) ** 2
+        )
+
+    gq = jax.grad(loss)(q)
+    eps = 1e-2
+    for idx in [(0, 17, 0, 5), (0, 100, 0, 31)]:
+        pert = jnp.zeros_like(q).at[idx].set(eps)
+        fd = (float(loss(q + pert)) - float(loss(q - pert))) / (2 * eps)
+        np.testing.assert_allclose(float(gq[idx]), fd, rtol=3e-2, atol=1e-6)
+
+
+def test_flash_lse_output():
+    """flash_attention_bshd_lse returns the true logsumexp and its VJP
+    (the lse cotangent folds into delta — check against jax logsumexp)."""
+    b, s, h, d = 2, 256, 2, 64
+    q = _rand((b, s, h, d), 0)
+    k = _rand((b, s, h, d), 1)
+    v = _rand((b, s, h, d), 2)
+    out, lse = pk.flash_attention_bshd_lse(q, k, v)
+    scale = 1.0 / np.sqrt(d)
+    lref = jax.nn.logsumexp(
+        jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale, axis=-1
+    )
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(lref), rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(pk._ref_attention_bshd(q, k, v, False, None)),
+        rtol=2e-4, atol=2e-5,
+    )
+    # gradient THROUGH the lse output (ring attention differentiates it)
+    gl = jax.grad(lambda q: jnp.sum(pk.flash_attention_bshd_lse(q, k, v)[1]))(q)
+    glr = jax.grad(
+        lambda q: jnp.sum(
+            jax.nn.logsumexp(jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale, axis=-1)
+        )
+    )(q)
+    np.testing.assert_allclose(np.asarray(gl), np.asarray(glr), rtol=2e-3, atol=2e-4)
+    # mixed cotangent: out AND lse both contribute
+    gm = jax.grad(
+        lambda q: jnp.sum(pk.flash_attention_bshd_lse(q, k, v)[0])
+        + jnp.sum(pk.flash_attention_bshd_lse(q, k, v)[1])
+    )(q)
+    gmr = jax.grad(
+        lambda q: jnp.sum(pk._ref_attention_bshd(q, k, v, False, None))
+        + jnp.sum(jax.nn.logsumexp(jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale, axis=-1))
+    )(q)
+    np.testing.assert_allclose(np.asarray(gm), np.asarray(gmr), rtol=2e-3, atol=2e-4)
 
 
 def test_flash_head_dim_128_wide_blocks():
@@ -134,3 +280,79 @@ def test_flash_head_dim_128_wide_blocks():
                                        rtol=2e-3, atol=2e-4)
     finally:
         pallas_ops._INTERPRET = old
+
+
+def test_gqa_no_repeated_kv_materialization():
+    """The GQA forward jaxpr contains NO intermediate with the repeated-KV
+    shape — the whole point of native GQA (reference materializes nothing
+    either: flash_attn_utils.h:140 passes num_heads_k into the kernel)."""
+    b, sq, sk, h, hkv, d = 2, 256, 512, 8, 2, 64
+    q = jnp.zeros((b, sq, h, d), jnp.float32)
+    k = jnp.zeros((b, sk, hkv, d), jnp.float32)
+    v = jnp.zeros((b, sk, hkv, d), jnp.float32)
+    jaxpr = jax.make_jaxpr(
+        lambda q, k, v: pk.flash_attention_bshd(q, k, v, causal=False)
+    )(q, k, v)
+    repeated = {(b, sk, h, d), (b * h, sk, d), (b, h, sk, d)}
+
+    def walk(jp):
+        for eqn in jp.eqns:
+            for var in eqn.outvars:
+                assert tuple(var.aval.shape) not in repeated, (
+                    f"repeated-KV intermediate {var.aval.shape} in {eqn.primitive}"
+                )
+            for sub in eqn.params.values():
+                if hasattr(sub, "jaxpr"):
+                    walk(sub.jaxpr)
+
+    walk(jaxpr.jaxpr)
+
+
+def test_llama_gqa_dispatches_kernel_without_repeat():
+    """LlamaAttention with num_kv_heads < num_heads rides the flash kernel
+    directly (no repeat_interleave) and matches the repeat+dense oracle."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaAttention
+    from paddle_tpu.ops import manipulation as manip
+
+    paddle.seed(0)
+    attn = LlamaAttention(hidden_size=256, num_heads=4, num_kv_heads=2)
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(1, 512, 256).astype(np.float32)
+    )
+
+    called = {"repeat": 0, "flash": 0}
+    orig_rep = manip.repeat_interleave
+    orig_flash = pk.flash_attention_bshd
+
+    def count_rep(*a, **kw):
+        called["repeat"] += 1
+        return orig_rep(*a, **kw)
+
+    def count_flash(*a, **kw):
+        called["flash"] += 1
+        return orig_flash(*a, **kw)
+
+    manip.repeat_interleave = count_rep
+    pk.flash_attention_bshd = count_flash
+    try:
+        out = attn(x)
+    finally:
+        manip.repeat_interleave = orig_rep
+        pk.flash_attention_bshd = orig_flash
+    assert called["flash"] == 1 and called["repeat"] == 0
+
+    # numerics vs the repeat+dense oracle on the same projections
+    q = np.asarray(attn.q_proj(x).numpy()).reshape(1, 512, 4, 64)
+    k = np.asarray(attn.k_proj(x).numpy()).reshape(1, 512, 2, 64)
+    v = np.asarray(attn.v_proj(x).numpy()).reshape(1, 512, 2, 64)
+    from paddle_tpu.models.llama import _rope
+
+    qr, kr = _rope(jnp.asarray(q), jnp.asarray(k))
+    ref = pk._ref_attention_bshd(qr, kr, jnp.asarray(v), True, None)
+    got = attn.o_proj.weight.numpy()  # only to confirm shapes line up
+    assert got.shape == (256, 256)
+    inner = np.asarray(ref).reshape(1, 512, 256) @ np.asarray(got)
+    np.testing.assert_allclose(
+        np.asarray(out.numpy(), np.float32), inner, rtol=2e-3, atol=2e-3
+    )
