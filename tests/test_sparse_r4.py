@@ -5,6 +5,9 @@ vs dense-conv oracles, and a small sparse-conv net training end-to-end
 Reference: python/paddle/sparse/__init__.py, sparse/nn/__init__.py,
 paddle/phi/kernels/sparse/.
 """
+import os
+import time
+
 import numpy as np
 import pytest
 
@@ -213,3 +216,67 @@ class TestSparseConv:
             opt.clear_grad()
             losses.append(float(loss.numpy()))
         assert losses[-1] < losses[0] * 0.5, losses[::10]
+
+
+class TestRulebookScale:
+    """r5 (VERDICT next-round #6): the vectorized rulebook at the
+    point-cloud operating point — 100k active sites x 3^3 offsets."""
+
+    @staticmethod
+    def _cloud(nnz, shape, seed=0):
+        rng = np.random.RandomState(seed)
+        flat = rng.choice(int(np.prod(shape)), nnz, replace=False)
+        sp = np.stack(np.unravel_index(flat, shape), axis=1)
+        return np.concatenate([np.zeros((nnz, 1), np.int64), sp], axis=1)
+
+    def test_100k_sites_structural(self):
+        from paddle_tpu.sparse.conv_engine import build_rulebook
+
+        shape = (400, 400, 40)
+        coords = self._cloud(100_000, shape, seed=3)
+        t0 = time.perf_counter()
+        out_coords, pairs, out_sp = build_rulebook(
+            coords, shape, 3, 1, 1, 1, subm=True
+        )
+        build_s = time.perf_counter() - t0
+        assert build_s < 2.0, f"rulebook build too slow: {build_s:.2f}s"
+        assert out_sp == shape and out_coords.shape == coords.shape
+        assert len(pairs) == 27
+        # center offset (13) is the identity map over every site
+        ci, co = pairs[13]
+        assert len(ci) == len(coords)
+        np.testing.assert_array_equal(np.sort(ci), np.arange(len(coords)))
+        np.testing.assert_array_equal(ci, co)
+        # every (in, out) pair's coordinates differ by exactly the offset
+        rng = np.random.RandomState(0)
+        offs = np.stack(
+            np.meshgrid(*[np.arange(3)] * 3, indexing="ij"), -1
+        ).reshape(-1, 3) - 1
+        for k in rng.choice(27, 6, replace=False):
+            ii, oi = pairs[k]
+            if len(ii) == 0:
+                continue
+            take = rng.choice(len(ii), min(200, len(ii)), replace=False)
+            np.testing.assert_array_equal(
+                coords[ii[take], 1:], coords[oi[take], 1:] + offs[k]
+            )
+            # out sites unique within one offset
+            assert len(np.unique(oi)) == len(oi)
+
+    def test_5k_sites_match_dict_reference(self):
+        """Exact equality against the r4 per-site dict build (the slow
+        oracle stays suite-feasible at 5k sites)."""
+        import sys as _sys
+
+        _sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "benchmarks"))
+        from sparse_rulebook_bench import dict_build_subm
+
+        from paddle_tpu.sparse.conv_engine import build_rulebook
+
+        shape = (60, 60, 20)
+        coords = self._cloud(5000, shape, seed=4)
+        _, fast, _ = build_rulebook(coords, shape, 3, 1, 1, 1, subm=True)
+        ref = dict_build_subm(coords, shape, (3, 3, 3), (1, 1, 1))
+        for (fi, fo), (di, do) in zip(fast, ref):
+            np.testing.assert_array_equal(fi[np.argsort(fo)], di[np.argsort(do)])
+            np.testing.assert_array_equal(np.sort(fo), np.sort(do))
