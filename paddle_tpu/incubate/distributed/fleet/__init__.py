@@ -1,0 +1,9 @@
+"""paddle.incubate.distributed.fleet parity (reference
+python/paddle/incubate/distributed/fleet/__init__.py): re-exports the fleet
+recompute entry points."""
+from ....distributed.fleet.recompute.recompute import (  # noqa: F401
+    recompute_hybrid,
+    recompute_sequential,
+)
+
+__all__ = ["recompute_sequential", "recompute_hybrid"]

@@ -210,7 +210,21 @@ class Predictor:
         return None
 
     def clone(self) -> "Predictor":
-        return Predictor(self._config)
+        """Reference semantics: the clone SHARES the loaded program (no
+        re-deserialization) and gets its own I/O buffers."""
+        c = Predictor.__new__(Predictor)
+        c._config = self._config
+        c._exported = self._exported
+        c._meta = self._meta
+        c._input_names = list(self._input_names)
+        c._output_names = list(self._output_names)
+        dtypes = self._meta.get("in_dtypes")
+        c._inputs = {
+            n: Tensor(n, dtype=(dtypes[i] if dtypes else None))
+            for i, n in enumerate(c._input_names)
+        }
+        c._outputs = {n: Tensor(n) for n in c._output_names}
+        return c
 
     def clear_intermediate_tensor(self):
         return None
@@ -222,3 +236,150 @@ class Predictor:
 def create_predictor(config: Config) -> Predictor:
     """paddle.inference.create_predictor."""
     return Predictor(config)
+
+
+class DataType:
+    """paddle_infer.DataType enum (paddle_tensor.h PaddleDType)."""
+
+    FLOAT64 = -1  # extension: not in the C enum, used by get_num_bytes
+    FLOAT32 = 0
+    INT64 = 1
+    INT32 = 2
+    UINT8 = 3
+    INT8 = 4
+    FLOAT16 = 5
+    BFLOAT16 = 6
+    BOOL = 7
+
+
+def get_num_bytes_of_data_type(dtype) -> int:
+    """paddle.inference.get_num_bytes_of_data_type."""
+    sizes = {
+        DataType.FLOAT64: 8, DataType.FLOAT32: 4, DataType.INT64: 8,
+        DataType.INT32: 4, DataType.UINT8: 1, DataType.INT8: 1,
+        DataType.FLOAT16: 2, DataType.BFLOAT16: 2, DataType.BOOL: 1,
+    }
+    if dtype not in sizes:
+        raise ValueError(f"unknown inference DataType: {dtype}")
+    return sizes[dtype]
+
+
+def get_version() -> str:
+    """paddle.inference.get_version (version banner string)."""
+    from .. import version as _v
+
+    return f"version: {_v.full_version}\ncommit: {_v.commit}\n"
+
+
+def get_trt_compile_version():
+    """TensorRT does not exist on TPU — the reference returns the linked
+    TRT version; here the triple is zeros (the Config TRT knobs are inert)."""
+    return (0, 0, 0)
+
+
+def get_trt_runtime_version():
+    return (0, 0, 0)
+
+
+def _get_phi_kernel_name(op_name: str) -> str:
+    """Reference maps a legacy fluid op name to its phi kernel name via the
+    compat registry. This framework has one dispatch point (core/apply), so
+    the op name IS the kernel name; the handful of renamed legacy ops the
+    reference table covers are mapped explicitly."""
+    legacy = {
+        "matmul_v2": "matmul", "elementwise_add": "add",
+        "elementwise_sub": "subtract", "elementwise_mul": "multiply",
+        "elementwise_div": "divide", "reduce_sum": "sum",
+        "reduce_mean": "mean", "fill_constant": "full",
+    }
+    return legacy.get(op_name, op_name)
+
+
+class XpuConfig:
+    """paddle.inference.XpuConfig parity: accepted-and-inert device knobs
+    (kunlun XPU settings have no role on TPU; kept for config portability)."""
+
+    def __init__(self, **kwargs):
+        self.device_id = kwargs.pop("device_id", 0)
+        self.l3_size = kwargs.pop("l3_size", 0)
+        self.l3_autotune_size = kwargs.pop("l3_autotune_size", 0)
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+
+class PredictorPool:
+    """paddle.inference.PredictorPool: `size` predictors sharing one Config.
+    The first is the primary; the rest are clones (reference semantics —
+    clone shares the loaded program, each handle has its own I/O buffers)."""
+
+    def __init__(self, config: Config, size: int = 1):
+        if size < 1:
+            raise ValueError("PredictorPool size must be >= 1")
+        main = Predictor(config)
+        self._preds = [main] + [main.clone() for _ in range(size - 1)]
+
+    def retrieve(self, idx: int) -> Predictor:
+        return self._preds[idx]
+
+
+def convert_to_mixed_precision(
+    model_file: str,
+    params_file: str,
+    mixed_model_file: str,
+    mixed_params_file: str,
+    mixed_precision=PrecisionType.Half,
+    backend=None,
+    keep_io_types: bool = True,
+    black_list=None,
+    **kwargs,
+):
+    """paddle.inference.convert_to_mixed_precision: rewrite a saved model's
+    SEPARATE parameter payload (.pdiparams) to a reduced precision — the
+    on-disk/load-time half-sizing that is the point of the conversion. The
+    frozen StableHLO program is copied as-is (XLA re-fuses casts at compile
+    time; artifacts whose weights are baked INTO the program blob are
+    unaffected by design), and the converted precision is recorded in the
+    .pdmeta sidecar. Reference:
+    python/paddle/inference/convert_to_mixed_precision.py."""
+    import shutil
+
+    target = {PrecisionType.Half: np.float16, PrecisionType.Bfloat16: "bfloat16"}.get(
+        mixed_precision
+    )
+    if target is None:
+        raise ValueError("mixed_precision must be PrecisionType.Half or Bfloat16")
+    black = set(black_list or ())
+    shutil.copyfile(model_file, mixed_model_file)
+    # sidecar meta: derive the prefix from ANY extension (reference passes
+    # .pdmodel, but Config accepts arbitrary file names)
+    src_meta = os.path.splitext(model_file)[0] + ".pdmeta"
+    dst_meta = os.path.splitext(mixed_model_file)[0] + ".pdmeta"
+    if os.path.exists(src_meta):
+        with open(src_meta, "rb") as f:
+            meta = pickle.load(f)
+        meta["mixed_precision"] = int(mixed_precision)
+        with open(dst_meta, "wb") as f:
+            pickle.dump(meta, f)
+    from ..framework import io as fio
+
+    params = fio.load(params_file)
+    import jax.numpy as _jnp
+
+    def cast(name, a):
+        arr = np.asarray(a)
+        if name in black or arr.dtype != np.float32:
+            return arr
+        if target == "bfloat16":
+            return np.asarray(_jnp.asarray(arr).astype(_jnp.bfloat16))
+        return arr.astype(target)
+
+    converted = {k: cast(k, v) for k, v in params.items()}
+    fio.save(converted, mixed_params_file)
+
+
+__all__ += [
+    "DataType", "PredictorPool", "XpuConfig", "get_version",
+    "get_trt_compile_version", "get_trt_runtime_version",
+    "get_num_bytes_of_data_type", "convert_to_mixed_precision",
+    "_get_phi_kernel_name",
+]

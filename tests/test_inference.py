@@ -108,3 +108,80 @@ print("CHILD_OK")
     # XLA's default f32 matmul precision is reduced (bf16 passes) — the
     # contract is platform-precision equality, not bitwise equality
     np.testing.assert_allclose(got, want, rtol=6e-2, atol=2e-3)
+
+
+def test_inference_r5_surface(tmp_path):
+    """r5 strays (VERDICT Missing #4): PredictorPool, DataType,
+    get_version, convert_to_mixed_precision + the rest of the reference
+    __all__ — now also audited by the full-tree namespace sweep."""
+    import paddle_tpu.inference as inf
+
+    # DataType + byte sizes
+    assert inf.get_num_bytes_of_data_type(inf.DataType.FLOAT32) == 4
+    assert inf.get_num_bytes_of_data_type(inf.DataType.BFLOAT16) == 2
+    assert inf.get_num_bytes_of_data_type(inf.DataType.INT64) == 8
+    with pytest.raises(ValueError):
+        inf.get_num_bytes_of_data_type(12345)
+    assert "version" in inf.get_version()
+    assert inf.get_trt_compile_version() == (0, 0, 0)
+    assert inf._get_phi_kernel_name("elementwise_add") == "add"
+    assert inf._get_phi_kernel_name("matmul") == "matmul"
+    assert inf.XpuConfig(device_id=1).device_id == 1
+
+    # PredictorPool: clones share the program, run independently
+    prefix, lin = _export_static(tmp_path)
+    pool = inf.PredictorPool(Config(prefix), 3)
+    xv = np.random.RandomState(0).randn(2, 4).astype(np.float32)
+    (a,) = pool.retrieve(0).run([xv])
+    (b,) = pool.retrieve(2).run([xv])
+    np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+def test_convert_to_mixed_precision(tmp_path):
+    import paddle_tpu.inference as inf
+
+    paddle.seed(1)
+    m = nn.Sequential(nn.Linear(6, 8), nn.ReLU(), nn.Linear(8, 2))
+    m.eval()
+    prefix = str(tmp_path / "jm")
+    paddle.jit.save(m, prefix, input_spec=[static.InputSpec([3, 6], "float32")])
+    out_prefix = str(tmp_path / "mixed")
+    inf.convert_to_mixed_precision(
+        prefix + ".pdmodel", prefix + ".pdiparams",
+        out_prefix + ".pdmodel", out_prefix + ".pdiparams",
+        mixed_precision=inf.PrecisionType.Half,
+    )
+    from paddle_tpu.framework import io as fio
+
+    conv = fio.load(out_prefix + ".pdiparams")
+    assert all(np.asarray(v).dtype == np.float16 for v in conv.values()
+               if np.asarray(v).dtype.kind == "f"), {
+        k: np.asarray(v).dtype for k, v in conv.items()}
+    # meta records the precision
+    import pickle
+
+    with open(out_prefix + ".pdmeta", "rb") as f:
+        meta = pickle.load(f)
+    assert meta["mixed_precision"] == int(inf.PrecisionType.Half)
+
+
+def test_incubate_distributed_fleet_shim():
+    """r5 (VERDICT Missing #5): the incubate.distributed.fleet module."""
+    from paddle_tpu.incubate.distributed.fleet import (
+        recompute_hybrid,
+        recompute_sequential,
+    )
+
+    paddle.seed(0)
+    seq = nn.Sequential(nn.Linear(4, 8), nn.GELU(), nn.Linear(8, 4))
+    x = paddle.to_tensor(np.random.RandomState(0).randn(2, 4).astype(np.float32))
+    want = seq(x)
+    got = recompute_sequential({"segments": 2}, seq, x)
+    np.testing.assert_allclose(got.numpy(), want.numpy(), rtol=1e-6)
+
+    got_h = recompute_hybrid({"mp_group": None, "offload": False}, seq, x)
+    np.testing.assert_allclose(got_h.numpy(), want.numpy(), rtol=1e-6)
+    got_h.sum().backward()
+    assert seq[0].weight.grad is not None
+    with pytest.raises(TypeError):
+        recompute_hybrid("bad-ctx", seq, x)
