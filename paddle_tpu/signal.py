@@ -62,7 +62,10 @@ def stft(
         n = v.shape[-1]
         num = 1 + (n - n_fft) // hop_length
         idx = jnp.arange(n_fft)[None, :] + hop_length * jnp.arange(num)[:, None]
-        frames = v[..., idx] * win_v  # [..., num, n_fft]
+        # window in the INPUT dtype: the default jnp.ones window is f64
+        # under the global x64 mode, and f32*f64 would promote the whole
+        # transform to complex128 (reference: float32 in -> complex64 out)
+        frames = v[..., idx] * win_v.astype(v.dtype)  # [..., num, n_fft]
         spec = jnp.fft.rfft(frames, axis=-1) if onesided else jnp.fft.fft(frames, axis=-1)
         if normalized:
             spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
@@ -96,14 +99,17 @@ def istft(
         if normalized:
             spec = spec * jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
         frames = jnp.fft.irfft(spec, n=n_fft, axis=-1) if onesided else jnp.fft.ifft(spec, axis=-1).real
-        frames = frames * win_v
+        # window in the frames' real dtype (complex64 in -> float32 out;
+        # see stft: the default window is f64 under global x64)
+        win = win_v.astype(frames.dtype)
+        frames = frames * win
         num = frames.shape[-2]
         n = n_fft + hop_length * (num - 1)
         out = jnp.zeros(frames.shape[:-2] + (n,), frames.dtype)
         wsum = jnp.zeros((n,), frames.dtype)
         for i in range(num):
             out = out.at[..., i * hop_length : i * hop_length + n_fft].add(frames[..., i, :])
-            wsum = wsum.at[i * hop_length : i * hop_length + n_fft].add(win_v**2)
+            wsum = wsum.at[i * hop_length : i * hop_length + n_fft].add(win**2)
         out = out / jnp.maximum(wsum, 1e-10)
         if center:
             pad = n_fft // 2
