@@ -343,15 +343,50 @@ class Momentum(Optimizer):
         p.stop_gradient = False
 
 
+def _sr_round(x32, dtype, key):
+    """Cast f32 -> `dtype` with STOCHASTIC rounding: add uniform noise below
+    the mantissa cut, then truncate. Unbiased (E[round(x)] = x), which is
+    what lets a bf16 second moment accumulate tiny (1-b2)*g^2 increments
+    that round-to-nearest would swallow. bf16 is the f32 top half, so the
+    truncation is a 16-bit shift."""
+    if dtype == jnp.float32:
+        return x32
+    assert dtype == jnp.bfloat16, dtype
+    bits = jax.lax.bitcast_convert_type(x32, jnp.uint32)
+    noise = jax.random.bits(key, x32.shape, dtype=jnp.uint32) & jnp.uint32(0xFFFF)
+    out16 = jax.lax.shift_right_logical(bits + noise, jnp.uint32(16)).astype(jnp.uint16)
+    return jax.lax.bitcast_convert_type(out16, jnp.bfloat16)
+
+
+def _m2_dtype_from(name, kw):
+    """moment2_dtype kwarg (or PADDLE_TPU_ADAM_M2_DTYPE env default):
+    'float32' (default) or 'bfloat16' (halves the second-moment HBM traffic;
+    stochastically rounded — see BASELINE.md A/B)."""
+    import os as _os
+
+    v = kw.pop("moment2_dtype", None) or _os.environ.get("PADDLE_TPU_ADAM_M2_DTYPE")
+    if v in (None, "", "float32", jnp.float32):
+        return jnp.float32
+    if v in ("bfloat16", "bf16", jnp.bfloat16):
+        return jnp.bfloat16
+    raise ValueError(f"moment2_dtype must be float32 or bfloat16, got {v!r}")
+
+
 class Adam(Optimizer):
     _wd_mode = "l2"  # adam applies wd to grad; adamw decouples
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False, multi_precision=True, name=None, **kw):
+        self._m2_dtype = _m2_dtype_from("moment2_dtype", kw)
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
         self._beta1 = beta1
         self._beta2 = beta2
         self._eps = epsilon
         self._multi_precision = multi_precision
+
+    def _m2_key(self):
+        from ..framework.random import default_generator
+
+        return default_generator().next_key()
 
     def _effective_wd(self, p, wd):
         return wd
@@ -429,13 +464,16 @@ class Adam(Optimizer):
             if self._wd_mode == "l2" and wdv:
                 G = G + wdv * P
             m_new = b1 * m.value + (1 - b1) * G
-            v_new = b2 * v.value + (1 - b2) * G * G
+            v_new = b2 * v.value.astype(jnp.float32) + (1 - b2) * G * G
             upd = (m_new / c1) / (jnp.sqrt(v_new / c2) + eps)
             if self._wd_mode == "decoupled" and wdv:
                 upd = upd + wdv * P
             P2 = P - lr * upd
             m._replace_value(m_new)
-            v._replace_value(v_new)
+            v._replace_value(
+                v_new if self._m2_dtype == jnp.float32
+                else _sr_round(v_new, self._m2_dtype, self._m2_key())
+            )
             for i, (p, _) in enumerate(pgs):
                 p._replace_value(P2[i])
                 p.stop_gradient = False
@@ -457,16 +495,17 @@ class Adam(Optimizer):
             by_shape[tuple(p._value.shape)].append(p)
 
         def gather(name, group):
+            dt = self._m2_dtype if name == "moment2" else jnp.float32
             parts, have_any = [], False
             for p in group:
                 prev = self._pop_param_state(name, id(p))
                 if prev is not None:
                     have_any = True
-                    parts.append(jnp.asarray(prev, jnp.float32))
+                    parts.append(jnp.asarray(prev).astype(dt))
                 else:
-                    parts.append(jnp.zeros(p._value.shape, jnp.float32))
+                    parts.append(jnp.zeros(p._value.shape, dt))
             if not have_any:
-                return jnp.zeros((len(group),) + tuple(group[0]._value.shape), jnp.float32)
+                return jnp.zeros((len(group),) + tuple(group[0]._value.shape), dt)
             return jnp.stack(parts)
 
         def gather_scalar(name, fill):
@@ -502,7 +541,7 @@ class Adam(Optimizer):
 
     def _apply_one(self, p, g, wd, lr_scale):
         m = self._add_accumulator("moment1", p)
-        v = self._add_accumulator("moment2", p)
+        v = self._add_accumulator("moment2", p, dtype=self._m2_dtype)
         b1p = self._add_accumulator("beta1_pow", p, fill=1.0, dtype=jnp.float32, shape=())
         b2p = self._add_accumulator("beta2_pow", p, fill=1.0, dtype=jnp.float32, shape=())
         lr = self._lr_value(lr_scale)
@@ -517,7 +556,7 @@ class Adam(Optimizer):
         b1p_new = b1p.value * b1
         b2p_new = b2p.value * b2
         m_new = b1 * m.value + (1 - b1) * gv
-        v_new = b2 * v.value + (1 - b2) * gv * gv
+        v_new = b2 * v.value.astype(jnp.float32) + (1 - b2) * gv * gv
         mhat = m_new / (1 - b1p_new)
         vhat = v_new / (1 - b2p_new)
         upd = mhat / (jnp.sqrt(vhat) + eps)
@@ -525,7 +564,10 @@ class Adam(Optimizer):
             upd = upd + wdv * pv32
         new_p = pv32 - lr * upd
         m._replace_value(m_new)
-        v._replace_value(v_new)
+        v._replace_value(
+            v_new if self._m2_dtype == jnp.float32
+            else _sr_round(v_new, self._m2_dtype, self._m2_key())
+        )
         b1p._replace_value(b1p_new)
         b2p._replace_value(b2p_new)
         p._replace_value(new_p.astype(p._value.dtype))
@@ -538,7 +580,7 @@ class AdamW(Adam):
     _wd_mode = "decoupled"
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, parameters=None, weight_decay=0.01, lr_ratio=None, apply_decay_param_fun=None, grad_clip=None, lazy_mode=False, multi_precision=True, name=None, **kw):
-        super().__init__(learning_rate, beta1, beta2, epsilon, parameters, weight_decay, grad_clip, lazy_mode, multi_precision, name)
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters, weight_decay, grad_clip, lazy_mode, multi_precision, name, **kw)
         self._apply_decay_param_fun = apply_decay_param_fun
 
     def _effective_wd(self, p, wd):

@@ -370,3 +370,51 @@ def test_linear_lr():
     assert vals[0] == pytest.approx(0.05)
     assert vals[5] == pytest.approx(0.075)
     assert vals[10] == pytest.approx(0.1) and vals[11] == pytest.approx(0.1)
+
+
+def test_adamw_bf16_second_moment():
+    """r5 (VERDICT next-round #10): moment2_dtype='bfloat16' halves the
+    second-moment HBM traffic; stochastic rounding keeps the accumulation
+    unbiased. Convergence must track f32-m2; state must round-trip."""
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    def train(m2, steps=40):
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(8, 16), nn.GELU(), nn.Linear(16, 1))
+        opt = paddle.optimizer.AdamW(
+            1e-2, parameters=model.parameters(), moment2_dtype=m2
+        )
+        rng = np.random.RandomState(0)
+        xs = paddle.to_tensor(rng.randn(32, 8).astype(np.float32))
+        ys = paddle.to_tensor((rng.randn(32, 1) * 0.1 + 1.0).astype(np.float32))
+        loss = None
+        for _ in range(steps):
+            loss = nn.MSELoss()(model(xs), ys)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        return float(loss), opt
+
+    lf, _ = train("float32")
+    lb, opt_b = train("bfloat16")
+    assert lb < 0.3 and lb < lf * 1.5 + 1e-3, (lf, lb)
+
+    # the bf16 dtype survives the accumulator store and state round-trip
+    st = opt_b.state_dict()
+    m2_arrays = [v for k, v in st.items() if "moment2" in k]
+    assert m2_arrays and all(
+        jnp.asarray(v).dtype == jnp.bfloat16 for v in m2_arrays
+    ), {k: str(jnp.asarray(v).dtype) for k, v in st.items() if "moment2" in k}
+
+    paddle.seed(0)
+    model2 = nn.Sequential(nn.Linear(8, 16), nn.GELU(), nn.Linear(16, 1))
+    opt2 = paddle.optimizer.AdamW(
+        1e-2, parameters=model2.parameters(), moment2_dtype="bfloat16"
+    )
+    opt2.set_state_dict(st)
+
+    with pytest.raises(ValueError):
+        paddle.optimizer.AdamW(1e-2, parameters=model2.parameters(), moment2_dtype="fp8")
