@@ -51,6 +51,17 @@ def init_parallel_env():
     if nprocs > 1 and jax.process_count() == 1:
         coordinator = _coordinator_from_env()
         rank = int(os.environ.get("PADDLE_TRAINER_ID", os.environ.get("PADDLE_RANK", "0")))
+        # importing the framework probes devices, which initializes the XLA
+        # backend — jax.distributed.initialize must run first. Drop any
+        # probe-time backend so the rendezvous can re-init it with the
+        # global (multi-process) world view (clear_backends is a cheap
+        # no-op when nothing was initialized).
+        try:
+            from jax.extend.backend import clear_backends
+
+            clear_backends()
+        except Exception:
+            pass
         jax.distributed.initialize(
             coordinator_address=coordinator, num_processes=nprocs, process_id=rank
         )
