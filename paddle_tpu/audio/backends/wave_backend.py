@@ -79,7 +79,10 @@ def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
     if normalize:
         waveform = data.astype(np.float32) / (2 ** 15)
     else:
-        waveform = data
+        # reference behavior (audio_as_np32 in the wave backend): the raw
+        # path still returns float32, just UNSCALED int16 values — code
+        # ported from Paddle does float arithmetic on it
+        waveform = data.astype(np.float32)
     if num_frames != -1:
         waveform = waveform[frame_offset: frame_offset + num_frames, :]
     elif frame_offset:

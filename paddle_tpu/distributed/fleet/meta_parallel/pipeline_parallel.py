@@ -240,6 +240,13 @@ class PipelineParallel(Layer):
                     "hetero compiled pipeline needs a uniform inter-stage "
                     f"activation shape; got {tuple(a._value.shape)} vs {mid_shape}"
                 )
+            if a._value.dtype != mid_dtype:
+                # a dtype change would TypeError inside the compiled scan
+                # carry — refuse here so the engine demotes to eager instead
+                raise NotImplementedError(
+                    "hetero compiled pipeline needs a uniform inter-stage "
+                    f"activation dtype; got {a._value.dtype} vs {mid_dtype}"
+                )
         out_shape = tuple(acts[-1]._value.shape)
         out_dtype = acts[-1]._value.dtype
 

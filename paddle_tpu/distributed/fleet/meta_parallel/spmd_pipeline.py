@@ -101,7 +101,11 @@ def pipeline_spmd(stage_fn: Callable, mesh: Mesh, axis: str = "pp", checkpoint_s
     microbatches: pytree of [M, ...] micro-batch streams (replicated over the
     pipeline axis; sharded over `data_axis` on the batch dim when given —
     the dp x pp composition: each dp slice runs its own micro-batch stream
-    through the same pp ring).
+    through the same pp ring). RANK CONTRACT when `data_axis` is set: every
+    micro-batch leaf must be [M, B, ...] (batch at dim 1) and every stage
+    output leaf >= 2-D — the shard specs below assume it. `run` validates
+    the INPUT leaves loudly; a 1-D stage OUTPUT still surfaces as a
+    PartitionSpec rank error from jit (outputs aren't known until trace).
     param_specs: optional pytree of PartitionSpec matching stacked_params
     (each spec must lead with the stage axis). Extra axes express hybrid
     layouts: P(axis, None, 'tp') for Megatron-style stages whose stage_fn
@@ -152,6 +156,14 @@ def pipeline_spmd(stage_fn: Callable, mesh: Mesh, axis: str = "pp", checkpoint_s
     def run(stacked_params, microbatches):
         leaves = jax.tree_util.tree_leaves(microbatches)
         M = leaves[0].shape[0]
+        if data_axis:
+            bad = [tuple(l.shape) for l in leaves if l.ndim < 2]
+            if bad:
+                raise ValueError(
+                    "pipeline_spmd(data_axis=...) requires every micro-batch "
+                    f"leaf to be [M, B, ...] (batch at dim 1); got leaves of "
+                    f"shape {bad}"
+                )
         ys = sharded(stacked_params, microbatches)  # [S, M+S-1, ...]
         # final stage's outputs for micro-batch m appear at t = m + S - 1
         return jax.tree_util.tree_map(lambda l: l[S - 1, S - 1 : M + S - 1], ys)
