@@ -1,15 +1,23 @@
 """Benchmark: ERNIE-3.0-base MLM pretrain throughput on one TPU chip.
 
-Two operating points (round 4):
+Three operating points (round 5):
   A. seq 128, batch 64  — the historical headline (BASELINE.json metric
      "ERNIE-3.0 tokens/sec/chip"); matmul-dominated.
-  B. seq 4096, batch 2  — the long-context point where the Pallas flash
+  B. seq 4096, batch 2-3 — the long-context point where the Pallas flash
      attention kernel IS the auto-dispatched path (gate is S >= 512) and
      attention is ~40% of the step. Same ERNIE-3.0-base dims (12 layers,
      hidden 768, ffn 3072) with the TPU-native head shape 6 heads x 128:
      the MXU is 128 lanes wide, so head_dim 64 runs every attention matmul
      at half utilization (measured: fwd+bwd 6.9 ms vs 2.7 ms per layer at
      S=4096). Param count is identical to the 12x64 config.
+     ROUND 5: runs with attention_probs_dropout_prob=0.1 — the REAL
+     ERNIE/BERT pretrain regime (r4 VERDICT Missing #1) — now that the
+     kernel applies dropout in-kernel via the stateless position hash.
+  C. Llama-3-8B layer shape (BASELINE configs[4]): hidden 4096, 32q/8kv
+     GQA heads at head_dim 128, SwiGLU ffn 14336, seq 4096, causal — as
+     many decoder layers as fit one chip's HBM with AdamW state (2).
+     Exercises the kernel's native GQA head-group mapping (no repeated
+     KV materialization).
 
 The reference publishes no tokens/s number (BASELINE.md records
 published: {}), so vs_baseline reports measured MFU as the comparable
@@ -42,7 +50,7 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 
-def build_train_step(batch, seq, heads, max_pos=None):
+def build_train_step(batch, seq, heads, max_pos=None, attn_dropout=0.0):
     """The benchmark workload: ERNIE-3.0-base dims MLM + AdamW, bf16 AMP,
     to_static. Shared with benchmarks/profile_xplane.py so the profiled
     model is BY CONSTRUCTION the benchmarked model."""
@@ -56,7 +64,7 @@ def build_train_step(batch, seq, heads, max_pos=None):
         ErnieModel(
             vocab_size=40000, hidden_size=768, num_hidden_layers=12,
             num_attention_heads=heads, intermediate_size=3072,
-            hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+            hidden_dropout_prob=0.0, attention_probs_dropout_prob=attn_dropout,
             max_position_embeddings=max_pos if max_pos is not None else max(512, seq),
         )
     )
@@ -78,9 +86,11 @@ def build_train_step(batch, seq, heads, max_pos=None):
     return model, train_step, ids, labels
 
 
-def _build(batch, seq, heads, max_pos, steps):
+def _build(batch, seq, heads, max_pos, steps, attn_dropout=0.0):
     """Build one config and return its measured stats."""
-    model, train_step, ids, labels = build_train_step(batch, seq, heads, max_pos)
+    model, train_step, ids, labels = build_train_step(
+        batch, seq, heads, max_pos, attn_dropout
+    )
 
     def run(n):
         """n steps ending in a host fetch (forces the whole chain)."""
@@ -112,10 +122,185 @@ def _build(batch, seq, heads, max_pos, steps):
         "seq": seq,
         "heads": heads,
         "steps": steps,
+        "attn_dropout": attn_dropout,
         "ms_per_step": round(dt_step * 1000, 2),
         "tokens_per_sec": round(batch * seq / dt_step, 1),
         "final_loss": final_loss,
         "flops_per_token": flops_per_token,
+    }
+
+
+def _build_llama(steps):
+    """Llama-3-8B layer shape on one chip (BASELINE configs[4]): hidden
+    4096, GQA 32q/8kv at head_dim 128, SwiGLU ffn 14336, seq 4096, causal
+    flash attention with native GQA. 2 decoder layers + 32k vocab fit the
+    chip's HBM with AdamW moments (~0.6B params * 12 bytes)."""
+    import time
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaForCausalLM
+
+    batch, seq, hidden, layers = 1, 4096, 4096, 2
+    paddle.seed(0)
+    model = LlamaForCausalLM(
+        vocab_size=32000, hidden_size=hidden, num_hidden_layers=layers,
+        num_attention_heads=32, num_key_value_heads=8,
+        intermediate_size=14336,
+    )
+    opt = paddle.optimizer.AdamW(1e-4, parameters=model.parameters(), weight_decay=0.01)
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, 32000, (batch, seq)).astype(np.int64))
+    labels = paddle.to_tensor(rng.randint(0, 32000, (batch, seq)).astype(np.int64))
+
+    @paddle.jit.to_static
+    def train_step(ids, labels):
+        with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+            loss, _ = model(ids, labels=labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    def run(n):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            loss = train_step(ids, labels)
+        val = float(loss.numpy())
+        return time.perf_counter() - t0, val
+
+    run(3)
+    short = max(2, steps // 4)
+    t_short, _ = run(short)
+    t_long, final_loss = run(steps)
+    dt_step = (t_long - t_short) / (steps - short)
+
+    # 6 * matmul params (embedding excluded: lookup-only on input; lm_head
+    # is untied and counts via its own matmul) + causal attention
+    # 6 * S * hidden per layer (half the bidirectional 12: lower-triangle
+    # scores only — both kernels skip fully-masked tiles)
+    n_params = sum(p.size for p in model.parameters())
+    embed = model.llama.embed_tokens.weight.size
+    flops_per_token = 6 * (n_params - embed) + 6 * seq * hidden * layers
+    return {
+        "batch": batch,
+        "seq": seq,
+        "heads": "32q/8kv",
+        "layers": layers,
+        "steps": steps,
+        "ms_per_step": round(dt_step * 1000, 2),
+        "tokens_per_sec": round(batch * seq / dt_step, 1),
+        "final_loss": final_loss,
+        "flops_per_token": flops_per_token,
+    }
+
+
+def _release_device_memory():
+    """Drop compiled executables + dead buffers between configs — the
+    Llama-shaped config holds ~8GB of AdamW state; without this the peak
+    re-measure after it can RESOURCE_EXHAUST on the 16GB chip."""
+    import gc
+
+    import jax
+
+    gc.collect()
+    jax.clear_caches()
+    gc.collect()
+
+
+def _build_resnet(steps):
+    """BASELINE configs[0]: ResNet-50 ImageNet classification images/sec,
+    synthetic data, bf16 AMP, Momentum+CE — measured BOTH dygraph-eager and
+    @to_static (the north-star metric line names ResNet-50 images/sec)."""
+    import time
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.vision.models import resnet50
+
+    batch = int(os.environ.get("BENCH_RESNET_BATCH", 64))
+    paddle.seed(0)
+    model = resnet50(num_classes=1000)
+    opt = paddle.optimizer.Momentum(0.1, parameters=model.parameters(), weight_decay=1e-4)
+    rng = np.random.RandomState(0)
+    imgs = paddle.to_tensor(rng.randn(batch, 3, 224, 224).astype(np.float32))
+    labels = paddle.to_tensor(rng.randint(0, 1000, (batch,)).astype(np.int64))
+
+    def step_body(imgs, labels):
+        with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+            logits = model(imgs)
+            loss = paddle.nn.functional.cross_entropy(logits, labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    static_step = paddle.jit.to_static(step_body)
+
+    def measure(fn, n_steps):
+        def run(n):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                loss = fn(imgs, labels)
+            val = float(loss.numpy())  # host fetch forces the chain
+            return time.perf_counter() - t0, val
+
+        run(3)
+        short = max(2, n_steps // 4)
+        t_short, _ = run(short)
+        t_long, final_loss = run(n_steps)
+        return (t_long - t_short) / (n_steps - short), final_loss
+
+    dt_static, loss_static = measure(static_step, steps)
+    dt_eager, _ = measure(step_body, max(4, steps // 4))
+    return {
+        "batch": batch,
+        "ms_per_step": round(dt_static * 1000, 2),
+        "images_per_sec": round(batch / dt_static, 1),
+        "images_per_sec_dygraph": round(batch / dt_eager, 1),
+        "final_loss": loss_static,
+    }
+
+
+def _build_ppocr(n_images=8):
+    """BASELINE configs[2]: PP-OCR det+rec end-to-end latency on one chip —
+    DBNet detection + per-box host crop/resize + CRNN recognition (the
+    models/ocr.py pipeline; synthetic 640x640 pages with text-like boxes)."""
+    import time
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.ocr import OCRSystem
+
+    paddle.seed(0)
+    sys_ = OCRSystem()
+    sys_.eval()
+    rng = np.random.RandomState(0)
+    # synthetic page: background + a few bright rectangles (detector finds
+    # SOMETHING so rec runs; content doesn't matter for throughput)
+    img = rng.rand(1, 3, 640, 640).astype(np.float32) * 0.1
+    for y, x in ((100, 80), (300, 200), (480, 360)):
+        img[:, :, y : y + 40, x : x + 220] = 1.0
+
+    def run(n):
+        t0 = time.perf_counter()
+        res = None
+        for _ in range(n):
+            res = sys_(paddle.to_tensor(img))
+        return time.perf_counter() - t0, res
+
+    run(2)  # warm + compile
+    t_short, _ = run(max(2, n_images // 4))
+    t_long, res = run(n_images)
+    dt = (t_long - t_short) / (n_images - max(2, n_images // 4))
+    n_boxes = len(res[0]) if res else 0
+    return {
+        "ms_per_image": round(dt * 1000, 2),
+        "images_per_sec": round(1.0 / dt, 2),
+        "boxes_recognized": n_boxes,
     }
 
 
@@ -128,6 +313,7 @@ def main():
     peaks = [_measured_peak_flops()]
 
     res_a = _build(batch, seq, heads=12, max_pos=max(512, seq), steps=steps)
+    _release_device_memory()
     peaks.append(_measured_peak_flops())
 
     res_b = None
@@ -135,16 +321,36 @@ def main():
         # batch 3 fits the tunnel's HBM today (measured: MFU ~0.70 vs ~0.68
         # at batch 2 — the fixed AdamW/copy costs amortize over 1.5x
         # tokens), but headroom varies run to run on the shared tunnel, so
-        # fall back to batch 2 on OOM instead of failing the bench
+        # fall back to batch 2 on OOM instead of failing the bench.
+        # attn_dropout=0.1: the real pretrain regime (in-kernel dropout, r5)
         for b4096 in (3, 2):
             try:
                 res_b = _build(batch=b4096, seq=4096, heads=6, max_pos=4096,
-                               steps=max(10, steps // 2))
+                               steps=max(10, steps // 2), attn_dropout=0.1)
                 break
             except Exception as e:  # jax RESOURCE_EXHAUSTED surfaces as RuntimeError
                 if b4096 == 2 or "RESOURCE_EXHAUSTED" not in str(e):
                     raise
+                _release_device_memory()
+        _release_device_memory()
         peaks.append(_measured_peak_flops())
+
+    res_c = None
+    if not os.environ.get("BENCH_SKIP_LLAMA", "").lower() in ("1", "true", "yes"):
+        try:
+            res_c = _build_llama(steps=max(8, steps // 4))
+        except Exception as e:
+            if "RESOURCE_EXHAUSTED" not in str(e):
+                raise
+        _release_device_memory()
+        peaks.append(_measured_peak_flops())
+
+    res_rn = res_ocr = None
+    if not os.environ.get("BENCH_SKIP_VISION", "").lower() in ("1", "true", "yes"):
+        res_rn = _build_resnet(steps=max(10, steps // 2))
+        _release_device_memory()
+        res_ocr = _build_ppocr()
+        _release_device_memory()
 
     def mfu(res, peak_pair):
         peak = sum(peak_pair) / len(peak_pair)
@@ -171,8 +377,34 @@ def main():
             "note": (
                 "heads 6x128 = TPU-native head shape (param count identical "
                 "to 12x64; MXU is 128 lanes); Pallas flash kernel dispatched "
-                "(gate S>=512)"
+                "(gate S>=512) WITH in-kernel attention dropout 0.1 — the "
+                "real pretrain regime (r5)"
             ),
+        }
+    if res_c is not None:
+        pi = 2 if res_b is not None else 1
+        mfu_c, peak_c = mfu(res_c, peaks[pi:pi + 2])
+        detail["llama3_shape"] = {
+            **{k: v for k, v in res_c.items() if k != "flops_per_token"},
+            "mfu": round(mfu_c, 4),
+            "co_measured_peak_tflops": round(peak_c / 1e12, 1),
+            "note": (
+                "Llama-3-8B layer dims (hidden 4096, GQA 32q/8kv, ffn "
+                "14336), 2 layers on one chip; causal flash with native "
+                "GQA head-group mapping (no repeated KV)"
+            ),
+        }
+    if res_rn is not None:
+        detail["resnet50"] = {
+            **res_rn,
+            "note": "BASELINE configs[0]: synthetic ImageNet, bf16 AMP, "
+                    "Momentum; images_per_sec = @to_static, *_dygraph = eager",
+        }
+    if res_ocr is not None:
+        detail["ppocr_e2e"] = {
+            **res_ocr,
+            "note": "BASELINE configs[2]: DBNet det + CRNN rec end-to-end "
+                    "(device inference + host box crop/CTC decode)",
         }
 
     print(
@@ -191,22 +423,35 @@ def main():
 def _measured_peak_flops(n=16384, iters=10):
     """Best sustained bf16 matmul rate: the chain runs inside ONE compiled
     fori_loop (no per-iter dispatch) and ends in a host-fetched scalar so
-    deferred-execution backends can't skip the work."""
+    deferred-execution backends can't skip the work. Falls back to n=8192
+    if the 16k operands don't fit the HBM headroom left after a big config
+    (8192^3 x 2 x iters is still ~11 TFLOP per fetch — saturating)."""
     import time
 
     import jax
     import jax.numpy as jnp
     import numpy as np
 
-    a = jnp.asarray(np.random.randn(n, n), jnp.bfloat16)
-    b = jnp.asarray(np.eye(n) + 1e-3, jnp.bfloat16)
+    try:
+        a = jnp.asarray(np.random.randn(n, n), jnp.bfloat16)
+        b = jnp.asarray(np.eye(n) + 1e-3, jnp.bfloat16)
+        jax.block_until_ready((a, b))
+    except Exception as e:
+        if "RESOURCE_EXHAUSTED" not in str(e) or n <= 8192:
+            raise
+        return _measured_peak_flops(n=8192, iters=iters * 4)
 
     @jax.jit
     def chain(a, b):
         c = jax.lax.fori_loop(0, iters, lambda i, c: c @ b, a)
         return jnp.sum(c.astype(jnp.float32))
 
-    float(chain(a, b))  # warm + compile
+    try:
+        float(chain(a, b))  # warm + compile
+    except Exception as e:
+        if "RESOURCE_EXHAUSTED" not in str(e) or n <= 8192:
+            raise
+        return _measured_peak_flops(n=8192, iters=iters * 4)
     best = float("inf")
     for _ in range(3):
         t0 = time.perf_counter()
