@@ -86,6 +86,19 @@ def build_train_step(batch, seq, heads, max_pos=None, attn_dropout=0.0):
     return model, train_step, ids, labels
 
 
+def _slope_measure(run, steps, warm=3):
+    """Shared slope-timing harness: `run(n)` does n iterations ENDING IN A
+    HOST FETCH and returns (seconds, final_value). Per-step time is the
+    slope between a short and a long run — the constant fetch latency
+    cancels (see module docstring). Every config uses this one helper so
+    the methodology cannot drift between configs."""
+    run(warm)  # recording run + compile + steady steps
+    short = max(2, steps // 4)
+    t_short, _ = run(short)
+    t_long, final = run(steps)
+    return (t_long - t_short) / (steps - short), final
+
+
 def _build(batch, seq, heads, max_pos, steps, attn_dropout=0.0):
     """Build one config and return its measured stats."""
     model, train_step, ids, labels = build_train_step(
@@ -100,13 +113,7 @@ def _build(batch, seq, heads, max_pos, steps, attn_dropout=0.0):
         val = float(loss.numpy())
         return time.perf_counter() - t0, val
 
-    # warmup: recording run + compile + steady steps
-    run(3)
-    short = max(2, steps // 4)
-    t_short, _ = run(short)
-    t_long, final_loss = run(steps)
-    # slope: per-step time with the constant fetch latency cancelled
-    dt_step = (t_long - t_short) / (steps - short)
+    dt_step, final_loss = _slope_measure(run, steps)
 
     # MFU numerator: 6 * matmul-params per token (fwd+bwd; word embeddings
     # are a lookup on input BUT also the tied MLM decoder matmul, so they
@@ -170,11 +177,7 @@ def _build_llama(steps):
         val = float(loss.numpy())
         return time.perf_counter() - t0, val
 
-    run(3)
-    short = max(2, steps // 4)
-    t_short, _ = run(short)
-    t_long, final_loss = run(steps)
-    dt_step = (t_long - t_short) / (steps - short)
+    dt_step, final_loss = _slope_measure(run, steps)
 
     # 6 * matmul params (embedding excluded: lookup-only on input; lm_head
     # is untied and counts via its own matmul) + causal attention
@@ -247,11 +250,7 @@ def _build_resnet(steps):
             val = float(loss.numpy())  # host fetch forces the chain
             return time.perf_counter() - t0, val
 
-        run(3)
-        short = max(2, n_steps // 4)
-        t_short, _ = run(short)
-        t_long, final_loss = run(n_steps)
-        return (t_long - t_short) / (n_steps - short), final_loss
+        return _slope_measure(run, n_steps)
 
     dt_static, loss_static = measure(static_step, steps)
     dt_eager, _ = measure(step_body, max(4, steps // 4))
@@ -264,47 +263,89 @@ def _build_resnet(steps):
     }
 
 
-def _build_ppocr(n_images=8):
-    """BASELINE configs[2]: PP-OCR det+rec end-to-end latency on one chip —
-    DBNet detection + per-box host crop/resize + CRNN recognition (the
-    models/ocr.py pipeline; synthetic 640x640 pages with text-like boxes)."""
+def _build_ppocr(n_images=8, n_boxes=3):
+    """BASELINE configs[2]: PP-OCR det+rec end-to-end latency on one chip.
+    The weights are untrained, so DBNet's box output on a synthetic page is
+    arbitrary — det and rec are therefore timed EXPLICITLY (det forward +
+    postprocess on the full page; CRNN on a fixed batch of n_boxes crops +
+    CTC decode) and e2e = det + rec, the pipeline models/ocr.py runs."""
     import time
 
     import numpy as np
 
     import paddle_tpu as paddle
-    from paddle_tpu.models.ocr import OCRSystem
+    from paddle_tpu.models.ocr import OCRSystem, ctc_greedy_decode, db_postprocess
 
     paddle.seed(0)
     sys_ = OCRSystem()
     sys_.eval()
     rng = np.random.RandomState(0)
-    # synthetic page: background + a few bright rectangles (detector finds
-    # SOMETHING so rec runs; content doesn't matter for throughput)
-    img = rng.rand(1, 3, 640, 640).astype(np.float32) * 0.1
-    for y, x in ((100, 80), (300, 200), (480, 360)):
-        img[:, :, y : y + 40, x : x + 220] = 1.0
+    img = paddle.to_tensor(rng.rand(1, 3, 640, 640).astype(np.float32))
+    crops = paddle.to_tensor(
+        rng.rand(n_boxes, *sys_.rec_image_shape).astype(np.float32)
+    )
 
-    def run(n):
-        t0 = time.perf_counter()
-        res = None
-        for _ in range(n):
-            res = sys_(paddle.to_tensor(img))
-        return time.perf_counter() - t0, res
+    def det_once():
+        prob = sys_.det(img)
+        return db_postprocess(prob)
 
-    run(2)  # warm + compile
-    t_short, _ = run(max(2, n_images // 4))
-    t_long, res = run(n_images)
-    dt = (t_long - t_short) / (n_images - max(2, n_images // 4))
-    n_boxes = len(res[0]) if res else 0
+    def rec_once():
+        return ctc_greedy_decode(sys_.rec(crops))
+
+    def measure(fn, n_steps):
+        def run(n):
+            t0 = time.perf_counter()
+            out = None
+            for _ in range(n):
+                out = fn()  # both fns end host-side (numpy postprocess)
+            return time.perf_counter() - t0, out
+
+        return _slope_measure(run, n_steps, warm=2)[0]
+
+    det_s = measure(det_once, n_images)
+    rec_s = measure(rec_once, n_images)
+    e2e = det_s + rec_s
     return {
-        "ms_per_image": round(dt * 1000, 2),
-        "images_per_sec": round(1.0 / dt, 2),
-        "boxes_recognized": n_boxes,
+        "det_ms_per_image": round(det_s * 1000, 2),
+        "rec_ms_per_batch": round(rec_s * 1000, 2),
+        "rec_boxes": n_boxes,
+        "ms_per_image_e2e": round(e2e * 1000, 2),
+        "images_per_sec": round(1.0 / e2e, 2),
     }
 
 
+def _run_config_child(kind, steps):
+    """Run one bench config in a child process (HBM released at exit).
+    Returns the config's stats dict, or None on child RESOURCE_EXHAUSTED."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["BENCH_CHILD"] = kind
+    env["BENCH_CHILD_STEPS"] = str(steps)
+    r = subprocess.run(
+        [sys.executable, os.path.abspath(__file__)],
+        env=env, capture_output=True, text=True, timeout=3600,
+    )
+    if r.returncode != 0:
+        if "RESOURCE_EXHAUSTED" in r.stderr:
+            # distinguishable from BENCH_SKIP_*: the detail records WHY
+            print(f"bench child {kind}: RESOURCE_EXHAUSTED, skipped", file=sys.stderr)
+            return {"skipped": "RESOURCE_EXHAUSTED"}
+        raise RuntimeError(f"bench child {kind} failed:\n{r.stderr[-3000:]}")
+    return json.loads(r.stdout.strip().splitlines()[-1])
+
+
 def main():
+    child = os.environ.get("BENCH_CHILD")
+    if child:
+        steps_c = int(os.environ.get("BENCH_CHILD_STEPS", 8))
+        if child == "llama":
+            print(json.dumps(_build_llama(steps=steps_c)))
+        else:
+            raise ValueError(f"unknown BENCH_CHILD {child}")
+        return
+
     steps = max(10, int(os.environ.get("BENCH_STEPS", 30)))
     batch = int(os.environ.get("BENCH_BATCH", 64))
     seq = int(os.environ.get("BENCH_SEQ", 128))
@@ -337,12 +378,10 @@ def main():
 
     res_c = None
     if not os.environ.get("BENCH_SKIP_LLAMA", "").lower() in ("1", "true", "yes"):
-        try:
-            res_c = _build_llama(steps=max(8, steps // 4))
-        except Exception as e:
-            if "RESOURCE_EXHAUSTED" not in str(e):
-                raise
-        _release_device_memory()
+        # run in a SUBPROCESS: the config holds ~8GB of AdamW state and the
+        # tunnel does not reliably return freed HBM to later allocations in
+        # the same client — process exit is the only guaranteed release
+        res_c = _run_config_child("llama", max(8, steps // 4))
         peaks.append(_measured_peak_flops())
 
     res_rn = res_ocr = None
@@ -381,6 +420,9 @@ def main():
                 "real pretrain regime (r5)"
             ),
         }
+    if res_c is not None and "skipped" in res_c:
+        detail["llama3_shape"] = res_c
+        res_c = None
     if res_c is not None:
         pi = 2 if res_b is not None else 1
         mfu_c, peak_c = mfu(res_c, peaks[pi:pi + 2])
@@ -432,6 +474,7 @@ def _measured_peak_flops(n=16384, iters=10):
     import jax.numpy as jnp
     import numpy as np
 
+    a = b = None
     try:
         a = jnp.asarray(np.random.randn(n, n), jnp.bfloat16)
         b = jnp.asarray(np.eye(n) + 1e-3, jnp.bfloat16)
@@ -439,6 +482,8 @@ def _measured_peak_flops(n=16384, iters=10):
     except Exception as e:
         if "RESOURCE_EXHAUSTED" not in str(e) or n <= 8192:
             raise
+        del a, b  # release the failed 16k operands before the retry
+        _release_device_memory()
         return _measured_peak_flops(n=8192, iters=iters * 4)
 
     @jax.jit
@@ -451,6 +496,8 @@ def _measured_peak_flops(n=16384, iters=10):
     except Exception as e:
         if "RESOURCE_EXHAUSTED" not in str(e) or n <= 8192:
             raise
+        del a, b
+        _release_device_memory()
         return _measured_peak_flops(n=8192, iters=iters * 4)
     best = float("inf")
     for _ in range(3):

@@ -52,8 +52,12 @@ def bench_attn(attn, q, k, v, w, tag):
 
 
 def main():
-    # ERNIE-base-like head config, bf16, total tokens held ~constant
+    # ERNIE-base-like head config, bf16, total tokens held ~constant.
+    # ATTN_DROPOUT=0.1 re-runs the sweep with in-kernel dropout (r5: both
+    # paths apply the SAME position-hash mask, so this is apples-to-apples)
     H, D = 12, 64
+    p_drop = float(os.environ.get("ATTN_DROPOUT", "0"))
+    seed = jnp.asarray(1234, jnp.int32)
     for S in [128, 256, 512, 1024, 2048, 4096]:
         B = max(1, 8192 // S)
         rng = np.random.RandomState(0)
@@ -63,12 +67,14 @@ def main():
         w = jnp.asarray(rng.randn(B, S, H, D), jnp.bfloat16)
 
         t_flash = bench_attn(
-            lambda q, k, v: pallas_ops.flash_attention_bshd(q, k, v, causal=False),
+            lambda q, k, v: pallas_ops.flash_attention_bshd(
+                q, k, v, causal=False, dropout_p=p_drop, dropout_seed=seed),
             q, k, v, w, "flash")
         t_ref = bench_attn(
-            lambda q, k, v: pallas_ops._ref_attention_bshd(q, k, v, False, None),
+            lambda q, k, v: pallas_ops._ref_attention_bshd(
+                q, k, v, False, None, dropout_p=p_drop, seed=seed),
             q, k, v, w, "ref")
-        print(f"B={B:3d} S={S:5d}: flash {t_flash*1000:7.2f} ms  "
+        print(f"B={B:3d} S={S:5d} p={p_drop}: flash {t_flash*1000:7.2f} ms  "
               f"xla-ref {t_ref*1000:7.2f} ms  -> {'FLASH' if t_flash < t_ref else 'XLA'}")
 
 

@@ -1,0 +1,95 @@
+"""Per-op device-time breakdown of the ResNet-50 train step (BASELINE
+configs[0]) — names the conv share of the step (r4 VERDICT next-round #3).
+
+Same xplane parsing as profile_xplane.py; the step builder is bench.py's
+_build_resnet workload by construction (resnet50 + Momentum + bf16 AMP +
+to_static on synthetic ImageNet shapes).
+
+Run: python benchmarks/profile_resnet.py
+"""
+import glob
+import gzip
+import json
+import os
+import sys
+import tempfile
+from collections import defaultdict
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+
+import paddle_tpu as paddle
+
+
+def main():
+    from paddle_tpu.vision.models import resnet50
+
+    batch = int(os.environ.get("BENCH_RESNET_BATCH", 64))
+    paddle.seed(0)
+    model = resnet50(num_classes=1000)
+    opt = paddle.optimizer.Momentum(0.1, parameters=model.parameters(), weight_decay=1e-4)
+    rng = np.random.RandomState(0)
+    imgs = paddle.to_tensor(rng.randn(batch, 3, 224, 224).astype(np.float32))
+    labels = paddle.to_tensor(rng.randint(0, 1000, (batch,)).astype(np.int64))
+
+    @paddle.jit.to_static
+    def train_step(imgs, labels):
+        with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+            logits = model(imgs)
+            loss = paddle.nn.functional.cross_entropy(logits, labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    for _ in range(4):
+        loss = train_step(imgs, labels)
+    float(loss.numpy())
+
+    tdir = tempfile.mkdtemp(prefix="xplane_rn_")
+    jax.profiler.start_trace(tdir)
+    NSTEP = 3
+    for _ in range(NSTEP):
+        loss = train_step(imgs, labels)
+    float(loss.numpy())
+    jax.profiler.stop_trace()
+
+    traces = glob.glob(f"{tdir}/**/*.trace.json.gz", recursive=True)
+    d = json.load(gzip.open(traces[0]))
+    evs = d["traceEvents"]
+    dev_pid = next(e["pid"] for e in evs
+                   if e.get("ph") == "M" and e.get("name") == "process_name"
+                   and "TPU" in e["args"]["name"])
+    ops_tid = next(e["tid"] for e in evs
+                   if e.get("ph") == "M" and e.get("name") == "thread_name"
+                   and e["pid"] == dev_pid and e["args"]["name"] == "XLA Ops")
+
+    cat_time = defaultdict(float)
+    op_time = defaultdict(float)
+    total = conv = 0.0
+    for e in evs:
+        if e.get("ph") != "X" or e.get("pid") != dev_pid or e.get("tid") != ops_tid:
+            continue
+        a = e.get("args", {})
+        dur_ms = int(a.get("device_duration_ps", 0)) / 1e9
+        cat = a.get("hlo_category", "?")
+        cat_time[cat] += dur_ms
+        op_time[e["name"]] += dur_ms
+        total += dur_ms
+        if "convolution" in cat or "conv" in e["name"]:
+            conv += dur_ms
+
+    print(f"== ResNet-50 batch {batch}: device {total/NSTEP:.2f} ms/step, "
+          f"conv share {100*conv/total:.1f}% ==")
+    print("\n-- by HLO category --")
+    for cat, t in sorted(cat_time.items(), key=lambda kv: -kv[1]):
+        print(f"{t/NSTEP:9.3f} ms/step  {100*t/total:5.1f}%  {cat}")
+    print("\n-- top 12 ops --")
+    for name, t in sorted(op_time.items(), key=lambda kv: -kv[1])[:12]:
+        print(f"{t/NSTEP:9.3f} ms/step  {name[:80]}")
+
+
+if __name__ == "__main__":
+    main()

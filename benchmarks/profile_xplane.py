@@ -28,8 +28,13 @@ def main():
     batch = int(os.environ.get("BENCH_BATCH", 64))
     seq = int(os.environ.get("BENCH_SEQ", 128))
     heads = int(os.environ.get("BENCH_HEADS", 12))
-    # same builder as bench.py: the profiled model IS the benchmarked model
-    model, train_step, ids, labels = build_train_step(batch, seq, heads)
+    # same builder as bench.py: the profiled model IS the benchmarked model.
+    # BENCH_ATTN_DROPOUT=0.1 matches bench.py's seq-4096 operating point
+    # (in-kernel attention dropout — r5); default 0 matches seq-128.
+    drop = float(os.environ.get("BENCH_ATTN_DROPOUT", "0"))
+    model, train_step, ids, labels = build_train_step(
+        batch, seq, heads, attn_dropout=drop
+    )
 
     # warm + compile
     for _ in range(4):

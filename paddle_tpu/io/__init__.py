@@ -632,7 +632,16 @@ class _MPEpochIter:
 
 
 class DataLoader:
-    """python/paddle/io/reader.py:216 parity."""
+    """python/paddle/io/reader.py:216 parity.
+
+    Worker modes: num_workers=0 is synchronous; num_workers>0 uses the
+    thread + native prefetch ring by default; persistent_workers=True
+    spawns persistent worker PROCESSES (map-style datasets only — needs a
+    picklable dataset/collate_fn/worker_init_fn). If spawn fails (e.g.
+    unpicklable local classes), loading falls back to the thread path with
+    a UserWarning — and `worker_init_fn` does NOT run on that fallback
+    (threads share the parent's state; per-worker init has no process to
+    initialize)."""
 
     def __init__(
         self,
