@@ -141,7 +141,18 @@ def _build_llama(steps):
     """Llama-3-8B layer shape on one chip (BASELINE configs[4]): hidden
     4096, GQA 32q/8kv at head_dim 128, SwiGLU ffn 14336, seq 4096, causal
     flash attention with native GQA. 2 decoder layers + 32k vocab fit the
-    chip's HBM with AdamW moments (~0.6B params * 12 bytes)."""
+    chip's HBM with AdamW moments (~0.7B params * 12 bytes) when the
+    shared tunnel is quiet; falls back to 1 layer when it is not."""
+    for layers in (2, 1):
+        try:
+            return _build_llama_at(steps, layers)
+        except Exception as e:
+            if layers == 1 or "RESOURCE_EXHAUSTED" not in str(e):
+                raise
+            _release_device_memory()
+
+
+def _build_llama_at(steps, layers):
     import time
 
     import numpy as np
@@ -149,7 +160,7 @@ def _build_llama(steps):
     import paddle_tpu as paddle
     from paddle_tpu.models.llama import LlamaForCausalLM
 
-    batch, seq, hidden, layers = 1, 4096, 4096, 2
+    batch, seq, hidden = 1, 4096, 4096
     paddle.seed(0)
     model = LlamaForCausalLM(
         vocab_size=32000, hidden_size=hidden, num_hidden_layers=layers,
@@ -215,15 +226,30 @@ def _release_device_memory():
 def _build_resnet(steps):
     """BASELINE configs[0]: ResNet-50 ImageNet classification images/sec,
     synthetic data, bf16 AMP, Momentum+CE — measured BOTH dygraph-eager and
-    @to_static (the north-star metric line names ResNet-50 images/sec)."""
-    import time
+    @to_static (the north-star metric line names ResNet-50 images/sec).
+    Batch backs off 64 -> 32 -> 16 when the shared tunnel's HBM is tight."""
+    batches = [int(os.environ.get("BENCH_RESNET_BATCH", 64))]
+    while batches[-1] > 16:
+        batches.append(batches[-1] // 2)
+    for i, b in enumerate(batches):
+        try:
+            return _build_resnet_at(steps, b)
+        except Exception as e:
+            if i == len(batches) - 1 or "RESOURCE_EXHAUSTED" not in str(e):
+                raise
+            _release_device_memory()
 
+
+def build_resnet_step(batch):
+    """ResNet-50 train-step builder shared with benchmarks/profile_resnet.py
+    so the profiled model is BY CONSTRUCTION the benchmarked model (same
+    contract as build_train_step for the ERNIE configs). Returns
+    (model, static_step, eager_step, imgs, labels)."""
     import numpy as np
 
     import paddle_tpu as paddle
     from paddle_tpu.vision.models import resnet50
 
-    batch = int(os.environ.get("BENCH_RESNET_BATCH", 64))
     paddle.seed(0)
     model = resnet50(num_classes=1000)
     opt = paddle.optimizer.Momentum(0.1, parameters=model.parameters(), weight_decay=1e-4)
@@ -240,7 +266,13 @@ def _build_resnet(steps):
         opt.clear_grad()
         return loss
 
-    static_step = paddle.jit.to_static(step_body)
+    return model, paddle.jit.to_static(step_body), step_body, imgs, labels
+
+
+def _build_resnet_at(steps, batch):
+    import time
+
+    model, static_step, step_body, imgs, labels = build_resnet_step(batch)
 
     def measure(fn, n_steps):
         def run(n):
@@ -285,12 +317,16 @@ def _build_ppocr(n_images=8, n_boxes=3):
         rng.rand(n_boxes, *sys_.rec_image_shape).astype(np.float32)
     )
 
+    # deployment runs the frozen (compiled) predictor, not eager dispatch —
+    # on the tunnel, eager's per-op latency would swamp the device time
+    det_fwd = paddle.jit.to_static(lambda im: sys_.det(im))
+    rec_fwd = paddle.jit.to_static(lambda c: sys_.rec(c))
+
     def det_once():
-        prob = sys_.det(img)
-        return db_postprocess(prob)
+        return db_postprocess(det_fwd(img))
 
     def rec_once():
-        return ctc_greedy_decode(sys_.rec(crops))
+        return ctc_greedy_decode(rec_fwd(crops))
 
     def measure(fn, n_steps):
         def run(n):
@@ -323,29 +359,64 @@ def _run_config_child(kind, steps):
     env = dict(os.environ)
     env["BENCH_CHILD"] = kind
     env["BENCH_CHILD_STEPS"] = str(steps)
-    r = subprocess.run(
-        [sys.executable, os.path.abspath(__file__)],
-        env=env, capture_output=True, text=True, timeout=3600,
-    )
-    if r.returncode != 0:
-        if "RESOURCE_EXHAUSTED" in r.stderr:
-            # distinguishable from BENCH_SKIP_*: the detail records WHY
-            print(f"bench child {kind}: RESOURCE_EXHAUSTED, skipped", file=sys.stderr)
-            return {"skipped": "RESOURCE_EXHAUSTED"}
-        raise RuntimeError(f"bench child {kind} failed:\n{r.stderr[-3000:]}")
-    return json.loads(r.stdout.strip().splitlines()[-1])
+    for attempt in (1, 2):
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, capture_output=True, text=True, timeout=3600,
+        )
+        if r.returncode == 0:
+            return json.loads(r.stdout.strip().splitlines()[-1])
+        if "RESOURCE_EXHAUSTED" not in r.stderr:
+            raise RuntimeError(f"bench child {kind} failed:\n{r.stderr[-3000:]}")
+        if attempt == 1:
+            # the tunnel reclaims a prior child's HBM asynchronously —
+            # give it a beat and retry once before recording the skip
+            import time as _time
+
+            print(f"bench child {kind}: RESOURCE_EXHAUSTED, retrying in 60s",
+                  file=sys.stderr)
+            _time.sleep(60)
+    # distinguishable from BENCH_SKIP_*: the detail records WHY
+    print(f"bench child {kind}: RESOURCE_EXHAUSTED, skipped", file=sys.stderr)
+    return {"skipped": "RESOURCE_EXHAUSTED"}
+
+
+def _child_4096(steps):
+    # batch 3 fits the tunnel's HBM today (measured: MFU ~0.70 vs ~0.68
+    # at batch 2 — the fixed AdamW/copy costs amortize over 1.5x
+    # tokens), but headroom varies run to run on the shared tunnel, so
+    # fall back to batch 2 on OOM instead of failing the config.
+    # attn_dropout=0.1: the real pretrain regime (in-kernel dropout, r5)
+    for b4096 in (3, 2):
+        try:
+            return _build(batch=b4096, seq=4096, heads=6, max_pos=4096,
+                          steps=steps, attn_dropout=0.1)
+        except Exception as e:  # jax RESOURCE_EXHAUSTED surfaces as RuntimeError
+            if b4096 == 2 or "RESOURCE_EXHAUSTED" not in str(e):
+                raise
+            _release_device_memory()
 
 
 def main():
     child = os.environ.get("BENCH_CHILD")
     if child:
         steps_c = int(os.environ.get("BENCH_CHILD_STEPS", 8))
-        if child == "llama":
-            print(json.dumps(_build_llama(steps=steps_c)))
-        else:
+        builders = {
+            "llama": lambda: _build_llama(steps=steps_c),
+            "ernie4096": lambda: _child_4096(steps_c),
+            "resnet": lambda: _build_resnet(steps=steps_c),
+            "ocr": lambda: _build_ppocr(n_images=steps_c),
+        }
+        if child not in builders:
             raise ValueError(f"unknown BENCH_CHILD {child}")
+        print(json.dumps(builders[child]()))
         return
 
+    # Every heavy config runs in its OWN child process: the tunnel does not
+    # reliably return freed HBM to later allocations in the same client, so
+    # in-process sequencing of multi-GB configs RESOURCE_EXHAUSTs the later
+    # ones. The parent holds only the peak-measure operands (freed per call)
+    # and co-measures the peak between children.
     steps = max(10, int(os.environ.get("BENCH_STEPS", 30)))
     batch = int(os.environ.get("BENCH_BATCH", 64))
     seq = int(os.environ.get("BENCH_SEQ", 128))
@@ -358,38 +429,24 @@ def main():
     peaks.append(_measured_peak_flops())
 
     res_b = None
+    b_skip_note = None
+    b_peak_lo = len(peaks) - 1
     if not skip_4096:
-        # batch 3 fits the tunnel's HBM today (measured: MFU ~0.70 vs ~0.68
-        # at batch 2 — the fixed AdamW/copy costs amortize over 1.5x
-        # tokens), but headroom varies run to run on the shared tunnel, so
-        # fall back to batch 2 on OOM instead of failing the bench.
-        # attn_dropout=0.1: the real pretrain regime (in-kernel dropout, r5)
-        for b4096 in (3, 2):
-            try:
-                res_b = _build(batch=b4096, seq=4096, heads=6, max_pos=4096,
-                               steps=max(10, steps // 2), attn_dropout=0.1)
-                break
-            except Exception as e:  # jax RESOURCE_EXHAUSTED surfaces as RuntimeError
-                if b4096 == 2 or "RESOURCE_EXHAUSTED" not in str(e):
-                    raise
-                _release_device_memory()
-        _release_device_memory()
+        res_b = _run_config_child("ernie4096", max(10, steps // 2))
+        if res_b is not None and "skipped" in res_b:
+            b_skip_note, res_b = res_b, None  # detail records WHY (not a silent drop)
         peaks.append(_measured_peak_flops())
 
     res_c = None
+    c_peak_lo = len(peaks) - 1
     if not os.environ.get("BENCH_SKIP_LLAMA", "").lower() in ("1", "true", "yes"):
-        # run in a SUBPROCESS: the config holds ~8GB of AdamW state and the
-        # tunnel does not reliably return freed HBM to later allocations in
-        # the same client — process exit is the only guaranteed release
         res_c = _run_config_child("llama", max(8, steps // 4))
         peaks.append(_measured_peak_flops())
 
     res_rn = res_ocr = None
     if not os.environ.get("BENCH_SKIP_VISION", "").lower() in ("1", "true", "yes"):
-        res_rn = _build_resnet(steps=max(10, steps // 2))
-        _release_device_memory()
-        res_ocr = _build_ppocr()
-        _release_device_memory()
+        res_rn = _run_config_child("resnet", max(10, steps // 2))
+        res_ocr = _run_config_child("ocr", 8)
 
     def mfu(res, peak_pair):
         peak = sum(peak_pair) / len(peak_pair)
@@ -407,8 +464,10 @@ def main():
             "publishes no number"
         ),
     }
+    if b_skip_note is not None:
+        detail["seq4096"] = b_skip_note
     if res_b is not None:
-        mfu_b, peak_b = mfu(res_b, peaks[1:3])
+        mfu_b, peak_b = mfu(res_b, peaks[b_peak_lo : b_peak_lo + 2])
         detail["seq4096"] = {
             **{k: v for k, v in res_b.items() if k != "flops_per_token"},
             "mfu": round(mfu_b, 4),
@@ -424,8 +483,7 @@ def main():
         detail["llama3_shape"] = res_c
         res_c = None
     if res_c is not None:
-        pi = 2 if res_b is not None else 1
-        mfu_c, peak_c = mfu(res_c, peaks[pi:pi + 2])
+        mfu_c, peak_c = mfu(res_c, peaks[c_peak_lo : c_peak_lo + 2])
         detail["llama3_shape"] = {
             **{k: v for k, v in res_c.items() if k != "flops_per_token"},
             "mfu": round(mfu_c, 4),

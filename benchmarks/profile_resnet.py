@@ -24,25 +24,11 @@ import paddle_tpu as paddle
 
 
 def main():
-    from paddle_tpu.vision.models import resnet50
+    from bench import build_resnet_step
 
     batch = int(os.environ.get("BENCH_RESNET_BATCH", 64))
-    paddle.seed(0)
-    model = resnet50(num_classes=1000)
-    opt = paddle.optimizer.Momentum(0.1, parameters=model.parameters(), weight_decay=1e-4)
-    rng = np.random.RandomState(0)
-    imgs = paddle.to_tensor(rng.randn(batch, 3, 224, 224).astype(np.float32))
-    labels = paddle.to_tensor(rng.randint(0, 1000, (batch,)).astype(np.int64))
-
-    @paddle.jit.to_static
-    def train_step(imgs, labels):
-        with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
-            logits = model(imgs)
-            loss = paddle.nn.functional.cross_entropy(logits, labels)
-        loss.backward()
-        opt.step()
-        opt.clear_grad()
-        return loss
+    # same builder as bench.py: the profiled model IS the benchmarked model
+    model, train_step, _eager, imgs, labels = build_resnet_step(batch)
 
     for _ in range(4):
         loss = train_step(imgs, labels)

@@ -147,34 +147,55 @@ def db_loss(pred, gt_prob, gt_thresh, prob_mask=None, thresh_mask=None, alpha=5.
 def db_postprocess(prob_map, bin_thresh=0.3, box_thresh=0.6, min_area=4):
     """Host-side box extraction from the probability map: connected
     components of the binarized map -> axis-aligned boxes (PaddleOCR uses
-    polygon unclipping via pyclipper; AABBs are the dependency-free form)."""
+    polygon unclipping via pyclipper; AABBs are the dependency-free form).
+    Components come from scipy.ndimage (C-level two-pass labeling — the
+    pure-Python BFS fallback below costs seconds on a 640x640 page)."""
     pm = prob_map.numpy() if isinstance(prob_map, Tensor) else np.asarray(prob_map)
+    try:
+        from scipy import ndimage as ndi
+    except ImportError:
+        ndi = None
     out = []
     for b in range(pm.shape[0]):
         bitmap = pm[b, 0] > bin_thresh
         boxes = []
-        visited = np.zeros_like(bitmap, dtype=bool)
-        h, w = bitmap.shape
-        for y in range(h):
-            for x in range(w):
-                if bitmap[y, x] and not visited[y, x]:
-                    # BFS flood fill
-                    stack = [(y, x)]
-                    visited[y, x] = True
-                    ys, xs = [], []
-                    while stack:
-                        cy, cx = stack.pop()
-                        ys.append(cy)
-                        xs.append(cx)
-                        for dy, dx in ((1, 0), (-1, 0), (0, 1), (0, -1)):
-                            ny, nx = cy + dy, cx + dx
-                            if 0 <= ny < h and 0 <= nx < w and bitmap[ny, nx] and not visited[ny, nx]:
-                                visited[ny, nx] = True
-                                stack.append((ny, nx))
-                    if len(ys) >= min_area:
-                        score = float(pm[b, 0, ys, xs].mean())
-                        if score >= box_thresh:
-                            boxes.append([min(xs), min(ys), max(xs) + 1, max(ys) + 1, score])
+        if ndi is not None:
+            # 4-connectivity to match the BFS fallback's neighbor set
+            labels, n = ndi.label(bitmap, structure=[[0, 1, 0], [1, 1, 1], [0, 1, 0]])
+            if n:
+                idx = np.arange(1, n + 1)
+                areas = ndi.sum_labels(bitmap, labels, idx)
+                scores = ndi.mean(pm[b, 0], labels, idx)
+                keep = (areas >= min_area) & (scores >= box_thresh)
+                slices = ndi.find_objects(labels)
+                for i in np.nonzero(keep)[0]:
+                    sy, sx = slices[i]
+                    boxes.append(
+                        [sx.start, sy.start, sx.stop, sy.stop, float(scores[i])]
+                    )
+        else:
+            visited = np.zeros_like(bitmap, dtype=bool)
+            h, w = bitmap.shape
+            for y in range(h):
+                for x in range(w):
+                    if bitmap[y, x] and not visited[y, x]:
+                        # BFS flood fill
+                        stack = [(y, x)]
+                        visited[y, x] = True
+                        ys, xs = [], []
+                        while stack:
+                            cy, cx = stack.pop()
+                            ys.append(cy)
+                            xs.append(cx)
+                            for dy, dx in ((1, 0), (-1, 0), (0, 1), (0, -1)):
+                                ny, nx = cy + dy, cx + dx
+                                if 0 <= ny < h and 0 <= nx < w and bitmap[ny, nx] and not visited[ny, nx]:
+                                    visited[ny, nx] = True
+                                    stack.append((ny, nx))
+                        if len(ys) >= min_area:
+                            score = float(pm[b, 0, ys, xs].mean())
+                            if score >= box_thresh:
+                                boxes.append([min(xs), min(ys), max(xs) + 1, max(ys) + 1, score])
         out.append(np.asarray(boxes, np.float32).reshape(-1, 5))
     return out
 

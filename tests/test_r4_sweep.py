@@ -34,11 +34,15 @@ class TestAudioIO:
         assert tuple(loaded.shape) == (2, n)
         np.testing.assert_allclose(loaded.numpy(), waveform.numpy(), atol=2e-4)
 
-        # frame windowing + raw int16 + channels_last
+        # frame windowing + raw (unscaled) path + channels_last. r5: the
+        # raw path returns float32 holding UNSCALED int16 values — the
+        # reference wave backend's audio_as_np32 behavior (ADVICE r4)
         part, _ = paddle.audio.load(p, frame_offset=100, num_frames=50,
                                     normalize=False, channels_first=False)
         assert tuple(part.shape) == (50, 2)
-        assert part.numpy().dtype == np.int16
+        assert part.numpy().dtype == np.float32
+        vals = part.numpy()
+        assert np.all(vals == np.round(vals)) and np.abs(vals).max() > 1.5
 
     def test_backend_registry(self):
         assert "wave_backend" in paddle.audio.backends.list_available_backends()
