@@ -137,19 +137,26 @@ def _build(batch, seq, heads, max_pos, steps, attn_dropout=0.0):
     }
 
 
+def _oom_backoff(candidates, build):
+    """THE RESOURCE_EXHAUSTED backoff policy, shared by every config: try
+    build(c) for each candidate in order; on OOM release device memory and
+    try the next; the last candidate's failure propagates."""
+    for i, c in enumerate(candidates):
+        try:
+            return build(c)
+        except Exception as e:  # jax RESOURCE_EXHAUSTED surfaces as RuntimeError
+            if i == len(candidates) - 1 or "RESOURCE_EXHAUSTED" not in str(e):
+                raise
+            _release_device_memory()
+
+
 def _build_llama(steps):
     """Llama-3-8B layer shape on one chip (BASELINE configs[4]): hidden
     4096, GQA 32q/8kv at head_dim 128, SwiGLU ffn 14336, seq 4096, causal
     flash attention with native GQA. 2 decoder layers + 32k vocab fit the
     chip's HBM with AdamW moments (~0.7B params * 12 bytes) when the
     shared tunnel is quiet; falls back to 1 layer when it is not."""
-    for layers in (2, 1):
-        try:
-            return _build_llama_at(steps, layers)
-        except Exception as e:
-            if layers == 1 or "RESOURCE_EXHAUSTED" not in str(e):
-                raise
-            _release_device_memory()
+    return _oom_backoff((2, 1), lambda layers: _build_llama_at(steps, layers))
 
 
 def _build_llama_at(steps, layers):
@@ -230,14 +237,8 @@ def _build_resnet(steps):
     Batch backs off 64 -> 32 -> 16 when the shared tunnel's HBM is tight."""
     batches = [int(os.environ.get("BENCH_RESNET_BATCH", 64))]
     while batches[-1] > 16:
-        batches.append(batches[-1] // 2)
-    for i, b in enumerate(batches):
-        try:
-            return _build_resnet_at(steps, b)
-        except Exception as e:
-            if i == len(batches) - 1 or "RESOURCE_EXHAUSTED" not in str(e):
-                raise
-            _release_device_memory()
+        batches.append(max(16, batches[-1] // 2))  # floor: never below 16
+    return _oom_backoff(batches, lambda b: _build_resnet_at(steps, b))
 
 
 def build_resnet_step(batch):
@@ -387,14 +388,11 @@ def _child_4096(steps):
     # tokens), but headroom varies run to run on the shared tunnel, so
     # fall back to batch 2 on OOM instead of failing the config.
     # attn_dropout=0.1: the real pretrain regime (in-kernel dropout, r5)
-    for b4096 in (3, 2):
-        try:
-            return _build(batch=b4096, seq=4096, heads=6, max_pos=4096,
-                          steps=steps, attn_dropout=0.1)
-        except Exception as e:  # jax RESOURCE_EXHAUSTED surfaces as RuntimeError
-            if b4096 == 2 or "RESOURCE_EXHAUSTED" not in str(e):
-                raise
-            _release_device_memory()
+    return _oom_backoff(
+        (3, 2),
+        lambda b: _build(batch=b, seq=4096, heads=6, max_pos=4096,
+                         steps=steps, attn_dropout=0.1),
+    )
 
 
 def main():
@@ -495,13 +493,13 @@ def main():
             ),
         }
     if res_rn is not None:
-        detail["resnet50"] = {
+        detail["resnet50"] = res_rn if "skipped" in res_rn else {
             **res_rn,
             "note": "BASELINE configs[0]: synthetic ImageNet, bf16 AMP, "
                     "Momentum; images_per_sec = @to_static, *_dygraph = eager",
         }
     if res_ocr is not None:
-        detail["ppocr_e2e"] = {
+        detail["ppocr_e2e"] = res_ocr if "skipped" in res_ocr else {
             **res_ocr,
             "note": "BASELINE configs[2]: DBNet det + CRNN rec end-to-end "
                     "(device inference + host box crop/CTC decode)",

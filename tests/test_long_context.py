@@ -229,3 +229,31 @@ class TestRingFlashKernelPath:
         np.testing.assert_allclose(
             np.asarray(out), np.asarray(_ref(q, k, v, True)), rtol=2e-5, atol=2e-5
         )
+
+
+def test_ring_flash_gqa_kernel_path():
+    """GQA rides the kernel path inside the ring (no repeat anywhere):
+    2-device submesh, S_local=2048, 4q/1kv vs the repeat+dense oracle."""
+    from paddle_tpu.ops import pallas as pk
+    from paddle_tpu.ops import ring_attention as ra
+
+    mesh = Mesh(np.array(jax.devices()[:2]), ("sep",))
+    q, k, v = _qkv(b=1, s=4096, h=4, d=64, hkv=1, seed=5)
+    calls = {"flash": 0}
+    orig = ra._ring_flash_local
+
+    def counted(*a, **kw):
+        calls["flash"] += 1
+        return orig(*a, **kw)
+
+    old_interp, pk._INTERPRET = pk._INTERPRET, True
+    ra._ring_flash_local = counted
+    jax.clear_caches()
+    try:
+        out = ring_attention(q, k, v, mesh=mesh, causal=True)
+    finally:
+        ra._ring_flash_local = orig
+        pk._INTERPRET = old_interp
+    assert calls["flash"] >= 1
+    ref = _ref(q, k, v, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
