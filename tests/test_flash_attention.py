@@ -356,3 +356,32 @@ def test_llama_gqa_dispatches_kernel_without_repeat():
     np.testing.assert_allclose(
         np.asarray(out.numpy(), np.float32), inner, rtol=2e-3, atol=2e-3
     )
+
+
+def test_dropout_mask_consistent_across_tilings():
+    """d=128 wide blocks: the fwd/dq kernels tile at 1024 while dkdv's
+    q-loop caps at 512 — the position-hash mask must regenerate identically
+    under BOTH tilings or gradients silently decorrelate from the forward.
+    Verified against the one-shot jnp oracle (itself a third 'tiling')."""
+    b, s, h, d = 1, 1024, 2, 128
+    p_drop = 0.2
+    q = _rand((b, s, h, d), 0)
+    k = _rand((b, s, h, d), 1)
+    v = _rand((b, s, h, d), 2)
+    g = _rand((b, s, h, d), 3)
+    seed = jnp.asarray(77, jnp.int32)
+    assert pk._pick_block(s, pk._block_cap(d, pk._MAX_BLOCK_Q)) == 1024
+
+    f = lambda q, k, v: pk.flash_attention_bshd(
+        q, k, v, causal=True, dropout_p=p_drop, dropout_seed=seed
+    )
+    fr = lambda q, k, v: pk._ref_attention_bshd(
+        q, k, v, True, None, dropout_p=p_drop, seed=seed
+    )
+    out, vjp = jax.vjp(f, q, k, v)
+    ref, vjpr = jax.vjp(fr, q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=3e-4, atol=1e-4)
+    for got, want, nm in zip(vjp(g), vjpr(g), "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=3e-3, atol=3e-4, err_msg=f"d{nm}"
+        )
