@@ -22,6 +22,7 @@ per-process local tensors in a single-controller world.
 from __future__ import annotations
 
 import functools
+import time
 from typing import List, Optional, Sequence
 
 import numpy as np
@@ -514,21 +515,124 @@ class _StreamNS:
 stream = _StreamNS()
 
 
-# ---- watchdog wiring (reference comm_task_manager.h) ----
+# ---- watchdog + telemetry wiring (reference comm_task_manager.h +
+# DistributedView's communication summaries) ----
+
+
+# which argument carries the INPUT payload, per op: (param name, positional
+# index). Output placeholders (out_tensor, gather lists) must not count —
+# they would double the reported bytes; ops absent here (barrier, wait,
+# batch_isend_irecv) move no accountable payload through this wrapper.
+_PAYLOAD_ARG = {
+    "all_reduce": ("tensor", 0),
+    "all_gather": ("tensor", 1),
+    "broadcast": ("tensor", 0),
+    "reduce": ("tensor", 0),
+    "reduce_scatter": ("tensor_list", 1),
+    "scatter": ("tensor_list", 1),
+    "all_to_all": ("in_tensor_list", 1),
+    "all_to_all_single": ("in_tensor", 1),
+}
+
+
+def _payload_nbytes(op: str, args, kwargs) -> int:
+    """Bytes of the op's input payload operand (lists summed)."""
+    spec = _PAYLOAD_ARG.get(op)
+    if spec is None:
+        return 0
+    pname, idx = spec
+    val = kwargs.get(pname, args[idx] if idx < len(args) else None)
+    total = 0
+    for t in val if isinstance(val, (list, tuple)) else (val,):
+        v = t._value if isinstance(t, Tensor) else t
+        nb = getattr(v, "nbytes", None)
+        if nb is not None:
+            total += int(nb)
+    return total
+
+
+def _find_group(args, kwargs) -> Optional[Group]:
+    g = kwargs.get("group")
+    if g is None:
+        for a in args:
+            if isinstance(a, Group):
+                return a
+    return g
+
+
+# (op, group) -> (calls counter child, bytes counter child, latency histogram
+# child): resolved once, so the per-collective cost is one dict lookup
+# instead of three registry-lock get-or-creates + label-tuple rebuilds
+_metric_children: dict = {}
+
+
+def _coll_metrics(op: str, group: str):
+    key = (op, group)
+    m = _metric_children.get(key)
+    if m is None:
+        from .. import telemetry as _tm
+
+        labels = {"op": op, "group": group}
+        m = _metric_children[key] = (
+            _tm.counter(
+                "paddle_tpu_collective_calls_total",
+                "eager collective invocations", ("op", "group"),
+            ).labels(**labels),
+            _tm.counter(
+                "paddle_tpu_collective_bytes_total",
+                "tensor payload bytes moved by eager collectives", ("op", "group"),
+            ).labels(**labels),
+            _tm.histogram(
+                "paddle_tpu_collective_latency_seconds",
+                "eager collective host-side latency (dispatch to sync)", ("op", "group"),
+            ).labels(**labels),
+        )
+    return m
+
 
 def _watched(fn):
     """Wrap a collective entry point in a CommTask so a hung dispatch/compile
-    (e.g. wedged tunnel) is detected and aborted with diagnostics."""
+    (e.g. wedged tunnel) is detected and aborted with diagnostics; with
+    telemetry enabled, also publish per-op/per-group call, byte, and latency
+    metrics and emit the span as a `Communication` host event so it lands in
+    the chrome trace and the DistributedView summary.
+
+    Note: PADDLE_TPU_TELEMETRY=0 deliberately suppresses the Communication
+    spans too (not just the counters) — the disabled fast path must add no
+    events at all, even under an active Profiler."""
 
     @functools.wraps(fn)
     def inner(*args, **kwargs):
         from .comm_watchdog import comm_task
+        from .. import telemetry as _tm
 
-        g = kwargs.get("group")
-        with comm_task(
-            f"collective.{fn.__name__}", ranks=tuple(getattr(g, "ranks", ()) or ()) or "world"
-        ):
-            return fn(*args, **kwargs)
+        g = _find_group(args, kwargs)
+        op_name = f"collective.{fn.__name__}"
+        task = comm_task(op_name, ranks=tuple(getattr(g, "ranks", ()) or ()) or "world")
+        if not _tm.enabled():
+            with task:
+                return fn(*args, **kwargs)
+
+        from ..profiler.utils import RecordEvent, TracerEventType
+
+        group_label = getattr(g, "name", None) or "_world"
+        nbytes = _payload_nbytes(fn.__name__, args, kwargs)
+        calls_c, bytes_c, lat_c = _coll_metrics(fn.__name__, group_label)
+        calls_c.inc()
+        bytes_c.inc(nbytes)
+        span = RecordEvent(
+            op_name, TracerEventType.Communication,
+            args={"group": group_label, "bytes": nbytes},
+        )
+        t0 = time.perf_counter()
+        try:
+            with task, span:
+                return fn(*args, **kwargs)
+        finally:
+            # observe even when the collective raises: calls_total already
+            # counted this invocation, and diverging count/observe breaks
+            # rate(calls)/rate(latency_count) exactly in failure windows
+            lat_c.observe(time.perf_counter() - t0)
 
     return inner
 

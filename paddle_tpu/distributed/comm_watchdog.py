@@ -41,6 +41,14 @@ _flags.define_flag(
 )
 
 
+def _record_task_metric(name: str, op: str) -> None:
+    """Publish a comm-task lifecycle event into the telemetry registry."""
+    from .. import telemetry as _tm
+
+    if _tm.enabled():
+        _tm.counter(name, "comm watchdog task lifecycle", ("op",)).labels(op=op).inc()
+
+
 class CommTask:
     __slots__ = ("tid", "op", "info", "start", "timeout")
 
@@ -104,6 +112,7 @@ class CommTaskManager:
             self._tasks[t.tid] = t
             self._ensure_thread()
         self._wake.set()
+        _record_task_metric("paddle_tpu_comm_tasks_started_total", op)
         return t.tid
 
     def end_task(self, tid: Optional[int]) -> None:
@@ -145,6 +154,7 @@ class CommTaskManager:
                         dump = "\n".join(x.describe() for x in tasks)
                         with self._lock:
                             self._tasks.pop(t.tid, None)
+                        _record_task_metric("paddle_tpu_comm_tasks_timeout_total", t.op)
                         try:
                             self._handler(t, dump)
                         except Exception:

@@ -13,6 +13,15 @@ import threading
 _lock = threading.Lock()
 _registry: dict = {}
 _meta: dict = {}
+_watchers: dict = {}  # flag name -> [callback(value)]
+
+
+def watch_flag(name: str, fn):
+    """Register a callback fired (outside the registry lock) whenever
+    set_flags changes `name` — lets hot paths cache a flag as a plain bool
+    instead of taking this lock per event (see telemetry.metrics)."""
+    with _lock:
+        _watchers.setdefault(name, []).append(fn)
 
 
 def define_flag(name: str, default, doc: str = ""):
@@ -36,12 +45,17 @@ def define_flag(name: str, default, doc: str = ""):
 
 
 def set_flags(flags: dict):
-    """paddle.set_flags analog."""
+    """paddle.set_flags analog. All-or-nothing: validate every key before
+    applying any, so a typo can't leave the registry half-updated with
+    watchers unfired (which would desync cached gates like telemetry's)."""
     with _lock:
-        for k, v in flags.items():
-            if k not in _registry:
-                raise KeyError(f"unknown flag {k!r}; define_flag it first")
-            _registry[k] = v
+        unknown = [k for k in flags if k not in _registry]
+        if unknown:
+            raise KeyError(f"unknown flag {unknown[0]!r}; define_flag it first")
+        _registry.update(flags)
+        fired = [(fn, v) for k, v in flags.items() for fn in _watchers.get(k, ())]
+    for fn, v in fired:
+        fn(v)
 
 
 def get_flags(flags):

@@ -123,6 +123,16 @@ class StaticFunction:
             return self._fn(*args, **kwargs)
         key = self._guard_key(args, kwargs)
         entry = self._cache.get(key)
+        from .. import telemetry as _tm
+
+        if _tm.enabled():
+            _tm.counter(
+                "paddle_tpu_jit_cache_total",
+                "to_static guard-cache lookups", ("function", "result"),
+            ).labels(
+                function=getattr(self._fn, "__name__", "<fn>"),
+                result="hit" if entry is not None else "miss",
+            ).inc()
         if entry is None:
             entry = self._trace(args, kwargs, key)
             if entry is None:  # recording run already produced the result
@@ -131,6 +141,11 @@ class StaticFunction:
 
     # ---- phase 1: eager recording run ----
     def _trace(self, args, kwargs, key):
+        import time
+
+        from .. import telemetry as _tm
+
+        t0 = time.perf_counter()
         arg_leaves = [l for l in tree_util.tree_leaves((args, kwargs), is_leaf=lambda x: isinstance(x, Tensor)) if isinstance(l, Tensor)]
         rec = _Recorder(exclude_ids={id(t) for t in arg_leaves})
         prev = core_state.set_recorder(rec)
@@ -138,6 +153,16 @@ class StaticFunction:
             out = self._fn(*args, **kwargs)
         finally:
             core_state.set_recorder(prev)
+            if _tm.enabled():
+                fn_label = getattr(self._fn, "__name__", "<fn>")
+                _tm.counter(
+                    "paddle_tpu_jit_trace_total",
+                    "to_static recording-run traces", ("function",),
+                ).labels(function=fn_label).inc()
+                _tm.histogram(
+                    "paddle_tpu_jit_trace_seconds",
+                    "wall time of the to_static eager recording run", ("function",),
+                ).labels(function=fn_label).observe(time.perf_counter() - t0)
 
         state_tensors = list(rec.reads.values())
         grad_tensors = [t for t, _ in rec.grad_writes.values()]

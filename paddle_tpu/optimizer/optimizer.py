@@ -96,8 +96,37 @@ class Optimizer:
         return self._accumulators[name][id(param)]
 
     # ---- the step ----
+    def _record_step(self, body):
+        """Run one optimizer step `body` under telemetry: step counter +
+        wall-time histogram per optimizer class, plus an Optimization span
+        for the profiler. Subclasses overriding step() (LBFGS) route their
+        body through this too so instrumentation stays uniform."""
+        from .. import telemetry as _tm
+
+        if not _tm.enabled():
+            return body()
+        import time
+
+        from ..profiler.utils import RecordEvent, TracerEventType
+
+        cls = type(self).__name__
+        t0 = time.perf_counter()
+        with RecordEvent(f"Optimizer.step#{cls}", TracerEventType.Optimization):
+            out = body()
+        _tm.counter(
+            "paddle_tpu_optimizer_step_total", "optimizer steps", ("optimizer",)
+        ).labels(optimizer=cls).inc()
+        _tm.histogram(
+            "paddle_tpu_optimizer_step_seconds",
+            "host wall time of Optimizer.step", ("optimizer",),
+        ).labels(optimizer=cls).observe(time.perf_counter() - t0)
+        return out
+
     @no_grad()
     def step(self):
+        return self._record_step(self._step_impl)
+
+    def _step_impl(self):
         self._sync_lr()
         self._step_count._replace_value(self._step_count._value + 1)
         for entries in self._collect_entries():
@@ -830,6 +859,9 @@ class LBFGS(Optimizer):
     def step(self, closure=None):
         if closure is None:
             raise ValueError("LBFGS.step requires a closure re-evaluating the loss")
+        return self._record_step(lambda: self._lbfgs_step(closure))
+
+    def _lbfgs_step(self, closure):
         loss = closure()
         params, flat_g = self._gather()
         shapes = [tuple(p._value.shape) for p in params]

@@ -283,14 +283,20 @@ class Profiler:
         includes OperatorView/KernelView/OverView prints it."""
         if self.profiler_result is None:
             return
-        if views is not None:
-            if isinstance(views, SummaryView):
-                views = [views]
-            wanted = {SummaryView.OperatorView, SummaryView.KernelView,
-                      SummaryView.OverView}
-            if not wanted.intersection(views):
-                return
-        print(_build_summary_table(self.profiler_result, sorted_by=sorted_by, time_unit=time_unit))
+        from .profiler_statistic import _build_distributed_table
+
+        if views is not None and isinstance(views, SummaryView):
+            views = [views]
+        op_wanted = views is None or bool(
+            {SummaryView.OperatorView, SummaryView.KernelView, SummaryView.OverView}.intersection(views)
+        )
+        dist_wanted = views is None or SummaryView.DistributedView in views
+        if op_wanted:
+            print(_build_summary_table(self.profiler_result, sorted_by=sorted_by, time_unit=time_unit))
+        if dist_wanted:
+            dist = _build_distributed_table(self.profiler_result, time_unit=time_unit)
+            if dist:
+                print(dist)
 
 
 def load_profiler_result(filename: str):

@@ -62,19 +62,23 @@ class StatisticData:
     def to_chrome_trace(self):
         events = []
         for ev in self.host_events:
-            events.append(
-                {
-                    "name": ev.name,
-                    "cat": ev.event_type,
-                    "ph": "X",
-                    "ts": ev.start_ns / 1e3,  # chrome tracing uses microseconds
-                    "dur": ev.duration_ns / 1e3,
-                    "pid": 0,
-                    "tid": ev.tid,
-                }
-            )
+            entry = {
+                "name": ev.name,
+                "cat": ev.event_type,
+                "ph": "X",
+                "ts": ev.start_ns / 1e3,  # chrome tracing uses microseconds
+                "dur": ev.duration_ns / 1e3,
+                "pid": 0,
+                "tid": ev.tid,
+            }
+            if getattr(ev, "args", None):
+                entry["args"] = dict(ev.args)
+            events.append(entry)
         meta = {"device_trace_dir": self.device_trace_dir}
         return {"traceEvents": events, "metadata": meta}
+
+    def comm_events(self):
+        return [e for e in self.host_events if e.event_type == "Communication"]
 
 
 _UNIT_DIV = {"s": 1e9, "ms": 1e6, "us": 1e3, "ns": 1.0}
@@ -89,6 +93,71 @@ _SORT_KEY = {
     SortedKeys.GPUMax: lambda s: s.max_ns,
     SortedKeys.GPUMin: lambda s: s.min_ns or 0,
 }
+
+
+class CommSummary:
+    """Per (op, group) communication aggregate for the DistributedView."""
+
+    __slots__ = ("op", "group", "calls", "total_ns", "max_ns", "bytes")
+
+    def __init__(self, op, group):
+        self.op = op
+        self.group = group
+        self.calls = 0
+        self.total_ns = 0
+        self.max_ns = 0
+        self.bytes = 0
+
+    def add(self, ev):
+        self.calls += 1
+        self.total_ns += ev.duration_ns
+        self.max_ns = max(self.max_ns, ev.duration_ns)
+        args = getattr(ev, "args", None) or {}
+        self.bytes += int(args.get("bytes", 0))
+
+    @property
+    def avg_ns(self):
+        return self.total_ns / self.calls if self.calls else 0
+
+
+def _comm_summaries(data: StatisticData):
+    """Aggregate Communication spans by (op name, group label)."""
+    table = {}
+    for ev in data.comm_events():
+        args = getattr(ev, "args", None) or {}
+        key = (ev.name, str(args.get("group", "-")))
+        s = table.get(key)
+        if s is None:
+            s = table[key] = CommSummary(*key)
+        s.add(ev)
+    return table
+
+
+def _build_distributed_table(data: StatisticData, time_unit="ms"):
+    """DistributedView parity (reference profiler_statistic.py distributed
+    summary): which collective, on which group, how often, how slow, how
+    many bytes."""
+    rows = sorted(_comm_summaries(data).values(), key=lambda s: s.total_ns, reverse=True)
+    if not rows:
+        return ""
+    div = _UNIT_DIV.get(time_unit, 1e6)
+    name_w = max([len(r.op) for r in rows] + [24]) + 2
+    grp_w = max([len(r.group) for r in rows] + [8]) + 2
+    lines = []
+    lines.append("-" * (name_w + grp_w + 60))
+    lines.append("Distributed Summary (Communication)")
+    lines.append(
+        f"{'Name':<{name_w}}{'Group':<{grp_w}}{'Calls':>8}{'Total(' + time_unit + ')':>14}"
+        f"{'Avg(' + time_unit + ')':>12}{'Max(' + time_unit + ')':>12}{'Bytes':>14}"
+    )
+    lines.append("=" * (name_w + grp_w + 60))
+    for r in rows:
+        lines.append(
+            f"{r.op:<{name_w}}{r.group:<{grp_w}}{r.calls:>8}{r.total_ns / div:>14.4f}"
+            f"{r.avg_ns / div:>12.4f}{r.max_ns / div:>12.4f}{r.bytes:>14}"
+        )
+    lines.append("-" * (name_w + grp_w + 60))
+    return "\n".join(lines)
 
 
 def _build_summary_table(data: StatisticData, sorted_by=SortedKeys.CPUTotal, time_unit="ms"):

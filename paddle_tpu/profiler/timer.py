@@ -69,6 +69,28 @@ class Benchmark:
         self.num_samples = num_samples
         if num_samples is not None and dt > 0:
             self.ips_stat.update(num_samples / dt)
+        self._publish_gauges()
+
+    def _publish_gauges(self):
+        """Mirror the running averages into the telemetry registry so step
+        time / reader cost / ips are scrapeable alongside the other runtime
+        metrics (the role of the reference's fleet metric reporters)."""
+        from .. import telemetry as _tm
+
+        if not _tm.enabled():
+            return
+        _tm.gauge(
+            "paddle_tpu_benchmark_reader_cost_seconds",
+            "avg dataloader wait per step (post-warmup)",
+        ).set(self.reader_cost.avg)
+        _tm.gauge(
+            "paddle_tpu_benchmark_batch_cost_seconds",
+            "avg step wall time (post-warmup)",
+        ).set(self.batch_cost.avg)
+        if self.ips_stat.count:
+            _tm.gauge(
+                "paddle_tpu_benchmark_ips", "avg items/sec (post-warmup)"
+            ).set(self.ips_stat.avg)
 
     def end(self):
         self.running = False

@@ -4,7 +4,8 @@ Reference parity: python/paddle/profiler/utils.py (RecordEvent, in_profiler_mode
 and the host tracer side of paddle/fluid/platform/profiler/host_tracer.cc. The
 device side is XLA's own xplane tracer (jax.profiler), wired in profiler.py —
 host events here capture Python-level spans (dataloader, forward, backward,
-optimizer) the way the reference's RecordEvent instruments its Python loops.
+optimizer, communication) the way the reference's RecordEvent instruments its
+Python loops.
 """
 from __future__ import annotations
 
@@ -14,7 +15,15 @@ import time
 from typing import List, Optional
 
 _state = threading.local()
-_global = {"enabled": False, "events": None, "lock": threading.Lock(), "start_ns": 0}
+_global = {
+    "enabled": False,
+    "events": None,
+    "lock": threading.Lock(),
+    "start_ns": 0,
+    # RecordEvents begun but not yet ended — closed at tracer-disable time so
+    # a span straddling the end of the record window is exported, not dropped
+    "open": {},
+}
 
 
 class TracerEventType:
@@ -32,14 +41,15 @@ class TracerEventType:
 
 
 class HostEvent:
-    __slots__ = ("name", "event_type", "start_ns", "end_ns", "tid")
+    __slots__ = ("name", "event_type", "start_ns", "end_ns", "tid", "args")
 
-    def __init__(self, name, event_type, start_ns, end_ns, tid):
+    def __init__(self, name, event_type, start_ns, end_ns, tid, args=None):
         self.name = name
         self.event_type = event_type
         self.start_ns = start_ns
         self.end_ns = end_ns
         self.tid = tid
+        self.args = args  # optional dict of span metadata (chrome trace "args")
 
     @property
     def duration_ns(self):
@@ -55,11 +65,24 @@ def _enable_host_tracer():
         _global["events"] = []
         _global["start_ns"] = time.perf_counter_ns()
         _global["enabled"] = True
+        _global["open"] = {}
 
 
 def _disable_host_tracer() -> List[HostEvent]:
     with _global["lock"]:
         _global["enabled"] = False
+        # close spans still open mid-step: the reference host tracer flushes
+        # in-flight RecordEvents on stop; dropping them would truncate the
+        # last profiled step's export
+        now = time.perf_counter_ns()
+        for rec in list(_global["open"].values()):
+            if rec._begin_ns is not None and _global["events"] is not None:
+                _global["events"].append(
+                    HostEvent(rec.name, rec.event_type, rec._begin_ns, now,
+                              rec._tid or threading.get_ident(), rec.args)
+                )
+            rec._begin_ns = None
+        _global["open"] = {}
         events, _global["events"] = _global["events"], None
     return events or []
 
@@ -68,29 +91,39 @@ class RecordEvent:
     """Context manager / decorator that records a named host span while a
     Profiler is active (python/paddle/profiler/utils.py:RecordEvent)."""
 
-    def __init__(self, name: str, event_type: str = TracerEventType.PythonUserDefined):
+    def __init__(self, name: str, event_type: str = TracerEventType.PythonUserDefined, args: Optional[dict] = None):
         self.name = name
         self.event_type = event_type
+        self.args = args
         self._begin_ns: Optional[int] = None
+        self._tid: Optional[int] = None
 
     def begin(self):
         if not _global["enabled"]:
             return
         self._begin_ns = time.perf_counter_ns()
+        self._tid = threading.get_ident()
+        with _global["lock"]:
+            if _global["enabled"]:
+                _global["open"][id(self)] = self
 
     def end(self):
-        if self._begin_ns is None or not _global["enabled"]:
+        begin_ns = self._begin_ns
+        if begin_ns is None:
             return
-        ev = HostEvent(
-            self.name,
-            self.event_type,
-            self._begin_ns,
-            time.perf_counter_ns(),
-            threading.get_ident(),
-        )
+        if not _global["enabled"]:
+            # tracer already stopped: _disable_host_tracer closed this span
+            self._begin_ns = None
+            return
+        end_ns = time.perf_counter_ns()
         with _global["lock"]:
-            if _global["events"] is not None:
-                _global["events"].append(ev)
+            # a concurrent disable may have closed this span already — every
+            # live span is in `open`, so a missing entry means don't re-emit
+            if _global["open"].pop(id(self), None) is not None and _global["events"] is not None:
+                _global["events"].append(
+                    HostEvent(self.name, self.event_type, begin_ns, end_ns,
+                              self._tid or threading.get_ident(), self.args)
+                )
         self._begin_ns = None
 
     def __enter__(self):

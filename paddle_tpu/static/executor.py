@@ -141,11 +141,49 @@ class Executor:
         return [Tensor(f) for f in fetches]
 
     # ---- compilation ----
+    @staticmethod
+    def _program_structure_key(program: Program):
+        """Structural identity of the instruction list. Every OpInstr carries
+        a process-global monotonic serial (program.py `_op_serial`) that is
+        never reused, so an op REPLACED in-place (same op count — which a
+        length-based key can't see) gets a fresh serial and therefore a new
+        key; the stale compiled callable is evicted instead of silently
+        replayed. Deliberately O(#ops) per run: detecting an in-place
+        `program.ops[i] = ...` edit requires looking at the list — a cached
+        key invalidated only at record_op/append_backward would miss exactly
+        that mutation — and run() is already O(#params + #ops) in its
+        feed/param marshalling, so one flat int tuple adds no new asymptote."""
+        ops_key = tuple(op.seq for op in program.ops)
+        grads_key = tuple(
+            (loss, tuple(pvs), tuple(gvs)) for loss, pvs, gvs in program.grad_requests
+        )
+        opts_key = tuple((u.param_var, u.grad_var) for u in program.opt_updates)
+        return (ops_key, grads_key, opts_key)
+
     def _compile(self, program: Program, feed_names, fetch_vars):
-        key = (feed_names, fetch_vars, len(program.ops), len(program.grad_requests), len(program.opt_updates))
+        from .. import telemetry as _tm
+
+        telemetry_on = _tm.enabled()
+        structure = self._program_structure_key(program)
+        key = (feed_names, fetch_vars, structure)
         hit = program._compiled.get(key)
+        if telemetry_on:
+            _tm.counter(
+                "paddle_tpu_executor_compile_cache_total",
+                "static Executor compiled-program cache lookups", ("result",),
+            ).labels(result="hit" if hit is not None else "miss").inc()
         if hit is not None:
             return hit
+        # evict entries for the same (feed, fetch) signature whose program
+        # structure went stale — they can never hit again
+        stale = [k for k in program._compiled if k[0] == feed_names and k[1] == fetch_vars]
+        for k in stale:
+            del program._compiled[k]
+        if stale and telemetry_on:
+            _tm.counter(
+                "paddle_tpu_executor_compile_cache_evictions_total",
+                "stale compiled-program cache entries dropped on recompile",
+            ).inc(len(stale))
 
         feed_var_ids = [program.feed_vars[n] for n in feed_names]
         grad_requests = list(program.grad_requests)
@@ -218,8 +256,43 @@ class Executor:
             return fetches, updated, new_accums
 
         compiled = jax.jit(replay)
+        if telemetry_on:
+            compiled = self._timed_first_call(compiled)
         program._compiled[key] = compiled
         return compiled
+
+    @staticmethod
+    def _timed_first_call(compiled):
+        """Observe trace+XLA-compile wall time: jax.jit is lazy, so the real
+        compile cost lands on the first invocation — time that one."""
+        import threading
+        import time
+
+        done = [False]
+        done_lock = threading.Lock()
+
+        def wrapper(*args, **kwargs):
+            if done[0]:
+                return compiled(*args, **kwargs)
+            t0 = time.perf_counter()
+            out = compiled(*args, **kwargs)
+            dt = time.perf_counter() - t0
+            with done_lock:
+                first, done[0] = not done[0], True
+            from .. import telemetry as _tm
+
+            # re-check the gate at observe time: telemetry may have been
+            # disabled between _compile and the first run, and the disabled
+            # contract is "record nothing"
+            if first and _tm.enabled():
+                _tm.histogram(
+                    "paddle_tpu_executor_compile_seconds",
+                    "wall time of a static Executor program's first "
+                    "(tracing + XLA compile) run",
+                ).observe(dt)
+            return out
+
+        return wrapper
 
 
 def global_scope():

@@ -19,10 +19,18 @@ from ..core import state
 from ..core.tensor import Tensor
 
 
+import itertools
+
+# process-global monotonic serial for OpInstr identity: unlike id(), serials
+# are never reused, so the Executor's compile-cache key can tell a replaced
+# op from the original even at the same memory address
+_op_serial = itertools.count()
+
+
 class OpInstr:
     """One recorded op: out_vars = fn(*in_refs, **kwargs)."""
 
-    __slots__ = ("name", "fn", "in_refs", "kwargs", "out_vars")
+    __slots__ = ("name", "fn", "in_refs", "kwargs", "out_vars", "seq")
 
     def __init__(self, name, fn, in_refs, kwargs, out_vars):
         self.name = name
@@ -30,6 +38,7 @@ class OpInstr:
         self.in_refs = in_refs  # list of ("var", var_id) | ("lit", value)
         self.kwargs = kwargs
         self.out_vars = out_vars  # list of var_id
+        self.seq = next(_op_serial)
 
     def __repr__(self):
         ins = [f"v{r[1]}" if r[0] == "var" else repr(r[1]) for r in self.in_refs]

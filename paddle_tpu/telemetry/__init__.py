@@ -1,0 +1,51 @@
+"""Unified runtime telemetry.
+
+Reference parity: paddle/fluid/platform/monitor.cc + python/paddle/profiler
+shipped observability as one system (stat registry feeding the profiler's
+summaries); `paddle_tpu.telemetry` is that system here. One labeled metrics
+registry absorbs the framework's scattered counters; the hot paths —
+executor compile cache, jit trace, optimizer step, eager collectives, comm
+watchdog, throughput timer — publish into it (gated by the
+`PADDLE_TPU_TELEMETRY` env flag, near-zero-cost when off); exporters render
+Prometheus text and JSON-lines snapshots, and collective spans land in the
+profiler's chrome trace as `Communication` events feeding DistributedView.
+"""
+from .metrics import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    counter,
+    default_registry,
+    disable,
+    enable,
+    enabled,
+    gauge,
+    histogram,
+)
+from .exporters import (  # noqa: F401
+    dump_snapshot,
+    parse_prometheus,
+    to_json_lines,
+    to_prometheus,
+    validate_snapshot,
+)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "Registry",
+    "counter",
+    "gauge",
+    "histogram",
+    "default_registry",
+    "enabled",
+    "enable",
+    "disable",
+    "to_prometheus",
+    "to_json_lines",
+    "parse_prometheus",
+    "dump_snapshot",
+    "validate_snapshot",
+]

@@ -1,0 +1,173 @@
+"""Telemetry exporters: Prometheus text exposition + JSON-lines snapshots.
+
+Reference parity: the reference scrapes monitor.cc stats into its Fleet
+metric reporters; here the registry renders directly to the two formats the
+surrounding tooling speaks — Prometheus text format 0.0.4 for scrapers, and
+one-JSON-object-per-line snapshots for offline diffing / CI schema checks.
+Chrome-trace merging needs no exporter of its own: collective spans are
+recorded as `TracerEventType.Communication` host events, so the profiler's
+`export_chrome_tracing` picks them up with every other span.
+"""
+from __future__ import annotations
+
+import json
+import math
+from typing import Optional
+
+from .metrics import Registry, default_registry
+
+# JSON-lines snapshot schema, validated by the tier-1 smoke test. Every line
+# is one sample; histograms carry sum/count/buckets instead of value.
+SNAPSHOT_SCHEMA = {
+    "required": ["name", "type", "labels"],
+    "types": {"counter", "gauge", "histogram"},
+    "scalar_fields": ["value"],
+    "histogram_fields": ["sum", "count", "buckets"],
+}
+
+
+def _escape_label_value(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt_labels(labels: dict, extra: Optional[dict] = None) -> str:
+    items = dict(labels)
+    if extra:
+        items.update(extra)
+    if not items:
+        return ""
+    body = ",".join(f'{k}="{_escape_label_value(str(v))}"' for k, v in sorted(items.items()))
+    return "{" + body + "}"
+
+
+def _fmt_value(v) -> str:
+    if isinstance(v, float) and math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    return repr(v) if isinstance(v, float) else str(v)
+
+
+def to_prometheus(registry: Optional[Registry] = None) -> str:
+    """Render the registry in Prometheus text exposition format."""
+    registry = registry or default_registry()
+    lines = []
+    for fam in registry.families():
+        if fam.doc:
+            lines.append(f"# HELP {fam.name} {fam.doc}")
+        lines.append(f"# TYPE {fam.name} {fam.kind}")
+        for child in fam.children():
+            labels = dict(child.labels)
+            if fam.kind == "histogram":
+                for le, c in child.cumulative_buckets():
+                    le_s = "+Inf" if math.isinf(le) else _fmt_value(float(le))
+                    lines.append(
+                        f"{fam.name}_bucket{_fmt_labels(labels, {'le': le_s})} {c}"
+                    )
+                lines.append(f"{fam.name}_sum{_fmt_labels(labels)} {_fmt_value(child.sum)}")
+                lines.append(f"{fam.name}_count{_fmt_labels(labels)} {child.count}")
+            else:
+                lines.append(f"{fam.name}{_fmt_labels(labels)} {_fmt_value(child.value)}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def parse_prometheus(text: str) -> dict:
+    """Minimal parser for the text format — the round-trip half used by
+    tests: {(name, (label items...)): float value} for non-histogram lines."""
+    out = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        metric, _, value = line.rpartition(" ")
+        if "{" in metric:
+            name, _, rest = metric.partition("{")
+            body = rest.rstrip("}")
+            labels = []
+            for part in _split_labels(body):
+                k, _, v = part.partition("=")
+                labels.append((k, json.loads(v)))
+            key = (name, tuple(sorted(labels)))
+        else:
+            key = (metric, ())
+        out[key] = float("inf") if value == "+Inf" else float(value)
+    return out
+
+
+def _split_labels(body: str):
+    """Split 'a="x",b="y,z"' on commas outside quotes."""
+    parts, cur, in_q, esc = [], [], False, False
+    for ch in body:
+        if esc:
+            cur.append(ch)
+            esc = False
+            continue
+        if ch == "\\":
+            cur.append(ch)
+            esc = True
+            continue
+        if ch == '"':
+            in_q = not in_q
+        if ch == "," and not in_q:
+            parts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    if cur:
+        parts.append("".join(cur))
+    return parts
+
+
+def to_json_lines(registry: Optional[Registry] = None) -> str:
+    """One JSON object per line, schema per SNAPSHOT_SCHEMA."""
+    registry = registry or default_registry()
+    # allow_nan=False: regressions that leak inf/nan must fail loudly here,
+    # not produce RFC-8259-invalid `Infinity` tokens downstream tools reject
+    return "\n".join(json.dumps(s, sort_keys=True, allow_nan=False) for s in registry.collect())
+
+
+def dump_snapshot(path: str, registry: Optional[Registry] = None, fmt: str = "jsonl") -> str:
+    """Write a snapshot file; returns the path. fmt: 'jsonl' | 'prometheus'."""
+    if fmt == "jsonl":
+        payload = to_json_lines(registry)
+    elif fmt in ("prometheus", "prom", "text"):
+        payload = to_prometheus(registry)
+    else:
+        raise ValueError(f"unknown snapshot format {fmt!r}")
+    with open(path, "w") as f:
+        f.write(payload)
+        if payload and not payload.endswith("\n"):
+            f.write("\n")
+    return path
+
+
+def validate_snapshot_line(obj: dict) -> None:
+    """Raise ValueError if one parsed JSON-lines sample violates the schema."""
+    for field in SNAPSHOT_SCHEMA["required"]:
+        if field not in obj:
+            raise ValueError(f"snapshot sample missing {field!r}: {obj}")
+    if obj["type"] not in SNAPSHOT_SCHEMA["types"]:
+        raise ValueError(f"snapshot sample has unknown type {obj['type']!r}")
+    if not isinstance(obj["labels"], dict):
+        raise ValueError("snapshot sample labels must be a dict")
+    if obj["type"] == "histogram":
+        for field in SNAPSHOT_SCHEMA["histogram_fields"]:
+            if field not in obj:
+                raise ValueError(f"histogram sample missing {field!r}: {obj}")
+        for b in obj["buckets"]:
+            if "le" not in b or "count" not in b:
+                raise ValueError(f"histogram bucket malformed: {b}")
+            if not (isinstance(b["le"], (int, float)) or b["le"] == "+Inf"):
+                raise ValueError(f"histogram bucket bound malformed: {b}")
+    else:
+        if "value" not in obj:
+            raise ValueError(f"{obj['type']} sample missing 'value': {obj}")
+
+
+def validate_snapshot(text: str) -> int:
+    """Validate a JSON-lines snapshot; returns the number of samples."""
+    n = 0
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        validate_snapshot_line(json.loads(line))
+        n += 1
+    return n

@@ -76,4 +76,18 @@ def test_monitor_counters():
     snap = monitor.snapshot()
     assert snap["counters"]["steps"] == 3
     monitor.reset("steps")
-    assert monitor.get("steps") is None
+    # counter semantics: a missing counter reads 0, not None
+    assert monitor.get("steps") == 0
+    assert monitor.get("never_recorded") == 0
+
+
+def test_monitor_is_a_telemetry_shim():
+    from paddle_tpu import telemetry
+
+    monitor.reset()
+    monitor.add("shim_steps", 5)
+    # the shim writes into the unified registry -> shows up in exports
+    assert telemetry.default_registry().get("shim_steps").value == 5
+    assert "shim_steps 5" in telemetry.to_prometheus()
+    monitor.reset("shim_steps")
+    assert telemetry.default_registry().get("shim_steps") is None

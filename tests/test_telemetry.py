@@ -1,0 +1,537 @@
+"""Unified runtime telemetry: labeled registry, Prometheus/JSON-lines export,
+collective Communication spans, compile-cache instrumentation, and the
+disabled-telemetry fast path."""
+import json
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu import static, telemetry
+from paddle_tpu.profiler import Profiler, ProfilerTarget, RecordEvent, SummaryView
+from paddle_tpu.telemetry import metrics as tmetrics
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_on():
+    """Every test starts with telemetry enabled (the repo default)."""
+    was = telemetry.enabled()
+    telemetry.enable()
+    yield
+    (telemetry.enable if was else telemetry.disable)()
+
+
+def _counter_value(name, **labels):
+    fam = telemetry.default_registry().get(name)
+    if fam is None:
+        return 0
+    if labels:
+        return fam.labels(**labels).value
+    return fam.value
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+
+def test_counter_gauge_histogram_basics():
+    reg = tmetrics.Registry()
+    c = reg.counter("req_total", "requests", ("route",))
+    c.labels(route="/a").inc()
+    c.labels(route="/a").inc(4)
+    c.labels(route="/b").inc()
+    assert c.labels(route="/a").value == 5
+    assert c.labels(route="/b").value == 1
+
+    g = reg.gauge("depth")
+    g.set(3)
+    g.inc()
+    g.dec(2)
+    assert g.value == 2
+
+    h = reg.histogram("lat", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    assert h.count == 3
+    assert h.sum == pytest.approx(5.55)
+    cb = h._default().cumulative_buckets()
+    assert cb[0] == (0.1, 1) and cb[1] == (1.0, 2)
+    assert cb[-1][0] == float("inf") and cb[-1][1] == 3
+
+
+def test_counter_rejects_negative_and_wrong_labels():
+    reg = tmetrics.Registry()
+    c = reg.counter("neg_total", label_names=("k",))
+    with pytest.raises(ValueError):
+        c.labels(k="x").inc(-1)
+    with pytest.raises(ValueError):
+        c.labels(wrong="x")
+    with pytest.raises(TypeError):
+        reg.gauge("neg_total")  # kind conflict
+
+
+def test_registry_get_or_create_is_idempotent():
+    reg = tmetrics.Registry()
+    a = reg.counter("same_total", "doc", ("x",))
+    b = reg.counter("same_total", "other doc", ("x",))
+    assert a is b
+
+
+def test_registry_rejects_schema_drift():
+    reg = tmetrics.Registry()
+    reg.counter("drift_total", label_names=("op",))
+    with pytest.raises(ValueError):
+        reg.counter("drift_total")  # different label set
+    reg.histogram("drift_seconds", buckets=(0.1, 1.0))
+    with pytest.raises(ValueError):
+        reg.histogram("drift_seconds", buckets=(5.0, 10.0))
+
+
+def test_monitor_counter_and_gauge_share_a_name():
+    """Old dual-dict monitor allowed add(x) and set_gauge(x) to coexist."""
+    from paddle_tpu.framework import monitor
+
+    monitor.reset("shared_name")
+    monitor.add("shared_name", 3)
+    monitor.set_gauge("shared_name", 0.5)
+    # counter-first read priority, both visible in the snapshot
+    assert monitor.get("shared_name") == 3
+    snap = monitor.snapshot()
+    assert snap["counters"]["shared_name"] == 3
+    assert snap["gauges"]["shared_name"] == 0.5
+    monitor.reset("shared_name")
+    assert monitor.get("shared_name") == 0
+    assert telemetry.default_registry().get("shared_name__gauge") is None
+
+
+# ---------------------------------------------------------------------------
+# exporters
+# ---------------------------------------------------------------------------
+
+
+def test_prometheus_round_trips_labels():
+    reg = tmetrics.Registry()
+    c = reg.counter("rt_total", "round trip", ("op", "group"))
+    c.labels(op="all_reduce", group="pg_0").inc(7)
+    reg.gauge("rt_gauge").set(2.5)
+    text = telemetry.to_prometheus(reg)
+    parsed = telemetry.parse_prometheus(text)
+    key = ("rt_total", (("group", "pg_0"), ("op", "all_reduce")))
+    assert parsed[key] == 7.0
+    assert parsed[("rt_gauge", ())] == 2.5
+    assert "# TYPE rt_total counter" in text
+
+
+def test_prometheus_escapes_label_values():
+    reg = tmetrics.Registry()
+    reg.counter("esc_total", label_names=("v",)).labels(v='a"b\\c').inc()
+    text = telemetry.to_prometheus(reg)
+    parsed = telemetry.parse_prometheus(text)
+    assert parsed[("esc_total", (("v", 'a"b\\c'),))] == 1.0
+
+
+def test_json_lines_snapshot_schema():
+    reg = tmetrics.Registry()
+    reg.counter("snap_total", label_names=("k",)).labels(k="v").inc(2)
+    reg.histogram("snap_seconds").observe(0.2)
+    payload = telemetry.to_json_lines(reg)
+    assert telemetry.validate_snapshot(payload) == 2
+    lines = [json.loads(l) for l in payload.splitlines()]
+    hist = next(l for l in lines if l["type"] == "histogram")
+    assert hist["count"] == 1 and hist["buckets"][-1]["count"] == 1
+    with pytest.raises(ValueError):
+        telemetry.validate_snapshot('{"name": "x", "type": "bogus", "labels": {}}')
+
+
+def test_json_lines_histogram_is_strict_rfc_json():
+    reg = tmetrics.Registry()
+    reg.histogram("inf_seconds").observe(0.5)
+    payload = telemetry.to_json_lines(reg)
+    assert "Infinity" not in payload  # bare Infinity is not RFC-8259 JSON
+    last_bucket = json.loads(payload)["buckets"][-1]
+    assert last_bucket["le"] == "+Inf" and last_bucket["count"] == 1
+    assert telemetry.validate_snapshot(payload) == 1
+
+
+def test_dump_snapshot_file(tmp_path):
+    reg = tmetrics.Registry()
+    reg.counter("file_total").inc()
+    p = telemetry.dump_snapshot(str(tmp_path / "snap.jsonl"), reg)
+    with open(p) as f:
+        assert telemetry.validate_snapshot(f.read()) == 1
+    p2 = telemetry.dump_snapshot(str(tmp_path / "snap.prom"), reg, fmt="prometheus")
+    with open(p2) as f:
+        assert "file_total 1" in f.read()
+
+
+# ---------------------------------------------------------------------------
+# collective instrumentation: metrics + Communication spans
+# ---------------------------------------------------------------------------
+
+
+def test_collectives_produce_comm_spans_and_metrics():
+    calls0 = _counter_value("paddle_tpu_collective_calls_total", op="all_reduce", group="_world")
+    bytes0 = _counter_value("paddle_tpu_collective_bytes_total", op="all_reduce", group="_world")
+    collected = []
+    with Profiler(
+        targets=[ProfilerTarget.CPU],
+        on_trace_ready=lambda prof: collected.append(prof.profiler_result),
+    ) as p:
+        t = paddle.to_tensor(np.ones((8, 2), "float32"))
+        dist.all_reduce(t)
+        parts = []
+        dist.all_gather(parts, paddle.to_tensor(np.ones((8, 2), "float32")))
+        p.step()
+
+    spans = collected[0].comm_events()
+    names = [e.name for e in spans]
+    assert "collective.all_reduce" in names
+    assert "collective.all_gather" in names
+    ar = next(e for e in spans if e.name == "collective.all_reduce")
+    assert ar.args["group"] == "_world"
+    assert ar.args["bytes"] == 8 * 2 * 4
+    # metrics advanced in step with the spans
+    assert _counter_value("paddle_tpu_collective_calls_total", op="all_reduce", group="_world") == calls0 + 1
+    assert _counter_value("paddle_tpu_collective_bytes_total", op="all_reduce", group="_world") == bytes0 + 64
+    lat = telemetry.default_registry().get("paddle_tpu_collective_latency_seconds")
+    assert lat is not None and lat.labels(op="all_reduce", group="_world").count >= 1
+
+
+def test_comm_spans_merge_into_chrome_trace(tmp_path):
+    out = str(tmp_path / "trace")
+    with Profiler(
+        targets=[ProfilerTarget.CPU],
+        on_trace_ready=paddle.profiler.export_chrome_tracing(out, worker_name="w"),
+    ) as p:
+        t = paddle.to_tensor(np.ones((8, 4), "float32"))
+        dist.all_reduce(t)
+        p.step()
+    import os
+
+    files = [f for f in os.listdir(out) if f.endswith(".json")]
+    with open(os.path.join(out, files[0])) as f:
+        trace = json.load(f)
+    comm = [e for e in trace["traceEvents"] if e.get("cat") == "Communication"]
+    assert comm and comm[0]["name"] == "collective.all_reduce"
+    assert comm[0]["args"]["bytes"] == 8 * 4 * 4
+
+
+def test_per_group_labels():
+    g = dist.new_group(list(range(4)))
+    t = paddle.to_tensor(np.ones((4, 2), "float32"))
+    dist.all_reduce(t, group=g)
+    assert _counter_value("paddle_tpu_collective_calls_total", op="all_reduce", group=g.name) >= 1
+
+
+def test_distributed_summary_view(capsys):
+    with Profiler(targets=[ProfilerTarget.CPU]) as p:
+        t = paddle.to_tensor(np.ones((8, 2), "float32"))
+        dist.all_reduce(t)
+        dist.broadcast(t, src=0)
+    p.summary(views=SummaryView.DistributedView)
+    out = capsys.readouterr().out
+    assert "Distributed Summary" in out
+    assert "collective.all_reduce" in out and "collective.broadcast" in out
+    assert "_world" in out
+
+
+def test_disabled_telemetry_records_nothing():
+    telemetry.disable()
+    reg = telemetry.default_registry()
+    before = {(s["name"], tuple(sorted(s["labels"].items()))): s.get("value") for s in reg.collect()}
+    collected = []
+    with Profiler(
+        targets=[ProfilerTarget.CPU],
+        on_trace_ready=lambda prof: collected.append(prof.profiler_result),
+    ) as p:
+        t = paddle.to_tensor(np.ones((8, 2), "float32"))
+        dist.all_reduce(t)
+        p.step()
+    # no Communication spans on the fast path
+    assert collected[0].comm_events() == []
+    # and no metric moved
+    after = {(s["name"], tuple(sorted(s["labels"].items()))): s.get("value") for s in reg.collect()}
+    assert after == before
+
+
+# ---------------------------------------------------------------------------
+# executor compile cache
+# ---------------------------------------------------------------------------
+
+
+def _build_linear_program():
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        x = static.data("x", [2, 3], "float32")
+        y = paddle.matmul(x, paddle.ones([3, 2])) * 2.0
+    return main, y
+
+
+def test_executor_compile_cache_hit_miss_counters():
+    main, y = _build_linear_program()
+    exe = static.Executor()
+    miss0 = _counter_value("paddle_tpu_executor_compile_cache_total", result="miss")
+    hit0 = _counter_value("paddle_tpu_executor_compile_cache_total", result="hit")
+    xv = np.ones((2, 3), "float32")
+    exe.run(main, feed={"x": xv}, fetch_list=[y])
+    exe.run(main, feed={"x": xv}, fetch_list=[y])
+    exe.run(main, feed={"x": xv}, fetch_list=[y])
+    assert _counter_value("paddle_tpu_executor_compile_cache_total", result="miss") == miss0 + 1
+    assert _counter_value("paddle_tpu_executor_compile_cache_total", result="hit") == hit0 + 2
+    hist = telemetry.default_registry().get("paddle_tpu_executor_compile_seconds")
+    assert hist is not None and hist.count >= 1
+
+
+def test_executor_recompiles_when_op_replaced_same_count():
+    """The old cache keyed on len(program.ops): replacing an op (same count)
+    silently replayed the stale callable. The structural key must miss."""
+    main, y = _build_linear_program()
+    exe = static.Executor()
+    xv = np.ones((2, 3), "float32")
+    (out1,) = exe.run(main, feed={"x": xv}, fetch_list=[y])
+
+    # replace the scale op in place: same op count, different function
+    ev0 = _counter_value("paddle_tpu_executor_compile_cache_evictions_total")
+    old = main.ops[-1]
+    new_fn = lambda a, b: a * 10.0  # noqa: E731
+    main.ops[-1] = type(old)(old.name, new_fn, old.in_refs, old.kwargs, old.out_vars)
+    (out2,) = exe.run(main, feed={"x": xv}, fetch_list=[y])
+    assert not np.allclose(out1, out2), "stale compiled callable was reused"
+    np.testing.assert_allclose(out2, (xv @ np.ones((3, 2), "float32")) * 10.0)
+    assert _counter_value("paddle_tpu_executor_compile_cache_evictions_total") == ev0 + 1
+
+
+# ---------------------------------------------------------------------------
+# jit / optimizer / watchdog / timer wiring
+# ---------------------------------------------------------------------------
+
+
+def test_jit_trace_metrics():
+    @paddle.jit.to_static
+    def f(x):
+        return x * 2 + 1
+
+    t0 = _counter_value("paddle_tpu_jit_trace_total", function="f")
+    f(paddle.to_tensor(np.ones((2, 2), "float32")))
+    f(paddle.to_tensor(np.ones((2, 2), "float32")))
+    f(paddle.to_tensor(np.ones((3, 2), "float32")))  # shape change -> retrace
+    assert _counter_value("paddle_tpu_jit_trace_total", function="f") == t0 + 2
+    assert _counter_value("paddle_tpu_jit_cache_total", function="f", result="hit") >= 1
+    assert _counter_value("paddle_tpu_jit_cache_total", function="f", result="miss") >= 2
+
+
+def test_optimizer_step_metrics():
+    lin = paddle.nn.Linear(3, 1)
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=lin.parameters())
+    s0 = _counter_value("paddle_tpu_optimizer_step_total", optimizer="SGD")
+    loss = (lin(paddle.to_tensor(np.ones((2, 3), "float32"))) ** 2).mean()
+    loss.backward()
+    opt.step()
+    assert _counter_value("paddle_tpu_optimizer_step_total", optimizer="SGD") == s0 + 1
+    hist = telemetry.default_registry().get("paddle_tpu_optimizer_step_seconds")
+    assert hist is not None and hist.labels(optimizer="SGD").count >= 1
+
+
+def test_lbfgs_step_is_instrumented():
+    lin = paddle.nn.Linear(2, 1)
+    opt = paddle.optimizer.LBFGS(learning_rate=0.5, parameters=lin.parameters())
+    x = paddle.to_tensor(np.ones((4, 2), "float32"))
+    yt = paddle.to_tensor(np.zeros((4, 1), "float32"))
+
+    def closure():
+        opt.clear_grad()
+        loss = ((lin(x) - yt) ** 2).mean()
+        loss.backward()
+        return loss
+
+    before = _counter_value("paddle_tpu_optimizer_step_total", optimizer="LBFGS")
+    opt.step(closure)
+    assert _counter_value("paddle_tpu_optimizer_step_total", optimizer="LBFGS") == before + 1
+
+
+def test_watchdog_task_metrics():
+    from paddle_tpu.distributed import comm_watchdog as wd
+
+    mgr = wd.CommTaskManager.instance()
+    fired = []
+    prev = mgr.set_timeout_handler(lambda task, dump: fired.append(task.op))
+    try:
+        s0 = _counter_value("paddle_tpu_comm_tasks_started_total", op="unit.test")
+        to0 = _counter_value("paddle_tpu_comm_tasks_timeout_total", op="unit.test")
+        with wd.comm_task("unit.test", timeout=0.01):
+            deadline = time.monotonic() + 5.0
+            while not fired and time.monotonic() < deadline:
+                time.sleep(0.01)
+        assert fired == ["unit.test"]
+        assert _counter_value("paddle_tpu_comm_tasks_started_total", op="unit.test") == s0 + 1
+        assert _counter_value("paddle_tpu_comm_tasks_timeout_total", op="unit.test") == to0 + 1
+    finally:
+        mgr.set_timeout_handler(prev)
+
+
+def test_benchmark_publishes_gauges():
+    b = paddle.profiler.benchmark()
+    b.reader_cost.skip_n = 0
+    b.batch_cost.skip_n = 0
+    b.ips_stat.skip_n = 0
+    b.begin()
+    b.step(num_samples=4)
+    b.end()
+    reg = telemetry.default_registry()
+    assert reg.get("paddle_tpu_benchmark_batch_cost_seconds").value > 0
+    assert reg.get("paddle_tpu_benchmark_ips").value > 0
+
+
+def test_monitor_shim_tolerates_shared_registry():
+    """monitor.get()/reset() share the registry with telemetry families —
+    they must read 0 for non-scalar names and never delete telemetry's."""
+    from paddle_tpu.framework import monitor
+
+    reg = telemetry.default_registry()
+    telemetry.histogram("shared_hist_seconds").observe(0.1)
+    telemetry.counter("shared_labeled_total", label_names=("k",)).labels(k="v").inc()
+    assert monitor.get("shared_hist_seconds") == 0
+    assert monitor.get("shared_labeled_total") == 0
+    monitor.reset("shared_hist_seconds")  # not monitor-owned: must be a no-op
+    assert reg.get("shared_hist_seconds") is not None
+    reg.unregister("shared_hist_seconds")
+    reg.unregister("shared_labeled_total")
+
+
+def test_monitor_add_supports_legacy_decrement():
+    from paddle_tpu.framework import monitor
+
+    monitor.reset("inflight")
+    monitor.add("inflight", 3)
+    monitor.add("inflight", -2)
+    assert monitor.get("inflight") == 1
+    monitor.reset("inflight")
+
+
+def test_payload_counts_inputs_only():
+    from paddle_tpu.distributed.collective import _payload_nbytes
+
+    t_in = paddle.to_tensor(np.ones((8, 4), "float32"))
+    t_out = paddle.to_tensor(np.zeros((8, 4), "float32"))
+    # all_to_all_single(out, in): only the input operand counts
+    assert _payload_nbytes("all_to_all_single", (t_out, t_in), {}) == 8 * 4 * 4
+    # wait/barrier move no accountable payload
+    assert _payload_nbytes("wait", (t_in,), {}) == 0
+    assert _payload_nbytes("barrier", (), {}) == 0
+    # kwargs resolution
+    assert _payload_nbytes("all_reduce", (), {"tensor": t_in}) == 8 * 4 * 4
+
+
+def test_compile_histogram_respects_late_disable():
+    """Telemetry on at _compile time but off at first run: the first-call
+    timing wrapper must not observe while disabled."""
+    main, y = _build_linear_program()
+    exe = static.Executor()
+    # compile with telemetry ON -> timing wrapper installed, nothing run yet
+    exe._compile(main, ("x",), (main._id2var[id(y)],))
+    hist = telemetry.default_registry().get("paddle_tpu_executor_compile_seconds")
+    before = hist.count if hist else 0
+    telemetry.disable()
+    try:
+        # cache hit -> the wrapper's first (compiling) call happens disabled
+        exe.run(main, feed={"x": np.ones((2, 3), "float32")}, fetch_list=[y])
+    finally:
+        telemetry.enable()
+    hist = telemetry.default_registry().get("paddle_tpu_executor_compile_seconds")
+    assert (hist.count if hist else 0) == before
+
+
+def test_set_flags_is_atomic_for_watchers():
+    assert telemetry.enabled()
+    with pytest.raises(KeyError):
+        paddle.set_flags({"PADDLE_TPU_TELEMETRY": False, "FLAGS_no_such_flag": 1})
+    # nothing applied: flag value and cached gate both unchanged
+    assert paddle.get_flags("PADDLE_TPU_TELEMETRY")["PADDLE_TPU_TELEMETRY"] is True
+    assert telemetry.enabled()
+
+
+def test_collective_latency_observed_on_error():
+    lat = telemetry.default_registry().get("paddle_tpu_collective_latency_seconds")
+    before = lat.labels(op="all_to_all_single", group="_world").count if lat else 0
+    out = paddle.to_tensor(np.zeros((8, 8), "float32"))
+    t = paddle.to_tensor(np.ones((8, 8), "float32"))
+    with pytest.raises(NotImplementedError):
+        dist.all_to_all_single(out, t, in_split_sizes=[1, 2, 3, 4, 5, 6, 7, 8])
+    lat = telemetry.default_registry().get("paddle_tpu_collective_latency_seconds")
+    assert lat.labels(op="all_to_all_single", group="_world").count == before + 1
+    # calls and latency stay in lockstep even through the failure
+    assert _counter_value(
+        "paddle_tpu_collective_calls_total", op="all_to_all_single", group="_world"
+    ) == lat.labels(op="all_to_all_single", group="_world").count
+
+
+# ---------------------------------------------------------------------------
+# profiler: spans open at disable time are closed, not dropped
+# ---------------------------------------------------------------------------
+
+
+def test_open_span_closed_at_profiler_stop():
+    collected = []
+    prof = Profiler(
+        targets=[ProfilerTarget.CPU],
+        on_trace_ready=lambda p: collected.append(p.profiler_result),
+    )
+    prof.start()
+    ev = RecordEvent("straddler")
+    ev.begin()
+    time.sleep(0.002)
+    prof.stop()  # tracer disables while the span is still open
+    assert collected
+    spans = [e for e in collected[0].host_events if e.name == "straddler"]
+    assert len(spans) == 1
+    assert spans[0].duration_ns >= 1_000_000
+    ev.end()  # must be a harmless no-op after the forced close
+    assert len([e for e in collected[0].host_events if e.name == "straddler"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# tier-1 smoke: 3-step to_static train loop -> snapshot with valid schema
+# ---------------------------------------------------------------------------
+
+
+def test_telemetry_smoke_three_step_train_loop(tmp_path):
+    lin = paddle.nn.Linear(4, 2)
+    opt = paddle.optimizer.AdamW(learning_rate=0.01, parameters=lin.parameters())
+
+    @paddle.jit.to_static
+    def train_step(x, y):
+        loss = ((lin(x) - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    rng = np.random.RandomState(0)
+    with Profiler(targets=[ProfilerTarget.CPU]) as p:
+        for _ in range(3):
+            x = paddle.to_tensor(rng.randn(8, 4).astype("float32"))
+            yt = paddle.to_tensor(rng.randn(8, 2).astype("float32"))
+            t = paddle.to_tensor(np.ones((8, 2), "float32"))
+            dist.all_reduce(t)
+            train_step(x, yt)
+            p.step()
+
+    # JSON-lines snapshot: schema-valid and non-trivial
+    path = telemetry.dump_snapshot(str(tmp_path / "telemetry.jsonl"))
+    with open(path) as f:
+        n = telemetry.validate_snapshot(f.read())
+    assert n > 5
+
+    # Prometheus snapshot: compile-cache + per-group collective metrics present
+    text = telemetry.to_prometheus()
+    assert "paddle_tpu_jit_cache_total" in text
+    assert 'result="miss"' in text and 'result="hit"' in text
+    assert 'paddle_tpu_collective_bytes_total{group="_world",op="all_reduce"}' in text
+    assert "paddle_tpu_collective_latency_seconds_bucket" in text
+    # chrome trace side: the profiled window carries the Communication spans
+    comm = p.profiler_result.comm_events()
+    assert len([e for e in comm if e.name == "collective.all_reduce"]) >= 1
