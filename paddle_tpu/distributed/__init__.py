@@ -96,6 +96,7 @@ from .auto_parallel.api import (  # noqa: F401,E402
 )
 from .collective import alltoall_single, gather  # noqa: F401,E402
 from . import auto_tuner  # noqa: F401,E402
+from . import resilience  # noqa: F401,E402
 from . import rpc  # noqa: F401,E402
 
 

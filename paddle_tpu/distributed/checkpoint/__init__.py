@@ -1,6 +1,21 @@
 """paddle.distributed.checkpoint namespace (reference: python/paddle/distributed/checkpoint/)."""
-from .load_state_dict import load_state_dict  # noqa: F401
+from .load_state_dict import (  # noqa: F401
+    CheckpointCorrupt,
+    load_state_dict,
+    select_checkpoint_dir,
+    verify_step,
+)
 from .metadata import LocalTensorMetadata, Metadata, TensorMetadata  # noqa: F401
-from .save_state_dict import save_state_dict  # noqa: F401
+from .save_state_dict import list_steps, save_state_dict  # noqa: F401
 
-__all__ = ["save_state_dict", "load_state_dict", "Metadata", "TensorMetadata", "LocalTensorMetadata"]
+__all__ = [
+    "save_state_dict",
+    "load_state_dict",
+    "list_steps",
+    "select_checkpoint_dir",
+    "verify_step",
+    "CheckpointCorrupt",
+    "Metadata",
+    "TensorMetadata",
+    "LocalTensorMetadata",
+]

@@ -1,4 +1,4 @@
-"""Sharded checkpoint load with re-sharding.
+"""Sharded checkpoint load with re-sharding and integrity verification.
 
 Reference parity: python/paddle/distributed/checkpoint/load_state_dict.py —
 reads the global metadata, then for every target tensor fills each local
@@ -8,19 +8,45 @@ mapping / re-shard path). TPU-native: the target layout is the jax sharding
 already attached to the destination tensor; per-device blocks are assembled
 host-side and joined with jax.make_array_from_single_device_arrays, so no
 full-size global materialization is needed for sharded tensors.
+
+Integrity: `path` may be a checkpoint ROOT of `step_<N>/` directories (the
+save_state_dict format) or a legacy flat directory. For a root, steps are
+tried newest-first and a step is used only if it is COMPLETE (marker +
+metadata present) and every shard file matches its recorded CRC32
+(FLAGS_ckpt_verify_crc) — a torn or corrupt latest step is skipped with a
+diagnostic and the newest complete one restores instead, so a SIGKILL
+mid-save never strands the job.
 """
 from __future__ import annotations
 
 import glob
 import os
 import pickle
+import sys
 
 import jax
 import numpy as np
 
 from ...core.tensor import Tensor
+from ...framework import flags as _flags
 from .metadata import Metadata, intersection, slices_overlap
-from .save_state_dict import _flatten_state_dict
+from .save_state_dict import (
+    COMPLETE_MARKER,
+    STEP_PREFIX,
+    _crc32_file,
+    _flatten_state_dict,
+    list_steps,
+)
+
+_flags.define_flag(
+    "FLAGS_ckpt_verify_crc", True,
+    "verify shard-file CRC32s recorded in checkpoint metadata when selecting "
+    "a step to load (catches torn/corrupt writes at the cost of one read)",
+)
+
+
+class CheckpointCorrupt(RuntimeError):
+    """A step directory failed integrity verification."""
 
 
 def _read_metadata(path) -> Metadata:
@@ -37,7 +63,91 @@ def _read_metadata(path) -> Metadata:
             else:
                 merged.state_dict_metadata[name] = tm
         merged.flat_mapping.update(part.flat_mapping)
+        # pre-checksum pickles lack the field entirely
+        merged.file_checksums.update(getattr(part, "file_checksums", {}))
     return merged
+
+
+def verify_step(step_dir, require_marker=True) -> Metadata:
+    """Integrity-check one checkpoint directory: completeness marker,
+    readable metadata, every referenced shard present, CRC32s matching.
+    Returns the merged metadata on success, raises CheckpointCorrupt on any
+    violation."""
+    if require_marker and not os.path.exists(os.path.join(step_dir, COMPLETE_MARKER)):
+        raise CheckpointCorrupt(f"{step_dir}: no {COMPLETE_MARKER} marker (torn save)")
+    try:
+        meta = _read_metadata(step_dir)
+    except FileNotFoundError as e:
+        raise CheckpointCorrupt(f"{step_dir}: {e}") from e
+    except Exception as e:  # truncated/corrupt pickle
+        raise CheckpointCorrupt(f"{step_dir}: unreadable metadata ({type(e).__name__}: {e})") from e
+    referenced = {
+        sh.file_name
+        for tm in meta.state_dict_metadata.values()
+        for sh in tm.shards
+    }
+    for fname in sorted(referenced):
+        fp = os.path.join(step_dir, fname)
+        if not os.path.exists(fp):
+            raise CheckpointCorrupt(f"{step_dir}: shard {fname} missing")
+    if _flags.get_flag("FLAGS_ckpt_verify_crc"):
+        for fname, want in sorted(meta.file_checksums.items()):
+            fp = os.path.join(step_dir, fname)
+            if not os.path.exists(fp):
+                raise CheckpointCorrupt(f"{step_dir}: checksummed file {fname} missing")
+            got = _crc32_file(fp)
+            if got != want:
+                raise CheckpointCorrupt(
+                    f"{step_dir}: {fname} CRC32 mismatch (got {got:#x}, recorded {want:#x})"
+                )
+    return meta
+
+
+def _record_fallback(reason: str) -> None:
+    from ... import telemetry as _tm
+
+    if _tm.enabled():
+        _tm.counter(
+            "paddle_tpu_ckpt_fallbacks_total",
+            "checkpoint steps skipped at load for integrity violations", ("reason",),
+        ).labels(reason=reason).inc()
+
+
+def select_checkpoint_dir(path):
+    """Resolve `path` to the directory to actually load: `path` itself for a
+    legacy flat checkpoint, else the newest COMPLETE + checksum-valid
+    `step_<N>/`. Returns (dir, merged Metadata)."""
+    steps = list_steps(path)
+    if not steps:
+        if glob.glob(os.path.join(path, "*.metadata")):
+            # legacy flat layout: trust-but-verify (no marker requirement).
+            # Only when NO step dirs exist — a pre-upgrade flat checkpoint
+            # that later saves step_N/ alongside must not shadow the newer
+            # steps with its stale weights.
+            return path, verify_step(path, require_marker=False)
+        raise FileNotFoundError(f"no checkpoint steps (or .metadata files) under {path}")
+    last_err = None
+    for step in reversed(steps):
+        base = os.path.join(path, f"{STEP_PREFIX}{step}")
+        # base + the `.old` a same-step overwrite leaves if it dies between
+        # its two renames — that copy is complete, don't strand the job
+        for step_dir in (base, base + ".old"):
+            if not os.path.isdir(step_dir):
+                continue
+            try:
+                return step_dir, verify_step(step_dir)
+            except CheckpointCorrupt as e:
+                reason = "torn" if COMPLETE_MARKER in str(e) else "corrupt"
+                _record_fallback(reason)
+                sys.stderr.write(
+                    f"[paddle_tpu.checkpoint] skipping {os.path.basename(step_dir)}: "
+                    f"{e}; falling back to the previous complete step\n"
+                )
+                last_err = e
+    raise CheckpointCorrupt(
+        f"no complete, uncorrupted checkpoint step under {path} "
+        f"({len(steps)} step(s) rejected; last: {last_err})"
+    )
 
 
 def _fill_block(path, tm, offset, shape, dtype, mmap_cache=None):
@@ -70,8 +180,10 @@ def _fill_block(path, tm, offset, shape, dtype, mmap_cache=None):
 
 def load_state_dict(state_dict, path, process_group=None, coordinator_rank=0):
     """Fill `state_dict`'s tensors in place from the checkpoint at `path`,
-    re-sharding as needed to each tensor's current placement."""
-    meta = _read_metadata(path)
+    re-sharding as needed to each tensor's current placement. `path` may be
+    a step directory, a legacy flat checkpoint, or a checkpoint root (newest
+    complete step wins — see module doc)."""
+    path, meta = select_checkpoint_dir(path)
     flat = _flatten_state_dict(state_dict)
     mmap_cache: dict = {}  # one open mmap per shard file for this call
     missing = []

@@ -33,6 +33,11 @@ class TensorMetadata:
 class Metadata:
     state_dict_metadata: Dict[str, TensorMetadata] = field(default_factory=dict)
     flat_mapping: Dict[str, str] = field(default_factory=dict)  # structured name aliases
+    # shard file -> CRC32 of its bytes, recorded at save time BEFORE the
+    # shard hits disk; load verifies these to detect torn/corrupt steps.
+    # (default_factory keeps pickles from the pre-checksum format loadable —
+    # readers must getattr(..., "file_checksums", {}).)
+    file_checksums: Dict[str, int] = field(default_factory=dict)
 
 
 def slices_overlap(off_a, shape_a, off_b, shape_b):

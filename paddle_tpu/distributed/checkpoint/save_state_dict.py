@@ -1,4 +1,4 @@
-"""Sharded checkpoint save.
+"""Sharded checkpoint save — atomic, checksummed step directories.
 
 Reference parity: python/paddle/distributed/checkpoint/save_state_dict.py:104
 — every rank writes the shards it owns plus one global metadata file mapping
@@ -6,17 +6,36 @@ tensor name → [(global_offset, local_shape, file)]. TPU-native: a "rank"'s
 shards are the jax.Array's addressable shards on this process; replicas are
 deduped with shard.replica_id == 0 so each slice is written exactly once
 across the job (the reference dedupes with its coordinator gather instead).
+
+Durability contract (the part the reference leaves to its coordinator):
+`path` is a checkpoint ROOT; each save lands in its own `step_<N>/`
+directory, so repeated saves can never interleave stale shards with fresh
+metadata. Within a save: shards are written to a hidden temp dir with their
+CRC32 recorded in metadata BEFORE the bytes hit disk, metadata is written
+after every shard, a `COMPLETE` marker after the metadata, every file is
+fsync'd, and a single atomic rename publishes the step. A SIGKILL at ANY
+point leaves either the previous steps untouched or an unpublished temp dir
+the loader ignores — never a half-visible checkpoint. Chaos plans hook
+`ckpt.write_shard` / `ckpt.write_metadata` / `ckpt.publish`.
 """
 from __future__ import annotations
 
+import json
 import os
 import pickle
+import shutil
+import time
+import zlib
 
 import jax
 import numpy as np
 
 from ...core.tensor import Tensor
+from ..resilience import fault_injection as _fi
 from .metadata import LocalTensorMetadata, Metadata, TensorMetadata
+
+STEP_PREFIX = "step_"
+COMPLETE_MARKER = "COMPLETE"
 
 
 def _flatten_state_dict(state_dict, prefix=""):
@@ -30,36 +49,168 @@ def _flatten_state_dict(state_dict, prefix=""):
     return flat
 
 
-def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0, async_save=False):
+def list_steps(path):
+    """Published step numbers under a checkpoint root, ascending. A
+    `step_<N>.old` left by a same-step overwrite that died between its two
+    renames counts as step N — the loader falls back to it."""
+    if not os.path.isdir(path):
+        return []
+    steps = set()
+    for d in os.listdir(path):
+        if not d.startswith(STEP_PREFIX):
+            continue
+        tail = d[len(STEP_PREFIX):]
+        if tail.endswith(".old"):
+            tail = tail[:-len(".old")]
+        if tail.isdigit():
+            steps.add(int(tail))
+    return sorted(steps)
+
+
+def _crc32_file(fp, chunk=1 << 20) -> int:
+    """Chunked CRC32: constant memory on multi-GB shards."""
+    crc = 0
+    with open(fp, "rb") as f:
+        while True:
+            block = f.read(chunk)
+            if not block:
+                return crc
+            crc = zlib.crc32(block, crc)
+
+
+class _CrcWriter:
+    """File-object wrapper that CRCs every byte as np.save streams it, so
+    the recorded checksum is of the IN-FLIGHT bytes (single pass, constant
+    memory) — a write corrupted on its way to disk then fails load-time
+    verification instead of checksumming 'clean' from a re-read.
+
+    No `fileno` on purpose: np.lib.format's isfileobj() check then routes
+    through plain .write() calls instead of array.tofile()."""
+
+    def __init__(self, f):
+        self._f = f
+        self.crc = 0
+
+    def write(self, data):
+        self.crc = zlib.crc32(data, self.crc)
+        return self._f.write(data)
+
+    def flush(self):
+        self._f.flush()
+
+
+def _fsync_dir(d) -> None:
+    try:
+        fd = os.open(d, os.O_RDONLY)
+    except OSError:
+        return  # platform without directory fds
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _record_save_metric(outcome: str) -> None:
+    from ... import telemetry as _tm
+
+    if _tm.enabled():
+        _tm.counter(
+            "paddle_tpu_ckpt_saves_total",
+            "distributed checkpoint save attempts", ("outcome",),
+        ).labels(outcome=outcome).inc()
+
+
+def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
+                    async_save=False, step=None):
+    """Save into `path/step_<N>/` (N = `step` or max existing + 1) with the
+    atomic-publish protocol above; returns the published step directory.
+
+    Multi-process note (single-controller SPMD runs one process, the path
+    every test exercises): with process_count > 1 each process writes the
+    same deterministic temp dir on its own filesystem and process 0's rename
+    publishes. The atomicity/durability guarantees above are PER PROCESS —
+    nothing here orders process 0's publish after the other processes'
+    writes; on a shared filesystem callers must barrier before AND after the
+    save (the reference delegates the same ordering to its coordinator).
+    """
     flat = _flatten_state_dict(state_dict)
     os.makedirs(path, exist_ok=True)
     proc = jax.process_index()
-    meta = Metadata()
-    file_idx = 0
-    for name, t in flat.items():
-        if not isinstance(t, Tensor):
-            t = Tensor(np.asarray(t))
-        arr = t._value
-        tm = TensorMetadata(global_shape=tuple(arr.shape), dtype=str(np.dtype(arr.dtype)))
-        for shard in arr.addressable_shards:
-            if shard.replica_id != 0:
-                continue  # replicas hold identical bytes; first replica writes
-            offset = tuple(sl.start or 0 for sl in shard.index) if shard.index else ()
-            local = np.asarray(shard.data)
-            fname = f"{proc}_{file_idx}.distcp.npy"
-            file_idx += 1
-            np.save(os.path.join(path, fname), local)
-            tm.shards.append(
-                LocalTensorMetadata(
-                    global_offset=offset,
-                    local_shape=tuple(local.shape),
-                    dtype=tm.dtype,
-                    file_name=fname,
+    if step is None:
+        existing = list_steps(path)
+        step = existing[-1] + 1 if existing else 0
+    step_dir = os.path.join(path, f"{STEP_PREFIX}{step}")
+    tmp_dir = os.path.join(path, f".tmp_{STEP_PREFIX}{step}")
+    if proc == 0:
+        shutil.rmtree(tmp_dir, ignore_errors=True)  # stale temp from a dead save
+    os.makedirs(tmp_dir, exist_ok=True)
+
+    try:
+        meta = Metadata()
+        file_idx = 0
+        for name, t in flat.items():
+            if not isinstance(t, Tensor):
+                t = Tensor(np.asarray(t))
+            arr = t._value
+            tm = TensorMetadata(global_shape=tuple(arr.shape), dtype=str(np.dtype(arr.dtype)))
+            for shard in arr.addressable_shards:
+                if shard.replica_id != 0:
+                    continue  # replicas hold identical bytes; first replica writes
+                offset = tuple(sl.start or 0 for sl in shard.index) if shard.index else ()
+                local = np.asarray(shard.data)
+                fname = f"{proc}_{file_idx}.distcp.npy"
+                file_idx += 1
+                fpath = os.path.join(tmp_dir, fname)
+                _fi.fault_point("ckpt.write_shard", file=fname, tensor=name)
+                with open(fpath, "wb") as f:
+                    w = _CrcWriter(f)
+                    np.save(w, local)
+                    f.flush()
+                    os.fsync(f.fileno())
+                meta.file_checksums[fname] = w.crc
+                # chaos: corrupt AFTER the checksum is recorded — the
+                # torn-write shape load-time verification must catch
+                _fi.corrupt_file("ckpt.write_shard", fpath)
+                tm.shards.append(
+                    LocalTensorMetadata(
+                        global_offset=offset,
+                        local_shape=tuple(local.shape),
+                        dtype=tm.dtype,
+                        file_name=fname,
+                    )
                 )
-            )
-        meta.state_dict_metadata[name] = tm
-    # each process writes its own metadata piece; process 0's piece is merged
-    # with the others at load time (single-host: one file)
-    with open(os.path.join(path, f"{proc}.metadata"), "wb") as f:
-        pickle.dump(meta, f)
-    return path
+            meta.state_dict_metadata[name] = tm
+
+        # metadata is written only after every shard it references landed;
+        # each process writes its own piece (merged at load time)
+        _fi.fault_point("ckpt.write_metadata", step=step)
+        meta_path = os.path.join(tmp_dir, f"{proc}.metadata")
+        with open(meta_path, "wb") as f:
+            pickle.dump(meta, f)
+            f.flush()
+            os.fsync(f.fileno())
+        _fi.corrupt_file("ckpt.write_metadata", meta_path)
+
+        # completeness marker last: a temp dir without it is a torn save
+        _fi.fault_point("ckpt.publish", step=step)
+        if proc == 0:
+            marker = os.path.join(tmp_dir, COMPLETE_MARKER)
+            with open(marker, "w") as f:
+                json.dump({"step": step, "files": file_idx, "ts": time.time()}, f)
+                f.flush()
+                os.fsync(f.fileno())
+            _fsync_dir(tmp_dir)
+            if os.path.exists(step_dir):  # explicit same-step overwrite
+                trash = step_dir + ".old"
+                shutil.rmtree(trash, ignore_errors=True)
+                os.rename(step_dir, trash)
+                os.rename(tmp_dir, step_dir)
+                shutil.rmtree(trash, ignore_errors=True)
+            else:
+                os.rename(tmp_dir, step_dir)  # atomic publish
+            _fsync_dir(path)
+    except BaseException:
+        _record_save_metric("failed")
+        raise
+    _record_save_metric("ok")
+    return step_dir

@@ -604,14 +604,23 @@ def _watched(fn):
     @functools.wraps(fn)
     def inner(*args, **kwargs):
         from .comm_watchdog import comm_task
+        from .resilience import fault_injection as _fi
         from .. import telemetry as _tm
 
         g = _find_group(args, kwargs)
         op_name = f"collective.{fn.__name__}"
         task = comm_task(op_name, ranks=tuple(getattr(g, "ranks", ()) or ()) or "world")
+
+        def dispatch():
+            # chaos site INSIDE the watched section: a FaultPlan delay past
+            # the watchdog deadline drives the warn→dump→abort ladder
+            # through the real dispatch path
+            _fi.fault_point(op_name, group=getattr(g, "name", "_world"))
+            return fn(*args, **kwargs)
+
         if not _tm.enabled():
             with task:
-                return fn(*args, **kwargs)
+                return dispatch()
 
         from ..profiler.utils import RecordEvent, TracerEventType
 
@@ -627,7 +636,7 @@ def _watched(fn):
         t0 = time.perf_counter()
         try:
             with task, span:
-                return fn(*args, **kwargs)
+                return dispatch()
         finally:
             # observe even when the collective raises: calls_total already
             # counted this invocation, and diverging count/observe breaks

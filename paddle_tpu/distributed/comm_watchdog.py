@@ -9,20 +9,32 @@ TPU-native design: compiled collectives are XLA program internals — a hang
 surfaces as a host thread blocked in dispatch/compile (tunnel) or in a
 blocking wait (store rendezvous, block_until_ready). So the watchdog tracks
 HOST-SIDE blocking sections: every eager collective dispatch and every store
-wait registers a CommTask; a daemon thread scans them and, past the
-deadline, emits a full diagnostic dump (op, group ranks, elapsed, every
-other in-flight task) and invokes the abort handler — by default
-`os._exit(1)` after printing, matching the reference's abort-on-hang
-semantics. Tests/graceful users install their own handler via
-`set_timeout_handler`.
+wait registers a CommTask; a daemon thread scans them and escalates through
+a ladder instead of killing the process blind:
+
+1. **warn** — a task older than FLAGS_comm_watchdog_warn_s (but under its
+   hard deadline) gets ONE stderr warning + telemetry counter, so a
+   slowly-degrading link shows up before the abort;
+2. **dump** — past the hard deadline the default handler writes the full
+   diagnostic dump (op, group ranks, elapsed, every other in-flight task),
+   every thread's stack via `faulthandler`, and a telemetry snapshot;
+3. **abort** — flushes stderr (the dump must survive buffered pipes under
+   `launch`) and invokes the abort handler — default `os._exit(1)`,
+   matching the reference's abort-on-hang semantics.
+
+Tests/graceful users install their own hard-deadline handler via
+`set_timeout_handler` (replacing stages 2+3), or keep the diagnostics and
+swap only the final abort via `set_abort_handler`.
 
 Config: FLAGS_enable_comm_watchdog (default True),
 FLAGS_comm_watchdog_timeout_s (default 600, the reference's default
-CommTask timeout scale), or per-task timeouts; DistributedStrategy maps
-its `comm_watchdog_timeout` hybrid config here (see fleet/fleet.py).
+CommTask timeout scale), FLAGS_comm_watchdog_warn_s (soft deadline), or
+per-task timeouts; DistributedStrategy maps its `comm_watchdog_timeout`
+hybrid config here (see fleet/fleet.py).
 """
 from __future__ import annotations
 
+import faulthandler
 import itertools
 import os
 import sys
@@ -39,6 +51,11 @@ _flags.define_flag(
     "extra grace added to a blocking call's OWN timeout before the watchdog "
     "declares it stuck (a wait is only 'hung' once past its own deadline)",
 )
+_flags.define_flag(
+    "FLAGS_comm_watchdog_warn_s", 300.0,
+    "soft deadline: a comm task older than this (but not yet hung) emits one "
+    "warning with diagnostics; 0 disables the warn stage",
+)
 
 
 def _record_task_metric(name: str, op: str) -> None:
@@ -50,7 +67,7 @@ def _record_task_metric(name: str, op: str) -> None:
 
 
 class CommTask:
-    __slots__ = ("tid", "op", "info", "start", "timeout")
+    __slots__ = ("tid", "op", "info", "start", "timeout", "warned")
 
     def __init__(self, tid, op, info, timeout):
         self.tid = tid
@@ -58,6 +75,7 @@ class CommTask:
         self.info = info
         self.start = time.monotonic()
         self.timeout = timeout
+        self.warned = False
 
     def elapsed(self) -> float:
         return time.monotonic() - self.start
@@ -70,14 +88,47 @@ class CommTask:
         return f"CommTask[{self.tid}] op={self.op} elapsed={self.elapsed():.1f}s timeout={self.timeout:.0f}s {extra}"
 
 
+def flush_diagnostics() -> None:
+    """Make the dump survive the process: write a telemetry snapshot to
+    stderr (the retry/fault/collective counters are the post-mortem) and
+    flush — under `launch`, worker stderr rides a buffered pipe and an
+    unflushed abort loses everything after the last newline."""
+    try:
+        from .. import telemetry as _tm
+
+        if _tm.enabled():
+            sys.stderr.write("--- telemetry snapshot ---\n")
+            sys.stderr.write(_tm.to_prometheus())
+    except Exception:
+        pass  # diagnostics must never mask the abort
+    try:
+        sys.stderr.flush()
+    except Exception:
+        pass
+
+
+def _default_abort(task: CommTask) -> None:
+    os._exit(1)
+
+
 def _default_handler(task: CommTask, dump: str) -> None:
+    """Hard-deadline stages of the escalation ladder: dump, then abort."""
     sys.stderr.write(
         f"\n=== paddle_tpu comm watchdog: HUNG COLLECTIVE DETECTED ===\n"
         f"{task.describe()}\n--- all in-flight comm tasks ---\n{dump}\n"
-        f"aborting process (reference CommTaskManager semantics)\n"
+        f"--- all thread stacks ---\n"
     )
-    sys.stderr.flush()
-    os._exit(1)
+    try:
+        faulthandler.dump_traceback(file=sys.stderr, all_threads=True)
+    except Exception:
+        pass
+    flush_diagnostics()
+    sys.stderr.write("aborting process (reference CommTaskManager semantics)\n")
+    try:
+        sys.stderr.flush()
+    except Exception:
+        pass
+    CommTaskManager.instance()._abort_handler(task)
 
 
 class CommTaskManager:
@@ -92,6 +143,8 @@ class CommTaskManager:
         self._ids = itertools.count()
         self._thread: Optional[threading.Thread] = None
         self._handler: Callable = _default_handler
+        self._abort_handler: Callable = _default_abort
+        self._warn_handler: Optional[Callable] = None
         self._wake = threading.Event()
 
     @classmethod
@@ -126,6 +179,37 @@ class CommTaskManager:
         self._handler = fn or _default_handler
         return prev
 
+    def set_abort_handler(self, fn: Optional[Callable]) -> Callable:
+        """Swap the ladder's final stage (default os._exit(1)) while keeping
+        the dump/flush diagnostics — what a graceful shutdown hook or a chaos
+        test observing the full warn→dump→abort ordering wants."""
+        prev = self._abort_handler
+        self._abort_handler = fn or _default_abort
+        return prev
+
+    def set_warn_handler(self, fn: Optional[Callable]) -> Optional[Callable]:
+        prev = self._warn_handler
+        self._warn_handler = fn
+        return prev
+
+    def _warn(self, task: CommTask) -> None:
+        task.warned = True
+        _record_task_metric("paddle_tpu_comm_tasks_warned_total", task.op)
+        sys.stderr.write(
+            f"[paddle_tpu comm watchdog] WARNING: {task.describe()} — past the "
+            f"soft deadline (FLAGS_comm_watchdog_warn_s), will abort at "
+            f"{task.timeout:.0f}s\n"
+        )
+        try:
+            sys.stderr.flush()
+        except Exception:
+            pass
+        if self._warn_handler is not None:
+            try:
+                self._warn_handler(task)
+            except Exception:
+                pass
+
     def active_tasks(self):
         with self._lock:
             return list(self._tasks.values())
@@ -149,6 +233,7 @@ class CommTaskManager:
                     tasks = list(self._tasks.values())
                 if not tasks:
                     break
+                warn_s = float(_flags.get_flag("FLAGS_comm_watchdog_warn_s"))
                 for t in tasks:
                     if t.is_timeout():
                         dump = "\n".join(x.describe() for x in tasks)
@@ -159,8 +244,19 @@ class CommTaskManager:
                             self._handler(t, dump)
                         except Exception:
                             pass
-                # scan at 1/10 of the smallest remaining margin, bounded
-                margin = min((t.timeout - t.elapsed() for t in tasks), default=0.5)
+                    elif not t.warned and 0 < warn_s <= t.elapsed():
+                        # soft deadline: one warning per task, then keep
+                        # counting down to the hard deadline
+                        self._warn(t)
+                # scan at 1/10 of the smallest remaining margin (to a warn OR
+                # hard deadline), bounded
+                def _next_deadline(t):
+                    hard = t.timeout - t.elapsed()
+                    if not t.warned and 0 < warn_s:
+                        return min(hard, max(warn_s - t.elapsed(), 0.0))
+                    return hard
+
+                margin = min((_next_deadline(t) for t in tasks), default=0.5)
                 time.sleep(min(max(margin / 10, 0.02), 0.5))
 
 
@@ -186,3 +282,11 @@ class comm_task:
 
 def set_timeout_handler(fn: Optional[Callable]) -> Callable:
     return CommTaskManager.instance().set_timeout_handler(fn)
+
+
+def set_abort_handler(fn: Optional[Callable]) -> Callable:
+    return CommTaskManager.instance().set_abort_handler(fn)
+
+
+def set_warn_handler(fn: Optional[Callable]) -> Optional[Callable]:
+    return CommTaskManager.instance().set_warn_handler(fn)

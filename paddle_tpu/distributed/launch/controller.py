@@ -16,8 +16,18 @@ import socket
 import sys
 import time
 
+from ..resilience.retry import backoff_delay
 from .job import Pod
 from .master import HTTPMaster
+
+RESTART_BACKOFF_CAP_S = 30.0
+
+
+def _launch_metric(name: str, doc: str) -> None:
+    from ... import telemetry as _tm
+
+    if _tm.enabled():
+        _tm.counter(name, doc).inc()
 
 
 class Context:
@@ -38,6 +48,10 @@ class CollectiveController:
         self.master = None
         self.elastic = None  # ElasticManager when elastic mode is on
         self.elastic_restarts = 0
+        # restart backoff state: consecutive restarts since the last healthy
+        # window, and when the last restart happened (monotonic)
+        self.consecutive_restarts = 0
+        self.last_restart_t = None
 
     # ---- topology ----
     def _rendezvous(self):
@@ -119,6 +133,60 @@ class CollectiveController:
         self.elastic_restarts += 1
         return True
 
+    # ---- restart budget + backoff ----
+    def _restart_pod(self, why: str) -> None:
+        """Terminate + reap every container, back off, redeploy.
+
+        Restarting the WHOLE pod, not just the dead rank: a collective job's
+        survivors are blocked on the dead peer (the reference's NCCL jobs
+        behave the same — watchdog aborts the peers, launcher redeploys all);
+        workers resume from their distributed checkpoint. The backoff doubles
+        per consecutive restart with full jitter so a crash-looping pod
+        doesn't burn its restart budget racing zombies (or a half-restarted
+        master), and decorrelates multi-node redeploy stampedes."""
+        print(f"[launch] {why}, restarting pod", file=sys.stderr)
+        _launch_metric("paddle_tpu_launch_restarts_total", "pod restarts by the launch controller")
+        for c in self.pod.containers:
+            c.terminate(force=True)
+            c.restarts += 1
+        # reap before redeploy: a dying worker can still hold the exclusive
+        # device lock, and an unreaped Popen is a zombie
+        for c in self.pod.containers:
+            c.wait(timeout=10)
+        base = getattr(self.ctx.args, "restart_backoff", 0.5)
+        if base > 0:
+            delay = backoff_delay(self.consecutive_restarts, base, RESTART_BACKOFF_CAP_S)
+            print(f"[launch] restart backoff {delay:.2f}s "
+                  f"(consecutive={self.consecutive_restarts + 1})", file=sys.stderr)
+            time.sleep(delay)
+        self.consecutive_restarts += 1
+        self.last_restart_t = time.monotonic()
+        self.pod.deploy()
+
+    def _maybe_reset_restart_budget(self) -> None:
+        """A pod that has run clean for the healthy window earns its restart
+        budget back — a preemption every few hours must not accumulate
+        toward --max_restart forever."""
+        window = getattr(self.ctx.args, "restart_healthy_window", 0.0)
+        if (
+            window > 0
+            and self.last_restart_t is not None
+            and time.monotonic() - self.last_restart_t >= window
+            and not self.pod.failed_containers()
+        ):
+            print(
+                f"[launch] pod healthy for {window:.0f}s: restart budget reset",
+                file=sys.stderr,
+            )
+            _launch_metric(
+                "paddle_tpu_launch_budget_resets_total",
+                "restart budgets returned after a healthy window",
+            )
+            for c in self.pod.containers:
+                c.restarts = 0
+            self.consecutive_restarts = 0
+            self.last_restart_t = None
+
     def watch(self) -> int:
         """Poll container status (reference watcher.py): on failure either
         restart the whole pod (elastic, up to max_restart) or tear down."""
@@ -127,6 +195,7 @@ class CollectiveController:
         args = self.ctx.args
         while True:
             time.sleep(args.poll_interval)
+            self._maybe_reset_restart_budget()
             if self.elastic is not None:
                 st = self.elastic.watch()
                 if st == ElasticStatus.RESTART:
@@ -143,13 +212,7 @@ class CollectiveController:
                 if not failed:
                     return 0
                 if args.max_restart > 0 and all(c.restarts < args.max_restart for c in self.pod.containers):
-                    print(f"[launch] {len(failed)} container(s) failed, restarting pod", file=sys.stderr)
-                    for c in self.pod.containers:
-                        c.terminate(force=True)
-                        c.restarts += 1
-                    for c in self.pod.containers:
-                        c.wait(timeout=10)
-                    self.pod.deploy()
+                    self._restart_pod(f"{len(failed)} container(s) failed")
                     continue
                 print(f"[launch] job failed: exit codes {self.pod.exit_codes()}", file=sys.stderr)
                 return 1
@@ -157,25 +220,9 @@ class CollectiveController:
             if failed:
                 restartable = args.max_restart > 0 and all(c.restarts < args.max_restart for c in failed)
                 if restartable:
-                    # restart the WHOLE pod, not just the dead rank: a
-                    # collective job's survivors are blocked on the dead
-                    # peer (the reference's NCCL jobs behave the same —
-                    # watchdog aborts the peers, launcher redeploys all);
-                    # workers resume from their distributed checkpoint
-                    print(
-                        f"[launch] rank(s) {[c.env['PADDLE_TRAINER_ID'] for c in failed]} "
-                        "failed, restarting pod",
-                        file=sys.stderr,
+                    self._restart_pod(
+                        f"rank(s) {[c.env['PADDLE_TRAINER_ID'] for c in failed]} failed"
                     )
-                    for c in self.pod.containers:
-                        c.terminate(force=True)
-                        c.restarts += 1
-                    # reap before redeploy: a dying worker can still hold
-                    # the exclusive device lock, and an unreaped Popen is a
-                    # zombie — racing the relaunch against it burns restarts
-                    for c in self.pod.containers:
-                        c.wait(timeout=10)
-                    self.pod.deploy()
                 else:
                     print("[launch] container failed, stopping pod", file=sys.stderr)
                     self.pod.stop(force=True)

@@ -29,6 +29,17 @@ def parse_args(argv=None):
         "which a node is dead and the pod relaunches with new ranks",
     )
     p.add_argument("--poll_interval", type=float, default=1.0)
+    p.add_argument(
+        "--restart_backoff", type=float, default=0.5,
+        help="base seconds between pod restarts (doubles per consecutive "
+        "restart, full jitter, capped at 30s) so a crash-looping pod doesn't "
+        "burn its restart budget racing zombies",
+    )
+    p.add_argument(
+        "--restart_healthy_window", type=float, default=300.0,
+        help="seconds the pod must run clean after a restart before the "
+        "restart budget (--max_restart) and backoff reset; 0 disables",
+    )
     p.add_argument("--module", "-m", action="store_true", help="run script as a python module")
     p.add_argument("training_script")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)

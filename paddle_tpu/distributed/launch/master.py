@@ -123,20 +123,37 @@ class HTTPMaster(Master):
         self.client = KVClient(addr)
 
     def sync_peers(self, job_id: str, endpoint: str, nnodes: int, timeout=600):
+        from ..resilience.retry import RetryError, RetryPolicy, backoff_delay
+
         self.lazy_init()
         key = f"{job_id}/{endpoint.replace(':', '_').replace('/', '_')}"
+        # registration under the shared RetryPolicy: a node restarting while
+        # the master is itself mid-relaunch must back off with jitter, not
+        # hammer a refused port in lockstep with every other relaunched node
+        policy = RetryPolicy(
+            max_attempts=1_000_000, base_s=0.25, max_backoff_s=2.0, deadline_s=timeout
+        )
+
+        def register():
+            if not self.client.put(key, endpoint):
+                raise ConnectionError(f"cannot reach master {self.ctx.args.master}")
+
+        # one deadline across BOTH phases (register + peer wait): `timeout`
+        # bounds the whole rendezvous, not each stage
         deadline = time.time() + timeout
-        while not self.client.put(key, endpoint):
-            if time.time() > deadline:
-                raise TimeoutError(f"cannot reach master {self.ctx.args.master}")
-            time.sleep(0.5)
+        try:
+            policy.call(register, site="rendezvous.register")
+        except RetryError as e:
+            raise TimeoutError(f"cannot reach master {self.ctx.args.master}") from e
+        attempt = 0
         while True:
             peers = sorted(v for k, v in self.client.get_all().items() if k.startswith(f"/{job_id}/"))
             if len(peers) >= nnodes:
                 return peers, peers.index(endpoint)
             if time.time() > deadline:
                 raise TimeoutError(f"rendezvous timeout: {len(peers)}/{nnodes} nodes")
-            time.sleep(0.5)
+            time.sleep(0.1 + backoff_delay(attempt, 0.25, 1.0))
+            attempt += 1
 
     def stop(self):
         if self.server:

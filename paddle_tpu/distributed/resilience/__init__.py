@@ -1,0 +1,53 @@
+"""Unified resilience layer for the distributed runtime.
+
+At pod scale, preemptions, torn checkpoints, and rendezvous races are the
+steady state — this package gives every failure path one vocabulary:
+
+- `fault_injection` — deterministic `FaultPlan`s (named injection points
+  with fail-N-times / delay / corrupt actions, seedable, activatable via the
+  `PADDLE_TPU_FAULT_PLAN` env var) wired into TCPStore ops, eager collective
+  dispatch, and checkpoint shard IO, so chaos tests drive REAL failure
+  handling instead of hand-rolled monkeypatches.
+- `retry` — `RetryPolicy`: exponential backoff with full jitter under an
+  overall deadline, publishing per-site attempt/giveup counters into the
+  telemetry registry. Applied to TCPStore connect/op reconnects and launch
+  rendezvous; the launcher's restart backoff shares its delay schedule.
+
+The watchdog escalation ladder (warn → thread-stack dump + telemetry flush →
+abort) lives in `distributed/comm_watchdog.py` and the atomic, checksummed
+checkpoint format in `distributed/checkpoint/` — both consume the primitives
+here.
+"""
+from .fault_injection import (  # noqa: F401
+    FaultAction,
+    FaultInjected,
+    FaultPlan,
+    clear_plan,
+    corrupt_file,
+    current_plan,
+    fault_point,
+    install_plan,
+    plan_from_spec,
+)
+from .retry import (  # noqa: F401
+    RetryError,
+    RetryPolicy,
+    backoff_delay,
+    default_store_policy,
+)
+
+__all__ = [
+    "FaultAction",
+    "FaultInjected",
+    "FaultPlan",
+    "install_plan",
+    "clear_plan",
+    "current_plan",
+    "plan_from_spec",
+    "fault_point",
+    "corrupt_file",
+    "RetryPolicy",
+    "RetryError",
+    "backoff_delay",
+    "default_store_policy",
+]

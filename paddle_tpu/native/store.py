@@ -4,14 +4,36 @@ Reference parity: paddle/phi/core/distributed/store/tcp_store.h — rank 0
 hosts the store (is_master=True), all ranks connect; get/set/add/wait back
 process-group bootstrap and barriers. The server and protocol live in C++
 (src/core.cc); this wraps the C ABI.
+
+Resilience: connect and every op run under the distributed runtime's
+RetryPolicy (FLAGS_store_retry_* — exponential backoff + full jitter + an
+overall deadline), so workers racing the master during an elastic relaunch
+heal instead of dying on the first refused connection. A failed op drops the
+thread's cached socket and reconnects on the next attempt; exhaustion
+surfaces a descriptive error (op, key, host:port, attempts, elapsed). Chaos
+plans (distributed.resilience.fault_injection) hook the `store.connect` /
+`store.set` / `store.get` / `store.add` / `store.wait` sites.
 """
 from __future__ import annotations
 
 import ctypes
 import socket
 import threading
+import time
 
 from . import NativeUnavailable, get_lib
+
+_rz_mods = None
+
+
+def _rz():
+    """Lazy (import-cycle-safe) handle on the resilience primitives."""
+    global _rz_mods
+    if _rz_mods is None:
+        from ..distributed.resilience import fault_injection, retry
+
+        _rz_mods = (fault_injection, retry)
+    return _rz_mods
 
 
 class TCPStore:
@@ -37,15 +59,16 @@ class TCPStore:
         self.host = host
         self.port = port
         self._ip = socket.gethostbyname(host)
-        self._connect()  # fail fast on the creating thread
+        self._connect_with_retry()  # fail fast on the creating thread
 
-    def _connect(self):
-        c = self._lib.pt_store_client_connect(self._ip.encode(), self.port, int(self._timeout * 1000))
+    # ---- connection management ----
+    def _connect_once(self, timeout=None):
+        fi, _ = _rz()
+        fi.fault_point("store.connect", host=self.host, port=self.port)
+        timeout = self._timeout if timeout is None else timeout
+        c = self._lib.pt_store_client_connect(self._ip.encode(), self.port, int(timeout * 1000))
         if not c:
-            if self._server and not self._all_clients:
-                self._lib.pt_store_server_stop(self._server)
-                self._server = None
-            raise TimeoutError(f"TCPStore: cannot connect to {self.host}:{self.port}")
+            raise ConnectionError(f"TCPStore: cannot connect to {self.host}:{self.port}")
         with self._clients_lock:
             if self._closed:  # lost the race with close(): don't leak a live socket
                 self._lib.pt_store_client_shutdown(c)
@@ -54,42 +77,121 @@ class TCPStore:
         self._tls.client = c
         return c
 
+    def _connect_with_retry(self):
+        fi, rt = _rz()
+        policy = rt.default_store_policy(
+            retry_on=(ConnectionError, TimeoutError, OSError, fi.FaultInjected)
+        )
+        try:
+            return policy.call(self._connect_once, site="store.connect")
+        except rt.RetryError as e:
+            if self._server and not self._all_clients:
+                self._lib.pt_store_server_stop(self._server)
+                self._server = None
+            raise TimeoutError(
+                f"TCPStore: cannot connect to {self.host}:{self.port} "
+                f"after {e.attempts} attempt(s) in {e.elapsed:.2f}s"
+            ) from e
+
+    # back-compat alias (tests / callers may reach for _connect directly)
+    _connect = _connect_with_retry
+
     @property
     def _client(self):
         if self._closed:
             raise RuntimeError("TCPStore is closed")
         c = getattr(self._tls, "client", None)
-        return c if c is not None else self._connect()
+        return c if c is not None else self._connect_with_retry()
 
+    def _drop_client(self, c) -> None:
+        """Discard this thread's cached socket after an op-level failure so
+        the next attempt dials a fresh connection. shutdown (not close): the
+        C struct is intentionally leaked — freeing could race a concurrent
+        blocked request (see core.cc pt_store_client_shutdown)."""
+        if getattr(self._tls, "client", None) is c:
+            self._tls.client = None
+        with self._clients_lock:
+            if c in self._all_clients:
+                self._all_clients.remove(c)
+                self._lib.pt_store_client_shutdown(c)
+
+    def _op(self, op: str, key: str, attempt_once):
+        """Run one store op under the RetryPolicy: each attempt injects the
+        chaos site, grabs (or re-dials) this thread's client, and maps a
+        dead-socket result to ConnectionError so the policy reconnects with
+        backoff instead of surfacing a bare 'connection lost'. The re-dial is
+        a SINGLE connect attempt — the op's own policy owns backoff and the
+        overall deadline (nesting the full connect policy per attempt would
+        multiply FLAGS_store_retry_deadline_s)."""
+        fi, rt = _rz()
+
+        def attempt():
+            fi.fault_point(f"store.{op}", key=key)
+            if self._closed:
+                raise RuntimeError("TCPStore is closed")
+            c = getattr(self._tls, "client", None)
+            if c is None:
+                c = self._connect_once()
+            try:
+                return attempt_once(c)
+            except ConnectionError:
+                self._drop_client(c)
+                raise
+
+        policy = rt.default_store_policy(
+            retry_on=(ConnectionError, TimeoutError, OSError, fi.FaultInjected)
+        )
+        t0 = time.monotonic()
+        try:
+            return policy.call(attempt, site=f"store.{op}")
+        except rt.RetryError as e:
+            raise RuntimeError(
+                f"TCPStore.{op} failed: key={key!r} store={self.host}:{self.port} "
+                f"attempts={e.attempts} elapsed={time.monotonic() - t0:.2f}s "
+                f"last_error={type(e.last).__name__}: {e.last}"
+            ) from e
+
+    # ---- ops ----
     def set(self, key: str, value) -> None:
         if isinstance(value, str):
             value = value.encode()
-        rc = self._lib.pt_store_set(self._client, key.encode(), value, len(value))
-        if rc != 0:
-            raise RuntimeError("TCPStore.set failed (connection lost)")
+
+        def once(c):
+            rc = self._lib.pt_store_set(c, key.encode(), value, len(value))
+            if rc != 0:
+                raise ConnectionError("pt_store_set: connection lost")
+
+        self._op("set", key, once)
 
     def get(self, key: str) -> bytes:
-        cap = 1 << 16
-        buf = ctypes.create_string_buffer(cap)
-        n = self._lib.pt_store_get(self._client, key.encode(), buf, cap)
-        if n < 0:
-            raise KeyError(key)
-        if n > cap:  # value larger than the first buffer: refetch exactly
-            buf = ctypes.create_string_buffer(n)
-            n = self._lib.pt_store_get(self._client, key.encode(), buf, n)
+        def once(c):
+            cap = 1 << 16
+            buf = ctypes.create_string_buffer(cap)
+            n = self._lib.pt_store_get(c, key.encode(), buf, cap)
             if n < 0:
                 raise KeyError(key)
-        return buf.raw[:n]
+            if n > cap:  # value larger than the first buffer: refetch exactly
+                buf = ctypes.create_string_buffer(n)
+                n = self._lib.pt_store_get(c, key.encode(), buf, n)
+                if n < 0:
+                    raise KeyError(key)
+            return buf.raw[:n]
+
+        return self._op("get", key, once)
 
     def add(self, key: str, delta: int) -> int:
-        v = self._lib.pt_store_add(self._client, key.encode(), delta)
-        if v == -(2**63) or v == -(2**31):  # LONG_MIN sentinel
-            raise RuntimeError("TCPStore.add failed (connection lost)")
-        return int(v)
+        def once(c):
+            v = self._lib.pt_store_add(c, key.encode(), delta)
+            if v == -(2**63) or v == -(2**31):  # LONG_MIN sentinel
+                raise ConnectionError("pt_store_add: connection lost")
+            return int(v)
+
+        return self._op("add", key, once)
 
     def wait(self, keys, timeout=30.0) -> None:
         from ..distributed.comm_watchdog import comm_task
 
+        fi, _ = _rz()
         if isinstance(keys, str):
             keys = [keys]
         for k in keys:
@@ -105,9 +207,45 @@ class TCPStore:
             with comm_task(
                 "TCPStore.wait", timeout=wd_timeout, key=k, host=self._ip, port=self.port
             ):
-                rc = self._lib.pt_store_wait(self._client, k.encode(), int(timeout * 1000))
-            if rc != 0:
-                raise TimeoutError(f"TCPStore.wait timed out on key '{k}'")
+                fi.fault_point("store.wait", key=k)
+                deadline = time.monotonic() + timeout
+                while True:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise TimeoutError(f"TCPStore.wait timed out on key '{k}'")
+                    if self._closed:
+                        raise RuntimeError("TCPStore is closed")
+                    c = getattr(self._tls, "client", None)
+                    if c is None:
+                        # re-dial bounded by THIS wait's remaining budget —
+                        # the full connect policy (60s deadline, 30s dials)
+                        # must not block a 5s wait for minutes and trip the
+                        # watchdog that was armed for timeout+margin
+                        try:
+                            c = self._connect_once(timeout=min(self._timeout, remaining))
+                        except (ConnectionError, fi.FaultInjected):
+                            self._record_wait_retry(k)
+                            time.sleep(min(0.05, max(deadline - time.monotonic(), 0)))
+                            continue
+                    rc = self._lib.pt_store_wait(c, k.encode(), int(remaining * 1000))
+                    if rc == 0:
+                        break
+                    # nonzero is both "timed out" and "socket died" — only a
+                    # fast failure with budget left is worth re-dialing (the
+                    # master may be mid-relaunch); a real timeout consumed
+                    # the whole budget and exits above on the next check
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0.05:
+                        raise TimeoutError(f"TCPStore.wait timed out on key '{k}'")
+                    self._drop_client(c)
+                    self._record_wait_retry(k)
+                    time.sleep(min(0.05, remaining))
+
+    def _record_wait_retry(self, key: str) -> None:
+        _, rt = _rz()
+        metrics = rt._retry_metrics("store.wait")
+        if metrics:
+            metrics[1].inc()  # retries_total
 
     def delete_key(self, key: str) -> None:
         self._lib.pt_store_del(self._client, key.encode())

@@ -1,16 +1,17 @@
-"""Fault-injection integration test (VERDICT r2 next-round #4).
+"""Real-subprocess chaos for the resilience layer (slow lane).
 
-Real subprocess pattern of the reference's test_dist_base.py:959 fused with
-the elastic relaunch contract: the launcher spawns 2 REAL worker processes
-doing lockstep data-parallel SGD with gradient exchange over the native C++
-TCPStore and per-rank distributed checkpoint shards; the test SIGKILLs one
-worker mid-run; the controller relaunches the pod; workers resume from the
-latest complete checkpoint and the final loss equals an uninterrupted run's.
+The elastic relaunch contract driven through the FRAMEWORK's own machinery
+— no hand-rolled completeness markers: workers checkpoint through
+`dist.checkpoint.save_state_dict` (atomic step dirs + CRC metadata), the
+chaos schedule arrives via `PADDLE_TPU_FAULT_PLAN` in the environment
+(store connect flaps on every (re)launched process, healed by the default
+RetryPolicy), the test SIGKILLs a worker mid-run, and the launch controller
+relaunches the pod with restart backoff. Workers resume from the newest
+COMPLETE checkpoint step and converge to the uninterrupted run's weights.
 """
 import json
 import os
 import signal
-import sys
 import threading
 import time
 
@@ -19,8 +20,6 @@ import pytest
 
 from paddle_tpu.distributed.launch import CollectiveController, Context, parse_args
 
-# real-subprocess chaos: out of the tier-1 fast lane (the in-process
-# FaultPlan coverage lives in test_resilience.py)
 pytestmark = pytest.mark.slow
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -28,7 +27,13 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = r'''
 import json, os, sys, time
 sys.path.insert(0, os.environ["FI_REPO"])
+import jax
+jax.config.update("jax_platforms", "cpu")
 import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu import telemetry
+from paddle_tpu.distributed.checkpoint import list_steps, load_state_dict, save_state_dict
+from paddle_tpu.native.store import TCPStore
 
 rank = int(os.environ["PADDLE_TRAINER_ID"])
 world = int(os.environ["PADDLE_TRAINERS_NUM"])
@@ -37,10 +42,10 @@ out = os.environ["FI_DIR"]
 TOTAL = int(os.environ["FI_STEPS"])
 LR = 0.2
 
-from paddle_tpu.native.store import TCPStore
+# PADDLE_TPU_FAULT_PLAN in the env injects store.connect failures on every
+# process (first launch AND relaunch); the default RetryPolicy heals them.
 store = TCPStore(host, int(port), is_master=(rank == 0), world_size=world, timeout=60)
 
-# deterministic problem, sharded by rank
 rng = np.random.RandomState(0)
 w_true = np.array([[1.0], [-2.0], [3.0], [0.5]], np.float32)
 X = rng.randn(64, 4).astype(np.float32)
@@ -49,24 +54,23 @@ xs, ys = X[rank::world], Y[rank::world]
 
 w = np.zeros((4, 1), np.float32)
 
-# resume from the latest COMPLETE step (marker written only after every
-# rank's shard landed)
+# resume from the last step EVERY rank completed (a rank killed mid-step has
+# fewer published steps; the laggard decides, survivors re-do the tail by
+# overwriting their own step dirs deterministically)
 ck = os.path.join(out, "ckpt")
-os.makedirs(ck, exist_ok=True)
-start = 0
-done_steps = sorted(
-    int(f.split("_")[1]) for f in os.listdir(ck) if f.startswith("complete_")
-)
-if done_steps:
-    s = done_steps[-1]
-    w = np.load(os.path.join(ck, f"shard_{s}_{rank}.npy"))
-    start = s + 1
+roots = [os.path.join(ck, f"rank{r}") for r in range(world)]
+last_done = [(list_steps(r) or [-1])[-1] for r in roots]
+start = min(last_done) + 1
+if start > 0:
+    sd = {"w": paddle.zeros([4, 1])}
+    load_state_dict(sd, os.path.join(roots[rank], f"step_{start - 1}"))
+    w = sd["w"].numpy().copy()
     with open(os.path.join(out, f"resumed.{rank}"), "a") as f:
-        f.write(f"{s}\n")
+        f.write(f"{start - 1}\n")
 
 for step in range(start, TOTAL):
     pred = xs @ w
-    grad = 2.0 * xs.T @ (pred - ys) / xs.shape[0]   # [4,1]
+    grad = 2.0 * xs.T @ (pred - ys) / xs.shape[0]
     store.set(f"g{step}_{rank}", grad.astype(np.float32).tobytes())
     store.wait([f"g{step}_{r}" for r in range(world)], timeout=120.0)
     gsum = np.zeros_like(grad)
@@ -74,20 +78,24 @@ for step in range(start, TOTAL):
         gsum += np.frombuffer(store.get(f"g{step}_{r}"), np.float32).reshape(4, 1)
     w = w - LR * gsum / world
 
-    # per-rank checkpoint shard, atomic
-    tmp = os.path.join(ck, f".tmp_{step}_{rank}.npy")
-    np.save(tmp, w)
-    os.replace(tmp, os.path.join(ck, f"shard_{step}_{rank}.npy"))
-    store.set(f"done{step}_{rank}", b"1")
-    store.wait([f"done{step}_{r}" for r in range(world)], timeout=120.0)
-    if rank == 0:
-        open(os.path.join(ck, f"complete_{step}_"), "w").close()
+    # framework checkpoint: atomic step dir, CRC metadata, marker last
+    save_state_dict({"w": paddle.to_tensor(w)}, roots[rank], step=step)
 
     with open(os.path.join(out, f"progress.{rank}.tmp"), "w") as f:
         f.write(str(step))
     os.replace(os.path.join(out, f"progress.{rank}.tmp"), os.path.join(out, f"progress.{rank}"))
     if os.environ.get("FI_STEP_DELAY"):
         time.sleep(float(os.environ["FI_STEP_DELAY"]))
+
+# surface the healed connect flaps for the assertion in the parent
+fam = telemetry.default_registry().get("paddle_tpu_retry_retries_total")
+healed = 0
+if fam is not None:
+    for child in fam.children():
+        if dict(child.labels).get("site") == "store.connect":
+            healed = child.value
+with open(os.path.join(out, f"retries.{rank}"), "w") as f:
+    f.write(str(healed))
 
 if rank == 0:
     loss = float(np.mean((X @ w - Y) ** 2))
@@ -106,7 +114,7 @@ def _free_port():
         return s.getsockname()[1]
 
 
-def _run_pod(tmp_path, tag, steps, step_delay=None, kill_after_step=None):
+def _run_pod(tmp_path, tag, steps, step_delay=None, kill_after_step=None, fault_plan=None):
     out = tmp_path / tag
     out.mkdir()
     script = tmp_path / f"worker_{tag}.py"
@@ -115,15 +123,19 @@ def _run_pod(tmp_path, tag, steps, step_delay=None, kill_after_step=None):
         "FI_REPO": REPO,
         "FI_DIR": str(out),
         "FI_STEPS": str(steps),
+        "JAX_PLATFORMS": "cpu",
     }
     if step_delay:
         env["FI_STEP_DELAY"] = str(step_delay)
+    if fault_plan:
+        env["PADDLE_TPU_FAULT_PLAN"] = fault_plan
     old = {k: os.environ.get(k) for k in env}
     os.environ.update(env)
     try:
         args = parse_args([
             "--nproc_per_node", "2", "--max_restart", "3",
-            "--poll_interval", "0.2", "--port", str(_free_port()), str(script),
+            "--poll_interval", "0.2", "--restart_backoff", "0.2",
+            "--port", str(_free_port()), str(script),
         ])
         ctrl = CollectiveController(Context(args))
         result = {}
@@ -136,7 +148,7 @@ def _run_pod(tmp_path, tag, steps, step_delay=None, kill_after_step=None):
 
         if kill_after_step is not None:
             prog = out / "progress.1"
-            deadline = time.time() + 120
+            deadline = time.time() + 180
             while time.time() < deadline:
                 if prog.exists() and int(prog.read_text() or -1) >= kill_after_step:
                     break
@@ -146,7 +158,7 @@ def _run_pod(tmp_path, tag, steps, step_delay=None, kill_after_step=None):
             pid = ctrl.pod.containers[1].proc.pid
             os.kill(pid, signal.SIGKILL)
 
-        th.join(timeout=240)
+        th.join(timeout=360)
         assert not th.is_alive(), "launcher did not finish"
         assert result["code"] == 0, f"pod exit code {result['code']}"
         final = json.load(open(out / "final.json"))
@@ -156,26 +168,25 @@ def _run_pod(tmp_path, tag, steps, step_delay=None, kill_after_step=None):
             os.environ.pop(k, None) if v is None else os.environ.__setitem__(k, v)
 
 
-def test_sigkill_midrun_relaunch_resumes_to_same_loss(tmp_path):
-    steps = 12
+def test_sigkill_with_framework_checkpoints_and_connect_flaps(tmp_path):
+    steps = 10
     ref, _, _ = _run_pod(tmp_path, "ref", steps)
 
     got, ctrl, out = _run_pod(
-        tmp_path, "faulty", steps, step_delay=0.25, kill_after_step=3)
+        tmp_path, "chaos", steps, step_delay=0.3, kill_after_step=2,
+        fault_plan="store.connect=fail*2",
+    )
 
-    # the pod actually restarted
+    # the pod actually restarted (with backoff) after the SIGKILL
     assert all(c.restarts >= 1 for c in ctrl.pod.containers)
-    # workers actually resumed from a checkpoint (not from scratch)
+    # workers resumed from a published framework checkpoint step, not scratch
     resumed = (out / "resumed.0").read_text().strip().splitlines()
-    assert resumed and int(resumed[0]) >= 2
+    assert resumed and int(resumed[0]) >= 1
+    # the injected connect flaps were healed by the RetryPolicy (visible in
+    # the workers' telemetry counters)
+    assert int((out / "retries.0").read_text()) >= 2
 
-    # training converged to the SAME result as the uninterrupted run
+    # identical result to the uninterrupted run
     np.testing.assert_allclose(got["w"], ref["w"], rtol=1e-6, atol=1e-7)
     assert got["loss"] == pytest.approx(ref["loss"], rel=1e-6)
-    assert ref["loss"] < 1e-2  # and it genuinely learned
-
-
-def test_uninterrupted_pod_trains(tmp_path):
-    final, ctrl, _ = _run_pod(tmp_path, "plain", 10)
-    assert final["loss"] < 0.05
-    assert all(c.restarts == 0 for c in ctrl.pod.containers)
+    assert ref["loss"] < 0.05  # and it genuinely learned

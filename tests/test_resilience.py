@@ -1,0 +1,575 @@
+"""Resilience layer: FaultPlan chaos, RetryPolicy backoff, atomic verified
+checkpoints, watchdog escalation ladder — all in-process (tier-1 safe).
+
+The real-subprocess chaos (SIGKILL + elastic relaunch) lives in
+test_fault_injection.py / test_chaos_slow.py behind the `slow` marker; these
+tests drive the SAME failure paths through the framework's own FaultPlan
+injection points instead of hand-rolled monkeypatches.
+"""
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu import telemetry
+from paddle_tpu.distributed import resilience as rz
+from paddle_tpu.distributed.checkpoint import (
+    CheckpointCorrupt,
+    list_steps,
+    load_state_dict,
+    save_state_dict,
+    verify_step,
+)
+from paddle_tpu.distributed.comm_watchdog import (
+    comm_task,
+    set_abort_handler,
+    set_timeout_handler,
+    set_warn_handler,
+)
+from paddle_tpu.framework import flags as _flags
+from paddle_tpu.native.store import TCPStore
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_plan():
+    rz.clear_plan()
+    yield
+    rz.clear_plan()
+
+
+@pytest.fixture
+def fast_retry():
+    old = _flags.get_flags([
+        "FLAGS_store_retry_max_attempts", "FLAGS_store_retry_base_s",
+        "FLAGS_store_retry_max_s", "FLAGS_store_retry_deadline_s",
+    ])
+    _flags.set_flags({
+        "FLAGS_store_retry_max_attempts": 5,
+        "FLAGS_store_retry_base_s": 0.002,
+        "FLAGS_store_retry_max_s": 0.01,
+        "FLAGS_store_retry_deadline_s": 5.0,
+    })
+    yield
+    _flags.set_flags(old)
+
+
+def _counter_value(name, **labels):
+    fam = telemetry.default_registry().get(name)
+    if fam is None:
+        return 0
+    for child in fam.children():
+        if dict(child.labels) == {k: str(v) for k, v in labels.items()}:
+            return child.value
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan
+# ---------------------------------------------------------------------------
+
+
+def test_plan_compact_and_json_spec_parse():
+    p = rz.plan_from_spec("store.connect=fail*2;ckpt.write_shard=corrupt;x=delay*3:0.5")
+    assert [(s.site, s.action, s.times) for s in p.specs] == [
+        ("store.connect", "fail", 2),
+        ("ckpt.write_shard", "corrupt", 1),
+        ("x", "delay", 3),
+    ]
+    assert p.specs[2].arg == 0.5
+    p2 = rz.plan_from_spec(
+        '[{"site": "store.set", "action": "delay", "times": null, "arg": 0.05}]'
+    )
+    assert p2.specs[0].times is None and p2.specs[0].arg == 0.05
+    # arg without an explicit *times (documented grammar)
+    p3 = rz.plan_from_spec("store.set=delay:0.05")
+    assert (p3.specs[0].action, p3.specs[0].times, p3.specs[0].arg) == ("delay", 1, 0.05)
+    with pytest.raises(ValueError):
+        rz.FaultPlan().add("s", "explode")
+
+
+def test_fail_n_times_then_clean():
+    rz.install_plan(rz.FaultPlan().add("site.a", "fail", times=2))
+    for _ in range(2):
+        with pytest.raises(rz.FaultInjected):
+            rz.fault_point("site.a")
+    rz.fault_point("site.a")  # exhausted: clean
+    assert rz.current_plan().triggered["site.a"] == 2
+
+
+def test_glob_site_and_delay():
+    rz.install_plan(rz.FaultPlan().add("store.*", "delay", times=1, arg=0.05))
+    t0 = time.monotonic()
+    rz.fault_point("store.set", key="k")
+    assert time.monotonic() - t0 >= 0.05
+    rz.fault_point("store.set", key="k")  # exhausted
+
+
+def test_corrupt_is_seeded_and_deterministic(tmp_path):
+    payload = bytes(range(256)) * 4
+    out = []
+    for run in range(2):
+        fp = tmp_path / f"f{run}.bin"
+        fp.write_bytes(payload)
+        rz.install_plan(rz.FaultPlan(seed=7).add("ckpt.write_shard", "corrupt", times=1))
+        assert rz.corrupt_file("ckpt.write_shard", str(fp))
+        out.append(fp.read_bytes())
+        rz.clear_plan()
+    assert out[0] == out[1] != payload  # same seed -> same flips
+
+
+def test_env_activation(tmp_path):
+    # a fresh plan-state module picks the plan up from the environment (the
+    # path a launched worker subprocess takes)
+    import importlib
+
+    from paddle_tpu.distributed.resilience import fault_injection as fi
+
+    os.environ["PADDLE_TPU_FAULT_PLAN"] = "env.site=fail*1"
+    try:
+        fi._env_checked = False
+        fi._active = None
+        with pytest.raises(fi.FaultInjected):
+            fi.fault_point("env.site")
+        fi.fault_point("env.site")  # exhausted
+    finally:
+        del os.environ["PADDLE_TPU_FAULT_PLAN"]
+        fi.install_plan(None)
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy
+# ---------------------------------------------------------------------------
+
+
+def test_retry_heals_transient_failures_with_backoff():
+    calls = {"n": 0}
+    sleeps = []
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] <= 3:
+            raise ConnectionError("flap")
+        return "ok"
+
+    policy = rz.RetryPolicy(max_attempts=6, base_s=0.1, max_backoff_s=0.4,
+                            deadline_s=30.0, sleep=sleeps.append)
+    assert policy.call(flaky, site="test.flaky") == "ok"
+    assert calls["n"] == 4
+    # full jitter: each delay in [0, min(cap, base * 2**attempt)]
+    assert len(sleeps) == 3
+    for i, d in enumerate(sleeps):
+        assert 0.0 <= d <= min(0.4, 0.1 * 2**i)
+    assert _counter_value("paddle_tpu_retry_attempts_total", site="test.flaky") >= 4
+    assert _counter_value("paddle_tpu_retry_retries_total", site="test.flaky") >= 3
+
+
+def test_retry_gives_up_with_descriptive_error_and_counter():
+    policy = rz.RetryPolicy(max_attempts=3, base_s=0.001, max_backoff_s=0.002,
+                            deadline_s=30.0, sleep=lambda s: None)
+    before = _counter_value("paddle_tpu_retry_giveups_total", site="test.dead")
+    with pytest.raises(rz.RetryError) as ei:
+        policy.call(lambda: (_ for _ in ()).throw(ConnectionError("down")), site="test.dead")
+    assert ei.value.attempts == 3 and isinstance(ei.value.last, ConnectionError)
+    assert "test.dead" in str(ei.value) and "3 attempt" in str(ei.value)
+    assert _counter_value("paddle_tpu_retry_giveups_total", site="test.dead") == before + 1
+
+
+def test_retry_respects_overall_deadline():
+    policy = rz.RetryPolicy(max_attempts=1000, base_s=0.2, max_backoff_s=0.2,
+                            deadline_s=0.05, sleep=lambda s: None)
+    t = {"n": 0}
+
+    def fail():
+        t["n"] += 1
+        time.sleep(0.03)
+        raise TimeoutError("x")
+
+    with pytest.raises(rz.RetryError):
+        policy.call(fail, site="test.deadline")
+    assert t["n"] < 10  # deadline cut it off long before 1000 attempts
+
+
+def test_non_transient_error_propagates_immediately():
+    policy = rz.RetryPolicy(max_attempts=5, retry_on=(ConnectionError,))
+    with pytest.raises(KeyError):
+        policy.call(lambda: (_ for _ in ()).throw(KeyError("real answer")), site="t")
+
+
+# ---------------------------------------------------------------------------
+# TCPStore under chaos (acceptance: ops survive N injected failures, backoff
+# visible in telemetry)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def store_pair():
+    master = TCPStore("127.0.0.1", 0, is_master=True)
+    client = TCPStore("127.0.0.1", master.port, is_master=False)
+    yield master, client
+    client.close()
+    master.close()
+
+
+def test_store_connect_survives_injected_failures(fast_retry):
+    master = TCPStore("127.0.0.1", 0, is_master=True)
+    before = _counter_value("paddle_tpu_retry_retries_total", site="store.connect")
+    rz.install_plan(rz.FaultPlan().add("store.connect", "fail", times=3))
+    client = TCPStore("127.0.0.1", master.port, is_master=False)
+    client.set("k", b"v")
+    assert client.get("k") == b"v"
+    assert _counter_value("paddle_tpu_retry_retries_total", site="store.connect") >= before + 3
+    client.close()
+    master.close()
+
+
+def test_store_ops_survive_injected_failures(fast_retry, store_pair):
+    _, client = store_pair
+    rz.install_plan(
+        rz.FaultPlan()
+        .add("store.set", "fail", times=2)
+        .add("store.add", "fail", times=2)
+        .add("store.get", "fail", times=1)
+    )
+    client.set("k2", b"w")
+    assert client.add("cnt", 5) == 5
+    assert client.get("k2") == b"w"
+    assert _counter_value("paddle_tpu_retry_retries_total", site="store.set") >= 2
+    assert _counter_value("paddle_tpu_retry_retries_total", site="store.add") >= 2
+
+
+def test_store_exhaustion_error_is_descriptive(fast_retry, store_pair):
+    _, client = store_pair
+    rz.install_plan(rz.FaultPlan().add("store.set", "fail", times=None))
+    with pytest.raises(RuntimeError) as ei:
+        client.set("doomed", b"x")
+    msg = str(ei.value)
+    assert "TCPStore.set" in msg and "doomed" in msg
+    assert f"{client.host}:{client.port}" in msg
+    assert "attempts=" in msg and "elapsed=" in msg
+
+
+def test_store_op_reconnects_after_dead_socket(fast_retry, store_pair):
+    """A dead cached per-thread socket must heal: drop + re-dial + retry
+    instead of the old bare RuntimeError('connection lost')."""
+    _, client = store_pair
+    c = client._client
+    client._lib.pt_store_client_shutdown(c)  # kill the cached socket under it
+    client.set("after-death", b"alive")
+    assert client.get("after-death") == b"alive"
+    assert client._client is not c  # a fresh connection was dialed
+
+
+def test_store_wait_heals_across_reconnect(fast_retry, store_pair):
+    master, client = store_pair
+    master.set("ready", b"1")
+    c = client._client
+    client._lib.pt_store_client_shutdown(c)
+    client.wait("ready", timeout=5.0)  # dead socket -> re-dial -> wait succeeds
+
+
+def test_store_wait_redial_survives_injected_connect_faults(fast_retry, store_pair):
+    master, client = store_pair
+    master.set("ready2", b"1")
+    c = client._client
+    client._drop_client(c)
+    rz.install_plan(rz.FaultPlan().add("store.connect", "fail", times=2))
+    client.wait("ready2", timeout=5.0)  # FaultInjected on re-dial is retried, not fatal
+
+
+# ---------------------------------------------------------------------------
+# atomic checkpoints (acceptance: torn/corrupt latest step -> newest complete
+# restores, driven by FaultPlan)
+# ---------------------------------------------------------------------------
+
+
+def _save(root, value, shape=(3, 4)):
+    sd = {"w": paddle.to_tensor(np.full(shape, value, "float32"))}
+    return save_state_dict(sd, str(root))
+
+
+def _load_w(root, shape=(3, 4)):
+    tgt = {"w": paddle.zeros(list(shape))}
+    load_state_dict(tgt, str(root))
+    return float(tgt["w"].numpy()[0, 0])
+
+
+def test_each_save_lands_in_its_own_step_dir(tmp_path):
+    p0 = _save(tmp_path, 1.0)
+    p1 = _save(tmp_path, 2.0)
+    assert os.path.basename(p0) == "step_0" and os.path.basename(p1) == "step_1"
+    assert list_steps(str(tmp_path)) == [0, 1]
+    # stale shards cannot interleave: the two steps are disjoint directories
+    assert set(os.listdir(p0)) & set(os.listdir(p1)) == set(os.listdir(p0))
+    assert _load_w(tmp_path) == 2.0
+
+
+def test_torn_save_falls_back_to_previous_complete_step(tmp_path):
+    """The SIGKILL-mid-save shape: the fault plan kills the save before its
+    metadata/completeness marker lands; load must reject the torn step via
+    the integrity check and restore the newest COMPLETE one."""
+    _save(tmp_path, 1.0)
+    rz.install_plan(rz.FaultPlan().add("ckpt.write_metadata", "fail", times=1))
+    with pytest.raises(rz.FaultInjected):
+        _save(tmp_path, 9.0)
+    rz.clear_plan()
+    assert _load_w(tmp_path) == 1.0  # previous checkpoint still loadable
+
+
+def test_kill_before_publish_leaves_previous_step(tmp_path):
+    _save(tmp_path, 3.0)
+    rz.install_plan(rz.FaultPlan().add("ckpt.publish", "fail", times=1))
+    with pytest.raises(rz.FaultInjected):
+        _save(tmp_path, 9.0)
+    rz.clear_plan()
+    assert list_steps(str(tmp_path)) == [0]  # torn temp dir never published
+    assert _load_w(tmp_path) == 3.0
+
+
+def test_corrupt_shard_detected_by_crc_and_skipped(tmp_path):
+    before = _counter_value("paddle_tpu_ckpt_fallbacks_total", reason="corrupt")
+    _save(tmp_path, 1.0)
+    rz.install_plan(rz.FaultPlan().add("ckpt.write_shard", "corrupt", times=1))
+    _save(tmp_path, 9.0)  # publishes, but its shard bytes are rotten
+    rz.clear_plan()
+    assert list_steps(str(tmp_path)) == [0, 1]
+    assert _load_w(tmp_path) == 1.0  # CRC mismatch -> newest COMPLETE wins
+    assert _counter_value("paddle_tpu_ckpt_fallbacks_total", reason="corrupt") == before + 1
+    with pytest.raises(CheckpointCorrupt, match="CRC32 mismatch"):
+        verify_step(os.path.join(str(tmp_path), "step_1"))
+
+
+def test_all_steps_corrupt_raises(tmp_path):
+    rz.install_plan(rz.FaultPlan().add("ckpt.write_shard", "corrupt", times=None))
+    _save(tmp_path, 1.0)
+    rz.clear_plan()
+    with pytest.raises(CheckpointCorrupt, match="no complete, uncorrupted"):
+        _load_w(tmp_path)
+
+
+def test_overwrite_crash_between_renames_falls_back_to_old(tmp_path):
+    """A same-step overwrite that dies between its two renames leaves only
+    `step_<N>.old` — the loader must use that complete copy, not strand."""
+    _save(tmp_path, 5.0, )
+    step = os.path.join(str(tmp_path), "step_0")
+    os.rename(step, step + ".old")  # the mid-overwrite crash window
+    assert list_steps(str(tmp_path)) == [0]
+    assert _load_w(tmp_path) == 5.0
+
+
+def test_legacy_flat_checkpoint_still_loads(tmp_path):
+    import shutil
+
+    step = _save(tmp_path / "root", 4.0)
+    legacy = tmp_path / "flat"
+    legacy.mkdir()
+    for f in os.listdir(step):
+        if f != "COMPLETE":
+            shutil.copy(os.path.join(step, f), legacy)
+    assert _load_w(legacy) == 4.0
+
+
+def test_step_dirs_shadow_stale_legacy_flat_files(tmp_path):
+    """A pre-upgrade flat checkpoint at the root must not mask newer step
+    saves written alongside it."""
+    import shutil
+
+    step0 = _save(tmp_path, 1.0)
+    for f in os.listdir(step0):  # stale flat copy at the root
+        if f != "COMPLETE":
+            shutil.copy(os.path.join(step0, f), tmp_path)
+    _save(tmp_path, 2.0)
+    assert _load_w(tmp_path) == 2.0  # step_1 wins over the root's flat files
+
+
+def test_resume_loop_with_framework_checkpoint(tmp_path):
+    """The relaunch contract end-to-end, in process: train, die mid-save,
+    resume from the newest complete step, converge to the same weights."""
+    rng = np.random.RandomState(0)
+    w_true = np.array([[1.0], [-2.0], [3.0], [0.5]], np.float32)
+    X = rng.randn(64, 4).astype(np.float32)
+    Y = X @ w_true
+
+    def run(root, fault_plan=None, die_at=None):
+        steps_done = 0
+        w = np.zeros((4, 1), np.float32)
+        if list_steps(str(root)):
+            sd = {"w": paddle.zeros([4, 1]), "step": paddle.zeros([1])}
+            load_state_dict(sd, str(root))
+            w = sd["w"].numpy().copy()
+            steps_done = int(sd["step"].numpy()[0]) + 1
+        for step in range(steps_done, 8):
+            grad = 2.0 * X.T @ (X @ w - Y) / X.shape[0]
+            w = w - 0.2 * grad
+            if fault_plan is not None and step == die_at:
+                rz.install_plan(fault_plan)
+            try:
+                save_state_dict(
+                    {"w": paddle.to_tensor(w), "step": paddle.to_tensor([float(step)])},
+                    str(root), step=step,
+                )
+            except rz.FaultInjected:
+                rz.clear_plan()
+                return w, step, True  # "process died" mid-save
+        return w, step, False
+
+    ref, _, _ = run(tmp_path / "ref")
+    faulty_root = tmp_path / "faulty"
+    plan = rz.FaultPlan().add("ckpt.write_metadata", "fail", times=1)
+    w1, died_step, died = run(faulty_root, fault_plan=plan, die_at=4)
+    assert died and died_step == 4
+    w2, _, _ = run(faulty_root)  # relaunch: resumes from step_3, not scratch
+    np.testing.assert_allclose(w2, ref, rtol=1e-6)
+    assert list_steps(str(faulty_root)) == [0, 1, 2, 3, 4, 5, 6, 7]
+
+
+# ---------------------------------------------------------------------------
+# watchdog escalation ladder
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def ladder_hooks():
+    events = []
+    prev_warn = set_warn_handler(lambda t: events.append(("warn", t.op)))
+    prev_abort = set_abort_handler(lambda t: events.append(("abort", t.op)))
+    yield events
+    set_warn_handler(prev_warn)
+    set_abort_handler(None if prev_abort is None else prev_abort)
+
+
+def test_watchdog_ladder_warn_dump_abort_ordering(ladder_hooks, capfd):
+    _flags.set_flags({"FLAGS_comm_watchdog_warn_s": 0.15})
+    try:
+        with comm_task("collective.all_reduce", timeout=0.5, ranks=(0, 1)):
+            time.sleep(0.9)
+    finally:
+        _flags.set_flags({"FLAGS_comm_watchdog_warn_s": 300.0})
+    assert ladder_hooks == [
+        ("warn", "collective.all_reduce"),
+        ("abort", "collective.all_reduce"),
+    ]
+    err = capfd.readouterr().err
+    # ladder ordering on the wire too: warn < task dump < thread stacks < abort
+    i_warn = err.index("soft deadline")
+    i_dump = err.index("HUNG COLLECTIVE DETECTED")
+    i_stacks = err.index("all thread stacks")
+    i_abort = err.index("aborting process")
+    assert i_warn < i_dump < i_stacks < i_abort
+    assert "Thread" in err  # faulthandler actually dumped stacks
+
+
+def test_watchdog_warn_counts_in_telemetry(ladder_hooks):
+    before = _counter_value("paddle_tpu_comm_tasks_warned_total", op="test.slowpoke")
+    _flags.set_flags({"FLAGS_comm_watchdog_warn_s": 0.1})
+    try:
+        with comm_task("test.slowpoke", timeout=60.0):
+            time.sleep(0.35)  # passes soft deadline, never the hard one
+    finally:
+        _flags.set_flags({"FLAGS_comm_watchdog_warn_s": 300.0})
+    assert ladder_hooks == [("warn", "test.slowpoke")]
+    assert _counter_value("paddle_tpu_comm_tasks_warned_total", op="test.slowpoke") == before + 1
+
+
+def test_custom_timeout_handler_still_replaces_ladder(ladder_hooks):
+    fired = []
+    prev = set_timeout_handler(lambda task, dump: fired.append(task.op))
+    try:
+        with comm_task("test.hang", timeout=0.1):
+            time.sleep(0.3)
+    finally:
+        set_timeout_handler(None if prev is None else prev)
+    assert fired == ["test.hang"]
+    assert ladder_hooks == []  # custom handler replaced dump+abort entirely
+
+
+def test_injected_collective_delay_trips_watchdog(ladder_hooks):
+    """A FaultPlan delay on eager collective dispatch past the hard deadline
+    drives the full ladder through the REAL collective entry point."""
+    dist.init_parallel_env()
+    fired = []
+    prev = set_timeout_handler(lambda task, dump: fired.append((task.op, dump)))
+    rz.install_plan(rz.FaultPlan().add("collective.all_reduce", "delay", times=1, arg=0.5))
+    try:
+        x = paddle.to_tensor(np.ones((8, 4), np.float32))
+        dist.all_reduce(x)  # watchdog sees the injected 0.5s stall... but
+        # the default deadline is 600s, so no fire; now tighten and re-inject
+        _flags.set_flags({"FLAGS_comm_watchdog_timeout_s": 0.15})
+        rz.install_plan(rz.FaultPlan().add("collective.all_reduce", "delay", times=1, arg=0.6))
+        dist.all_reduce(x)
+    finally:
+        _flags.set_flags({"FLAGS_comm_watchdog_timeout_s": 600.0})
+        set_timeout_handler(None if prev is None else prev)
+    assert fired and fired[0][0] == "collective.all_reduce"
+    assert "collective.all_reduce" in fired[0][1]
+
+
+# ---------------------------------------------------------------------------
+# launcher backoff knobs (unit level; the subprocess path is in the slow lane)
+# ---------------------------------------------------------------------------
+
+
+def test_backoff_delay_shape():
+    import random
+
+    rng = random.Random(0)
+    for attempt in range(8):
+        d = rz.backoff_delay(attempt, 0.5, 30.0, rng)
+        assert 0.0 <= d <= min(30.0, 0.5 * 2**attempt)
+
+
+def test_controller_healthy_window_resets_budget(tmp_path):
+    from paddle_tpu.distributed.launch import CollectiveController, Context, parse_args
+
+    script = tmp_path / "noop.py"
+    script.write_text("pass\n")
+    args = parse_args([
+        "--max_restart", "3", "--restart_healthy_window", "0.01",
+        "--restart_backoff", "0", str(script),
+    ])
+    ctrl = CollectiveController(Context(args))
+    ctrl.build_pod()
+    for c in ctrl.pod.containers:
+        c.restarts = 2
+    ctrl.consecutive_restarts = 2
+    ctrl.last_restart_t = time.monotonic() - 1.0  # healthy past the window
+    ctrl._maybe_reset_restart_budget()
+    assert all(c.restarts == 0 for c in ctrl.pod.containers)
+    assert ctrl.consecutive_restarts == 0 and ctrl.last_restart_t is None
+
+
+def test_default_store_policy_reads_flags(fast_retry):
+    p = rz.default_store_policy()
+    assert p.max_attempts == 5 and p.base_s == 0.002
+    assert p.max_backoff_s == 0.01 and p.deadline_s == 5.0
+
+
+# ---------------------------------------------------------------------------
+# tier-1 smoke: inject -> observe retry counters in a schema-valid snapshot
+# ---------------------------------------------------------------------------
+
+
+def test_fault_injection_telemetry_smoke(fast_retry, store_pair, tmp_path):
+    _, client = store_pair
+    rz.install_plan(rz.FaultPlan().add("store.set", "fail", times=2))
+    client.set("smoke", b"1")
+    snap = telemetry.dump_snapshot(str(tmp_path / "m.jsonl"))
+    text = open(snap).read()
+    assert telemetry.validate_snapshot(text) > 0
+    rows = [json.loads(l) for l in text.splitlines() if l.strip()]
+    by_name = {}
+    for r in rows:
+        by_name.setdefault(r["name"], []).append(r)
+    retries = {
+        r["labels"]["site"]: r["value"]
+        for r in by_name.get("paddle_tpu_retry_retries_total", [])
+    }
+    faults = {
+        (r["labels"]["site"], r["labels"]["action"]): r["value"]
+        for r in by_name.get("paddle_tpu_faults_injected_total", [])
+    }
+    assert retries.get("store.set", 0) >= 2
+    assert faults.get(("store.set", "fail"), 0) >= 2
