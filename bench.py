@@ -38,16 +38,71 @@ work, and step time is the SLOPE between a short and a long run, which
 cancels the ~100 ms constant fetch latency. Peak is measured the same way:
 matmuls chained inside one compiled fori_loop reduced to a fetched scalar.
 
-Run: python bench.py            -> one JSON line on stdout
+Capture contract (round 6 — the un-forfeitable bench): a complete,
+parsable JSON line is printed after EVERY config (snapshot-and-extend;
+the driver reads the LAST valid line), a global deadline
+(`BENCH_DEADLINE_S`, default 3000 s) converts not-yet-run configs into
+explicit `{"skipped": "deadline"}` entries instead of losing the whole
+record to the driver's timeout, per-config failures are recorded as
+explicit skips instead of aborting the run, and after the headline the
+configs run CHEAPEST-FIRST (ocr, resnet, ernie-4096, llama) so a tight
+budget forfeits the expensive tail, never the whole record. r05 lost every
+number it measured to exactly this failure mode (`BENCH_r05.json` rc=124,
+parsed=null).
+
+Round 6 headline regime: the seq-128 config runs with
+FLAGS_fused_optimizer=1 (flat-bucket one-pass Pallas AdamW,
+ops/fused_optimizer.py) and moment2_dtype='bfloat16' (stochastic-rounding
+bf16 second moment — the measured ~2.3% win; see BASELINE.md for the
+loss-curve caveat). `detail.optimizer` names both so the capture carries
+the change. BENCH_FUSED_OPT=0 / BENCH_M2_BF16=0 restore the r5 regime.
+
+Run: python bench.py            -> JSON lines on stdout (last one wins)
 Env: BENCH_STEPS / BENCH_BATCH / BENCH_SEQ override config A;
-     BENCH_SKIP_4096=1 skips config B (quick runs).
+     BENCH_SKIP_4096=1 skips config B (quick runs);
+     BENCH_DEADLINE_S=<s> global wall budget for the whole capture.
 """
 import json
+import math
 import os
 import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+_DEADLINE = [None]  # monotonic deadline, set in main()
+
+
+def _remaining():
+    if _DEADLINE[0] is None:
+        return math.inf
+    return _DEADLINE[0] - time.monotonic()
+
+
+# minimum-plausible completion time of each config on the shared tunnel
+# (compile + steps + fetches) — used only to decide "don't even start" (a
+# config with less budget than this left is recorded skipped:deadline
+# immediately instead of burning the tail of the budget to produce
+# nothing); never used to stop a config that already started (children get
+# the remaining budget as their subprocess timeout instead)
+_EST_S = {
+    "peak": 60,
+    "seq128": 240,
+    "ocr": 90,
+    "resnet": 180,
+    "ernie4096": 240,
+    "llama": 300,
+}
+
+
+def _fused_opt_regime():
+    """(fused, m2_bf16) for the ERNIE configs — round 6 defaults both ON;
+    BENCH_FUSED_OPT=0 / BENCH_M2_BF16=0 restore the r5 per-tensor regime."""
+    off = ("0", "false", "no")
+    return (
+        os.environ.get("BENCH_FUSED_OPT", "1").lower() not in off,
+        os.environ.get("BENCH_M2_BF16", "1").lower() not in off,
+    )
 
 
 def build_train_step(batch, seq, heads, max_pos=None, attn_dropout=0.0):
@@ -68,7 +123,12 @@ def build_train_step(batch, seq, heads, max_pos=None, attn_dropout=0.0):
             max_position_embeddings=max_pos if max_pos is not None else max(512, seq),
         )
     )
-    opt = paddle.optimizer.AdamW(1e-4, parameters=model.parameters(), weight_decay=0.01)
+    fused, m2_bf16 = _fused_opt_regime()
+    paddle.set_flags({"FLAGS_fused_optimizer": fused})
+    opt = paddle.optimizer.AdamW(
+        1e-4, parameters=model.parameters(), weight_decay=0.01,
+        moment2_dtype="bfloat16" if m2_bf16 else "float32",
+    )
 
     rng = np.random.RandomState(0)
     ids = paddle.to_tensor(rng.randint(0, 40000, (batch, seq)).astype(np.int64))
@@ -150,16 +210,37 @@ def _oom_backoff(candidates, build):
             _release_device_memory()
 
 
+# The Llama OOM-fallback ladder (BASELINE configs[4]): each rung trades a
+# little fidelity for a lot of HBM, and the rung that produced the number is
+# RECORDED in the result — a degraded-but-real number with its config beats
+# a skip (r5 Missing #2: this config has never produced an e2e number).
+#   1. the full target: 2 decoder layers, seq 4096
+#   2. halve the depth (params + AdamW state are the biggest tenant)
+#   3. activation recompute on the decoder block (~1/3 more compute,
+#      O(layers) less activation memory)
+#   4. halve the sequence (attention activations go 4x down)
+#   5. batch micro-splitting: 2 rows of 2048 stepped as 2 grad-accumulated
+#      micro-batches of 1 — same tokens/step, half the live activations
+_LLAMA_RUNGS = (
+    dict(layers=2, seq=4096, recompute=False, micro=1),
+    dict(layers=1, seq=4096, recompute=False, micro=1),
+    dict(layers=1, seq=4096, recompute=True, micro=1),
+    dict(layers=1, seq=2048, recompute=True, micro=1),
+    dict(layers=1, seq=2048, recompute=True, micro=2),
+)
+
+
 def _build_llama(steps):
     """Llama-3-8B layer shape on one chip (BASELINE configs[4]): hidden
-    4096, GQA 32q/8kv at head_dim 128, SwiGLU ffn 14336, seq 4096, causal
-    flash attention with native GQA. 2 decoder layers + 32k vocab fit the
-    chip's HBM with AdamW moments (~0.7B params * 12 bytes) when the
-    shared tunnel is quiet; falls back to 1 layer when it is not."""
-    return _oom_backoff((2, 1), lambda layers: _build_llama_at(steps, layers))
+    4096, GQA 32q/8kv at head_dim 128, SwiGLU ffn 14336, causal flash
+    attention with native GQA — descending the _LLAMA_RUNGS ladder on
+    RESOURCE_EXHAUSTED until a rung fits the tunnel's HBM window."""
+    return _oom_backoff(
+        _LLAMA_RUNGS, lambda rung: _build_llama_at(steps, **rung)
+    )
 
 
-def _build_llama_at(steps, layers):
+def _build_llama_at(steps, layers, seq=4096, recompute=False, micro=1):
     import time
 
     import numpy as np
@@ -167,12 +248,12 @@ def _build_llama_at(steps, layers):
     import paddle_tpu as paddle
     from paddle_tpu.models.llama import LlamaForCausalLM
 
-    batch, seq, hidden = 1, 4096, 4096
+    batch, hidden = micro, 4096  # micro rows step as grad-accum micro-batches
     paddle.seed(0)
     model = LlamaForCausalLM(
         vocab_size=32000, hidden_size=hidden, num_hidden_layers=layers,
         num_attention_heads=32, num_key_value_heads=8,
-        intermediate_size=14336,
+        intermediate_size=14336, recompute=recompute,
     )
     opt = paddle.optimizer.AdamW(1e-4, parameters=model.parameters(), weight_decay=0.01)
     rng = np.random.RandomState(0)
@@ -181,9 +262,11 @@ def _build_llama_at(steps, layers):
 
     @paddle.jit.to_static
     def train_step(ids, labels):
-        with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
-            loss, _ = model(ids, labels=labels)
-        loss.backward()
+        loss = None
+        for i in range(micro):  # micro=1 degenerates to the plain step
+            with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+                loss, _ = model(ids[i:i + 1], labels=labels[i:i + 1])
+            (loss * (1.0 / micro)).backward()  # grads accumulate across rows
         opt.step()
         opt.clear_grad()
         return loss
@@ -200,7 +283,9 @@ def _build_llama_at(steps, layers):
     # 6 * matmul params (embedding excluded: lookup-only on input; lm_head
     # is untied and counts via its own matmul) + causal attention
     # 6 * S * hidden per layer (half the bidirectional 12: lower-triangle
-    # scores only — both kernels skip fully-masked tiles)
+    # scores only — both kernels skip fully-masked tiles). Recompute's extra
+    # forward is deliberately NOT counted: MFU stays model FLOPs / time, so
+    # a recompute rung honestly reports its efficiency loss.
     n_params = sum(p.size for p in model.parameters())
     embed = model.llama.embed_tokens.weight.size
     flops_per_token = 6 * (n_params - embed) + 6 * seq * hidden * layers
@@ -210,6 +295,10 @@ def _build_llama_at(steps, layers):
         "heads": "32q/8kv",
         "layers": layers,
         "steps": steps,
+        "rung": {
+            "layers": layers, "seq": seq, "recompute": recompute,
+            "micro_batches": micro,
+        },
         "ms_per_step": round(dt_step * 1000, 2),
         "tokens_per_sec": round(batch * seq / dt_step, 1),
         "final_loss": final_loss,
@@ -353,7 +442,9 @@ def _build_ppocr(n_images=8, n_boxes=3):
 
 def _run_config_child(kind, steps):
     """Run one bench config in a child process (HBM released at exit).
-    Returns the config's stats dict, or None on child RESOURCE_EXHAUSTED."""
+    Always returns a dict — measured stats or an explicit {"skipped": why}:
+    a child failure must never abort the capture (r5 forfeited its whole
+    record to one config's timeout)."""
     import subprocess
     import sys
 
@@ -361,22 +452,40 @@ def _run_config_child(kind, steps):
     env["BENCH_CHILD"] = kind
     env["BENCH_CHILD_STEPS"] = str(steps)
     for attempt in (1, 2):
-        r = subprocess.run(
-            [sys.executable, os.path.abspath(__file__)],
-            env=env, capture_output=True, text=True, timeout=3600,
-        )
+        budget = min(3600.0, _remaining())
+        if budget <= _EST_S.get(kind, 30):
+            return {"skipped": "deadline"}
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=env, capture_output=True, text=True, timeout=budget,
+            )
+        except subprocess.TimeoutExpired:
+            print(f"bench child {kind}: killed at the global deadline",
+                  file=sys.stderr)
+            return {"skipped": "deadline"}
         if r.returncode == 0:
-            return json.loads(r.stdout.strip().splitlines()[-1])
+            try:
+                return json.loads(r.stdout.strip().splitlines()[-1])
+            except (ValueError, IndexError):
+                # rc=0 but unparsable/empty stdout (stray atexit print, ...)
+                # — record it, never abort the capture
+                print(f"bench child {kind}: unparsable stdout", file=sys.stderr)
+                return {"skipped": "error", "error": "unparsable child stdout"}
         if "RESOURCE_EXHAUSTED" not in r.stderr:
-            raise RuntimeError(f"bench child {kind} failed:\n{r.stderr[-3000:]}")
-        if attempt == 1:
-            # the tunnel reclaims a prior child's HBM asynchronously —
-            # give it a beat and retry once before recording the skip
+            print(f"bench child {kind} failed:\n{r.stderr[-3000:]}", file=sys.stderr)
+            return {"skipped": "error", "error": r.stderr[-400:]}
+        if attempt == 1 and _remaining() > 300:
+            # the tunnel reclaims a prior child's HBM asynchronously — give
+            # it a beat and retry once, but ONLY when the budget affords the
+            # sleep + a rerun (r5 burned 2x60s retrying into its deadline)
             import time as _time
 
             print(f"bench child {kind}: RESOURCE_EXHAUSTED, retrying in 60s",
                   file=sys.stderr)
             _time.sleep(60)
+        else:
+            break
     # distinguishable from BENCH_SKIP_*: the detail records WHY
     print(f"bench child {kind}: RESOURCE_EXHAUSTED, skipped", file=sys.stderr)
     return {"skipped": "RESOURCE_EXHAUSTED"}
@@ -393,6 +502,43 @@ def _child_4096(steps):
         lambda b: _build(batch=b, seq=4096, heads=6, max_pos=4096,
                          steps=steps, attn_dropout=0.1),
     )
+
+
+class _Snapshot:
+    """The un-forfeitable capture: one result dict, re-printed as a complete
+    JSON line after every config resolves. The driver reads the LAST valid
+    line, so the record can only GROW — a timeout mid-run costs the configs
+    not yet run (which the final state marks as explicit skips), never the
+    ones already measured."""
+
+    CONFIGS = ("seq128", "seq4096", "llama3_shape", "resnet50", "ppocr_e2e")
+
+    def __init__(self):
+        self.result = {
+            "metric": "ernie3.0-base tokens/sec/chip",
+            "value": None,
+            "unit": "tokens/s",
+            "vs_baseline": None,
+            "detail": {
+                "configs": {k: "pending" for k in self.CONFIGS},
+            },
+        }
+
+    def resolve(self, key, status):
+        self.result["detail"]["configs"][key] = status
+        self.emit()
+
+    def finalize_pending(self, why="deadline"):
+        """Terminal emit: anything still pending (only possible if a config
+        path escaped its own skip handling) becomes an explicit skip."""
+        for k, st in self.result["detail"]["configs"].items():
+            if st == "pending":
+                self.result["detail"]["configs"][k] = f"skipped:{why}"
+                self.result["detail"].setdefault(k, {"skipped": why})
+        self.emit()
+
+    def emit(self):
+        print(json.dumps(self.result), flush=True)
 
 
 def main():
@@ -418,104 +564,151 @@ def main():
     steps = max(10, int(os.environ.get("BENCH_STEPS", 30)))
     batch = int(os.environ.get("BENCH_BATCH", 64))
     seq = int(os.environ.get("BENCH_SEQ", 128))
-    skip_4096 = os.environ.get("BENCH_SKIP_4096", "").lower() in ("1", "true", "yes")
+    _DEADLINE[0] = time.monotonic() + float(os.environ.get("BENCH_DEADLINE_S", 3000))
 
-    peaks = [_measured_peak_flops()]
+    def skip_env(name):
+        return os.environ.get(name, "").lower() in ("1", "true", "yes")
 
-    res_a = _build(batch, seq, heads=12, max_pos=max(512, seq), steps=steps)
-    _release_device_memory()
-    peaks.append(_measured_peak_flops())
-
-    res_b = None
-    b_skip_note = None
-    b_peak_lo = len(peaks) - 1
-    if not skip_4096:
-        res_b = _run_config_child("ernie4096", max(10, steps // 2))
-        if res_b is not None and "skipped" in res_b:
-            b_skip_note, res_b = res_b, None  # detail records WHY (not a silent drop)
-        peaks.append(_measured_peak_flops())
-
-    res_c = None
-    c_peak_lo = len(peaks) - 1
-    if not os.environ.get("BENCH_SKIP_LLAMA", "").lower() in ("1", "true", "yes"):
-        res_c = _run_config_child("llama", max(8, steps // 4))
-        peaks.append(_measured_peak_flops())
-
-    res_rn = res_ocr = None
-    if not os.environ.get("BENCH_SKIP_VISION", "").lower() in ("1", "true", "yes"):
-        res_rn = _run_config_child("resnet", max(10, steps // 2))
-        res_ocr = _run_config_child("ocr", 8)
-
-    def mfu(res, peak_pair):
-        peak = sum(peak_pair) / len(peak_pair)
-        ach = res["tokens_per_sec"] * res["flops_per_token"]
-        return ach / peak if peak else 0.0, peak
-
-    mfu_a, peak_a = mfu(res_a, peaks[0:2])
-    detail = {
-        **{k: v for k, v in res_a.items() if k != "flops_per_token"},
-        "co_measured_peak_tflops": round(peak_a / 1e12, 1),
-        "all_peaks_tflops": [round(p / 1e12, 1) for p in peaks],
-        "mfu_note": (
-            "vs_baseline = model FLOPs (matmul params + attention) / "
-            "bf16 matmul peak co-measured around each run; reference "
-            "publishes no number"
+    snap = _Snapshot()
+    detail = snap.result["detail"]
+    fused, m2_bf16 = _fused_opt_regime()
+    detail["optimizer"] = {
+        "fused_pallas": fused,
+        "moment2_dtype": "bfloat16" if m2_bf16 else "float32",
+        "note": (
+            "FLAGS_fused_optimizer=1: flat-bucket one-pass Pallas AdamW "
+            "(ops/fused_optimizer.py) replaces XLA's per-tensor update "
+            "fusions; moment2_dtype=bfloat16 halves second-moment HBM via "
+            "stochastic rounding — unbiased, but individual loss curves "
+            "diverge from the f32-moment run at matching step counts "
+            "(BASELINE.md bf16-m2 A/B); disable via BENCH_FUSED_OPT=0 / "
+            "BENCH_M2_BF16=0"
         ),
     }
-    if b_skip_note is not None:
-        detail["seq4096"] = b_skip_note
-    if res_b is not None:
-        mfu_b, peak_b = mfu(res_b, peaks[b_peak_lo : b_peak_lo + 2])
-        detail["seq4096"] = {
-            **{k: v for k, v in res_b.items() if k != "flops_per_token"},
-            "mfu": round(mfu_b, 4),
-            "co_measured_peak_tflops": round(peak_b / 1e12, 1),
-            "note": (
-                "heads 6x128 = TPU-native head shape (param count identical "
-                "to 12x64; MXU is 128 lanes); Pallas flash kernel dispatched "
-                "(gate S>=512) WITH in-kernel attention dropout 0.1 — the "
-                "real pretrain regime (r5)"
-            ),
-        }
-    if res_c is not None and "skipped" in res_c:
-        detail["llama3_shape"] = res_c
-        res_c = None
-    if res_c is not None:
-        mfu_c, peak_c = mfu(res_c, peaks[c_peak_lo : c_peak_lo + 2])
-        detail["llama3_shape"] = {
-            **{k: v for k, v in res_c.items() if k != "flops_per_token"},
-            "mfu": round(mfu_c, 4),
-            "co_measured_peak_tflops": round(peak_c / 1e12, 1),
-            "note": (
-                "Llama-3-8B layer dims (hidden 4096, GQA 32q/8kv, ffn "
-                "14336), 2 layers on one chip; causal flash with native "
-                "GQA head-group mapping (no repeated KV)"
-            ),
-        }
-    if res_rn is not None:
-        detail["resnet50"] = res_rn if "skipped" in res_rn else {
-            **res_rn,
-            "note": "BASELINE configs[0]: synthetic ImageNet, bf16 AMP, "
-                    "Momentum; images_per_sec = @to_static, *_dygraph = eager",
-        }
-    if res_ocr is not None:
+    detail["mfu_note"] = (
+        "vs_baseline = model FLOPs (matmul params + attention) / bf16 "
+        "matmul peak co-measured around each run; reference publishes "
+        "no number"
+    )
+    peaks = []
+
+    def try_peak():
+        if _remaining() >= _EST_S["peak"]:
+            peaks.append(_measured_peak_flops())
+        detail["all_peaks_tflops"] = [round(p / 1e12, 1) for p in peaks]
+
+    def mfu(res, lo):
+        """MFU against the mean of the peaks bracketing the config; degrades
+        to one peak (or None) when the deadline ate a peak measurement."""
+        pair = peaks[lo:lo + 2] or peaks[-1:]
+        if not pair or "tokens_per_sec" not in res:
+            return None, None
+        peak = sum(pair) / len(pair)
+        return res["tokens_per_sec"] * res["flops_per_token"] / peak, peak
+
+    # ---- headline: seq-128 (runs in-parent, first — it IS the record) ----
+    try_peak()
+    if _remaining() >= _EST_S["seq128"]:
+        try:
+            res_a = _build(batch, seq, heads=12, max_pos=max(512, seq), steps=steps)
+            _release_device_memory()
+            try_peak()
+            mfu_a, peak_a = mfu(res_a, 0)
+            detail.update(
+                {k: v for k, v in res_a.items() if k != "flops_per_token"}
+            )
+            if peak_a:
+                detail["co_measured_peak_tflops"] = round(peak_a / 1e12, 1)
+            snap.result["value"] = res_a["tokens_per_sec"]
+            snap.result["vs_baseline"] = round(mfu_a, 4) if mfu_a else None
+            snap.resolve("seq128", "measured")
+        except Exception as e:  # noqa: BLE001 — the capture must survive
+            print(f"bench seq128 failed: {e}", file=sys.stderr)
+            detail["seq128"] = {"skipped": "error", "error": str(e)[-400:]}
+            snap.resolve("seq128", "skipped:error")
+    else:
+        detail["seq128"] = {"skipped": "deadline"}
+        snap.resolve("seq128", "skipped:deadline")
+
+    # ---- satellites, CHEAPEST-FIRST: a tight budget forfeits the
+    # expensive tail, never the whole record ----
+    if skip_env("BENCH_SKIP_VISION"):
+        snap.resolve("ppocr_e2e", "skipped:env")
+        snap.resolve("resnet50", "skipped:env")
+    else:
+        res_ocr = _run_config_child("ocr", 8)
         detail["ppocr_e2e"] = res_ocr if "skipped" in res_ocr else {
             **res_ocr,
             "note": "BASELINE configs[2]: DBNet det + CRNN rec end-to-end "
                     "(device inference + host box crop/CTC decode)",
         }
-
-    print(
-        json.dumps(
-            {
-                "metric": "ernie3.0-base tokens/sec/chip",
-                "value": res_a["tokens_per_sec"],
-                "unit": "tokens/s",
-                "vs_baseline": round(mfu_a, 4),
-                "detail": detail,
-            }
+        snap.resolve(
+            "ppocr_e2e",
+            "measured" if "skipped" not in res_ocr
+            else f"skipped:{res_ocr['skipped']}",
         )
-    )
+
+        res_rn = _run_config_child("resnet", max(10, steps // 2))
+        detail["resnet50"] = res_rn if "skipped" in res_rn else {
+            **res_rn,
+            "note": "BASELINE configs[0]: synthetic ImageNet, bf16 AMP, "
+                    "Momentum; images_per_sec = @to_static, *_dygraph = eager",
+        }
+        snap.resolve(
+            "resnet50",
+            "measured" if "skipped" not in res_rn
+            else f"skipped:{res_rn['skipped']}",
+        )
+
+    if skip_env("BENCH_SKIP_4096"):
+        snap.resolve("seq4096", "skipped:env")
+    else:
+        b_lo = max(0, len(peaks) - 1)
+        res_b = _run_config_child("ernie4096", max(10, steps // 2))
+        if "skipped" in res_b:
+            detail["seq4096"] = res_b
+            snap.resolve("seq4096", f"skipped:{res_b['skipped']}")
+        else:
+            try_peak()
+            mfu_b, peak_b = mfu(res_b, b_lo)
+            detail["seq4096"] = {
+                **{k: v for k, v in res_b.items() if k != "flops_per_token"},
+                "mfu": round(mfu_b, 4) if mfu_b else None,
+                "co_measured_peak_tflops": round(peak_b / 1e12, 1) if peak_b else None,
+                "note": (
+                    "heads 6x128 = TPU-native head shape (param count identical "
+                    "to 12x64; MXU is 128 lanes); Pallas flash kernel dispatched "
+                    "(gate S>=512) WITH in-kernel attention dropout 0.1 — the "
+                    "real pretrain regime (r5)"
+                ),
+            }
+            snap.resolve("seq4096", "measured")
+
+    if skip_env("BENCH_SKIP_LLAMA"):
+        snap.resolve("llama3_shape", "skipped:env")
+    else:
+        c_lo = max(0, len(peaks) - 1)
+        res_c = _run_config_child("llama", max(8, steps // 4))
+        if "skipped" in res_c:
+            detail["llama3_shape"] = res_c
+            snap.resolve("llama3_shape", f"skipped:{res_c['skipped']}")
+        else:
+            try_peak()
+            mfu_c, peak_c = mfu(res_c, c_lo)
+            detail["llama3_shape"] = {
+                **{k: v for k, v in res_c.items() if k != "flops_per_token"},
+                "mfu": round(mfu_c, 4) if mfu_c else None,
+                "co_measured_peak_tflops": round(peak_c / 1e12, 1) if peak_c else None,
+                "note": (
+                    "Llama-3-8B layer dims (hidden 4096, GQA 32q/8kv, ffn "
+                    "14336) on one chip; causal flash with native GQA "
+                    "head-group mapping (no repeated KV); `rung` records "
+                    "which OOM-ladder config produced the number"
+                ),
+            }
+            snap.resolve("llama3_shape", "measured")
+
+    snap.finalize_pending()
 
 
 def _measured_peak_flops(n=16384, iters=10):

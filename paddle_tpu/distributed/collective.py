@@ -32,6 +32,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core.tensor import Tensor
 from . import parallel_env
+from ..framework.jax_compat import shard_map as _shard_map
 
 
 class ReduceOp:
@@ -461,7 +462,7 @@ def _k_permute(mesh: Mesh, perm: tuple):
         def local(s):
             return jax.lax.ppermute(s, "g", list(perm))
 
-        return jax.shard_map(local, mesh=mesh, in_specs=P("g"), out_specs=P("g"))(x)
+        return _shard_map(local, mesh=mesh, in_specs=P("g"), out_specs=P("g"))(x)
 
     return jax.jit(f, out_shardings=sh)
 

@@ -32,6 +32,7 @@ from typing import Callable
 import jax
 from jax import numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from ....framework.jax_compat import shard_map as _shard_map
 
 
 def _tree_where(pred, a, b):
@@ -145,7 +146,7 @@ def pipeline_spmd(stage_fn: Callable, mesh: Mesh, axis: str = "pp", checkpoint_s
     # per-device output leaves are [1, T, B, ...]
     out_spec = P(axis, None, data_axis) if data_axis else P(axis)
 
-    sharded = jax.shard_map(
+    sharded = _shard_map(
         per_device,
         mesh=mesh,
         in_specs=(param_in_spec, mb_in_spec),
@@ -230,7 +231,7 @@ def pipeline_spmd_interleave(
         _, ys = jax.lax.scan(step, init, jnp.arange(T))
         return jax.tree_util.tree_map(lambda l: l[None], ys)
 
-    sharded = jax.shard_map(
+    sharded = _shard_map(
         per_device,
         mesh=mesh,
         in_specs=(P(axis), P()),
@@ -325,7 +326,7 @@ def pipeline_spmd_hetero(stage_fns, mesh: Mesh, axis: str = "pp",
         _, ys = jax.lax.scan(step, init, jnp.arange(M + S - 1))
         return jax.tree_util.tree_map(lambda l: l[None], ys)
 
-    sharded = jax.shard_map(
+    sharded = _shard_map(
         per_device, mesh=mesh, in_specs=(P(axis), P()), out_specs=P(axis),
         check_vma=False,
     )
@@ -446,7 +447,7 @@ def pipeline_spmd_hetero_interleave(stage_fns, mesh: Mesh, num_virtual_stages,
         _, ys = jax.lax.scan(step, init, jnp.arange(T))
         return jax.tree_util.tree_map(lambda l: l[None], ys)
 
-    sharded = jax.shard_map(
+    sharded = _shard_map(
         per_device, mesh=mesh, in_specs=(P(axis), P()), out_specs=P(axis),
         check_vma=False,
     )

@@ -83,6 +83,15 @@ define_flag(
     "(donated arrays raise 'deleted' on read)",
 )
 define_flag("FLAGS_use_bf16_default", False, "prefer bfloat16 in AMP on TPU")
+define_flag(
+    "FLAGS_fused_optimizer",
+    False,
+    "route Adam/AdamW updates through the flat-bucket one-pass Pallas "
+    "optimizer engine (ops/fused_optimizer.py): params/moments/grads are "
+    "flattened into contiguous same-dtype buckets and each bucket updates "
+    "in ONE kernel streaming tiles through VMEM once — replacing XLA's "
+    "per-tensor fusion scatter (~9 ms of the 53 ms seq-128 step)",
+)
 define_flag("FLAGS_jit_guard_shapes", True, "retrace to_static programs on input shape change")
 define_flag("FLAGS_eager_delete_tensor_gb", 0.0, "no-op on TPU; XLA owns HBM")
 define_flag("FLAGS_log_level", 0, "framework verbosity")

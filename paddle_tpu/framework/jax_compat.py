@@ -1,0 +1,40 @@
+"""Version-compat aliases for jax APIs that moved between releases.
+
+The framework is written against current jax (the TPU driver image), but
+CI-style CPU environments may carry an older release where several APIs
+live under their pre-promotion names:
+
+  - ``jax.shard_map``            <- ``jax.experimental.shard_map.shard_map``
+  - ``jax.enable_x64``           <- ``jax.experimental.enable_x64``
+  - ``pltpu.CompilerParams``     <- ``pltpu.TPUCompilerParams``
+
+Import the name from here instead of guessing the spelling at each call
+site; each alias resolves to the new name when present and falls back to
+the old one. (Before round 6 these spellings collection-errored the whole
+flash/ring/pipeline test files on older-jax environments.)
+"""
+from __future__ import annotations
+
+import jax
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:  # jax < 0.5: experimental namespace, and check_vma was check_rep
+    from jax.experimental.shard_map import shard_map as _experimental_shard_map
+
+    def shard_map(f, *args, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _experimental_shard_map(f, *args, **kwargs)
+
+if hasattr(jax, "enable_x64"):
+    enable_x64 = jax.enable_x64
+else:
+    from jax.experimental import enable_x64  # noqa: F401
+
+
+def tpu_compiler_params():
+    """The Pallas TPU CompilerParams class under either name."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    return getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams

@@ -111,7 +111,9 @@ def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6, begin_norm_axis
         rows = x2.shape[0]
         use_pallas = _on_tpu() and d % 128 == 0 and rows % 8 == 0
         if use_pallas:
-            with jax.enable_x64(False):  # Mosaic rejects i64 index types
+            from ...ops.pallas import enable_x64  # version-compat alias
+
+            with enable_x64(False):  # Mosaic rejects i64 index types
                 bz = bv if bv is not None else jnp.zeros_like(wv)
                 out = _rms_norm_pallas_2d(x2, wv, bz, float(epsilon), bv is not None)
         else:

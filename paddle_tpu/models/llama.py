@@ -97,6 +97,7 @@ class LlamaModel(nn.Layer):
         num_key_value_heads=None,
         intermediate_size=1376,
         rms_norm_eps=1e-6,
+        recompute=False,
     ):
         super().__init__()
         self.embed_tokens = nn.Embedding(vocab_size, hidden_size)
@@ -107,11 +108,20 @@ class LlamaModel(nn.Layer):
             ]
         )
         self.norm = nn.RMSNorm(hidden_size, rms_norm_eps)
+        # activation recompute on the decoder blocks: trade ~1/3 more compute
+        # for O(layers) less activation memory — the bench's OOM-fallback
+        # ladder flips this on before shrinking the workload further
+        self.recompute = recompute
 
     def forward(self, input_ids):
+        from ..distributed.fleet.recompute import recompute as _ckpt
+
         x = self.embed_tokens(input_ids)
         for layer in self.layers:
-            x = layer(x)
+            if self.recompute and self.training:
+                x = _ckpt(layer, x)
+            else:
+                x = layer(x)
         return self.norm(x)
 
 

@@ -125,16 +125,24 @@ _INTERPRET = False
 # much larger); raise the per-kernel budget so the tuned tiles compile.
 _VMEM_LIMIT = 40 * 1024 * 1024
 
+# jax renamed TPUCompilerParams -> CompilerParams and promoted
+# experimental.enable_x64 to jax.enable_x64 (~0.5); resolve both through the
+# central compat module so the kernels import (and run in interpret mode) on
+# older CPU-only environments
+from ..framework.jax_compat import enable_x64, tpu_compiler_params  # noqa: E402
+
+CompilerParams = tpu_compiler_params()
+
 # every grid axis is an independent (bh, block) tile — declaring them
 # parallel lets Mosaic pipeline HBM->VMEM copies across grid steps
-_COMPILER_PARAMS = pltpu.CompilerParams(
+_COMPILER_PARAMS = CompilerParams(
     dimension_semantics=("parallel", "parallel"),
     vmem_limit_bytes=_VMEM_LIMIT,
 )
 # dkdv grid is (b*h_kv, n_k, group): the group axis REVISITS the same
 # dk/dv block on consecutive steps (in-VMEM accumulation), so it must be
 # sequential ("arbitrary"), not parallel
-_COMPILER_PARAMS_3D = pltpu.CompilerParams(
+_COMPILER_PARAMS_3D = CompilerParams(
     dimension_semantics=("parallel", "parallel", "arbitrary"),
     vmem_limit_bytes=_VMEM_LIMIT,
 )
@@ -462,7 +470,10 @@ def _bwd_dq_kernel(sq, sk, d, causal, scale, bq, bk, dropout_p):
             dp = _dot_nt(dob, vb)  # = d(dropped P) for the dropout case
             if use_drop:
                 keep = _tile_keep(seed, bh, qi * bq, ki * bk, bq, bk, thresh)
-                dp = jnp.where(keep, dp, 0.0) * inv_keep
+                # z-form: keep-select and the 1/(1-p) upscale collapse into
+                # one mask product (same shape as the dkdv kernel's z)
+                z = jnp.where(keep, inv_keep, 0.0)
+                dp = dp * z
             ds = p * (dp - delta) * scale
             return dq + _dot_nn(ds.astype(kb.dtype), kb)
 
@@ -513,6 +524,9 @@ def _bwd_dkdv_kernel(sq, sk, d, causal, scale, bq, bk, dropout_p, h, hkv):
                 s = _mask_boundary(s, off, qi, ki, bq, bk)
             p = jnp.exp(s - lse)
             if use_drop:
+                # the dropout mask product materializes ONCE per tile: z is
+                # computed here and reused for BOTH the dv operand (p * z)
+                # and the dp rescale below — not re-derived per product
                 keep = _tile_keep(seed, bh_q, qi * bq, ki * bk, bq, bk, thresh)
                 z = jnp.where(keep, inv_keep, 0.0)
                 pd = p * z
@@ -670,7 +684,7 @@ def _core_fwd(q, k, v, seed, causal, sm_scale, dropout_p):
 def _core_bwd(causal, sm_scale, dropout_p, res, g):
     q, k, v, seed, out, lse = res
     g_out, g_lse = g
-    with jax.enable_x64(False):
+    with enable_x64(False):
         dq, dk, dv = _flash_bwd_impl(
             q, k, v, out, lse, g_out, g_lse, seed, causal, sm_scale, dropout_p
         )
@@ -727,7 +741,7 @@ def _flash_fwd_x32_wrap(q, k, v, seed, causal, sm_scale, dropout_p):
     # Mosaic rejects i64 grid/index types, and the framework enables x64
     # globally (paddle dtype semantics) — trace the kernel with x64 off.
     # All kernel dtypes are explicit so numerics are unchanged.
-    with jax.enable_x64(False):
+    with enable_x64(False):
         return _flash_fwd_jit(q, k, v, seed, causal, sm_scale, dropout_p)
 
 

@@ -98,6 +98,7 @@ def _ring_flash_local(q, k, v, *, axis_name, causal, sm_scale):
 
 
 from .pallas import repeat_kv as _repeat_kv  # shared GQA fallback helper
+from ..framework.jax_compat import shard_map as _shard_map
 
 
 def ring_attention_local(
@@ -202,7 +203,7 @@ def ring_attention(q, k, v, *, mesh, axis_name: str = "sep", causal: bool = Fals
     from jax.sharding import PartitionSpec as P
 
     spec = P(None, axis_name, None, None)
-    fn = jax.shard_map(
+    fn = _shard_map(
         functools.partial(
             ring_attention_local, axis_name=axis_name, causal=causal, sm_scale=sm_scale
         ),

@@ -1,0 +1,316 @@
+"""Flat-bucket engine for the one-pass Pallas optimizer (FLAGS_fused_optimizer).
+
+Reference parity: the role of fleet's tensor_fusion_helper + the GPU
+multi_tensor_adam path — parameters (with their grads, moment1, moment2)
+are flattened into a small number of contiguous same-(dtype, weight-decay,
+lr-scale) buckets at first step(), and each bucket updates through ONE
+Pallas kernel (`ops.fused_optimizer.fused_adamw_apply`) that streams the
+param/m/v/grad tiles through VMEM exactly once.
+
+Differences from the stacked-group fusion in `Adam._apply_fused` (which
+stays the default — this engine is opt-in via FLAGS_fused_optimizer):
+
+  - buckets span *heterogeneous shapes*: a param→(bucket, offset, shape)
+    index map reconstitutes per-param views for state_dict round-trips;
+  - moment1/moment2 live PERSISTENTLY flat — only the param/grad gather and
+    param scatter touch per-tensor layout, and those are single
+    concat/slice ops XLA schedules around the kernel;
+  - the global-norm clip enters the kernel as one scalar operand instead of
+    scaling every gradient tensor first;
+  - beta-pow bias corrections are per-bucket scalars;
+  - moment2 may be stored bfloat16 (optimizer moment2_dtype='bfloat16')
+    with the flat-index stochastic rounding from ops/fused_optimizer.
+
+State contract: `state_dict()` output is identical in keys and shapes to
+the per-tensor path (`moment1_i` / `moment2_i` / `beta1_pow_i` / ...), so
+checkpoints move freely between fused and unfused runs, matching the
+stacked buckets' fusion-agnostic format.
+"""
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..ops.fused_optimizer import fused_adamw_apply, pad_to_tile
+
+
+def _bucket_array(t, what="bucket state"):
+    """Read a flat-bucket Tensor's array, raising a clean error when the
+    buffer was donated to a compiled step and consumed (FLAGS_to_static_donate
+    adopts the output buffer; the old array is deleted on device)."""
+    import jax
+
+    v = t._value
+    # tracers (under to_static capture) have no liveness to check
+    deleted = None if isinstance(v, jax.core.Tracer) else getattr(v, "is_deleted", None)
+    if deleted is not None and deleted():
+        raise RuntimeError(
+            f"fused-optimizer {what} was donated to a to_static compiled step "
+            "and its buffer is gone; read optimizer state before the step or "
+            "set FLAGS_to_static_donate=False to keep copying semantics"
+        )
+    return v
+
+
+class FlatAdamWEngine:
+    """Per-optimizer flat-bucket store + step executor for Adam/AdamW."""
+
+    def __init__(self, opt):
+        self.opt = opt
+        # key -> bucket dict; key = (dtype, wd_value, lr_scale, need_clip)
+        self.buckets: dict = {}
+
+    # ---- partitioning ----
+    def _partition(self, entries):
+        """Split (p, g, wd, lr_scale) entries into flat-fusable buckets and a
+        per-param remainder (same gates as Adam._fuse_partition, widened to
+        bfloat16 params — the kernel computes in f32 and stores back bf16,
+        matching the per-tensor cast chain)."""
+        from ..regularizer import L1Decay
+        from .optimizer import _wd_value
+
+        buckets = defaultdict(list)
+        rest = []
+        for p, g, wd, s in entries:
+            fusable = (
+                not isinstance(wd, L1Decay)
+                and p._value.dtype in (jnp.float32, jnp.bfloat16)
+                and getattr(p, "_dist_attr", None) is None
+                and tuple(g.value.shape) == tuple(p._value.shape)
+            )
+            if fusable:
+                key = (p._value.dtype, _wd_value(wd), float(s),
+                       bool(getattr(p, "need_clip", True)))
+                buckets[key].append((p, g))
+            else:
+                rest.append((p, g, wd, s))
+        return buckets, rest
+
+    # ---- bucket lifecycle ----
+    def _build_bucket(self, key, plist):
+        from .. import telemetry as _tm
+
+        t0 = time.perf_counter()
+        opt = self.opt
+        ids = tuple(id(p) for p, _ in plist)
+        new_ids = set(ids)
+        # composition changed (params frozen/unfrozen, groups edited):
+        # dissolve every overlapping bucket — flat AND stacked (migration
+        # from the default path when the flag flips mid-training) — so its
+        # state lands in _pending_state and is inherited below, not zeroed
+        for k2, b2 in list(self.buckets.items()):
+            if new_ids.intersection(b2["ids"]):
+                self._defuse_bucket(b2)
+                del self.buckets[k2]
+        for old_ids, old_st in list(opt._fused_buckets.items()):
+            if new_ids.intersection(old_ids):
+                opt._defuse_bucket(old_st)
+                del opt._fused_buckets[old_ids]
+
+        index, off = {}, 0
+        for p, _ in plist:
+            size = int(p._value.size)
+            index[id(p)] = (off, size, tuple(p._value.shape))
+            off += size
+        n, n_pad = off, pad_to_tile(off)
+        m2_dtype = opt._m2_dtype
+
+        def gather(name, dtype):
+            parts = []
+            for p, _ in plist:
+                prev = opt._pop_param_state(name, id(p))
+                if prev is not None:
+                    parts.append(jnp.asarray(prev).astype(dtype).ravel())
+                else:
+                    parts.append(jnp.zeros((int(p._value.size),), dtype))
+            if n_pad > n:
+                parts.append(jnp.zeros((n_pad - n,), dtype))
+            return jnp.concatenate(parts)
+
+        def gather_scalar(name, fill):
+            first = None
+            for p, _ in plist:
+                prev = opt._pop_param_state(name, id(p))
+                if prev is not None and first is None:
+                    first = jnp.asarray(prev, jnp.float32).reshape(())
+            return first if first is not None else jnp.asarray(fill, jnp.float32)
+
+        bucket = {
+            "ids": ids,
+            "index": index,
+            "n": n,
+            "n_pad": n_pad,
+            "moment1": Tensor(gather("moment1", jnp.float32)),
+            "moment2": Tensor(gather("moment2", m2_dtype)),
+            "beta1_pow": Tensor(gather_scalar("beta1_pow", 1.0)),
+            "beta2_pow": Tensor(gather_scalar("beta2_pow", 1.0)),
+        }
+        self.buckets[key] = bucket
+        if _tm.enabled():
+            _tm.counter(
+                "paddle_tpu_fused_optimizer_bucket_builds_total",
+                "flat optimizer buckets (re)built",
+            ).inc()
+            _tm.histogram(
+                "paddle_tpu_fused_optimizer_bucket_build_seconds",
+                "wall time to flatten one bucket's params/state",
+            ).observe(time.perf_counter() - t0)
+            _tm.gauge(
+                "paddle_tpu_fused_optimizer_bucket_bytes", "flat bucket bytes",
+            ).set(sum(
+                int(b["moment1"]._value.nbytes + b["moment2"]._value.nbytes)
+                for b in self.buckets.values()
+            ))
+        return bucket
+
+    def _bucket_for(self, key, plist):
+        ids = tuple(id(p) for p, _ in plist)
+        b = self.buckets.get(key)
+        if b is None or b["ids"] != ids:
+            b = self._build_bucket(key, plist)
+        return b
+
+    # ---- the step ----
+    def step(self, groups):
+        """groups = [(clip, entries)] with UNCLIPPED grads; entries =
+        (p, g, wd, lr_scale)."""
+        from .. import telemetry as _tm
+        from ..nn.clip import ClipGradByGlobalNorm
+
+        opt = self.opt
+        launches_saved = 0
+        for clip, entries in groups:
+            entries = [(p, g, opt._effective_wd(p, wd), s) for p, g, wd, s in entries]
+            scale = None
+            if isinstance(clip, ClipGradByGlobalNorm):
+                # the norm reduction runs here (one fused XLA reduction over
+                # raw grads); the SCALING rides the kernel as a scalar operand
+                gs = [g.value for p, g, _, _ in entries if getattr(p, "need_clip", True)]
+                if gs:
+                    gn = jnp.sqrt(sum(
+                        jnp.sum(jnp.square(gv.astype(jnp.float32))) for gv in gs
+                    ))
+                    scale = clip.clip_norm / jnp.maximum(gn, clip.clip_norm)
+            elif clip is not None:
+                # per-tensor clips (ByNorm/ByValue) have no scalar form:
+                # pre-apply them, then fuse the clipped grads
+                pgs = clip([(p, g) for p, g, _, _ in entries])
+                entries = [
+                    (p, g2, wd, s)
+                    for (p, _, wd, s), (_, g2) in zip(entries, pgs)
+                ]
+            buckets, rest = self._partition(entries)
+            for key, plist in buckets.items():
+                self._apply_bucket(key, plist, scale)
+                launches_saved += max(0, len(plist) - 1)
+            for p, g, wd, s in rest:
+                if scale is not None and getattr(p, "need_clip", True):
+                    g = Tensor(g.value * scale.astype(g.value.dtype))
+                opt._apply_one(p, g, wd, s)
+        if _tm.enabled():
+            _tm.counter(
+                "paddle_tpu_fused_optimizer_steps_total",
+                "optimizer steps taken through the flat-bucket engine",
+                ("optimizer",),
+            ).labels(optimizer=type(opt).__name__).inc()
+            _tm.counter(
+                "paddle_tpu_fused_optimizer_launches_saved_total",
+                "per-tensor update launches replaced by bucket kernels",
+            ).inc(launches_saved)
+            _tm.gauge(
+                "paddle_tpu_fused_optimizer_buckets", "live flat buckets",
+            ).set(len(self.buckets))
+
+    def _apply_bucket(self, key, plist, clip_scale):
+        opt = self.opt
+        dtype, wdv, lr_scale, need_clip = key
+        b = self._bucket_for(key, plist)
+        n, n_pad = b["n"], b["n_pad"]
+
+        g_parts = [g.value.ravel().astype(jnp.float32) for _, g in plist]
+        p_parts = [p._value.ravel() for p, _ in plist]
+        if n_pad > n:
+            g_parts.append(jnp.zeros((n_pad - n,), jnp.float32))
+            p_parts.append(jnp.zeros((n_pad - n,), dtype))
+        G = jnp.concatenate(g_parts) if len(g_parts) > 1 else g_parts[0]
+        P = jnp.concatenate(p_parts) if len(p_parts) > 1 else p_parts[0]
+
+        b1p, b2p = b["beta1_pow"], b["beta2_pow"]
+        b1p_new = b1p.value * opt._beta1
+        b2p_new = b2p.value * opt._beta2
+        seed = opt._m2_key() if opt._m2_dtype == jnp.bfloat16 else 0
+
+        P2, M2, V2 = fused_adamw_apply(
+            P,
+            _bucket_array(b["moment1"], "moment1 bucket"),
+            _bucket_array(b["moment2"], "moment2 bucket"),
+            G,
+            lr=opt._lr_value(lr_scale),
+            clip_scale=clip_scale if (clip_scale is not None and need_clip) else 1.0,
+            c1=1.0 - b1p_new,
+            c2=1.0 - b2p_new,
+            seed=seed,
+            beta1=opt._beta1,
+            beta2=opt._beta2,
+            eps=opt._eps,
+            wd=wdv,
+            decoupled=opt._wd_mode == "decoupled",
+        )
+        for p, _ in plist:
+            off, size, shape = b["index"][id(p)]
+            p._replace_value(P2[off:off + size].reshape(shape))
+            p.stop_gradient = False
+        b["moment1"]._replace_value(M2)
+        b["moment2"]._replace_value(V2)
+        b1p._replace_value(b1p_new)
+        b2p._replace_value(b2p_new)
+
+    # ---- state plumbing (mirrors the stacked buckets' contracts) ----
+    def materialize(self, groups):
+        """Force buckets into existence for the current composition without
+        updating anything (snapshot/restore consumers — GradScaler)."""
+        for clip, entries in groups:
+            entries = [
+                (p, g, self.opt._effective_wd(p, wd), s) for p, g, wd, s in entries
+            ]
+            buckets, _ = self._partition(entries)
+            for key, plist in buckets.items():
+                self._bucket_for(key, plist)
+
+    def _defuse_bucket(self, b):
+        m = _bucket_array(b["moment1"], "moment1 bucket")
+        v = _bucket_array(b["moment2"], "moment2 bucket")
+        for pid, (off, size, shape) in b["index"].items():
+            self.opt._pending_state[("moment1", pid)] = m[off:off + size].reshape(shape)
+            self.opt._pending_state[("moment2", pid)] = v[off:off + size].reshape(shape)
+            self.opt._pending_state[("beta1_pow", pid)] = b["beta1_pow"]._value
+            self.opt._pending_state[("beta2_pow", pid)] = b["beta2_pow"]._value
+
+    def defuse_all(self):
+        for b in list(self.buckets.values()):
+            self._defuse_bucket(b)
+        self.buckets.clear()
+
+    def view_into(self, view):
+        """Expose bucket state as per-param slices (state_dict format is
+        fusion-agnostic, same as the stacked buckets)."""
+        for b in self.buckets.values():
+            m = _bucket_array(b["moment1"], "moment1 bucket")
+            v = _bucket_array(b["moment2"], "moment2 bucket")
+            for pid, (off, size, shape) in b["index"].items():
+                view.setdefault("moment1", {})[pid] = Tensor(m[off:off + size].reshape(shape))
+                view.setdefault("moment2", {})[pid] = Tensor(v[off:off + size].reshape(shape))
+                view.setdefault("beta1_pow", {})[pid] = b["beta1_pow"]
+                view.setdefault("beta2_pow", {})[pid] = b["beta2_pow"]
+
+    def state_entries(self):
+        out = []
+        for b in self.buckets.values():
+            out.append((b["moment1"], 0.0))
+            out.append((b["moment2"], 0.0))
+            out.append((b["beta1_pow"], 1.0))
+            out.append((b["beta2_pow"], 1.0))
+        return out

@@ -39,6 +39,9 @@ class Optimizer:
         self._step_count = Tensor(jnp.zeros((), jnp.int64))
         # fused flat accumulators: ids-tuple -> bucket dict (see _apply_fused)
         self._fused_buckets: dict = {}
+        # FLAGS_fused_optimizer flat-bucket engine (fused_engine.py), created
+        # lazily by optimizers that support it (Adam/AdamW)
+        self._flat_engine = None
         # wrappers that need per-param accumulators (shard_optimizer, ZeRO
         # sharding) flip this off to force the per-param path
         self._fuse_allowed = True
@@ -132,18 +135,16 @@ class Optimizer:
         for entries in self._collect_entries():
             self._apply_entries(entries)
 
-    def _collect_entries(self, apply_clip=True):
-        """Per param-group: [(param, grad, weight_decay, lr_scale)] with
-        grad clip applied (unless apply_clip=False — bucket-composition-only
-        consumers like _materialize_state skip the clip graph) and per-param
-        overrides resolved."""
+    def _collect_groups(self):
+        """Per param-group: (clip, [(param, grad, weight_decay, lr_scale)])
+        with UNCLIPPED grads and per-param overrides resolved — the flat
+        engine needs the raw grads plus the clip object (global-norm clip
+        becomes one scalar kernel operand there)."""
         out = []
         for group, params_grads in self._grouped_params_grads():
             if not params_grads:
                 continue
             clip = group.get("grad_clip", self._grad_clip)
-            if clip is not None and apply_clip:
-                params_grads = clip(params_grads)
             wd = group.get("weight_decay", self._weight_decay)
             lr_scale = group.get("learning_rate", 1.0)
             entries = []
@@ -155,7 +156,22 @@ class Optimizer:
                 p_wd = getattr(p, "regularizer", None)
                 entries.append((p, g, p_wd if p_wd is not None else wd, p_scale))
             if entries:
-                out.append(entries)
+                out.append((clip, entries))
+        return out
+
+    def _collect_entries(self, apply_clip=True):
+        """Per param-group: [(param, grad, weight_decay, lr_scale)] with
+        grad clip applied (unless apply_clip=False — bucket-composition-only
+        consumers like _materialize_state skip the clip graph)."""
+        out = []
+        for clip, entries in self._collect_groups():
+            if clip is not None and apply_clip:
+                pgs = clip([(p, g) for p, g, _, _ in entries])
+                entries = [
+                    (p, g2, wd, s)
+                    for (p, _, wd, s), (_, g2) in zip(entries, pgs)
+                ]
+            out.append(entries)
         return out
 
     def _materialize_state(self):
@@ -240,6 +256,8 @@ class Optimizer:
         for st in list(self._fused_buckets.values()):
             self._defuse_bucket(st)
         self._fused_buckets.clear()
+        if self._flat_engine is not None:
+            self._flat_engine.defuse_all()
 
     def disable_fusion(self):
         """Switch to per-param updates, preserving any state already living
@@ -259,6 +277,8 @@ class Optimizer:
                         view.setdefault(nm, {})[pid] = Tensor(stacked._value[i])
                     for nm, sc in st["scalars"].items():
                         view.setdefault(nm, {})[pid] = sc
+        if self._flat_engine is not None:
+            self._flat_engine.view_into(view)
         # loaded-but-not-yet-applied entries (set_state_dict before a step)
         for (nm, pid), v in self._pending_state.items():
             view.setdefault(nm, {}).setdefault(pid, Tensor(jnp.asarray(v)))
@@ -284,6 +304,8 @@ class Optimizer:
                     out.append((t, 0.0))
             for nm, t in st["scalars"].items():
                 out.append((t, 1.0 if nm.endswith("_pow") else 0.0))
+        if self._flat_engine is not None:
+            out.extend(self._flat_engine.state_entries())
         return out
 
     # ---- state dict ----
@@ -434,6 +456,34 @@ class Adam(Optimizer):
     def _effective_wd(self, p, wd):
         return wd
 
+    def _use_flat_fusion(self):
+        """FLAGS_fused_optimizer routes updates through the flat-bucket
+        one-pass Pallas engine (fused_engine.FlatAdamWEngine). Checked per
+        step so set_flags() toggles take effect live; wrappers that
+        disable_fusion() (ZeRO, shard_optimizer) win over the flag."""
+        from ..framework import flags as _flags
+
+        return self._fuse_allowed and bool(_flags.get_flag("FLAGS_fused_optimizer"))
+
+    def _flat_engine_or_create(self):
+        if self._flat_engine is None:
+            from .fused_engine import FlatAdamWEngine
+
+            self._flat_engine = FlatAdamWEngine(self)
+        return self._flat_engine
+
+    def _step_impl(self):
+        if self._use_flat_fusion():
+            self._sync_lr()
+            self._step_count._replace_value(self._step_count._value + 1)
+            self._flat_engine_or_create().step(self._collect_groups())
+            return
+        if self._flat_engine is not None and self._flat_engine.buckets:
+            # flag flipped off mid-training: migrate flat state to per-param
+            # pending entries instead of silently resetting moments
+            self._flat_engine.defuse_all()
+        super()._step_impl()
+
     def _apply_entries(self, entries):
         """Bucket homogeneous params and update each bucket with ONE fused
         elementwise kernel over a flat buffer (reference's multi_tensor_adam,
@@ -477,6 +527,9 @@ class Adam(Optimizer):
         return buckets, rest
 
     def _materialize_state(self):
+        if self._use_flat_fusion():
+            self._flat_engine_or_create().materialize(self._collect_groups())
+            return
         for entries in self._collect_entries(apply_clip=False):
             buckets, _ = self._fuse_partition(entries)
             for plist in buckets.values():

@@ -41,6 +41,50 @@ class _OptUpdate:
         self.wd = wd
 
 
+class _FusedAdamWUpdate:
+    """Grouped one-pass update (FLAGS_fused_optimizer): every parameter of
+    one minimize() call with the same storage dtype updates through a single
+    `ops.fused_optimizer.fused_adamw_apply` over a flat bucket inside the
+    compiled replay — the moments live persistently flat in `accum_tensors`
+    ([m_flat, v_flat, t]) and the param gather/scatter is a concat/slice
+    pair XLA schedules around the kernel."""
+
+    __slots__ = ("param_vars", "grad_vars", "index", "n_pad", "accum_tensors",
+                 "lr", "clip", "beta1", "beta2", "eps", "wd", "decoupled")
+
+    def __init__(self, param_vars, grad_vars, index, n_pad, accum_tensors, lr,
+                 clip, beta1, beta2, eps, wd, decoupled):
+        self.param_vars = list(param_vars)
+        self.grad_vars = list(grad_vars)
+        self.index = index  # param_var -> (offset, size, shape)
+        self.n_pad = n_pad
+        self.accum_tensors = accum_tensors
+        self.lr = lr
+        self.clip = clip
+        self.beta1, self.beta2, self.eps = beta1, beta2, eps
+        # decay (coupled for Adam, decoupled for AdamW) runs IN-KERNEL; the
+        # replay's per-update wd fold never fires for fused updates
+        self.wd = wd
+        self.decoupled = decoupled
+
+    # the structure key and write-back treat param_var/grad_var uniformly
+    @property
+    def param_var(self):
+        return tuple(self.param_vars)
+
+    @property
+    def grad_var(self):
+        return tuple(self.grad_vars)
+
+
+def _update_params_of(upd):
+    """Positions-of-write-back helper: per-param updates own one var, fused
+    updates own a tuple."""
+    if isinstance(upd, _FusedAdamWUpdate):
+        return upd.param_vars
+    return (upd.param_var,)
+
+
 def append_backward(loss: Tensor, parameter_list=None, no_grad_set=None):
     """paddle.static.append_backward parity (python/paddle/base/backward.py):
     registers grad computation for every trainable parameter the program
@@ -129,7 +173,9 @@ class Executor:
 
         # write back persistables (optimizer-touched params + accumulators)
         pos_of = {v: i for i, v in enumerate(program.param_vars)}
-        updated_positions = sorted({pos_of[u.param_var] for u in program.opt_updates})
+        updated_positions = sorted(
+            {pos_of[pv] for u in program.opt_updates for pv in _update_params_of(u)}
+        )
         for i, new in zip(updated_positions, updated):
             program._var_tensors[program.param_vars[i]]._replace_value(new)
         for upd, accs in zip(program.opt_updates, new_accums):
@@ -193,7 +239,9 @@ class Executor:
             return program.replay_env(dict(zip(feed_var_ids, feed_arrays)), param_arrays)
 
         pos_of_param = {v: i for i, v in enumerate(program.param_vars)}
-        updated_positions = sorted({pos_of_param[u.param_var] for u in opt_updates})
+        updated_positions = sorted(
+            {pos_of_param[pv] for u in opt_updates for pv in _update_params_of(u)}
+        )
 
         def replay(feed_arrays, param_arrays, accum_arrays, lr_arrays):
             env = None
@@ -222,26 +270,51 @@ class Executor:
             new_params = list(param_arrays)
             # coupled L2 decay folds into the gradient; global-norm clip
             # scales each minimize-call's gradient group jointly (parity with
-            # the eager step(): clip -> decay -> update)
+            # the eager step(): clip -> decay -> update). Fused updates carry
+            # a LIST of grads; clip flattens over them.
             eff_grads = []
             for upd in opt_updates:
+                if isinstance(upd, _FusedAdamWUpdate):
+                    gs = [env.get(gv) for gv in upd.grad_vars]
+                    if any(g is None for g in gs):
+                        raise RuntimeError("optimizer update without computed gradient")
+                    eff_grads.append(gs)
+                    continue
                 g = env.get(upd.grad_var)
                 if g is None:
                     raise RuntimeError("optimizer update without computed gradient")
                 eff_grads.append(g)
             from ..nn.clip import ClipGradByGlobalNorm
 
+            def _as_list(g):
+                return g if isinstance(g, list) else [g]
+
             clip_groups = {}
             for i, upd in enumerate(opt_updates):
                 if isinstance(upd.clip, ClipGradByGlobalNorm):
                     clip_groups.setdefault(id(upd.clip), (upd.clip, []))[1].append(i)
             for clip, idxs in clip_groups.values():
-                gn = jnp.sqrt(sum(jnp.sum(jnp.square(eff_grads[i].astype(jnp.float32))) for i in idxs))
+                gn = jnp.sqrt(sum(
+                    jnp.sum(jnp.square(g.astype(jnp.float32)))
+                    for i in idxs for g in _as_list(eff_grads[i])
+                ))
                 scale = jnp.minimum(1.0, clip.clip_norm / jnp.maximum(gn, 1e-12))
+
+                def _scaled(g):
+                    return (g.astype(jnp.float32) * scale).astype(g.dtype)
+
                 for i in idxs:
-                    eff_grads[i] = (eff_grads[i].astype(jnp.float32) * scale).astype(eff_grads[i].dtype)
+                    if isinstance(eff_grads[i], list):
+                        eff_grads[i] = [_scaled(g) for g in eff_grads[i]]
+                    else:
+                        eff_grads[i] = _scaled(eff_grads[i])
             new_accums = []
             for upd, accs, lr, g in zip(opt_updates, accum_arrays, lr_arrays, eff_grads):
+                if isinstance(upd, _FusedAdamWUpdate):
+                    new_accums.append(
+                        self._apply_fused_update(upd, accs, lr, g, new_params, pos_of_param)
+                    )
+                    continue
                 i = pos_of_param[upd.param_var]
                 if upd.wd:
                     g = g + jnp.asarray(upd.wd, g.dtype) * new_params[i].astype(g.dtype)
@@ -260,6 +333,45 @@ class Executor:
             compiled = self._timed_first_call(compiled)
         program._compiled[key] = compiled
         return compiled
+
+    @staticmethod
+    def _apply_fused_update(upd, accs, lr, grads, new_params, pos_of_param):
+        """One flat-bucket kernel for a whole minimize() call's params: gather
+        grads/params into padded flat buffers, run fused_adamw_apply, scatter
+        params back. Returns the update's new accums [m_flat, v_flat, t]."""
+        from ..ops.fused_optimizer import fused_adamw_apply
+
+        m_flat, v_flat, t = accs
+        t2 = t + 1
+        c1 = 1.0 - jnp.power(jnp.float32(upd.beta1), t2.astype(jnp.float32))
+        c2 = 1.0 - jnp.power(jnp.float32(upd.beta2), t2.astype(jnp.float32))
+        first = new_params[pos_of_param[upd.param_vars[0]]]
+        n = sum(upd.index[pv][1] for pv in upd.param_vars)
+        g_parts = [g.ravel().astype(jnp.float32) for g in grads]
+        p_parts = [new_params[pos_of_param[pv]].ravel() for pv in upd.param_vars]
+        if upd.n_pad > n:
+            g_parts.append(jnp.zeros((upd.n_pad - n,), jnp.float32))
+            p_parts.append(jnp.zeros((upd.n_pad - n,), first.dtype))
+        P2, M2, V2 = fused_adamw_apply(
+            jnp.concatenate(p_parts) if len(p_parts) > 1 else p_parts[0],
+            m_flat,
+            v_flat,
+            jnp.concatenate(g_parts) if len(g_parts) > 1 else g_parts[0],
+            lr=lr,
+            clip_scale=1.0,  # global-norm clip already scaled eff_grads
+            c1=c1,
+            c2=c2,
+            seed=0,
+            beta1=upd.beta1,
+            beta2=upd.beta2,
+            eps=upd.eps,
+            wd=upd.wd,
+            decoupled=upd.decoupled,
+        )
+        for pv in upd.param_vars:
+            off, size, shape = upd.index[pv]
+            new_params[pos_of_param[pv]] = P2[off:off + size].reshape(shape)
+        return [M2, V2, t2]
 
     @staticmethod
     def _timed_first_call(compiled):

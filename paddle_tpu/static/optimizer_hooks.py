@@ -65,6 +65,10 @@ def static_minimize(optimizer, loss, parameters=None):
     from ..optimizer.optimizer import _wd_value
 
     clip = optimizer._grad_clip
+    if type(optimizer) in (Adam, AdamW) and _use_fused_flag():
+        _append_fused_adamw(prog, optimizer, pairs, lr_getter, clip)
+        prog._compiled.clear()
+        return None, pairs
     coupled_wd = 0.0
     if type(optimizer) is not AdamW:  # SGD/Momentum/Adam fold L2 into the grad
         coupled_wd = _wd_value(optimizer._weight_decay) or 0.0
@@ -94,3 +98,47 @@ def static_minimize(optimizer, loss, parameters=None):
         prog.opt_updates.append(_OptUpdate(pv, gv, fn, accums, lr_getter, clip=clip, wd=coupled_wd))
     prog._compiled.clear()
     return None, pairs
+
+
+def _use_fused_flag():
+    from ..framework import flags as _flags
+
+    return bool(_flags.get_flag("FLAGS_fused_optimizer"))
+
+
+def _append_fused_adamw(prog, optimizer, pairs, lr_getter, clip):
+    """FLAGS_fused_optimizer static path: one _FusedAdamWUpdate per param
+    storage dtype — the whole minimize() call's elementwise update runs as
+    one flat-bucket kernel inside the compiled replay (executor
+    _apply_fused_update)."""
+    from collections import defaultdict
+
+    from ..ops.fused_optimizer import pad_to_tile
+    from ..optimizer.optimizer import AdamW, _wd_value
+    from .executor import _FusedAdamWUpdate
+
+    by_dtype = defaultdict(list)
+    for p, g in pairs:
+        by_dtype[p._value.dtype].append((p, g))
+    wd = _wd_value(optimizer._weight_decay) or 0.0
+    for dt, pgs in by_dtype.items():
+        index, off = {}, 0
+        pvs, gvs = [], []
+        for p, g in pgs:
+            pv = prog.var_of(p)
+            pvs.append(pv)
+            gvs.append(prog._id2var[id(g)])
+            size = int(p._value.size)
+            index[pv] = (off, size, tuple(p._value.shape))
+            off += size
+        n_pad = pad_to_tile(off)
+        accums = [
+            Tensor(jnp.zeros((n_pad,), jnp.float32)),  # moment1, flat
+            Tensor(jnp.zeros((n_pad,), jnp.float32)),  # moment2, flat
+            Tensor(jnp.zeros((), jnp.int32)),          # t
+        ]
+        prog.opt_updates.append(_FusedAdamWUpdate(
+            pvs, gvs, index, n_pad, accums, lr_getter, clip,
+            optimizer._beta1, optimizer._beta2, optimizer._eps,
+            wd=wd, decoupled=type(optimizer) is AdamW,
+        ))

@@ -1,0 +1,72 @@
+"""The un-forfeitable bench capture (fast tier-1 lane, NOT `slow`).
+
+r05's driver capture was lost entirely (`BENCH_r05.json` rc=124,
+parsed=null) because bench.py printed its single JSON line only after ALL
+configs completed. These tests pin the round-6 contract: under an
+artificially tiny `BENCH_DEADLINE_S` the run still exits 0, every stdout
+line is a complete parsable JSON snapshot, and the last line lists every
+config as measured or EXPLICITLY skipped — the driver can never again read
+`parsed: null` from a timed-out run.
+"""
+import json
+import os
+import subprocess
+import sys
+
+BENCH = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "bench.py")
+CONFIGS = {"seq128", "seq4096", "llama3_shape", "resnet50", "ppocr_e2e"}
+
+
+def _run_bench(deadline_s):
+    env = dict(os.environ)
+    env["BENCH_DEADLINE_S"] = str(deadline_s)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("BENCH_CHILD", None)
+    return subprocess.run(
+        [sys.executable, BENCH], env=env, capture_output=True, text=True,
+        timeout=240,
+    )
+
+
+def test_tiny_deadline_yields_explicit_skips():
+    r = _run_bench(0.1)
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [l for l in r.stdout.strip().splitlines() if l.strip()]
+    assert lines, "bench printed nothing"
+
+    # EVERY line is a complete snapshot (the driver may catch any of them)
+    snaps = [json.loads(l) for l in lines]
+    for s in snaps:
+        assert set(s) >= {"metric", "value", "unit", "vs_baseline", "detail"}
+        assert set(s["detail"]["configs"]) == CONFIGS
+
+    last = snaps[-1]
+    for k, status in last["detail"]["configs"].items():
+        assert status == "skipped:deadline", (k, status)
+    # the headline's skip is recorded in the detail too, not silently null
+    assert last["detail"]["seq128"] == {"skipped": "deadline"}
+    assert last["value"] is None
+    # snapshot-and-extend: one line per resolved config plus the terminal one
+    assert len(lines) >= len(CONFIGS)
+
+
+def test_deadline_skip_reason_survives_env_skips():
+    env = dict(os.environ)
+    env.update(
+        BENCH_DEADLINE_S="0.1", JAX_PLATFORMS="cpu",
+        BENCH_SKIP_VISION="1", BENCH_SKIP_4096="1", BENCH_SKIP_LLAMA="1",
+    )
+    env.pop("BENCH_CHILD", None)
+    r = subprocess.run(
+        [sys.executable, BENCH], env=env, capture_output=True, text=True,
+        timeout=240,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    last = json.loads(r.stdout.strip().splitlines()[-1])
+    cfg = last["detail"]["configs"]
+    # env skips and deadline skips stay distinguishable in the record
+    assert cfg["resnet50"] == "skipped:env"
+    assert cfg["ppocr_e2e"] == "skipped:env"
+    assert cfg["seq4096"] == "skipped:env"
+    assert cfg["llama3_shape"] == "skipped:env"
+    assert cfg["seq128"] == "skipped:deadline"

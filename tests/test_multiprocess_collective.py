@@ -26,7 +26,10 @@ import os, sys
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 4)
+try:
+    jax.config.update("jax_num_cpu_devices", 4)
+except AttributeError:
+    pass  # older jax: the XLA_FLAGS device-count flag above already applies
 sys.path.insert(0, os.environ["PADDLE_TPU_REPO"])
 
 import numpy as np
@@ -53,8 +56,10 @@ local = np.asarray(
 garr = jax.make_array_from_process_local_data(
     NamedSharding(mesh, P("dp", None)), local, (8, 1)
 )
+from paddle_tpu.framework.jax_compat import shard_map
+
 total = jax.jit(
-    jax.shard_map(
+    shard_map(
         lambda x: jax.lax.psum(x, "dp"),
         mesh=mesh, in_specs=P("dp", None), out_specs=P(None, None),
     )
