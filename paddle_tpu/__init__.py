@@ -71,6 +71,12 @@ CUDAPlace = TPUPlace  # alias: "the accelerator place"
 
 from .framework.random import get_rng_state, seed, set_rng_state  # noqa: F401,E402
 from .framework.flags import get_flags, set_flags  # noqa: F401,E402
+from .framework.guardian import (  # noqa: F401,E402
+    DesyncDetector,
+    FlightRecorder,
+    GuardianAnomaly,
+    TrainingGuardian,
+)
 
 # ---- core tensor + ops (patches Tensor methods on import) ----
 from .core.tensor import Tensor  # noqa: E402

@@ -237,6 +237,20 @@ class GradScaler:
         self.step(optimizer)
 
     @no_grad()
+    def record_external_skip(self):
+        """Count a step that was skipped OUTSIDE the scaler (the training
+        guardian's skip_step / rollback-unavailable policies) in the dynamic
+        loss-scale bookkeeping — same accounting as a found-inf step, so the
+        scale backs off after `decr_every_n_nan_or_inf` guardian skips just
+        as it would after scaler-detected overflows."""
+        if not self._enable:
+            return
+        prev = self._found_inf._value
+        self._found_inf._replace_value(jnp.ones((), jnp.bool_))
+        self.update()
+        self._found_inf._replace_value(prev)
+
+    @no_grad()
     def update(self):
         if not (self._enable and self._dynamic):
             return

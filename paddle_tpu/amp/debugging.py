@@ -155,6 +155,12 @@ def _check_op_output(op_name: str, value) -> None:
         cfg = _checker["config"]
         mode = cfg.debug_mode if cfg else DebugMode.CHECK_NAN_INF_AND_ABORT
         msg = f"nan/inf detected in output of op {op_name!r}"
+        # the per-op anomaly is post-mortem gold: land it in every live
+        # flight recorder so a crash dump names the op that went bad first
+        from ..framework import guardian as _guardian
+
+        for rec in list(_guardian._recorders):
+            rec.record_event("op_anomaly", op=op_name)
         if mode == DebugMode.CHECK_NAN_INF_AND_ABORT:
             raise FloatingPointError(msg)
         print(f"[check_nan_inf] {msg}")

@@ -213,4 +213,13 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
         _record_save_metric("failed")
         raise
     _record_save_metric("ok")
+    try:
+        # guardian crash dumps default to a `crash/` dir NEXT TO the newest
+        # checkpoint, so the flight recorder lands where the operator is
+        # already looking after a failure
+        from ...framework import guardian as _guardian
+
+        _guardian.note_checkpoint_dir(path)
+    except Exception:
+        pass
     return step_dir

@@ -587,6 +587,12 @@ def _coll_metrics(op: str, group: str):
                 "paddle_tpu_collective_latency_seconds",
                 "eager collective host-side latency (dispatch to sync)", ("op", "group"),
             ).labels(**labels),
+            _tm.gauge(
+                "paddle_tpu_collective_last_latency_seconds",
+                "latency of the most recent call per (op, group) — the "
+                "point-in-time view the guardian flight recorder snapshots",
+                ("op", "group"),
+            ).labels(**labels),
         )
     return m
 
@@ -627,7 +633,7 @@ def _watched(fn):
 
         group_label = getattr(g, "name", None) or "_world"
         nbytes = _payload_nbytes(fn.__name__, args, kwargs)
-        calls_c, bytes_c, lat_c = _coll_metrics(fn.__name__, group_label)
+        calls_c, bytes_c, lat_c, last_c = _coll_metrics(fn.__name__, group_label)
         calls_c.inc()
         bytes_c.inc(nbytes)
         span = RecordEvent(
@@ -642,7 +648,9 @@ def _watched(fn):
             # observe even when the collective raises: calls_total already
             # counted this invocation, and diverging count/observe breaks
             # rate(calls)/rate(latency_count) exactly in failure windows
-            lat_c.observe(time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            lat_c.observe(dt)
+            last_c.set(dt)
 
     return inner
 

@@ -123,6 +123,20 @@ def _default_handler(task: CommTask, dump: str) -> None:
     except Exception:
         pass
     flush_diagnostics()
+    try:
+        # the guardian flight recorders are the per-step post-mortem (loss,
+        # grad norm, skip/rollback/desync events, collective latencies) —
+        # dump them to the crash dir before the process dies
+        from ..framework import guardian as _guardian
+
+        for p in _guardian.dump_flight_recorders(reason=f"watchdog:{task.op}"):
+            sys.stderr.write(f"flight recorder dumped: {p}\n")
+    except Exception:
+        pass  # diagnostics must never mask the abort
+    try:
+        sys.stderr.flush()
+    except Exception:
+        pass
     sys.stderr.write("aborting process (reference CommTaskManager semantics)\n")
     try:
         sys.stderr.flush()

@@ -24,6 +24,7 @@ from .fault_injection import (  # noqa: F401
     FaultPlan,
     clear_plan,
     corrupt_file,
+    corrupt_value,
     current_plan,
     fault_point,
     install_plan,
@@ -46,6 +47,7 @@ __all__ = [
     "plan_from_spec",
     "fault_point",
     "corrupt_file",
+    "corrupt_value",
     "RetryPolicy",
     "RetryError",
     "backoff_delay",

@@ -201,6 +201,24 @@ def fault_point(site: str, **ctx) -> None:
     raise FaultInjected(site, remaining)
 
 
+def corrupt_value(site: str) -> Optional[FaultSpec]:
+    """Injection point for corrupt actions on IN-MEMORY values — the
+    on-device analog of `corrupt_file`. Claims a matching CORRUPT spec and
+    returns it (None when nothing is scheduled); the CALLER applies its own
+    site-specific corruption, e.g. the training guardian NaN-poisons a
+    gradient (`guardian.grad_nan`) or flips one bit in a simulated rank's
+    optimizer bucket (`guardian.bucket_bitflip`). The returned spec's `arg`
+    and `fired` fields let the caller derive deterministic corruption
+    parameters (target rank, bit position) from the plan seed."""
+    plan = current_plan()
+    if plan is None:
+        return None
+    spec = plan._claim(site, (FaultAction.CORRUPT,))
+    if spec is not None:
+        _record(site, FaultAction.CORRUPT)
+    return spec
+
+
 def corrupt_file(site: str, path: str) -> bool:
     """Injection point for corrupt actions: flip deterministic byte positions
     in the file at `path` (seeded by the plan), AFTER the caller recorded its

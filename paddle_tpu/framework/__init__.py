@@ -1,1 +1,1 @@
-from . import dtype, device, flags, monitor, random  # noqa: F401
+from . import dtype, device, flags, guardian, monitor, random  # noqa: F401

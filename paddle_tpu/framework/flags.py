@@ -93,6 +93,44 @@ define_flag(
     "per-tensor fusion scatter (~9 ms of the 53 ms seq-128 step)",
 )
 define_flag("FLAGS_jit_guard_shapes", True, "retrace to_static programs on input shape change")
+# Training guardian (framework/guardian.py): state-failure guards layered on
+# the PR 2 process/IO resilience — numerical anomaly policy, last-known-good
+# rollback ring, cross-rank desync digest, crash flight recorder.
+define_flag(
+    "FLAGS_guardian_policy",
+    "raise",
+    "what TrainingGuardian.step does on a numerical anomaly: 'raise' (dump "
+    "flight recorder + FloatingPointError), 'skip_step' (drop the update, "
+    "count the step as skipped in GradScaler accounting), or 'rollback' "
+    "(restore the newest last-known-good snapshot and re-seed the generator)",
+)
+define_flag(
+    "FLAGS_guardian_abs_ceiling",
+    0.0,
+    "abs-magnitude ceiling for the guardian's fused numerics check over "
+    "loss/grads/params (0 disables the ceiling; non-finiteness is always "
+    "checked when FLAGS_check_nan_inf is on)",
+)
+define_flag(
+    "FLAGS_lkg_interval",
+    100,
+    "steps between last-known-good on-device snapshots of params + optimizer "
+    "state (fused-bucket aware); the rollback policy restores the newest one",
+)
+define_flag("FLAGS_lkg_ring", 2, "how many last-known-good snapshots to keep")
+define_flag(
+    "FLAGS_desync_interval",
+    0,
+    "steps between cross-rank desync digest checks (param-bucket checksums + "
+    "RNG state + step counter all-reduced over the group); 0 disables the "
+    "periodic check — explicit check_desync() calls always run",
+)
+define_flag(
+    "FLAGS_flight_recorder_len",
+    256,
+    "per-step records kept in the guardian flight recorder ring (dumped as "
+    "JSON to the crash dir on watchdog escalation or guardian abort)",
+)
 define_flag("FLAGS_eager_delete_tensor_gb", 0.0, "no-op on TPU; XLA owns HBM")
 define_flag("FLAGS_log_level", 0, "framework verbosity")
 define_flag("FLAGS_benchmark", False, "block_until_ready after each op (timing)")

@@ -1,0 +1,861 @@
+"""Training guardian: state-failure guards for the training loop.
+
+Reference parity: paddle/fluid/framework/details/nan_inf_utils* +
+python/paddle/amp/debugging.py (TensorChecker) cover the anomaly-DETECTION
+half; the reference leaves recovery to the user. PR 2 hardened the
+process/IO failure paths (retries, atomic checkpoints, watchdog); this
+module guards the STATE failure paths on top of them:
+
+1. **Numerical anomaly guard** — a jittable fused reduction over
+   loss/grads/params (finiteness + an optional abs-magnitude ceiling,
+   `FLAGS_guardian_abs_ceiling`) that costs ONE device->host scalar sync per
+   step, gated by `FLAGS_check_nan_inf`. The verdict drives a policy knob
+   (`FLAGS_guardian_policy` / per-guardian override): `raise` dumps the
+   flight recorder and raises, `skip_step` drops the update (counted into
+   GradScaler's dynamic-loss-scale bookkeeping via
+   `GradScaler.record_external_skip`), `rollback` restores the newest
+   last-known-good snapshot. Skipped/rolled-back steps never invoke
+   `optimizer.step()`, so the fused-optimizer donated buckets are never
+   consumed by a step that is then discarded.
+
+2. **Last-known-good snapshots** — a ring (`FLAGS_lkg_ring`) of cheap
+   on-device copies of params + optimizer state, taken every
+   `FLAGS_lkg_interval` clean steps. Fused-bucket aware: the snapshot
+   covers the FLAT bucket tensors (via `Optimizer._fused_state_entries`),
+   not per-tensor views, and copies are real device buffers so a later
+   to_static donation can't invalidate them. `rollback()` restores every
+   covered tensor bit-identically, resets state born after the snapshot to
+   its creation fill (GradScaler-skip semantics), restores the generator
+   key, and folds the rollback count into it so the retried steps draw
+   fresh-but-deterministic dropout instead of replaying the diverged path.
+
+3. **Cross-rank desync detector** — a periodic all-reduce (MIN and MAX) of
+   a per-rank digest vector: one position-sensitive checksum per param and
+   per optimizer state bucket, plus the RNG state and step counter.
+   Columns where MIN != MAX name exactly WHICH unit diverged; majority
+   vote over the gathered matrix names WHICH rank. Detection records the
+   (bucket, rank) pair in the flight recorder, dumps it, and aborts through
+   the comm-watchdog escalation ladder (so custom timeout/abort handlers
+   and the faulthandler stack dump all apply). FaultPlan site
+   `guardian.bucket_bitflip` flips one bit in a simulated rank's bucket
+   before digesting — the SDC drill.
+
+4. **Flight recorder** — a bounded ring of per-step records (loss,
+   grad-norm, lr, skip/rollback/anomaly events, per-op collective latency
+   deltas from the telemetry registry) dumped as JSON to a crash dir next
+   to the checkpoint (`note_checkpoint_dir`) by any guardian abort and by
+   the PR 2 watchdog escalation (`comm_watchdog._default_handler`).
+
+FaultPlan chaos sites: `guardian.grad_nan` (poison one gradient value with
+NaN inside `TrainingGuardian.step`, before the check) and
+`guardian.bucket_bitflip` (see above).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import weakref
+from collections import deque
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax import numpy as jnp
+
+from . import flags as _flags
+from . import random as random_mod
+
+POLICIES = ("raise", "skip_step", "rollback")
+
+# anomaly bitmask returned by the fused check
+ANOMALY_NONFINITE = 1
+ANOMALY_MAGNITUDE = 2
+
+
+class GuardianAnomaly(FloatingPointError):
+    """Raised by the `raise` policy (and the compiled-state hooks) after the
+    flight recorder has been dumped."""
+
+    def __init__(self, msg: str, kind: str = "nonfinite", dump_paths=()):
+        super().__init__(msg)
+        self.kind = kind
+        self.dump_paths = list(dump_paths)
+
+
+# ---------------------------------------------------------------------------
+# fused numerics check
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def _check_impl(grad_vals, other_vals, ceiling):
+    """ONE fused reduction over every array: (anomaly bitmask, grad norm).
+
+    Everything reduces on-device to two scalars, so the host pays a single
+    tiny transfer per guarded step regardless of model size.
+    """
+    nonfinite = jnp.zeros((), jnp.bool_)
+    over = jnp.zeros((), jnp.bool_)
+    gn_sq = jnp.zeros((), jnp.float32)
+    use_ceiling = ceiling > 0.0
+    for v in grad_vals:
+        vf = v.astype(jnp.float32)
+        nonfinite = nonfinite | ~jnp.all(jnp.isfinite(vf))
+        over = over | (use_ceiling & jnp.any(jnp.abs(vf) > ceiling))
+        gn_sq = gn_sq + jnp.sum(jnp.square(vf))
+    for v in other_vals:
+        vf = v.astype(jnp.float32)
+        nonfinite = nonfinite | ~jnp.all(jnp.isfinite(vf))
+        over = over | (use_ceiling & jnp.any(jnp.abs(vf) > ceiling))
+    flags = nonfinite.astype(jnp.int32) * ANOMALY_NONFINITE
+    flags = flags + over.astype(jnp.int32) * ANOMALY_MAGNITUDE
+    return flags, jnp.sqrt(gn_sq)
+
+
+def _floating(values):
+    return [v for v in values if jnp.issubdtype(jnp.result_type(v), jnp.floating)]
+
+
+def check_arrays(grad_vals, other_vals=(), ceiling: float = 0.0):
+    """Run the fused numerics check over raw arrays.
+
+    Returns `(mask, grad_norm)` as host scalars: `mask` is a bitwise OR of
+    ANOMALY_NONFINITE / ANOMALY_MAGNITUDE (0 = clean) and `grad_norm` the
+    global L2 norm over `grad_vals`. Non-floating arrays are skipped (an
+    integer step counter cannot go NaN).
+    """
+    gs = _floating(grad_vals)
+    os_ = _floating(other_vals)
+    if not gs and not os_:
+        return 0, 0.0
+    flags, gn = _check_impl(gs, os_, jnp.asarray(float(ceiling), jnp.float32))
+    flags, gn = jax.device_get((flags, gn))
+    return int(flags), float(gn)
+
+
+def _anomaly_kind(mask: int) -> str:
+    kinds = []
+    if mask & ANOMALY_NONFINITE:
+        kinds.append("nonfinite")
+    if mask & ANOMALY_MAGNITUDE:
+        kinds.append("magnitude")
+    return "+".join(kinds) or "clean"
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+_recorders: "weakref.WeakSet" = weakref.WeakSet()
+_noted_ckpt_dir: List[Optional[str]] = [None]
+
+
+def note_checkpoint_dir(path: str) -> None:
+    """Remember the latest checkpoint root so crash dumps land NEXT TO the
+    checkpoint by default (called by distributed.checkpoint.save_state_dict)."""
+    _noted_ckpt_dir[0] = os.path.join(str(path), "crash")
+
+
+def default_crash_dir() -> str:
+    env = os.environ.get("PADDLE_TPU_CRASH_DIR")
+    if env:
+        return env
+    if _noted_ckpt_dir[0]:
+        return _noted_ckpt_dir[0]
+    return os.path.join(os.getcwd(), "paddle_tpu_crash")
+
+
+class FlightRecorder:
+    """Bounded ring of per-step records + events, dumped as JSON on crash.
+
+    Records are plain dicts (already JSON-clean floats/ints/strings); the
+    ring length follows `FLAGS_flight_recorder_len` unless overridden.
+    """
+
+    def __init__(self, capacity: Optional[int] = None, name: str = "train",
+                 crash_dir: Optional[str] = None):
+        if capacity is None:
+            capacity = int(_flags.get_flag("FLAGS_flight_recorder_len"))
+        self.name = name
+        self.crash_dir = crash_dir
+        self._ring: deque = deque(maxlen=max(int(capacity), 1))
+        self._lock = threading.Lock()
+        self._seq = 0
+        _recorders.add(self)
+
+    def record(self, kind: str, **fields) -> None:
+        rec = {"t": time.time(), "kind": kind}
+        rec.update(fields)
+        with self._lock:
+            self._seq += 1
+            rec["seq"] = self._seq
+            self._ring.append(rec)
+
+    def record_step(self, step: int, **fields) -> None:
+        self.record("step", step=int(step), **fields)
+
+    def record_event(self, event: str, **fields) -> None:
+        self.record("event", event=event, **fields)
+
+    def records(self) -> list:
+        with self._lock:
+            return list(self._ring)
+
+    def dump(self, reason: str = "", crash_dir: Optional[str] = None) -> str:
+        """Write the ring as one JSON file; returns the path."""
+        d = crash_dir or self.crash_dir or default_crash_dir()
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(
+            d, f"flight_{self.name}_{os.getpid()}_{int(time.time() * 1000)}.json"
+        )
+        payload = {
+            "version": 1,
+            "reason": reason,
+            "dumped_at": time.time(),
+            "name": self.name,
+            "pid": os.getpid(),
+            "records": self.records(),
+        }
+        with open(path, "w") as f:
+            json.dump(payload, f, indent=1, default=str)
+            f.write("\n")
+        return path
+
+
+def dump_flight_recorders(reason: str = "", crash_dir: Optional[str] = None) -> list:
+    """Dump every live flight recorder (called by the comm-watchdog
+    escalation ladder and by guardian aborts); returns the written paths."""
+    paths = []
+    for rec in list(_recorders):
+        try:
+            paths.append(rec.dump(reason=reason, crash_dir=crash_dir))
+        except Exception:
+            pass  # a failing dump must never mask the abort path
+    return paths
+
+
+def _collective_latency_totals() -> dict:
+    """op -> (count, sum) cumulative totals from the telemetry registry."""
+    from .. import telemetry as _tm
+
+    if not _tm.enabled():
+        return {}
+    fam = _tm.default_registry().get("paddle_tpu_collective_latency_seconds")
+    if fam is None:
+        return {}
+    totals: dict = {}
+    for child in fam.children():
+        op = dict(child.labels).get("op", "?")
+        c, s = totals.get(op, (0, 0.0))
+        totals[op] = (c + child.count, s + child.sum)
+    return totals
+
+
+# ---------------------------------------------------------------------------
+# digests (cross-rank desync)
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def _digest_impl(vals):
+    """One order/position-sensitive uint32 checksum per array, computed
+    on-device: bitcast to integer lanes, mix with a position hash, wraparound
+    sum. A single flipped bit anywhere changes the digest."""
+    outs = []
+    for v in vals:
+        dt = v.dtype
+        flat = v.reshape(-1)
+        if dt == jnp.bfloat16 or dt == jnp.float16:
+            u = jax.lax.bitcast_convert_type(flat, jnp.uint16).astype(jnp.uint32)
+        elif dt == jnp.float32:
+            u = jax.lax.bitcast_convert_type(flat, jnp.uint32)
+        elif dt == jnp.float64:
+            u64 = jax.lax.bitcast_convert_type(flat, jnp.uint64)
+            u = (u64 ^ jax.lax.shift_right_logical(u64, np.uint64(32))).astype(jnp.uint32)
+        else:
+            u = flat.astype(jnp.uint32)
+        idx = jax.lax.iota(jnp.uint32, u.size)
+        mixed = u ^ (idx * np.uint32(0x9E3779B1) + np.uint32(1))
+        outs.append(jnp.sum(mixed, dtype=jnp.uint32))
+    return jnp.stack(outs) if outs else jnp.zeros((0,), jnp.uint32)
+
+
+def digest_arrays(arrays) -> np.ndarray:
+    """Host uint32 digest vector, one entry per array."""
+    if not arrays:
+        return np.zeros((0,), np.uint32)
+    return np.asarray(jax.device_get(_digest_impl(list(arrays))), np.uint32)
+
+
+def _flip_one_bit(arr, seed: int, salt: int):
+    """Deterministically flip one bit of `arr` (host-side; chaos-drill only)."""
+    import random as _random
+
+    a = np.array(np.asarray(arr))  # writable host copy
+    buf = a.view(np.uint8).reshape(-1)
+    rng = _random.Random(f"{seed}:bitflip:{salt}")
+    byte = rng.randrange(buf.size)
+    bit = rng.randrange(8)
+    buf[byte] ^= np.uint8(1 << bit)
+    return jnp.asarray(a)
+
+
+class DesyncDetector:
+    """Periodic cross-rank digest comparison over a collective group.
+
+    Single-controller SPMD note: every rank is a slice of one program, so a
+    REAL divergence means silent data corruption (bit flip in HBM, a
+    miscompiled replica, host memory rot). The detector rides the stacked
+    collective convention: a [nranks, D] digest matrix all-reduced with MIN
+    and MAX; any column where they differ names the diverged unit, and the
+    majority vote over rows names the rank.
+    """
+
+    def __init__(self, optimizer, group=None, recorder: Optional[FlightRecorder] = None):
+        self.optimizer = optimizer
+        self.group = group
+        self.recorder = recorder
+
+    def digest_units(self) -> List[Tuple[str, object]]:
+        """[(unit name, raw array)] — params + bucket-aware optimizer state."""
+        opt = self.optimizer
+        units: List[Tuple[str, object]] = []
+        pid2idx = {}
+        for i, (_, p) in enumerate(opt._all_params()):
+            pid2idx[id(p)] = i
+            units.append((p.name or f"param:{i}", p._raw()))
+        for name, store in sorted(opt._accumulators.items()):
+            for pid, t in store.items():
+                units.append((f"accum:{name}:{pid2idx.get(pid, '?')}", t._raw()))
+        for bi, st in enumerate(getattr(opt, "_fused_buckets", {}).values()):
+            for gi, grp in enumerate(st["groups"]):
+                for nm, t in grp["flat"].items():
+                    units.append((f"stacked_bucket:{bi}.{gi}:{nm}", t._raw()))
+        eng = getattr(opt, "_flat_engine", None)
+        if eng is not None:
+            units.extend(eng.digest_units())
+        return units
+
+    def local_digest(self) -> Tuple[List[str], np.ndarray]:
+        units = self.digest_units()
+        names = [n for n, _ in units]
+        vec = digest_arrays([a for _, a in units])
+        # RNG state + step counter ride the digest so seed drift / step skew
+        # is caught even when params still agree
+        rng_state = np.asarray(random_mod.get_rng_state()).view(np.uint32)
+        names.append("rng_state")
+        extra = [np.uint32(np.bitwise_xor.reduce(rng_state.reshape(-1)))]
+        names.append("step_count")
+        extra.append(np.uint32(int(self.optimizer._step_count._raw()) & 0xFFFFFFFF))
+        return names, np.concatenate([vec, np.asarray(extra, np.uint32)])
+
+    def check(self, escalate: bool = True) -> Optional[dict]:
+        """Run one desync check. Returns None when all ranks agree; else a
+        report dict {unit, ranks, units} — after recording it in the flight
+        recorder, dumping, and (escalate=True) aborting through the
+        comm-watchdog ladder."""
+        from .. import telemetry as _tm
+        from ..distributed.resilience import fault_injection as _fi
+
+        names, vec = self.local_digest()
+        group = self.group
+        n = getattr(group, "nranks", 1) if group is not None else 1
+        if _tm.enabled():
+            _tm.counter(
+                "paddle_tpu_guardian_desync_checks_total",
+                "cross-rank desync digest comparisons",
+            ).inc()
+        if n <= 1:
+            return None
+
+        mat = np.tile(vec, (n, 1))
+        spec = _fi.corrupt_value("guardian.bucket_bitflip")
+        if spec is not None:
+            # SDC drill: recompute ONE rank's digest over a bit-flipped copy
+            # of a bucket (prefer a real bucket unit; else the first unit)
+            rank = int(spec.arg) % n
+            units = self.digest_units()
+            j = next(
+                (i for i, (nm, _) in enumerate(units) if "bucket" in nm), 0
+            )
+            plan = _fi.current_plan()
+            flipped = _flip_one_bit(
+                units[j][1], plan.seed if plan else 0, spec.fired
+            )
+            mat[rank, j] = digest_arrays([flipped])[0]
+
+        from ..core.tensor import Tensor
+        from ..distributed import collective as _coll
+
+        lo = Tensor(jnp.asarray(mat.astype(np.int64)))
+        hi = Tensor(jnp.asarray(mat.astype(np.int64)))
+        _coll.all_reduce(lo, op=_coll.ReduceOp.MIN, group=group)
+        _coll.all_reduce(hi, op=_coll.ReduceOp.MAX, group=group)
+        lo_v = np.asarray(lo._raw())[0]
+        hi_v = np.asarray(hi._raw())[0]
+        diverged_cols = np.nonzero(lo_v != hi_v)[0]
+        if diverged_cols.size == 0:
+            return None
+
+        # attribution needs every rank's actual row, not the local tile —
+        # gather them (rare path: only after the cheap MIN/MAX detected a
+        # mismatch) and majority-vote per diverged column
+        gathered_rows: list = []
+        _coll.all_gather(
+            gathered_rows, Tensor(jnp.asarray(mat.astype(np.int64))), group=group
+        )
+        gathered = np.stack([np.asarray(t._raw()) for t in gathered_rows])
+
+        report_units = []
+        for j in diverged_cols:
+            col = gathered[:, int(j)]
+            vals, counts = np.unique(col, return_counts=True)
+            maxc = counts.max()
+            modal = vals[counts == maxc]
+            if len(modal) == 1:
+                bad = np.nonzero(col != modal[0])[0]
+            else:
+                # modal tie (e.g. a 2-rank group): majority cannot name the
+                # culprit — implicate every rank rather than coin-flip blame
+                bad = np.arange(n)
+            if bad.size == 0:
+                # defensive: detection said the column diverged; never tell
+                # the operator "diverged on no rank"
+                bad = np.arange(n)
+            report_units.append(
+                {"unit": names[int(j)], "ranks": [int(r) for r in bad]}
+            )
+        report = {
+            "unit": report_units[0]["unit"],
+            "ranks": report_units[0]["ranks"],
+            "units": report_units,
+            "step": int(self.optimizer._step_count._raw()),
+        }
+        if _tm.enabled():
+            for u in report_units:
+                for r in u["ranks"]:
+                    _tm.counter(
+                        "paddle_tpu_guardian_desync_detected_total",
+                        "diverged (unit, rank) pairs caught by the desync digest",
+                        ("unit", "rank"),
+                    ).labels(unit=u["unit"], rank=str(r)).inc()
+        if self.recorder is not None:
+            self.recorder.record_event("desync", **report)
+        paths = dump_flight_recorders(reason="desync")
+        if escalate:
+            self._escalate(report, paths)
+        return report
+
+    def _escalate(self, report: dict, dump_paths) -> None:
+        """Abort through the watchdog ladder: custom timeout/abort handlers,
+        faulthandler stack dump, and telemetry flush all apply."""
+        from ..distributed.comm_watchdog import CommTask, CommTaskManager
+
+        task = CommTask(
+            tid=-1,
+            op="guardian.desync",
+            info={
+                "unit": report["unit"],
+                "ranks": report["ranks"],
+                "step": report["step"],
+                "flight_recorder": list(dump_paths),
+            },
+            timeout=0.0,
+        )
+        dump = "\n".join(
+            f"desync unit={u['unit']} ranks={u['ranks']}" for u in report["units"]
+        )
+        CommTaskManager.instance()._handler(task, dump)
+
+
+# ---------------------------------------------------------------------------
+# training guardian
+# ---------------------------------------------------------------------------
+
+
+class TrainingGuardian:
+    """Wraps the optimizer step with the anomaly guard, the last-known-good
+    ring, the desync detector, and the flight recorder.
+
+    Usage (drop-in for `optimizer.step()` / `scaler.step(optimizer)`)::
+
+        guardian = TrainingGuardian(opt, scaler=scaler, policy="rollback")
+        for batch in loader:
+            loss = model(batch)
+            (scaler.scale(loss) if scaler else loss).backward()
+            verdict = guardian.step(loss)   # 'ok' | 'skipped' | 'rolled_back'
+            opt.clear_grad()
+
+    The numerics check only runs when FLAGS_check_nan_inf is on; with it off
+    the guardian still keeps the flight recorder and LKG ring warm. Under a
+    jax trace (to_static replay) the host-sync policies cannot run — the
+    guardian degrades to a plain step and the compiled-state hooks in
+    jit/api.py + static/executor.py take over detection (those hooks are
+    global: they honor FLAGS_guardian_abs_ceiling, not a per-instance
+    `ceiling=` override — see check_compiled_state).
+    """
+
+    def __init__(self, optimizer, scaler=None, policy: Optional[str] = None,
+                 ceiling: Optional[float] = None, lkg_interval: Optional[int] = None,
+                 lkg_ring: Optional[int] = None, desync_interval: Optional[int] = None,
+                 group=None, crash_dir: Optional[str] = None,
+                 recorder: Optional[FlightRecorder] = None, name: str = "train"):
+        if policy is not None and policy not in POLICIES:
+            raise ValueError(f"guardian policy must be one of {POLICIES}, got {policy!r}")
+        self.optimizer = optimizer
+        self.scaler = scaler
+        self._policy = policy
+        self._ceiling = ceiling
+        self._lkg_interval = lkg_interval
+        self._desync_interval = desync_interval
+        ring = lkg_ring if lkg_ring is not None else int(_flags.get_flag("FLAGS_lkg_ring"))
+        self._snapshots: deque = deque(maxlen=max(int(ring), 1))
+        self.recorder = recorder or FlightRecorder(name=name, crash_dir=crash_dir)
+        if crash_dir is not None:
+            self.recorder.crash_dir = crash_dir
+        self.detector = DesyncDetector(optimizer, group=group, recorder=self.recorder)
+        self.steps_total = 0
+        self.skipped_steps = 0
+        self.rollbacks = 0
+        self._rollback_count = 0
+        self._warned_tracing = False
+        self._coll_totals = _collective_latency_totals()
+
+    # ---- config (flag-backed, overridable per instance) ----
+    @property
+    def policy(self) -> str:
+        p = self._policy or str(_flags.get_flag("FLAGS_guardian_policy"))
+        if p not in POLICIES:
+            raise ValueError(f"FLAGS_guardian_policy must be one of {POLICIES}, got {p!r}")
+        return p
+
+    @property
+    def ceiling(self) -> float:
+        if self._ceiling is not None:
+            return float(self._ceiling)
+        return float(_flags.get_flag("FLAGS_guardian_abs_ceiling"))
+
+    @property
+    def lkg_interval(self) -> int:
+        if self._lkg_interval is not None:
+            return int(self._lkg_interval)
+        return int(_flags.get_flag("FLAGS_lkg_interval"))
+
+    @property
+    def desync_interval(self) -> int:
+        if self._desync_interval is not None:
+            return int(self._desync_interval)
+        return int(_flags.get_flag("FLAGS_desync_interval"))
+
+    # ---- the guarded step ----
+    def step(self, loss=None) -> str:
+        opt = self.optimizer
+        self.steps_total += 1
+        grads = [p.grad for _, p in opt._all_params() if p.grad is not None]
+        if self._tracing(loss, grads):
+            # inside a jax trace the one-scalar sync is impossible; the
+            # compiled-state hooks catch anomalies after the replay instead
+            if not self._warned_tracing:
+                self._warned_tracing = True
+                import warnings
+
+                warnings.warn(
+                    "TrainingGuardian.step is running under a jax trace "
+                    "(to_static replay): anomaly policies need a host sync "
+                    "and are disabled inside the compiled step; post-run "
+                    "compiled-state checks still apply", stacklevel=2,
+                )
+            self._plain_step()
+            return "ok"
+        scaler_on = self.scaler is not None and self.scaler.is_enable()
+        if scaler_on:
+            # unscale first so the check (and any skip decision) sees the
+            # true gradients; scaler.step won't re-unscale (id bookkeeping)
+            self.scaler.unscale_(opt)
+        self._maybe_inject_grad_nan(grads)
+        loss_raw = self._loss_raw(loss)
+        verdict = "ok"
+        mask, grad_norm = 0, None
+        if _flags.get_flag("FLAGS_check_nan_inf"):
+            t0 = time.perf_counter()
+            mask, grad_norm = self._check(loss_raw, grads)
+            self._observe_check(time.perf_counter() - t0)
+        if mask:
+            return self._handle_anomaly(mask, loss_raw, grad_norm)
+        self._plain_step()
+        self._after_clean_step(loss_raw, grad_norm)
+        return verdict
+
+    def _plain_step(self):
+        if self.scaler is not None and self.scaler.is_enable():
+            self.scaler.step(self.optimizer)
+        else:
+            self.optimizer.step()
+
+    def _tracing(self, loss, grads) -> bool:
+        probes = [loss] + grads
+        for t in probes:
+            if t is not None and isinstance(getattr(t, "_value", None), jax.core.Tracer):
+                return True
+        return False
+
+    def _loss_raw(self, loss):
+        """Raw UNSCALED loss value. The caller backward()s through the
+        GradScaler-scaled loss, but the grads above were unscaled — the
+        check (magnitude ceiling!) and the flight recorder must see the same
+        de-scaled world, or a 2^15 scale turns every healthy loss into a
+        'magnitude' anomaly and corrupts the recorded loss curve."""
+        if loss is None or not hasattr(loss, "_raw"):
+            return None
+        v = loss._raw()
+        if self.scaler is not None and self.scaler.is_enable():
+            v = v / self.scaler._scale._raw().astype(v.dtype)
+        return v
+
+    def _check(self, loss_raw, grads):
+        grad_vals = [g._raw() for g in grads]
+        other = [p._raw() for _, p in self.optimizer._all_params()]
+        if loss_raw is not None:
+            other.append(loss_raw)
+        return check_arrays(grad_vals, other, self.ceiling)
+
+    def _observe_check(self, dt):
+        from .. import telemetry as _tm
+
+        if _tm.enabled():
+            _tm.histogram(
+                "paddle_tpu_guardian_check_seconds",
+                "host wall time of the fused numerics check (incl. the one "
+                "scalar sync)",
+            ).observe(dt)
+
+    def _maybe_inject_grad_nan(self, grads):
+        from ..distributed.resilience import fault_injection as _fi
+
+        spec = _fi.corrupt_value("guardian.grad_nan")
+        if spec is None or not grads:
+            return
+        g = grads[0]
+        v = g._raw()
+        flat = v.reshape(-1).astype(v.dtype)
+        poisoned = flat.at[0].set(jnp.nan).reshape(v.shape)
+        g._replace_value(poisoned)
+
+    # ---- anomaly handling ----
+    def _handle_anomaly(self, mask: int, loss_raw, grad_norm) -> str:
+        from .. import telemetry as _tm
+
+        kind = _anomaly_kind(mask)
+        policy = self.policy
+        step = int(self.optimizer._step_count._raw())
+        if self.scaler is not None:
+            # the skipped step never reaches scaler.step, which is what
+            # normally clears the per-step unscale bookkeeping — clear it
+            # here or the NEXT step's grads would silently stay scaled
+            self.scaler._unscaled.discard(id(self.optimizer))
+        if _tm.enabled():
+            _tm.counter(
+                "paddle_tpu_guardian_anomalies_total",
+                "numerical anomalies caught by the guardian", ("kind", "policy"),
+            ).labels(kind=kind, policy=policy).inc()
+        self.recorder.record_event(
+            "anomaly", anomaly=kind, policy=policy, step=step,
+            loss=_loss_float(loss_raw), grad_norm=grad_norm,
+        )
+        if policy == "skip_step":
+            self.skipped_steps += 1
+            if _tm.enabled():
+                _tm.counter(
+                    "paddle_tpu_guardian_steps_skipped_total",
+                    "optimizer steps dropped by the skip_step policy",
+                ).inc()
+            if self.scaler is not None and self.scaler.is_enable():
+                self.scaler.record_external_skip()
+            return "skipped"
+        if policy == "rollback":
+            if not self._snapshots:
+                # nothing to restore yet — degrade to skip (recorded as such)
+                self.recorder.record_event("rollback_unavailable", step=step)
+                self.skipped_steps += 1
+                if self.scaler is not None and self.scaler.is_enable():
+                    self.scaler.record_external_skip()
+                return "skipped"
+            self.rollback()
+            return "rolled_back"
+        paths = dump_flight_recorders(reason=f"anomaly:{kind}")
+        raise GuardianAnomaly(
+            f"training guardian: {kind} anomaly at step {step} "
+            f"(policy=raise; flight recorder: {paths})",
+            kind=kind, dump_paths=paths,
+        )
+
+    # ---- last-known-good ring ----
+    def _state_entries(self):
+        """[(tensor, fill-or-None)] — every mutable piece of train state:
+        params (fill None: they always predate the guardian), optimizer
+        accumulators, fused flat/stacked bucket tensors, the step counter,
+        and GradScaler bookkeeping."""
+        opt = self.optimizer
+        out = [(p, None) for _, p in opt._all_params()]
+        for name, store in opt._accumulators.items():
+            fill = opt._accumulator_fills.get(name, 0.0)
+            out.extend((t, fill) for t in store.values())
+        out.extend(getattr(opt, "_fused_state_entries", lambda: [])())
+        out.append((opt._step_count, None))
+        if self.scaler is not None and self.scaler.is_enable():
+            out.extend((t, None) for t in self.scaler.state_dict().values())
+        return out
+
+    def snapshot(self) -> None:
+        """Take one last-known-good on-device snapshot (fused-bucket aware)."""
+        from .. import telemetry as _tm
+
+        opt = self.optimizer
+        getattr(opt, "_materialize_state", lambda: None)()
+        entries = [
+            (t, jnp.array(t._raw(), copy=True)) for t, _ in self._state_entries()
+        ]
+        self._snapshots.append({
+            "step": int(opt._step_count._raw()),
+            "entries": entries,
+            "rng": np.array(random_mod.get_rng_state(), copy=True),
+            "wall": time.time(),
+        })
+        if _tm.enabled():
+            _tm.counter(
+                "paddle_tpu_guardian_snapshots_total",
+                "last-known-good snapshots taken",
+            ).inc()
+
+    def rollback(self) -> int:
+        """Restore the newest last-known-good snapshot bit-identically.
+
+        State born AFTER the snapshot (lazily-created accumulators, rebuilt
+        buckets) resets to its creation fill — the same semantics as
+        GradScaler's branchless skip. The generator restores to the snapshot
+        key with the rollback count folded in, so the retried steps draw
+        deterministic but fresh dropout instead of replaying the diverged
+        trajectory. Gradients are cleared: the anomalous grads must not be
+        re-applied to the restored params.
+        """
+        from .. import telemetry as _tm
+
+        snap = self._snapshots[-1]
+        covered = {id(t): v for t, v in snap["entries"]}
+        for t, fill in self._state_entries():
+            v = covered.get(id(t))
+            if v is not None:
+                t._replace_value(v)
+            elif fill is not None:
+                t._replace_value(jnp.full(t._raw().shape, fill, t._raw().dtype))
+        self._rollback_count += 1
+        self.rollbacks += 1
+        gen = random_mod.default_generator()
+        gen.set_state(snap["rng"])
+        gen.fold_in(self._rollback_count)
+        self.optimizer.clear_grad()
+        self.recorder.record_event(
+            "rollback", restored_step=snap["step"], rollback=self._rollback_count,
+        )
+        if _tm.enabled():
+            _tm.counter(
+                "paddle_tpu_guardian_rollbacks_total",
+                "rollbacks to a last-known-good snapshot",
+            ).inc()
+        return snap["step"]
+
+    @property
+    def snapshots(self):
+        return list(self._snapshots)
+
+    # ---- post-step bookkeeping ----
+    def _after_clean_step(self, loss_raw, grad_norm) -> None:
+        opt = self.optimizer
+        step = int(opt._step_count._raw())
+        self.recorder.record_step(
+            step,
+            loss=_loss_float(loss_raw),
+            grad_norm=grad_norm,
+            lr=float(opt.get_lr()),
+            collectives=self._collective_deltas(),
+        )
+        interval = self.lkg_interval
+        if interval > 0 and step % interval == 0:
+            self.snapshot()
+        dint = self.desync_interval
+        if dint > 0 and step % dint == 0:
+            self.check_desync()
+
+    def _collective_deltas(self) -> dict:
+        now = _collective_latency_totals()
+        prev, self._coll_totals = self._coll_totals, now
+        out = {}
+        for op, (c, s) in now.items():
+            pc, ps = prev.get(op, (0, 0.0))
+            if c > pc:
+                out[op] = {"calls": c - pc, "mean_s": (s - ps) / (c - pc)}
+        return out
+
+    def check_desync(self, escalate: bool = True):
+        return self.detector.check(escalate=escalate)
+
+
+def _loss_float(loss):
+    try:
+        if loss is None:
+            return None
+        v = loss._raw() if hasattr(loss, "_raw") else loss
+        if isinstance(v, jax.core.Tracer):
+            return None
+        return float(np.asarray(v).reshape(-1)[0])
+    except Exception:
+        return None
+
+
+# ---------------------------------------------------------------------------
+# compiled-state hooks (to_static replay / static Executor)
+# ---------------------------------------------------------------------------
+
+
+def check_compiled_state(tensors, origin: str) -> None:
+    """Post-run numerics check over the CONCRETE state a compiled step wrote
+    back (to_static replay, static Executor). Detection-only at this layer —
+    a donated compiled step cannot be skipped after the fact — so an anomaly
+    records into every flight recorder, dumps, and raises GuardianAnomaly;
+    a caller holding a TrainingGuardian can then rollback() to the last
+    known good snapshot (snapshots are real copies, donation-proof).
+
+    This hook is global (it cannot know which guardian instance, if any,
+    owns the step), so the magnitude ceiling comes from
+    FLAGS_guardian_abs_ceiling alone — a per-instance
+    TrainingGuardian(ceiling=...) override applies only to the eager path;
+    set the flag too if the ceiling must hold inside compiled steps."""
+    vals = []
+    for t in tensors:
+        v = getattr(t, "_value", t)
+        if isinstance(v, jax.core.Tracer):
+            return  # nested trace: nothing concrete to check
+        deleted = getattr(v, "is_deleted", None)
+        if deleted is not None and deleted():
+            continue  # donated-away input buffer; its successor is checked
+        vals.append(v)
+    mask, _ = check_arrays([], vals, float(_flags.get_flag("FLAGS_guardian_abs_ceiling")))
+    if not mask:
+        return
+    from .. import telemetry as _tm
+
+    kind = _anomaly_kind(mask)
+    if _tm.enabled():
+        _tm.counter(
+            "paddle_tpu_guardian_anomalies_total",
+            "numerical anomalies caught by the guardian", ("kind", "policy"),
+        ).labels(kind=kind, policy=f"compiled:{origin}").inc()
+    for rec in list(_recorders):
+        rec.record_event("compiled_state_anomaly", anomaly=kind, origin=origin)
+    paths = dump_flight_recorders(reason=f"compiled_state:{origin}")
+    raise GuardianAnomaly(
+        f"training guardian: {kind} in state written back by {origin} "
+        f"(flight recorder: {paths})", kind=kind, dump_paths=paths,
+    )

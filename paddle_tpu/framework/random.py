@@ -43,6 +43,17 @@ class Generator:
 
         self._key = jnp.asarray(state, dtype=jnp.uint32)
 
+    def fold_in(self, data: int):
+        """Deterministically derive a new base key from (current key, data).
+
+        Used by the training guardian's rollback: restoring a snapshot key
+        then folding in the rollback count makes the retried steps draw
+        fresh dropout/noise deterministically instead of replaying the
+        exact randomness of the diverged attempt."""
+        with self._lock:
+            self._key = jax.random.fold_in(self._key, int(data))
+        return self
+
     def next_key(self):
         """Return a fresh PRNG key. Thread-safe; trace-aware."""
         with self._lock:

@@ -335,13 +335,16 @@ def convert_to_mixed_precision(
 ):
     """paddle.inference.convert_to_mixed_precision: rewrite a saved model's
     SEPARATE parameter payload (.pdiparams) to a reduced precision — the
-    on-disk/load-time half-sizing that is the point of the conversion. The
-    frozen StableHLO program is copied as-is (XLA re-fuses casts at compile
-    time; artifacts whose weights are baked INTO the program blob are
-    unaffected by design), and the converted precision is recorded in the
-    .pdmeta sidecar. Reference:
+    on-disk/load-time half-sizing that is the point of the conversion.
+
+    SCOPE WARNING (also emitted at runtime): the frozen StableHLO program is
+    copied AS-IS. Weights that were baked INTO the program blob at export
+    time (constants, not a separate .pdiparams payload) are NOT converted —
+    they stay at their exported precision and XLA re-fuses casts at compile
+    time. Only the separate parameter payload halves on disk. Reference:
     python/paddle/inference/convert_to_mixed_precision.py."""
     import shutil
+    import warnings
 
     target = {PrecisionType.Half: np.float16, PrecisionType.Bfloat16: "bfloat16"}.get(
         mixed_precision
@@ -349,6 +352,14 @@ def convert_to_mixed_precision(
     if target is None:
         raise ValueError("mixed_precision must be PrecisionType.Half or Bfloat16")
     black = set(black_list or ())
+    warnings.warn(
+        "convert_to_mixed_precision converts only the SEPARATE parameter "
+        f"payload ({params_file!r}); the program blob is copied as-is, so any "
+        "weights baked into the program as constants keep their exported "
+        "precision and see no size/precision change",
+        UserWarning,
+        stacklevel=2,
+    )
     shutil.copyfile(model_file, mixed_model_file)
     # sidecar meta: derive the prefix from ANY extension (reference passes
     # .pdmodel, but Config accepts arbitrary file names)

@@ -311,6 +311,19 @@ class _CompiledEntry:
                     t.stop_gradient = not t.trainable
         for t, v in zip(self.grad_tensors, new_grads):
             t.grad = Tensor(v) if v is not None else None
+        from ..framework import flags as _flags
+
+        if _flags._registry.get("FLAGS_check_nan_inf", False):
+            # guardian hook: the per-op scan can't see inside a compiled
+            # program (tracers), so the anomaly check runs over the CONCRETE
+            # state the replay wrote back — one fused reduction, only when
+            # the flag is on
+            from ..framework import guardian as _guardian
+
+            _guardian.check_compiled_state(
+                [t for t, mask in zip(self.state, self.mut_mask) if mask],
+                origin=f"to_static:{getattr(self.fn, '__name__', '<fn>')}",
+            )
         return self._rebuild_out(outs)
 
     def _build(self, args, kwargs, treedef, t_idx, template_leaves):

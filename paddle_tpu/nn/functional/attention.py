@@ -117,7 +117,15 @@ def scaled_dot_product_attention(
 
         rng_key = random_mod.next_key()
 
-    from ...ops import pallas as pallas_ops
+    # functions (not the pallas module!) in the closure cells: _closure_sig
+    # hashes closed-over FUNCTIONS by code identity but bails on modules, so
+    # capturing `pallas_ops` would silently bypass the cached-linearization
+    # fast path on EVERY sdpa call (re-tracing the vjp each step)
+    from ...ops.pallas import (
+        _ref_attention_bshd,
+        flash_attention_bshd,
+        flash_attention_profitable,
+    )
 
     args = [q, k, v]
     if attn_mask is not None:
@@ -138,19 +146,19 @@ def scaled_dot_product_attention(
         args.append(_t(seed))
 
         def f(qv, kv, vv, seedv):
-            if pallas_ops.flash_attention_profitable(qv, is_causal, p_drop, kv, vv):
-                return pallas_ops.flash_attention_bshd(
+            if flash_attention_profitable(qv, is_causal, p_drop, kv, vv):
+                return flash_attention_bshd(
                     qv, kv, vv, causal=is_causal, dropout_p=p_drop, dropout_seed=seedv
                 )
-            return pallas_ops._ref_attention_bshd(
+            return _ref_attention_bshd(
                 qv, kv, vv, is_causal, None, dropout_p=p_drop, seed=seedv
             )
 
     else:
         def f(qv, kv, vv):
-            if pallas_ops.flash_attention_profitable(qv, is_causal, 0.0, kv, vv):
-                return pallas_ops.flash_attention_bshd(qv, kv, vv, causal=is_causal)
-            return pallas_ops._ref_attention_bshd(qv, kv, vv, is_causal, None)
+            if flash_attention_profitable(qv, is_causal, 0.0, kv, vv):
+                return flash_attention_bshd(qv, kv, vv, causal=is_causal)
+            return _ref_attention_bshd(qv, kv, vv, is_causal, None)
 
     return apply("scaled_dot_product_attention", f, *args)
 

@@ -215,11 +215,53 @@ def dropout_keep_reference(seed, n_bh, sq, sk, dropout_p):
     return jax.vmap(one)(jnp.arange(n_bh, dtype=jnp.int32))
 
 
-def _as_seed(dropout_seed):
-    """Normalize the user seed to the (1,) int32 scalar-prefetch operand."""
+def _as_seed(dropout_seed, dropout_p=0.0):
+    """Normalize the user seed to the (1,) int32 scalar-prefetch operand.
+
+    None with active dropout draws a FRESH seed from the framework generator
+    (trace-aware under to_static, like sdpa's) — the one source of truth for
+    the default, so the flash entry points can't drift apart. Validates the
+    common foot-guns loudly: a non-scalar seed would silently take element 0
+    after reshape, a float would truncate, and a python int outside int32
+    range would wrap to a different mask than the caller thinks they seeded.
+    """
     if dropout_seed is None:
+        if dropout_p > 0.0:
+            return _fresh_dropout_seed()
         return jnp.zeros((1,), jnp.int32)
-    return jnp.asarray(dropout_seed, jnp.int32).reshape((1,))
+    import numbers
+
+    if isinstance(dropout_seed, bool) or isinstance(dropout_seed, float):
+        raise ValueError(
+            f"dropout_seed must be an int32-range integer scalar, got "
+            f"{type(dropout_seed).__name__} {dropout_seed!r}"
+        )
+    if isinstance(dropout_seed, numbers.Integral):
+        v = int(dropout_seed)
+        if not (-(2 ** 31) <= v < 2 ** 31):
+            raise ValueError(
+                f"dropout_seed {v} is outside int32 range [-2**31, 2**31)"
+            )
+        return jnp.full((1,), v, jnp.int32)
+    arr = jnp.asarray(dropout_seed)
+    if arr.size != 1:
+        raise ValueError(
+            f"dropout_seed must be a scalar, got shape {tuple(arr.shape)}"
+        )
+    if not jnp.issubdtype(arr.dtype, jnp.integer):
+        raise ValueError(
+            f"dropout_seed must be an integer scalar, got dtype {arr.dtype}"
+        )
+    return arr.astype(jnp.int32).reshape((1,))
+
+
+def _fresh_dropout_seed():
+    """Per-call int32 seed drawn from the framework generator (trace-aware
+    under to_static, like sdpa's): dropout_p > 0 with dropout_seed=None must
+    mean fresh dropout each step, not the silent fixed seed 0."""
+    from ..framework.random import next_key
+
+    return jax.random.randint(next_key(), (1,), 0, 2 ** 31 - 1, dtype=jnp.int32)
 
 
 # ---------------------------------------------------------------------------
@@ -719,9 +761,9 @@ def flash_attention_bshd(
     """Flash attention, paddle [B, S, H, D] layout. k/v may carry fewer
     heads than q (GQA/MQA, h_kv | h_q); dropout_p > 0 applies in-kernel
     upscale-in-train attention dropout keyed by `dropout_seed` (an int32
-    scalar; pass a fresh value per step)."""
+    scalar; None draws a fresh one from the framework generator)."""
     _check_heads(q, k, v)
-    seed = _as_seed(dropout_seed)
+    seed = _as_seed(dropout_seed, float(dropout_p))
     out, _ = _flash_core(q, k, v, seed, causal, sm_scale, float(dropout_p))
     return out
 
@@ -733,7 +775,7 @@ def flash_attention_bshd_lse(
     [B, H, Sq] (f32) — the ingredient ring attention needs to merge chunk
     outputs across devices. Differentiable in both outputs."""
     _check_heads(q, k, v)
-    seed = _as_seed(dropout_seed)
+    seed = _as_seed(dropout_seed, float(dropout_p))
     return _flash_core(q, k, v, seed, causal, sm_scale, float(dropout_p))
 
 

@@ -32,6 +32,7 @@ import time
 from collections import defaultdict
 
 import jax.numpy as jnp
+import numpy as _np
 
 from ..core.tensor import Tensor
 from ..ops.fused_optimizer import fused_adamw_apply, pad_to_tile
@@ -313,4 +314,19 @@ class FlatAdamWEngine:
             out.append((b["moment2"], 0.0))
             out.append((b["beta1_pow"], 1.0))
             out.append((b["beta2_pow"], 1.0))
+        return out
+
+    def digest_units(self):
+        """[(name, array)] for the guardian's cross-rank desync digest: one
+        checksum unit per flat bucket tensor, named by the bucket key so a
+        detected divergence points at a specific (dtype, wd, lr_scale) bucket
+        rather than 'somewhere in the optimizer'."""
+        out = []
+        for bi, (key, b) in enumerate(sorted(
+            self.buckets.items(), key=lambda kv: repr(kv[0])
+        )):
+            dtype, wdv, lr_scale, _need_clip = key
+            tag = f"flat_bucket:{bi}[{_np.dtype(dtype).name},wd={wdv},lrs={lr_scale}]"
+            out.append((f"{tag}:moment1", _bucket_array(b["moment1"], "moment1 bucket")))
+            out.append((f"{tag}:moment2", _bucket_array(b["moment2"], "moment2 bucket")))
         return out

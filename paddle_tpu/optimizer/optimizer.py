@@ -440,6 +440,16 @@ class Adam(Optimizer):
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False, multi_precision=True, name=None, **kw):
         self._m2_dtype = _m2_dtype_from("moment2_dtype", kw)
+        # reference kwargs that are accepted-and-inert here (tensor fusion is
+        # FLAGS_fused_optimizer-driven, not a constructor knob)
+        kw.pop("use_multi_tensor", None)
+        if kw:
+            # a misspelled kwarg (e.g. weight_dacay=) silently swallowed here
+            # trains with the default — fail loudly instead
+            raise TypeError(
+                f"{type(self).__name__}() got unexpected keyword argument(s) "
+                f"{sorted(kw)}"
+            )
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
         self._beta1 = beta1
         self._beta2 = beta2

@@ -182,6 +182,22 @@ class Executor:
             for t, new in zip(upd.accum_tensors, accs):
                 t._replace_value(new)
 
+        from ..framework import flags as _flags
+
+        if _flags._registry.get("FLAGS_check_nan_inf", False):
+            # guardian hook: the compiled replay is opaque to the per-op
+            # scan, so check the state it wrote back (updated params +
+            # optimizer accumulators) — one fused reduction, flag-gated
+            from ..framework import guardian as _guardian
+
+            touched = [
+                program._var_tensors[program.param_vars[i]]
+                for i in updated_positions
+            ]
+            for upd in program.opt_updates:
+                touched.extend(upd.accum_tensors)
+            _guardian.check_compiled_state(touched, origin="static_executor")
+
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return [Tensor(f) for f in fetches]

@@ -385,3 +385,42 @@ def test_dropout_mask_consistent_across_tilings():
         np.testing.assert_allclose(
             np.asarray(got), np.asarray(want), rtol=3e-3, atol=3e-4, err_msg=f"d{nm}"
         )
+
+
+def test_dropout_seed_none_draws_fresh_framework_seed():
+    """dropout_p > 0 with dropout_seed=None must mean fresh dropout per call
+    (drawn from the framework generator, like sdpa), not a silent fixed
+    seed 0 — and it must be deterministic under paddle.seed."""
+    import paddle_tpu as paddle
+
+    b, s, h, d = 1, 256, 2, 64
+    q = _rand((b, s, h, d), 0)
+    k = _rand((b, s, h, d), 1)
+    v = _rand((b, s, h, d), 2)
+    paddle.seed(77)
+    a = np.asarray(pk.flash_attention_bshd(q, k, v, dropout_p=0.3))
+    b_ = np.asarray(pk.flash_attention_bshd(q, k, v, dropout_p=0.3))
+    assert np.abs(a - b_).max() > 1e-4, "two None-seed calls reused a seed"
+    # and NOT the old silent seed-0 behavior
+    zero = np.asarray(
+        pk.flash_attention_bshd(q, k, v, dropout_p=0.3, dropout_seed=0)
+    )
+    assert np.abs(a - zero).max() > 1e-4
+    paddle.seed(77)
+    a2 = np.asarray(pk.flash_attention_bshd(q, k, v, dropout_p=0.3))
+    np.testing.assert_array_equal(a, a2)
+
+
+def test_as_seed_validates_loudly():
+    with pytest.raises(ValueError, match="scalar"):
+        pk._as_seed(jnp.asarray([1, 2], jnp.int32))
+    with pytest.raises(ValueError, match="int32 range"):
+        pk._as_seed(2 ** 40)
+    with pytest.raises(ValueError, match="integer"):
+        pk._as_seed(1.5)
+    with pytest.raises(ValueError, match="integer"):
+        pk._as_seed(jnp.asarray(1.5))
+    np.testing.assert_array_equal(
+        np.asarray(pk._as_seed(7)), np.asarray([7], np.int32)
+    )
+    np.testing.assert_array_equal(np.asarray(pk._as_seed(None)), [0])

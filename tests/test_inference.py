@@ -185,3 +185,23 @@ def test_incubate_distributed_fleet_shim():
     assert seq[0].weight.grad is not None
     with pytest.raises(TypeError):
         recompute_hybrid("bad-ctx", seq, x)
+
+
+def test_convert_to_mixed_precision_warns_about_embedded_weights(tmp_path):
+    """The conversion only rewrites the separate .pdiparams payload; it must
+    say so loudly instead of silently 'succeeding' on program-embedded
+    weights."""
+    import paddle_tpu.inference as inf
+
+    paddle.seed(2)
+    m = nn.Linear(4, 2)
+    m.eval()
+    prefix = str(tmp_path / "warn")
+    paddle.jit.save(m, prefix, input_spec=[static.InputSpec([2, 4], "float32")])
+    out_prefix = str(tmp_path / "warn_mixed")
+    with pytest.warns(UserWarning, match="baked into the program"):
+        inf.convert_to_mixed_precision(
+            prefix + ".pdmodel", prefix + ".pdiparams",
+            out_prefix + ".pdmodel", out_prefix + ".pdiparams",
+            mixed_precision=inf.PrecisionType.Bfloat16,
+        )

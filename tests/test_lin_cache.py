@@ -81,3 +81,37 @@ def test_lru_eviction_bounds_cache():
     finally:
         apply_mod._LIN_CACHE_CAP = old_cap
         apply_mod._lin_cache.update(baseline)
+
+
+def test_sdpa_dispatch_closure_hits_cache():
+    """The sdpa dispatch closure must reference the pallas FUNCTIONS, not the
+    module: a module in a closure cell makes _closure_sig bail, silently
+    re-tracing the vjp on every call (regression for the cached-fast-path
+    comment in nn/functional/attention.py)."""
+    import paddle_tpu.nn.functional as F
+
+    def tensors(seed):
+        out = []
+        for i in range(3):
+            t = paddle.to_tensor(
+                np.random.RandomState(seed + i).randn(1, 8, 2, 4).astype(np.float32)
+            )
+            t.stop_gradient = False
+            out.append(t)
+        return out
+
+    q, k, v = tensors(0)
+    F.scaled_dot_product_attention(q, k, v)
+    keys_after_first = set(apply_mod._lin_cache.keys())
+    sdpa_keys = [k_ for k_ in keys_after_first if k_[0] == "scaled_dot_product_attention"]
+    assert sdpa_keys, "sdpa closure is not cacheable (cache key is None)"
+
+    q2, k2, v2 = tensors(10)
+    out = F.scaled_dot_product_attention(q2, k2, v2)
+    assert set(apply_mod._lin_cache.keys()) == keys_after_first, (
+        "second sdpa call with identical shapes must hit the cached "
+        "linearization, not add a new entry"
+    )
+    loss = out.sum()
+    loss.backward()
+    assert q2.grad is not None  # the cached pullback still differentiates

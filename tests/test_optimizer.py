@@ -418,3 +418,21 @@ def test_adamw_bf16_second_moment():
 
     with pytest.raises(ValueError):
         paddle.optimizer.AdamW(1e-2, parameters=model2.parameters(), moment2_dtype="fp8")
+
+
+def test_adam_rejects_misspelled_kwargs():
+    """**kw must not swallow typos: anything left after popping
+    moment2_dtype raises TypeError (a silent weight_dacay= would train with
+    the default and nobody would know)."""
+    ps = [nn.Parameter(np.zeros((2, 2), np.float32))]
+    with pytest.raises(TypeError, match="weight_dacay"):
+        paddle.optimizer.AdamW(0.01, parameters=ps, weight_dacay=0.1)
+    with pytest.raises(TypeError, match="beta3"):
+        paddle.optimizer.Adam(0.01, parameters=ps, beta3=0.5)
+    # the documented extra kwargs still work: moment2_dtype (ours) and
+    # use_multi_tensor (reference Paddle's, accepted-and-inert here)
+    opt = paddle.optimizer.Adam(0.01, parameters=ps, moment2_dtype="bfloat16")
+    import jax.numpy as jnp
+
+    assert opt._m2_dtype == jnp.bfloat16
+    paddle.optimizer.Adam(0.01, parameters=ps, use_multi_tensor=True)

@@ -72,6 +72,11 @@ def test_weight_norm_and_remove():
 
 
 def test_spectral_norm_limits_sigma():
+    # pin the generator: the power-iteration init vector comes from the
+    # global RNG, and the 0.05 tolerance is tight enough that an unlucky
+    # stream position (which depends on every test that ran before) fails —
+    # the test must not hinge on suite ordering
+    paddle.seed(0)
     net = paddle.nn.Linear(6, 6)
     net.weight._replace_value(net.weight._value * 50.0)  # huge spectral norm
     nn_utils.spectral_norm(net, "weight", n_power_iterations=5)
