@@ -162,6 +162,7 @@ from . import hapi  # noqa: E402,F401
 from . import hub  # noqa: E402,F401
 from . import profiler  # noqa: E402,F401
 from . import telemetry  # noqa: E402,F401
+from . import cost_model  # noqa: E402,F401
 from . import distribution  # noqa: E402,F401
 from . import quantization  # noqa: E402,F401
 from . import audio  # noqa: E402,F401

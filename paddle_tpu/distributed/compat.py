@@ -216,6 +216,15 @@ def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
         if time.monotonic() > deadline:
             raise TimeoutError("gloo_init_parallel_env: ranks did not all join")
         time.sleep(0.01)
+    # every rank passes this line within one store round-trip of the last
+    # joiner — record the (perf_ns, unix_ns) pair the trace merge uses to
+    # align per-rank host-tracer clocks into one timeline
+    try:
+        from ..profiler import trace_merge as _trace_merge
+
+        _trace_merge.note_rendezvous(rank_id, rank_num)
+    except Exception:
+        pass
     return store
 
 

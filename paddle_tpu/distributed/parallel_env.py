@@ -70,6 +70,18 @@ def init_parallel_env():
     from . import collective
 
     collective._ensure_world_group()
+    # rendezvous clock sync for the multi-rank trace merge: every process
+    # records its (perf_ns, unix_ns) pair here, right after the coordinated
+    # initialize — profiler exports embed it so trace_merge can align lanes
+    try:
+        from ..profiler import trace_merge as _trace_merge
+
+        _trace_merge.note_rendezvous(
+            int(os.environ.get("PADDLE_TRAINER_ID", os.environ.get("PADDLE_RANK", "0"))),
+            nprocs,
+        )
+    except Exception:
+        pass
     return ParallelEnv()
 
 

@@ -218,6 +218,15 @@ class FlightRecorder:
             "pid": os.getpid(),
             "records": self.records(),
         }
+        # "was this an OOM-adjacent step": the HBM high-water mark + the
+        # newest compiled-program attribution ride every crash dump
+        try:
+            from ..profiler import perf_attribution as _pa
+
+            payload["peak_hbm_bytes"] = _pa.watermark().get("peak_hbm_bytes")
+            payload["perf_report"] = _pa.snapshot_for_crash()
+        except Exception:
+            pass  # attribution must never mask the dump
         with open(path, "w") as f:
             json.dump(payload, f, indent=1, default=str)
             f.write("\n")
@@ -650,6 +659,14 @@ class TrainingGuardian:
         kind = _anomaly_kind(mask)
         policy = self.policy
         step = int(self.optimizer._step_count._raw())
+        # anomaly-time HBM probe: OOM-adjacency is exactly what the crash
+        # dump needs to answer; no-op when telemetry is off
+        try:
+            from ..profiler import perf_attribution as _pa
+
+            wm = _pa.sample_watermark(tag=f"anomaly:{kind}", force=True)
+        except Exception:
+            wm = None
         if self.scaler is not None:
             # the skipped step never reaches scaler.step, which is what
             # normally clears the per-step unscale bookkeeping — clear it
@@ -663,6 +680,7 @@ class TrainingGuardian:
         self.recorder.record_event(
             "anomaly", anomaly=kind, policy=policy, step=step,
             loss=_loss_float(loss_raw), grad_norm=grad_norm,
+            peak_hbm_bytes=(wm or {}).get("peak_hbm_bytes"),
         )
         if policy == "skip_step":
             self.skipped_steps += 1
@@ -774,12 +792,19 @@ class TrainingGuardian:
     def _after_clean_step(self, loss_raw, grad_norm) -> None:
         opt = self.optimizer
         step = int(opt._step_count._raw())
+        try:
+            from ..profiler import perf_attribution as _pa
+
+            wm = _pa.watermark()
+        except Exception:
+            wm = {}
         self.recorder.record_step(
             step,
             loss=_loss_float(loss_raw),
             grad_norm=grad_norm,
             lr=float(opt.get_lr()),
             collectives=self._collective_deltas(),
+            peak_hbm_bytes=wm.get("peak_hbm_bytes"),
         )
         interval = self.lkg_interval
         if interval > 0 and step % interval == 0:
@@ -847,6 +872,12 @@ def check_compiled_state(tensors, origin: str) -> None:
     from .. import telemetry as _tm
 
     kind = _anomaly_kind(mask)
+    try:
+        from ..profiler import perf_attribution as _pa
+
+        _pa.sample_watermark(tag=f"anomaly:{kind}", force=True)
+    except Exception:
+        pass
     if _tm.enabled():
         _tm.counter(
             "paddle_tpu_guardian_anomalies_total",

@@ -292,7 +292,25 @@ class _CompiledEntry:
                 ]
                 self.grad_tensors.extend(new_grad_ts)
                 if not missed and not new_grad_ts:
-                    self.jitted = traced.lower().compile()
+                    import time as _time
+
+                    t0 = _time.perf_counter()
+                    lowered = traced.lower()
+                    self.jitted = lowered.compile()
+                    # attribution capture at the one place the whole train
+                    # step exists as a compiled XLA program: FLOPs, HBM
+                    # bytes, memory footprint, compile time (telemetry-gated
+                    # inside record_compiled; never raises)
+                    from ..profiler import perf_attribution as _pa
+
+                    _pa.record_compiled(
+                        "to_static",
+                        getattr(self.fn, "__name__", "<fn>"),
+                        lowered=lowered,
+                        compiled=self.jitted,
+                        compile_seconds=_time.perf_counter() - t0,
+                        extra={"n_state": len(self.state)},
+                    )
                     break
                 self.state.extend(missed)
             else:
@@ -311,6 +329,12 @@ class _CompiledEntry:
                     t.stop_gradient = not t.trainable
         for t, v in zip(self.grad_tensors, new_grads):
             t.grad = Tensor(v) if v is not None else None
+        # compiled-step boundary: Optimizer.step's HBM probe never fires
+        # inside the replay (the step is python-free), so sample here —
+        # no-op when telemetry is off
+        from ..profiler import perf_attribution as _pa
+
+        _pa.sample_watermark(tag="to_static_step")
         from ..framework import flags as _flags
 
         if _flags._registry.get("FLAGS_check_nan_inf", False):

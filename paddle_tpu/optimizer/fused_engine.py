@@ -165,7 +165,56 @@ class FlatAdamWEngine:
                 int(b["moment1"]._value.nbytes + b["moment2"]._value.nbytes)
                 for b in self.buckets.values()
             ))
+            # after the build-time observe: the attribution AOT compile must
+            # not inflate the bucket-build histogram
+            self._record_kernel_attribution(key, bucket)
         return bucket
+
+    def _record_kernel_attribution(self, key, bucket):
+        """Capture the bucket kernel's XLA cost/memory analysis into the
+        attribution layer: one AOT lower+compile of `fused_adamw_apply` at
+        the bucket's exact shapes/dtypes. Runs only at bucket (re)build and
+        only under telemetry — a one-time compile of a flat elementwise
+        program, paid so perf_report can attribute the optimizer's HBM
+        traffic per bucket. Best-effort: failure never touches the step."""
+        try:
+            import time
+
+            import jax
+
+            from ..profiler import perf_attribution as _pa
+
+            opt = self.opt
+            dtype, wdv, _lr_scale, _need_clip = key
+            n_pad = bucket["n_pad"]
+            m2_dtype = bucket["moment2"]._value.dtype
+            decoupled = opt._wd_mode == "decoupled"
+
+            def apply_fn(p, m, v, g, lr, c1, c2):
+                return fused_adamw_apply(
+                    p, m, v, g, lr=lr, clip_scale=1.0, c1=c1, c2=c2, seed=0,
+                    beta1=opt._beta1, beta2=opt._beta2, eps=opt._eps,
+                    wd=wdv, decoupled=decoupled,
+                )
+
+            flat = lambda d: jax.ShapeDtypeStruct((n_pad,), d)  # noqa: E731
+            scalar = jax.ShapeDtypeStruct((), jnp.float32)
+            t0 = time.perf_counter()
+            lowered = jax.jit(apply_fn).lower(
+                flat(dtype), flat(jnp.float32), flat(m2_dtype),
+                flat(jnp.float32), scalar, scalar, scalar,
+            )
+            compiled = lowered.compile()
+            _pa.record_compiled(
+                "fused_optimizer",
+                f"bucket[{_np.dtype(dtype).name},n={n_pad}]",
+                lowered=lowered,
+                compiled=compiled,
+                compile_seconds=time.perf_counter() - t0,
+                extra={"n_elems": n_pad, "m2_dtype": str(_np.dtype(m2_dtype))},
+            )
+        except Exception:
+            pass
 
     def _bucket_for(self, key, plist):
         ids = tuple(id(p) for p, _ in plist)

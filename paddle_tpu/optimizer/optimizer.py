@@ -123,6 +123,11 @@ class Optimizer:
             "paddle_tpu_optimizer_step_seconds",
             "host wall time of Optimizer.step", ("optimizer",),
         ).labels(optimizer=cls).observe(time.perf_counter() - t0)
+        # step-boundary HBM probe: the live-bytes high-water mark the perf
+        # report / flight recorder cite (metadata walk, no device sync)
+        from ..profiler import perf_attribution as _pa
+
+        _pa.sample_watermark(tag="optimizer_step")
         return out
 
     @no_grad()

@@ -264,7 +264,17 @@ class Profiler:
                 jax.profiler.stop_trace()
             finally:
                 self._device_trace_active = False
-        self.profiler_result = StatisticData(events, device_trace_dir=self._trace_dir)
+        # snapshot the live-HBM census at window close so MemoryView reports
+        # the memory state of the steps just profiled
+        try:
+            from . import perf_attribution as _pa
+
+            census = _pa.live_array_census(set_gauges=False)
+        except Exception:
+            census = None
+        self.profiler_result = StatisticData(
+            events, device_trace_dir=self._trace_dir, memory_census=census
+        )
 
     # ---- reporting ----
     def export(self, path: str, format: str = "json"):
@@ -283,7 +293,7 @@ class Profiler:
         includes OperatorView/KernelView/OverView prints it."""
         if self.profiler_result is None:
             return
-        from .profiler_statistic import _build_distributed_table
+        from .profiler_statistic import _build_distributed_table, _build_memory_table
 
         if views is not None and isinstance(views, SummaryView):
             views = [views]
@@ -291,12 +301,21 @@ class Profiler:
             {SummaryView.OperatorView, SummaryView.KernelView, SummaryView.OverView}.intersection(views)
         )
         dist_wanted = views is None or SummaryView.DistributedView in views
+        mem_wanted = views is None or SummaryView.MemoryView in views
         if op_wanted:
             print(_build_summary_table(self.profiler_result, sorted_by=sorted_by, time_unit=time_unit))
         if dist_wanted:
             dist = _build_distributed_table(self.profiler_result, time_unit=time_unit)
             if dist:
                 print(dist)
+        if mem_wanted and getattr(self.profiler_result, "memory_census", None):
+            from . import perf_attribution as _pa
+
+            mem = _build_memory_table(
+                self.profiler_result.memory_census, watermark=_pa.watermark()
+            )
+            if mem:
+                print(mem)
 
 
 def load_profiler_result(filename: str):

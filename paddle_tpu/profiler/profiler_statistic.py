@@ -46,9 +46,12 @@ class StatisticData:
     """Collected result for one record window: host events + the directory
     holding the XLA xplane protobuf (device timeline, open with XProf)."""
 
-    def __init__(self, host_events, device_trace_dir=None):
+    def __init__(self, host_events, device_trace_dir=None, memory_census=None):
         self.host_events = list(host_events)
         self.device_trace_dir = device_trace_dir
+        # live-HBM census (perf_attribution.live_array_census) captured at
+        # collect time; feeds the MemoryView summary table
+        self.memory_census = memory_census
 
     def event_summaries(self):
         table = {}
@@ -75,6 +78,17 @@ class StatisticData:
                 entry["args"] = dict(ev.args)
             events.append(entry)
         meta = {"device_trace_dir": self.device_trace_dir}
+        # rank + rendezvous clock-sync pair: what trace_merge needs to align
+        # this export with the other ranks' on one wall clock
+        try:
+            from .trace_merge import clock_sync
+
+            cs = clock_sync()
+            if cs:
+                meta["rank"] = cs["rank"]
+                meta["clock_sync"] = cs
+        except Exception:
+            pass
         return {"traceEvents": events, "metadata": meta}
 
     def comm_events(self):
@@ -157,6 +171,60 @@ def _build_distributed_table(data: StatisticData, time_unit="ms"):
             f"{r.avg_ns / div:>12.4f}{r.max_ns / div:>12.4f}{r.bytes:>14}"
         )
     lines.append("-" * (name_w + grp_w + 60))
+    return "\n".join(lines)
+
+
+def _human_bytes(n):
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024.0 or unit == "GiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024.0
+
+
+def _build_memory_table(census, watermark=None):
+    """MemoryView parity (reference profiler_statistic.py memory summary):
+    live device bytes by dtype and by annotated module from the HBM census,
+    plus the process high-water mark."""
+    if not census:
+        return ""
+    rows = sorted(
+        census.get("by_dtype", {}).items(),
+        key=lambda kv: kv[1]["bytes"], reverse=True,
+    )
+    mod_rows = sorted(
+        census.get("by_module", {}).items(),
+        key=lambda kv: kv[1]["bytes"], reverse=True,
+    )
+    name_w = max(
+        [len(k) for k, _ in rows] + [len(k) for k, _ in mod_rows] + [18]
+    ) + 2
+    lines = []
+    lines.append("-" * (name_w + 34))
+    lines.append("Memory Summary (live device arrays)")
+    lines.append(f"{'Dtype / Module':<{name_w}}{'Arrays':>10}{'Bytes':>14}")
+    lines.append("=" * (name_w + 34))
+    for dt, st in rows:
+        lines.append(
+            f"{dt:<{name_w}}{st['count']:>10}{_human_bytes(st['bytes']):>14}"
+        )
+    if mod_rows:
+        lines.append("-" * (name_w + 34))
+        for m, st in mod_rows:
+            lines.append(
+                f"{m:<{name_w}}{st['count']:>10}{_human_bytes(st['bytes']):>14}"
+            )
+    lines.append("=" * (name_w + 34))
+    lines.append(
+        f"{'TOTAL':<{name_w}}{census.get('count', 0):>10}"
+        f"{_human_bytes(census.get('bytes', 0)):>14}"
+    )
+    if watermark and watermark.get("peak_hbm_bytes"):
+        lines.append(
+            f"High-water mark: {_human_bytes(watermark['peak_hbm_bytes'])} "
+            f"(tag={watermark.get('peak_tag')})"
+        )
+    lines.append("-" * (name_w + 34))
     return "\n".join(lines)
 
 

@@ -346,7 +346,7 @@ class Executor:
 
         compiled = jax.jit(replay)
         if telemetry_on:
-            compiled = self._timed_first_call(compiled)
+            compiled = self._attributed_compile(compiled, program)
         program._compiled[key] = compiled
         return compiled
 
@@ -390,35 +390,77 @@ class Executor:
         return [M2, V2, t2]
 
     @staticmethod
-    def _timed_first_call(compiled):
-        """Observe trace+XLA-compile wall time: jax.jit is lazy, so the real
-        compile cost lands on the first invocation — time that one."""
-        import threading
+    def _attributed_compile(jitted, program):
+        """AOT (lower -> compile) per input-shape signature instead of the
+        lazy jit first call, so the replay program's XLA `cost_analysis()` /
+        `memory_analysis()` can be captured into the attribution layer at
+        compile time (perf_attribution.record_compiled) along with the
+        compile wall time. Shape polymorphism is preserved: a new signature
+        lowers again, exactly like jit retracing. The telemetry gate is
+        re-checked at call time — disabled means record NOTHING and run the
+        plain jitted path; any AOT failure (aval drift, backend without the
+        AOT API) falls back to the jitted callable permanently."""
         import time
 
-        done = [False]
-        done_lock = threading.Lock()
+        cache = {}
+        fallback = [False]
 
-        def wrapper(*args, **kwargs):
-            if done[0]:
-                return compiled(*args, **kwargs)
-            t0 = time.perf_counter()
-            out = compiled(*args, **kwargs)
-            dt = time.perf_counter() - t0
-            with done_lock:
-                first, done[0] = not done[0], True
-            from .. import telemetry as _tm
+        def wrapper(feed_arrays, param_arrays, accum_arrays, lr_arrays):
+            args = (feed_arrays, param_arrays, accum_arrays, lr_arrays)
+            if fallback[0]:
+                return jitted(*args)
+            # key on the FEEDS only: param/accum/lr shapes are fixed for a
+            # given program structure (a structure change lands a different
+            # outer cache entry), so walking them per call would tax every
+            # step O(n_params) for an always-identical suffix. If that
+            # invariant ever breaks, the AOT executable rejects the call
+            # (TypeError below) and the program falls back to plain jit.
+            key = tuple((tuple(a.shape), str(a.dtype)) for a in feed_arrays)
+            exe = cache.get(key)
+            if exe is None:
+                from .. import telemetry as _tm
 
-            # re-check the gate at observe time: telemetry may have been
-            # disabled between _compile and the first run, and the disabled
-            # contract is "record nothing"
-            if first and _tm.enabled():
+                if not _tm.enabled():
+                    # disabled contract: record nothing, compile nothing
+                    # extra — but already-compiled signatures (below) keep
+                    # serving their AOT executables
+                    return jitted(*args)
+                try:
+                    t0 = time.perf_counter()
+                    lowered = jitted.lower(*args)
+                    exe = lowered.compile()
+                    dt = time.perf_counter() - t0
+                except Exception:
+                    fallback[0] = True
+                    return jitted(*args)
+                cache[key] = exe
                 _tm.histogram(
                     "paddle_tpu_executor_compile_seconds",
                     "wall time of a static Executor program's first "
                     "(tracing + XLA compile) run",
                 ).observe(dt)
-            return out
+                from ..profiler import perf_attribution as _pa
+
+                _pa.record_compiled(
+                    "static_executor",
+                    f"replay[{len(program.ops)}ops,{len(feed_arrays)}feeds]",
+                    lowered=lowered,
+                    compiled=exe,
+                    compile_seconds=dt,
+                    # lets CostModel.profile_measure find THIS program's
+                    # record on a warm cache instead of the global newest
+                    extra={"program_id": id(program)},
+                )
+            try:
+                return exe(*args)
+            except TypeError:
+                # aval mismatch (weak-type drift, ...) the AOT executable
+                # rejects but jit handles by retracing — our shape/dtype key
+                # is evidently too coarse for this program, so stop AOT'ing
+                # it. Anything else (OOM, a real in-program error) must
+                # propagate, NOT re-execute the whole program via jit.
+                fallback[0] = True
+                return jitted(*args)
 
         return wrapper
 
