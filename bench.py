@@ -50,6 +50,13 @@ budget forfeits the expensive tail, never the whole record. r05 lost every
 number it measured to exactly this failure mode (`BENCH_r05.json` rc=124,
 parsed=null).
 
+Round 9: the driver retains only a short stdout TAIL, and r5's retry
+chatter pushed the last snapshot line out of it — so bench now also traps
+SIGTERM (what the driver's timeout sends first) and re-emits the terminal
+snapshot as the process's very last line, with still-pending configs
+marked `skipped:sigterm`. A torn capture now requires an outright SIGKILL
+with no grace period.
+
 Round 6 headline regime: the seq-128 config runs with
 FLAGS_fused_optimizer=1 (flat-bucket one-pass Pallas AdamW,
 ops/fused_optimizer.py) and moment2_dtype='bfloat16' (stochastic-rounding
@@ -632,14 +639,21 @@ class _Snapshot:
         self.result["detail"]["configs"][key] = status
         self.emit()
 
-    def finalize_pending(self, why="deadline"):
+    def finalize_pending(self, why="deadline", signal_safe=False):
         """Terminal emit: anything still pending (only possible if a config
-        path escaped its own skip handling) becomes an explicit skip."""
+        path escaped its own skip handling) becomes an explicit skip.
+        signal_safe: emit via raw os.write — print() on the buffered stdout
+        is not reentrant (RuntimeError if the signal landed inside another
+        print, and it could splice into a half-written line); the leading
+        newline guarantees the snapshot is a complete line of its own."""
         for k, st in self.result["detail"]["configs"].items():
             if st == "pending":
                 self.result["detail"]["configs"][k] = f"skipped:{why}"
                 self.result["detail"].setdefault(k, {"skipped": why})
-        self.emit()
+        if signal_safe:
+            os.write(1, b"\n" + json.dumps(self.result).encode() + b"\n")
+        else:
+            self.emit()
 
     def emit(self):
         print(json.dumps(self.result), flush=True)
@@ -674,6 +688,24 @@ def main():
         return os.environ.get(name, "").lower() in ("1", "true", "yes")
 
     snap = _Snapshot()
+
+    def _on_sigterm(signum, frame):
+        # The driver's timeout delivers SIGTERM (then KILL after a grace
+        # period) and retains only a short stdout TAIL — r5's last snapshot
+        # line was pushed out of that tail by two minutes of retry chatter,
+        # so parsed=null despite four valid lines earlier in the stream.
+        # Make the terminal snapshot the process's very last output, then
+        # exit immediately.
+        snap.finalize_pending(why="sigterm", signal_safe=True)
+        os._exit(0)
+
+    import signal
+
+    try:
+        signal.signal(signal.SIGTERM, _on_sigterm)
+    except (ValueError, OSError):  # non-main thread / exotic platform
+        pass
+
     detail = snap.result["detail"]
     fused, m2_bf16 = _fused_opt_regime()
     detail["optimizer"] = {

@@ -14,6 +14,8 @@ capture.
 """
 from __future__ import annotations
 
+import itertools
+import weakref
 from typing import Callable, List, Optional, Sequence, Tuple
 
 import jax
@@ -23,6 +25,57 @@ from jax import numpy as jnp
 from . import state
 
 float0 = jax.dtypes.float0
+
+# ---------------------------------------------------------------------------
+# backward-end hooks: observers (grad reducers) that must act once per
+# run_backward AFTER every leaf has its merged grad — per-leaf hooks alone
+# cannot see "this backward is over", which a bucket with a never-used param
+# needs in order to dispatch its stragglers (reference EagerReducer marks
+# unused params ready at the end of backward).
+# ---------------------------------------------------------------------------
+
+_backward_end_hooks: dict = {}
+_backward_end_ids = itertools.count()
+_grad_collection_depth = 0
+
+
+def grad_collection_active() -> bool:
+    """True while a walk collects into a custom accumulate_fn
+    (paddle.autograd.grad / double-backward inner walks) instead of
+    accumulating training grads into .grad — observers that treat every
+    backward as a training cycle (grad reducers) must sit those out."""
+    return _grad_collection_depth > 0
+
+
+class _BackwardEndHookHandle:
+    __slots__ = ("_key",)
+
+    def __init__(self, key):
+        self._key = key
+
+    def remove(self):
+        _backward_end_hooks.pop(self._key, None)
+
+
+def register_backward_end_hook(fn) -> _BackwardEndHookHandle:
+    """Call fn(completed: bool) at the end of every run_backward —
+    completed=False means the walk raised and leaf grads may be partial,
+    so observers must drop (not dispatch) their per-cycle state. A bound
+    method is held weakly (its owner stays collectable); any other
+    callable is held strongly until the handle is removed."""
+    entry = weakref.WeakMethod(fn) if hasattr(fn, "__self__") else fn
+    key = next(_backward_end_ids)
+    _backward_end_hooks[key] = entry
+    return _BackwardEndHookHandle(key)
+
+
+def _fire_backward_end_hooks(completed: bool):
+    for key, entry in list(_backward_end_hooks.items()):
+        fn = entry() if isinstance(entry, weakref.WeakMethod) else entry
+        if fn is None:
+            _backward_end_hooks.pop(key, None)
+        else:
+            fn(completed)
 
 
 class Edge:
@@ -120,6 +173,33 @@ def run_backward(
       should be reported via watch_fn(key, raw_cotangent) — this is how
       paddle.grad supports non-leaf input tensors (general_grad.h analog).
     """
+    # backward-end hooks fire on EVERY exit: completed=False on an aborted
+    # walk (a leaf hook raising, backward-twice) so observers drop their
+    # per-cycle state instead of leaking it into — or dispatching partial
+    # grads during — the next backward. A grad-COLLECTION walk (custom
+    # accumulate_fn: paddle.autograd.grad, double-backward inners) is not
+    # a training cycle at all: no end hooks, and grad_collection_active()
+    # is raised so per-leaf observers sit it out too.
+    global _grad_collection_depth
+    collection = accumulate_fn is not None
+    if collection:
+        _grad_collection_depth += 1
+    try:
+        _run_backward_walk(tensors, grad_tensors, retain_graph,
+                           accumulate_fn, watches, watch_fn)
+    except BaseException:
+        if not collection:
+            _fire_backward_end_hooks(False)
+        raise
+    finally:
+        if collection:
+            _grad_collection_depth -= 1
+    if not collection:
+        _fire_backward_end_hooks(True)
+
+
+def _run_backward_walk(tensors, grad_tensors, retain_graph, accumulate_fn,
+                       watches, watch_fn):
     from .tensor import Tensor  # cycle
 
     # --- seed holders ---
@@ -151,7 +231,13 @@ def run_backward(
         roots.append(node)
 
     # --- dependency counting: how many pending consumer-edges feed each node ---
+    # Leaf edges are counted too: a leaf consumed by several ops (tied
+    # embedding, shared projection) receives one cotangent per edge, but its
+    # hooks must observe the MERGED gradient exactly once per backward
+    # (paddle's AccumulateGrad semantics) — per-edge hook fires would hand
+    # observers (grad reducers, user hooks) partial gradients.
     indeg: dict = {}
+    leaf_pending: dict = {}  # id(leaf) -> [tensor, edges_left, merged_cot]
     visited = set()
     stack = list(dict.fromkeys(roots))
     order_check = list(stack)
@@ -165,6 +251,9 @@ def run_backward(
                 indeg[e.node] = indeg.get(e.node, 0) + 1
                 if e.node not in visited:
                     stack.append(e.node)
+            elif e.is_leaf():
+                ent = leaf_pending.setdefault(id(e.leaf), [e.leaf, 0, None])
+                ent[1] += 1
 
     ready = [n for n in dict.fromkeys(order_check) if indeg.get(n, 0) == 0]
     # nodes seeded but also consumed by other seeded nodes wait for their deps
@@ -212,8 +301,16 @@ def run_backward(
             if not _is_meaningful(c):
                 c = None
             if e.is_leaf():
+                ent = leaf_pending.get(id(e.leaf))
+                if ent is None:  # pragma: no cover - leaf edge outside the walk
+                    if c is not None and not e.leaf.stop_gradient:
+                        _leaf_accumulate(e.leaf, c, accumulate_fn)
+                    continue
                 if c is not None and not e.leaf.stop_gradient:
-                    _leaf_accumulate(e.leaf, c, accumulate_fn)
+                    ent[2] = _accumulate(ent[2], c)
+                ent[1] -= 1
+                if ent[1] == 0 and ent[2] is not None:
+                    _leaf_accumulate(ent[0], ent[2], accumulate_fn)
             elif e.node is not None:
                 if c is not None:
                     pslots = holders.setdefault(e.node, [None] * len(e.node.out_avals))

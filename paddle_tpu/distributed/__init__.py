@@ -48,6 +48,7 @@ from .collective import (  # noqa: F401
     wait,
 )
 from .parallel import DataParallel, spawn  # noqa: F401
+from .grad_reducer import AsyncBucketedGradReducer  # noqa: F401
 from . import fleet  # noqa: F401
 from .fleet.recompute import recompute  # noqa: F401
 from .fleet.meta_parallel.parallel_layers.mp_layers import split  # noqa: F401

@@ -31,6 +31,14 @@ from .....nn.layer import Layer
 from ...base.topology import get_hybrid_communicate_group
 
 
+def _collective_matmul():
+    # lazy: fleet.utils.__init__ imports sequence_parallel_utils which
+    # imports THIS module — a top-level import here would cycle
+    from ...utils import collective_matmul
+
+    return collective_matmul
+
+
 def _mp_mesh_axis():
     hcg = get_hybrid_communicate_group()
     if hcg is None:
@@ -110,6 +118,12 @@ class ColumnParallelLinear(Layer):
             self.bias = None
 
     def forward(self, x):
+        _cm = _collective_matmul()
+        sub = _cm.enabled()
+        if sub and self.gather_output and _cm.usable(x, self.weight, self._mesh, self._axis, "mm_ag_cols"):
+            # decomposed mm→ag: row-chunked local matmul, each chunk's
+            # column all-gather overlaps the next chunk's matmul
+            return _cm.matmul_ag_cols(x, self.weight, self.bias, self._mesh, self._axis, sub)
         out = F.linear(x, self.weight, self.bias)
         if self.gather_output:
             out = _constrain(out, P(*([None] * len(out.shape))), self._mesh)
@@ -151,6 +165,13 @@ class RowParallelLinear(Layer):
             self.bias = None
 
     def forward(self, x):
+        _cm = _collective_matmul()
+        sub = _cm.enabled()
+        if sub and self.input_is_parallel and _cm.usable(x, self.weight, self._mesh, self._axis, "mm_ar"):
+            # decomposed mm→ar: the partial-sum all-reduce is split into
+            # per-column-chunk psums, each overlapping the next chunk's
+            # matmul (the bias stays post-reduction, reference :541)
+            return _cm.matmul_ar(x, self.weight, self.bias, self._mesh, self._axis, sub)
         if self.input_is_parallel:
             x = _constrain(x, P(*([None] * (len(x.shape) - 1) + [self._axis])), self._mesh)
         out = F.linear(x, self.weight, self.bias)

@@ -24,6 +24,7 @@ from ....nn.initializer import Constant, XavierUniform
 from ....nn.layer import Layer
 from ..base.topology import get_hybrid_communicate_group
 from ..meta_parallel.parallel_layers.mp_layers import ColumnParallelLinear, RowParallelLinear
+from . import collective_matmul as _cm
 
 
 def _mesh():
@@ -109,12 +110,58 @@ def is_sequence_parallel_parameter(param):
 
 
 def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1, fuse_sequence_parallel_allreduce=False):
-    """Reference :192 — per-param grad allreduce over mp for params marked
+    """Reference :192 — grad allreduce over mp for params marked
     sequence-parallel (LayerNorm weights etc. that see seq-sharded
-    activations). Under GSPMD those grads are computed from the sharded seq
-    axis by a contraction, so the reduction is already inside backward; this
-    is a no-op kept for API parity."""
-    return None
+    activations).
+
+    Under GSPMD those grads arrive already SUMMED over mp (the contraction
+    over the sharded seq axis emits the reduction inside backward), so the
+    hook reduces with AVG — mathematically the identity on synchronized
+    grads, which makes the registration idempotent here while exercising
+    the exact bucketed dispatch a multi-process backend needs. With
+    fuse_sequence_parallel_allreduce=True the marked params go through ONE
+    AsyncBucketedGradReducer (size/dtype buckets, reduce dispatched as each
+    bucket's backward completes); False registers per-param hooks — one
+    collective per param, the reference's unfused shape. Both paths honor
+    accumulation_steps: the unfused hooks count arrivals per param and
+    reduce the ACCUMULATED grad only on each Nth backward.
+
+    Returns the AsyncBucketedGradReducer on both paths (so callers can
+    flush()/no_sync()/stop() uniformly), or None when nothing is marked.
+    """
+    from ...grad_reducer import AsyncBucketedGradReducer
+
+    # frozen marked params need no grad sync (and the reducer skips
+    # stop_gradient params anyway — counting them would desync the
+    # per-param-bucket assertion below)
+    params = [p for p in model.parameters()
+              if is_sequence_parallel_parameter(p) and not p.stop_gradient]
+    if not params:
+        return None
+    hcg = get_hybrid_communicate_group()
+    group = hcg.get_model_parallel_group() if hcg is not None else None
+    # re-registration must not stack hook sets (same hazard DataParallel
+    # guards against): stop the previous reducer before attaching a new one
+    prev = getattr(model, "_seq_parallel_grad_reducer", None)
+    if prev is not None:
+        prev.stop()
+    if fuse_sequence_parallel_allreduce:
+        reducer = AsyncBucketedGradReducer(
+            params, group=group, op="avg",
+            accumulation_steps=accumulation_steps,
+        )
+    else:
+        # unfused: same reducer machinery, bucket cap 0 → every param its
+        # own bucket → one collective per param (the reference's unfused
+        # shape) with a single maintained accumulate/dispatch/unstack
+        # implementation
+        reducer = AsyncBucketedGradReducer(
+            params, group=group, op="avg",
+            accumulation_steps=accumulation_steps, bucket_bytes=0,
+        )
+        assert len(reducer.bucket_sizes) == len(params)
+    model._seq_parallel_grad_reducer = reducer
+    return reducer
 
 
 class ColumnSequenceParallelLinear(ColumnParallelLinear):
@@ -130,6 +177,12 @@ class ColumnSequenceParallelLinear(ColumnParallelLinear):
         )
 
     def forward(self, x):
+        sub = _cm.enabled()
+        if sub and not self.gather_output and _cm.usable(x, self.weight, self._mesh, self._axis, "ag_mm"):
+            # decomposed ag→mm: each ring step matmuls the seq shard it
+            # holds while the next shard's ppermute is in flight — the
+            # all-gather never materializes as a standalone blocking op
+            return _cm.ag_matmul(x, self.weight, self.bias, self._mesh, self._axis, sub)
         x = AllGatherOp.apply(x)
         return super().forward(x)
 
@@ -147,5 +200,10 @@ class RowSequenceParallelLinear(RowParallelLinear):
         )
 
     def forward(self, x):
+        sub = _cm.enabled()
+        if sub and self.input_is_parallel and _cm.usable(x, self.weight, self._mesh, self._axis, "mm_rs"):
+            # decomposed mm→rs: the partial-sum accumulator rides the ring;
+            # step k's block matmul overlaps step k-1's ppermute
+            return _cm.matmul_rs(x, self.weight, self.bias, self._mesh, self._axis, sub)
         out = super().forward(x)
         return ReduceScatterOp.apply(out)

@@ -1,0 +1,352 @@
+"""Async bucketed gradient reduction (the EagerReducer rebuilt for overlap).
+
+Reference parity: paddle/fluid/distributed/collective/reducer.cc — the C++
+EagerReducer that groups parameters into size-capped buckets and launches a
+NCCL all-reduce for each bucket as soon as every grad in it has been
+produced by backward, so the reduction of early buckets overlaps the rest
+of backward.
+
+TPU-native design: XLA dispatch is asynchronous, so "launch and overlap" is
+`collective.all_reduce(..., sync_op=False)` on the bucket's flattened grad
+— the host returns immediately and the remaining eager backward keeps
+dispatching compute while the reduce executes. Under this repo's
+single-controller SPMD DataParallel the cross-shard sum is ALREADY inside
+backward (a replicated-param grad contracts the dp-sharded batch axis), so
+the default reduce op is AVG: mathematically the identity on synchronized
+grads, which makes the reducer idempotent here while exercising the exact
+bucket/dispatch schedule a per-process backend (multi-host gloo ranks)
+needs — and making desynchronized grads converge instead of doubling.
+
+Bucket layout can be reused from the fused optimizer: pass `optimizer=`
+and any live `FlatAdamWEngine` bucket index maps (param → (offset, size,
+shape) in a flat bucket) become the reducer's buckets, so the grad flat
+buffer layout matches the optimizer's update layout exactly — one
+flatten serves both.
+
+Ordering contract with the guardian/GradScaler: reduction happens on the
+SCALED grads during backward (reduction is linear, so scale · avg(g) =
+avg(scale · g)); `flush()` dispatches any incomplete buckets and must run
+before anything READS grads for a global decision — TrainingGuardian calls
+it before its grad-norm/anomaly check when constructed with
+`grad_reducer=`, keeping the check ordering: backward (+ async bucket
+reduces) → flush → unscale → check → step.
+"""
+from __future__ import annotations
+
+import collections
+import contextlib
+from typing import Optional, Sequence
+
+import jax
+from jax import numpy as jnp
+
+from ..core import autograd_engine as _engine
+from ..core.tensor import Tensor
+from ..framework import flags as _flags
+from . import collective as _coll
+
+_flags.define_flag(
+    "FLAGS_async_grad_allreduce",
+    False,
+    "DataParallel registers an AsyncBucketedGradReducer over the wrapped "
+    "model's params: grads are bucketed by (dtype, size cap) and each "
+    "bucket's all-reduce is dispatched (sync_op=False) the moment its last "
+    "grad lands in backward, overlapping the reduction with the remaining "
+    "backward instead of leaving sync entirely to GSPMD scheduling",
+)
+
+
+def unstack_collective_result(red, ndim):
+    """Eager collectives may return the rank-stacked [nranks, ...] form —
+    every row is the reduction, so any row is this rank's view."""
+    if red.ndim == ndim + 1:
+        return red[0]
+    return red
+
+
+class _Bucket:
+    __slots__ = ("params", "index", "numel", "dtype", "arrived")
+
+    def __init__(self, params, index, numel, dtype):
+        self.params = params          # list[Tensor] in flatten order
+        self.index = index            # id(p) -> (offset, size, shape)
+        self.numel = numel
+        self.dtype = dtype
+        self.arrived = {}             # id(p) -> arrival count this cycle
+
+
+class AsyncBucketedGradReducer:
+    """Bucket grads by (dtype, byte cap); all-reduce each bucket as its
+    backward completes.
+
+    parameters: the params to reduce (only those with stop_gradient=False
+      participate).
+    group: collective Group (None = world).
+    bucket_bytes: soft cap per bucket (reference comm_buffer_size_MB).
+    op: 'avg' (default — idempotent on GSPMD-synchronized grads) or 'sum'.
+    accumulation_steps: grads are reduced only on every Nth backward per
+      param (gradient accumulation windows stay local, the boundary
+      backward triggers the reduce of the ACCUMULATED grad — reference
+      EagerReducer's no_sync counting).
+    optimizer: when given and running the flat fused engine
+      (FLAGS_fused_optimizer), its bucket index maps are adopted verbatim
+      so grad buckets mirror the optimizer's update buckets.
+    """
+
+    def __init__(
+        self,
+        parameters: Sequence,
+        group=None,
+        bucket_bytes: int = 25 << 20,
+        op: str = "avg",
+        accumulation_steps: int = 1,
+        optimizer=None,
+    ):
+        if op not in ("avg", "sum"):
+            raise ValueError(f"op must be 'avg' or 'sum', got {op!r}")
+        self.group = group
+        self.op = _coll.ReduceOp.AVG if op == "avg" else _coll.ReduceOp.SUM
+        self.accumulation_steps = max(1, int(accumulation_steps))
+        self._sync = True
+        self._handles = []
+        # task handles exist only so flush(wait=True) can block on this
+        # cycle's dispatches; each handle pins the reduced bucket array, so
+        # a loop that never flushes (DataParallel without a guardian) must
+        # not pin them for the process lifetime — handles from finished
+        # cycles are dropped at the next cycle's first arrival
+        # (_tasks_stale), which also bounds the deque at one cycle's
+        # dispatch count (a maxlen would silently evict handles flush
+        # still owes a wait on when a cycle dispatches many buckets)
+        self._tasks = collections.deque()
+        self._tasks_stale = False
+        params = [p for p in parameters if not getattr(p, "stop_gradient", False)]
+        self.buckets = self._build_buckets(params, int(bucket_bytes), optimizer)
+        self._by_param = {}
+        for b in self.buckets:
+            for p in b.params:
+                self._by_param[id(p)] = b
+        for b in self.buckets:
+            for p in b.params:
+                self._handles.append(p.register_hook(self._make_hook(p, b)))
+        # end-of-backward straggler dispatch: a bucket holding a param the
+        # forward never used would otherwise never reach its all-arrived
+        # boundary — its used params' grads would silently never sync (on a
+        # real per-process backend) and its arrival counts would leak into
+        # the next backward. Once the window's used params have completed
+        # their accumulation count, the backward's end IS the boundary.
+        self._engine_hook = _engine.register_backward_end_hook(self._on_backward_end)
+
+    # ---- bucket construction ----
+    def _build_buckets(self, params, cap_bytes, optimizer):
+        buckets = []
+        claimed = set()
+        engine = getattr(optimizer, "_flat_engine", None) if optimizer is not None else None
+        if engine is not None and getattr(engine, "buckets", None):
+            by_id = {id(p): p for p in params}
+            for b in engine.buckets.values():
+                if not all(pid in by_id for pid in b["ids"]):
+                    # a PARTIAL adoption would keep the engine's flat
+                    # offsets while the reducer flattens only the present
+                    # params — every offset past the gap would slice the
+                    # wrong values; leave these params to plain bucketing
+                    continue
+                # flatten order must match the engine's offset order
+                plist = sorted((by_id[pid] for pid in b["ids"]),
+                               key=lambda p: b["index"][id(p)][0])
+                index = {id(p): b["index"][id(p)] for p in plist}
+                numel = sum(sz for _, sz, _ in index.values())
+                buckets.append(_Bucket(plist, index, numel, plist[0]._value.dtype))
+                claimed.update(id(p) for p in plist)
+        rest = [p for p in params if id(p) not in claimed]
+        # reference reducer walks params in REVERSE registration order —
+        # backward produces grads roughly output-to-input, so reverse-order
+        # buckets complete (and dispatch) earliest
+        by_dtype = {}
+        for p in reversed(rest):
+            by_dtype.setdefault(p._value.dtype, []).append(p)
+        for dtype, plist in by_dtype.items():
+            cur, cur_bytes = [], 0
+            itemsize = jnp.dtype(dtype).itemsize
+            for p in plist:
+                nb = int(p._value.size) * itemsize
+                if cur and cur_bytes + nb > cap_bytes:
+                    buckets.append(self._plain_bucket(cur, dtype))
+                    cur, cur_bytes = [], 0
+                cur.append(p)
+                cur_bytes += nb
+            if cur:
+                buckets.append(self._plain_bucket(cur, dtype))
+        return buckets
+
+    @staticmethod
+    def _plain_bucket(plist, dtype):
+        index, off = {}, 0
+        for p in plist:
+            size = int(p._value.size)
+            index[id(p)] = (off, size, tuple(p._value.shape))
+            off += size
+        return _Bucket(list(plist), index, off, dtype)
+
+    # ---- hooks ----
+    def _make_hook(self, param, bucket):
+        def hook(grad):
+            return self._on_grad(param, bucket, grad)
+
+        return hook
+
+    def _on_grad(self, param, bucket, grad):
+        if not self._sync:
+            # accumulation window: the engine keeps accumulating into
+            # p.grad, but arrivals are NOT counted — otherwise the first
+            # hook of the boundary backward would see every count already
+            # satisfied and dispatch before the other params' grads of
+            # THAT backward have landed. Counting only sync arrivals makes
+            # the boundary backward a fresh cycle whose LAST hook reduces
+            # the whole accumulation.
+            return None
+        if _engine.grad_collection_active():
+            # paddle.autograd.grad / double-backward: not a training cycle
+            # — counting it (or worse, dispatching and rewriting .grad from
+            # a penalty pass) would corrupt the real training gradients
+            return None
+        if self._tasks_stale:
+            # first arrival of a new backward: handles from finished cycles
+            # have served their flush(wait=True) window — release them so
+            # they stop pinning the reduced bucket arrays
+            self._tasks.clear()
+            self._tasks_stale = False
+        pid = id(param)
+        bucket.arrived[pid] = bucket.arrived.get(pid, 0) + 1
+        boundary = all(
+            bucket.arrived.get(id(p), 0) >= self.accumulation_steps
+            for p in bucket.params
+        )
+        if not boundary:
+            return None
+        return self._reduce_bucket(bucket, last_param=param, incoming=grad)
+
+    # ---- the reduce ----
+    def _grad_value(self, p, last_param, incoming):
+        """Final accumulated grad for p this cycle. For the param whose hook
+        is firing right now the engine has NOT yet written .grad — its final
+        value is .grad (prior accumulation) + the incoming cotangent."""
+        if p is last_param:
+            inc = incoming._value if isinstance(incoming, Tensor) else jnp.asarray(incoming)
+            if p.grad is not None:
+                return p.grad._value + inc
+            return inc
+        return p.grad._value if p.grad is not None else None
+
+    def _reduce_bucket(self, bucket, last_param=None, incoming=None):
+        parts = []
+        missing = set()
+        for p in bucket.params:
+            g = self._grad_value(p, last_param, incoming)
+            if g is None:
+                # a param with no grad this cycle (unused in forward):
+                # contribute zeros so the flat layout stays fixed — but its
+                # .grad stays None below (the sync=off path leaves unused
+                # params untouched; writing the reduced zeros would make the
+                # optimizer start decaying/moment-tracking them)
+                missing.add(id(p))
+                g = jnp.zeros((int(p._value.size),), bucket.dtype)
+                parts.append(g)
+            else:
+                parts.append(g.astype(bucket.dtype).ravel())
+        flat = jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+        # [1, numel]: the eager collectives treat a leading dim equal to the
+        # group size as "already rank-stacked" — a flat bucket whose numel
+        # happens to equal nranks would be reduced ACROSS ITS OWN ELEMENTS;
+        # the explicit unit leading dim makes the layout unambiguous
+        holder = Tensor(flat.reshape(1, -1))
+        task = _coll.all_reduce(holder, op=self.op, group=self.group, sync_op=False)
+        self._tasks.append(task)
+        red = unstack_collective_result(holder._value, 2)[0]
+        ret = None
+        for p in bucket.params:
+            if id(p) in missing:
+                continue
+            off, size, shape = bucket.index[id(p)]
+            sl = Tensor(red[off:off + size].reshape(shape).astype(p._value.dtype))
+            sl.stop_gradient = True
+            if p is last_param:
+                # the engine accumulates the hook's return INTO p.grad —
+                # clear it so the reduced slice (which already contains the
+                # full accumulation) lands exactly once
+                p.grad = None
+                ret = sl
+            else:
+                p.grad = sl
+        # cycle state resets the moment the bucket dispatches: the next
+        # accumulation window starts counting from zero with no flush needed
+        bucket.arrived.clear()
+        return ret
+
+    def _on_backward_end(self, completed=True):
+        """Fires after every run_backward: dispatch buckets whose USED
+        params completed their accumulation window but whose boundary never
+        triggered because some param got no grad (unused in this forward).
+        Mid-window buckets (every count < accumulation_steps) keep
+        accumulating untouched. An ABORTED backward (completed=False) left
+        partial grads behind — drop the cycle's counts instead of letting
+        them complete a later boundary against poisoned values (the caller
+        must clear_grad and redo the window, same as after any failed step)."""
+        if not self._sync:
+            return
+        self._tasks_stale = True
+        if not completed:
+            for b in self.buckets:
+                b.arrived.clear()
+            return
+        for b in self.buckets:
+            if b.arrived and max(b.arrived.values()) >= self.accumulation_steps:
+                self._reduce_bucket(b)
+
+    # ---- lifecycle ----
+    def flush(self, wait: bool = False):
+        """Dispatch any buckets not yet reduced this cycle (stragglers:
+        params that never got a grad, or a backward that ended mid-bucket),
+        then reset per-cycle state. Call before anything reads grads for a
+        global decision (guardian check, clip, optimizer.step). With
+        wait=True also blocks until every dispatched reduce completes."""
+        if self._sync:
+            for b in self.buckets:
+                if b.arrived:
+                    self._reduce_bucket(b)
+        tasks = list(self._tasks)
+        self._tasks.clear()
+        if wait:
+            for t in tasks:
+                t.wait()
+        for b in self.buckets:
+            b.arrived.clear()
+
+    @contextlib.contextmanager
+    def no_sync(self):
+        """Accumulation window: grads accumulate locally (the engine keeps
+        summing into p.grad) and nothing is counted or reduced; the first
+        backward AFTER the context exits reduces the whole accumulation at
+        its bucket boundaries. Run the boundary backward outside the
+        window (standard DDP usage) — exiting straight into flush() leaves
+        the accumulation unreduced (AVG-identity here, but a real sum
+        backend needs the boundary backward)."""
+        prev = self._sync
+        self._sync = False
+        try:
+            yield
+        finally:
+            self._sync = prev
+
+    def stop(self):
+        """Remove every registered hook (module teardown)."""
+        for h in self._handles:
+            try:
+                h.remove()
+            except Exception:
+                pass
+        self._handles.clear()
+        self._engine_hook.remove()
+
+    @property
+    def bucket_sizes(self):
+        return [b.numel for b in self.buckets]

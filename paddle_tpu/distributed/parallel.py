@@ -4,12 +4,14 @@ Reference parity: python/paddle/distributed/parallel.py
 (DataParallel:202, init_parallel_env:943) + the C++ EagerReducer bucketed
 allreduce (paddle/fluid/distributed/collective/reducer.cc). TPU-native
 design: DataParallel shards the input batch over the mesh's devices and
-leaves parameters replicated; the gradient all-reduce is NOT a hook-driven
-bucketed NCCL call — XLA emits it inside the (jitted or eager) backward
-because a replicated-param gradient is a contraction over the sharded batch
-axis. Bucketing/overlap (`comm_buffer_size_MB`, `last_comm_buffer_size_MB`)
-therefore have no effect and are accepted for compat: the XLA scheduler
-already overlaps the emitted collectives with compute.
+leaves parameters replicated; by default the gradient all-reduce is NOT a
+hook-driven bucketed NCCL call — XLA emits it inside the (jitted or eager)
+backward because a replicated-param gradient is a contraction over the
+sharded batch axis, and the XLA scheduler already overlaps the emitted
+collectives with compute. Under FLAGS_async_grad_allreduce an explicit
+AsyncBucketedGradReducer (grad_reducer.py) is attached instead, and
+`comm_buffer_size` (MB) becomes its bucket cap; `last_comm_buffer_size_MB`
+remains accepted-and-inert for compat.
 """
 from __future__ import annotations
 
@@ -64,6 +66,26 @@ class DataParallel(Layer):
             self._mesh = _world_data_mesh()
         self._sharding_cache = {}
         self._grad_sync = True
+        # FLAGS_async_grad_allreduce: explicit bucketed reduction dispatched
+        # as each bucket's backward completes (grad_reducer module doc) —
+        # honoring comm_buffer_size as the bucket cap like the reference
+        self._reducer = None
+        from ..framework import flags as _flags
+        from .grad_reducer import AsyncBucketedGradReducer  # defines the flag
+
+        if _flags.get_flag("FLAGS_async_grad_allreduce") and self._mesh.size > 1:
+            # re-wrapping the same module (tests, notebooks, fleet re-init)
+            # must not stack hook sets — two live reducers would dispatch
+            # two all-reduces per bucket and chain one's hook on the
+            # other's reduced output
+            prev = getattr(layers, "_async_grad_reducer", None)
+            if prev is not None:
+                prev.stop()
+            self._reducer = AsyncBucketedGradReducer(
+                layers.parameters(), group=group, op="avg",
+                bucket_bytes=int(comm_buffer_size) << 20,
+            )
+            layers._async_grad_reducer = self._reducer
 
     def _shard_input(self, t: Tensor) -> Tensor:
         x = t._raw()
@@ -90,7 +112,11 @@ class DataParallel(Layer):
         this context exists for API parity."""
         self._grad_sync = False
         try:
-            yield
+            if self._reducer is not None:
+                with self._reducer.no_sync():
+                    yield
+            else:
+                yield
         finally:
             self._grad_sync = True
 

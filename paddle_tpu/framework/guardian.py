@@ -510,11 +510,16 @@ class TrainingGuardian:
                  ceiling: Optional[float] = None, lkg_interval: Optional[int] = None,
                  lkg_ring: Optional[int] = None, desync_interval: Optional[int] = None,
                  group=None, crash_dir: Optional[str] = None,
-                 recorder: Optional[FlightRecorder] = None, name: str = "train"):
+                 recorder: Optional[FlightRecorder] = None, name: str = "train",
+                 grad_reducer=None):
         if policy is not None and policy not in POLICIES:
             raise ValueError(f"guardian policy must be one of {POLICIES}, got {policy!r}")
         self.optimizer = optimizer
         self.scaler = scaler
+        # async bucketed DP reduction (distributed.grad_reducer): flushed
+        # before grads are read so the anomaly check / grad-norm sees the
+        # REDUCED gradients, never a half-synced bucket
+        self.grad_reducer = grad_reducer
         self._policy = policy
         self._ceiling = ceiling
         self._lkg_interval = lkg_interval
@@ -562,6 +567,11 @@ class TrainingGuardian:
     def step(self, loss=None) -> str:
         opt = self.optimizer
         self.steps_total += 1
+        if self.grad_reducer is not None:
+            # check ordering: backward (+ async bucket reduces) → flush →
+            # unscale → check → step. Straggler buckets dispatch here; the
+            # grads read below are the fully reduced ones.
+            self.grad_reducer.flush()
         grads = [p.grad for _, p in opt._all_params() if p.grad is not None]
         if self._tracing(loss, grads):
             # inside a jax trace the one-scalar sync is impossible; the

@@ -24,7 +24,8 @@ class Parameter(Tensor):
     python/paddle/base/framework.py)."""
 
     # placements/process_mesh live on Tensor as dist-attr properties
-    __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip", "is_distributed")
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip", "is_distributed",
+                 "sequence_parallel")
 
     def __init__(self, value, trainable=True, name=None):
         super().__init__(value, stop_gradient=not trainable, name=name)

@@ -137,6 +137,24 @@ def test_hook():
     assert x.grad.item() == 2.0
 
 
+def test_hook_fires_once_for_shared_leaf():
+    """A leaf consumed by several ops (tied embedding shape) must see its
+    hook exactly ONCE per backward, with the MERGED cotangent — per-edge
+    fires would hand observers (grad reducers) partial gradients."""
+    x = paddle.to_tensor(np.ones(3, np.float32)); x.stop_gradient = False
+    seen = []
+
+    def hook(g):
+        seen.append(g.numpy().copy())
+
+    h = x.register_hook(hook)
+    ((x * 2) + (x * 3)).sum().backward()
+    assert len(seen) == 1
+    np.testing.assert_allclose(seen[0], [5, 5, 5])
+    np.testing.assert_allclose(x.grad.numpy(), [5, 5, 5])
+    h.remove()
+
+
 def test_int_inputs_dont_build_graph():
     x = paddle.to_tensor([1, 2, 3])
     x.stop_gradient = False  # int tensors never require grad

@@ -97,6 +97,55 @@ def test_measured_config_carries_attribution():
         assert attr["platform"]
 
 
+def test_sigterm_still_emits_terminal_snapshot():
+    """Round-9 contract: the driver's timeout sends SIGTERM — bench must
+    answer with a complete terminal JSON line as its LAST output (pending
+    configs become explicit `skipped:sigterm`), so the driver's short
+    stdout tail always contains a parsable record."""
+    import select
+    import signal
+
+    env = dict(os.environ)
+    env["BENCH_DEADLINE_S"] = "3000"  # deadline far away: SIGTERM is the exit
+    env["JAX_PLATFORMS"] = "cpu"
+    # shrink the headline so the first compile is short: SIGTERM delivery
+    # waits out whatever C-level XLA call is in flight, so a full-size
+    # headline compile adds ~10s of pure latency to this test
+    env.update(
+        BENCH_STEPS="10", BENCH_BATCH="2", BENCH_SEQ="16",
+        BENCH_VOCAB="256", BENCH_HIDDEN="64", BENCH_LAYERS="2",
+        BENCH_FFN="128", BENCH_HEADS="4",
+        BENCH_PEAK_N="256", BENCH_EST_SEQ128="5", BENCH_EST_PEAK="1",
+    )
+    env.pop("BENCH_CHILD", None)
+    p = subprocess.Popen(
+        [sys.executable, BENCH], env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        # signal as soon as the FIRST snapshot line lands (headline just
+        # resolved, the other configs are pending — each needs a child
+        # spawn, so they cannot all resolve in the signal-delivery gap) or
+        # after 3s mid-headline, whichever comes first; a fixed sleep alone
+        # races bench finishing entirely on a fast host
+        select.select([p.stdout], [], [], 3.0)
+        p.send_signal(signal.SIGTERM)
+        out, err = p.communicate(timeout=60)
+    finally:
+        if p.poll() is None:
+            p.kill()
+            p.communicate()
+    assert p.returncode == 0, err[-2000:]
+    lines = [l for l in out.strip().splitlines() if l.strip()]
+    assert lines, "SIGTERM produced no terminal snapshot"
+    last = json.loads(lines[-1])
+    assert set(last["detail"]["configs"]) == CONFIGS
+    for k, status in last["detail"]["configs"].items():
+        assert status != "pending", (k, status)
+    assert any(s.startswith("skipped:sigterm")
+               for s in last["detail"]["configs"].values())
+
+
 def test_deadline_skip_reason_survives_env_skips():
     env = dict(os.environ)
     env.update(

@@ -167,11 +167,15 @@ def test_alexnet_mobilenetv3_shufflenet_variants():
     import numpy as np
     from paddle_tpu.vision import models as M
 
+    # alexnet's 6x6 adaptive head wants the native 224 pipeline; the rest
+    # end in AdaptiveAvgPool2D(1) and prove the same structure at 96px for
+    # a fraction of the single-core conv time (tier-1 wall budget)
     x = paddle.to_tensor(np.random.RandomState(0).randn(1, 3, 224, 224).astype("float32"))
     m = M.alexnet(num_classes=10)
     m.eval()
     assert tuple(m(x).shape) == (1, 10)
 
+    x = paddle.to_tensor(np.random.RandomState(0).randn(1, 3, 96, 96).astype("float32"))
     for fac in (M.mobilenet_v3_small, M.mobilenet_v3_large):
         m = fac(num_classes=7)
         m.eval()
@@ -198,7 +202,9 @@ def test_inception_v3():
 
     m = M.inception_v3(num_classes=6)
     m.eval()
-    x = paddle.to_tensor(np.random.RandomState(1).randn(1, 3, 299, 299).astype("float32"))
+    # 160px keeps every stage ≥ the 3x3 stride-1 pools' minimum while
+    # costing ~1/4 of the native-299 single-core conv time (adaptive head)
+    x = paddle.to_tensor(np.random.RandomState(1).randn(1, 3, 160, 160).astype("float32"))
     assert tuple(m(x).shape) == (1, 6)
     n_params = sum(p.size for p in m.parameters())
     assert 20e6 < n_params < 30e6  # ~23.8M reference param count

@@ -1,0 +1,211 @@
+"""Roofline-gated perf CI (tools/perf_gate.py) — round-9 contract.
+
+The gate must: pass identical captures, fail (exit 1) on an injected >=10%
+unexplained step-time or HBM regression, pass a step-time change whose
+attribution explains it (the workload measurably grew), and hard-fail
+(exit 2) on torn/invalid captures — including the exact r5 failure shape
+(`parsed: null`).
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GATE = os.path.join(REPO, "tools", "perf_gate.py")
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import perf_gate  # noqa: E402
+
+
+def _capture(ms=50.0, flops=1.0e12, hbm=2.0e9, pmem=3.0e9, seq4096_ms=130.0):
+    return {
+        "metric": "ernie3.0-base tokens/sec/chip",
+        "value": 150000.0,
+        "unit": "tokens/s",
+        "vs_baseline": 0.68,
+        "detail": {
+            "configs": {
+                "seq128": "measured",
+                "seq4096": "measured",
+                "llama3_shape": "skipped:env",
+                "resnet50": "skipped:env",
+                "ppocr_e2e": "skipped:env",
+            },
+            "batch": 64, "seq": 128, "heads": 12,
+            "ms_per_step": ms,
+            "tokens_per_sec": 150000.0,
+            "attribution": {
+                "program": "to_static",
+                "flops": flops,
+                "hbm_bytes": hbm,
+                "program_memory_bytes": pmem,
+                "peak_hbm_bytes": pmem,
+                "compile_seconds": 3.0,
+                "mfu": 0.67,
+                "hbm_util": 0.2,
+                "bound": "compute",
+                "platform": "cpu",
+            },
+            "seq4096": {
+                "batch": 3, "seq": 4096, "heads": 6,
+                "ms_per_step": seq4096_ms,
+                "attribution": {
+                    "flops": 4.0e12, "hbm_bytes": 8.0e9,
+                    "program_memory_bytes": 9.0e9, "mfu": 0.66,
+                },
+            },
+        },
+    }
+
+
+def _write(tmp_path, name, obj):
+    p = tmp_path / name
+    p.write_text(json.dumps(obj) if not isinstance(obj, str) else obj)
+    return str(p)
+
+
+def _run(*argv):
+    r = subprocess.run(
+        [sys.executable, GATE, *argv], capture_output=True, text=True,
+        timeout=60,
+    )
+    return r.returncode, r.stdout, r.stderr
+
+
+def test_identical_captures_pass(tmp_path):
+    a = _write(tmp_path, "a.json", _capture())
+    b = _write(tmp_path, "b.json", _capture())
+    rc, out, err = _run(a, b)
+    assert rc == 0, (out, err)
+    assert "PASS" in out
+
+
+def test_unexplained_step_time_regression_fails(tmp_path):
+    a = _write(tmp_path, "a.json", _capture(ms=50.0))
+    b = _write(tmp_path, "b.json", _capture(ms=58.0))  # +16%, flat flops
+    rc, out, err = _run(a, b)
+    assert rc == 1, (out, err)
+    assert "UNEXPLAINED" in out
+
+
+def test_explained_change_passes(tmp_path):
+    # +16% step time WITH +20% attributed FLOPs: the program does more
+    a = _write(tmp_path, "a.json", _capture(ms=50.0, flops=1.0e12))
+    b = _write(tmp_path, "b.json", _capture(ms=58.0, flops=1.2e12))
+    rc, out, err = _run(a, b)
+    assert rc == 0, (out, err)
+    assert "explained" in out
+
+
+def test_shape_change_not_compared(tmp_path):
+    old = _capture(ms=50.0)
+    new = _capture(ms=90.0)
+    new["detail"]["batch"] = 128  # different workload entirely
+    a = _write(tmp_path, "a.json", old)
+    b = _write(tmp_path, "b.json", new)
+    rc, out, err = _run(a, b)
+    assert rc == 0, (out, err)
+    assert "workload changed" in out
+
+
+def test_memory_regression_fails(tmp_path):
+    a = _write(tmp_path, "a.json", _capture(pmem=3.0e9))
+    b = _write(tmp_path, "b.json", _capture(pmem=3.6e9))  # +20% mem, flat work
+    rc, out, err = _run(a, b)
+    assert rc == 1, (out, err)
+    assert "memory regression" in out
+
+
+def test_nested_config_regression_fails(tmp_path):
+    a = _write(tmp_path, "a.json", _capture(seq4096_ms=130.0))
+    b = _write(tmp_path, "b.json", _capture(seq4096_ms=160.0))
+    rc, out, err = _run(a, b)
+    assert rc == 1, (out, err)
+    assert "seq4096" in out
+
+
+def test_ppocr_field_names_gated(tmp_path):
+    # ppocr reports ms_per_image_e2e (not ms_per_step) — the gate must
+    # recognize the real field names bench.py emits for every config
+    def with_ppocr(e2e_ms):
+        c = _capture()
+        c["detail"]["configs"]["ppocr_e2e"] = "measured"
+        c["detail"]["ppocr_e2e"] = {
+            "n_images": 2, "n_boxes": 3,
+            "det_ms_per_image": 320.0, "rec_ms_per_batch": 60.0,
+            "ms_per_image_e2e": e2e_ms,
+        }
+        return c
+    a = _write(tmp_path, "a.json", with_ppocr(380.0))
+    b = _write(tmp_path, "b.json", with_ppocr(475.0))  # +25%, no attribution
+    rc, out, err = _run(a, b)
+    assert rc == 1, (out, err)
+    assert "ppocr_e2e" in out and "UNEXPLAINED" in out
+
+
+def test_torn_capture_fails_loudly(tmp_path):
+    a = _write(tmp_path, "a.json", _capture())
+    torn = _write(tmp_path, "torn.json", '{"metric": "x", "value": 1, "uni')
+    rc, out, err = _run(a, torn)
+    assert rc == 2, (out, err)
+    assert "INVALID CAPTURE" in err
+
+
+def test_parsed_null_driver_capture_fails(tmp_path):
+    # the exact r5 failure shape: rc=124, parsed=null
+    a = _write(tmp_path, "a.json", _capture())
+    b = _write(tmp_path, "b.json", {"n": 5, "rc": 124, "tail": "...", "parsed": None})
+    rc, out, err = _run(a, b)
+    assert rc == 2, (out, err)
+    assert "parsed=null" in err
+
+
+def test_driver_wrapper_accepted(tmp_path):
+    wrapped = {"n": 6, "rc": 0, "tail": "...", "parsed": _capture()}
+    a = _write(tmp_path, "a.json", wrapped)
+    b = _write(tmp_path, "b.json", _capture())
+    rc, out, err = _run(a, b)
+    assert rc == 0, (out, err)
+
+
+def test_pending_snapshot_rejected(tmp_path):
+    bad = _capture()
+    bad["detail"]["configs"]["seq4096"] = "pending"
+    a = _write(tmp_path, "a.json", _capture())
+    b = _write(tmp_path, "b.json", bad)
+    rc, out, err = _run(a, b)
+    assert rc == 2, (out, err)
+    assert "pending" in err
+
+
+def test_skips_reported_not_compared(tmp_path):
+    old = _capture()
+    new = _capture(ms=58.0)
+    new["detail"]["configs"]["seq128"] = "skipped:deadline"
+    a = _write(tmp_path, "a.json", old)
+    b = _write(tmp_path, "b.json", new)
+    rc, out, err = _run(a, b)
+    # seq128 skipped in candidate -> not compared; seq4096 identical -> pass
+    assert rc == 0, (out, err)
+    assert "not compared" in out
+
+
+def test_gate_api_inprocess():
+    old, new = _capture(), _capture(ms=58.0)
+    code, report = perf_gate.gate(
+        perf_gate.validate_capture(old), perf_gate.validate_capture(new)
+    )
+    assert code == 1
+    assert any("UNEXPLAINED" in l for l in report)
+    code2, _ = perf_gate.gate(old, _capture(ms=54.9))  # +9.8% inside tol
+    assert code2 == 0
+
+
+def test_validate_rejects_non_dict():
+    with pytest.raises(perf_gate.CaptureError):
+        perf_gate.validate_capture([1, 2, 3])
+    with pytest.raises(perf_gate.CaptureError):
+        perf_gate.validate_capture({"metric": "m"})

@@ -1,0 +1,273 @@
+#!/usr/bin/env python
+"""Roofline-gated perf CI: diff two bench captures, fail on unexplained
+regression.
+
+PR 5 made every measured config carry `detail.attribution` (XLA-counted
+FLOPs, HBM bytes, program memory, roofline utilization). This tool turns
+that reporting into ENFORCEMENT: given a baseline and a candidate capture,
+
+    python tools/perf_gate.py BENCH_old.json BENCH_new.json [--tol 0.10]
+
+it exits nonzero when any config's step time, HBM traffic, or program
+memory regressed beyond the tolerance band WITHOUT an explanation in the
+record itself. A change is "explained" when the capture says the workload
+changed:
+
+  - the config's shape fields differ (batch/seq/heads/layers/rung/
+    dims_override) — a different problem, not a regression;
+  - the attributed work changed commensurately — step time may grow up to
+    tol beyond the measured FLOP/HBM growth (the program genuinely does
+    more); a step-time regression with FLAT attribution is exactly the
+    "scheduling/overlap got worse" case this gate exists to catch;
+  - the config was skipped in either capture (skips are reported, never
+    compared — the capture contract already makes skips explicit).
+
+Capture schema is validated FIRST and hard-fails (exit 2) on torn files:
+a truncated JSON, a `parsed: null` driver record (the r5 timeout shape),
+or a record missing `detail.configs` never silently passes.
+
+Exit codes: 0 = pass, 1 = regression, 2 = invalid capture / bad usage.
+
+Accepted inputs: a driver capture ({"n":…, "tail":…, "parsed": {...}}), a
+raw bench.py JSON line ({"metric":…, "detail": {...}}), or a file whose
+last line is such a JSON line (a bench stdout log).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Optional
+
+# config keys inside `detail` holding per-config stat dicts, plus the
+# headline whose stats live directly in `detail`
+NESTED_CONFIGS = ("seq4096", "llama3_shape", "resnet50", "ppocr_e2e")
+# fields whose change means "different workload" (never a regression)
+SHAPE_FIELDS = (
+    "batch", "seq", "heads", "layers", "rung", "micro", "n_images",
+    "n_boxes", "dims_override", "recompute",
+)
+# (field, larger-is-worse) regression metrics per config record; the
+# names match what bench.py actually emits per config (ernie/llama/resnet
+# report ms_per_step; ppocr reports per-stage + e2e per-image times)
+TIME_FIELDS = (
+    "ms_per_step", "ms_per_image_e2e", "det_ms_per_image", "rec_ms_per_batch",
+)
+ATTR_WORK_FIELDS = ("flops", "hbm_bytes")
+ATTR_MEM_FIELDS = ("program_memory_bytes", "peak_hbm_bytes")
+
+
+class CaptureError(Exception):
+    pass
+
+
+def load_capture(path: str) -> dict:
+    """Parse + schema-validate one capture; returns the bench record."""
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError as e:
+        raise CaptureError(f"{path}: unreadable ({e})")
+    rec = None
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError:
+        # maybe a bench stdout log: last parsable line wins
+        for line in reversed([l for l in text.splitlines() if l.strip()]):
+            try:
+                doc = json.loads(line)
+                break
+            except json.JSONDecodeError:
+                continue
+        else:
+            raise CaptureError(f"{path}: not JSON (torn capture?)")
+    if isinstance(doc, dict) and "parsed" in doc:
+        # driver capture wrapper
+        rec = doc["parsed"]
+        if rec is None:
+            raise CaptureError(
+                f"{path}: parsed=null — the run produced no complete record "
+                f"(rc={doc.get('rc')}); a torn capture cannot gate"
+            )
+    else:
+        rec = doc
+    return validate_capture(rec, path)
+
+
+def validate_capture(rec, path: str = "<capture>") -> dict:
+    """The capture schema contract (round 9): a dict with metric/value/
+    unit/detail, detail.configs mapping every config to a status string,
+    and a stats dict (or explicit skip marker) for each non-pending one."""
+    if not isinstance(rec, dict):
+        raise CaptureError(f"{path}: record is {type(rec).__name__}, not an object")
+    missing = {"metric", "value", "unit", "detail"} - set(rec)
+    if missing:
+        raise CaptureError(f"{path}: record missing keys {sorted(missing)}")
+    detail = rec["detail"]
+    if not isinstance(detail, dict):
+        raise CaptureError(f"{path}: detail is not an object")
+    configs = detail.get("configs")
+    if not isinstance(configs, dict) or not configs:
+        raise CaptureError(f"{path}: detail.configs missing/empty — pre-round-6 "
+                           "captures cannot gate (no skip accounting)")
+    for k, st in configs.items():
+        if not isinstance(st, str):
+            raise CaptureError(f"{path}: configs[{k!r}] status is not a string")
+        if st == "pending":
+            raise CaptureError(f"{path}: configs[{k!r}] still 'pending' — "
+                               "not a terminal snapshot (torn capture)")
+    return rec
+
+
+def _config_stats(rec: dict, key: str) -> Optional[dict]:
+    """Stats dict for a config, or None when skipped/absent."""
+    detail = rec["detail"]
+    status = detail["configs"].get(key)
+    if status != "measured":
+        return None
+    if key == "seq128":
+        return detail  # headline stats live at detail top level
+    sub = detail.get(key)
+    return sub if isinstance(sub, dict) and "skipped" not in sub else None
+
+
+def _rel(new: float, old: float) -> float:
+    return (new - old) / old if old else 0.0
+
+
+def _shape_changed(old: dict, new: dict):
+    changed = []
+    for f in SHAPE_FIELDS:
+        if old.get(f) != new.get(f):
+            changed.append(f)
+    return changed
+
+
+def _attr(stats: dict) -> dict:
+    a = stats.get("attribution")
+    return a if isinstance(a, dict) and "attribution" not in a else {}
+
+
+def compare_config(key: str, old: dict, new: dict, tol: float):
+    """-> (verdict, lines); verdict in {'pass', 'explained', 'regress'}."""
+    lines = []
+    shape = _shape_changed(old, new)
+    if shape:
+        return "explained", [f"{key}: workload changed ({', '.join(shape)}) — not compared"]
+    oa, na = _attr(old), _attr(new)
+    # a field the baseline measured but the candidate lost (or zeroed) is
+    # suspicious — never silently narrow the gate's coverage; absence in
+    # BOTH captures is the legitimate no-cost-analysis platform case
+    for f in ATTR_WORK_FIELDS + ATTR_MEM_FIELDS + ("mfu", "hbm_util"):
+        if bool(oa.get(f)) != bool(na.get(f)):
+            side = "candidate" if oa.get(f) else "baseline"
+            lines.append(
+                f"{key}: attribution.{f} missing/zero in the {side} — "
+                "field not compared (collection regression?)"
+            )
+    # attributed-work growth budget: step time may legitimately grow as
+    # much as the worst measured work growth
+    work_growth = 0.0
+    for f in ATTR_WORK_FIELDS:
+        if oa.get(f) and na.get(f):
+            work_growth = max(work_growth, _rel(na[f], oa[f]))
+    verdict = "pass"
+    for f in TIME_FIELDS:
+        if f in old and f in new and isinstance(old[f], (int, float)) and isinstance(new[f], (int, float)):
+            r = _rel(new[f], old[f])
+            if r > tol + max(0.0, work_growth):
+                lines.append(
+                    f"{key}: {f} {old[f]:.3f} -> {new[f]:.3f} (+{r:.1%}) with "
+                    f"attributed work +{work_growth:.1%} — UNEXPLAINED step-time regression"
+                )
+                verdict = "regress"
+            elif r > tol:
+                lines.append(
+                    f"{key}: {f} +{r:.1%} explained by attributed work "
+                    f"(+{work_growth:.1%})"
+                )
+                if verdict == "pass":
+                    verdict = "explained"
+    for f in ATTR_MEM_FIELDS:
+        if oa.get(f) and na.get(f):
+            r = _rel(na[f], oa[f])
+            # same proportional budget as the time check: memory may grow
+            # up to tol beyond the measured work growth — work growing past
+            # tol must not switch the memory gate off entirely
+            if r > tol + max(0.0, work_growth):
+                lines.append(
+                    f"{key}: attribution.{f} {oa[f]} -> {na[f]} (+{r:.1%}) with "
+                    f"attributed work +{work_growth:.1%} — UNEXPLAINED memory regression"
+                )
+                verdict = "regress"
+    # roofline drop: utilization falling past tol while work stayed flat is
+    # the overlap/scheduling signal even if absolute time fields are absent
+    for f in ("mfu", "hbm_util"):
+        if oa.get(f) and na.get(f):
+            r = _rel(na[f], oa[f])
+            if r < -(tol + max(0.0, work_growth)) and not any("UNEXPLAINED" in l for l in lines):
+                lines.append(
+                    f"{key}: roofline {f} {oa[f]:.3f} -> {na[f]:.3f} ({r:.1%}) — "
+                    "utilization regression (informational; time fields gate)"
+                )
+    if not lines:
+        lines.append(f"{key}: ok")
+    return verdict, lines
+
+
+def gate(old_rec: dict, new_rec: dict, tol: float = 0.10):
+    """-> (exit_code, report_lines)."""
+    report = []
+    regressed = False
+    # every config either capture reports is gated — a config added in a
+    # later round must not be silently exempt just because this list
+    # predates it (statuses were already schema-validated per key)
+    seen = set(old_rec["detail"]["configs"]) | set(new_rec["detail"]["configs"])
+    keys = ["seq128"] + [k for k in NESTED_CONFIGS if k in seen]
+    keys += sorted(seen - set(keys))
+    compared = 0
+    for key in keys:
+        so, sn = _config_stats(old_rec, key), _config_stats(new_rec, key)
+        if so is None or sn is None:
+            st_o = old_rec["detail"]["configs"].get(key, "absent")
+            st_n = new_rec["detail"]["configs"].get(key, "absent")
+            report.append(f"{key}: not compared (baseline={st_o}, candidate={st_n})")
+            continue
+        compared += 1
+        verdict, lines = compare_config(key, so, sn, tol)
+        report.extend(lines)
+        if verdict == "regress":
+            regressed = True
+    if compared == 0:
+        report.append("no config measured in BOTH captures — nothing gated")
+    return (1 if regressed else 0), report
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python tools/perf_gate.py",
+        description="diff detail.attribution between two bench captures; "
+                    "exit 1 on unexplained step-time/HBM regression, 2 on "
+                    "an invalid/torn capture",
+    )
+    p.add_argument("baseline", help="older capture (BENCH_rN.json or bench stdout)")
+    p.add_argument("candidate", help="newer capture to gate")
+    p.add_argument("--tol", type=float, default=0.10,
+                   help="relative tolerance band (default 0.10 = 10%%)")
+    args = p.parse_args(argv)
+    try:
+        old_rec = load_capture(args.baseline)
+        new_rec = load_capture(args.candidate)
+    except CaptureError as e:
+        print(f"perf_gate: INVALID CAPTURE: {e}", file=sys.stderr)
+        return 2
+    code, report = gate(old_rec, new_rec, tol=args.tol)
+    for line in report:
+        print(f"perf_gate: {line}")
+    print(f"perf_gate: {'FAIL (unexplained regression)' if code else 'PASS'}"
+          f" (tol={args.tol:.0%})")
+    return code
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
