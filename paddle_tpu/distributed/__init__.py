@@ -99,6 +99,7 @@ from .collective import alltoall_single, gather  # noqa: F401,E402
 from . import auto_tuner  # noqa: F401,E402
 from . import resilience  # noqa: F401,E402
 from . import rpc  # noqa: F401,E402
+from . import sharding  # noqa: F401,E402  — unified mesh/SpecLayout layer
 
 
 def __getattr__(name):

@@ -29,6 +29,9 @@ import numpy as np
 
 from ...core.tensor import Tensor
 from ...framework import flags as _flags
+from ..resilience import fault_injection as _fi
+from ..resilience.retry import RetryPolicy
+from ..sharding import spec_layout as _sl
 from .metadata import Metadata, intersection, slices_overlap
 from .save_state_dict import (
     COMPLETE_MARKER,
@@ -43,6 +46,31 @@ _flags.define_flag(
     "verify shard-file CRC32s recorded in checkpoint metadata when selecting "
     "a step to load (catches torn/corrupt writes at the cost of one read)",
 )
+_flags.define_flag(
+    "FLAGS_ckpt_read_retries", 3,
+    "attempts for each checkpoint shard-file read at load/reshard time "
+    "(transient IO errors back off with full jitter like the store retries; "
+    "chaos plans hook the ckpt.read_shard site)",
+)
+
+
+def _read_policy() -> RetryPolicy:
+    return RetryPolicy(
+        max_attempts=max(1, int(_flags.get_flag("FLAGS_ckpt_read_retries"))),
+        base_s=0.05, max_backoff_s=1.0, deadline_s=30.0,
+    )
+
+
+def _open_shard(path, file_name):
+    """One shard-file open+mmap, behind the ckpt.read_shard chaos site and
+    the read retry policy (a reshard-on-load after an elastic restart reads
+    MANY remote shards — the flakiest moment of the recovery path)."""
+
+    def attempt():
+        _fi.fault_point("ckpt.read_shard", file=file_name)
+        return np.load(os.path.join(path, file_name), mmap_mode="r")
+
+    return _read_policy().call(attempt, site="ckpt.read_shard")
 
 
 class CheckpointCorrupt(RuntimeError):
@@ -65,6 +93,10 @@ def _read_metadata(path) -> Metadata:
         merged.flat_mapping.update(part.flat_mapping)
         # pre-checksum pickles lack the field entirely
         merged.file_checksums.update(getattr(part, "file_checksums", {}))
+        # pre-portability pickles lack the saving-mesh record; all
+        # processes of one save recorded the same mesh, first one wins
+        if merged.mesh is None:
+            merged.mesh = getattr(part, "mesh", None)
     return merged
 
 
@@ -164,10 +196,10 @@ def _fill_block(path, tm, offset, shape, dtype, mmap_cache=None):
         if mmap_cache is not None:
             src = mmap_cache.get(sh.file_name)
             if src is None:
-                src = np.load(os.path.join(path, sh.file_name), mmap_mode="r")
+                src = _open_shard(path, sh.file_name)
                 mmap_cache[sh.file_name] = src
         else:
-            src = np.load(os.path.join(path, sh.file_name), mmap_mode="r")
+            src = _open_shard(path, sh.file_name)
         src_sel = tuple(slice(o - go, o - go + s) for o, go, s in zip(ioff, sh.global_offset, ishape))
         dst_sel = tuple(slice(o - bo, o - bo + s) for o, bo, s in zip(ioff, offset, ishape))
         block[dst_sel] = src[src_sel]
@@ -176,6 +208,25 @@ def _fill_block(path, tm, offset, shape, dtype, mmap_cache=None):
     if filled is not None and not filled.all():
         raise ValueError("checkpoint does not cover the requested slice (missing shards)")
     return block
+
+
+def _record_reshard(tensors_resharded: int, cross_mesh: bool) -> None:
+    """Reshard-on-load telemetry: how many tensors changed layout, and
+    whether the whole load crossed topologies (saving mesh != ours) — the
+    signal the elastic-restart path is exercising its recovery muscle."""
+    from ... import telemetry as _tm
+
+    if not _tm.enabled():
+        return
+    _tm.counter(
+        "paddle_tpu_ckpt_reshard_loads_total",
+        "checkpoint loads by layout relationship", ("kind",),
+    ).labels(kind="cross_topology" if cross_mesh else "same_topology").inc()
+    if tensors_resharded:
+        _tm.counter(
+            "paddle_tpu_ckpt_reshard_tensors_total",
+            "tensors whose placement at load differed from their saved layout",
+        ).inc(tensors_resharded)
 
 
 def load_state_dict(state_dict, path, process_group=None, coordinator_rank=0):
@@ -187,6 +238,12 @@ def load_state_dict(state_dict, path, process_group=None, coordinator_rank=0):
     flat = _flatten_state_dict(state_dict)
     mmap_cache: dict = {}  # one open mmap per shard file for this call
     missing = []
+    saved_mesh = getattr(meta, "mesh", None)
+    cross_mesh = (
+        saved_mesh is not None
+        and _sl.mesh_to_meta(_sl.global_mesh_or_none()) not in (None, saved_mesh)
+    )
+    tensors_resharded = 0
     for name, t in flat.items():
         tm = meta.state_dict_metadata.get(name) or meta.state_dict_metadata.get(meta.flat_mapping.get(name, ""))
         if tm is None:
@@ -198,6 +255,8 @@ def load_state_dict(state_dict, path, process_group=None, coordinator_rank=0):
             raise ValueError(f"'{name}': target shape {tuple(t.shape)} != saved {tuple(tm.global_shape)}")
         dtype = np.dtype(tm.dtype)
         sharding = t._value.sharding
+        if _sl.sharding_to_meta(sharding)["spec"] != getattr(tm, "partition_spec", None):
+            tensors_resharded += 1
         index_map = sharding.addressable_devices_indices_map(tuple(tm.global_shape))
         if index_map and tm.global_shape:
             per_device = []
@@ -220,4 +279,5 @@ def load_state_dict(state_dict, path, process_group=None, coordinator_rank=0):
         t._replace_value(new_val)
     if missing:
         raise KeyError(f"tensors missing from checkpoint: {missing}")
+    _record_reshard(tensors_resharded, cross_mesh)
     return state_dict

@@ -27,6 +27,14 @@ class TensorMetadata:
     global_shape: Tuple[int, ...]
     dtype: str
     shards: List[LocalTensorMetadata] = field(default_factory=list)
+    # the tensor's PartitionSpec AT SAVE TIME, serialized to plain tuples by
+    # spec_layout.spec_to_meta (None for unsharded/single-device tensors).
+    # Purely descriptive for the reshard-on-load path — the loader targets
+    # the DESTINATION placement and only needs the shard offsets above —
+    # but it lets tools and the reshard telemetry tell a topology change
+    # from a same-layout reload. getattr(..., "partition_spec", None) for
+    # pre-portability pickles.
+    partition_spec: Tuple = None
 
 
 @dataclass
@@ -38,6 +46,11 @@ class Metadata:
     # (default_factory keeps pickles from the pre-checksum format loadable —
     # readers must getattr(..., "file_checksums", {}).)
     file_checksums: Dict[str, int] = field(default_factory=dict)
+    # the SAVING mesh, serialized by spec_layout.mesh_to_meta:
+    # {"axes": [(name, size), ...], "n_devices": N}. None on pre-portability
+    # checkpoints and pure host-tensor saves. Loaders compare it against the
+    # current global mesh to count reshard-on-load events.
+    mesh: Dict = None
 
 
 def slices_overlap(off_a, shape_a, off_b, shape_b):

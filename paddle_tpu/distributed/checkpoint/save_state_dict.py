@@ -110,6 +110,33 @@ def _fsync_dir(d) -> None:
         os.close(fd)
 
 
+def prune_stale_old_steps(path) -> list:
+    """Remove `step_<N>.old` directories whose base `step_<N>/` exists and
+    is COMPLETE. A same-step overwrite that died between its two renames
+    leaves `.old` as the ONLY copy of step N — that one is load-bearing
+    (the loader falls back to it) and is kept; once a later save succeeds
+    the superseded trash can go. Returns the pruned directory names."""
+    pruned = []
+    if not os.path.isdir(path):
+        return pruned
+    for d in sorted(os.listdir(path)):
+        if not (d.startswith(STEP_PREFIX) and d.endswith(".old")):
+            continue
+        base = os.path.join(path, d[: -len(".old")])
+        if os.path.isdir(base) and os.path.exists(os.path.join(base, COMPLETE_MARKER)):
+            shutil.rmtree(os.path.join(path, d), ignore_errors=True)
+            pruned.append(d)
+    if pruned:
+        from ... import telemetry as _tm
+
+        if _tm.enabled():
+            _tm.counter(
+                "paddle_tpu_ckpt_old_dirs_pruned_total",
+                "stale step_<N>.old directories removed after a successful save",
+            ).inc(len(pruned))
+    return pruned
+
+
 def _record_save_metric(outcome: str) -> None:
     from ... import telemetry as _tm
 
@@ -146,13 +173,29 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
     os.makedirs(tmp_dir, exist_ok=True)
 
     try:
+        from ..sharding import spec_layout as _sl
+
         meta = Metadata()
+        # record the saving topology: the mesh the saved tensors ACTUALLY
+        # live on (first NamedSharding-placed tensor wins), falling back to
+        # the process-global mesh — the global one is process-wide state a
+        # prior fleet.init may have left behind and can misdescribe an
+        # auto-parallel save; loaders compare this against THEIR mesh to
+        # tell reshard from same-layout reload
+        tensor_mesh_meta = None
         file_idx = 0
         for name, t in flat.items():
             if not isinstance(t, Tensor):
                 t = Tensor(np.asarray(t))
             arr = t._value
-            tm = TensorMetadata(global_shape=tuple(arr.shape), dtype=str(np.dtype(arr.dtype)))
+            sharding_meta = _sl.sharding_to_meta(arr.sharding)
+            if tensor_mesh_meta is None and sharding_meta["mesh"] is not None:
+                tensor_mesh_meta = sharding_meta["mesh"]
+            tm = TensorMetadata(
+                global_shape=tuple(arr.shape),
+                dtype=str(np.dtype(arr.dtype)),
+                partition_spec=sharding_meta["spec"],
+            )
             for shard in arr.addressable_shards:
                 if shard.replica_id != 0:
                     continue  # replicas hold identical bytes; first replica writes
@@ -183,6 +226,7 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
 
         # metadata is written only after every shard it references landed;
         # each process writes its own piece (merged at load time)
+        meta.mesh = tensor_mesh_meta or _sl.mesh_to_meta(_sl.global_mesh_or_none())
         _fi.fault_point("ckpt.write_metadata", step=step)
         meta_path = os.path.join(tmp_dir, f"{proc}.metadata")
         with open(meta_path, "wb") as f:
@@ -209,6 +253,10 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
             else:
                 os.rename(tmp_dir, step_dir)  # atomic publish
             _fsync_dir(path)
+            # only after a successful publish: trash from same-step
+            # overwrites that died between their two renames is superseded
+            # now that a newer COMPLETE step exists
+            prune_stale_old_steps(path)
     except BaseException:
         _record_save_metric("failed")
         raise

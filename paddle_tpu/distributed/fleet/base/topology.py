@@ -109,15 +109,41 @@ class HybridCommunicateGroup:
 
     # ---- TPU-native surface ----
     def _build_mesh(self) -> Mesh:
+        # compile through the unified sharding layer: same axis order as the
+        # topology, registered as THE global mesh every strategy/checkpoint
+        # consumer resolves (lazy import: spec_layout's package pulls
+        # fleet.meta_parallel, which is mid-init when fleet.init first runs)
+        from ...sharding import spec_layout as _sl
+
         names = self._topo.get_hybrid_group_names()
         dims = [self._topo.get_dim(nm) for nm in names]
-        devs = np.array(jax.devices()[: self._topo.world_size()]).reshape(dims)
-        axes = tuple(self.AXIS_ALIAS.get(nm, nm) for nm in names)
-        return Mesh(devs, axes)
+        devs = jax.devices()[: self._topo.world_size()]
+        roles = [_sl.AXIS_TO_ROLE.get(self.AXIS_ALIAS.get(nm, nm), nm) for nm in names]
+        if all(r in _sl.CANONICAL_AXES for r in roles):
+            mesh = _sl.build_mesh(
+                **{r: d for r, d in zip(roles, dims)},
+                devices=devs,
+                axis_order=roles,
+            )
+        else:  # custom axis names pass through untranslated
+            mesh = Mesh(
+                np.array(devs).reshape(dims),
+                tuple(self.AXIS_ALIAS.get(nm, nm) for nm in names),
+            )
+        _sl.set_global_mesh(mesh)
+        return mesh
 
     @property
     def mesh(self) -> Mesh:
         return self._mesh
+
+    @property
+    def layout(self):
+        """The SpecLayout bound to this topology's mesh axis names — the
+        declarative table Fleet layers compile their shardings through."""
+        from ...sharding import spec_layout as _sl
+
+        return _sl.layout()
 
     @property
     def process_mesh(self):

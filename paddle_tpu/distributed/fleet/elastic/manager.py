@@ -19,6 +19,56 @@ from ...launch.master import KVClient
 
 ELASTIC_TIMEOUT = 30  # heartbeat staleness => node considered dead
 
+# canonical mesh roles + fleet-name aliases, mirrored from
+# distributed.sharding.spec_layout — NOT imported: this module runs inside
+# the launcher process, which must stay jax-free (spec_layout's package
+# init pulls the whole fleet stack). test_spec_layout pins the two
+# implementations together.
+CANONICAL_AXES = ("data", "fsdp", "tp", "pp", "sep")
+AXIS_TO_ROLE = {"dp": "data", "sharding": "fsdp", "mp": "tp", "pp": "pp", "sep": "sep"}
+
+
+def normalize_degrees(degrees=None):
+    """Accept canonical-role OR fleet-axis-name keys; warn on unknown keys
+    instead of silently dropping a parallel degree (spec_layout mirror)."""
+    out = {}
+    for k, v in (degrees or {}).items():
+        role = k if k in CANONICAL_AXES else AXIS_TO_ROLE.get(k)
+        if role is not None:
+            out[role] = int(v)
+        elif k != "world":
+            import sys
+
+            sys.stderr.write(
+                f"[elastic] ignoring unknown parallel-degree key {k!r} "
+                f"(known: {CANONICAL_AXES} or fleet names {tuple(AXIS_TO_ROLE)})\n"
+            )
+    return out
+
+
+def plan_elastic_degrees(n_devices, degrees=None):
+    """Largest valid mesh over `n_devices` survivors (jax-free mirror of
+    spec_layout.plan_elastic_degrees): model-parallel degrees keep their
+    largest feasible divisor — tp first (a weight shard that fit in HBM
+    before keeps fitting), then pp, sep, fsdp — and dp absorbs the shrink.
+    Returns the full canonical-degree dict plus "world" = devices used."""
+    degrees = normalize_degrees(degrees)
+    old = {r: max(1, int(degrees.get(r, 1))) for r in CANONICAL_AXES}
+    n_devices = max(1, int(n_devices))
+
+    def largest_fitting_divisor(n, budget):
+        return max(d for d in range(1, n + 1) if n % d == 0 and d <= budget)
+
+    fixed = 1
+    out = {}
+    for role in ("tp", "pp", "sep", "fsdp"):
+        d = largest_fitting_divisor(old[role], n_devices // fixed)
+        out[role] = d
+        fixed *= d
+    out["data"] = n_devices // fixed
+    out["world"] = out["data"] * fixed
+    return out
+
 
 class ElasticStatus:
     COMPLETED = "completed"
@@ -85,3 +135,20 @@ class ElasticManager:
         if len(nodes) < self.np:
             return ElasticStatus.RESTART if self.host in nodes else ElasticStatus.EXIT
         return ElasticStatus.RESTART
+
+    def plan_world(self, nproc_per_node: int = 1, degrees=None, nodes=None):
+        """The largest valid mesh over the survivors: device count = alive
+        nodes x procs/node, degrees = the old topology (tp/pp kept at their
+        largest feasible divisor, dp absorbing the shrink). The launch
+        controller exports this plan to relaunched workers so their
+        fleet.init lands on the mesh the reshard-on-load targets.
+
+        Pass `nodes` (the membership snapshot the caller already re-ranked
+        from) so the plan and the exported ranks can't disagree — a second
+        live alive_nodes() query here could see a different world if
+        another node dies between the two calls."""
+        if nodes is None:
+            nodes = self.alive_nodes()
+        return plan_elastic_degrees(
+            len(nodes) * max(1, int(nproc_per_node)), degrees
+        )

@@ -37,6 +37,43 @@ _state = _FleetState()
 
 _ORDER_TO_TOPO_NAME = {"dp": "data", "pp": "pipe", "sharding": "sharding", "sep": "sep", "mp": "model"}
 _DEGREE_KEY = {"dp": "dp_degree", "pp": "pp_degree", "sharding": "sharding_degree", "sep": "sep_degree", "mp": "mp_degree"}
+# canonical spec_layout role -> hybrid order key
+_ROLE_TO_ORDER = {"data": "dp", "pp": "pp", "fsdp": "sharding", "sep": "sep", "tp": "mp"}
+
+
+def _apply_elastic_plan(degrees, order):
+    """Honor PADDLE_ELASTIC_PLAN (exported by the launch controller's
+    `_elastic_restart`): after an elastic shrink the relaunched worker's
+    script still carries its ORIGINAL hybrid_configs, which no longer fit
+    the surviving world — fleet.init would die on 'topology world size >
+    available devices' and crash-loop the pod. The plan (canonical-role
+    degrees from ElasticManager.plan_world) overrides the strategy's
+    degrees so init lands on the mesh reshard-on-load targets."""
+    import json
+    import sys
+
+    raw = os.environ.get("PADDLE_ELASTIC_PLAN")
+    if not raw:
+        return degrees
+    try:
+        plan = json.loads(raw)
+        planned = {
+            order_key: int(plan.get(role, 1))
+            for role, order_key in _ROLE_TO_ORDER.items()
+        }
+    except Exception as e:
+        sys.stderr.write(
+            f"[fleet] ignoring unparseable PADDLE_ELASTIC_PLAN {raw!r} "
+            f"({type(e).__name__}: {e}) — keeping the strategy's degrees\n"
+        )
+        return degrees
+    new = {k: planned.get(k, 1) for k in order}
+    if new != degrees:
+        sys.stderr.write(
+            f"[fleet] elastic restart: overriding hybrid degrees {degrees} "
+            f"-> {new} from PADDLE_ELASTIC_PLAN\n"
+        )
+    return new
 
 
 def init(role_maker=None, is_collective: bool = False, strategy: Optional[DistributedStrategy] = None):
@@ -59,6 +96,7 @@ def init(role_maker=None, is_collective: bool = False, strategy: Optional[Distri
             known *= d
     if degrees.get("dp", 1) in (-1, 0):
         degrees["dp"] = max(1, world // known)
+    degrees = _apply_elastic_plan(degrees, order)
 
     names = [_ORDER_TO_TOPO_NAME[k] for k in order]
     dims = [degrees[k] for k in order]

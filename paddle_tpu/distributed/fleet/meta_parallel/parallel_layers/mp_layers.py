@@ -14,17 +14,15 @@ matmul contracting a sharded dim IS the row-parallel psum; a vocab-sharded
 gather compiles to the masked-lookup + all-reduce trick of mp_layers.py:47).
 `gather_output=False` / `input_is_parallel=True` become sharding constraints
 on activations rather than separate comm ops.
+
+All PartitionSpecs and placements here compile through the unified
+`distributed.sharding.spec_layout` table (SpecLayout.column_weight /
+row_weight / vocab_embedding / tp_activation) — no inline specs.
 """
 from __future__ import annotations
 
 from typing import Optional
 
-import jax
-from jax import numpy as jnp
-from jax.sharding import NamedSharding, PartitionSpec as P
-
-from .....core.apply import apply
-from .....core.tensor import Tensor
 from .....nn import functional as F
 from .....nn.initializer import Constant, XavierUniform
 from .....nn.layer import Layer
@@ -39,26 +37,27 @@ def _collective_matmul():
     return collective_matmul
 
 
+def _spec_layout():
+    # lazy: distributed.sharding.__init__ pulls fleet.meta_parallel, which
+    # is mid-init when this module first loads
+    from ....sharding import spec_layout
+
+    return spec_layout
+
+
 def _mp_mesh_axis():
     hcg = get_hybrid_communicate_group()
     if hcg is None:
         raise RuntimeError("fleet.init(...) with mp_degree > 1 must run before building mpu layers")
-    return hcg.mesh, "mp"
+    return hcg.mesh, hcg.layout.tp_axis
 
 
-def _put(param: Tensor, spec: P, mesh) -> None:
-    param._replace_value(jax.device_put(param._raw(), NamedSharding(mesh, spec)))
+def _put(param, spec, mesh) -> None:
+    _spec_layout().place(param, spec, mesh)
 
 
-def _constrain(t: Tensor, spec: P, mesh) -> Tensor:
-    sh = NamedSharding(mesh, spec)
-
-    def f(x):
-        if isinstance(x, jax.core.Tracer):
-            return jax.lax.with_sharding_constraint(x, sh)
-        return jax.device_put(x, sh)
-
-    return apply("shard_constraint", f, t)
+def _constrain(t, spec, mesh):
+    return _spec_layout().constrain(t, spec, mesh)
 
 
 def mark_as_sequence_parallel_parameter(param):
@@ -79,7 +78,7 @@ class VocabParallelEmbedding(Layer):
             default_initializer=XavierUniform(),
         )
         self.weight.is_distributed = True
-        _put(self.weight, P(axis, None), mesh)
+        _put(self.weight, _spec_layout().layout().vocab_embedding(), mesh)
 
     def forward(self, x):
         return F.embedding(x, self.weight)
@@ -107,13 +106,13 @@ class ColumnParallelLinear(Layer):
             shape=[in_features, out_features], attr=weight_attr, default_initializer=XavierUniform()
         )
         self.weight.is_distributed = True
-        _put(self.weight, P(None, axis), mesh)
+        _put(self.weight, _spec_layout().layout().column_weight(), mesh)
         if has_bias:
             self.bias = self.create_parameter(
                 shape=[out_features], attr=None, is_bias=True, default_initializer=Constant(0.0)
             )
             self.bias.is_distributed = True
-            _put(self.bias, P(axis), mesh)
+            _put(self.bias, _spec_layout().layout().column_bias(), mesh)
         else:
             self.bias = None
 
@@ -124,11 +123,12 @@ class ColumnParallelLinear(Layer):
             # decomposed mm→ag: row-chunked local matmul, each chunk's
             # column all-gather overlaps the next chunk's matmul
             return _cm.matmul_ag_cols(x, self.weight, self.bias, self._mesh, self._axis, sub)
+        lo = _spec_layout().layout()
         out = F.linear(x, self.weight, self.bias)
         if self.gather_output:
-            out = _constrain(out, P(*([None] * len(out.shape))), self._mesh)
+            out = _constrain(out, lo.replicated(len(out.shape)), self._mesh)
         else:
-            out = _constrain(out, P(*([None] * (len(out.shape) - 1) + [self._axis])), self._mesh)
+            out = _constrain(out, lo.tp_activation(len(out.shape)), self._mesh)
         return out
 
 
@@ -155,12 +155,15 @@ class RowParallelLinear(Layer):
             shape=[in_features, out_features], attr=weight_attr, default_initializer=XavierUniform()
         )
         self.weight.is_distributed = True
-        _put(self.weight, P(axis, None), mesh)
+        _put(self.weight, _spec_layout().layout().row_weight(), mesh)
         if has_bias:
-            # bias is applied AFTER the reduction -> replicated (mp_layers.py:541)
+            # bias is applied AFTER the reduction -> replicated (mp_layers.py:541);
+            # placed EXPLICITLY so a reshard-on-load targets the mesh
+            # placement instead of an uncommitted single-device default
             self.bias = self.create_parameter(
                 shape=[out_features], attr=None, is_bias=True, default_initializer=Constant(0.0)
             )
+            _put(self.bias, _spec_layout().layout().replicated(1), mesh)
         else:
             self.bias = None
 
@@ -173,7 +176,7 @@ class RowParallelLinear(Layer):
             # matmul (the bias stays post-reduction, reference :541)
             return _cm.matmul_ar(x, self.weight, self.bias, self._mesh, self._axis, sub)
         if self.input_is_parallel:
-            x = _constrain(x, P(*([None] * (len(x.shape) - 1) + [self._axis])), self._mesh)
+            x = _constrain(x, _spec_layout().layout().tp_activation(len(x.shape)), self._mesh)
         out = F.linear(x, self.weight, self.bias)
         return out
 
@@ -209,13 +212,13 @@ def _c_identity(tensor, group=None):
 def _c_concat(tensor, group=None):
     """Gather the mp-sharded last dim (forward of gather_output)."""
     mesh, axis = _mp_mesh_axis()
-    return _constrain(tensor, P(*([None] * len(tensor.shape))), mesh)
+    return _constrain(tensor, _spec_layout().layout().replicated(len(tensor.shape)), mesh)
 
 
 def _c_split(tensor, group=None):
     """Shard the last dim over mp."""
     mesh, axis = _mp_mesh_axis()
-    return _constrain(tensor, P(*([None] * (len(tensor.shape) - 1) + [axis])), mesh)
+    return _constrain(tensor, _spec_layout().layout().tp_activation(len(tensor.shape)), mesh)
 
 
 def _mp_allreduce(tensor, op=None, group=None, use_calc_stream=True, use_model_parallel=True):
@@ -223,7 +226,7 @@ def _mp_allreduce(tensor, op=None, group=None, use_calc_stream=True, use_model_p
     when the producing op contracted a sharded dim. Explicit call = gather
     constraint to the replicated layout."""
     mesh, axis = _mp_mesh_axis()
-    return _constrain(tensor, P(*([None] * len(tensor.shape))), mesh)
+    return _constrain(tensor, _spec_layout().layout().replicated(len(tensor.shape)), mesh)
 
 
 def split(x, size, operation, axis=0, num_partitions=1, gather_out=True, weight_attr=None, bias_attr=None, name=None):

@@ -11,16 +11,16 @@ design.
 from __future__ import annotations
 
 import jax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from .....core.tensor import Tensor
+from ....sharding import spec_layout as _sl
 
 
 def shard_axis_spec(shape, n: int, axis_name: str) -> P:
-    """First-dim sharding when divisible, else replicated."""
-    if len(shape) >= 1 and shape[0] % n == 0 and shape[0] > 0:
-        return P(*([axis_name] + [None] * (len(shape) - 1)))
-    return P(*([None] * len(shape)))
+    """First-dim sharding when divisible, else replicated — the ZeRO layout
+    from the unified SpecLayout table."""
+    return _sl.layout().fsdp_shard(shape, n, axis=axis_name)
 
 
 def place_sharded(t: Tensor, mesh: Mesh, axis_name: str, memory_kind=None) -> None:
@@ -29,22 +29,23 @@ def place_sharded(t: Tensor, mesh: Mesh, axis_name: str, memory_kind=None) -> No
     memory and XLA streams it to the device where used (the reference's
     offload=True cpu placement, group_sharded_stage3.py)."""
     n = mesh.shape[axis_name]
-    v = t._raw()
-    spec = shard_axis_spec(v.shape, n, axis_name)
-    sh = NamedSharding(mesh, spec, memory_kind=memory_kind) if memory_kind else NamedSharding(mesh, spec)
-    t._replace_value(jax.device_put(v, sh))
+    spec = shard_axis_spec(t._raw().shape, n, axis_name)
+    _sl.place(t, spec, mesh, memory_kind=memory_kind)
 
 
 def place_replicated(t: Tensor, mesh: Mesh) -> None:
-    v = t._raw()
-    t._replace_value(jax.device_put(v, NamedSharding(mesh, P(*([None] * v.ndim)))))
+    _sl.place(t, _sl.layout().replicated(t._raw().ndim), mesh)
 
 
 def group_mesh(group=None, axis_name: str = "sharding") -> Mesh:
-    """Mesh for a sharding group: the group's own 1-D mesh, or the hybrid
-    topology's mesh if a HybridCommunicateGroup is active."""
+    """Mesh for a sharding group: the group's own 1-D mesh, the global /
+    hybrid-topology mesh when it carries the axis, else a fresh 1-D mesh
+    over all devices."""
     if group is not None and hasattr(group, "mesh"):
         return group.mesh
+    gm = _sl.global_mesh_or_none()
+    if gm is not None and axis_name in gm.shape:
+        return gm
     from ...base.topology import get_hybrid_communicate_group
 
     hcg = get_hybrid_communicate_group()

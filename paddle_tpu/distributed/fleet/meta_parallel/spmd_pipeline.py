@@ -299,12 +299,21 @@ def pipeline_spmd_interleave(
     return run
 
 
+def _stacked_spec(ndim: int, axis: str) -> P:
+    """Leading-axis pp shard for stacked stage params — the SpecLayout
+    stage_stacked layout (spec built through the unified table so the
+    checkpoint/reshard layer sees the same naming)."""
+    from ...sharding import spec_layout as _sl
+
+    return _sl.SpecLayout(pp_axis=axis).stage_stacked(ndim)
+
+
 def stack_stage_params(param_trees, mesh: Mesh, axis: str = "pp"):
     """Stack S per-stage param pytrees on a new leading axis sharded over pp."""
     stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs, axis=0), *param_trees)
 
     def put(x):
-        return jax.device_put(x, NamedSharding(mesh, P(*([axis] + [None] * (x.ndim - 1)))))
+        return jax.device_put(x, NamedSharding(mesh, _stacked_spec(x.ndim, axis)))
 
     return jax.tree_util.tree_map(put, stacked)
 
@@ -322,7 +331,7 @@ def stack_stage_params_interleave(param_trees, mesh: Mesh, num_virtual_stages: i
 
     def put(x):
         # leading axis pp*v sharded over pp -> rank d holds rows [d*v, (d+1)*v)
-        return jax.device_put(x, NamedSharding(mesh, P(*([axis] + [None] * (x.ndim - 1)))))
+        return jax.device_put(x, NamedSharding(mesh, _stacked_spec(x.ndim, axis)))
 
     return jax.tree_util.tree_map(put, stacked)
 
@@ -434,7 +443,7 @@ def stack_stage_params_hetero(param_trees, mesh: Mesh, axis: str = "pp"):
     ]
     n_rows = len(rows)
     pp = mesh.shape[axis]
-    sharding = NamedSharding(mesh, P(axis, None))
+    sharding = NamedSharding(mesh, _stacked_spec(2, axis))
     try:
         if n_rows % pp != 0:
             raise ValueError("rows not evenly groupable over the mesh axis")

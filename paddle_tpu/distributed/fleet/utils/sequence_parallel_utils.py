@@ -12,16 +12,16 @@ GSPMD-inserted when layouts demand them. The PyLayer forward/backward pairs
 (scatter fwd/gather bwd etc.) collapse into differentiable relayouts — the
 vjp of a resharding is the opposite resharding, which is exactly the
 reference's autograd pairing.
+
+Specs compile through the unified `distributed.sharding.spec_layout` table
+(SpecLayout.seq_activation / replicated) like the mp layers.
 """
 from __future__ import annotations
 
-import jax
-from jax.sharding import NamedSharding, PartitionSpec as P
-
-from ....core.apply import apply
 from ....core.tensor import Tensor
 from ....nn.initializer import Constant, XavierUniform
 from ....nn.layer import Layer
+from ...sharding import spec_layout as _sl
 from ..base.topology import get_hybrid_communicate_group
 from ..meta_parallel.parallel_layers.mp_layers import ColumnParallelLinear, RowParallelLinear
 from . import collective_matmul as _cm
@@ -34,26 +34,16 @@ def _mesh():
     return hcg.mesh
 
 
-def _relayout(t: Tensor, spec: P) -> Tensor:
-    mesh = _mesh()
-    sh = NamedSharding(mesh, spec)
-
-    def f(x):
-        if isinstance(x, jax.core.Tracer):
-            return jax.lax.with_sharding_constraint(x, sh)
-        return jax.device_put(x, sh)
-
-    return apply("sp_relayout", f, t)
+def _relayout(t: Tensor, spec) -> Tensor:
+    return _sl.constrain(t, spec, _mesh())
 
 
-def _seq_spec(ndim: int, seq_axis: int = 0) -> P:
-    spec = [None] * ndim
-    spec[seq_axis] = "mp"
-    return P(*spec)
+def _seq_spec(ndim: int, seq_axis: int = 0):
+    return _sl.layout().seq_activation(ndim, seq_axis)
 
 
-def _rep_spec(ndim: int) -> P:
-    return P(*([None] * ndim))
+def _rep_spec(ndim: int):
+    return _sl.layout().replicated(ndim)
 
 
 class ScatterOp:

@@ -11,6 +11,7 @@ CPU-mesh testing via --nproc_per_node.
 """
 from __future__ import annotations
 
+import json
 import os
 import socket
 import sys
@@ -113,27 +114,88 @@ class CollectiveController:
     def _elastic_restart(self):
         """Membership changed: recompute node rank/world from the alive set
         and relaunch every local worker with re-ranked envs (the reference's
-        scale-event -> relaunch-with-new-ranks flow)."""
+        scale-event -> relaunch-with-new-ranks flow).
+
+        Elastic restarts spend the SAME jittered-backoff/budget accounting
+        as pod restarts (_apply_restart_backoff): a node flapping in and out
+        of the membership set would otherwise relaunch the pod in a tight
+        loop with an unmetered budget. Returns False when the restart budget
+        is exhausted (the watch loop then tears down) or this node fell out
+        of the alive set."""
         nodes = self.elastic.alive_nodes()
         if self.elastic.host not in nodes:
             return False
         args = self.ctx.args
+        if args.max_restart > 0 and self.consecutive_restarts >= args.max_restart:
+            print(
+                f"[launch] elastic: restart budget exhausted "
+                f"({self.consecutive_restarts}/{args.max_restart} since last "
+                "healthy window), giving up",
+                file=sys.stderr,
+            )
+            return False
+        prev_world = args.nnodes * args.nproc_per_node
         args.nnodes = len(nodes)
         args.node_rank = nodes.index(self.elastic.host)
         self.elastic.np = len(nodes)
+        new_world = args.nnodes * args.nproc_per_node
+        # the largest valid mesh over the survivors: degrees come from
+        # PADDLE_ELASTIC_DEGREES on the controller (JSON, e.g. '{"tp":2}');
+        # the plan is exported to every relaunched worker so fleet.init
+        # lands on the mesh reshard-on-load targets
+        try:
+            degrees = json.loads(os.environ.get("PADDLE_ELASTIC_DEGREES", "{}"))
+            if not isinstance(degrees, dict):
+                raise TypeError(f"expected a JSON object, got {type(degrees).__name__}")
+        except Exception as e:
+            print(
+                f"[launch] unusable PADDLE_ELASTIC_DEGREES "
+                f"({type(e).__name__}: {e}) — planning with tp=pp=1",
+                file=sys.stderr,
+            )
+            degrees = {}
+        # plan from the SAME membership snapshot the re-rank above used —
+        # a fresh query could disagree if another node died meanwhile
+        plan = self.elastic.plan_world(args.nproc_per_node, degrees, nodes=nodes)
         print(
             f"[launch] elastic scale event: nodes={nodes} -> re-rank "
-            f"node_rank={args.node_rank} world={args.nnodes * args.nproc_per_node}",
+            f"node_rank={args.node_rank} world={new_world} "
+            f"mesh plan={plan}",
             file=sys.stderr,
         )
+        _launch_metric(
+            "paddle_tpu_launch_elastic_restarts_total",
+            "pod relaunches from elastic membership changes",
+        )
         self.pod.stop(force=True)
+        self._apply_restart_backoff()
         self.pod = Pod()
         self.build_pod()
+        reshard_env = {
+            "PADDLE_ELASTIC_RESTARTS": str(self.elastic_restarts + 1),
+            "PADDLE_ELASTIC_PREV_WORLD": str(prev_world),
+            "PADDLE_ELASTIC_PLAN": json.dumps(plan),
+        }
+        for c in self.pod.containers:
+            c.env.update(reshard_env)
         self.pod.deploy()
         self.elastic_restarts += 1
         return True
 
     # ---- restart budget + backoff ----
+    def _apply_restart_backoff(self) -> None:
+        """The shared jittered-backoff accounting: sleep the doubling
+        full-jitter delay, then count this restart against the budget that
+        _maybe_reset_restart_budget returns after a healthy window."""
+        base = getattr(self.ctx.args, "restart_backoff", 0.5)
+        if base > 0:
+            delay = backoff_delay(self.consecutive_restarts, base, RESTART_BACKOFF_CAP_S)
+            print(f"[launch] restart backoff {delay:.2f}s "
+                  f"(consecutive={self.consecutive_restarts + 1})", file=sys.stderr)
+            time.sleep(delay)
+        self.consecutive_restarts += 1
+        self.last_restart_t = time.monotonic()
+
     def _restart_pod(self, why: str) -> None:
         """Terminate + reap every container, back off, redeploy.
 
@@ -153,14 +215,7 @@ class CollectiveController:
         # device lock, and an unreaped Popen is a zombie
         for c in self.pod.containers:
             c.wait(timeout=10)
-        base = getattr(self.ctx.args, "restart_backoff", 0.5)
-        if base > 0:
-            delay = backoff_delay(self.consecutive_restarts, base, RESTART_BACKOFF_CAP_S)
-            print(f"[launch] restart backoff {delay:.2f}s "
-                  f"(consecutive={self.consecutive_restarts + 1})", file=sys.stderr)
-            time.sleep(delay)
-        self.consecutive_restarts += 1
-        self.last_restart_t = time.monotonic()
+        self._apply_restart_backoff()
         self.pod.deploy()
 
     def _maybe_reset_restart_budget(self) -> None:

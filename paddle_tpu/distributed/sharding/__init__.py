@@ -15,8 +15,30 @@ from ..fleet.meta_parallel.sharding import (
     GroupShardedStage2,
     GroupShardedStage3,
 )
+from .spec_layout import (  # noqa: F401 — the unified sharding surface
+    DEFAULT_LAYOUT,
+    LayoutTable,
+    SpecLayout,
+    build_mesh,
+    global_mesh,
+    largest_valid_mesh,
+    plan_elastic_degrees,
+    set_global_mesh,
+    transformer_layout_table,
+)
 
-__all__ = ["group_sharded_parallel", "save_group_sharded_model"]
+__all__ = [
+    "group_sharded_parallel",
+    "save_group_sharded_model",
+    "SpecLayout",
+    "LayoutTable",
+    "build_mesh",
+    "global_mesh",
+    "set_global_mesh",
+    "largest_valid_mesh",
+    "plan_elastic_degrees",
+    "transformer_layout_table",
+]
 
 
 def group_sharded_parallel(

@@ -1,0 +1,462 @@
+"""Unified mesh / SpecLayout sharding layer.
+
+Every Fleet strategy (DP/TP/PP/ZeRO/SP) used to roll its own PartitionSpec
+plumbing — mp_layers built `P(None, "mp")` by hand, the group-sharded stages
+computed first-divisible-dim specs locally, the SPMD pipeline stacked stage
+params with an inline spec, and the dryrun's ERNIE step carried a private
+name→spec function. This module is the one place all of them compile
+through (ROADMAP item 2; SNIPPETS [2] `SpecLayout` canonical per-weight
+specs over named axes, [3] one global named mesh):
+
+- ONE GLOBAL NAMED MESH. `build_mesh(...)` constructs the multi-axis jax
+  Mesh from parallel degrees; `fleet.init` registers the hybrid topology's
+  mesh here via `set_global_mesh`, and `global_mesh()` is the single
+  resolution point every layer/stage/checkpoint consumer asks. Axis naming:
+  the CANONICAL roles are `data` / `fsdp` / `tp` / `pp` / `sep`; the mesh
+  axis NAMES stay the fleet short forms (`dp` / `sharding` / `mp` / `pp` /
+  `sep`) so existing PartitionSpecs, shard_map bodies, and tests keep
+  working — `SpecLayout` owns the role→axis-name mapping.
+
+- A DECLARATIVE PER-PARAMETER TABLE. `SpecLayout` names the canonical
+  layouts (column/row/vocab-parallel weights, seq-sharded activations,
+  first-divisible ZeRO shards, pp-stacked stage params); `LayoutTable`
+  resolves parameter NAMES to those layouts through ordered glob rules, so
+  a model's whole sharding story is a readable table instead of branchy
+  code (`transformer_layout_table` is the Megatron-TP + ZeRO-DP instance
+  the dryrun and tests drive).
+
+- TOPOLOGY PORTABILITY. `sharding_to_meta` / `meta_to_spec` /
+  `mesh_to_meta` serialize a tensor's PartitionSpec and the saving mesh
+  into checkpoint metadata (plain tuples/dicts — no jax objects in
+  pickles), and `largest_valid_mesh` is the elastic-restart policy: given
+  the surviving device count, pick the biggest usable mesh that keeps the
+  model-parallel degrees intact (shrinking them to divisors only when the
+  survivors force it), dp absorbing the loss. Pure arithmetic lives in
+  `plan_elastic_degrees` (re-exported by fleet.elastic.manager, which must
+  stay importable without jax in the launcher process).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import fnmatch
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# canonical role -> fleet mesh axis name (the short names predate this
+# module; renaming the axes would break every P("mp")-style spec in tests
+# and user code, so the mapping lives here instead)
+CANONICAL_AXES = ("data", "fsdp", "tp", "pp", "sep")
+ROLE_TO_AXIS = {"data": "dp", "fsdp": "sharding", "tp": "mp", "pp": "pp", "sep": "sep"}
+AXIS_TO_ROLE = {v: k for k, v in ROLE_TO_AXIS.items()}
+
+
+# ---------------------------------------------------------------------------
+# the one global mesh
+# ---------------------------------------------------------------------------
+
+_global_mesh: Optional[Mesh] = None
+
+
+def set_global_mesh(mesh: Optional[Mesh]) -> None:
+    """Register THE mesh every strategy shards through (fleet.init does
+    this with the hybrid topology's mesh)."""
+    global _global_mesh
+    _global_mesh = mesh
+
+
+def global_mesh_or_none() -> Optional[Mesh]:
+    return _global_mesh
+
+
+def global_mesh() -> Mesh:
+    """The registered global mesh, falling back to the active hybrid
+    topology's mesh, falling back to a 1-axis data mesh over all devices."""
+    if _global_mesh is not None:
+        return _global_mesh
+    from ..fleet.base.topology import get_hybrid_communicate_group
+
+    hcg = get_hybrid_communicate_group()
+    if hcg is not None:
+        return hcg.mesh
+    return Mesh(np.array(jax.devices()), (ROLE_TO_AXIS["data"],))
+
+
+def build_mesh(
+    data: int = 1,
+    fsdp: int = 1,
+    tp: int = 1,
+    pp: int = 1,
+    sep: int = 1,
+    devices: Optional[Sequence] = None,
+    axis_order: Sequence[str] = ("data", "pp", "fsdp", "sep", "tp"),
+    dp: Optional[int] = None,
+) -> Mesh:
+    """Build the global named mesh from canonical-role parallel degrees
+    (`dp` accepted as an alias for `data`). `axis_order` matches the hybrid
+    topology's default order (data outermost, tp innermost =
+    fastest-varying, the ICI-friendliest placement)."""
+    degrees = {"data": dp if dp is not None else data,
+               "fsdp": fsdp, "tp": tp, "pp": pp, "sep": sep}
+    dims = [int(degrees[r]) for r in axis_order]
+    world = int(np.prod(dims))
+    devs = list(devices) if devices is not None else jax.devices()
+    if world > len(devs):
+        raise ValueError(f"mesh {dict(zip(axis_order, dims))} needs {world} devices, have {len(devs)}")
+    arr = np.array(devs[:world]).reshape(dims)
+    return Mesh(arr, tuple(ROLE_TO_AXIS[r] for r in axis_order))
+
+
+def mesh_degrees(mesh: Mesh) -> Dict[str, int]:
+    """Canonical-role degrees of a mesh (axes it lacks report 1)."""
+    out = {r: 1 for r in CANONICAL_AXES}
+    for name, size in zip(mesh.axis_names, mesh.devices.shape):
+        role = AXIS_TO_ROLE.get(name, name)
+        out[role] = int(size)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# SpecLayout: the canonical per-weight / per-activation layouts
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class SpecLayout:
+    """Canonical PartitionSpecs over the named mesh axes.
+
+    One instance per mesh naming convention; every Fleet layer asks this
+    object for its spec instead of constructing PartitionSpecs inline, so
+    the whole sharding story is auditable (and re-mappable) in one place.
+    """
+
+    data_axis: str = ROLE_TO_AXIS["data"]
+    fsdp_axis: str = ROLE_TO_AXIS["fsdp"]
+    tp_axis: str = ROLE_TO_AXIS["tp"]
+    pp_axis: str = ROLE_TO_AXIS["pp"]
+    sep_axis: str = ROLE_TO_AXIS["sep"]
+
+    # ---- weights ----
+    def replicated(self, ndim: int) -> P:
+        return P(*([None] * ndim))
+
+    def column_weight(self) -> P:
+        """[in, out] with the OUTPUT dim tp-sharded (Megatron column)."""
+        return P(None, self.tp_axis)
+
+    def column_bias(self) -> P:
+        """Column-parallel bias rides the sharded output dim."""
+        return P(self.tp_axis)
+
+    def row_weight(self) -> P:
+        """[in, out] with the INPUT dim tp-sharded (Megatron row) — the
+        contraction over it IS the partial-sum all-reduce."""
+        return P(self.tp_axis, None)
+
+    def vocab_embedding(self) -> P:
+        """[vocab, hidden] with the vocab dim tp-sharded."""
+        return P(self.tp_axis, None)
+
+    def fsdp_shard(self, shape: Sequence[int], degree: int, axis: Optional[str] = None) -> P:
+        """ZeRO-style first-divisible-dim shard over the fsdp/sharding axis
+        (replicated when nothing divides)."""
+        ax = axis or self.fsdp_axis
+        if len(shape) >= 1 and shape[0] > 0 and shape[0] % max(1, degree) == 0:
+            return P(*([ax] + [None] * (len(shape) - 1)))
+        return P(*([None] * len(shape)))
+
+    # ---- activations ----
+    def batch_activation(self, ndim: int, batch_axis: int = 0) -> P:
+        spec: List[Optional[str]] = [None] * ndim
+        spec[batch_axis] = self.data_axis
+        return P(*spec)
+
+    def seq_activation(self, ndim: int, seq_axis: int = 0) -> P:
+        """Sequence-parallel activation: seq dim sharded over tp between TP
+        regions (Megatron-SP)."""
+        spec: List[Optional[str]] = [None] * ndim
+        spec[seq_axis] = self.tp_axis
+        return P(*spec)
+
+    def tp_activation(self, ndim: int, feature_axis: int = -1) -> P:
+        """Activation leaving a column-parallel layer: last (feature) dim
+        tp-sharded."""
+        spec: List[Optional[str]] = [None] * ndim
+        spec[feature_axis] = self.tp_axis
+        return P(*spec)
+
+    # ---- pipeline ----
+    def stage_stacked(self, ndim: int, inner: Optional[P] = None) -> P:
+        """Per-stage params stacked on a leading pp-sharded axis; `inner`
+        optionally shards the per-stage dims (e.g. tp on a weight dim)."""
+        if inner is not None:
+            tail = list(tuple(inner))
+        else:
+            tail = [None] * (ndim - 1)
+        tail = (tail + [None] * (ndim - 1 - len(tail)))[: ndim - 1]
+        return P(*([self.pp_axis] + tail))
+
+
+# one default instance bound to the fleet short names — the layout nearly
+# every caller wants; fleet.init exposes it as hcg.layout too
+DEFAULT_LAYOUT = SpecLayout()
+
+
+def layout() -> SpecLayout:
+    """The active SpecLayout (the default naming unless a topology installs
+    another)."""
+    return DEFAULT_LAYOUT
+
+
+# ---------------------------------------------------------------------------
+# LayoutTable: declarative name -> spec rules
+# ---------------------------------------------------------------------------
+
+# role name -> resolver(layout, shape) for table entries given as strings
+_ROLE_RESOLVERS: Dict[str, Callable[[SpecLayout, Tuple[int, ...]], P]] = {
+    "column": lambda lo, sh: lo.column_weight(),
+    "column_bias": lambda lo, sh: lo.column_bias(),
+    "row": lambda lo, sh: lo.row_weight(),
+    "vocab": lambda lo, sh: lo.vocab_embedding(),
+    "replicated": lambda lo, sh: lo.replicated(len(sh)),
+}
+
+
+class LayoutTable:
+    """Ordered (glob-pattern, role) rules mapping parameter names to
+    PartitionSpecs — the declarative per-parameter SpecLayout table.
+
+    `role` is a string key into the canonical layouts ("column", "row",
+    "vocab", "replicated", "fsdp:<degree>") or a callable
+    (layout, name, shape) -> PartitionSpec for anything bespoke. First
+    matching rule wins; unmatched names fall back to `default` (a role or
+    callable, "replicated" unless told otherwise).
+    """
+
+    def __init__(
+        self,
+        rules: Sequence[Tuple[str, Union[str, Callable]]],
+        layout: SpecLayout = DEFAULT_LAYOUT,
+        default: Union[str, Callable] = "replicated",
+    ):
+        self.layout = layout
+        self.rules = list(rules)
+        self.default = default
+
+    def _resolve(self, entry, name: str, shape: Tuple[int, ...]) -> P:
+        if callable(entry):
+            return entry(self.layout, name, shape)
+        if entry.startswith("fsdp:"):
+            return self.layout.fsdp_shard(shape, int(entry.split(":", 1)[1]))
+        try:
+            return _ROLE_RESOLVERS[entry](self.layout, shape)
+        except KeyError:
+            raise ValueError(f"unknown layout role {entry!r} for {name!r}") from None
+
+    def spec_for(self, name: str, shape: Sequence[int]) -> P:
+        shape = tuple(int(s) for s in shape)
+        for pattern, entry in self.rules:
+            if fnmatch.fnmatchcase(name, pattern):
+                return self._resolve(entry, name, shape)
+        return self._resolve(self.default, name, shape)
+
+    def specs_for(self, named_shapes: Dict[str, Sequence[int]]) -> Dict[str, P]:
+        return {k: self.spec_for(k, v) for k, v in named_shapes.items()}
+
+
+def transformer_layout_table(
+    dp: int = 1, layout: SpecLayout = DEFAULT_LAYOUT
+) -> LayoutTable:
+    """The Megatron-TP + ZeRO-DP table for the repo's transformer stacks
+    (ERNIE/Llama naming): qkv + ffn-in column-parallel, out-proj + ffn-out
+    row-parallel, embeddings vocab-sharded, everything 2-D else ZeRO-sharded
+    over dp when divisible, 1-D state dp-sharded when divisible."""
+
+    def _fallback(lo: SpecLayout, name: str, shape):
+        if len(shape) == 2:
+            return lo.fsdp_shard(shape, dp, axis=lo.data_axis)
+        if len(shape) == 1 and shape[0] >= dp:
+            return lo.fsdp_shard(shape, dp, axis=lo.data_axis)
+        return lo.replicated(len(shape))
+
+    return LayoutTable(
+        rules=[
+            ("*q_proj.weight", "column"),
+            ("*k_proj.weight", "column"),
+            ("*v_proj.weight", "column"),
+            ("*qkv_proj.weight", "column"),
+            ("*linear1.weight", "column"),
+            ("*gate_proj.weight", "column"),
+            ("*up_proj.weight", "column"),
+            ("*out_proj.weight", "row"),
+            ("*down_proj.weight", "row"),
+            ("*linear2.weight", "row"),
+            ("*word_embeddings.weight", "vocab"),
+        ],
+        layout=layout,
+        default=_fallback,
+    )
+
+
+# ---------------------------------------------------------------------------
+# placement helpers (the one implementation mp_layers / SP / ZeRO share)
+# ---------------------------------------------------------------------------
+
+
+def named_sharding(spec: P, mesh: Optional[Mesh] = None, memory_kind=None) -> NamedSharding:
+    mesh = mesh if mesh is not None else global_mesh()
+    if memory_kind:
+        return NamedSharding(mesh, spec, memory_kind=memory_kind)
+    return NamedSharding(mesh, spec)
+
+
+def place(param, spec: P, mesh: Optional[Mesh] = None, memory_kind=None) -> None:
+    """Re-place a framework Tensor's value under `spec` (in place). Eager
+    path: physically moves the bytes."""
+    param._replace_value(
+        jax.device_put(param._raw(), named_sharding(spec, mesh, memory_kind))
+    )
+
+
+def constrain(t, spec: P, mesh: Optional[Mesh] = None):
+    """Differentiable relayout: with_sharding_constraint under trace,
+    device_put eagerly (the vjp of a resharding is the opposite resharding,
+    so the reference's PyLayer fwd/bwd pairs collapse into this)."""
+    from ...core.apply import apply
+
+    sh = named_sharding(spec, mesh)
+
+    def f(x):
+        if isinstance(x, jax.core.Tracer):
+            return jax.lax.with_sharding_constraint(x, sh)
+        return jax.device_put(x, sh)
+
+    return apply("shard_constraint", f, t)
+
+
+# ---------------------------------------------------------------------------
+# serialization: PartitionSpec / mesh <-> checkpoint metadata
+# ---------------------------------------------------------------------------
+
+
+def spec_to_meta(spec) -> Optional[Tuple]:
+    """PartitionSpec -> plain nested tuples (None | str | tuple-of-str per
+    dim) safe to pickle into checkpoint metadata."""
+    if spec is None:
+        return None
+    out = []
+    for entry in tuple(spec):
+        if entry is None or isinstance(entry, str):
+            out.append(entry)
+        else:  # multi-axis dim sharding, e.g. ("sharding", "mp")
+            out.append(tuple(str(a) for a in entry))
+    return tuple(out)
+
+
+def meta_to_spec(meta) -> Optional[P]:
+    if meta is None:
+        return None
+    return P(*[tuple(e) if isinstance(e, (list, tuple)) else e for e in meta])
+
+
+def mesh_to_meta(mesh: Optional[Mesh]) -> Optional[Dict]:
+    """Mesh -> {"axes": [(name, size), ...], "n_devices": N} (the saving
+    topology, recorded so a loader can tell reshard from same-layout)."""
+    if mesh is None:
+        return None
+    return {
+        "axes": [(str(n), int(s)) for n, s in zip(mesh.axis_names, mesh.devices.shape)],
+        "n_devices": int(mesh.devices.size),
+    }
+
+
+def sharding_to_meta(sharding) -> Dict:
+    """jax sharding -> {"spec": ..., "mesh": ...} (both None for shardings
+    that aren't NamedShardings — e.g. SingleDeviceSharding — which are
+    replicated-equivalent for checkpoint purposes)."""
+    spec = getattr(sharding, "spec", None)
+    mesh = getattr(sharding, "mesh", None)
+    try:
+        mesh_meta = mesh_to_meta(mesh) if isinstance(mesh, Mesh) else None
+    except Exception:
+        mesh_meta = None
+    return {"spec": spec_to_meta(spec), "mesh": mesh_meta}
+
+
+# ---------------------------------------------------------------------------
+# elastic policy: largest valid mesh over survivors
+# ---------------------------------------------------------------------------
+
+
+def normalize_degrees(degrees: Optional[Dict[str, int]]) -> Dict[str, int]:
+    """Degree dicts may arrive keyed by canonical role (data/fsdp/tp/...)
+    OR by the fleet axis name (dp/sharding/mp/...) — operators use both.
+    Normalize to canonical roles; a key this module doesn't know is almost
+    certainly a typo that would silently drop a parallel degree (e.g.
+    {"tp ": 8} planning tp=1 and resharding the model fully replicated),
+    so it warns loudly instead of vanishing. "world" (a prior plan's
+    output) passes through silently."""
+    out: Dict[str, int] = {}
+    for k, v in (degrees or {}).items():
+        role = k if k in CANONICAL_AXES else AXIS_TO_ROLE.get(k)
+        if role is not None:
+            out[role] = int(v)
+        elif k != "world":
+            import sys
+
+            sys.stderr.write(
+                f"[spec_layout] ignoring unknown parallel-degree key {k!r} "
+                f"(known: {CANONICAL_AXES} or fleet names {tuple(AXIS_TO_ROLE)})\n"
+            )
+    return out
+
+
+def plan_elastic_degrees(
+    n_devices: int, degrees: Optional[Dict[str, int]] = None
+) -> Dict[str, int]:
+    """Pure arithmetic: the largest usable topology on `n_devices` given
+    the old degrees (canonical roles or fleet axis names — see
+    normalize_degrees). Model-parallel degrees keep their largest feasible
+    divisor — greedily, tp first (a weight shard that fit in HBM before
+    keeps fitting), then pp, sep, fsdp — and dp absorbs the shrink
+    (dp >= 1 always). Returns a full canonical-degree dict plus "world" =
+    the device count actually used (<= n_devices; survivors beyond the
+    largest divisible world idle rather than force an invalid mesh).
+
+    Mirrored (not imported) by fleet.elastic.manager so the launcher
+    process stays jax-free; test_spec_layout pins the two implementations
+    together.
+    """
+    degrees = normalize_degrees(degrees)
+    old = {r: max(1, int(degrees.get(r, 1))) for r in CANONICAL_AXES}
+    n_devices = max(1, int(n_devices))
+
+    def largest_fitting_divisor(n, budget):
+        return max(d for d in range(1, n + 1) if n % d == 0 and d <= budget)
+
+    fixed = 1
+    out = {}
+    for role in ("tp", "pp", "sep", "fsdp"):
+        d = largest_fitting_divisor(old[role], n_devices // fixed)
+        out[role] = d
+        fixed *= d
+    out["data"] = n_devices // fixed
+    out["world"] = out["data"] * fixed
+    return out
+
+
+def largest_valid_mesh(
+    n_devices: int,
+    degrees: Optional[Dict[str, int]] = None,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """The elastic-restart mesh: plan degrees over the survivors and build
+    the global mesh on the first `world` usable devices."""
+    plan = plan_elastic_degrees(n_devices, degrees)
+    return build_mesh(
+        data=plan["data"], fsdp=plan["fsdp"], tp=plan["tp"], pp=plan["pp"],
+        sep=plan["sep"], devices=devices,
+    )

@@ -110,6 +110,99 @@ def test_elastic_manager_membership():
         srv.stop()
 
 
+class _StubElastic:
+    """Minimal ElasticManager stand-in: a fixed alive set, real plan math."""
+
+    def __init__(self, nodes, host="hostA"):
+        self._nodes = nodes
+        self.host = host
+        self.np = len(nodes) + 1
+
+    def alive_nodes(self):
+        return list(self._nodes)
+
+    def plan_world(self, nproc_per_node=1, degrees=None, nodes=None):
+        from paddle_tpu.distributed.fleet.elastic.manager import plan_elastic_degrees
+
+        # the controller must hand over ITS membership snapshot so plan and
+        # ranks can't disagree (a fresh alive_nodes() here could differ)
+        assert nodes is not None, "controller must plan from its own snapshot"
+        return plan_elastic_degrees(len(nodes) * nproc_per_node, degrees)
+
+
+def test_elastic_restart_spends_backoff_budget_and_exports_plan(tmp_path, monkeypatch):
+    """Satellite r10: _elastic_restart goes through the SAME jittered
+    backoff + consecutive-restart accounting as pod restarts (it used to
+    bypass both), and exports the largest-valid-mesh plan to the relaunched
+    workers."""
+    import paddle_tpu.distributed.launch.controller as ctrl_mod
+
+    script = tmp_path / "w.py"
+    script.write_text("import time; time.sleep(0.1)\n")
+    args = parse_args([
+        "--nnodes", "2", "--node_rank", "0", "--nproc_per_node", "1",
+        "--restart_backoff", "0.01", "--max_restart", "2",
+        "--poll_interval", "0.1", str(script),
+    ])
+    controller = CollectiveController(Context(args))
+    controller.elastic = _StubElastic(["hostA"])
+    controller.build_pod()
+    delays = []
+    monkeypatch.setattr(ctrl_mod.time, "sleep", lambda d: delays.append(d))
+    monkeypatch.setenv("PADDLE_ELASTIC_DEGREES", '{"tp": 1}')
+    try:
+        assert controller._elastic_restart() is True
+        assert controller.consecutive_restarts == 1, "elastic restart must spend the budget"
+        assert controller.last_restart_t is not None
+        assert len(delays) == 1 and delays[0] >= 0.0, "jittered backoff must be applied"
+        env = controller.pod.containers[0].env
+        assert env["PADDLE_ELASTIC_RESTARTS"] == "1"
+        assert env["PADDLE_ELASTIC_PREV_WORLD"] == "2"
+        plan = json.loads(env["PADDLE_ELASTIC_PLAN"])
+        assert plan["world"] == 1 and plan["tp"] == 1 and plan["data"] == 1
+
+        # valid JSON but not an object must not kill the controller mid-recovery
+        monkeypatch.setenv("PADDLE_ELASTIC_DEGREES", "[2, 4]")
+        assert controller._elastic_restart() is True
+        assert controller.consecutive_restarts == 2 and len(delays) == 2
+        assert json.loads(controller.pod.containers[0].env["PADDLE_ELASTIC_PLAN"])["world"] == 1
+        # budget exhausted: the third membership flap refuses to relaunch
+        assert controller._elastic_restart() is False
+        assert controller.consecutive_restarts == 2
+    finally:
+        controller.pod.stop(force=True)
+
+
+def test_elastic_restart_budget_returns_after_healthy_window(tmp_path, monkeypatch):
+    """The healthy-window reset covers elastic restarts too: a pod that ran
+    clean earns its elastic budget back, exactly like pod restarts."""
+    import paddle_tpu.distributed.launch.controller as ctrl_mod
+
+    script = tmp_path / "w.py"
+    script.write_text("import time; time.sleep(0.1)\n")
+    args = parse_args([
+        "--nnodes", "2", "--node_rank", "0", "--restart_backoff", "0.01",
+        "--max_restart", "1", "--restart_healthy_window", "0.01",
+        "--poll_interval", "0.1", str(script),
+    ])
+    controller = CollectiveController(Context(args))
+    controller.elastic = _StubElastic(["hostA"])
+    controller.build_pod()
+    monkeypatch.setattr(ctrl_mod.time, "sleep", lambda d: None)
+    try:
+        assert controller._elastic_restart() is True
+        assert controller._elastic_restart() is False  # budget gone
+        # fake a healthy window: the last restart was long ago, pod clean
+        controller.last_restart_t = ctrl_mod.time.monotonic() - 10.0
+        for c in controller.pod.containers:
+            c.wait(timeout=10)
+        controller._maybe_reset_restart_budget()
+        assert controller.consecutive_restarts == 0
+        assert controller._elastic_restart() is True  # budget earned back
+    finally:
+        controller.pod.stop(force=True)
+
+
 def test_elastic_scale_event_relaunches_with_new_ranks(tmp_path):
     """VERDICT r1: peer death must trigger relaunch with re-ranked envs
     through the launcher (reference ElasticManager scale flow)."""

@@ -1,0 +1,240 @@
+"""Unified mesh/SpecLayout sharding layer (round 10): the one global mesh,
+the declarative per-parameter table, serialization for checkpoint metadata,
+and the elastic largest-valid-mesh policy."""
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet.elastic import manager as elastic_manager
+from paddle_tpu.distributed.sharding import spec_layout as sl
+
+
+def _fleet_init(**hybrid):
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = hybrid
+    fleet.init(is_collective=True, strategy=strategy)
+
+
+# ---------------------------------------------------------------------------
+# the global mesh
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_init_registers_the_global_mesh():
+    _fleet_init(dp_degree=4, mp_degree=2)
+    mesh = sl.global_mesh()
+    assert mesh.shape["dp"] == 4 and mesh.shape["mp"] == 2
+    assert mesh is fleet.get_hybrid_communicate_group().mesh
+    assert sl.mesh_degrees(mesh) == {"data": 4, "fsdp": 1, "tp": 2, "pp": 1, "sep": 1}
+
+
+def test_build_mesh_axis_order_and_bounds():
+    mesh = sl.build_mesh(data=2, tp=2, pp=2)
+    assert mesh.devices.shape == (2, 2, 1, 1, 2)
+    assert mesh.axis_names == ("dp", "pp", "sharding", "sep", "mp")
+    with pytest.raises(ValueError):
+        sl.build_mesh(data=16, tp=2)
+
+
+# ---------------------------------------------------------------------------
+# SpecLayout canonical layouts
+# ---------------------------------------------------------------------------
+
+
+def test_canonical_layout_specs():
+    lo = sl.layout()
+    assert lo.column_weight() == P(None, "mp")
+    assert lo.column_bias() == P("mp")
+    assert lo.row_weight() == P("mp", None)
+    assert lo.vocab_embedding() == P("mp", None)
+    assert lo.replicated(2) == P(None, None)
+    assert lo.seq_activation(3) == P("mp", None, None)
+    assert lo.tp_activation(3) == P(None, None, "mp")
+    assert lo.batch_activation(2) == P("dp", None)
+    assert lo.stage_stacked(3) == P("pp", None, None)
+    assert lo.stage_stacked(3, inner=P(None, "mp")) == P("pp", None, "mp")
+    # ZeRO first-divisible-dim shard
+    assert lo.fsdp_shard((8, 4), 4) == P("sharding", None)
+    assert lo.fsdp_shard((6, 4), 4) == P(None, None)
+    assert lo.fsdp_shard((8,), 4, axis="dp") == P("dp")
+
+
+def test_mp_layers_compile_through_the_table():
+    _fleet_init(dp_degree=4, mp_degree=2)
+    col = fleet.ColumnParallelLinear(16, 32, gather_output=False)
+    row = fleet.RowParallelLinear(32, 4, input_is_parallel=True)
+    lo = fleet.get_hybrid_communicate_group().layout
+    assert col.weight._value.sharding.spec == lo.column_weight()
+    assert col.bias._value.sharding.spec == lo.column_bias()
+    assert row.weight._value.sharding.spec == lo.row_weight()
+    # the replicated row bias is EXPLICITLY mesh-placed (reshard-on-load
+    # targets it; an uncommitted single-device default would strand it)
+    assert row.bias._value.sharding.spec == lo.replicated(1)
+    assert len(row.bias._value.devices()) == 8
+
+
+# ---------------------------------------------------------------------------
+# LayoutTable
+# ---------------------------------------------------------------------------
+
+
+def test_layout_table_rules_and_fallback():
+    table = sl.transformer_layout_table(dp=4)
+    assert table.spec_for("enc.layers.0.self_attn.q_proj.weight", (64, 64)) == P(None, "mp")
+    assert table.spec_for("enc.layers.0.self_attn.out_proj.weight", (64, 64)) == P("mp", None)
+    assert table.spec_for("enc.layers.0.linear1.weight", (64, 256)) == P(None, "mp")
+    assert table.spec_for("enc.layers.0.linear2.weight", (256, 64)) == P("mp", None)
+    assert table.spec_for("embeddings.word_embeddings.weight", (1024, 64)) == P("mp", None)
+    # biases miss the weight rules and fall back to the ZeRO-over-dp shard
+    assert table.spec_for("enc.layers.0.self_attn.q_proj.bias", (64,)) == P("dp")
+    assert table.spec_for("embeddings.layer_norm.weight", (6,)) == P(*[None])
+    assert table.spec_for("pos_embeddings.weight", (128, 64)) == P("dp", None)
+    assert table.spec_for("scalar_state", ()) == P()
+
+
+def test_layout_table_custom_axis_names_and_roles():
+    lo = sl.SpecLayout(data_axis="dp", tp_axis="tp")
+    table = sl.LayoutTable(
+        rules=[("*.w", "column"), ("*.frozen", lambda l, n, s: l.replicated(len(s)))],
+        layout=lo,
+        default="fsdp:2",
+    )
+    assert table.spec_for("block.w", (4, 4)) == P(None, "tp")
+    assert table.spec_for("block.frozen", (4, 4)) == P(None, None)
+    assert table.spec_for("other", (4, 4)) == P("sharding", None)
+    with pytest.raises(ValueError):
+        sl.LayoutTable([("*", "no_such_role")]).spec_for("x", (2,))
+
+
+# ---------------------------------------------------------------------------
+# serialization (checkpoint metadata)
+# ---------------------------------------------------------------------------
+
+
+def test_spec_and_mesh_meta_round_trip():
+    spec = P(None, ("sharding", "mp"), "dp")
+    meta = sl.spec_to_meta(spec)
+    assert meta == (None, ("sharding", "mp"), "dp")
+    assert sl.meta_to_spec(meta) == spec
+    assert sl.spec_to_meta(None) is None and sl.meta_to_spec(None) is None
+
+    mesh = sl.build_mesh(data=4, tp=2)
+    mm = sl.mesh_to_meta(mesh)
+    assert mm["n_devices"] == 8
+    assert ("dp", 4) in mm["axes"] and ("mp", 2) in mm["axes"]
+
+    t = paddle.to_tensor(np.zeros((4, 4), "float32"))
+    sm = sl.sharding_to_meta(t._value.sharding)
+    assert sm["spec"] is None or isinstance(sm["spec"], tuple)
+
+
+# ---------------------------------------------------------------------------
+# elastic policy
+# ---------------------------------------------------------------------------
+
+
+def test_plan_elastic_degrees_policy():
+    # tp survives a single-device loss; dp absorbs it
+    assert sl.plan_elastic_degrees(7, {"data": 4, "tp": 2}) == {
+        "tp": 2, "pp": 1, "sep": 1, "fsdp": 1, "data": 3, "world": 6,
+    }
+    # tp shrinks only to a divisor, and only when the survivors force it
+    assert sl.plan_elastic_degrees(3, {"data": 2, "tp": 4})["tp"] == 2
+    assert sl.plan_elastic_degrees(1, {"tp": 8}) == {
+        "tp": 1, "pp": 1, "sep": 1, "fsdp": 1, "data": 1, "world": 1,
+    }
+    # pp yields after tp
+    plan = sl.plan_elastic_degrees(5, {"tp": 2, "pp": 2})
+    assert plan["tp"] == 2 and plan["pp"] == 2 and plan["world"] == 4
+
+
+def test_elastic_manager_mirror_stays_in_lockstep():
+    """fleet.elastic.manager mirrors plan_elastic_degrees so the launcher
+    process never imports jax — the two implementations must agree."""
+    cases = [
+        (7, {"data": 4, "tp": 2}),
+        (6, {"data": 2, "tp": 4}),
+        (5, {"tp": 4, "pp": 2}),
+        (12, {"data": 2, "tp": 2, "pp": 2, "fsdp": 2}),
+        (1, {"tp": 8, "sep": 3}),
+        (9, {}),
+    ]
+    for n, degrees in cases:
+        assert elastic_manager.plan_elastic_degrees(n, degrees) == sl.plan_elastic_degrees(
+            n, degrees
+        ), (n, degrees)
+    assert elastic_manager.CANONICAL_AXES == sl.CANONICAL_AXES
+
+
+def test_largest_valid_mesh_builds_on_survivors():
+    mesh = sl.largest_valid_mesh(7, {"data": 4, "tp": 2})
+    assert mesh.devices.size == 6
+    assert mesh.shape["dp"] == 3 and mesh.shape["mp"] == 2
+
+
+def test_degree_keys_accept_fleet_names_and_warn_on_typos(capsys):
+    """Operators key degrees by fleet axis names (mp/dp/sharding) as often
+    as by canonical roles; both must plan identically, and a typo'd key
+    must warn instead of silently planning tp=1 (which would reshard a
+    tp-sharded model fully replicated — an HBM OOM on real hardware)."""
+    assert sl.plan_elastic_degrees(7, {"dp": 4, "mp": 2}) == sl.plan_elastic_degrees(
+        7, {"data": 4, "tp": 2}
+    )
+    assert elastic_manager.plan_elastic_degrees(7, {"dp": 4, "mp": 2}) == (
+        sl.plan_elastic_degrees(7, {"data": 4, "tp": 2})
+    )
+    # a prior plan's "world" output round-trips silently
+    plan = sl.plan_elastic_degrees(8, {"tp": 2})
+    assert sl.plan_elastic_degrees(8, plan) == plan
+    capsys.readouterr()
+    sl.plan_elastic_degrees(8, {"tp ": 2})
+    assert "unknown parallel-degree key 'tp '" in capsys.readouterr().err
+    elastic_manager.plan_elastic_degrees(8, {"modelp": 2})
+    assert "unknown parallel-degree key 'modelp'" in capsys.readouterr().err
+
+
+def test_fleet_init_honors_elastic_plan_env(monkeypatch):
+    """The loop the launcher closes: a relaunched worker still carries its
+    ORIGINAL hybrid_configs (dp=4 x mp=2 needs 8 devices); with
+    PADDLE_ELASTIC_PLAN exported by _elastic_restart, fleet.init lands on
+    the planned survivors' mesh instead of dying on world-size > devices
+    and crash-looping the pod."""
+    plan = sl.plan_elastic_degrees(6, {"data": 4, "tp": 2})
+    monkeypatch.setenv("PADDLE_ELASTIC_PLAN", __import__("json").dumps(plan))
+    _fleet_init(dp_degree=4, mp_degree=2)  # stale degrees: would need 8
+    mesh = fleet.get_hybrid_communicate_group().mesh
+    assert mesh.shape["dp"] == 3 and mesh.shape["mp"] == 2
+    assert mesh.devices.size == 6
+    monkeypatch.delenv("PADDLE_ELASTIC_PLAN")
+    _fleet_init(dp_degree=4, mp_degree=2)  # plan gone: back to the strategy
+    assert fleet.get_hybrid_communicate_group().mesh.devices.size == 8
+
+
+def test_fleet_init_survives_garbage_elastic_plan(monkeypatch, capsys):
+    monkeypatch.setenv("PADDLE_ELASTIC_PLAN", "{not json")
+    _fleet_init(dp_degree=2, mp_degree=2)
+    assert fleet.get_hybrid_communicate_group().mesh.shape["dp"] == 2
+    assert "unparseable PADDLE_ELASTIC_PLAN" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# group-sharded + pipeline layouts ride the same table
+# ---------------------------------------------------------------------------
+
+
+def test_group_sharded_placement_uses_fsdp_layout():
+    from paddle_tpu.distributed.fleet.meta_parallel.sharding import (
+        group_sharded_utils as gsu,
+    )
+
+    assert gsu.shard_axis_spec((8, 2), 8, "sharding") == sl.layout().fsdp_shard((8, 2), 8)
+    assert gsu.shard_axis_spec((6, 2), 8, "sharding") == P(None, None)
+
+
+def test_stacked_stage_spec_matches_layout():
+    from paddle_tpu.distributed.fleet.meta_parallel.spmd_pipeline import _stacked_spec
+
+    assert _stacked_spec(3, "pp") == sl.layout().stage_stacked(3)
+    assert _stacked_spec(2, "custom_pp") == P("custom_pp", None)
