@@ -73,6 +73,13 @@ analysis record an explicit `attribution: unavailable` marker — the
 capture contract extends to attribution. vs_baseline MFU methodology is
 unchanged (co-measured peak).
 
+Round 11: a `serving` config measures the decode-optimized serving tier —
+greedy decode through the paged-KV InferenceEngine (Pallas flash-decode on
+TPU, AOT prefill/decode shape buckets) under a synthetic heavy-traffic
+request replay, continuous batching vs static batching on the SAME seeded
+trace: tokens/s, p50/p99 TTFT and TPOT (pooled inter-token intervals).
+BENCH_SERVE_* shrink the model/replay; BENCH_SKIP_SERVING=1 skips it.
+
 Run: python bench.py            -> JSON lines on stdout (last one wins)
 Env: BENCH_STEPS / BENCH_BATCH / BENCH_SEQ override config A;
      BENCH_SKIP_4096=1 skips config B (quick runs);
@@ -111,6 +118,7 @@ _EST_S = {
     "peak": 60,
     "seq128": 240,
     "ocr": 90,
+    "serving": 180,
     "resnet": 180,
     "ernie4096": 240,
     "llama": 300,
@@ -413,6 +421,134 @@ def _build_llama_at(steps, layers, seq=4096, recompute=False, micro=1):
     }
 
 
+def _serve_dims():
+    """Serving-bench model dims + replay knobs, all BENCH_SERVE_*
+    overridable (tier-1 capture tests run a seconds-scale replay; a
+    shrunken run records serve_dims so it can't masquerade)."""
+    g = os.environ.get
+    return {
+        "vocab": int(g("BENCH_SERVE_VOCAB", 8192)),
+        "hidden": int(g("BENCH_SERVE_HIDDEN", 512)),
+        "layers": int(g("BENCH_SERVE_LAYERS", 4)),
+        "heads": int(g("BENCH_SERVE_HEADS", 8)),
+        "kv_heads": int(g("BENCH_SERVE_KV_HEADS", 4)),
+        "ffn": int(g("BENCH_SERVE_FFN", 1376)),
+        "max_seq": int(g("BENCH_SERVE_MAX_SEQ", 256)),
+        "block_size": int(g("BENCH_SERVE_BLOCK", 16)),
+        "max_batch": int(g("BENCH_SERVE_BATCH", 8)),
+        "n_requests": int(g("BENCH_SERVE_REQUESTS", 48)),
+        "seed": int(g("BENCH_SERVE_SEED", 11)),
+        "gap_s": float(g("BENCH_SERVE_GAP", 0.002)),
+    }
+
+
+def _build_serving():
+    """Serving tier under a synthetic heavy-traffic request replay
+    (round 11): greedy decode through the paged-KV InferenceEngine with
+    continuous batching vs the static-batching baseline on the SAME seeded
+    trace. Reports tokens/s (generated tokens over replay wall) and
+    p50/p99 TTFT + TPOT — TPOT percentiles over pooled inter-token
+    intervals (the ITL convention; robust to one OS blip wrecking a short
+    request's mean). Bucket compiles happen in a warmup pass so the
+    measured replay sees steady-state serving, and GC is paused during the
+    replay (both schedulers measured identically)."""
+    import gc
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.engine import InferenceEngine
+    from paddle_tpu.inference.scheduler import (
+        ContinuousBatchingScheduler,
+        Request,
+        StaticBatchingScheduler,
+        replay,
+    )
+    from paddle_tpu.models.llama import LlamaForCausalLM
+
+    d = _serve_dims()
+    paddle.seed(0)
+    model = LlamaForCausalLM(
+        vocab_size=d["vocab"], hidden_size=d["hidden"],
+        num_hidden_layers=d["layers"], num_attention_heads=d["heads"],
+        num_key_value_heads=d["kv_heads"], intermediate_size=d["ffn"],
+    )
+    model.eval()
+
+    def mk_requests():
+        rng = np.random.RandomState(d["seed"])
+        max_prompt = max(8, d["max_seq"] // 4)
+        gen_mix = [4, 8, 16, max(24, d["max_seq"] // 4)]
+        reqs, t = [], 0.0
+        for i in range(d["n_requests"]):
+            t += rng.exponential(d["gap_s"])
+            reqs.append(Request(
+                rid=i,
+                prompt=rng.randint(0, d["vocab"], (int(rng.randint(4, max_prompt)),)).tolist(),
+                max_new_tokens=int(rng.choice(gen_mix, p=[0.25, 0.3, 0.25, 0.2])),
+                arrival_time=t,
+            ))
+        return reqs
+
+    def fresh_engine():
+        eng = InferenceEngine(
+            model, max_seq_len=d["max_seq"], block_size=d["block_size"],
+            max_batch=d["max_batch"],
+            # one decode signature: step cost independent of occupancy, and
+            # the bucket cache stays tiny (standard fixed-batch TPU serving)
+            decode_batch_buckets=(d["max_batch"],),
+        )
+        for b in eng.prefill_buckets:  # warmup: compile outside the replay
+            pages = eng.pool.alloc(eng.pool.blocks_for_tokens(b))
+            eng.prefill(list(range(1, b + 1)), pages)
+            eng.pool.reset()
+        pages = eng.pool.alloc(1)
+        eng.decode([1], [0], [1], [pages])
+        eng.pool.reset()
+        return eng
+
+    def measured(kind):
+        eng = fresh_engine()
+        sched = (ContinuousBatchingScheduler(eng) if kind == "continuous"
+                 else StaticBatchingScheduler(eng))
+        gc.collect()
+        gc.disable()
+        try:
+            stats = replay(sched, mk_requests())
+        finally:
+            gc.enable()
+        stats["bucket_stats"] = dict(eng.bucket_stats)
+        return stats
+
+    cont = measured("continuous")
+    static = measured("static")
+    res = {
+        **cont,
+        "n_requests": d["n_requests"],
+        "static": static,
+        "speedup_vs_static": (
+            round(cont["tokens_per_sec"] / static["tokens_per_sec"], 3)
+            if cont.get("tokens_per_sec") and static.get("tokens_per_sec") else None
+        ),
+        "note": (
+            "greedy decode, paged KV (Pallas flash-decode on TPU), AOT "
+            "shape buckets, token-streamed continuous batching vs static "
+            "groups on the same seeded replay; tpot percentiles pool all "
+            "inter-token intervals"
+        ),
+        # decode step time is the serving hot path: attribute the decode
+        # program (compiled last in warmup) at the median interval
+        "attribution": _attribution(
+            (cont.get("p50_tpot_ms") or 0) / 1000.0 or None, origin="serving"
+        ),
+    }
+    res["serve_dims"] = {k: d[k] for k in ("vocab", "hidden", "layers", "heads",
+                                           "kv_heads", "ffn", "max_seq",
+                                           "block_size", "max_batch", "seed",
+                                           "gap_s")}
+    return res
+
+
 def _release_device_memory():
     """Drop compiled executables + dead buffers between configs — the
     Llama-shaped config holds ~8GB of AdamW state; without this the peak
@@ -622,7 +758,8 @@ class _Snapshot:
     not yet run (which the final state marks as explicit skips), never the
     ones already measured."""
 
-    CONFIGS = ("seq128", "seq4096", "llama3_shape", "resnet50", "ppocr_e2e")
+    CONFIGS = ("seq128", "seq4096", "llama3_shape", "resnet50", "ppocr_e2e",
+               "serving")
 
     def __init__(self):
         self.result = {
@@ -668,6 +805,7 @@ def main():
             "ernie4096": lambda: _child_4096(steps_c),
             "resnet": lambda: _build_resnet(steps=steps_c),
             "ocr": lambda: _build_ppocr(n_images=steps_c),
+            "serving": _build_serving,
         }
         if child not in builders:
             raise ValueError(f"unknown BENCH_CHILD {child}")
@@ -767,11 +905,11 @@ def main():
         detail["seq128"] = {"skipped": "deadline"}
         snap.resolve("seq128", "skipped:deadline")
 
-    # ---- satellites, CHEAPEST-FIRST: a tight budget forfeits the
-    # expensive tail, never the whole record ----
+    # ---- satellites, CHEAPEST-FIRST (ocr 90s < serving/resnet 180s <
+    # ernie4096 < llama): a tight budget forfeits the expensive tail,
+    # never the whole record ----
     if skip_env("BENCH_SKIP_VISION"):
         snap.resolve("ppocr_e2e", "skipped:env")
-        snap.resolve("resnet50", "skipped:env")
     else:
         res_ocr = _run_config_child("ocr", 8)
         detail["ppocr_e2e"] = res_ocr if "skipped" in res_ocr else {
@@ -785,6 +923,25 @@ def main():
             else f"skipped:{res_ocr['skipped']}",
         )
 
+    if skip_env("BENCH_SKIP_SERVING"):
+        snap.resolve("serving", "skipped:env")
+    else:
+        res_sv = _run_config_child("serving", 0)
+        detail["serving"] = res_sv if "skipped" in res_sv else {
+            **res_sv,
+            "note": res_sv.get("note", "") + " (BASELINE: the reference "
+                    "publishes no serving number; continuous-vs-static on "
+                    "the same replay is the comparison)",
+        }
+        snap.resolve(
+            "serving",
+            "measured" if "skipped" not in res_sv
+            else f"skipped:{res_sv['skipped']}",
+        )
+
+    if skip_env("BENCH_SKIP_VISION"):
+        snap.resolve("resnet50", "skipped:env")
+    else:
         res_rn = _run_config_child("resnet", max(10, steps // 2))
         detail["resnet50"] = res_rn if "skipped" in res_rn else {
             **res_rn,

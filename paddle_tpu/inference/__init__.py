@@ -62,6 +62,7 @@ class Config:
         self._device = "tpu"
         self._device_id = 0
         self._inert: Dict[str, object] = {}
+        self._llm_opts: Dict[str, object] = {}
 
     # ---- model paths ----
     def set_model(self, model_arg, params_file=None):
@@ -95,6 +96,19 @@ class Config:
 
     def gpu_device_id(self):
         return self._device_id
+
+    # ---- serving engine (paged-KV decode) ----
+    def enable_llm_engine(self, max_new_tokens=32, eos_id=None, **engine_opts):
+        """Route this Config through the serving InferenceEngine (paged KV
+        cache + AOT shape buckets + continuous batching) instead of the
+        frozen-program Predictor. Automatic when the model path carries a
+        `.pdllm` artifact; `engine_opts` forward to InferenceEngine
+        (max_seq_len, block_size, num_blocks, max_batch, ...)."""
+        self._llm_opts.update(max_new_tokens=max_new_tokens, eos_id=eos_id,
+                              **engine_opts)
+
+    def is_llm(self) -> bool:
+        return self._prefix is not None and os.path.exists(self._prefix + ".pdllm")
 
     # ---- accepted-but-inert engine knobs (CUDA/TRT/MKLDNN specific) ----
     def enable_tensorrt_engine(self, *a, **kw):
@@ -150,6 +164,150 @@ class Tensor:
         if self._value is not None:
             return list(np.asarray(self._value).shape)
         return list(self._declared_shape or [])
+
+
+def save_llm(model, prefix: str) -> str:
+    """Save a decode-capable causal LM as a serving artifact:
+    `{prefix}.pdllm` (JSON model config) + `{prefix}.pdiparams` (weights).
+
+    Unlike the frozen-StableHLO .pdmodel path, an LLM artifact stays a
+    LIVE model — the predictor rebuilds it and serves greedy decode through
+    the paged-KV InferenceEngine (prefill/decode shape buckets), which a
+    single frozen program cannot express."""
+    import json
+
+    import numpy as np
+
+    cfg = getattr(model, "config", None)
+    if not isinstance(cfg, dict):
+        raise ValueError("save_llm needs a model with a .config dict "
+                         "(LlamaForCausalLM-shaped)")
+    d = os.path.dirname(prefix)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(prefix + ".pdllm", "w") as f:
+        json.dump({"arch": "LlamaForCausalLM", "config": cfg}, f)
+    from ..framework import io as fio
+
+    state = {k: np.asarray(v._value) for k, v in model.state_dict().items()}
+    fio.save(state, prefix + ".pdiparams")
+    return prefix
+
+
+def load_llm(prefix: str):
+    """Rebuild the model saved by save_llm (weights loaded, eval mode)."""
+    import json
+
+    with open(prefix + ".pdllm") as f:
+        meta = json.load(f)
+    if meta.get("arch") != "LlamaForCausalLM":
+        raise ValueError(f"unknown LLM artifact arch {meta.get('arch')!r}")
+    from ..models.llama import LlamaForCausalLM
+
+    model = LlamaForCausalLM(**meta["config"])
+    from ..framework import io as fio
+
+    model.set_state_dict(fio.load(prefix + ".pdiparams"))
+    model.eval()
+    return model
+
+
+class LLMPredictor:
+    """Predictor surface over the serving engine: Config points at a
+    save_llm artifact, `create_predictor` returns this, and run() greedy-
+    decodes through the paged-KV continuous-batching stack.
+
+    Inputs: "input_ids" [B, S] int (rows right-padded; give true lengths
+    via the optional "seq_lens" [B] handle). Output: "generated_ids"
+    [B, max_new_tokens] int32, right-padded with -1 after EOS."""
+
+    def __init__(self, config: Config):
+        if config._prefix is None:
+            raise ValueError("Config has no model path")
+        self._config = config
+        self._model = load_llm(config._prefix)
+        opts = dict(config._llm_opts)
+        self._max_new_tokens = int(opts.pop("max_new_tokens", 32))
+        self._eos_id = opts.pop("eos_id", None)
+        self._engine_opts = opts
+        from .engine import InferenceEngine
+
+        self._engine = InferenceEngine(self._model, **opts)
+        self._inputs = {
+            "input_ids": Tensor("input_ids", dtype=np.int64),
+            "seq_lens": Tensor("seq_lens", dtype=np.int64),
+        }
+        self._outputs = {"generated_ids": Tensor("generated_ids")}
+
+    def get_input_names(self):
+        return list(self._inputs)
+
+    def get_output_names(self):
+        return list(self._outputs)
+
+    def get_input_handle(self, name):
+        return self._inputs[name]
+
+    def get_output_handle(self, name):
+        return self._outputs[name]
+
+    def run(self, inputs: Optional[list] = None):
+        if inputs is not None:
+            for n, a in zip(self.get_input_names(), inputs):
+                self._inputs[n].copy_from_cpu(a)
+        ids = self._inputs["input_ids"]._value
+        if ids is None:
+            raise RuntimeError("input 'input_ids' not set — copy_from_cpu it first")
+        ids = np.asarray(ids)
+        if ids.ndim == 1:
+            ids = ids[None]
+        lens = self._inputs["seq_lens"]._value
+        if lens is None:
+            lens = np.full((ids.shape[0],), ids.shape[1], np.int64)
+        lens = np.asarray(lens).reshape(-1)
+        if lens.shape[0] != ids.shape[0]:
+            raise ValueError(
+                f"seq_lens has {lens.shape[0]} entries for {ids.shape[0]} "
+                "input_ids rows — re-copy seq_lens (a stale handle from a "
+                "previous run() would silently truncate the batch)"
+            )
+        prompts = [list(map(int, row[: int(l)])) for row, l in zip(ids, lens)]
+        gen = self._engine.generate(
+            prompts, max_new_tokens=self._max_new_tokens, eos_id=self._eos_id
+        )
+        out = np.full((len(gen), self._max_new_tokens), -1, np.int32)
+        for i, g in enumerate(gen):
+            out[i, : len(g)] = g
+        self._outputs["generated_ids"]._value = out
+        if inputs is not None:
+            return [out]
+        return None
+
+    def clone(self) -> "LLMPredictor":
+        # the engine's KV pool is serial per predictor — a clone gets its
+        # own pool/engine over the SAME model (weights shared by reference)
+        c = LLMPredictor.__new__(LLMPredictor)
+        c._config = self._config
+        c._model = self._model
+        c._max_new_tokens = self._max_new_tokens
+        c._eos_id = self._eos_id
+        c._engine_opts = dict(self._engine_opts)
+        from .engine import InferenceEngine
+
+        c._engine = InferenceEngine(self._model, **c._engine_opts)
+        c._inputs = {
+            "input_ids": Tensor("input_ids", dtype=np.int64),
+            "seq_lens": Tensor("seq_lens", dtype=np.int64),
+        }
+        c._outputs = {"generated_ids": Tensor("generated_ids")}
+        return c
+
+    def clear_intermediate_tensor(self):
+        return None
+
+    def try_shrink_memory(self):
+        self._engine.pool.reset()
+        return None
 
 
 class Predictor:
@@ -233,8 +391,13 @@ class Predictor:
         return None
 
 
-def create_predictor(config: Config) -> Predictor:
-    """paddle.inference.create_predictor."""
+def create_predictor(config: Config):
+    """paddle.inference.create_predictor. A Config pointing at a save_llm
+    artifact (`.pdllm` + `.pdiparams`) gets the serving-engine-backed
+    LLMPredictor (greedy decode over the paged KV cache); frozen StableHLO
+    artifacts keep the program Predictor."""
+    if config.is_llm():
+        return LLMPredictor(config)
     return Predictor(config)
 
 
@@ -393,4 +556,5 @@ __all__ += [
     "get_trt_compile_version", "get_trt_runtime_version",
     "get_num_bytes_of_data_type", "convert_to_mixed_precision",
     "_get_phi_kernel_name",
+    "LLMPredictor", "save_llm", "load_llm",
 ]

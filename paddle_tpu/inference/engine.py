@@ -1,0 +1,353 @@
+"""Decode-optimized inference engine: AOT shape buckets over the paged KV
+cache.
+
+The serving-tier compute core (PAPER.md L3c `jit/serving`). One engine owns:
+
+- the model's parameter values (optionally placed on a mesh through PR 7's
+  SpecLayout table — TP-sharded decode runs through the same code path);
+- a BlockPool of paged KV (inference/kv_cache.py);
+- a small set of AOT-COMPILED shape buckets: requests are padded into
+  (batch=1, seq_bucket) prefill programs and (batch_bucket, 1) decode
+  programs, so steady-state serving never retraces — the same
+  per-signature `lower().compile()` discipline the static Executor adopted
+  in PR 5, with every compile recorded into the perf-attribution store
+  (origin "serving") and bucket hits/compiles counted in telemetry.
+
+Padding contract: prefill pads the prompt to the bucket on the right
+(causal masking means real tokens never attend to the pad tail; the padded
+tail's K/V writes land past `seq_len` — masked on every later read, and
+overwritten by decode before the sequence grows into them). Decode pads
+the batch with inactive rows whose block table is all trash-page and whose
+seq_len is 1 — they compute garbage that is discarded.
+"""
+from __future__ import annotations
+
+import math
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+from jax import numpy as jnp
+
+from .. import telemetry
+from ..telemetry import metrics as _metrics
+from .kv_cache import BlockPool, PagedCacheView
+
+__all__ = ["InferenceEngine"]
+
+
+def _bucket_counter():
+    return _metrics.counter(
+        "paddle_tpu_serving_bucket_events_total",
+        "AOT shape-bucket cache events (hit = reused compiled program, "
+        "compile = new signature lowered+compiled)",
+        label_names=("kind", "event"),
+    )
+
+
+def _default_prefill_buckets(max_seq_len: int, block_size: int) -> Tuple[int, ...]:
+    out, b = [], max(16, block_size)
+    while b < max_seq_len:
+        out.append(b)
+        b *= 2
+    out.append(max_seq_len)
+    return tuple(sorted(set(out)))
+
+
+def _default_batch_buckets(max_batch: int) -> Tuple[int, ...]:
+    out, b = [], 1
+    while b < max_batch:
+        out.append(b)
+        b *= 2
+    out.append(max_batch)
+    return tuple(sorted(set(out)))
+
+
+class InferenceEngine:
+    """Greedy-decode serving engine over a paged KV cache.
+
+    `model` is an LlamaForCausalLM-shaped layer: a `.config` dict naming the
+    stack's dims and a `forward(ids, cache=, positions=, last_index=)`
+    decode mode. `mesh` + `layout_table` place the weights for TP-sharded
+    decode (PR 7 SpecLayout); single-device when omitted.
+    """
+
+    def __init__(
+        self,
+        model,
+        *,
+        max_seq_len: int = 512,
+        block_size: int = 16,
+        num_blocks: Optional[int] = None,
+        max_batch: int = 8,
+        prefill_buckets: Optional[Sequence[int]] = None,
+        decode_batch_buckets: Optional[Sequence[int]] = None,
+        mesh=None,
+        layout_table=None,
+    ):
+        from ..jit.api import state_values
+
+        cfg = dict(getattr(model, "config", {}))
+        if not cfg:
+            raise ValueError(
+                "InferenceEngine needs a model with a .config dict "
+                "(LlamaForCausalLM-shaped)"
+            )
+        self._model = model
+        self.num_layers = int(cfg["num_hidden_layers"])
+        heads = int(cfg["num_attention_heads"])
+        self.num_kv_heads = int(cfg.get("num_key_value_heads") or heads)
+        self.head_dim = int(cfg["hidden_size"]) // heads
+        self.vocab_size = int(cfg["vocab_size"])
+        self.max_seq_len = int(max_seq_len)
+        self.block_size = int(block_size)
+        self.max_pages = math.ceil(self.max_seq_len / self.block_size)
+        self.max_batch = int(max_batch)
+        self.prefill_buckets = tuple(
+            prefill_buckets or _default_prefill_buckets(self.max_seq_len, self.block_size)
+        )
+        if max(self.prefill_buckets) > self.max_pages * self.block_size:
+            raise ValueError("prefill bucket exceeds the block-table capacity")
+        self.decode_batch_buckets = tuple(
+            decode_batch_buckets or _default_batch_buckets(self.max_batch)
+        )
+
+        params = state_values(model)
+        w_dtype = params[next(iter(params))].dtype
+        self._mesh = mesh
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            if layout_table is None:
+                from ..distributed.sharding.spec_layout import transformer_layout_table
+
+                layout_table = transformer_layout_table()
+            self._param_shardings = {
+                k: NamedSharding(mesh, layout_table.spec_for(k, v.shape))
+                for k, v in params.items()
+            }
+            self.params = {
+                k: jax.device_put(v, self._param_shardings[k]) for k, v in params.items()
+            }
+            self._repl = NamedSharding(mesh, P())
+            # cache pages follow the TP layout: k/v come out of the
+            # column-sharded k/v_proj per-head, so each tp rank holds its kv
+            # heads' pages (no gather on the decode read); replicated when
+            # the head count doesn't divide
+            tp_axis = layout_table.layout.tp_axis
+            tp_deg = int(mesh.shape.get(tp_axis, 1))
+            if tp_deg > 1 and self.num_kv_heads % tp_deg == 0:
+                self._page_sharding = NamedSharding(mesh, P(None, None, tp_axis, None))
+            else:
+                self._page_sharding = self._repl
+        else:
+            self._param_shardings = None
+            self.params = params
+            self._repl = None
+            self._page_sharding = None
+
+        if num_blocks is None:
+            # worst case: every decode slot at full context, plus the trash page
+            num_blocks = 1 + self.max_batch * self.max_pages
+        self.pool = BlockPool(
+            num_blocks, self.block_size, self.num_layers,
+            self.num_kv_heads, self.head_dim, dtype=w_dtype,
+        )
+        # donation keeps exactly one pool copy live on TPU; CPU's donation
+        # path only warns, so gate it on the platform
+        self._donate = jax.devices()[0].platform in ("tpu", "axon")
+        self._compiled: Dict[Tuple[str, int], object] = {}
+        self.bucket_stats = {"hits": 0, "compiles": 0}
+
+    # ---- buckets ----
+    def bucket_for(self, kind: str, n: int) -> int:
+        buckets = self.prefill_buckets if kind == "prefill" else self.decode_batch_buckets
+        for b in buckets:
+            if n <= b:
+                return b
+        raise ValueError(f"{kind} size {n} exceeds the largest bucket {buckets[-1]}")
+
+    def _get_compiled(self, kind: str, size: int):
+        key = (kind, size)
+        ex = self._compiled.get(key)
+        if ex is not None:
+            self.bucket_stats["hits"] += 1
+            if telemetry.enabled():
+                _bucket_counter().labels(kind=kind, event="hit").inc()
+            return ex
+        t0 = time.perf_counter()
+        ex = (self._compile_prefill if kind == "prefill" else self._compile_decode)(size)
+        dt = time.perf_counter() - t0
+        self._compiled[key] = ex
+        self.bucket_stats["compiles"] += 1
+        if telemetry.enabled():
+            _bucket_counter().labels(kind=kind, event="compile").inc()
+            try:
+                from ..profiler import perf_attribution as _pa
+
+                _pa.record_compiled(
+                    "serving", f"{kind}_{size}", compiled=ex, compile_seconds=dt
+                )
+            except Exception:
+                pass
+        return ex
+
+    def _page_avals(self):
+        shape = (self.pool.num_blocks, self.block_size, self.num_kv_heads, self.head_dim)
+        one = jax.ShapeDtypeStruct(shape, self.pool.dtype)
+        return [one] * self.num_layers
+
+    def _jit(self, fn, n_leading_args: int, donate_pages_from: int):
+        kwargs = {}
+        if self._donate:
+            # page arrays are threaded through every step — alias them
+            kwargs["donate_argnums"] = tuple(
+                range(donate_pages_from, donate_pages_from + 2)
+            )
+        if self._param_shardings is not None:
+            repl = self._repl
+            pages = [self._page_sharding] * self.num_layers
+            kwargs["in_shardings"] = (
+                self._param_shardings,
+                *([repl] * (n_leading_args - 1)),
+                pages,
+                list(pages),
+            )
+            # pin the outputs too: prefill/decode THREAD the pages — without
+            # this GSPMD picks per-program layouts and the next program's
+            # compiled signature rejects them
+            kwargs["out_shardings"] = (repl, pages, list(pages))
+        return jax.jit(fn, **kwargs)
+
+    def _compile_prefill(self, S: int):
+        from ..core.tensor import Tensor
+        from ..jit.api import functional_call
+        from ..autograd import no_grad
+
+        model, block_size = self._model, self.block_size
+
+        def fn(params, ids, true_len, bt, k_pages, v_pages):
+            view = PagedCacheView(k_pages, v_pages, bt, true_len, block_size)
+            with no_grad():
+                logits = functional_call(
+                    model, params, Tensor(ids), cache=view,
+                    last_index=true_len - 1, training=False,
+                )
+            return logits.value, view.k_pages, view.v_pages
+
+        i32 = jnp.int32
+        avals = (
+            {k: jax.ShapeDtypeStruct(v.shape, v.dtype) for k, v in self.params.items()},
+            jax.ShapeDtypeStruct((1, S), i32),
+            jax.ShapeDtypeStruct((1,), i32),
+            jax.ShapeDtypeStruct((1, self.max_pages), i32),
+            self._page_avals(),
+            self._page_avals(),
+        )
+        return self._jit(fn, 4, 4).lower(*avals).compile()
+
+    def _compile_decode(self, B: int):
+        from ..core.tensor import Tensor
+        from ..jit.api import functional_call
+        from ..autograd import no_grad
+
+        model, block_size = self._model, self.block_size
+
+        def fn(params, tokens, positions, seq_lens, bt, k_pages, v_pages):
+            view = PagedCacheView(k_pages, v_pages, bt, seq_lens, block_size)
+            with no_grad():
+                logits = functional_call(
+                    model, params, Tensor(tokens[:, None]), cache=view,
+                    positions=positions, training=False,
+                )
+            return logits.value[:, 0], view.k_pages, view.v_pages
+
+        i32 = jnp.int32
+        avals = (
+            {k: jax.ShapeDtypeStruct(v.shape, v.dtype) for k, v in self.params.items()},
+            jax.ShapeDtypeStruct((B,), i32),
+            jax.ShapeDtypeStruct((B,), i32),
+            jax.ShapeDtypeStruct((B,), i32),
+            jax.ShapeDtypeStruct((B, self.max_pages), i32),
+            self._page_avals(),
+            self._page_avals(),
+        )
+        return self._jit(fn, 5, 5).lower(*avals).compile()
+
+    # ---- steps ----
+    def prefill(self, prompt_ids: Sequence[int], pages: Sequence[int]) -> np.ndarray:
+        """Run one prompt through a prefill bucket, writing its K/V into
+        `pages`; returns the last-position logits [V]."""
+        L = len(prompt_ids)
+        if L < 1 or L > self.max_seq_len:
+            raise ValueError(f"prompt length {L} outside [1, {self.max_seq_len}]")
+        S = self.bucket_for("prefill", L)
+        ids = np.zeros((1, S), np.int32)
+        ids[0, :L] = np.asarray(prompt_ids, np.int32)
+        bt = np.asarray([self.pool.padded_table(pages, self.max_pages)], np.int32)
+        ex = self._get_compiled("prefill", S)
+        logits, k_pages, v_pages = ex(
+            self.params, jnp.asarray(ids), jnp.asarray([L], jnp.int32),
+            jnp.asarray(bt), self.pool.k_pages, self.pool.v_pages,
+        )
+        self.pool.adopt(k_pages, v_pages)
+        return np.asarray(logits[0])
+
+    def decode(
+        self,
+        tokens: Sequence[int],
+        positions: Sequence[int],
+        seq_lens: Sequence[int],
+        page_rows: Sequence[Sequence[int]],
+    ) -> np.ndarray:
+        """One decode step for `n` in-flight sequences (token i at absolute
+        position positions[i], context length seq_lens[i] AFTER this token);
+        returns logits [n, V]."""
+        n = len(tokens)
+        if n < 1:
+            raise ValueError("decode needs at least one sequence")
+        B = self.bucket_for("decode", n)
+        tok = np.zeros((B,), np.int32)
+        pos = np.zeros((B,), np.int32)
+        lens = np.ones((B,), np.int32)  # inactive rows read 1 trash slot
+        bt = np.zeros((B, self.max_pages), np.int32)
+        tok[:n] = np.asarray(tokens, np.int32)
+        pos[:n] = np.asarray(positions, np.int32)
+        lens[:n] = np.asarray(seq_lens, np.int32)
+        for i, row in enumerate(page_rows):
+            bt[i] = self.pool.padded_table(row, self.max_pages)
+        ex = self._get_compiled("decode", B)
+        logits, k_pages, v_pages = ex(
+            self.params, jnp.asarray(tok), jnp.asarray(pos), jnp.asarray(lens),
+            jnp.asarray(bt), self.pool.k_pages, self.pool.v_pages,
+        )
+        self.pool.adopt(k_pages, v_pages)
+        return np.asarray(logits[:n])
+
+    # ---- convenience: batch greedy generation through the scheduler ----
+    def generate(
+        self,
+        prompts: Sequence[Sequence[int]],
+        max_new_tokens=16,
+        eos_id: Optional[int] = None,
+    ) -> List[List[int]]:
+        """Greedy-decode every prompt (continuous batching under the hood);
+        returns the generated token ids per prompt."""
+        from .scheduler import ContinuousBatchingScheduler, Request
+
+        if isinstance(max_new_tokens, int):
+            max_new_tokens = [max_new_tokens] * len(prompts)
+        sched = ContinuousBatchingScheduler(self, eos_id=eos_id)
+        reqs = [
+            Request(rid=i, prompt=list(p), max_new_tokens=int(m))
+            for i, (p, m) in enumerate(zip(prompts, max_new_tokens))
+        ]
+        for r in reqs:
+            sched.submit(r)
+        while not sched.idle():
+            sched.step()
+        # a preempted request folds its generated prefix into the prompt
+        # (recompute-on-resume) — return the full generation, not just the
+        # post-resume tail
+        return [r.prompt[r.prompt_len:] + list(r.generated) for r in reqs]

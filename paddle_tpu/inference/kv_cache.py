@@ -1,0 +1,189 @@
+"""Paged KV cache: fixed-size blocks in a preallocated per-layer pool.
+
+The serving tier's memory manager (vLLM's PagedAttention layout, SURVEY's
+L3c serving rebuild): context KV for every in-flight sequence lives in
+fixed-size pages drawn from one preallocated pool per layer, addressed
+through a per-sequence block table. Allocation is a host-side free-list
+(O(1) alloc/free, no compaction — pages are interchangeable), the device
+arrays are functional jax values the compiled prefill/decode steps thread
+through, and pool pressure is observable: total/used blocks, alloc/free
+counts, allocation failures (the scheduler's preemption trigger), and
+internal fragmentation (allocated-but-unwritten slots) all export through
+the PR 1 telemetry registry.
+
+Page 0 is RESERVED as the trash page: block tables are padded with 0 past
+a sequence's last real page, so masked reads land on a valid page (never a
+fault) and padded-position writes scribble somewhere harmless.
+"""
+from __future__ import annotations
+
+import math
+from typing import List, Sequence, Tuple
+
+from jax import numpy as jnp
+
+from .. import telemetry
+from ..telemetry import metrics as _metrics
+
+__all__ = ["BlockPool", "PagedCacheView", "PoolExhausted", "TRASH_PAGE"]
+
+TRASH_PAGE = 0  # reserved: block-table padding + padded-position writes
+
+
+class PoolExhausted(RuntimeError):
+    """alloc() could not find enough free pages — the caller's cue to
+    preempt (continuous-batching scheduler) or reject admission."""
+
+
+def _pool_gauge(state: str):
+    return _metrics.gauge(
+        "paddle_tpu_kv_pool_blocks",
+        "paged KV cache pool occupancy by state",
+        label_names=("state",),
+    ).labels(state=state)
+
+
+class PagedCacheView:
+    """Functional view of the pool's device arrays for ONE traced step.
+
+    Holds per-layer k/v page arrays (possibly jax tracers), the step's
+    block tables [B, M] and seq_lens [B], and applies writes as functional
+    `.at[].set` updates stored back on the view — the compiled step returns
+    the updated arrays and the engine adopts them into the pool.
+    """
+
+    def __init__(self, k_pages: Sequence, v_pages: Sequence, block_tables,
+                 seq_lens, block_size: int):
+        self.k_pages = list(k_pages)
+        self.v_pages = list(v_pages)
+        self.block_tables = jnp.asarray(block_tables, jnp.int32)
+        self.seq_lens = jnp.asarray(seq_lens, jnp.int32)
+        self.block_size = int(block_size)
+
+    @property
+    def num_layers(self) -> int:
+        return len(self.k_pages)
+
+    def layer(self, idx: int) -> Tuple:
+        return self.k_pages[idx], self.v_pages[idx]
+
+    def write(self, idx: int, k_new, v_new, positions) -> None:
+        """Scatter new K/V into layer `idx`'s pages.
+
+        k_new/v_new [B, S, Hkv, D]; positions [B, S] int32 absolute token
+        positions. Position p of row b lands in page block_tables[b, p//bs]
+        slot p % bs; positions past a row's real pages hit table padding
+        (the trash page) by construction.
+        """
+        positions = jnp.asarray(positions, jnp.int32)
+        bs = self.block_size
+        pages = jnp.take_along_axis(self.block_tables, positions // bs, axis=1)
+        slots = positions % bs
+        self.k_pages[idx] = self.k_pages[idx].at[pages, slots].set(k_new)
+        self.v_pages[idx] = self.v_pages[idx].at[pages, slots].set(v_new)
+
+
+class BlockPool:
+    """Preallocated paged KV pool + host free-list allocator.
+
+    Device layout: per layer, k/v pages of shape
+    [num_blocks, block_size, num_kv_heads, head_dim]. `num_blocks` INCLUDES
+    the reserved trash page 0; usable capacity is num_blocks - 1 pages.
+    """
+
+    def __init__(self, num_blocks: int, block_size: int, num_layers: int,
+                 num_kv_heads: int, head_dim: int, dtype=jnp.float32):
+        if num_blocks < 2:
+            raise ValueError("BlockPool needs >= 2 blocks (page 0 is reserved)")
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self.num_layers = int(num_layers)
+        self.num_kv_heads = int(num_kv_heads)
+        self.head_dim = int(head_dim)
+        self.dtype = dtype
+        shape = (self.num_blocks, self.block_size, self.num_kv_heads, self.head_dim)
+        self.k_pages: List = [jnp.zeros(shape, dtype) for _ in range(self.num_layers)]
+        self.v_pages: List = [jnp.zeros(shape, dtype) for _ in range(self.num_layers)]
+        # LIFO free list: recently-freed (cache-warm) pages hand out first
+        self._free: List[int] = list(range(self.num_blocks - 1, 0, -1))
+        if telemetry.enabled():
+            _pool_gauge("total").set(self.num_blocks - 1)
+            _pool_gauge("used").set(0)
+
+    # ---- allocator ----
+    def blocks_for_tokens(self, n_tokens: int) -> int:
+        return max(1, math.ceil(n_tokens / self.block_size))
+
+    def available(self) -> int:
+        return len(self._free)
+
+    def used(self) -> int:
+        return (self.num_blocks - 1) - len(self._free)
+
+    def alloc(self, n: int) -> List[int]:
+        if n > len(self._free):
+            if telemetry.enabled():
+                _metrics.counter(
+                    "paddle_tpu_kv_pool_alloc_failures_total",
+                    "paged KV pool allocations refused for lack of free pages",
+                ).inc()
+            raise PoolExhausted(
+                f"paged KV pool exhausted: want {n} pages, {len(self._free)} free "
+                f"of {self.num_blocks - 1}"
+            )
+        out = [self._free.pop() for _ in range(n)]
+        if telemetry.enabled():
+            _metrics.counter(
+                "paddle_tpu_kv_pool_allocs_total", "paged KV pool pages handed out"
+            ).inc(n)
+            _pool_gauge("used").set(self.used())
+        return out
+
+    def free(self, pages: Sequence[int]) -> None:
+        for p in pages:
+            p = int(p)
+            if p == TRASH_PAGE:
+                raise ValueError("page 0 is reserved and never allocated")
+            if p in self._free:
+                raise ValueError(f"double free of page {p}")
+            self._free.append(p)
+        if telemetry.enabled() and pages:
+            _metrics.counter(
+                "paddle_tpu_kv_pool_frees_total", "paged KV pool pages returned"
+            ).inc(len(pages))
+            _pool_gauge("used").set(self.used())
+
+    def reset(self) -> None:
+        self._free = list(range(self.num_blocks - 1, 0, -1))
+        if telemetry.enabled():
+            _pool_gauge("used").set(0)
+
+    def note_fragmentation(self, active_tokens: int) -> None:
+        """Internal fragmentation: allocated slots minus live tokens — the
+        cost of fixed-size pages, the number paged allocation exists to keep
+        bounded (vs. one contiguous max-length buffer per sequence)."""
+        if telemetry.enabled():
+            _metrics.gauge(
+                "paddle_tpu_kv_pool_frag_slots",
+                "allocated-but-unwritten KV slots (internal fragmentation)",
+            ).set(self.used() * self.block_size - int(active_tokens))
+
+    # ---- device-array plumbing ----
+    def view(self, block_tables, seq_lens) -> PagedCacheView:
+        """Eager-path view over the pool's current arrays: run the model
+        with `cache=view`, then `adopt(view.k_pages, view.v_pages)`."""
+        return PagedCacheView(
+            self.k_pages, self.v_pages, block_tables, seq_lens, self.block_size
+        )
+
+    def adopt(self, k_pages: Sequence, v_pages: Sequence) -> None:
+        """Install a step's updated page arrays back into the pool."""
+        if len(k_pages) != self.num_layers or len(v_pages) != self.num_layers:
+            raise ValueError("page-array layer count does not match the pool")
+        self.k_pages = list(k_pages)
+        self.v_pages = list(v_pages)
+
+    def padded_table(self, pages: Sequence[int], n_cols: int):
+        """One sequence's block-table row padded with the trash page."""
+        row = list(pages)[:n_cols]
+        return row + [TRASH_PAGE] * (n_cols - len(row))

@@ -792,3 +792,190 @@ def _flash_fwd_x32_wrap(q, k, v, seed, causal, sm_scale, dropout_p):
 )
 def _flash_fwd_jit(q, k, v, seed, causal=False, sm_scale=None, dropout_p=0.0):
     return _flash_fwd_impl(q, k, v, seed, causal, sm_scale, dropout_p)
+
+
+# ---------------------------------------------------------------------------
+# paged flash-decode (serving tier): single-query GQA attention reading a
+# block-allocated (paged) KV cache
+# ---------------------------------------------------------------------------
+#
+# The decode regime is the transpose of prefill: one query token per
+# sequence against a long, NON-CONTIGUOUS context — the KV lives in
+# fixed-size pages scattered through a preallocated pool, addressed by a
+# per-sequence block table (vLLM's PagedAttention layout). The kernel grid
+# is (batch, kv_head, page): the block table rides in as a SCALAR-PREFETCH
+# operand so the k/v BlockSpec index maps pick the right page for each grid
+# step (the page fetch is a table lookup, never a gather in HBM), and the
+# online-softmax state (m, l, acc) for one (batch, kv_head) lives in VMEM
+# scratch across the sequential page axis — the same accumulator pattern as
+# the dkdv kernel's group axis. GQA is native: q is viewed [B, Hkv, group,
+# D], so the whole q-head group of a kv head shares its page stream and the
+# MXU does one [group, bs] logits tile per page.
+#
+# Masking contract: positions >= seq_lens[b] score -1e30 (the page slots
+# past the sequence end — including every slot of table entries past the
+# last real page — contribute exp(-1e30 - m) == 0). Callers pad block
+# tables with a valid page index (the pool's reserved page 0), so a masked
+# slot may READ garbage but can never fault or influence the output.
+# seq_lens must be >= 1 (a zero-length row would normalize an all-masked
+# softmax).
+
+_DECODE_SUBLANE = 8  # page slots must tile the VPU sublane dimension
+
+
+def paged_decode_usable(q, k_pages) -> bool:
+    """Kernel constraints: TPU platform (or interpret mode), head_dim <= 256
+    and lane-aligned, page slots a multiple of the sublane. q [B, H, D];
+    k_pages [N, bs, Hkv, D]. Off-gate callers fall back to the jnp
+    reference — bitwise-equivalent masking/GQA semantics, XLA-gathered."""
+    if not _on_tpu():
+        return False
+    if q.ndim != 3 or k_pages.ndim != 4:
+        return False
+    b, h, d = q.shape
+    n, bs, hkv, dk = k_pages.shape
+    if dk != d or not (0 < d <= 256 and d % 8 == 0):
+        return False
+    if bs % _DECODE_SUBLANE != 0:
+        return False
+    return hkv <= h and h % hkv == 0
+
+
+def paged_decode_reference(q, k_pages, v_pages, block_tables, seq_lens, sm_scale=None):
+    """jnp oracle for the paged decode kernel (and the off-TPU dispatch
+    path). Same accumulation discipline as the kernel: f32 logits via
+    preferred_element_type, probabilities cast to the storage dtype before
+    the value matmul. q [B, H, D] -> [B, H, D]."""
+    b, h, d = q.shape
+    n, bs, hkv, _ = k_pages.shape
+    group = h // hkv
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+    block_tables = jnp.asarray(block_tables, jnp.int32)
+    seq_lens = jnp.asarray(seq_lens, jnp.int32)
+
+    def one(qb, bt, sl):
+        # gather this sequence's pages -> a contiguous [S, Hkv, D] view
+        k = k_pages[bt].reshape(-1, hkv, d)
+        v = v_pages[bt].reshape(-1, hkv, d)
+        kg = repeat_kv(k[None], group)[0]  # [S, H, D], kernel head order
+        vg = repeat_kv(v[None], group)[0]
+        logits = jnp.einsum(
+            "hd,shd->hs", qb, kg, preferred_element_type=jnp.float32
+        ) * scale
+        pos = jnp.arange(kg.shape[0], dtype=jnp.int32)
+        logits = jnp.where(pos[None, :] < sl, logits, -1e30)
+        p = jax.nn.softmax(logits, axis=-1).astype(qb.dtype)
+        return jnp.einsum("hs,shd->hd", p, vg, preferred_element_type=jnp.float32).astype(qb.dtype)
+
+    return jax.vmap(one)(q, block_tables, seq_lens)
+
+
+def _paged_decode_kernel(bs, d, group, scale):
+    def kernel(bt_ref, seq_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr):
+        b = pl.program_id(0)
+        i = pl.program_id(2)
+
+        @pl.when(i == 0)
+        def _init():
+            m_scr[...] = jnp.full_like(m_scr, -1e30)
+            l_scr[...] = jnp.zeros_like(l_scr)
+            acc_scr[...] = jnp.zeros_like(acc_scr)
+
+        sl = seq_ref[b]
+        qb = q_ref[...]  # [group, d] — storage dtype, MXU at bf16 rate
+        kb = k_ref[...]  # [bs, d]   — one page of this kv head
+        vb = v_ref[...]
+        logits = _dot_nt(qb, kb) * scale  # [group, bs] f32
+        pos = i * bs + lax.broadcasted_iota(jnp.int32, (group, bs), 1)
+        logits = jnp.where(pos < sl, logits, -1e30)
+        m_prev = m_scr[...]
+        m_new = jnp.maximum(m_prev, jnp.max(logits, axis=-1, keepdims=True))
+        p = jnp.exp(logits - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_scr[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc_scr[...] * alpha + _dot_nn(p.astype(vb.dtype), vb)
+        m_scr[...] = m_new
+        l_scr[...] = l_new
+        acc_scr[...] = acc_new
+
+        @pl.when(i == pl.num_programs(2) - 1)
+        def _emit():
+            o_ref[...] = (acc_scr[...] / l_scr[...]).astype(o_ref.dtype)
+
+    return kernel
+
+
+def _paged_decode_impl(q, k_pages, v_pages, block_tables, seq_lens, sm_scale):
+    b, h, d = q.shape
+    n, bs, hkv, _ = k_pages.shape
+    group = h // hkv
+    m = block_tables.shape[1]
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+    qg = q.reshape(b, hkv, group, d)  # q head j = kv head j//group's group
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # block table + seq lens drive the index maps
+        grid=(b, hkv, m),
+        in_specs=[
+            pl.BlockSpec((None, None, group, d), lambda bi, hi, pi, *_: (bi, hi, 0, 0)),
+            # page fetch: the block table names the pool page for grid step
+            # (bi, pi); padded table entries point at the reserved page 0
+            pl.BlockSpec((None, bs, None, d), lambda bi, hi, pi, bt, sl: (bt[bi, pi], 0, hi, 0)),
+            pl.BlockSpec((None, bs, None, d), lambda bi, hi, pi, bt, sl: (bt[bi, pi], 0, hi, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, None, group, d), lambda bi, hi, pi, *_: (bi, hi, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((group, 1), jnp.float32),
+            pltpu.VMEM((group, 1), jnp.float32),
+            pltpu.VMEM((group, d), jnp.float32),
+        ],
+    )
+    # the page axis REVISITS the (bi, hi) accumulator scratch + out block on
+    # consecutive steps — it must stay sequential ("arbitrary"); batch/head
+    # steps each start a fresh accumulator at pi == 0
+    params = CompilerParams(
+        dimension_semantics=("arbitrary", "arbitrary", "arbitrary"),
+        vmem_limit_bytes=_VMEM_LIMIT,
+    )
+    out = pl.pallas_call(
+        _paged_decode_kernel(bs, d, group, scale),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hkv, group, d), q.dtype),
+        compiler_params=params,
+        interpret=_INTERPRET,
+    )(block_tables, seq_lens, qg, k_pages, v_pages)
+    return out.reshape(b, h, d)
+
+
+@functools.partial(jax.jit, static_argnames=("sm_scale",))
+def _paged_decode_jit(q, k_pages, v_pages, block_tables, seq_lens, sm_scale=None):
+    return _paged_decode_impl(q, k_pages, v_pages, block_tables, seq_lens, sm_scale)
+
+
+def flash_decode_paged(q, k_pages, v_pages, block_tables, seq_lens, sm_scale=None):
+    """Single-query attention over the paged KV cache.
+
+    q            [B, H, D]     — one query token per sequence
+    k_pages      [N, bs, Hkv, D] — the pool's key pages (one model layer)
+    v_pages      [N, bs, Hkv, D]
+    block_tables [B, M] int32  — page indices per sequence, padded with the
+                                 reserved page 0 past the last real page
+    seq_lens     [B]   int32   — valid context length per sequence (>= 1)
+
+    Dispatches the Pallas kernel on TPU (or under interpret mode), else the
+    jnp reference — identical masking/GQA semantics either way."""
+    if q.shape[2] != k_pages.shape[3]:
+        raise ValueError(
+            f"flash_decode_paged: head_dim mismatch q={q.shape} pages={k_pages.shape}"
+        )
+    h, hkv = q.shape[1], k_pages.shape[2]
+    if hkv > h or h % hkv != 0:
+        raise ValueError(
+            f"flash_decode_paged: kv heads must divide q heads; got q={h}, kv={hkv}"
+        )
+    block_tables = jnp.asarray(block_tables, jnp.int32)
+    seq_lens = jnp.asarray(seq_lens, jnp.int32)
+    if paged_decode_usable(q, k_pages):
+        with enable_x64(False):
+            return _paged_decode_jit(q, k_pages, v_pages, block_tables, seq_lens, sm_scale)
+    return paged_decode_reference(q, k_pages, v_pages, block_tables, seq_lens, sm_scale)

@@ -14,7 +14,8 @@ import subprocess
 import sys
 
 BENCH = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "bench.py")
-CONFIGS = {"seq128", "seq4096", "llama3_shape", "resnet50", "ppocr_e2e"}
+CONFIGS = {"seq128", "seq4096", "llama3_shape", "resnet50", "ppocr_e2e",
+           "serving"}
 
 
 def _run_bench(deadline_s):
@@ -61,6 +62,7 @@ def test_measured_config_carries_attribution():
         JAX_PLATFORMS="cpu",
         BENCH_DEADLINE_S="200",
         BENCH_SKIP_VISION="1", BENCH_SKIP_4096="1", BENCH_SKIP_LLAMA="1",
+        BENCH_SKIP_SERVING="1",  # the serving replay has its own tier-1 test
         # shrink the headline model to tier-1 scale; dims land in the record
         BENCH_STEPS="10", BENCH_BATCH="2", BENCH_SEQ="16",
         BENCH_VOCAB="256", BENCH_HIDDEN="64", BENCH_LAYERS="2",

@@ -209,3 +209,72 @@ def test_validate_rejects_non_dict():
         perf_gate.validate_capture([1, 2, 3])
     with pytest.raises(perf_gate.CaptureError):
         perf_gate.validate_capture({"metric": "m"})
+
+
+def _with_serving(tps=5000.0, ttft=40.0, tpot=8.0, n_requests=48,
+                  hidden=512, flops=2.0e11):
+    """Capture carrying a round-11 serving config (the SLO-field shape
+    bench.py emits: continuous stats flat, static nested)."""
+    c = _capture()
+    c["detail"]["configs"]["serving"] = "measured"
+    c["detail"]["serving"] = {
+        "n_requests": n_requests,
+        "tokens_per_sec": tps,
+        "p50_ttft_ms": ttft / 2, "p99_ttft_ms": ttft,
+        "p50_tpot_ms": tpot / 2, "p99_tpot_ms": tpot,
+        "preempted": 0,
+        "serve_dims": {"hidden": hidden, "layers": 4, "max_batch": 8},
+        "static": {"tokens_per_sec": tps * 0.8, "p99_tpot_ms": tpot * 1.2},
+        "attribution": {"flops": flops, "hbm_bytes": 4.0e9,
+                        "program_memory_bytes": 1.0e9},
+    }
+    return c
+
+
+def test_serving_tail_latency_regression_fails(tmp_path):
+    a = _write(tmp_path, "a.json", _with_serving(tpot=8.0))
+    b = _write(tmp_path, "b.json", _with_serving(tpot=9.5))  # p99 TPOT +19%
+    rc, out, err = _run(a, b)
+    assert rc == 1, (out, err)
+    assert "p99_tpot_ms" in out and "UNEXPLAINED" in out
+
+
+def test_serving_ttft_regression_fails(tmp_path):
+    a = _write(tmp_path, "a.json", _with_serving(ttft=40.0))
+    b = _write(tmp_path, "b.json", _with_serving(ttft=50.0))
+    rc, out, err = _run(a, b)
+    assert rc == 1, (out, err)
+    assert "p99_ttft_ms" in out
+
+
+def test_serving_throughput_drop_fails(tmp_path):
+    # tokens/s is larger-is-better: a 20% drop with flat attributed work is
+    # the inverted unexplained-regression signal
+    a = _write(tmp_path, "a.json", _with_serving(tps=5000.0))
+    b = _write(tmp_path, "b.json", _with_serving(tps=4000.0))
+    rc, out, err = _run(a, b)
+    assert rc == 1, (out, err)
+    assert "throughput regression" in out
+
+
+def test_serving_replay_shape_change_not_compared(tmp_path):
+    # a different trace (n_requests) or model (serve_dims) is a different
+    # problem, never a regression
+    a = _write(tmp_path, "a.json", _with_serving(tpot=8.0, n_requests=48))
+    b = _write(tmp_path, "b.json", _with_serving(tpot=20.0, n_requests=96))
+    rc, out, err = _run(a, b)
+    assert rc == 0, (out, err)
+    assert "workload changed" in out
+    a2 = _write(tmp_path, "a2.json", _with_serving(tpot=8.0, hidden=512))
+    b2 = _write(tmp_path, "b2.json", _with_serving(tpot=20.0, hidden=1024))
+    rc, out, err = _run(a2, b2)
+    assert rc == 0, (out, err)
+
+
+def test_serving_explained_by_attributed_work(tmp_path):
+    # p99 TPOT +19% alongside +25% attributed FLOPs: the decode program
+    # genuinely does more work per step
+    a = _write(tmp_path, "a.json", _with_serving(tpot=8.0, flops=2.0e11))
+    b = _write(tmp_path, "b.json", _with_serving(tpot=9.5, flops=2.5e11))
+    rc, out, err = _run(a, b)
+    assert rc == 0, (out, err)

@@ -41,18 +41,26 @@ from typing import Optional
 
 # config keys inside `detail` holding per-config stat dicts, plus the
 # headline whose stats live directly in `detail`
-NESTED_CONFIGS = ("seq4096", "llama3_shape", "resnet50", "ppocr_e2e")
+NESTED_CONFIGS = ("seq4096", "llama3_shape", "resnet50", "ppocr_e2e", "serving")
 # fields whose change means "different workload" (never a regression)
 SHAPE_FIELDS = (
     "batch", "seq", "heads", "layers", "rung", "micro", "n_images",
     "n_boxes", "dims_override", "recompute",
+    # serving replay shape: a different model/trace is a different problem
+    "n_requests", "serve_dims",
 )
-# (field, larger-is-worse) regression metrics per config record; the
-# names match what bench.py actually emits per config (ernie/llama/resnet
-# report ms_per_step; ppocr reports per-stage + e2e per-image times)
+# larger-is-worse regression metrics per config record; the names match
+# what bench.py actually emits per config (ernie/llama/resnet report
+# ms_per_step; ppocr reports per-stage + e2e per-image times; serving
+# reports p99 tail latencies from the request replay — round 11)
 TIME_FIELDS = (
     "ms_per_step", "ms_per_image_e2e", "det_ms_per_image", "rec_ms_per_batch",
+    "p99_ttft_ms", "p99_tpot_ms",
 )
+# larger-is-BETTER metrics: a drop beyond tolerance with flat attributed
+# work is the same unexplained-regression signal inverted (serving
+# tokens/s; the ernie headline's tokens_per_sec rides along consistently)
+THROUGHPUT_FIELDS = ("tokens_per_sec",)
 ATTR_WORK_FIELDS = ("flops", "hbm_bytes")
 ATTR_MEM_FIELDS = ("program_memory_bytes", "peak_hbm_bytes")
 
@@ -188,6 +196,15 @@ def compare_config(key: str, old: dict, new: dict, tol: float):
                 )
                 if verdict == "pass":
                     verdict = "explained"
+    for f in THROUGHPUT_FIELDS:
+        if f in old and f in new and isinstance(old[f], (int, float)) and isinstance(new[f], (int, float)):
+            r = _rel(new[f], old[f])
+            if r < -(tol + max(0.0, work_growth)):
+                lines.append(
+                    f"{key}: {f} {old[f]:.1f} -> {new[f]:.1f} ({r:.1%}) with "
+                    f"attributed work +{work_growth:.1%} — UNEXPLAINED throughput regression"
+                )
+                verdict = "regress"
     for f in ATTR_MEM_FIELDS:
         if oa.get(f) and na.get(f):
             r = _rel(na[f], oa[f])
