@@ -73,6 +73,14 @@ analysis record an explicit `attribution: unavailable` marker — the
 capture contract extends to attribution. vs_baseline MFU methodology is
 unchanged (co-measured peak).
 
+Round 12: an `input_stream` config measures the streaming data tier (tiny
+MLP + input-heavy synthetic reader, prefetch-on vs prefetch-off with the
+step delta attributed to `input_wait_s`; BENCH_INPUT_* shrink knobs,
+BENCH_SKIP_INPUT=1 skips) and a `moe_longcontext` config covers the
+ROADMAP-5 operating point (GQA flash + ring attention + capacity-limited
+MoE EP routing with drop counters in guardian telemetry; BENCH_MOE_*
+knobs, BENCH_SKIP_MOE=1 skips).
+
 Round 11: a `serving` config measures the decode-optimized serving tier —
 greedy decode through the paged-KV InferenceEngine (Pallas flash-decode on
 TPU, AOT prefill/decode shape buckets) under a synthetic heavy-traffic
@@ -118,8 +126,10 @@ _EST_S = {
     "peak": 60,
     "seq128": 240,
     "ocr": 90,
+    "input_stream": 90,
     "serving": 180,
     "resnet": 180,
+    "moe_longcontext": 240,
     "ernie4096": 240,
     "llama": 300,
 }
@@ -549,6 +559,324 @@ def _build_serving():
     return res
 
 
+def _input_dims():
+    """Input-bound streaming-bench knobs, all BENCH_INPUT_* overridable
+    (tier-1 capture tests run a seconds-scale pipeline; a shrunken run
+    records input_dims so it can't masquerade)."""
+    g = os.environ.get
+    return {
+        "n_samples": int(g("BENCH_INPUT_SAMPLES", 4096)),
+        "global_batch": int(g("BENCH_INPUT_BATCH", 64)),
+        "features": int(g("BENCH_INPUT_FEATURES", 1024)),
+        "hidden": int(g("BENCH_INPUT_HIDDEN", 2048)),
+        "classes": int(g("BENCH_INPUT_CLASSES", 128)),
+        # host work per SAMPLE: elements of np.sin ground through numpy in
+        # __getitem__ — sized so the reader is comparable to the step (the
+        # regime where prefetch overlap pays; a reader >> step is input-
+        # bound no matter what, a reader << step hides for free)
+        "reader_work": int(g("BENCH_INPUT_READER_WORK", 100_000)),
+        "steps": int(g("BENCH_INPUT_STEPS", 24)),
+        "seed": int(g("BENCH_INPUT_SEED", 7)),
+    }
+
+
+def _build_input_stream():
+    """Round 12: the streaming data tier under an input-heavy synthetic
+    reader — a tiny MLP step fed by paddle_tpu.io.streaming.StreamingLoader,
+    measured prefetch-ON (double-buffered device ring, donated slots) vs
+    prefetch-OFF (synchronous read+collate+H2D inline) on the same seeded
+    stream. The step-time difference must be attributed by the pipeline's
+    own input_wait_s measurements (the guardian/flight-recorder field), and
+    samples/s + p99 wait gate in tools/perf_gate.py."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.io import Dataset
+    from paddle_tpu.io.streaming import StreamingLoader
+    from paddle_tpu.io.streaming import stats as instats
+
+    d = _input_dims()
+
+    class HeavyReader(Dataset):
+        """Deterministic per-sample host work: the synthetic stand-in for
+        decode/augment/tokenize CPU cost."""
+
+        def __len__(self):
+            return d["n_samples"]
+
+        def __getitem__(self, i):
+            rng = np.random.RandomState((d["seed"] * 1_000_003 + i) % 2**31)
+            w = rng.standard_normal(d["reader_work"]).astype(np.float32)
+            f = d["features"]
+            feat = np.sin(w[: (w.size // f) * f]).reshape(f, -1).mean(axis=1)
+            return feat.astype(np.float32), np.int64(i % d["classes"])
+
+    dataset = HeavyReader()
+
+    def build_step():
+        paddle.seed(d["seed"])
+        model = paddle.nn.Sequential(
+            paddle.nn.Linear(d["features"], d["hidden"]),
+            paddle.nn.ReLU(),
+            paddle.nn.Linear(d["hidden"], d["classes"]),
+        )
+        opt = paddle.optimizer.SGD(0.01, parameters=model.parameters())
+
+        @paddle.jit.to_static
+        def train_step(x, y):
+            loss = paddle.nn.functional.cross_entropy(model(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        return train_step
+
+    def measure(prefetch_depth):
+        """(mean step s, p99/mean wait s, final loss) over d['steps'] after
+        warmup, waits from the pipeline's OWN stats (the same accumulator
+        the guardian reads as input_wait_s)."""
+        train_step = build_step()
+        loader = StreamingLoader(
+            dataset, d["global_batch"], seed=d["seed"], shuffle=True,
+            drop_last=True, prefetch_depth=prefetch_depth,
+            donate=prefetch_depth > 0, source="bench_input",
+        )
+        it = iter(loader)
+        steps, walls, waits, loss = d["steps"], [], [], None
+
+        def nxt():
+            nonlocal it
+            try:
+                return next(it)
+            except StopIteration:  # epoch rolled: keep streaming
+                it = iter(loader)
+                return next(it)
+
+        for _ in range(3):  # warmup: compile + ring fill
+            x, y = nxt()
+            float(train_step(x, y).numpy())
+        instats.take_step_wait()  # drop warmup waits from the measured window
+        for _ in range(steps):
+            t0 = time.perf_counter()
+            x, y = nxt()
+            loss = float(train_step(x, y).numpy())
+            walls.append(time.perf_counter() - t0)
+            waits.append(instats.take_step_wait() or 0.0)
+        import numpy as _np
+
+        return (
+            float(_np.mean(walls)),
+            float(_np.percentile(waits, 99)),
+            float(_np.mean(waits)),
+            loss,
+        )
+
+    dt_on, p99_on, mean_on, loss_on = measure(2)
+    verdict_on = instats.starvation_verdict()  # before the off-run pollutes the window
+    dt_off, p99_off, mean_off, loss_off = measure(0)
+    step_delta = dt_off - dt_on
+    wait_delta = mean_off - mean_on
+    res = {
+        "n_samples": d["n_samples"],
+        "global_batch": d["global_batch"],
+        "steps": d["steps"],
+        "input_dims": {k: d[k] for k in ("features", "hidden", "classes",
+                                         "reader_work")},
+        "prefetch_depth": 2,
+        "ms_per_step": round(dt_on * 1000, 3),
+        "samples_per_sec": round(d["global_batch"] / dt_on, 1),
+        "p99_input_wait_ms": round(p99_on * 1000, 3),
+        "mean_input_wait_ms": round(mean_on * 1000, 3),
+        "final_loss": loss_on,
+        "prefetch_off": {
+            "ms_per_step": round(dt_off * 1000, 3),
+            "samples_per_sec": round(d["global_batch"] / dt_off, 1),
+            "p99_input_wait_ms": round(p99_off * 1000, 3),
+            "mean_input_wait_ms": round(mean_off * 1000, 3),
+            "final_loss": loss_off,
+        },
+        # how much of the prefetch win the pipeline's own wait metric
+        # explains: ~1.0 means the step-time delta IS hidden input wait
+        "wait_attribution": {
+            "step_delta_ms": round(step_delta * 1000, 3),
+            "wait_delta_ms": round(wait_delta * 1000, 3),
+            "explained_fraction": (
+                round(wait_delta / step_delta, 3) if step_delta > 0 else None
+            ),
+        },
+        "overlap_efficiency": (
+            round(max(0.0, min(1.0, 1.0 - mean_on / mean_off)), 3)
+            if mean_off > 0 else None
+        ),
+        "verdict": verdict_on,
+        "attribution": _attribution(dt_on),
+    }
+    return res
+
+
+def _moe_dims():
+    """MoE + long-context bench knobs (ROADMAP item 5 down payment), all
+    BENCH_MOE_* overridable. Defaults target one TPU chip; the tier-1
+    capture test shrinks seq/experts to seconds scale (moe_dims recorded)."""
+    g = os.environ.get
+    return {
+        "seq": int(g("BENCH_MOE_SEQ", 16384)),
+        "d_model": int(g("BENCH_MOE_DMODEL", 512)),
+        "heads": int(g("BENCH_MOE_HEADS", 8)),
+        "kv_heads": int(g("BENCH_MOE_KV_HEADS", 2)),
+        "experts": int(g("BENCH_MOE_EXPERTS", 8)),
+        "top_k": int(g("BENCH_MOE_TOPK", 2)),
+        "capacity": float(g("BENCH_MOE_CAPACITY", 1.2)),
+        "ffn": int(g("BENCH_MOE_FFN", 1024)),
+        "steps": int(g("BENCH_MOE_STEPS", 6)),
+    }
+
+
+def _build_moe_longcontext():
+    """ROADMAP item 5 operating point: a sparse long-context block —
+    GQA flash attention (the r4 kernel's native head-group mapping), exact
+    ring attention over the sep axis (the seq >= 16k path), and MoE
+    expert-parallel routing with a REAL capacity factor (1.2 train) whose
+    token drops land in the guardian telemetry counters
+    (`paddle_tpu_moe_{routed,dropped}_tokens_total`). Runs EAGER: the drop
+    counters need concrete values each step (a traced count is a tracer),
+    so the capture records an explicit attribution-unavailable marker."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.core.apply import apply as _apply
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.incubate.distributed.models.moe import ExpertLayer, MoELayer
+    from paddle_tpu.ops.ring_attention import ring_attention
+
+    d = _moe_dims()
+    hd = d["d_model"] // d["heads"]
+    B, S = 1, d["seq"]
+
+    # ep routing needs a hybrid topology; on one chip the dp axis is width 1
+    # (the dispatch/combine einsums and capacity math are identical, the
+    # all-to-all is a no-op) — dryrun_multichip covers the 8-way EP path
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    sep_mesh = Mesh(np.array(jax.devices()[:1]), ("sep",))
+
+    paddle.seed(0)
+    q_proj = nn.Linear(d["d_model"], d["heads"] * hd)
+    kv_proj = nn.Linear(d["d_model"], 2 * d["kv_heads"] * hd)
+    out_proj = nn.Linear(d["heads"] * hd, d["d_model"])
+    ring_qkv = nn.Linear(d["d_model"], 3 * d["heads"] * hd)
+    ring_out = nn.Linear(d["heads"] * hd, d["d_model"])
+
+    def make_moe():
+        return MoELayer(
+            d_model=d["d_model"],
+            experts=[ExpertLayer(d["d_model"], d["ffn"])
+                     for _ in range(d["experts"])],
+            gate={"type": "gshard", "top_k": d["top_k"]},
+            ep_axis="dp",
+        )
+
+    moe0, moe1 = make_moe(), make_moe()
+    for m in (moe0, moe1):
+        m.gate.capacity_factor = (d["capacity"], d["capacity"] * 2)
+    params = (q_proj.parameters() + kv_proj.parameters()
+              + out_proj.parameters() + ring_qkv.parameters()
+              + ring_out.parameters() + moe0.parameters() + moe1.parameters())
+    opt = paddle.optimizer.AdamW(1e-4, parameters=params, weight_decay=0.01)
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(B, S, d["d_model"]).astype(np.float32) * 0.1
+    )
+
+    def forward(h):
+        # block 0: causal GQA attention (flash kernel on TPU: S >= 512 and
+        # h_kv | h_q dispatch the native head-group mapping) + MoE FFN
+        q = q_proj(h).reshape([B, S, d["heads"], hd])
+        kv = kv_proj(h).reshape([B, S, 2 * d["kv_heads"], hd])
+        k, v = kv[:, :, : d["kv_heads"]], kv[:, :, d["kv_heads"]:]
+        a = nn.functional.scaled_dot_product_attention(q, k, v, is_causal=True)
+        h = h + out_proj(a.reshape([B, S, d["heads"] * hd]))
+        h = h + moe0(h)
+        # block 1: exact ring attention with the sequence sharded over sep
+        # (the seq >= 16k long-context path; on one chip the ring is width 1
+        # but the kernel, layout, and chunked online-softmax are the real
+        # ones — dryrun_multichip runs the 8-device ring)
+        qkv = ring_qkv(h).reshape([B, S, 3 * d["heads"], hd])
+        rq = qkv[:, :, : d["heads"]]
+        rk = qkv[:, :, d["heads"]: 2 * d["heads"]]
+        rv = qkv[:, :, 2 * d["heads"]:]
+        r = _apply(
+            "ring_attention",
+            lambda a_, b_, c_: ring_attention(
+                a_, b_, c_, mesh=sep_mesh, causal=True
+            ),
+            rq, rk, rv,
+        )
+        h = h + ring_out(r.reshape([B, S, d["heads"] * hd]))
+        h = h + moe1(h)
+        return h
+
+    def train_step():
+        out = forward(x)
+        loss = (out * out).mean() + 0.01 * (moe0.l_aux + moe1.l_aux)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    def run(n):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            loss = train_step()
+        val = float(loss.numpy())
+        return time.perf_counter() - t0, val
+
+    dt_step, final_loss = _slope_measure(run, d["steps"], warm=2)
+
+    # capacity-drop counters: harvest the LAST (eager) forward's concrete
+    # counts into the guardian telemetry + the capture record
+    drops = {
+        name: m.record_drop_telemetry(name=name)
+        for name, m in (("moe0", moe0), ("moe1", moe1))
+    }
+    routed = sum(s["routed"] for s in drops.values() if s)
+    dropped = sum(s["dropped"] for s in drops.values() if s)
+    return {
+        "batch": B,
+        "seq": S,
+        "heads": f"{d['heads']}q/{d['kv_heads']}kv",
+        "experts": d["experts"],
+        "top_k": d["top_k"],
+        "capacity_factor": d["capacity"],
+        "moe_dims": {k: d[k] for k in ("d_model", "ffn")},
+        "steps": d["steps"],
+        "ms_per_step": round(dt_step * 1000, 2),
+        "tokens_per_sec": round(B * S / dt_step, 1),
+        "final_loss": final_loss,
+        "moe_drops": {
+            "routed_per_step": routed,
+            "dropped_per_step": dropped,
+            "drop_fraction": round(dropped / routed, 4) if routed else None,
+            "per_layer": drops,
+        },
+        "note": (
+            "GQA flash attention + exact ring attention (sep axis) + "
+            "GShard-capacity MoE EP routing in one eager block; drop "
+            "counters land in paddle_tpu_moe_*_tokens_total (guardian "
+            "telemetry); eager because traced drop counts are tracers"
+        ),
+        "attribution": {
+            "attribution": "unavailable",
+            "why": "eager config (concrete per-step capacity-drop counters); "
+                   "no compiled-program cost record to attribute",
+        },
+    }
+
+
 def _release_device_memory():
     """Drop compiled executables + dead buffers between configs — the
     Llama-shaped config holds ~8GB of AdamW state; without this the peak
@@ -759,7 +1087,7 @@ class _Snapshot:
     ones already measured."""
 
     CONFIGS = ("seq128", "seq4096", "llama3_shape", "resnet50", "ppocr_e2e",
-               "serving")
+               "serving", "input_stream", "moe_longcontext")
 
     def __init__(self):
         self.result = {
@@ -806,6 +1134,8 @@ def main():
             "resnet": lambda: _build_resnet(steps=steps_c),
             "ocr": lambda: _build_ppocr(n_images=steps_c),
             "serving": _build_serving,
+            "input_stream": _build_input_stream,
+            "moe_longcontext": _build_moe_longcontext,
         }
         if child not in builders:
             raise ValueError(f"unknown BENCH_CHILD {child}")
@@ -905,9 +1235,9 @@ def main():
         detail["seq128"] = {"skipped": "deadline"}
         snap.resolve("seq128", "skipped:deadline")
 
-    # ---- satellites, CHEAPEST-FIRST (ocr 90s < serving/resnet 180s <
-    # ernie4096 < llama): a tight budget forfeits the expensive tail,
-    # never the whole record ----
+    # ---- satellites, CHEAPEST-FIRST (ocr/input_stream 90s <
+    # serving/resnet 180s < moe_longcontext/ernie4096 240s < llama): a
+    # tight budget forfeits the expensive tail, never the whole record ----
     if skip_env("BENCH_SKIP_VISION"):
         snap.resolve("ppocr_e2e", "skipped:env")
     else:
@@ -921,6 +1251,23 @@ def main():
             "ppocr_e2e",
             "measured" if "skipped" not in res_ocr
             else f"skipped:{res_ocr['skipped']}",
+        )
+
+    if skip_env("BENCH_SKIP_INPUT"):
+        snap.resolve("input_stream", "skipped:env")
+    else:
+        res_in = _run_config_child("input_stream", 0)
+        detail["input_stream"] = res_in if "skipped" in res_in else {
+            **res_in,
+            "note": "round 12: streaming data tier under an input-heavy "
+                    "synthetic reader — prefetch-on vs prefetch-off on the "
+                    "same seeded stream, step delta attributed to "
+                    "input_wait_s by the pipeline's own stats",
+        }
+        snap.resolve(
+            "input_stream",
+            "measured" if "skipped" not in res_in
+            else f"skipped:{res_in['skipped']}",
         )
 
     if skip_env("BENCH_SKIP_SERVING"):
@@ -952,6 +1299,17 @@ def main():
             "resnet50",
             "measured" if "skipped" not in res_rn
             else f"skipped:{res_rn['skipped']}",
+        )
+
+    if skip_env("BENCH_SKIP_MOE"):
+        snap.resolve("moe_longcontext", "skipped:env")
+    else:
+        res_moe = _run_config_child("moe_longcontext", 0)
+        detail["moe_longcontext"] = res_moe
+        snap.resolve(
+            "moe_longcontext",
+            "measured" if "skipped" not in res_moe
+            else f"skipped:{res_moe['skipped']}",
         )
 
     if skip_env("BENCH_SKIP_4096"):

@@ -301,6 +301,37 @@ def transformer_layout_table(
     )
 
 
+def data_batch_axes(mesh: Optional[Mesh] = None) -> Tuple[str, ...]:
+    """Mesh axis names that shard the BATCH dim of input data: the `data`
+    and `fsdp` roles with degree > 1 (ZeRO replicas consume disjoint
+    batches exactly like plain DP; tp/pp/sep replicate the batch). The
+    streaming input tier (`paddle_tpu.io.streaming`) derives its per-rank
+    split and its device placement from this — the one place the input
+    pipeline and the model sharding agree on the dp degree."""
+    mesh = mesh if mesh is not None else global_mesh_or_none()
+    if mesh is None:
+        return ()
+    axes = []
+    for name, size in zip(mesh.axis_names, mesh.devices.shape):
+        role = AXIS_TO_ROLE.get(name, name)
+        if role in ("data", "fsdp") and int(size) > 1:
+            axes.append(str(name))
+    return tuple(axes)
+
+
+def data_parallel_degree(mesh: Optional[Mesh] = None) -> int:
+    """Number of data-parallel input replicas on the mesh (product of the
+    `data_batch_axes` degrees; 1 when no mesh is registered)."""
+    mesh = mesh if mesh is not None else global_mesh_or_none()
+    if mesh is None:
+        return 1
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    d = 1
+    for ax in data_batch_axes(mesh):
+        d *= int(sizes[ax])
+    return d
+
+
 # ---------------------------------------------------------------------------
 # placement helpers (the one implementation mp_layers / SP / ZeRO share)
 # ---------------------------------------------------------------------------

@@ -691,6 +691,9 @@ class TrainingGuardian:
             "anomaly", anomaly=kind, policy=policy, step=step,
             loss=_loss_float(loss_raw), grad_norm=grad_norm,
             peak_hbm_bytes=(wm or {}).get("peak_hbm_bytes"),
+            # anomalous steps consume their wait window too — a starved
+            # step that also went NaN should say so in the crash dump
+            input_wait_s=_input_wait_delta(),
         )
         if policy == "skip_step":
             self.skipped_steps += 1
@@ -815,6 +818,7 @@ class TrainingGuardian:
             lr=float(opt.get_lr()),
             collectives=self._collective_deltas(),
             peak_hbm_bytes=wm.get("peak_hbm_bytes"),
+            input_wait_s=_input_wait_delta(),
         )
         interval = self.lkg_interval
         if interval > 0 and step % interval == 0:
@@ -835,6 +839,21 @@ class TrainingGuardian:
 
     def check_desync(self, escalate: bool = True):
         return self.detector.check(escalate=escalate)
+
+
+def _input_wait_delta():
+    """Per-step input-pipeline wait (`input_wait_s`): how long this step's
+    data took to arrive, from the streaming tier's stats accumulator. None
+    when no input pipeline has reported a wait (loader-less loops record
+    nothing rather than a misleading 0.0). Consuming the delta here also
+    closes one (wall, wait) sample of the starved-vs-slow window that
+    perf_report()['input_pipeline'] judges."""
+    try:
+        from ..io.streaming import stats as _instats
+
+        return _instats.take_step_wait()
+    except Exception:
+        return None
 
 
 def _loss_float(loss):

@@ -57,11 +57,15 @@ def _constrain_first_dim(x, sharding):
 
 
 def _routing(probs, top_k: int, capacity: int, aux_mode, normalize: bool):
-    """Dense GShard routing: probs [T, E] -> combine [T, E, C], aux loss.
+    """Dense GShard routing: probs [T, E] -> combine [T, E, C], aux loss,
+    dropped-assignment count.
 
     Positions are assigned priority-major (all first choices before any
     second choice, matching gshard_gate.py's limit_by_capacity order);
-    tokens past an expert's capacity are dropped (weight zeroed).
+    tokens past an expert's capacity are dropped (weight zeroed). The
+    returned `dropped` scalar counts zeroed (token, k) assignments out of
+    T * top_k routed — the capacity-factor overflow signal the guardian
+    telemetry counters report (round 12).
     """
     T, E = probs.shape
     compute_dtype = probs.dtype
@@ -85,16 +89,18 @@ def _routing(probs, top_k: int, capacity: int, aux_mode, normalize: bool):
 
     combine = jnp.zeros((T, E, capacity), compute_dtype)
     prev_count = jnp.zeros((E,), jnp.int32)
+    dropped = jnp.zeros((), jnp.float32)
     for k in range(top_k):
         m = masks[:, k, :]  # [T, E]
         loc = jnp.cumsum(m, axis=0).astype(jnp.int32) - 1 + prev_count[None, :]
         prev_count = prev_count + jnp.sum(m, axis=0).astype(jnp.int32)
         pos_k = jnp.sum(loc * m.astype(jnp.int32), axis=1)  # [T]
         keep = (pos_k < capacity) & (pos_k >= 0)
+        dropped = dropped + (T - jnp.sum(keep.astype(jnp.float32)))
         w = gate_vals[:, k] * keep.astype(compute_dtype)  # [T]
         pos_oh = jax.nn.one_hot(jnp.clip(pos_k, 0, capacity - 1), capacity, dtype=compute_dtype)
         combine = combine + w[:, None, None] * m[:, :, None] * pos_oh[:, None, :]
-    return combine, l_aux
+    return combine, l_aux, dropped
 
 
 class ExpertLayer(Layer):
@@ -206,15 +212,70 @@ class MoELayer(Layer):
         esh = _ep_sharding(mesh, axis)
 
         if self._all_default_experts():
-            out, l_aux = self._fused_forward(x, probs, gate_cfg, esh)
+            out, l_aux, dropped = self._fused_forward(x, probs, gate_cfg, esh)
         else:
-            out, l_aux = self._generic_forward(x, probs, gate_cfg, esh)
+            out, l_aux, dropped = self._generic_forward(x, probs, gate_cfg, esh)
 
         self.l_aux = l_aux
         self.gate.l_aux = l_aux
+        # capacity-overflow accounting: dropped (token, k) assignments out
+        # of T * top_k routed this forward; host-queryable via drop_stats()
+        # (None under a jax trace — the count is a tracer there)
+        self._last_dropped = dropped
+        self._last_routed = T * self.gate.top_k
         if len(orig_shape) != 2:
             out = out.reshape(orig_shape)
         return out
+
+    # -- capacity-overflow telemetry (round 12) ------------------------------
+    def drop_stats(self):
+        """Host-side stats of the LAST forward's capacity drops:
+        {routed, dropped, drop_fraction}. None before any forward or when
+        the last forward ran under a jax trace (the count is a tracer
+        there; run one eager forward to harvest)."""
+        dropped = getattr(self, "_last_dropped", None)
+        if dropped is None:
+            return None
+        v = dropped._raw() if isinstance(dropped, Tensor) else dropped
+        if isinstance(v, jax.core.Tracer):
+            return None
+        n_dropped = float(jax.device_get(v))
+        routed = int(self._last_routed)
+        return {
+            "routed": routed,
+            "dropped": n_dropped,
+            "drop_fraction": n_dropped / routed if routed else 0.0,
+        }
+
+    def record_drop_telemetry(self, recorder=None, name: str = "moe"):
+        """Publish the last forward's drop stats into the guardian
+        telemetry: `paddle_tpu_moe_{routed,dropped}_tokens_total` counters +
+        a drop-fraction gauge, and (optionally) a flight-recorder event so
+        crash dumps carry the capacity-overflow state. Returns the stats
+        dict (or None when unavailable — see drop_stats)."""
+        stats = self.drop_stats()
+        if stats is None:
+            return None
+        from ..... import telemetry as _tm
+
+        if _tm.enabled():
+            _tm.counter(
+                "paddle_tpu_moe_routed_tokens_total",
+                "(token, k) assignments routed through MoE gates", ("layer",),
+            ).labels(layer=name).inc(stats["routed"])
+            _tm.counter(
+                "paddle_tpu_moe_dropped_tokens_total",
+                "(token, k) assignments dropped by expert capacity limits",
+                ("layer",),
+            ).labels(layer=name).inc(int(stats["dropped"]))
+            _tm.gauge(
+                "paddle_tpu_moe_drop_fraction",
+                "capacity-overflow drop fraction of the last MoE forward",
+                ("layer",),
+            ).labels(layer=name).set(stats["drop_fraction"])
+        if recorder is not None:
+            recorder.record_event("moe_capacity", layer=name, **stats)
+        return stats
 
     def _fused_forward(self, x, probs, gate_cfg, esh):
         top_k, C, aux_mode, normalize = gate_cfg
@@ -230,7 +291,7 @@ class MoELayer(Layer):
             b1 = jnp.stack(flat[1::4])  # [E, H]
             w2 = jnp.stack(flat[2::4])  # [E, H, M]
             b2 = jnp.stack(flat[3::4])  # [E, M]
-            combine, l_aux = _routing(pv, top_k, C, aux_mode, normalize)
+            combine, l_aux, dropped = _routing(pv, top_k, C, aux_mode, normalize)
             dispatch = (combine > 0).astype(xv.dtype)
 
             def experts_fn(disp, w1, b1, w2, b2):
@@ -244,19 +305,21 @@ class MoELayer(Layer):
             body = jax.checkpoint(experts_fn) if remat else experts_fn
             eo = body(dispatched, w1, b1, w2, b2)
             out = jnp.einsum("tec,ecm->tm", combine, eo)
-            return out, l_aux
+            return out, l_aux, dropped
 
-        return apply("moe_fused", fn, x, probs, *params, n_outputs=2)
+        return apply("moe_fused", fn, x, probs, *params, n_outputs=3)
 
     def _generic_forward(self, x, probs, gate_cfg, esh):
         top_k, C, aux_mode, normalize = gate_cfg
 
         def dispatch_fn(xv, pv):
-            combine, l_aux = _routing(pv, top_k, C, aux_mode, normalize)
+            combine, l_aux, dropped = _routing(pv, top_k, C, aux_mode, normalize)
             dispatched = jnp.einsum("tec,tm->ecm", (combine > 0).astype(xv.dtype), xv)
-            return _constrain_first_dim(dispatched, esh), combine, l_aux
+            return _constrain_first_dim(dispatched, esh), combine, l_aux, dropped
 
-        dispatched, combine, l_aux = apply("moe_dispatch", dispatch_fn, x, probs, n_outputs=3)
+        dispatched, combine, l_aux, dropped = apply(
+            "moe_dispatch", dispatch_fn, x, probs, n_outputs=4
+        )
 
         outs = []
         for i, expert in enumerate(self.experts):
@@ -267,4 +330,4 @@ class MoELayer(Layer):
             return jnp.einsum("tec,ecm->tm", cv, eo)
 
         out = apply("moe_combine", combine_fn, combine, *outs)
-        return out, l_aux
+        return out, l_aux, dropped

@@ -734,6 +734,35 @@ class DataLoader:
             )
             return self._mp_pool.run_epoch(list(self.batch_sampler))
 
+    def _record_worker_fallback(self, exc) -> None:
+        """Process->thread degradation accounting: warn once per loader with
+        the reason, count every occurrence
+        (`paddle_tpu_dataloader_fallbacks_total{reason}`)."""
+        reason = type(exc).__name__
+        try:
+            from .. import telemetry as _tm
+
+            if _tm.enabled():
+                _tm.counter(
+                    "paddle_tpu_dataloader_fallbacks_total",
+                    "DataLoader worker-process spawns degraded to thread "
+                    "prefetch (unpicklable dataset/collate, no mp, ...)",
+                    ("reason",),
+                ).labels(reason=reason).inc()
+        except Exception:
+            pass  # accounting must never break data loading
+        if getattr(self, "_fallback_warned", False):
+            return
+        self._fallback_warned = True
+        import warnings
+
+        warnings.warn(
+            f"DataLoader(persistent_workers=True): worker spawn failed "
+            f"({reason}: {exc}); falling back to thread prefetch "
+            "(worker_init_fn will NOT run)",
+            stacklevel=3,
+        )
+
     def __del__(self):
         pool = getattr(self, "_mp_pool", None)
         if pool is not None:
@@ -758,15 +787,12 @@ class DataLoader:
                 except (TypeError, AttributeError, OSError, ImportError) as e:
                     # spawn needs a picklable dataset/collate/worker_init_fn;
                     # degrade loudly, not silently — the user asked for
-                    # worker processes and is getting a thread
-                    import warnings
-
-                    warnings.warn(
-                        f"DataLoader(persistent_workers=True): worker spawn "
-                        f"failed ({type(e).__name__}: {e}); falling back to "
-                        "thread prefetch (worker_init_fn will NOT run)",
-                        stacklevel=2,
-                    )
+                    # worker processes and is getting a thread. The warning
+                    # fires ONCE per loader (every epoch re-enters here and
+                    # a 100-epoch run must not emit 100 identical lines);
+                    # the fallback COUNTER increments every time so
+                    # dashboards still see the real rate.
+                    self._record_worker_fallback(e)
             return self._prefetch_iter()
         return self._gen()
 
@@ -778,3 +804,9 @@ class DataLoader:
 
 def get_worker_info():
     return None
+
+
+# the streaming data tier (sharded/resumable/device-prefetched input —
+# ROADMAP item 4) lives in its own subpackage; imported last because its
+# loader builds on the Dataset/collate/prefetch machinery above
+from . import streaming  # noqa: E402,F401

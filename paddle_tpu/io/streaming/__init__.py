@@ -1,0 +1,36 @@
+"""Streaming data tier (ROADMAP item 4).
+
+Reference parity: paddle/fluid/operators/reader (the L0/L3 reader/feed
+layer) + python/paddle/io's DistributedBatchSampler, rebuilt TPU-native:
+per-rank sharded iterators derive their split from the PR 7 global mesh,
+host->device prefetch is a double-buffered `device_put` ring, mid-epoch
+resume is an iterator state_dict saved inside PR 2's atomic checkpoints,
+and reader lag is a first-class telemetry family
+(`paddle_tpu_input_*`) joined with PR 5's attribution into a
+starved-vs-slow verdict (`paddle.profiler.perf_report()['input_pipeline']`).
+"""
+from .sharding import (  # noqa: F401
+    MeshDistributedBatchSampler,
+    ShardPlan,
+    ShardedDataset,
+    data_shard_info,
+)
+from .loader import (  # noqa: F401
+    StreamingLoader,
+    state_template,
+    state_to_tensors,
+    tensors_to_state,
+)
+from . import stats  # noqa: F401
+
+__all__ = [
+    "MeshDistributedBatchSampler",
+    "ShardPlan",
+    "ShardedDataset",
+    "StreamingLoader",
+    "data_shard_info",
+    "state_template",
+    "state_to_tensors",
+    "tensors_to_state",
+    "stats",
+]

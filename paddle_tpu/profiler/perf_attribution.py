@@ -466,7 +466,7 @@ def roofline(flops, bytes_accessed, seconds, platform: Optional[str] = None,
 
 _REPORT_KEYS = (
     "version", "generated_at", "platform", "telemetry_enabled",
-    "programs", "live_arrays", "hbm_watermark",
+    "programs", "live_arrays", "hbm_watermark", "input_pipeline",
 )
 _PROGRAM_KEYS = (
     "serial", "origin", "name", "platform", "flops", "bytes_accessed",
@@ -475,11 +475,36 @@ _PROGRAM_KEYS = (
 )
 
 
+def _input_pipeline_section() -> dict:
+    """The starved-vs-slow join (round 12): the streaming tier's wait
+    totals + rolling-window verdict, annotated against the device-side
+    story this report carries. A 'starved' step is one the roofline records
+    CANNOT explain — the device was idle waiting for the host — which is
+    exactly the case where chasing `programs[]` mfu would mislead."""
+    try:
+        from ..io.streaming import stats as _instats
+
+        section = _instats.summary()
+    except Exception as e:  # the report must not die on a partial install
+        return {"verdict": "unavailable", "error": str(e)[-200:]}
+    hints = {
+        "starved": "host input pipeline bounds the step; device attribution "
+                   "(programs[]) cannot explain the step time — fix the "
+                   "reader/prefetch, not the kernels",
+        "input_limited": "input wait is a visible slice of the step; both "
+                         "host and device stories apply",
+        "compute": "device-bound: see programs[] cost records + roofline",
+    }
+    section["attribution_hint"] = hints.get(section.get("verdict"))
+    return section
+
+
 def perf_report(origin: Optional[str] = None) -> dict:
     """The queryable attribution summary (exported as
     `paddle.profiler.perf_report`): every recorded program's FLOPs / bytes /
-    memory / compile time, the live-array census, and the HBM watermark.
-    Plain JSON-serializable dict."""
+    memory / compile time, the live-array census, the HBM watermark, and
+    the input-pipeline starved-vs-slow verdict. Plain JSON-serializable
+    dict."""
     return {
         "version": 1,
         "generated_at": time.time(),
@@ -488,6 +513,7 @@ def perf_report(origin: Optional[str] = None) -> dict:
         "programs": program_records(origin),
         "live_arrays": live_array_census(set_gauges=False),
         "hbm_watermark": watermark(),
+        "input_pipeline": _input_pipeline_section(),
     }
 
 
@@ -508,6 +534,8 @@ def validate_report(report: dict) -> dict:
             raise ValueError(f"live_arrays census missing {k!r}")
     if "peak_hbm_bytes" not in report["hbm_watermark"]:
         raise ValueError("hbm_watermark missing peak_hbm_bytes")
+    if "verdict" not in report["input_pipeline"]:
+        raise ValueError("input_pipeline missing verdict")
     return report
 
 

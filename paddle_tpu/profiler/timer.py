@@ -56,8 +56,19 @@ class Benchmark:
 
     def after_reader(self):
         if self._reader_t is not None:
-            self.reader_cost.update(time.perf_counter() - self._reader_t)
+            dt = time.perf_counter() - self._reader_t
+            self.reader_cost.update(dt)
             self._reader_t = None
+            # round 12: every reader wait ALSO lands in the unified
+            # paddle_tpu_input_* family (io.streaming.stats), so Benchmark
+            # users and StreamingLoader users feed the same dashboards —
+            # and the guardian's per-step input_wait_s sees this path too
+            try:
+                from ..io.streaming import stats as _instats
+
+                _instats.observe_wait(dt, source="benchmark")
+            except Exception:
+                pass
 
     def step(self, num_samples=None):
         if not self.running:
@@ -74,14 +85,30 @@ class Benchmark:
     def _publish_gauges(self):
         """Mirror the running averages into the telemetry registry so step
         time / reader cost / ips are scrapeable alongside the other runtime
-        metrics (the role of the reference's fleet metric reporters)."""
+        metrics (the role of the reference's fleet metric reporters).
+
+        Round 12: the `paddle_tpu_input_*` family (source="benchmark") is
+        the SOURCE OF TRUTH — per-event waits publish from after_reader,
+        samples/s publishes here. The old `paddle_tpu_benchmark_*` gauges
+        are a DEPRECATION SHIM (same values, kept so existing dashboards
+        don't go dark); new consumers should read paddle_tpu_input_*."""
         from .. import telemetry as _tm
 
         if not _tm.enabled():
             return
+        if self.ips_stat.count:
+            try:
+                _tm.gauge(
+                    "paddle_tpu_input_samples_per_sec",
+                    "delivered input samples per second (rolling)", ("source",),
+                ).labels(source="benchmark").set(self.ips_stat.avg)
+            except Exception:
+                pass
+        # ---- deprecated names (shim over the paddle_tpu_input_* family) ----
         _tm.gauge(
             "paddle_tpu_benchmark_reader_cost_seconds",
-            "avg dataloader wait per step (post-warmup)",
+            "DEPRECATED: avg dataloader wait per step — read "
+            "paddle_tpu_input_wait_seconds{source='benchmark'} instead",
         ).set(self.reader_cost.avg)
         _tm.gauge(
             "paddle_tpu_benchmark_batch_cost_seconds",
@@ -89,7 +116,9 @@ class Benchmark:
         ).set(self.batch_cost.avg)
         if self.ips_stat.count:
             _tm.gauge(
-                "paddle_tpu_benchmark_ips", "avg items/sec (post-warmup)"
+                "paddle_tpu_benchmark_ips",
+                "DEPRECATED: avg items/sec — read "
+                "paddle_tpu_input_samples_per_sec{source='benchmark'} instead",
             ).set(self.ips_stat.avg)
 
     def end(self):

@@ -278,3 +278,80 @@ def test_serving_explained_by_attributed_work(tmp_path):
     b = _write(tmp_path, "b.json", _with_serving(tpot=9.5, flops=2.5e11))
     rc, out, err = _run(a, b)
     assert rc == 0, (out, err)
+
+
+def _with_input_stream(sps=800.0, p99_wait=20.0, ms=20.0, reader_work=100_000):
+    """Capture carrying a round-12 input_stream config (the streaming data
+    tier's field shape: samples/s throughput, p99 wait tail, reader shape)."""
+    c = _capture()
+    c["detail"]["configs"]["input_stream"] = "measured"
+    c["detail"]["input_stream"] = {
+        "n_samples": 4096, "global_batch": 64, "prefetch_depth": 2,
+        "input_dims": {"features": 1024, "hidden": 2048,
+                       "reader_work": reader_work},
+        "ms_per_step": ms,
+        "samples_per_sec": sps,
+        "p99_input_wait_ms": p99_wait,
+        "mean_input_wait_ms": p99_wait / 2,
+        "prefetch_off": {"ms_per_step": ms * 1.5},
+        "attribution": {"flops": 1.0e10, "hbm_bytes": 2.0e9,
+                        "program_memory_bytes": 5.0e8},
+    }
+    return c
+
+
+def test_input_stream_samples_per_sec_regression_fails(tmp_path):
+    # the ISSUE-10 acceptance: an injected samples/s drop (flat attributed
+    # work, same reader shape) must fail the gate
+    a = _write(tmp_path, "a.json", _with_input_stream(sps=800.0))
+    b = _write(tmp_path, "b.json", _with_input_stream(sps=600.0))
+    rc, out, err = _run(a, b)
+    assert rc == 1, (out, err)
+    assert "samples_per_sec" in out and "throughput regression" in out
+
+
+def test_input_stream_wait_tail_regression_fails(tmp_path):
+    a = _write(tmp_path, "a.json", _with_input_stream(p99_wait=20.0))
+    b = _write(tmp_path, "b.json", _with_input_stream(p99_wait=26.0))
+    rc, out, err = _run(a, b)
+    assert rc == 1, (out, err)
+    assert "p99_input_wait_ms" in out and "UNEXPLAINED" in out
+
+
+def test_input_stream_reader_shape_change_not_compared(tmp_path):
+    # a heavier synthetic reader is a different problem, not a regression
+    a = _write(tmp_path, "a.json", _with_input_stream(sps=800.0))
+    b = _write(tmp_path, "b.json",
+               _with_input_stream(sps=400.0, reader_work=400_000))
+    rc, out, err = _run(a, b)
+    assert rc == 0, (out, err)
+    assert "workload changed" in out
+
+
+def _with_moe(tps=50000.0, ms=160.0, experts=8, capacity=1.2):
+    c = _capture()
+    c["detail"]["configs"]["moe_longcontext"] = "measured"
+    c["detail"]["moe_longcontext"] = {
+        "batch": 1, "seq": 16384, "heads": "8q/2kv",
+        "experts": experts, "top_k": 2, "capacity_factor": capacity,
+        "moe_dims": {"d_model": 512, "ffn": 1024},
+        "ms_per_step": ms, "tokens_per_sec": tps,
+        "moe_drops": {"drop_fraction": 0.02},
+        "attribution": {"attribution": "unavailable", "why": "eager config"},
+    }
+    return c
+
+
+def test_moe_longcontext_gated(tmp_path):
+    # throughput drop with no attribution to explain it -> regression;
+    # a different expert count / capacity factor -> different workload
+    a = _write(tmp_path, "a.json", _with_moe(tps=50000.0))
+    b = _write(tmp_path, "b.json", _with_moe(tps=40000.0))
+    rc, out, err = _run(a, b)
+    assert rc == 1, (out, err)
+    assert "moe_longcontext" in out
+    a2 = _write(tmp_path, "a2.json", _with_moe(tps=50000.0, capacity=1.2))
+    b2 = _write(tmp_path, "b2.json", _with_moe(tps=40000.0, capacity=2.0))
+    rc, out, err = _run(a2, b2)
+    assert rc == 0, (out, err)
+    assert "workload changed" in out

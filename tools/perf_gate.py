@@ -41,26 +41,33 @@ from typing import Optional
 
 # config keys inside `detail` holding per-config stat dicts, plus the
 # headline whose stats live directly in `detail`
-NESTED_CONFIGS = ("seq4096", "llama3_shape", "resnet50", "ppocr_e2e", "serving")
+NESTED_CONFIGS = ("seq4096", "llama3_shape", "resnet50", "ppocr_e2e", "serving",
+                  "input_stream", "moe_longcontext")
 # fields whose change means "different workload" (never a regression)
 SHAPE_FIELDS = (
     "batch", "seq", "heads", "layers", "rung", "micro", "n_images",
     "n_boxes", "dims_override", "recompute",
     # serving replay shape: a different model/trace is a different problem
     "n_requests", "serve_dims",
+    # round 12: input-stream reader/model shape + MoE routing shape — a
+    # different reader cost or expert count is a different problem
+    "n_samples", "global_batch", "input_dims", "prefetch_depth",
+    "experts", "top_k", "capacity_factor", "moe_dims",
 )
 # larger-is-worse regression metrics per config record; the names match
 # what bench.py actually emits per config (ernie/llama/resnet report
 # ms_per_step; ppocr reports per-stage + e2e per-image times; serving
-# reports p99 tail latencies from the request replay — round 11)
+# reports p99 tail latencies from the request replay — round 11;
+# input_stream reports the p99 wait-for-batch tail — round 12)
 TIME_FIELDS = (
     "ms_per_step", "ms_per_image_e2e", "det_ms_per_image", "rec_ms_per_batch",
-    "p99_ttft_ms", "p99_tpot_ms",
+    "p99_ttft_ms", "p99_tpot_ms", "p99_input_wait_ms",
 )
 # larger-is-BETTER metrics: a drop beyond tolerance with flat attributed
 # work is the same unexplained-regression signal inverted (serving
-# tokens/s; the ernie headline's tokens_per_sec rides along consistently)
-THROUGHPUT_FIELDS = ("tokens_per_sec",)
+# tokens/s; the ernie headline's tokens_per_sec rides along consistently;
+# input_stream samples/s — round 12)
+THROUGHPUT_FIELDS = ("tokens_per_sec", "samples_per_sec")
 ATTR_WORK_FIELDS = ("flops", "hbm_bytes")
 ATTR_MEM_FIELDS = ("program_memory_bytes", "peak_hbm_bytes")
 
