@@ -81,6 +81,12 @@ ROADMAP-5 operating point (GQA flash + ring attention + capacity-limited
 MoE EP routing with drop counters in guardian telemetry; BENCH_MOE_*
 knobs, BENCH_SKIP_MOE=1 skips).
 
+Round 13: a `fleet` config replays the serving traffic through a
+ReplicaFleet at widths 1/2/4, recording tokens/s scaling vs replica count,
+with the widest run taking a mid-run zero-downtime weight hot-swap AND a
+FaultPlan-injected replica kill (swap-blip p99 + zero-loss asserted).
+BENCH_FLEET_* shrink knobs; BENCH_SKIP_FLEET=1 skips it.
+
 Round 11: a `serving` config measures the decode-optimized serving tier —
 greedy decode through the paged-KV InferenceEngine (Pallas flash-decode on
 TPU, AOT prefill/decode shape buckets) under a synthetic heavy-traffic
@@ -128,6 +134,7 @@ _EST_S = {
     "ocr": 90,
     "input_stream": 90,
     "serving": 180,
+    "fleet": 240,
     "resnet": 180,
     "moe_longcontext": 240,
     "ernie4096": 240,
@@ -557,6 +564,183 @@ def _build_serving():
                                            "block_size", "max_batch", "seed",
                                            "gap_s")}
     return res
+
+
+def _fleet_dims():
+    """Replica-fleet bench knobs (round 13), all BENCH_FLEET_* overridable
+    (tier-1 capture tests run a seconds-scale fleet; a shrunken run records
+    fleet_dims so it can't masquerade). `replicas` is the comma-separated
+    ladder of fleet widths replayed; the LAST entry is the headline run
+    that takes the mid-run weight swap + replica kill."""
+    g = os.environ.get
+    return {
+        "vocab": int(g("BENCH_FLEET_VOCAB", 8192)),
+        "hidden": int(g("BENCH_FLEET_HIDDEN", 256)),
+        "layers": int(g("BENCH_FLEET_LAYERS", 2)),
+        "heads": int(g("BENCH_FLEET_HEADS", 8)),
+        "kv_heads": int(g("BENCH_FLEET_KV_HEADS", 4)),
+        "ffn": int(g("BENCH_FLEET_FFN", 688)),
+        "max_seq": int(g("BENCH_FLEET_MAX_SEQ", 128)),
+        "block_size": int(g("BENCH_FLEET_BLOCK", 16)),
+        "max_batch": int(g("BENCH_FLEET_BATCH", 4)),
+        "n_requests": int(g("BENCH_FLEET_REQUESTS", 32)),
+        "replicas": tuple(
+            int(x) for x in g("BENCH_FLEET_REPLICAS", "1,2,4").split(",")
+        ),
+        "seed": int(g("BENCH_FLEET_SEED", 13)),
+        "gap_s": float(g("BENCH_FLEET_GAP", 0.002)),
+        # event triggers as completed-request fractions of the replay
+        "swap_at": float(g("BENCH_FLEET_SWAP_AT", 0.3)),
+        "kill_at": float(g("BENCH_FLEET_KILL_AT", 0.6)),
+    }
+
+
+def _build_fleet():
+    """Round 13: the replica fleet under the serving replay — the SAME
+    seeded traffic replayed at each fleet width in `replicas`, recording
+    tokens/s scaling vs replica count; the widest run additionally takes a
+    mid-run zero-downtime weight hot-swap (a `step_<N>/` checkpoint of the
+    same weights streamed into one drained replica at a time, so greedy
+    ids are preserved while the drain/load machinery runs for real) AND a
+    FaultPlan-injected replica kill (circuit breaker -> evacuation ->
+    recompute-from-prompt re-dispatch). Gated fields: scaling_vs_1replica
+    (throughput), p99_tpot_swap_ms (the swap-blip tail), n_replicas
+    (shape). `lost`/`duplicated` must be zero — asserted here, not just
+    reported."""
+    import gc
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import checkpoint as _ckpt
+    from paddle_tpu.distributed.resilience import fault_injection as _fi
+    from paddle_tpu.inference.engine import InferenceEngine
+    from paddle_tpu.inference.fleet import ReplicaFleet, fleet_replay
+    from paddle_tpu.inference.scheduler import Request
+    from paddle_tpu.models.llama import LlamaForCausalLM
+
+    d = _fleet_dims()
+    paddle.seed(0)
+    model = LlamaForCausalLM(
+        vocab_size=d["vocab"], hidden_size=d["hidden"],
+        num_hidden_layers=d["layers"], num_attention_heads=d["heads"],
+        num_key_value_heads=d["kv_heads"], intermediate_size=d["ffn"],
+    )
+    model.eval()
+
+    def mk_requests():
+        rng = np.random.RandomState(d["seed"])
+        max_prompt = max(8, d["max_seq"] // 4)
+        gen_mix = [4, 8, 16, max(24, d["max_seq"] // 4)]
+        reqs, t = [], 0.0
+        for i in range(d["n_requests"]):
+            t += rng.exponential(d["gap_s"])
+            reqs.append(Request(
+                rid=i,
+                prompt=rng.randint(0, d["vocab"], (int(rng.randint(4, max_prompt)),)).tolist(),
+                max_new_tokens=int(rng.choice(gen_mix, p=[0.25, 0.3, 0.25, 0.2])),
+                arrival_time=t,
+            ))
+        return reqs
+
+    def fresh_engine():
+        eng = InferenceEngine(
+            model, max_seq_len=d["max_seq"], block_size=d["block_size"],
+            max_batch=d["max_batch"], decode_batch_buckets=(d["max_batch"],),
+        )
+        for b in eng.prefill_buckets:  # warmup: compile outside the replay
+            pages = eng.pool.alloc(eng.pool.blocks_for_tokens(b))
+            eng.prefill(list(range(1, b + 1)), pages)
+            eng.pool.reset()
+        pages = eng.pool.alloc(1)
+        eng.decode([1], [0], [1], [pages])
+        eng.pool.reset()
+        return eng
+
+    ck_root = tempfile.mkdtemp(prefix="bench_fleet_swap_")
+    per_n = {}
+    try:
+        _ckpt.save_state_dict({"model": model.state_dict()}, ck_root, step=1)
+        widest = max(d["replicas"])
+        for n in d["replicas"]:
+            fleet = ReplicaFleet([fresh_engine() for _ in range(n)])
+            events = []
+            chaos = n == widest
+            if chaos:
+                events.append((
+                    max(1, int(d["swap_at"] * d["n_requests"])),
+                    lambda f=fleet: f.request_swap(ck_root),
+                ))
+                if n > 1:
+                    # kill the LAST replica: two consecutive injected step
+                    # faults open its breaker (threshold 2) -> evacuation
+                    def kill(idx=n - 1):
+                        _fi.install_plan(
+                            _fi.FaultPlan().add(
+                                f"fleet.replica_step.{idx}", "fail", times=2
+                            )
+                        )
+                    events.append((
+                        max(2, int(d["kill_at"] * d["n_requests"])), kill,
+                    ))
+            gc.collect()
+            gc.disable()
+            try:
+                stats = fleet_replay(fleet, mk_requests(), events=events)
+            finally:
+                gc.enable()
+                if chaos:
+                    _fi.clear_plan()
+            assert stats["lost"] == 0 and stats["duplicated"] == 0, stats
+            per_n[str(n)] = {
+                k: stats.get(k)
+                for k in ("tokens_per_sec", "p50_tpot_ms", "p99_tpot_ms",
+                          "p50_ttft_ms", "p99_ttft_ms", "completed",
+                          "evacuated", "replica_failures", "preempted",
+                          "swaps_completed", "p99_tpot_swap_ms", "wall_s")
+            }
+        head = per_n[str(widest)]
+        tps_1 = per_n.get("1", {}).get("tokens_per_sec")
+        res = {
+            "n_replicas": widest,
+            "n_requests": d["n_requests"],
+            "tokens_per_sec": head["tokens_per_sec"],
+            "p50_tpot_ms": head["p50_tpot_ms"],
+            "p99_tpot_ms": head["p99_tpot_ms"],
+            "p99_ttft_ms": head["p99_ttft_ms"],
+            "p99_tpot_swap_ms": head["p99_tpot_swap_ms"],
+            "swap_blip_ratio": (
+                round(head["p99_tpot_swap_ms"] / head["p99_tpot_ms"], 3)
+                if head.get("p99_tpot_swap_ms") and head.get("p99_tpot_ms")
+                else None
+            ),
+            "scaling_vs_1replica": (
+                round(head["tokens_per_sec"] / tps_1, 3)
+                if head.get("tokens_per_sec") and tps_1 else None
+            ),
+            "replicas": per_n,
+            "note": (
+                "same seeded replay at each fleet width; widest run takes a "
+                "mid-run step_<N>/ weight hot-swap (same weights: drain/"
+                "stream/re-admit machinery measured, greedy ids preserved) "
+                "and a FaultPlan replica kill (evacuation + re-dispatch); "
+                "lost==duplicated==0 asserted"
+            ),
+            "attribution": _attribution(
+                (head.get("p50_tpot_ms") or 0) / 1000.0 or None, origin="serving"
+            ),
+        }
+        res["fleet_dims"] = {k: d[k] for k in (
+            "vocab", "hidden", "layers", "heads", "kv_heads", "ffn",
+            "max_seq", "block_size", "max_batch", "seed", "gap_s",
+            "swap_at", "kill_at",
+        )}
+        res["fleet_dims"]["replicas"] = list(d["replicas"])
+        return res
+    finally:
+        shutil.rmtree(ck_root, ignore_errors=True)
 
 
 def _input_dims():
@@ -1087,7 +1271,7 @@ class _Snapshot:
     ones already measured."""
 
     CONFIGS = ("seq128", "seq4096", "llama3_shape", "resnet50", "ppocr_e2e",
-               "serving", "input_stream", "moe_longcontext")
+               "serving", "fleet", "input_stream", "moe_longcontext")
 
     def __init__(self):
         self.result = {
@@ -1134,6 +1318,7 @@ def main():
             "resnet": lambda: _build_resnet(steps=steps_c),
             "ocr": lambda: _build_ppocr(n_images=steps_c),
             "serving": _build_serving,
+            "fleet": _build_fleet,
             "input_stream": _build_input_stream,
             "moe_longcontext": _build_moe_longcontext,
         }
@@ -1236,8 +1421,8 @@ def main():
         snap.resolve("seq128", "skipped:deadline")
 
     # ---- satellites, CHEAPEST-FIRST (ocr/input_stream 90s <
-    # serving/resnet 180s < moe_longcontext/ernie4096 240s < llama): a
-    # tight budget forfeits the expensive tail, never the whole record ----
+    # serving/resnet 180s < fleet/moe_longcontext/ernie4096 240s < llama):
+    # a tight budget forfeits the expensive tail, never the whole record ----
     if skip_env("BENCH_SKIP_VISION"):
         snap.resolve("ppocr_e2e", "skipped:env")
     else:
@@ -1284,6 +1469,22 @@ def main():
             "serving",
             "measured" if "skipped" not in res_sv
             else f"skipped:{res_sv['skipped']}",
+        )
+
+    if skip_env("BENCH_SKIP_FLEET"):
+        snap.resolve("fleet", "skipped:env")
+    else:
+        res_fl = _run_config_child("fleet", 0)
+        detail["fleet"] = res_fl if "skipped" in res_fl else {
+            **res_fl,
+            "note": res_fl.get("note", "") + " (round 13: N engines behind "
+                    "the SLO-aware router; scaling_vs_1replica and the "
+                    "swap-blip p99 gate in tools/perf_gate.py)",
+        }
+        snap.resolve(
+            "fleet",
+            "measured" if "skipped" not in res_fl
+            else f"skipped:{res_fl['skipped']}",
         )
 
     if skip_env("BENCH_SKIP_VISION"):

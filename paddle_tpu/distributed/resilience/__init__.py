@@ -6,8 +6,12 @@ steady state — this package gives every failure path one vocabulary:
 - `fault_injection` — deterministic `FaultPlan`s (named injection points
   with fail-N-times / delay / corrupt actions, seedable, activatable via the
   `PADDLE_TPU_FAULT_PLAN` env var) wired into TCPStore ops, eager collective
-  dispatch, and checkpoint shard IO, so chaos tests drive REAL failure
-  handling instead of hand-rolled monkeypatches.
+  dispatch, checkpoint shard IO, and the serving replica fleet
+  (`fleet.route` on every routing decision, `fleet.replica_step.<idx>` on
+  every per-replica scheduler tick — a `fail*N` spec on one of those kills
+  a specific replica deterministically mid-decode, a `delay` spec trips the
+  heartbeat breaker), so chaos tests drive REAL failure handling instead of
+  hand-rolled monkeypatches.
 - `retry` — `RetryPolicy`: exponential backoff with full jitter under an
   overall deadline, publishing per-site attempt/giveup counters into the
   telemetry registry. Applied to TCPStore connect/op reconnects and launch

@@ -98,14 +98,22 @@ class Config:
         return self._device_id
 
     # ---- serving engine (paged-KV decode) ----
-    def enable_llm_engine(self, max_new_tokens=32, eos_id=None, **engine_opts):
+    def enable_llm_engine(self, max_new_tokens=32, eos_id=None, llm_replicas=1,
+                          **engine_opts):
         """Route this Config through the serving InferenceEngine (paged KV
         cache + AOT shape buckets + continuous batching) instead of the
         frozen-program Predictor. Automatic when the model path carries a
         `.pdllm` artifact; `engine_opts` forward to InferenceEngine
-        (max_seq_len, block_size, num_blocks, max_batch, ...)."""
+        (max_seq_len, block_size, num_blocks, max_batch, ...).
+
+        `llm_replicas > 1` backs the predictor with a ReplicaFleet over
+        that many engines sharing one weight set: SLO-aware routed,
+        replica-failure-surviving, hot-swappable (inference/fleet.py)."""
         self._llm_opts.update(max_new_tokens=max_new_tokens, eos_id=eos_id,
-                              **engine_opts)
+                              llm_replicas=int(llm_replicas), **engine_opts)
+
+    def llm_replicas(self) -> int:
+        return int(self._llm_opts.get("llm_replicas", 1))
 
     def is_llm(self) -> bool:
         return self._prefix is not None and os.path.exists(self._prefix + ".pdllm")
@@ -229,15 +237,37 @@ class LLMPredictor:
         opts = dict(config._llm_opts)
         self._max_new_tokens = int(opts.pop("max_new_tokens", 32))
         self._eos_id = opts.pop("eos_id", None)
+        self._n_replicas = max(1, int(opts.pop("llm_replicas", 1)))
         self._engine_opts = opts
-        from .engine import InferenceEngine
-
-        self._engine = InferenceEngine(self._model, **opts)
+        self._build_backend()
         self._inputs = {
             "input_ids": Tensor("input_ids", dtype=np.int64),
             "seq_lens": Tensor("seq_lens", dtype=np.int64),
         }
         self._outputs = {"generated_ids": Tensor("generated_ids")}
+
+    def _build_backend(self):
+        """One engine, or (Config.llm_replicas > 1) a ReplicaFleet of
+        engines over the SAME weights — routing/failure-survival/hot-swap
+        live in inference/fleet.py; the predictor surface is unchanged."""
+        from .engine import InferenceEngine
+
+        engines = [
+            InferenceEngine(self._model, **self._engine_opts)
+            for _ in range(self._n_replicas)
+        ]
+        self._engine = engines[0]
+        if self._n_replicas > 1:
+            from .fleet import ReplicaFleet
+
+            self._fleet = ReplicaFleet(engines, eos_id=self._eos_id)
+        else:
+            self._fleet = None
+
+    def fleet(self):
+        """The backing ReplicaFleet (None for a single-replica predictor) —
+        operational surface for request_swap() and health inspection."""
+        return self._fleet
 
     def get_input_names(self):
         return list(self._inputs)
@@ -272,9 +302,12 @@ class LLMPredictor:
                 "previous run() would silently truncate the batch)"
             )
         prompts = [list(map(int, row[: int(l)])) for row, l in zip(ids, lens)]
-        gen = self._engine.generate(
-            prompts, max_new_tokens=self._max_new_tokens, eos_id=self._eos_id
-        )
+        if self._fleet is not None:
+            gen = self._fleet.generate(prompts, max_new_tokens=self._max_new_tokens)
+        else:
+            gen = self._engine.generate(
+                prompts, max_new_tokens=self._max_new_tokens, eos_id=self._eos_id
+            )
         out = np.full((len(gen), self._max_new_tokens), -1, np.int32)
         for i, g in enumerate(gen):
             out[i, : len(g)] = g
@@ -285,16 +318,16 @@ class LLMPredictor:
 
     def clone(self) -> "LLMPredictor":
         # the engine's KV pool is serial per predictor — a clone gets its
-        # own pool/engine over the SAME model (weights shared by reference)
+        # own pool/engine (or fleet) over the SAME model (weights shared
+        # by reference)
         c = LLMPredictor.__new__(LLMPredictor)
         c._config = self._config
         c._model = self._model
         c._max_new_tokens = self._max_new_tokens
         c._eos_id = self._eos_id
+        c._n_replicas = self._n_replicas
         c._engine_opts = dict(self._engine_opts)
-        from .engine import InferenceEngine
-
-        c._engine = InferenceEngine(self._model, **c._engine_opts)
+        c._build_backend()
         c._inputs = {
             "input_ids": Tensor("input_ids", dtype=np.int64),
             "seq_lens": Tensor("seq_lens", dtype=np.int64),
@@ -306,7 +339,11 @@ class LLMPredictor:
         return None
 
     def try_shrink_memory(self):
-        self._engine.pool.reset()
+        if self._fleet is not None:
+            for rep in self._fleet.replicas:
+                rep.engine.pool.reset()
+        else:
+            self._engine.pool.reset()
         return None
 
 

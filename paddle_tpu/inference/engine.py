@@ -159,6 +159,77 @@ class InferenceEngine:
         self._donate = jax.devices()[0].platform in ("tpu", "axon")
         self._compiled: Dict[Tuple[str, int], object] = {}
         self.bucket_stats = {"hits": 0, "compiles": 0}
+        # bumped by every load_weights(); the fleet exports it per replica
+        # so a half-finished rollout is visible in telemetry
+        self.weights_version = 0
+
+    # ---- zero-downtime weight hot-swap hooks ----
+    def load_weights(self, state) -> int:
+        """Swap in a full replacement parameter set WITHOUT recompiling.
+
+        `state` maps every param name (exactly the engine's own key set) to
+        an array/Tensor of identical shape; dtype is cast to the current
+        param's. New values are placed under the engine's PINNED shardings
+        (`_param_shardings`), so the AOT-compiled prefill/decode programs —
+        whose in/out shardings were pinned at compile time — accept them
+        as-is and the threaded cache pages keep their layout: this is the
+        invariant that makes a live swap safe mid-traffic. Returns the new
+        weights_version."""
+        vals = {
+            k: (v._value if hasattr(v, "_value") else v) for k, v in state.items()
+        }
+        missing = set(self.params) - set(vals)
+        extra = set(vals) - set(self.params)
+        if missing or extra:
+            raise ValueError(
+                f"load_weights: state keys do not match the engine's params "
+                f"(missing {sorted(missing)[:3]}, unexpected {sorted(extra)[:3]})"
+            )
+        new = {}
+        for k, cur in self.params.items():
+            v = jnp.asarray(vals[k])
+            if tuple(v.shape) != tuple(cur.shape):
+                raise ValueError(
+                    f"load_weights: {k!r} shape {tuple(v.shape)} != engine's "
+                    f"{tuple(cur.shape)} — a hot swap cannot change the model"
+                )
+            if v.dtype != cur.dtype:
+                v = v.astype(cur.dtype)
+            if self._param_shardings is not None:
+                v = jax.device_put(v, self._param_shardings[k])
+            else:
+                v = jax.device_put(v)
+            new[k] = v
+        self.params = new
+        self.weights_version += 1
+        if telemetry.enabled():
+            _metrics.counter(
+                "paddle_tpu_serving_weight_swaps_total",
+                "engine parameter sets hot-swapped under pinned shardings",
+            ).inc()
+        return self.weights_version
+
+    def checkpoint_template(self, state_key: Optional[str] = "model"):
+        """A DETACHED Tensor template shaped and placed like the engine's
+        pinned params, for `distributed.checkpoint.load_state_dict` —
+        detached so streaming a checkpoint in never mutates the live model
+        object other replicas may still be serving from."""
+        from ..core.tensor import Tensor
+
+        tpl = {k: Tensor(v) for k, v in self.params.items()}
+        return {state_key: tpl} if state_key else tpl
+
+    def load_weights_from_checkpoint(self, path: str, state_key: Optional[str] = "model") -> int:
+        """Stream a topology-portable `step_<N>/` checkpoint (PR 7 format;
+        newest COMPLETE step under `path` wins, reshard-on-load included)
+        into this engine's pinned placements and swap it live. `state_key`
+        is the key the training loop saved the model state under
+        (`save_state_dict({"model": ...})`); None for a bare layout."""
+        from ..distributed import checkpoint as _ckpt
+
+        tpl = self.checkpoint_template(state_key)
+        _ckpt.load_state_dict(tpl, path)
+        return self.load_weights(tpl[state_key] if state_key else tpl)
 
     # ---- buckets ----
     def bucket_for(self, kind: str, n: int) -> int:

@@ -1,0 +1,716 @@
+"""Replica fleet: SLO-aware routing, replica failure survival, and
+zero-downtime weight hot-swap.
+
+"Millions of users" is N engines behind a router, not one. A `ReplicaFleet`
+fronts N `InferenceEngine` + `ContinuousBatchingScheduler` replicas with
+the three properties a production fleet needs at steady state:
+
+- **Routing** (`fleet.route` FaultPlan site): session affinity first — a
+  request's KV pages live on exactly one replica, so follow-on requests of
+  the same `Request.session` route home while that replica is healthy —
+  otherwise least-expected-drain-time: queue depth weighted by the
+  replica's EWMA step latency (a slow replica with a short queue can be a
+  worse bet than a fast one with a longer queue; this is the SLO-aware
+  part). With no healthy replica the request is HELD at the fleet (never
+  dropped) and flushed on the next step that finds one.
+
+- **Replica health** (`fleet.replica_step.<idx>` FaultPlan sites): every
+  replica step runs through a deterministic chaos point; a raised fault or
+  real exception opens the circuit one notch (healthy -> draining: no new
+  admissions, in-flight work keeps stepping), `breaker_threshold`
+  consecutive failures open it fully (-> down). A replica whose step takes
+  longer than `heartbeat_deadline_s` (its OWN wall time — a shared tick
+  clock would blame a stalled peer on healthy replicas) counts a failure
+  through the same breaker (the slow/hung-step shape a delay fault
+  produces; set the deadline above worst-case first-step compile). A
+  down replica is EVACUATED: every in-flight and queued request is reset
+  via the scheduler's preemption-resume path (generated tokens fold into
+  the prompt, K/V is recomputed from it on the new home) and re-dispatched
+  to a healthy replica — zero lost requests, session affinity broken only
+  by death.
+
+- **Zero-downtime weight hot-swap**: `request_swap(source)` streams a
+  topology-portable `step_<N>/` checkpoint (PR 7 reshard-on-load) into ONE
+  drained replica at a time — drain (stop admissions, migrate its waiting
+  queue, finish in-flight decode), swap under the engine's PINNED
+  out_shardings (cache-page layouts stay valid, no recompile), re-admit,
+  next replica. The rest of the fleet absorbs traffic, so the rollout
+  costs a bounded p99 blip, never an outage; a swapped replica's logits
+  are byte-identical to a cold-started engine on the same weights (pinned
+  shardings + identical programs — asserted in tests and the
+  `dryrun_multichip fleet_swap` scenario).
+
+Telemetry: replica-state and per-replica queue gauges, routing /
+evacuation / failure / swap counters, per-replica step-latency and
+swap-drain histograms; request-level TTFT/TPOT land in the PR 8 serving
+histograms (the schedulers observe them), so fleet p99s come from the same
+families the single-replica tier exports.
+"""
+from __future__ import annotations
+
+import time
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .. import telemetry
+from ..telemetry import metrics as _metrics
+from ..distributed.resilience import fault_injection as _fi
+from .scheduler import ContinuousBatchingScheduler, Request, percentiles
+
+__all__ = ["ReplicaFleet", "ReplicaStatus", "NoHealthyReplica", "fleet_replay"]
+
+
+class ReplicaStatus:
+    HEALTHY = "healthy"
+    DRAINING = "draining"
+    DOWN = "down"
+
+    ALL = (HEALTHY, DRAINING, DOWN)
+
+
+class NoHealthyReplica(RuntimeError):
+    """Every replica is down and work is outstanding — the fleet cannot
+    make progress (the caller's cue to escalate/restart, not spin)."""
+
+
+def _replicas_gauge(state: str):
+    return _metrics.gauge(
+        "paddle_tpu_fleet_replicas",
+        "fleet replicas by health state",
+        label_names=("state",),
+    ).labels(state=state)
+
+
+def _queue_gauge(replica: int, state: str):
+    return _metrics.gauge(
+        "paddle_tpu_fleet_replica_queue",
+        "per-replica scheduler occupancy",
+        label_names=("replica", "state"),
+    ).labels(replica=str(replica), state=state)
+
+
+def _routed_counter(reason: str):
+    return _metrics.counter(
+        "paddle_tpu_fleet_routed_total",
+        "routing decisions by reason (affinity = session home, "
+        "least_loaded = SLO-aware pick, evacuated = re-dispatch off a dead "
+        "replica, migrated = drained off a swapping replica, held = no "
+        "healthy replica, queued at the fleet, requeued = held request "
+        "flushed to a recovered replica)",
+        label_names=("reason",),
+    ).labels(reason=reason)
+
+
+def _swap_counter(event: str):
+    return _metrics.counter(
+        "paddle_tpu_fleet_swaps_total",
+        "weight hot-swap lifecycle events",
+        label_names=("event",),
+    ).labels(event=event)
+
+
+def _failure_counter(replica: int, reason: str):
+    return _metrics.counter(
+        "paddle_tpu_fleet_replica_failures_total",
+        "replica step failures feeding the circuit breaker, by cause "
+        "(step = chaos fault or real exception, heartbeat = step wall "
+        "time over the deadline)",
+        label_names=("replica", "reason"),
+    ).labels(replica=str(replica), reason=reason)
+
+
+def _evac_counter():
+    return _metrics.counter(
+        "paddle_tpu_fleet_evacuated_requests_total",
+        "in-flight/queued requests re-dispatched off a dead replica "
+        "(recompute-from-prompt on the new home)",
+    )
+
+
+_STEP_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+)
+
+
+def _step_hist(replica: int):
+    return _metrics.histogram(
+        "paddle_tpu_fleet_step_seconds",
+        "per-replica scheduler step latency (the fleet-level tail the "
+        "router's EWMA scoring tracks)",
+        label_names=("replica",),
+        buckets=_STEP_BUCKETS,
+    ).labels(replica=str(replica))
+
+
+def _drain_hist():
+    return _metrics.histogram(
+        "paddle_tpu_fleet_swap_drain_seconds",
+        "per-replica drain+swap duration during a weight rollout (the "
+        "blip window)",
+        buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0),
+    )
+
+
+class _Replica:
+    """One engine + scheduler behind the router, plus its health record."""
+
+    def __init__(self, idx: int, engine, sched: ContinuousBatchingScheduler):
+        self.idx = idx
+        self.engine = engine
+        self.sched = sched
+        self.status = ReplicaStatus.HEALTHY
+        self.consecutive_failures = 0
+        self.ewma_step_s = 0.0
+        self.draining_for_swap = False
+
+    def depth(self) -> int:
+        return len(self.sched.waiting) + len(self.sched.running)
+
+    def busy(self) -> bool:
+        return bool(self.sched.waiting or self.sched.running)
+
+
+class ReplicaFleet:
+    """Serving front over N replicas; duck-types the scheduler surface
+    (`submit` / `step` / `idle` / `finished`), so the single-replica replay
+    and predictor plumbing drive a fleet unchanged."""
+
+    def __init__(
+        self,
+        engines: Sequence,
+        *,
+        eos_id: Optional[int] = None,
+        max_running: Optional[int] = None,
+        clock: Callable[[], float] = time.monotonic,
+        breaker_threshold: int = 2,
+        heartbeat_deadline_s: Optional[float] = None,
+        session_cache_size: int = 4096,
+    ):
+        if not engines:
+            raise ValueError("ReplicaFleet needs at least one engine")
+        self.clock = clock
+        self.breaker_threshold = max(1, int(breaker_threshold))
+        self.heartbeat_deadline_s = heartbeat_deadline_s
+        self.session_cache_size = max(1, int(session_cache_size))
+        self.replicas: List[_Replica] = [
+            _Replica(
+                i,
+                eng,
+                ContinuousBatchingScheduler(
+                    eng, eos_id=eos_id, max_running=max_running, clock=clock
+                ),
+            )
+            for i, eng in enumerate(engines)
+        ]
+        self.finished: List[Request] = []
+        self.submitted_total = 0
+        self.evacuated_total = 0
+        self.failures_total = 0
+        self.swaps_completed = 0
+        # [(start, end)] fleet-clock windows of completed rollouts — the
+        # bench slices pooled inter-token intervals on these to report the
+        # swap-blip p99
+        self.swap_windows: List[tuple] = []
+        self._pending: List[Request] = []  # held: no healthy replica yet
+        # affinity is a performance hint, so the home map is a bounded LRU:
+        # an unbounded dict would grow by one entry per session ever seen,
+        # exactly the steady state a long-lived fleet serves
+        self._session_home: "OrderedDict[object, int]" = OrderedDict()
+        self._swap: Optional[dict] = None
+        self._swap_t0: Optional[float] = None
+        if telemetry.enabled():
+            self._sync_gauges()
+
+    # ---- scheduler-surface aggregates ----
+    @property
+    def preempted_total(self) -> int:
+        return sum(r.sched.preempted_total for r in self.replicas)
+
+    def idle(self) -> bool:
+        # an in-progress swap keeps the fleet non-idle so replay loops
+        # drive the drain -> swap -> re-admit machine to completion even
+        # after the traffic tail finished
+        return (
+            not self._pending
+            and self._swap is None
+            and all(
+                r.status == ReplicaStatus.DOWN or r.sched.idle()
+                for r in self.replicas
+            )
+        )
+
+    def healthy(self) -> List[_Replica]:
+        return [r for r in self.replicas if r.status == ReplicaStatus.HEALTHY]
+
+    # ---- routing ----
+    def _score(self, rep: _Replica) -> float:
+        """Expected time for a new request to start making progress:
+        occupancy weighted by the replica's recent step latency. A pure
+        queue-depth router sends traffic to a degraded-but-short replica;
+        weighting by the EWMA keeps the p99 honest."""
+        return (rep.depth() + 1) * max(rep.ewma_step_s, 1e-6)
+
+    def _route(self, req: Request, *, reason_override: Optional[str] = None) -> Optional[_Replica]:
+        # the chaos site models CLIENT-facing routing failures (submit()
+        # raises to the caller, who still owns the request); internal
+        # re-dispatch of evacuated/migrated/held requests must never fault
+        # here — the request exists only in a local list at that point, so
+        # a raise would silently lose it and void the zero-loss invariant
+        if reason_override is None:
+            _fi.fault_point("fleet.route", rid=req.rid)
+        healthy = self.healthy()
+        if not healthy:
+            if telemetry.enabled():
+                _routed_counter("held").inc()
+            return None
+        rep = None
+        reason = reason_override or "least_loaded"
+        if req.session is not None and reason_override is None:
+            home = self._session_home.get(req.session)
+            if home is not None and self.replicas[home].status == ReplicaStatus.HEALTHY:
+                rep = self.replicas[home]
+                reason = "affinity"
+        if rep is None:
+            rep = min(healthy, key=lambda r: (self._score(r), r.idx))
+        if req.session is not None:
+            self._session_home[req.session] = rep.idx
+            self._session_home.move_to_end(req.session)
+            while len(self._session_home) > self.session_cache_size:
+                self._session_home.popitem(last=False)
+        if telemetry.enabled():
+            _routed_counter(reason).inc()
+        return rep
+
+    def submit(self, req: Request) -> None:
+        rep = self._route(req)  # a chaos raise leaves the request unstamped
+        if rep is None:
+            # held at the fleet: the TTL clock starts NOW — acceptance —
+            # since no scheduler will stamp it until it routes
+            if req.submitted_time is None:
+                req.submitted_time = self.clock()
+            self._pending.append(req)
+        else:
+            # the scheduler stamps submitted_time itself AFTER its own
+            # validation, so a reject leaves the request entirely
+            # untouched (TTL clock included) with the caller
+            rep.sched.submit(req)
+        # counted only once the request is safely queued: a route chaos
+        # raise or a validation reject leaves it with the caller, and
+        # counting it would inflate the zero-loss `lost` accounting when
+        # the caller retries
+        self.submitted_total += 1
+
+    def _expire_pending(self, now: float) -> None:
+        """TTL sweep over requests HELD at the fleet — a deadline must
+        bind even while no replica can take the work."""
+        for req in list(self._pending):
+            if (
+                req.deadline_s is not None
+                and req.submitted_time is not None
+                and now - req.submitted_time > req.deadline_s
+            ):
+                self._pending.remove(req)
+                req.outcome = "expired"
+                req.finish_time = now
+                self.finished.append(req)
+                if telemetry.enabled():
+                    _metrics.counter(
+                        "paddle_tpu_serving_requests_total",
+                        "request lifecycle events", ("event",),
+                    ).labels(event="expired").inc()
+
+    def cancel(self, rid: int) -> bool:
+        """Client cancellation, fleet-wide: whichever replica (or the held
+        queue) owns `rid` drops it and frees its pages. The terminal record
+        is harvested into fleet.finished IMMEDIATELY — idle() ignores the
+        schedulers' finished lists, so waiting for the next step() would
+        strand a cancel that empties the fleet."""
+        for i, req in enumerate(self._pending):
+            if req.rid == rid:
+                req.outcome = "cancelled"
+                req.finish_time = self.clock()
+                self.finished.append(self._pending.pop(i))
+                if telemetry.enabled():
+                    _metrics.counter(
+                        "paddle_tpu_serving_requests_total",
+                        "request lifecycle events", ("event",),
+                    ).labels(event="cancelled").inc()
+                return True
+        for rep in self.replicas:
+            if rep.sched.cancel(rid):
+                self.finished.extend(rep.sched.finished)
+                rep.sched.finished = []
+                return True
+        return False
+
+    def _redispatch(self, req: Request, reason: str) -> None:
+        rep = self._route(req, reason_override=reason)
+        if rep is None:
+            self._pending.append(req)
+            return
+        try:
+            rep.sched.submit(req)
+        except Exception:
+            # a replica that can't legally take this request (heterogeneous
+            # engine limits) must neither crash the tick nor silently drop
+            # the REST of the evacuation/held list — park it; the next tick
+            # retries (possibly onto a different replica) and its TTL can
+            # still expire it, so nothing is ever lost unaccounted
+            self._pending.append(req)
+
+    def _flush_pending(self) -> None:
+        if not self._pending or not self.healthy():
+            return
+        held, self._pending = self._pending, []
+        for req in held:
+            # internal path (no chaos site, no re-count): a request that
+            # still can't route lands back in _pending, never on the floor
+            self._redispatch(req, reason="requeued")
+
+    # ---- health ----
+    def _note_failure(self, rep: _Replica, reason: str) -> None:
+        rep.consecutive_failures += 1
+        self.failures_total += 1
+        if telemetry.enabled():
+            _failure_counter(rep.idx, reason).inc()
+        if rep.consecutive_failures >= self.breaker_threshold:
+            self._kill(rep)
+        elif rep.status == ReplicaStatus.HEALTHY:
+            # circuit half-open: stop admissions, keep stepping in-flight
+            # work — one good step closes it again
+            rep.status = ReplicaStatus.DRAINING
+
+    def _kill(self, rep: _Replica) -> None:
+        rep.status = ReplicaStatus.DOWN
+        rep.draining_for_swap = False
+        # break session affinity: homes on a dead replica re-route freely
+        for s, idx in list(self._session_home.items()):
+            if idx == rep.idx:
+                del self._session_home[s]
+        evacuated = rep.sched.evacuate()
+        self.evacuated_total += len(evacuated)
+        if telemetry.enabled() and evacuated:
+            _evac_counter().inc(len(evacuated))
+        for req in evacuated:
+            self._redispatch(req, reason="evacuated")
+        # a dead replica can't finish its drain — hand the swap machine on
+        sw = self._swap
+        if sw is not None:
+            if sw.get("active") == rep.idx:
+                sw["active"] = None
+            if rep.idx in sw["queue"]:
+                sw["queue"].remove(rep.idx)
+
+    # ---- weight hot-swap ----
+    def request_swap(self, source, state_key: Optional[str] = "model") -> None:
+        """Begin a zero-downtime rollout: every live replica, one at a
+        time, is drained and re-weighted from `source` — a checkpoint root
+        or `step_<N>/` path (streamed via `load_weights_from_checkpoint`),
+        or a name->array mapping (applied via `load_weights`). Progress
+        happens inside step(); the fleet stays serving throughout."""
+        if self._swap is not None:
+            raise RuntimeError("a weight swap is already in progress")
+        self._swap = {
+            "source": source,
+            "state_key": state_key,
+            "queue": [r.idx for r in self.replicas if r.status != ReplicaStatus.DOWN],
+            "active": None,
+            "t_active": None,
+            "swapped": 0,
+        }
+        self._swap_t0 = self.clock()
+        if telemetry.enabled():
+            _swap_counter("requested").inc()
+        # the rollout starts NOW, not at the next tick: the first target
+        # drains (and, if already idle, swaps) synchronously so no request
+        # routed after this call lands on about-to-be-swapped weights
+        self._advance_swap(self.clock())
+
+    def swap_in_progress(self) -> bool:
+        return self._swap is not None
+
+    def _perform_swap(self, rep: _Replica) -> None:
+        src = self._swap["source"]
+        if isinstance(src, str):
+            rep.engine.load_weights_from_checkpoint(
+                src, state_key=self._swap["state_key"]
+            )
+        else:
+            rep.engine.load_weights(src)
+        if telemetry.enabled():
+            _metrics.gauge(
+                "paddle_tpu_fleet_weights_version",
+                "engine weights_version per replica (a half-finished "
+                "rollout is visible as a version split)",
+                label_names=("replica",),
+            ).labels(replica=str(rep.idx)).set(rep.engine.weights_version)
+
+    def _advance_swap(self, now: float) -> None:
+        sw = self._swap
+        if sw is None:
+            return
+        if sw["active"] is None:
+            while sw["queue"]:
+                idx = sw["queue"].pop(0)
+                rep = self.replicas[idx]
+                if rep.status == ReplicaStatus.DOWN:
+                    continue
+                rep.status = ReplicaStatus.DRAINING
+                rep.draining_for_swap = True
+                rep.sched.drain()
+                # its waiting queue holds no pages — migrate it now so
+                # those requests don't wait out the drain
+                waiting, rep.sched.waiting = list(rep.sched.waiting), []
+                for req in waiting:
+                    self._redispatch(req, reason="migrated")
+                sw["active"] = idx
+                sw["t_active"] = now
+                if telemetry.enabled():
+                    _swap_counter("drain_started").inc()
+                return
+            # queue empty, nothing active: the rollout is over — but it
+            # only COUNTS as completed if at least one replica was actually
+            # re-weighted (every target dying mid-rollout must not report
+            # a successful swap, nor record a blip window over nothing)
+            self._swap = None
+            if sw["swapped"]:
+                self.swap_windows.append((self._swap_t0, now))
+                self.swaps_completed += 1
+                if telemetry.enabled():
+                    _swap_counter("completed").inc()
+            elif telemetry.enabled():
+                _swap_counter("aborted").inc()
+            return
+        rep = self.replicas[sw["active"]]
+        # keep the drain target's waiting queue empty EVERY tick, not just
+        # at drain start: pool-pressure preemption during the drain
+        # re-queues its victim LOCALLY, where blocked admission would
+        # otherwise deadlock the swap (waiting never empties)
+        if rep.sched.waiting:
+            waiting, rep.sched.waiting = list(rep.sched.waiting), []
+            for req in waiting:
+                self._redispatch(req, reason="migrated")
+        if not rep.sched.running and not rep.sched.waiting:
+            try:
+                self._perform_swap(rep)
+            except Exception:
+                # a failed load must not wedge the fleet: abort the rollout
+                # cleanly — the target resumes serving its OLD weights (an
+                # earlier-swapped replica keeps the new ones: the version
+                # split is visible in the weights_version gauge) — and the
+                # error surfaces to the operator
+                rep.sched.resume_admission()
+                rep.status = ReplicaStatus.HEALTHY
+                rep.draining_for_swap = False
+                self._swap = None
+                if telemetry.enabled():
+                    _swap_counter("failed").inc()
+                raise
+            sw["swapped"] += 1
+            rep.sched.resume_admission()
+            rep.status = ReplicaStatus.HEALTHY
+            rep.draining_for_swap = False
+            rep.consecutive_failures = 0
+            if telemetry.enabled():
+                _swap_counter("replica_swapped").inc()
+                _drain_hist().observe(max(0.0, now - sw["t_active"]))
+            sw["active"] = None
+            # pick the next target immediately: a one-replica fleet must
+            # finish its swap on THIS step, not leak an extra idle tick
+            self._advance_swap(now)
+
+    # ---- the fleet tick ----
+    def step(self) -> int:
+        """One fleet tick: advance any rollout, flush held requests, step
+        every live replica through its chaos site, harvest finished work.
+        Returns tokens produced across the fleet."""
+        now = self.clock()
+        self._advance_swap(now)
+        self._expire_pending(now)
+        self._flush_pending()
+        # fatal only when every replica is fully DOWN: a merely-DRAINING
+        # (half-open) replica is alive and one good step re-opens it, so
+        # raising there would crash a fleet mid-recovery
+        if self._pending and all(
+            r.status == ReplicaStatus.DOWN for r in self.replicas
+        ):
+            raise NoHealthyReplica(
+                f"{len(self._pending)} request(s) held with every replica down"
+            )
+        produced = 0
+        for rep in self.replicas:
+            if rep.status == ReplicaStatus.DOWN:
+                continue
+            if not rep.busy():
+                # a half-open circuit with NOTHING in flight has no step
+                # left to prove itself on — close it here, or the replica
+                # is skipped forever (no traffic routes to a non-healthy
+                # replica, so it would never become busy again)
+                if rep.status == ReplicaStatus.DRAINING and not rep.draining_for_swap:
+                    rep.consecutive_failures = 0
+                    rep.status = ReplicaStatus.HEALTHY
+                continue
+            try:
+                # the delay fault sleeps INSIDE this point — measuring from
+                # before it is what lets a delay spec trip the heartbeat
+                # breaker (a hung/slow step, not an exception)
+                t0 = self.clock()
+                _fi.fault_point(f"fleet.replica_step.{rep.idx}", replica=rep.idx)
+                produced += rep.sched.step()
+                dt = self.clock() - t0
+            except Exception:
+                self._note_failure(rep, reason="step")
+                continue
+            rep.ewma_step_s = (
+                dt if rep.ewma_step_s == 0.0 else 0.8 * rep.ewma_step_s + 0.2 * dt
+            )
+            if telemetry.enabled():
+                _step_hist(rep.idx).observe(dt)
+            # heartbeat = the replica's OWN step wall time: charging a
+            # shared tick clock would blame a stalled peer's 10 s on every
+            # healthy replica stepped after it. A deadline miss is a breaker
+            # failure even though the step "succeeded"; set the deadline
+            # above worst-case first-step compile time.
+            if (
+                self.heartbeat_deadline_s is not None
+                and dt > self.heartbeat_deadline_s
+            ):
+                self._note_failure(rep, reason="heartbeat")
+                continue
+            rep.consecutive_failures = 0
+            if rep.status == ReplicaStatus.DRAINING and not rep.draining_for_swap:
+                rep.status = ReplicaStatus.HEALTHY  # circuit closes
+        for rep in self.replicas:
+            if rep.sched.finished:
+                self.finished.extend(rep.sched.finished)
+                rep.sched.finished = []
+        if telemetry.enabled():
+            self._sync_gauges()
+        return produced
+
+    def _sync_gauges(self) -> None:
+        counts = {s: 0 for s in ReplicaStatus.ALL}
+        for rep in self.replicas:
+            counts[rep.status] += 1
+            _queue_gauge(rep.idx, "running").set(len(rep.sched.running))
+            _queue_gauge(rep.idx, "waiting").set(len(rep.sched.waiting))
+        for s, n in counts.items():
+            _replicas_gauge(s).set(n)
+        _metrics.gauge(
+            "paddle_tpu_fleet_held_requests",
+            "requests held at the fleet for want of a healthy replica",
+        ).set(len(self._pending))
+
+    # ---- convenience: batch greedy generation through the fleet ----
+    def generate(self, prompts, max_new_tokens=16) -> List[List[int]]:
+        """Greedy-decode every prompt across the fleet; returns generated
+        ids per prompt (full output even across preemption/evacuation)."""
+        if isinstance(max_new_tokens, int):
+            max_new_tokens = [max_new_tokens] * len(prompts)
+        reqs = [
+            Request(rid=i, prompt=list(p), max_new_tokens=int(m))
+            for i, (p, m) in enumerate(zip(prompts, max_new_tokens))
+        ]
+        for r in reqs:
+            self.submit(r)
+        while not self.idle():
+            self.step()
+        # this call's requests are read back directly — drop them from the
+        # harvest list, or a long-lived fleet-backed predictor accumulates
+        # every request (prompt + tokens) it ever served
+        own = {id(r) for r in reqs}
+        self.finished = [r for r in self.finished if id(r) not in own]
+        self.submitted_total -= len(reqs)
+        return [r.prompt[r.prompt_len:] + list(r.generated) for r in reqs]
+
+
+def fleet_replay(
+    fleet: ReplicaFleet,
+    requests: Sequence[Request],
+    *,
+    events: Sequence[tuple] = (),
+    clock: Optional[Callable[[], float]] = None,
+    max_wall_s: float = 600.0,
+) -> Dict:
+    """scheduler.replay with mid-run chaos hooks: feed `requests` honoring
+    their arrival_time offsets, and fire each `(completed_threshold, fn)`
+    event once when that many requests have finished — the deterministic
+    trigger the bench/dryrun use to start a weight swap or install a
+    replica-kill FaultPlan mid-traffic. Returns the replay stats plus
+    fleet accounting (lost/duplicated counts, swap-window p99).
+
+    `clock` defaults to the FLEET's clock: the replay's t0/arrival pacing,
+    the schedulers' token timestamps, and the swap windows must share one
+    time base or every latency stat is cross-clock garbage."""
+    clock = clock or fleet.clock
+    pending = sorted(requests, key=lambda r: (r.arrival_time, r.rid))
+    fired = [False] * len(events)
+
+    def fire_due():
+        for j, (threshold, fn) in enumerate(events):
+            if not fired[j] and len(fleet.finished) >= threshold:
+                fired[j] = True
+                fn()
+
+    t0 = clock()
+    rt0 = time.monotonic()
+    i = 0
+    while i < len(pending) or not fleet.idle():
+        now = clock() - t0
+        # the watchdog runs on REAL wall time: a frozen/manual fleet clock
+        # would otherwise turn the idle-wait into an unbreakable busy-loop
+        if time.monotonic() - rt0 > max_wall_s:
+            raise TimeoutError(f"fleet replay exceeded {max_wall_s}s wall budget")
+        while i < len(pending) and pending[i].arrival_time <= now:
+            fleet.submit(pending[i])
+            i += 1
+        fire_due()
+        if fleet.idle():
+            if i < len(pending):
+                time.sleep(min(0.001, max(0.0, pending[i].arrival_time - now)))
+            continue
+        fleet.step()
+        # re-check AFTER the step too: a threshold first reached by the
+        # final (fleet-emptying) step must still fire — and if the fired
+        # event starts a swap, idle() goes false and the loop drives it
+        fire_due()
+    wall = clock() - t0
+
+    done = list(fleet.finished)
+    rids = [r.rid for r in done]
+    completed = [r for r in done if r.outcome == "completed"]
+    ttfts = [
+        r.first_token_time - (t0 + r.arrival_time)
+        for r in completed
+        if r.first_token_time is not None
+    ]
+    itls = [(iv, t) for r in completed
+            for iv, t in zip(np.diff(r.token_times), r.token_times[1:])]
+    swap_itls = [
+        iv
+        for iv, t in itls
+        for (ws, we) in fleet.swap_windows
+        if ws <= t <= we
+    ]
+    total_tokens = sum(
+        (len(r.prompt) - r.prompt_len) + len(r.generated) for r in completed
+    )
+    out = {
+        "n_requests": len(done),
+        "completed": len(completed),
+        "lost": fleet.submitted_total - len(set(rids)),
+        "duplicated": len(rids) - len(set(rids)),
+        "generated_tokens": int(total_tokens),
+        "wall_s": round(wall, 4),
+        "tokens_per_sec": round(total_tokens / wall, 2) if wall > 0 else None,
+        "preempted": fleet.preempted_total,
+        "evacuated": fleet.evacuated_total,
+        "replica_failures": fleet.failures_total,
+        "swaps_completed": fleet.swaps_completed,
+    }
+    out.update(percentiles("ttft_ms", [t * 1000 for t in ttfts]))
+    out.update(percentiles("tpot_ms", [iv * 1000 for iv, _ in itls]))
+    out.update(percentiles("tpot_swap_ms", [iv * 1000 for iv in swap_itls]))
+    return out

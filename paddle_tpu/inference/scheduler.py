@@ -16,6 +16,15 @@ time-per-output-token (mean decode interval) histograms, plus
 admitted/completed/preempted counters and running/waiting gauges. The
 clock is injectable so admission/preemption order is testable under a
 seeded synthetic arrival trace.
+
+Round 13 (replica fleet): requests carry an optional TTL
+(`Request.deadline_s` — expiry frees pool pages immediately,
+outcome="expired") and can be client-cancelled (`cancel(rid)`,
+outcome="cancelled"); the scheduler drains (`drain()` /
+`resume_admission()` — stop admissions, finish in-flight) and evacuates
+(`evacuate()` — the preemption-resume path applied to every request at
+once) for the fleet's hot-swap and failure-survival protocols
+(inference/fleet.py).
 """
 from __future__ import annotations
 
@@ -84,11 +93,22 @@ class Request:
     prompt: List[int]
     max_new_tokens: int = 16
     arrival_time: float = 0.0
+    # per-request TTL in scheduler-clock seconds from submit(); an expired
+    # request frees its pool pages IMMEDIATELY instead of pinning them for
+    # a client that will never read the answer (outcome="expired")
+    deadline_s: Optional[float] = None
+    # fleet session-affinity key: follow-on requests of one conversation
+    # carry the same session so the router sends them to the replica that
+    # (may) hold their warm KV pages; None = no affinity
+    session: Optional[object] = None
 
     # runtime (scheduler-owned)
     generated: List[int] = field(default_factory=list)
     pages: List[int] = field(default_factory=list)
     preemptions: int = 0
+    # terminal disposition: "completed" | "expired" | "cancelled" (None
+    # while in flight); the fleet also reads it for zero-loss accounting
+    outcome: Optional[str] = None
     # absolute clock at submit() — arrival_time is a REPLAY-relative offset
     # and must never be differenced against absolute timestamps
     submitted_time: Optional[float] = None
@@ -150,14 +170,32 @@ class ContinuousBatchingScheduler:
         self.running: List[Request] = []
         self.finished: List[Request] = []
         self.preempted_total = 0
+        # drain mode (fleet hot-swap protocol): admissions stop, in-flight
+        # work keeps decoding to completion, submit() still accepts (the
+        # caller is expected to route elsewhere; anything queued here just
+        # waits out the drain)
+        self.draining = False
 
     # ---- queue surface ----
+    def drain(self) -> None:
+        """Stop admitting new work into decode slots (in-flight requests
+        run to completion). The fleet swap protocol: drain -> swap weights
+        -> resume_admission."""
+        self.draining = True
+
+    def resume_admission(self) -> None:
+        self.draining = False
+
     def submit(self, req: Request) -> None:
         max_ctx = self.engine.max_seq_len
-        total = len(req.prompt) + req.max_new_tokens
+        # prompt_len, not len(prompt): a preempted/evacuated request folds
+        # its generated prefix into the prompt, but its FINAL context is
+        # still original-prompt + max_new (re-validating the folded length
+        # would reject a legal request mid-recovery)
+        total = req.prompt_len + req.max_new_tokens
         if total > max_ctx:
             raise ValueError(
-                f"request {req.rid}: prompt {len(req.prompt)} + "
+                f"request {req.rid}: prompt {req.prompt_len} + "
                 f"max_new {req.max_new_tokens} exceeds max_seq_len {max_ctx}"
             )
         pool = self.engine.pool
@@ -169,7 +207,11 @@ class ContinuousBatchingScheduler:
                 f"{pool.blocks_for_tokens(total)} pages; the pool has "
                 f"{pool.num_blocks - 1}"
             )
-        req.submitted_time = self.clock()
+        # preserved across re-dispatch (like _prompt_len): a request
+        # evacuated off a dead replica keeps its ORIGINAL submit clock, so
+        # its TTL and client-perceived TTFT never silently restart
+        if req.submitted_time is None:
+            req.submitted_time = self.clock()
         self.waiting.append(req)
         if telemetry.enabled():
             _req_counter().labels(event="submitted").inc()
@@ -185,14 +227,59 @@ class ContinuousBatchingScheduler:
     # ---- lifecycle ----
     def _finish(self, req: Request, now: float) -> None:
         req.finish_time = now
+        req.outcome = req.outcome or "completed"
         self.engine.pool.free(req.pages)
         req.pages = []
         self.finished.append(req)
         if telemetry.enabled():
-            _req_counter().labels(event="completed").inc()
+            _req_counter().labels(event=req.outcome).inc()
             tpot = req.tpot()
             if tpot is not None:
                 _tpot_hist().observe(tpot)
+
+    def cancel(self, rid: int) -> bool:
+        """Client-side cancellation: drop the request wherever it is and
+        free its pages IMMEDIATELY (a stuck/gone client must not pin pool
+        pages for the rest of the process). Returns False when `rid` is not
+        in flight (already finished or never submitted)."""
+        for queue in (self.waiting, self.running):
+            for req in queue:
+                if req.rid == rid:
+                    queue.remove(req)
+                    req.outcome = "cancelled"
+                    self._finish(req, self.clock())
+                    if telemetry.enabled():
+                        self._sync_gauges()
+                    return True
+        return False
+
+    def _expire_due(self, now: float) -> None:
+        """Per-request TTL: requests past their deadline_s (scheduler-clock
+        seconds since submit) finish with outcome="expired" and free their
+        pages right now — the serving-tier analogue of a dead client."""
+        for queue in (self.waiting, self.running):
+            for req in list(queue):
+                if (
+                    req.deadline_s is not None
+                    and req.submitted_time is not None
+                    and now - req.submitted_time > req.deadline_s
+                ):
+                    queue.remove(req)
+                    req.outcome = "expired"
+                    self._finish(req, now)
+
+    def _reset_for_resume(self, req: Request) -> Request:
+        """Recompute-on-resume bookkeeping shared by preemption and fleet
+        evacuation: generated tokens fold into the prompt (their K/V is
+        rebuilt by a fresh prefill/stream on whatever engine resumes the
+        request) and the streaming cursor rewinds. Pages must already be
+        freed by the caller."""
+        if req._prompt_len is None:
+            req._prompt_len = len(req.prompt)
+        req.prompt = req.prompt + req.generated
+        req.generated = []
+        req.cursor = 0
+        return req
 
     def _preempt_one(self) -> bool:
         """Evict the request with the least sunk work (still-streaming
@@ -207,19 +294,35 @@ class ContinuousBatchingScheduler:
         self.running.remove(victim)
         self.engine.pool.free(victim.pages)
         victim.pages = []
-        if victim._prompt_len is None:
-            victim._prompt_len = len(victim.prompt)
-        # fold generated tokens into the prompt: the resume re-streams (or
-        # re-prefills) their K/V and picks up at the NEXT token
-        victim.prompt = victim.prompt + victim.generated
-        victim.generated = []
-        victim.cursor = 0
+        self._reset_for_resume(victim)
         victim.preemptions += 1
         self.preempted_total += 1
         self.waiting.insert(0, victim)
         if telemetry.enabled():
             _req_counter().labels(event="preempted").inc()
         return True
+
+    def evacuate(self) -> List[Request]:
+        """Pull EVERY in-flight and queued request out of this scheduler,
+        reset for recompute-on-resume (the preemption path generalized to
+        the whole replica), and return them in resume order (running
+        first — they have the most sunk work — then waiting). The fleet
+        calls this when a replica's circuit breaker opens: the requests are
+        re-submitted to a healthy replica and their K/V pages are rebuilt
+        from the folded prompt there."""
+        evacuated: List[Request] = []
+        for req in self.running:
+            self.engine.pool.free(req.pages)
+            req.pages = []
+            evacuated.append(self._reset_for_resume(req))
+        # waiting requests hold no pages; a preemption-requeued one is
+        # already in resume form
+        evacuated.extend(self.waiting)
+        self.running = []
+        self.waiting = []
+        if telemetry.enabled():
+            self._sync_gauges()
+        return evacuated
 
     def _emit_token(self, req: Request, logits: np.ndarray, now: float) -> None:
         token = int(np.argmax(logits))
@@ -261,7 +364,7 @@ class ContinuousBatchingScheduler:
         slot one token per step (chunked prefill at token granularity), so
         admission never stalls anyone else's decode cadence.
         """
-        if not self.waiting or len(self.running) >= self.max_running:
+        if self.draining or not self.waiting or len(self.running) >= self.max_running:
             return None
         req = self.waiting[0]
         pool = self.engine.pool
@@ -291,6 +394,9 @@ class ContinuousBatchingScheduler:
     def step(self) -> int:
         """One scheduler tick; returns the number of tokens produced."""
         produced = 0
+        # TTL sweep first: an expired request must not consume an admission
+        # slot or grow pages this very tick
+        self._expire_due(self.clock())
         # admission: fill free decode slots from the waiting line
         while True:
             emitted = self._try_admit()

@@ -15,7 +15,7 @@ import sys
 
 BENCH = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "bench.py")
 CONFIGS = {"seq128", "seq4096", "llama3_shape", "resnet50", "ppocr_e2e",
-           "serving", "input_stream", "moe_longcontext"}
+           "serving", "fleet", "input_stream", "moe_longcontext"}
 
 
 def _run_bench(deadline_s):

@@ -355,3 +355,68 @@ def test_moe_longcontext_gated(tmp_path):
     rc, out, err = _run(a2, b2)
     assert rc == 0, (out, err)
     assert "workload changed" in out
+
+
+def _with_fleet(tps=1800.0, scaling=1.8, swap_p99=6.0, tpot=4.0,
+                n_replicas=4, flops=2.0e11):
+    """Capture carrying a round-13 fleet config (the replica-fleet field
+    shape bench.py emits: widest-run SLO stats flat, per-width nested)."""
+    c = _capture()
+    c["detail"]["configs"]["fleet"] = "measured"
+    c["detail"]["fleet"] = {
+        "n_replicas": n_replicas,
+        "n_requests": 32,
+        "tokens_per_sec": tps,
+        "p50_tpot_ms": tpot / 2, "p99_tpot_ms": tpot,
+        "p99_ttft_ms": 30.0,
+        "p99_tpot_swap_ms": swap_p99,
+        "swap_blip_ratio": round(swap_p99 / tpot, 3),
+        "scaling_vs_1replica": scaling,
+        "replicas": {"1": {"tokens_per_sec": tps / scaling},
+                     str(n_replicas): {"tokens_per_sec": tps}},
+        "fleet_dims": {"hidden": 256, "max_batch": 4, "replicas": [1, 2, 4]},
+        "attribution": {"flops": flops, "hbm_bytes": 4.0e9,
+                        "program_memory_bytes": 1.0e9},
+    }
+    return c
+
+
+def test_fleet_scaling_drop_fails(tmp_path):
+    # tokens/s scaling vs replica count is larger-is-better: the fleet
+    # delivering 1.3x instead of 1.8x over one replica with flat attributed
+    # work is a routing/drain regression, not a different workload
+    a = _write(tmp_path, "a.json", _with_fleet(scaling=1.8))
+    b = _write(tmp_path, "b.json", _with_fleet(scaling=1.3))
+    rc, out, err = _run(a, b)
+    assert rc == 1, (out, err)
+    assert "scaling_vs_1replica" in out and "throughput regression" in out
+
+
+def test_fleet_swap_blip_regression_fails(tmp_path):
+    # the p99 inter-token interval measured INSIDE the swap window is a
+    # TIME_FIELD: a rollout whose blip grows +25% unexplained fails
+    a = _write(tmp_path, "a.json", _with_fleet(swap_p99=6.0))
+    b = _write(tmp_path, "b.json", _with_fleet(swap_p99=7.5))
+    rc, out, err = _run(a, b)
+    assert rc == 1, (out, err)
+    assert "p99_tpot_swap_ms" in out and "UNEXPLAINED" in out
+
+
+def test_fleet_replica_count_is_shape(tmp_path):
+    # a different fleet width (or replica ladder) is a different problem —
+    # never compared, even with wildly different numbers
+    a = _write(tmp_path, "a.json", _with_fleet(tps=1800.0, n_replicas=4))
+    b = _write(tmp_path, "b.json",
+               _with_fleet(tps=600.0, scaling=1.0, n_replicas=2))
+    rc, out, err = _run(a, b)
+    assert rc == 0, (out, err)
+    assert "workload changed" in out
+
+
+def test_fleet_explained_by_attributed_work(tmp_path):
+    # swap-blip +25% alongside +30% attributed FLOPs: a bigger model per
+    # token, not a drain-protocol regression
+    a = _write(tmp_path, "a.json", _with_fleet(swap_p99=6.0, flops=2.0e11))
+    b = _write(tmp_path, "b.json", _with_fleet(swap_p99=7.5, flops=2.6e11))
+    rc, out, err = _run(a, b)
+    assert rc == 0, (out, err)

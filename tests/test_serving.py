@@ -452,6 +452,92 @@ def test_static_batching_baseline(tiny_model, shared_engine):
 
 
 # ---------------------------------------------------------------------------
+# request TTL / cancellation / drain (round 13)
+# ---------------------------------------------------------------------------
+
+def test_request_ttl_expires_and_frees_pages(tiny_model, shared_engine):
+    """A request past its deadline_s finishes with outcome="expired" and
+    frees its pool pages IMMEDIATELY (a stuck client must not pin pages),
+    counted into paddle_tpu_serving_requests_total{event=expired}; other
+    in-flight requests are untouched."""
+    from paddle_tpu.inference.scheduler import ContinuousBatchingScheduler, Request
+
+    eng = shared_engine
+    eng.pool.reset()
+    cnt = tm.counter("paddle_tpu_serving_requests_total",
+                     "request lifecycle events", ("event",))
+    expired_before = cnt.labels(event="expired").value
+    t = [0.0]
+    sched = ContinuousBatchingScheduler(eng, clock=lambda: t[0])
+    r0 = Request(rid=0, prompt=[1, 2, 3, 4], max_new_tokens=20, deadline_s=0.5)
+    r1 = Request(rid=1, prompt=[5, 6, 7, 8], max_new_tokens=3)
+    sched.submit(r0)
+    sched.submit(r1)
+    sched.step()
+    assert eng.pool.used() > 0
+    t[0] = 1.0  # past r0's TTL; r1 has none
+    sched.step()
+    assert r0.outcome == "expired" and r0.done and r0.pages == []
+    assert r0 in sched.finished
+    assert cnt.labels(event="expired").value == expired_before + 1
+    while not sched.idle():
+        sched.step()
+    assert r1.outcome == "completed" and len(r1.generated) == 3
+    assert eng.pool.used() == 0
+
+
+def test_request_cancellation_frees_pages(tiny_model, shared_engine):
+    from paddle_tpu.inference.scheduler import ContinuousBatchingScheduler, Request
+
+    eng = shared_engine
+    eng.pool.reset()
+    cnt = tm.counter("paddle_tpu_serving_requests_total",
+                     "request lifecycle events", ("event",))
+    cancelled_before = cnt.labels(event="cancelled").value
+    sched = ContinuousBatchingScheduler(eng)
+    r0 = Request(rid=0, prompt=[1, 2, 3, 4, 5], max_new_tokens=30)
+    r1 = Request(rid=1, prompt=[6, 7, 8], max_new_tokens=3)
+    sched.submit(r0)
+    sched.submit(r1)
+    sched.step()
+    assert sched.cancel(0) is True
+    assert r0.outcome == "cancelled" and r0.done and r0.pages == []
+    assert sched.cancel(0) is False  # already gone
+    assert sched.cancel(99) is False  # never submitted
+    assert cnt.labels(event="cancelled").value == cancelled_before + 1
+    while not sched.idle():
+        sched.step()
+    assert r1.outcome == "completed"
+    assert r1.generated == _greedy_oracle(tiny_model, r1.prompt, 3)
+    assert eng.pool.used() == 0
+
+
+def test_scheduler_drain_gates_admission(tiny_model, shared_engine):
+    """drain() stops NEW admissions while in-flight work keeps decoding —
+    the per-replica half of the fleet's hot-swap protocol."""
+    from paddle_tpu.inference.scheduler import ContinuousBatchingScheduler, Request
+
+    eng = shared_engine
+    eng.pool.reset()
+    sched = ContinuousBatchingScheduler(eng)
+    r0 = Request(rid=0, prompt=[1, 2, 3], max_new_tokens=6)
+    sched.submit(r0)
+    sched.step()  # r0 in flight
+    sched.drain()
+    r1 = Request(rid=1, prompt=[4, 5, 6], max_new_tokens=2)
+    sched.submit(r1)
+    for _ in range(8):
+        sched.step()
+    assert r0.done and r0.outcome == "completed"  # in-flight work finished
+    assert not r1.done and [r.rid for r in sched.waiting] == [1]
+    sched.resume_admission()
+    while not sched.idle():
+        sched.step()
+    assert r1.generated == _greedy_oracle(tiny_model, r1.prompt, 2)
+    assert eng.pool.used() == 0
+
+
+# ---------------------------------------------------------------------------
 # paddle_inference_api wiring
 # ---------------------------------------------------------------------------
 

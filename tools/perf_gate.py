@@ -42,7 +42,7 @@ from typing import Optional
 # config keys inside `detail` holding per-config stat dicts, plus the
 # headline whose stats live directly in `detail`
 NESTED_CONFIGS = ("seq4096", "llama3_shape", "resnet50", "ppocr_e2e", "serving",
-                  "input_stream", "moe_longcontext")
+                  "fleet", "input_stream", "moe_longcontext")
 # fields whose change means "different workload" (never a regression)
 SHAPE_FIELDS = (
     "batch", "seq", "heads", "layers", "rung", "micro", "n_images",
@@ -53,6 +53,9 @@ SHAPE_FIELDS = (
     # different reader cost or expert count is a different problem
     "n_samples", "global_batch", "input_dims", "prefetch_depth",
     "experts", "top_k", "capacity_factor", "moe_dims",
+    # round 13: fleet width + replay shape — a different replica ladder or
+    # swap/kill schedule is a different problem
+    "n_replicas", "fleet_dims",
 )
 # larger-is-worse regression metrics per config record; the names match
 # what bench.py actually emits per config (ernie/llama/resnet report
@@ -62,12 +65,19 @@ SHAPE_FIELDS = (
 TIME_FIELDS = (
     "ms_per_step", "ms_per_image_e2e", "det_ms_per_image", "rec_ms_per_batch",
     "p99_ttft_ms", "p99_tpot_ms", "p99_input_wait_ms",
+    # round 13: the inter-token p99 measured INSIDE the weight-swap window —
+    # a rollout whose blip grows past tol is a drain-protocol regression
+    "p99_tpot_swap_ms",
 )
 # larger-is-BETTER metrics: a drop beyond tolerance with flat attributed
 # work is the same unexplained-regression signal inverted (serving
 # tokens/s; the ernie headline's tokens_per_sec rides along consistently;
 # input_stream samples/s — round 12)
-THROUGHPUT_FIELDS = ("tokens_per_sec", "samples_per_sec")
+THROUGHPUT_FIELDS = ("tokens_per_sec", "samples_per_sec",
+                     # round 13: fleet tokens/s at the widest replica count
+                     # over the 1-replica run — scaling falling with flat
+                     # work is a routing/overlap regression
+                     "scaling_vs_1replica")
 ATTR_WORK_FIELDS = ("flops", "hbm_bytes")
 ATTR_MEM_FIELDS = ("program_memory_bytes", "peak_hbm_bytes")
 
