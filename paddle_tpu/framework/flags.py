@@ -93,6 +93,16 @@ define_flag(
     "per-tensor fusion scatter (~9 ms of the 53 ms seq-128 step)",
 )
 define_flag("FLAGS_jit_guard_shapes", True, "retrace to_static programs on input shape change")
+define_flag(
+    "FLAGS_verify_program",
+    True,
+    "run the static.analysis verifier (SSA single-assignment, "
+    "use-before-def, feed/param coverage, dangling fetch/grad/opt refs, "
+    "op-output arity, donation hazards) before Executor._compile and "
+    "program-export lowering, so malformed programs fail with a diagnostic "
+    "naming the op/var instead of an XLA traceback; costs ~O(#ops) python "
+    "per COMPILE (cache hits never re-verify)",
+)
 # Training guardian (framework/guardian.py): state-failure guards layered on
 # the PR 2 process/IO resilience — numerical anomaly policy, last-known-good
 # rollback ring, cross-rank desync digest, crash flight recorder.

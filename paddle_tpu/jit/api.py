@@ -294,6 +294,39 @@ class _CompiledEntry:
                 if not missed and not new_grad_ts:
                     import time as _time
 
+                    if self.donated:
+                        # donation safety over EVERYTHING donate_argnums
+                        # covers — discovered state (argnum 1) AND incoming
+                        # grads (argnum 3): two entries sharing one buffer
+                        # would donate it twice — fail HERE naming the
+                        # tensors, not inside XLA's anonymous
+                        # duplicate-donation error
+                        from ..static.analysis import (
+                            verify_donated_state,
+                            verify_enabled,
+                        )
+
+                        if verify_enabled():
+                            donated = list(self.state)
+                            labels = [f"state[{i}]" for i in range(len(donated))]
+                            for j, t in enumerate(self.grad_tensors):
+                                if t.grad is not None:
+                                    donated.append(t.grad)
+                                    name = getattr(t, "name", None) or f"#{j}"
+                                    labels.append(f"grad-of[{name}]")
+                            try:
+                                verify_donated_state(
+                                    donated,
+                                    origin=f"to_static:{getattr(self.fn, '__name__', '<fn>')}",
+                                    labels=labels,
+                                )
+                            except Exception:
+                                # _build already installed the donating jit
+                                # wrapper; leaving it set would let the NEXT
+                                # call skip this check and hit XLA's
+                                # anonymous duplicate-donation error
+                                self.jitted = None
+                                raise
                     t0 = _time.perf_counter()
                     lowered = traced.lower()
                     self.jitted = lowered.compile()

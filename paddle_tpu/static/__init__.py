@@ -18,6 +18,14 @@ from .program import (  # noqa: F401
     program_guard,
 )
 from ..ops.creation import create_parameter  # noqa: F401
+from . import analysis  # noqa: F401
+from .analysis import (  # noqa: F401
+    Diagnostic,
+    ProgramVerifyError,
+    dead_op_elimination,
+    describe_program,
+    verify,
+)
 from .extras import (  # noqa: F401
     BuildStrategy,
     CompiledProgram,

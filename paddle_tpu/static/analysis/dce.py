@@ -1,0 +1,75 @@
+"""Dead-op elimination: the first analysis-proven rewrite.
+
+Reference parity: paddle/fluid/pir/transforms/dead_code_elimination_pass.cc.
+TPU-native: XLA already DCEs the *lowered* jaxpr, but dead recorded ops
+still cost trace time on every (feed-shape, fetch-set) signature and
+pollute to_text dumps the pass layer diffs — eliminating them at the
+Program level is what makes `--print-after-pass` meaningful. Liveness is
+walked backward from the escape roots (fetches, grad requests, optimizer
+updates); effectful ops (print_op) and zero-output ops survive
+unconditionally. Removal is telemetry-counted and, by construction,
+bit-identical: a removed op's outputs are read by nothing live.
+"""
+from __future__ import annotations
+
+from typing import List
+
+from .graph import ProgramGraph
+
+
+def dead_op_elimination(program, fetch_list=None) -> int:
+    """Remove ops whose outputs no root (fetch/grad/opt) transitively
+    demands. Mutates `program` in place (run it on `program.clone()` to
+    keep the original) and returns the number of ops removed.
+
+    `fetch_list` entries may be Tensors recorded in the program or raw var
+    ids; omitted, only grad/opt roots pin liveness (an inference program
+    with no fetch list would lose everything — pass your fetches)."""
+    fetch_vars = _resolve_fetch(program, fetch_list)
+    graph = ProgramGraph(program, fetch_vars=fetch_vars)
+    mask = graph.live_ops()
+    removed = [op for op, live in zip(program.ops, mask) if not live]
+    if removed:
+        program.ops = [op for op, live in zip(program.ops, mask) if live]
+        # release the dead outputs' placeholder Tensors: the keepalive dict
+        # would otherwise pin their eagerly-evaluated activations (the
+        # largest arrays a capture holds) for the program's lifetime, and a
+        # stale vid must stop validating as a var of this program
+        for op in removed:
+            for vid in op.out_vars:
+                t = program._var_tensors.pop(vid, None)
+                if t is not None:
+                    program._id2var.pop(id(t), None)
+        program._compiled.clear()
+    from ... import telemetry as _tm
+
+    if _tm.enabled():
+        _tm.counter(
+            "paddle_tpu_program_dce_removed_ops_total",
+            "recorded ops removed by dead-op elimination",
+        ).inc(len(removed))
+    return len(removed)
+
+
+def _resolve_fetch(program, fetch_list) -> List[int]:
+    # every var with a recorded placeholder/persistable Tensor, plus grad
+    # vars (bound by the grad pass): the set of vids that can root liveness
+    known = set(program._var_tensors)
+    for _loss, _pvars, gvars in program.grad_requests:
+        known.update(gvars)
+    vids = []
+    for f in fetch_list or ():
+        if isinstance(f, int):
+            # an unvalidated stale/typo'd vid would root NOTHING and let
+            # the walk silently delete the ops the caller meant to keep
+            if f not in known:
+                raise ValueError(
+                    f"dead_op_elimination: fetch var id {f} is not a var of "
+                    f"this program"
+                )
+            vids.append(f)
+            continue
+        # Tensors and strings resolve through THE shared policy — liveness
+        # roots must match what a later exe.run(fetch_list=...) resolves to
+        vids.append(program.resolve_fetch(f))
+    return vids

@@ -1,0 +1,126 @@
+"""Donation / aliasing checks.
+
+Buffer donation is this framework's highest-leverage memory optimization
+(to_static donates params + optimizer moments; the serving engine donates
+cache pages) and its sharpest edge: a donated buffer read after the
+compiled step consumed it raises jax's opaque "array has been deleted"
+deep inside user code. These checks name the hazard BEFORE lowering:
+
+- static programs: a fused-optimizer flat bucket (state the one-pass
+  kernel consumes) that is ALSO registered as a program input, and a var
+  that is both fed and fetched (aliases one buffer end-to-end under a
+  donating engine);
+- to_static lowering: two discovered state tensors sharing one underlying
+  jax buffer — donate_argnums would donate the same buffer twice, which
+  XLA rejects with a traceback naming neither tensor.
+"""
+from __future__ import annotations
+
+from typing import List
+
+
+def check_donation(program, fetch_vars=None) -> List["Diagnostic"]:
+    """Static-program donation/aliasing diagnostics (warning severity for
+    hazards legal under the copying Executor, error for state aliasing
+    that silently corrupts write-back)."""
+    from .verifier import Diagnostic
+
+    diags: List[Diagnostic] = []
+    fetch_vars = set(fetch_vars or ())
+
+    feed_vids = set(program.feed_vars.values())
+    for vid in sorted(feed_vids & fetch_vars):
+        name = next(n for n, v in program.feed_vars.items() if v == vid)
+        diags.append(Diagnostic(
+            "fed-and-fetched",
+            f"feed {name!r} (%v{vid}) is also a fetch target — under a "
+            f"donating engine the fetched output would alias the donated "
+            f"feed buffer",
+            severity="warning", var=vid,
+        ))
+
+    # accumulator aliasing: the Executor writes back each update's accums
+    # after the run; one Tensor shared by two updates means the second
+    # write-back silently wins
+    seen_accums = {}
+    for ui, upd in enumerate(program.opt_updates):
+        for t in getattr(upd, "accum_tensors", ()):
+            prev = seen_accums.get(id(t))
+            if prev is not None:
+                diags.append(Diagnostic(
+                    "aliased-opt-state",
+                    f"opt#{ui} and opt#{prev} share one accumulator Tensor "
+                    f"object — the later write-back silently overwrites the "
+                    f"earlier update's state",
+                ))
+            else:
+                seen_accums[id(t)] = ui
+
+    # fused donated-bucket read: the flat m/v buckets are consumed by the
+    # one-pass kernel; if the SAME Tensor is also registered as a program
+    # input (an op read it during capture), the op replays against a
+    # buffer the kernel donates/overwrites — stale on TPU, racy anywhere
+    from ..executor import _FusedAdamWUpdate
+
+    accum_ids = {
+        id(t): (ui, ti)
+        for ui, upd in enumerate(program.opt_updates)
+        if isinstance(upd, _FusedAdamWUpdate)
+        for ti, t in enumerate(getattr(upd, "accum_tensors", ()))
+    }
+    if accum_ids:
+        read_vids = set()
+        for op in program.ops:
+            read_vids.update(r[1] for r in op.in_refs if r[0] == "var")
+        for vid in sorted(read_vids):
+            t = program._var_tensors.get(vid)
+            if t is not None and id(t) in accum_ids:
+                ui, ti = accum_ids[id(t)]
+                diags.append(Diagnostic(
+                    "donated-bucket-read",
+                    f"%v{vid} is fused opt#{ui}'s donated flat bucket "
+                    f"(accum {ti}) AND a program input — reads after the "
+                    f"one-pass kernel consumes the bucket see stale or "
+                    f"deleted memory",
+                    severity="warning", var=vid,
+                ))
+    return diags
+
+
+def verify_donated_state(state_tensors, origin="to_static", labels=None) -> None:
+    """to_static lowering check (flag-gated by the caller): no two donated
+    entries may share one underlying jax buffer. Raises ProgramVerifyError
+    naming the tensors instead of letting XLA reject the duplicate donation
+    with an anonymous traceback. `labels` (parallel to `state_tensors`)
+    names each entry's collection — the caller donates state AND incoming
+    grads, and the diagnostic must point at the right one."""
+    from .verifier import Diagnostic, ProgramVerifyError
+
+    by_buf = {}
+    diags = []
+
+    def _label(k, tt):
+        slot = labels[k] if labels is not None else f"state[{k}]"
+        return f"{slot} {getattr(tt, 'name', None) or '<unnamed>'}"
+
+    for i, t in enumerate(state_tensors):
+        v = t._raw() if hasattr(t, "_raw") else getattr(t, "_value", None)
+        if v is None:
+            continue
+        prev = by_buf.get(id(v))
+        if prev is not None:
+            j, other = prev
+            diags.append(Diagnostic(
+                "donated-state-alias",
+                f"{origin}: {_label(i, t)} and {_label(j, other)} share one "
+                f"underlying buffer — donating it twice is rejected by XLA; "
+                f"copy one of them (e.g. tensor.clone()) or set "
+                f"FLAGS_to_static_donate=0",
+            ))
+        else:
+            by_buf[id(v)] = (i, t)
+    if diags:
+        from .verifier import count_diagnostics
+
+        count_diagnostics(diags)
+        raise ProgramVerifyError(diags)

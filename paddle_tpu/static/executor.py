@@ -143,23 +143,7 @@ class Executor:
         program = program if program is not None else default_main_program()
         feed = feed or {}
         fetch_list = list(fetch_list or [])
-        fetch_vars = []
-        for f in fetch_list:
-            if isinstance(f, Tensor):
-                vid = program._id2var.get(id(f))
-                if vid is None:
-                    raise ValueError(f"fetch target {f.name or f} is not in this program")
-                fetch_vars.append(vid)
-            elif isinstance(f, str):  # fetch by feed/var name
-                if f in program.feed_vars:
-                    fetch_vars.append(program.feed_vars[f])
-                else:
-                    named = [v for v, t in program._var_tensors.items() if t.name == f]
-                    if not named:
-                        raise ValueError(f"no variable named {f!r} in program")
-                    fetch_vars.append(named[-1])
-            else:
-                raise TypeError(f"fetch_list entries must be Tensor or str, got {type(f)}")
+        fetch_vars = [program.resolve_fetch(f) for f in fetch_list]
 
         compiled = self._compile(program, tuple(sorted(feed)), tuple(fetch_vars))
 
@@ -246,6 +230,14 @@ class Executor:
                 "paddle_tpu_executor_compile_cache_evictions_total",
                 "stale compiled-program cache entries dropped on recompile",
             ).inc(len(stale))
+
+        # verify BEFORE lowering (flag-gated, compile-miss only): a malformed
+        # program fails here with a diagnostic naming the op/var, not as a
+        # KeyError/XLA traceback from inside the jit trace below
+        from .analysis import verifier as _verifier
+
+        if _verifier.verify_enabled():
+            _verifier.verify(program, feed_names=feed_names, fetch_vars=fetch_vars)
 
         feed_var_ids = [program.feed_vars[n] for n in feed_names]
         grad_requests = list(program.grad_requests)

@@ -57,6 +57,13 @@ def _export_program(feed_vars, fetch_vars, program):
             raise ValueError("fetch_vars must be outputs of this program")
         fetch_ids.append(vid)
 
+    # verify before lowering to StableHLO (flag-gated): exporting a
+    # malformed program must fail with a named diagnostic, not an XLA error
+    from .analysis import verifier as _verifier
+
+    if _verifier.verify_enabled():
+        _verifier.verify(program, feed_names=feed_names, fetch_vars=fetch_ids)
+
     param_arrays = [program._var_tensors[v]._value for v in program.param_vars]
 
     def infer_fn(*feed_arrays):

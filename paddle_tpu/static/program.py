@@ -28,16 +28,28 @@ _op_serial = itertools.count()
 
 
 class OpInstr:
-    """One recorded op: out_vars = fn(*in_refs, **kwargs)."""
+    """One recorded op: out_vars = fn(*in_refs, **kwargs).
 
-    __slots__ = ("name", "fn", "in_refs", "kwargs", "out_vars", "seq")
+    `out_positions[i]` is the index of out_vars[i] inside the RAW output
+    tuple fn returns (ops may interleave non-Tensor outputs, which are not
+    program vars); `n_raw_outs` is the full raw output count recorded at
+    capture time — replay_env enforces it so an arity drift between record
+    and replay raises a named error instead of silently truncating."""
 
-    def __init__(self, name, fn, in_refs, kwargs, out_vars):
+    __slots__ = ("name", "fn", "in_refs", "kwargs", "out_vars",
+                 "out_positions", "n_raw_outs", "seq")
+
+    def __init__(self, name, fn, in_refs, kwargs, out_vars,
+                 out_positions=None, n_raw_outs=None):
         self.name = name
         self.fn = fn
         self.in_refs = in_refs  # list of ("var", var_id) | ("lit", value)
         self.kwargs = kwargs
         self.out_vars = out_vars  # list of var_id
+        self.out_positions = (
+            list(out_positions) if out_positions is not None else list(range(len(out_vars)))
+        )
+        self.n_raw_outs = n_raw_outs if n_raw_outs is not None else len(out_vars)
         self.seq = next(_op_serial)
 
     def __repr__(self):
@@ -95,8 +107,13 @@ class Program:
             else:
                 in_refs.append(("lit", a))
         out_list = outs if isinstance(outs, (tuple, list)) else [outs]
-        out_vars = [self._new_var(o) for o in out_list if isinstance(o, Tensor)]
-        self.ops.append(OpInstr(name, fn, in_refs, dict(kwargs), out_vars))
+        out_vars, out_positions = [], []
+        for i, o in enumerate(out_list):
+            if isinstance(o, Tensor):
+                out_vars.append(self._new_var(o))
+                out_positions.append(i)
+        self.ops.append(OpInstr(name, fn, in_refs, dict(kwargs), out_vars,
+                                out_positions, len(out_list)))
         self._compiled.clear()
 
     # ---- replay (shared by Executor._compile and save_inference_model) ----
@@ -106,15 +123,45 @@ class Program:
         env = dict(feed_bindings)
         for vid, arr in zip(self.param_vars, param_arrays):
             env[vid] = arr
-        for instr in self.ops:
+        for i, instr in enumerate(self.ops):
             args = [env[r[1]] if r[0] == "var" else r[1] for r in instr.in_refs]
             out = instr.fn(*args, **instr.kwargs)
             outs = out if isinstance(out, (tuple, list)) else (out,)
-            for vid, o in zip(instr.out_vars, outs):
-                env[vid] = o
+            # arity is a hard contract: a fn returning fewer outputs than
+            # recorded used to silently drop the extra out_vars from env (a
+            # downstream read then failed as an opaque KeyError inside the
+            # jit trace), and extra outputs were silently ignored
+            if len(outs) != instr.n_raw_outs:
+                raise RuntimeError(
+                    f"program replay: op#{i} '{instr.name}' returned "
+                    f"{len(outs)} output(s) but {instr.n_raw_outs} were "
+                    f"recorded at capture time — the op function changed "
+                    f"arity between record and replay"
+                )
+            for vid, pos in zip(instr.out_vars, instr.out_positions):
+                env[vid] = outs[pos]
         return env
 
     # ---- introspection ----
+    def resolve_fetch(self, f) -> int:
+        """THE fetch-target resolution policy, shared by Executor.run and
+        the analysis passes (so liveness roots can never diverge from what
+        a later run() resolves): Tensor by identity, string by feed name
+        then newest named var."""
+        if isinstance(f, Tensor):
+            vid = self._id2var.get(id(f))
+            if vid is None:
+                raise ValueError(f"fetch target {f.name or f} is not in this program")
+            return vid
+        if isinstance(f, str):
+            if f in self.feed_vars:
+                return self.feed_vars[f]
+            named = [v for v, t in self._var_tensors.items() if t.name == f]
+            if not named:
+                raise ValueError(f"no variable named {f!r} in program")
+            return named[-1]
+        raise TypeError(f"fetch_list entries must be Tensor or str, got {type(f)}")
+
     def list_vars(self):
         return list(self._var_tensors.values())
 
@@ -130,10 +177,18 @@ class Program:
             if isinstance(self._var_tensors.get(v), Parameter)
         ]
 
+    def to_text(self, fetch_vars=None):
+        """Stable text dump of the program (the `--print-after-pass` format
+        of the analysis layer): feeds, params, ops with per-var shape/dtype
+        harvested from the recorded placeholder Tensors, grad requests, opt
+        updates and optional fetch roots. Renders empty and partially
+        recorded programs without error."""
+        from .analysis.graph import program_to_text
+
+        return program_to_text(self, fetch_vars=fetch_vars)
+
     def __repr__(self):
-        lines = [f"Program(feeds={list(self.feed_vars)}, params={len(self.param_vars)} ops={len(self.ops)})"]
-        lines += [f"  {op!r}" for op in self.ops]
-        return "\n".join(lines)
+        return self.to_text()
 
     clone = None  # assigned below
 

@@ -1,0 +1,521 @@
+"""Program analysis layer: ProgramGraph/to_text, the verifier's named
+diagnostics (one deliberately-malformed program per check class), dead-op
+elimination bit-identity, donation checks, and the trace-hazard linter
+(fixtures + the tier-1 clean-run gate over paddle_tpu/)."""
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static, telemetry
+from paddle_tpu.static.analysis import (
+    ProgramGraph,
+    ProgramVerifyError,
+    dead_op_elimination,
+    describe_program,
+    verify,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _checks(diags):
+    return [d.check for d in diags]
+
+
+def _counter_value(name, **labels):
+    fam = telemetry.default_registry().get(name)
+    if fam is None:
+        return 0
+    child = fam.labels(**labels) if labels else fam._default()
+    return child.value
+
+
+def _simple_program():
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        x = static.data("x", [2, 3], "float32")
+        lin = paddle.nn.Linear(3, 2)
+        y = lin(x) + 1.0
+    return main, x, y
+
+
+# ---------------------------------------------------------------------------
+# verifier: one malformed program per diagnostic class
+# ---------------------------------------------------------------------------
+
+def test_verify_clean_program_no_diagnostics():
+    main, x, y = _simple_program()
+    diags = verify(main, feed_names=["x"], fetch_vars=[main._id2var[id(y)]])
+    assert diags == []
+    # the public entry point takes fetch_list-style entries too (same
+    # resolution policy as exe.run / dead_op_elimination)
+    y.name = "out"
+    assert verify(main, feed_names=["x"], fetch_vars=[y]) == []
+    assert verify(main, feed_names=["x"], fetch_vars=["out"]) == []
+
+
+def test_use_before_def_named():
+    main, x, y = _simple_program()
+    main.ops.reverse()  # the add now reads the linear's output before it runs
+    with pytest.raises(ProgramVerifyError) as ei:
+        verify(main)
+    diags = ei.value.diagnostics
+    assert "use-before-def" in _checks(diags)
+    d = next(d for d in diags if d.check == "use-before-def")
+    assert "op#0" in d.message and "%v" in d.message
+
+
+def test_undefined_var_named():
+    main, x, y = _simple_program()
+    main.ops[0].in_refs[0] = ("var", 9999)
+    with pytest.raises(ProgramVerifyError) as ei:
+        verify(main)
+    d = next(d for d in ei.value.diagnostics if d.check == "undefined-var")
+    assert "%v9999" in d.message and main.ops[0].name in d.message
+
+
+def test_single_assignment_violation():
+    main, x, y = _simple_program()
+    # second op re-binds the first op's output var: SSA violation
+    main.ops[1].out_vars[0] = main.ops[0].out_vars[0]
+    with pytest.raises(ProgramVerifyError) as ei:
+        verify(main)
+    assert "single-assignment" in _checks(ei.value.diagnostics)
+
+
+def test_duplicate_var_binding():
+    main, x, y = _simple_program()
+    op = main.ops[0]
+    op.out_vars = op.out_vars + op.out_vars  # same vid twice in ONE op
+    op.out_positions = op.out_positions + op.out_positions
+    with pytest.raises(ProgramVerifyError) as ei:
+        verify(main)
+    assert "duplicate-var-binding" in _checks(ei.value.diagnostics)
+
+
+def test_op_output_arity_static_checks():
+    main, x, y = _simple_program()
+    main.ops[0].out_positions = []  # vars without positions
+    with pytest.raises(ProgramVerifyError) as ei:
+        verify(main)
+    assert "op-output-arity" in _checks(ei.value.diagnostics)
+
+    main2, _, _ = _simple_program()
+    main2.ops[0].out_positions = [5]  # outside recorded raw arity
+    with pytest.raises(ProgramVerifyError) as ei2:
+        verify(main2)
+    assert "op-output-arity" in _checks(ei2.value.diagnostics)
+
+
+def test_replay_arity_mismatch_raises_named_error():
+    """Satellite: replay_env must hard-error (naming the op) when the op fn
+    returns a different output count than recorded — it used to silently
+    zip-truncate."""
+    main, x, y = _simple_program()
+    op = main.ops[-1]
+    op.fn = lambda *a, **kw: (a[0], a[0])  # 2 outputs, 1 recorded
+    exe = static.Executor()
+    with pytest.raises(RuntimeError, match=rf"op#1 '{op.name}'.*returned 2"):
+        exe.run(main, feed={"x": np.ones((2, 3), "float32")}, fetch_list=[y])
+
+
+def test_missing_feed_is_named_diagnostic_not_keyerror():
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        a = static.data("a", [2], "float32")
+        b = static.data("b", [2], "float32")
+        c = a + b
+    exe = static.Executor()
+    with pytest.raises(ProgramVerifyError, match="feed-coverage.*'b'"):
+        exe.run(main, feed={"a": np.ones(2, "float32")}, fetch_list=[c])
+    # unknown provided feed name is also named
+    with pytest.raises(ProgramVerifyError, match="feed-coverage.*'zz'"):
+        exe.run(
+            main,
+            feed={"a": np.ones(2, "float32"), "b": np.ones(2, "float32"),
+                  "zz": np.ones(2, "float32")},
+            fetch_list=[c],
+        )
+
+
+def test_verify_flag_off_skips_to_raw_error():
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        a = static.data("a", [2], "float32")
+        b = static.data("b", [2], "float32")
+        c = a + b
+    exe = static.Executor()
+    paddle.set_flags({"FLAGS_verify_program": False})
+    try:
+        with pytest.raises(Exception) as ei:
+            exe.run(main, feed={"a": np.ones(2, "float32")}, fetch_list=[c])
+        assert not isinstance(ei.value, ProgramVerifyError)
+    finally:
+        paddle.set_flags({"FLAGS_verify_program": True})
+
+
+def test_dangling_fetch():
+    main, x, y = _simple_program()
+    with pytest.raises(ProgramVerifyError) as ei:
+        verify(main, fetch_vars=[123456])
+    d = next(d for d in ei.value.diagnostics if d.check == "dangling-fetch")
+    assert "%v123456" in d.message
+
+
+def test_dangling_grad_ref():
+    main, x, y = _simple_program()
+    main.grad_requests.append((424242, [main.param_vars[0]], [main._next_var]))
+    with pytest.raises(ProgramVerifyError) as ei:
+        verify(main)
+    assert "dangling-grad-ref" in _checks(ei.value.diagnostics)
+
+
+def test_dangling_opt_ref():
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        x = static.data("x", [4, 3], "float32")
+        lin = paddle.nn.Linear(3, 1)
+        loss = (lin(x) ** 2).mean()
+        opt = paddle.optimizer.SGD(0.1, parameters=lin.parameters())
+        opt.minimize(loss)
+    main.opt_updates[0].grad_var = 777777  # grad producer "removed"
+    with pytest.raises(ProgramVerifyError) as ei:
+        verify(main)
+    d = next(d for d in ei.value.diagnostics if d.check == "dangling-opt-ref")
+    assert "%v777777" in d.message
+
+
+def test_fed_and_fetched_is_warning_not_error():
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        x = static.data("x", [2], "float32")
+        y = x * 2.0
+    exe = static.Executor()
+    # legal under the copying Executor — must keep working
+    (got,) = exe.run(main, feed={"x": np.array([1.0, 2.0], "float32")}, fetch_list=["x"])
+    np.testing.assert_array_equal(got, [1.0, 2.0])
+    diags = verify(main, feed_names=["x"], fetch_vars=[main.feed_vars["x"]])
+    warn = [d for d in diags if d.check == "fed-and-fetched"]
+    assert len(warn) == 1 and warn[0].severity == "warning" and "'x'" in warn[0].message
+
+
+def test_donated_bucket_read_warning_and_aliased_opt_state():
+    paddle.set_flags({"FLAGS_fused_optimizer": True})
+    try:
+        main = static.Program()
+        with static.program_guard(main, static.Program()):
+            x = static.data("x", [4, 3], "float32")
+            lin = paddle.nn.Linear(3, 1)
+            loss = (lin(x) ** 2).mean()
+            opt = paddle.optimizer.AdamW(0.01, parameters=lin.parameters())
+            opt.minimize(loss)
+    finally:
+        paddle.set_flags({"FLAGS_fused_optimizer": False})
+    upd = main.opt_updates[0]
+    assert type(upd).__name__ == "_FusedAdamWUpdate"
+    # simulate user code reading the donated flat bucket during capture:
+    # the bucket Tensor becomes a program input read by an op
+    bucket = upd.accum_tensors[0]
+    vid = main.var_of(bucket)
+    from paddle_tpu.static.program import OpInstr
+
+    out = main._new_var(paddle.to_tensor(np.zeros(4, "float32")))
+    main.ops.append(OpInstr("mul", lambda a: a * 2, [("var", vid)], {}, [out]))
+    diags = verify(main, raise_on_error=False)
+    d = next(d for d in diags if d.check == "donated-bucket-read")
+    assert d.severity == "warning" and f"%v{vid}" in d.message
+
+    # aliased accumulator state between two updates is an ERROR
+    import copy
+
+    main.opt_updates.append(copy.copy(upd))  # shares accum_tensors objects
+    with pytest.raises(ProgramVerifyError) as ei:
+        verify(main)
+    assert "aliased-opt-state" in _checks(ei.value.diagnostics)
+
+
+def test_to_static_donated_state_alias_named():
+    """Two state tensors sharing ONE buffer would be donated twice; the
+    lowering check names them instead of XLA's anonymous rejection."""
+    lin = paddle.nn.Linear(4, 4)
+    tied = paddle.nn.Linear(4, 4)
+    tied.weight._value = lin.weight._value  # alias one underlying buffer
+
+    @paddle.jit.to_static
+    def f(x):
+        return tied(lin(x))
+
+    x = paddle.to_tensor(np.ones((2, 4), "float32"))
+    f(x)  # recording run (eager)
+    with pytest.raises(ProgramVerifyError, match="donated-state-alias"):
+        f(x)  # compiled path: donation-safety check fires before lowering
+    # EVERY later call must re-check too (a stale half-built jit wrapper
+    # would skip straight into XLA's anonymous duplicate-donation error)
+    with pytest.raises(ProgramVerifyError, match="donated-state-alias"):
+        f(x)
+
+
+# ---------------------------------------------------------------------------
+# ProgramGraph + to_text
+# ---------------------------------------------------------------------------
+
+def test_program_graph_def_use():
+    main, x, y = _simple_program()
+    yv = main._id2var[id(y)]
+    g = ProgramGraph(main, fetch_vars=[yv])
+    xv = main.feed_vars["x"]
+    assert g.def_of(xv).kind == "feed"
+    assert any(site == "op" for site, _, _ in g.uses_of(xv))
+    assert g.def_of(yv).kind == "op" and g.def_of(yv).def_op == 1
+    assert ("fetch", 0, 0) in g.uses_of(yv)
+    assert g.def_of(yv).shape == (2, 2) and g.def_of(yv).dtype == "float32"
+
+
+def test_to_text_empty_and_partial_programs():
+    # empty: no ops, no feeds — renders, no KeyError
+    empty = static.Program()
+    text = empty.to_text()
+    assert text.startswith("program {") and "0 ops" in text
+    assert repr(empty) == text
+    # feeds only (partially recorded)
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        static.data("x", [-1, 4], "float32")
+    t2 = main.to_text()
+    assert "feed  %v0 'x' : float32[-1, 4]" in t2
+    assert describe_program(main) == t2
+
+
+def test_to_text_full_program_stable_format():
+    main, x, y = _simple_program()
+    yv = main._id2var[id(y)]
+    text = main.to_text(fetch_vars=[yv])
+    assert "feed  %v0 'x' : float32[2, 3]" in text
+    assert "# op#0" in text and "# op#1" in text
+    assert f"fetch %v{yv}" in text
+    # stable: rendering twice is identical (no ids/addresses leak)
+    assert text == main.to_text(fetch_vars=[yv])
+    # training program renders grad + opt lines
+    main2 = static.Program()
+    with static.program_guard(main2, static.Program()):
+        a = static.data("a", [2, 2], "float32")
+        lin = paddle.nn.Linear(2, 1)
+        loss = lin(a).sum()
+        paddle.optimizer.SGD(0.1, parameters=lin.parameters()).minimize(loss)
+    t = main2.to_text()
+    assert "grad [" in t and "opt OptUpdate" in t
+
+
+# ---------------------------------------------------------------------------
+# dead-op elimination
+# ---------------------------------------------------------------------------
+
+def test_dce_removes_dead_ops_bit_identical():
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        x = static.data("x", [2, 4], "float32")
+        lin = paddle.nn.Linear(4, 2)
+        y = lin(x) + 1.0
+        dead = paddle.nn.functional.softmax(y) * 3.0  # two dead ops
+    exe = static.Executor()
+    xv = np.random.RandomState(0).randn(2, 4).astype("float32")
+    (before,) = exe.run(main, feed={"x": xv}, fetch_list=[y])
+    c0 = _counter_value("paddle_tpu_program_dce_removed_ops_total")
+    removed = dead_op_elimination(main, fetch_list=[y])
+    assert removed == 2
+    (after,) = exe.run(main, feed={"x": xv}, fetch_list=[y])
+    np.testing.assert_array_equal(np.asarray(before), np.asarray(after))
+    assert _counter_value("paddle_tpu_program_dce_removed_ops_total") == c0 + 2
+    # the pruned program still verifies clean
+    assert verify(main, feed_names=["x"], fetch_vars=[main._id2var[id(y)]]) == []
+
+
+def test_dce_keeps_effectful_and_grad_opt_roots():
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        x = static.data("x", [4, 3], "float32")
+        lin = paddle.nn.Linear(3, 1)
+        loss = (lin(x) ** 2).mean()
+        static.Print(loss, message="loss:")  # effectful, output unfetched
+        opt = paddle.optimizer.SGD(0.1, parameters=lin.parameters())
+        opt.minimize(loss)
+    n_ops = len(main.ops)
+    removed = dead_op_elimination(main, fetch_list=[loss])
+    # nothing feeding loss/grads may go, and print survives by effect
+    assert removed == 0 and len(main.ops) == n_ops
+    assert any(op.name == "print_op" for op in main.ops)
+    exe = static.Executor()
+    w0 = lin.weight.numpy().copy()
+    exe.run(main, feed={"x": np.ones((4, 3), "float32")}, fetch_list=[loss])
+    assert np.abs(lin.weight.numpy() - w0).max() > 0  # update still ran
+
+
+def test_dce_llama_eager_converted_bit_identity():
+    """Acceptance: DCE on an eager-converted Llama program removes >0 dead
+    ops (the recorded-but-unfetched training-loss forward) with
+    bit-identical fetch outputs."""
+    from paddle_tpu.models.llama import LlamaForCausalLM
+
+    model = LlamaForCausalLM(
+        vocab_size=64, hidden_size=32, num_hidden_layers=1,
+        num_attention_heads=4, intermediate_size=48,
+    )
+    model.eval()
+    ids_np = (np.arange(8, dtype="int64") % 64).reshape(1, 8)
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        ids = static.data("ids", [1, 8], "int64")
+        labels = static.data("labels", [1, 8], "int64")
+        logits = model(ids)
+        loss, _ = model(ids, labels=labels)  # recorded, never fetched
+    exe = static.Executor()
+    (before,) = exe.run(
+        main, feed={"ids": ids_np, "labels": ids_np}, fetch_list=[logits])
+    removed = dead_op_elimination(main, fetch_list=[logits])
+    assert removed > 0
+    assert verify(main, fetch_vars=[main._id2var[id(logits)]]) == []
+    # the labels feed is dead now too: feeding only ids must pass coverage
+    (after,) = exe.run(main, feed={"ids": ids_np}, fetch_list=[logits])
+    np.testing.assert_array_equal(np.asarray(before), np.asarray(after))
+
+
+def test_dce_rejects_unknown_int_fetch_vid():
+    main, x, y = _simple_program()
+    with pytest.raises(ValueError, match="fetch var id 9999"):
+        dead_op_elimination(main, fetch_list=[9999])
+    assert len(main.ops) == 2  # nothing was removed
+
+
+def test_verify_telemetry_counters_snapshot():
+    runs0 = _counter_value("paddle_tpu_program_verify_runs_total")
+    bad0 = _counter_value(
+        "paddle_tpu_program_verify_diagnostics_total", check="undefined-var")
+    main, x, y = _simple_program()
+    verify(main)  # clean run
+    main.ops[0].in_refs[0] = ("var", 31337)
+    with pytest.raises(ProgramVerifyError):
+        verify(main)
+    assert _counter_value("paddle_tpu_program_verify_runs_total") == runs0 + 2
+    assert _counter_value(
+        "paddle_tpu_program_verify_diagnostics_total", check="undefined-var"
+    ) == bad0 + 1
+    hist = telemetry.default_registry().get("paddle_tpu_program_verify_seconds")
+    assert hist is not None and hist.count >= 2
+
+
+# ---------------------------------------------------------------------------
+# trace lint
+# ---------------------------------------------------------------------------
+
+BAD_FIXTURE = textwrap.dedent(
+    '''
+    import functools
+    import jax
+    import jax.numpy as jnp
+
+    _CACHE = {}
+    _TABLE = jnp.arange(8)          # TL002: import-time jnp
+    _TABLE2: object = jnp.ones(4)   # TL002: annotated assignment too
+
+    @functools.lru_cache(maxsize=4)
+    def tables(n):
+        return jnp.zeros(n), jnp.ones(n)   # TL001 x2: cached jnp values
+
+    @functools.lru_cache(maxsize=4)
+    def jit_factory(n):
+        def f(x):
+            return jnp.sum(x) * n          # nested def: NOT flagged
+        return jax.jit(f)
+
+    def remember(t):
+        _CACHE[id(t)] = 1                  # TL003: id-keyed global store
+
+    def local_ok(t):
+        local = {}
+        local[id(t)] = t                   # local dict: NOT flagged
+        return local
+
+    def branchy(x):
+        if not jnp.any(x > 0):             # TL004 (reported ONCE, not per context)
+            return x
+        while jnp.all(x < 1):              # TL004
+            x = x + 1
+        return bool(jnp.isnan(x).any())    # TL004
+
+    def meta_ok(x):
+        if jnp.issubdtype(x.dtype, jnp.floating):  # metadata-safe: NOT flagged
+            return jnp.ndim(x)
+        return 0
+    '''
+)
+
+
+def _lint(tmp_path, source, name="fixture.py", baseline=None):
+    from tools import trace_lint
+
+    p = tmp_path / name
+    p.write_text(source)
+    return trace_lint.lint_paths([str(p)], baseline=baseline, root=str(tmp_path))
+
+
+def test_trace_lint_catches_each_rule(tmp_path):
+    unsup, sup, unused = _lint(tmp_path, BAD_FIXTURE)
+    rules = [f.rule for f in unsup]
+    assert rules.count("TL001") == 2
+    assert rules.count("TL002") == 2  # plain + annotated assignment
+    assert rules.count("TL003") == 1
+    # exactly 3: if/while/bool sites — the nested `not` must NOT double-report
+    assert rules.count("TL004") == 3
+    assert sup == [] and unused == []
+    # safe patterns stayed clean
+    assert not any(f.qualname in ("jit_factory", "local_ok", "meta_ok") for f in unsup)
+    # TL001 findings are attributed to the cached FUNCTION (baseline keys
+    # are per-function), not the enclosing module scope
+    assert all(f.qualname == "tables" for f in unsup if f.rule == "TL001")
+
+
+def test_trace_lint_inline_suppression(tmp_path):
+    src = (
+        "import jax.numpy as jnp\n"
+        "def f(x):\n"
+        "    if jnp.any(x):  # trace-lint: ignore[TL004] -- eager-only helper\n"
+        "        return 1\n"
+        "    return 0\n"
+    )
+    unsup, _, _ = _lint(tmp_path, src)
+    assert unsup == []
+
+
+def test_trace_lint_baseline_suppression_and_justification(tmp_path):
+    from tools import trace_lint
+
+    src = "import jax.numpy as jnp\ndef f(x):\n    return bool(jnp.any(x))\n"
+    baseline = {("mod.py", "TL004", "f"): "eager-only"}
+    unsup, sup, unused = _lint(tmp_path, src, name="mod.py", baseline=baseline)
+    assert unsup == [] and len(sup) == 1 and unused == []
+    # stale entries are reported back
+    _, _, unused2 = _lint(
+        tmp_path, "x = 1\n", name="clean.py",
+        baseline={("clean.py", "TL001", "gone"): "stale"})
+    assert unused2 == [("clean.py", "TL001", "gone")]
+    # a baseline entry without justification is rejected
+    bad = tmp_path / "baseline.txt"
+    bad.write_text("mod.py::TL004::f\n")
+    with pytest.raises(trace_lint.BaselineError, match="justification"):
+        trace_lint.load_baseline(str(bad))
+
+
+def test_trace_lint_tree_is_clean():
+    """Tier-1 gate: the shipped tree has zero unsuppressed trace hazards —
+    new ones are un-shippable. Runs the real CLI exactly as CI would."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.trace_lint", "paddle_tpu"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, f"trace_lint found hazards:\n{proc.stdout}{proc.stderr}"
+    assert "0 finding(s)" in proc.stdout

@@ -1,0 +1,2 @@
+# makes tools/ importable so `python -m tools.trace_lint` and
+# `python -m tools.perf_gate` resolve from a repo-root checkout

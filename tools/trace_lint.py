@@ -1,0 +1,537 @@
+"""trace_lint — AST linter for jax trace-hazard patterns this repo has hit.
+
+Every rule encodes a defect class that actually shipped (or nearly did)
+here before being found the hard way at runtime:
+
+  TL001 cached-jnp-value     an lru_cache/cache-decorated function computes
+                             jnp values directly in its body. A jnp value
+                             created INSIDE a jax trace is a tracer; caching
+                             it leaks the tracer across trace boundaries
+                             (PR 8's `_rope_tables` bug — the fix caches
+                             NUMPY and jnp.asarray's at the call site).
+                             Nested `def`s are exempt: caching a jit-wrapped
+                             CALLABLE keyed by static args is the sanctioned
+                             pattern (distributed/collective.py).
+  TL002 module-level-jnp     jnp computation at module import time (module
+                             globals, decorator args, default args). Runs
+                             before any device/mesh setup, allocates on the
+                             wrong backend, and a module-global jax array is
+                             a process-lifetime HBM pin no pass can free.
+  TL003 id-keyed-global-cache a store keyed by `id(obj)` into a MODULE-LEVEL
+                             container. id() is reused after GC, so a global
+                             id-keyed cache that does not also keep the
+                             object alive serves stale hits for a recycled
+                             address. (Instance-attribute caches whose
+                             lifetime matches their keys are not flagged.)
+  TL004 tracer-truth-test    Python truth-testing (`if`/`while`/`assert`/
+                             `bool()`/`not`) directly over a jnp call
+                             result. Under to_static/jit tracing the value
+                             is a tracer and the branch raises
+                             TracerBoolConversionError — or worse, bakes
+                             one branch silently when run under
+                             `jax.disable_jit`. Metadata-level jnp calls
+                             (issubdtype, result_type, ndim, ...) are
+                             trace-safe and exempt.
+
+Suppression:
+  inline   — append `# trace-lint: ignore[TL00X] -- why` on the flagged line
+  baseline — tools/trace_lint_baseline.txt, one entry per line:
+                 <relpath>::<rule>::<enclosing-qualname>  # justification
+             the justification comment is REQUIRED (entries without one are
+             a lint error themselves); unmatched entries warn but don't fail.
+
+Usage:
+  python -m tools.trace_lint paddle_tpu [more paths] [--baseline FILE]
+         [--no-baseline]
+Exit 0 when every finding is suppressed; 1 otherwise (CI gates on this).
+"""
+from __future__ import annotations
+
+import argparse
+import ast
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Set, Tuple
+
+RULES = {
+    "TL000": "parse-error",  # unparseable file: nothing was checked — never suppressible
+    "TL001": "cached-jnp-value",
+    "TL002": "module-level-jnp",
+    "TL003": "id-keyed-global-cache",
+    "TL004": "tracer-truth-test",
+}
+
+# jnp attributes that return static (non-tracer) metadata — safe to cache,
+# compute at import, or branch on
+METADATA_SAFE = frozenset({
+    "issubdtype", "isdtype", "result_type", "promote_types", "ndim",
+    "shape", "dtype", "finfo", "iinfo", "size", "iscomplexobj",
+})
+
+_INLINE_RE = re.compile(r"trace-lint:\s*ignore\[([A-Z0-9, ]+)\]")
+
+
+class Finding:
+    __slots__ = ("path", "relpath", "line", "col", "rule", "qualname", "message")
+
+    def __init__(self, path, relpath, line, col, rule, qualname, message):
+        self.path = path
+        self.relpath = relpath
+        self.line = line
+        self.col = col
+        self.rule = rule
+        self.qualname = qualname
+        self.message = message
+
+    def key(self):
+        return (self.relpath, self.rule, self.qualname)
+
+    def __str__(self):
+        return (
+            f"{self.relpath}:{self.line}:{self.col}: {self.rule} "
+            f"{RULES[self.rule]} (in {self.qualname}): {self.message}"
+        )
+
+
+class _ModuleLinter(ast.NodeVisitor):
+    def __init__(self, path: str, relpath: str, src: str):
+        self.path = path
+        self.relpath = relpath
+        self.lines = src.splitlines()
+        self.findings: List[Finding] = []
+        self.jnp_aliases: Set[str] = set()   # names bound to jax.numpy
+        self.jax_aliases: Set[str] = set()   # names bound to jax
+        self.module_globals: Set[str] = set()
+        self.scope: List[str] = []           # enclosing def/class names
+        self.func_depth = 0                  # >0 inside a function body
+
+    # ---- helpers ----
+    def qualname(self) -> str:
+        return ".".join(self.scope) if self.scope else "<module>"
+
+    def report(self, node, rule, message):
+        self.findings.append(Finding(
+            self.path, self.relpath, node.lineno, node.col_offset,
+            rule, self.qualname(), message,
+        ))
+
+    def _jnp_attr(self, node) -> Optional[str]:
+        """If `node` is an Attribute path rooted at a jax.numpy alias
+        (jnp.X, jnp.linalg.X, jax.numpy.X), return the FINAL attr name."""
+        if not isinstance(node, ast.Attribute):
+            return None
+        parts = []
+        cur = node
+        while isinstance(cur, ast.Attribute):
+            parts.append(cur.attr)
+            cur = cur.value
+        if not isinstance(cur, ast.Name):
+            return None
+        root = cur.id
+        if root in self.jnp_aliases:
+            return parts[0]
+        if root in self.jax_aliases and parts and parts[-1] == "numpy":
+            return parts[0]
+        return None
+
+    def _jnp_calls_in(self, node, skip_nested=True):
+        """Yield (call_node, attr) for every non-metadata jnp call under
+        `node`, optionally not descending into nested function bodies. A
+        Lambda is deferred-execution even as the ROOT (e.g. a lambda default
+        arg runs at call time, not import time), so its body never counts."""
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            if skip_nested and isinstance(
+                n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ) and (n is not node or isinstance(n, ast.Lambda)):
+                continue
+            if isinstance(n, ast.Call):
+                attr = self._jnp_attr(n.func)
+                if attr is not None and attr not in METADATA_SAFE:
+                    yield n, attr
+            stack.extend(ast.iter_child_nodes(n))
+
+    def _suppressed_inline(self, finding: Finding) -> bool:
+        if 1 <= finding.line <= len(self.lines):
+            m = _INLINE_RE.search(self.lines[finding.line - 1])
+            if m:
+                rules = {r.strip() for r in m.group(1).split(",")}
+                return finding.rule in rules
+        return False
+
+    # ---- pre-pass: imports + module globals ----
+    def collect_module_scope(self, tree: ast.Module):
+        for node in tree.body:
+            self._collect_stmt(node)
+        # jnp/jax aliases bind anywhere — the repo commonly does a
+        # function-LOCAL `import jax.numpy as jnp`, and a hazard inside such
+        # a function must not be invisible to the rules (aliases are tracked
+        # per-module, which can only over-approximate: fine for a linter)
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                self._collect_imports(node)
+
+    def check_module_body(self, tree: ast.Module):
+        """TL002 over every module-level statement (Assign, AnnAssign, Expr,
+        For, If, With, ...): anything that is not a def/class/import runs at
+        import time, so one walk covers all statement kinds instead of a
+        per-visitor list that misses shapes like annotated assignments.
+        def/class statements are excluded here; their decorators and default
+        args (also import-time) are checked by _function/visit_ClassDef."""
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef, ast.Import, ast.ImportFrom)):
+                continue
+            self._check_import_time(node)
+
+    def _collect_imports(self, node):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                name = a.asname or a.name.split(".")[0]
+                if a.name == "jax.numpy":
+                    (self.jnp_aliases if a.asname else self.jax_aliases).add(name)
+                elif a.name == "jax" or a.name.startswith("jax."):
+                    self.jax_aliases.add(name)
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "jax":
+                for a in node.names:
+                    if a.name == "numpy":
+                        self.jnp_aliases.add(a.asname or a.name)
+            # from jax.numpy import X — bare X calls are too alias-heavy to
+            # track; the repo convention is jnp.
+
+    def _collect_stmt(self, node):
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            for t in targets:
+                # `_cache, _lock = {}, Lock()` binds module globals too —
+                # walk Tuple/List/Starred targets down to their Names
+                for el in ast.walk(t):
+                    if isinstance(el, ast.Name):
+                        self.module_globals.add(el.id)
+        elif isinstance(node, (ast.If, ast.Try, ast.For, ast.While, ast.With)):
+            # module globals are assigned inside all of these compound
+            # statements too (e.g. `with _lock: _cache = {}`, or the
+            # `except ImportError: _cache = {}` fallback idiom — except
+            # handlers are not stmt children, recurse into their bodies)
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.stmt):
+                    self._collect_stmt(child)
+                elif isinstance(child, ast.ExceptHandler):
+                    for sub in child.body:
+                        self._collect_stmt(sub)
+        elif isinstance(node, ast.ClassDef):
+            self.module_globals.add(node.name)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self.module_globals.add(node.name)
+
+    # ---- rule machinery ----
+    def _is_cache_decorator(self, dec) -> bool:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        parts = []
+        cur = target
+        while isinstance(cur, ast.Attribute):
+            parts.append(cur.attr)
+            cur = cur.value
+        if isinstance(cur, ast.Name):
+            parts.append(cur.id)
+        dotted = ".".join(reversed(parts))
+        return dotted in (
+            "lru_cache", "cache", "functools.lru_cache", "functools.cache",
+        )
+
+    def _check_import_time(self, node):
+        """TL002 at module depth: decorators/defaults/module statements."""
+        for call, attr in self._jnp_calls_in(node, skip_nested=True):
+            self.report(
+                call, "TL002",
+                f"jnp.{attr}(...) runs at module import time — the value "
+                f"lives for the process (wrong backend, un-freeable HBM pin); "
+                f"compute it lazily inside the caller",
+            )
+
+    def visit_FunctionDef(self, node):
+        self._function(node)
+
+    def visit_AsyncFunctionDef(self, node):
+        self._function(node)
+
+    def _function(self, node):
+        if self.func_depth == 0:
+            # decorators + default args evaluate at import time
+            for dec in node.decorator_list:
+                self._check_import_time(dec)
+            for default in list(node.args.defaults) + [
+                d for d in node.args.kw_defaults if d is not None
+            ]:
+                self._check_import_time(default)
+        cached = any(self._is_cache_decorator(d) for d in node.decorator_list)
+        self.scope.append(node.name)
+        self.func_depth += 1
+        if cached:
+            # only the BODY is cached: decorator args/defaults run once at
+            # import (TL002's business), and nested defs are the sanctioned
+            # jit-factory pattern
+            for stmt in node.body:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                for call, attr in self._jnp_calls_in(stmt, skip_nested=True):
+                    self.report(
+                        call, "TL001",
+                        f"jnp.{attr}(...) computed inside lru_cache'd "
+                        f"'{node.name}' — if first called inside a trace the "
+                        f"cache pins a TRACER; cache numpy and jnp.asarray at "
+                        f"the call site (or cache a jitted callable via a "
+                        f"nested def)",
+                    )
+        self.generic_visit(node)
+        self.func_depth -= 1
+        self.scope.pop()
+
+    def visit_ClassDef(self, node):
+        if self.func_depth == 0:
+            for dec in node.decorator_list:
+                self._check_import_time(dec)
+            # class bodies execute at import time too
+            for stmt in node.body:
+                if not isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                         ast.ClassDef)):
+                    self._check_import_time(stmt)
+        self.scope.append(node.name)
+        self.generic_visit(node)
+        self.scope.pop()
+
+    def visit_Assign(self, node):
+        self._check_id_key_store_targets(node.targets)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        self._check_id_key_store_targets([node.target])
+        self.generic_visit(node)
+
+    # ---- TL003: id()-keyed stores into module globals ----
+    def _base_name(self, node) -> Optional[str]:
+        cur = node
+        while isinstance(cur, (ast.Subscript, ast.Attribute)):
+            cur = cur.value
+        return cur.id if isinstance(cur, ast.Name) else None
+
+    def _is_id_call(self, node) -> bool:
+        return (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "id"
+        )
+
+    def _check_id_key_store_targets(self, targets):
+        for t in targets:
+            if isinstance(t, ast.Subscript) and self._is_id_call(t.slice):
+                base = self._base_name(t.value)
+                if base in self.module_globals:
+                    self.report(
+                        t, "TL003",
+                        f"store keyed by id(...) into module-level "
+                        f"'{base}' — id() is recycled after GC; a global "
+                        f"id-keyed cache must also keep its keys alive "
+                        f"(or key by a stable identity)",
+                    )
+
+    def visit_Call(self, node):
+        # d.setdefault(id(x), ...) into a module global
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "setdefault"
+            and node.args
+            and self._is_id_call(node.args[0])
+        ):
+            base = self._base_name(node.func.value)
+            if base in self.module_globals:
+                self.report(
+                    node, "TL003",
+                    f"setdefault keyed by id(...) into module-level "
+                    f"'{base}' — id() is recycled after GC; keep the keys "
+                    f"alive or key by a stable identity",
+                )
+        # bool(jnp...) truth coercion
+        if isinstance(node.func, ast.Name) and node.func.id == "bool" and node.args:
+            self._check_truth_expr(node.args[0], "bool()")
+        self.generic_visit(node)
+
+    # ---- TL004: truth contexts ----
+    def _check_truth_expr(self, expr, ctx):
+        for call, attr in self._jnp_calls_in(expr, skip_nested=True):
+            self.report(
+                call, "TL004",
+                f"{ctx} truth-tests jnp.{attr}(...) — under trace this is a "
+                f"tracer (TracerBoolConversionError); hoist the check out of "
+                f"traced paths, use lax.cond, or read a concrete value "
+                f"explicitly",
+            )
+
+    def visit_If(self, node):
+        self._check_truth_expr(node.test, "if")
+        self.generic_visit(node)
+
+    def visit_While(self, node):
+        self._check_truth_expr(node.test, "while")
+        self.generic_visit(node)
+
+    def visit_Assert(self, node):
+        self._check_truth_expr(node.test, "assert")
+        self.generic_visit(node)
+
+    def visit_IfExp(self, node):
+        self._check_truth_expr(node.test, "conditional expression")
+        self.generic_visit(node)
+
+    def visit_UnaryOp(self, node):
+        if isinstance(node.op, ast.Not):
+            self._check_truth_expr(node.operand, "not")
+        self.generic_visit(node)
+
+
+def lint_file(path: str, relpath: str) -> List[Finding]:
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            src = f.read()
+    except OSError as e:
+        # keep the exit-0/1/2 contract: an unreadable path is a finding,
+        # not a traceback, and the remaining paths still get linted
+        return [Finding(path, relpath, 0, 0, "TL000", "<module>",
+                        f"cannot read file: {e.strerror or e}")]
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [Finding(path, relpath, e.lineno or 0, 0, "TL000", "<module>",
+                        f"file does not parse: {e.msg}")]
+    linter = _ModuleLinter(path, relpath, src)
+    linter.collect_module_scope(tree)
+    linter.check_module_body(tree)
+    linter.visit(tree)
+    # nested truth contexts (`if not jnp.any(x)`) hit multiple visitors;
+    # one hazard site reports once
+    seen, unique = set(), []
+    for f in linter.findings:
+        key = (f.rule, f.line, f.col)
+        if key not in seen:
+            seen.add(key)
+            unique.append(f)
+    return [f for f in unique if not linter._suppressed_inline(f)]
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "trace_lint_baseline.txt")
+
+
+class BaselineError(ValueError):
+    pass
+
+
+def load_baseline(path: str) -> Dict[Tuple[str, str, str], str]:
+    """relpath::rule::qualname -> justification. Entries WITHOUT a
+    `# justification` comment are rejected — the baseline is a reviewed
+    list of accepted hazards, not a mute button."""
+    entries = {}
+    if not os.path.exists(path):
+        return entries
+    with open(path, "r", encoding="utf-8") as f:
+        for ln, raw in enumerate(f, 1):
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            if "#" in line:
+                entry, justification = line.split("#", 1)
+                justification = justification.strip()
+            else:
+                entry, justification = line, ""
+            if not justification:
+                raise BaselineError(
+                    f"{path}:{ln}: baseline entry has no '# justification' "
+                    f"comment — every accepted hazard needs one line of why"
+                )
+            parts = [p.strip() for p in entry.strip().split("::")]
+            if len(parts) != 3 or parts[1] not in RULES or parts[1] == "TL000":
+                raise BaselineError(
+                    f"{path}:{ln}: malformed entry {entry.strip()!r} "
+                    f"(want <relpath>::<TL00X>::<qualname>; TL000 parse "
+                    f"errors are not suppressible)"
+                )
+            entries[(parts[0].replace(os.sep, "/"), parts[1], parts[2])] = justification
+    return entries
+
+
+def lint_paths(paths, baseline: Optional[dict] = None, root: Optional[str] = None):
+    """Lint files/dirs; returns (unsuppressed, suppressed, unused_baseline).
+    `root` anchors relpaths (default: cwd) so baseline entries are stable."""
+    root = os.path.abspath(root or os.getcwd())
+    baseline = baseline or {}
+    files = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, _dirnames, filenames in os.walk(p):
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        files.append(os.path.join(dirpath, fn))
+        else:
+            files.append(p)
+    unsuppressed, suppressed = [], []
+    matched_keys = set()
+    for f in sorted(files):
+        rel = os.path.relpath(os.path.abspath(f), root).replace(os.sep, "/")
+        for finding in lint_file(f, rel):
+            # a parse failure means NOTHING in the file was checked — it can
+            # never be baselined away
+            if finding.rule != "TL000" and finding.key() in baseline:
+                matched_keys.add(finding.key())
+                suppressed.append(finding)
+            else:
+                unsuppressed.append(finding)
+    unused = [k for k in baseline if k not in matched_keys]
+    return unsuppressed, suppressed, unused
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="trace_lint", description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="+", help="files or directories to lint")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="suppression baseline file (default: %(default)s)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline (report everything)")
+    ap.add_argument("--root", default=None,
+                    help="directory baseline relpaths are anchored at "
+                         "(default: the baseline file's repo root, so "
+                         "results are cwd-independent)")
+    args = ap.parse_args(argv)
+
+    try:
+        baseline = {} if args.no_baseline else load_baseline(args.baseline)
+    except BaselineError as e:
+        print(f"trace_lint: {e}", file=sys.stderr)
+        return 2
+    # anchor relpaths at the repo the baseline belongs to (tools/..), NOT
+    # the invoker's cwd — otherwise running from anywhere else turns every
+    # baselined hazard into a spurious new finding
+    root = args.root or os.path.dirname(
+        os.path.dirname(os.path.abspath(args.baseline)))
+    unsuppressed, suppressed, unused = lint_paths(args.paths, baseline, root=root)
+    for f in unsuppressed:
+        print(f)
+    for key in unused:
+        print(f"trace_lint: warning: unused baseline entry "
+              f"{key[0]}::{key[1]}::{key[2]}", file=sys.stderr)
+    print(
+        f"trace_lint: {len(unsuppressed)} finding(s), "
+        f"{len(suppressed)} baselined, over {len(args.paths)} path(s)"
+    )
+    return 1 if unsuppressed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
