@@ -130,6 +130,7 @@ def _remaining():
 # the remaining budget as their subprocess timeout instead)
 _EST_S = {
     "peak": 60,
+    "passes": 30,
     "seq128": 240,
     "ocr": 90,
     "input_stream": 90,
@@ -281,6 +282,74 @@ def _attribution(dt_step_s, origin="to_static", combine_last=1):
         return out
     except Exception as e:  # noqa: BLE001 — attribution must never kill a config
         return {"attribution": "unavailable", "error": str(e)[-200:]}
+
+
+def _measure_passes():
+    """Round 15: the graph-pass pipeline probe. An eager-converted
+    tiny-Llama capture (capture_program — ZERO model-code changes) runs the
+    static.passes default pipeline; the record carries per-pass match /
+    rewritten-op counts (GATED by tools/perf_gate.py: a pattern silently
+    un-matching is a fusion-coverage regression, exit 1), the measured
+    pipeline wall time per compile-miss, and an outputs_identical bit from
+    compiling the same capture with FLAGS_program_passes on vs off."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import static
+    from paddle_tpu.jit import capture_program
+    from paddle_tpu.models.llama import LlamaForCausalLM
+    from paddle_tpu.static import passes as passes_mod
+
+    dims = {
+        "vocab_size": 256, "hidden_size": 64, "num_hidden_layers": 2,
+        "num_attention_heads": 4, "intermediate_size": 176,
+    }
+    batch, seq = 1, 16
+    model = LlamaForCausalLM(**dims)
+    model.eval()
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(
+        rng.randint(0, dims["vocab_size"], (batch, seq)).astype(np.int64)
+    )
+    program, feed_names, fetch_list = capture_program(
+        model, ids, feed_names=["ids"]
+    )
+    fetch_vid = program.resolve_fetch(fetch_list[0])
+    # pipeline cost per compile-miss: best of 3 (clone + full pipeline +
+    # per-pass/post verify — exactly what Executor._compile pays on a miss)
+    best = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        _work, res = passes_mod.run_default_pipeline(
+            program, fetch_vars=[fetch_vid], feed_names=feed_names
+        )
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    exe = static.Executor()
+    feed = {"ids": ids.numpy()}
+    (on,) = exe.run(program, feed=feed, fetch_list=fetch_list)
+    paddle.set_flags({"FLAGS_program_passes": False})
+    try:
+        (off,) = exe.run(program, feed=feed, fetch_list=fetch_list)
+    finally:
+        paddle.set_flags({"FLAGS_program_passes": True})
+    return {
+        "passes_dims": {**dims, "batch": batch, "seq": seq},
+        "n_ops_recorded": len(program.ops),
+        "n_ops_after": len(_work.ops),
+        "pipeline_ms": round(best * 1000, 3),
+        "matches": res.matches,
+        "rewritten_ops": res.rewritten_ops,
+        "outputs_identical": bool(
+            np.array_equal(np.asarray(on), np.asarray(off))
+        ),
+        "note": (
+            "static.passes default pipeline over an eager-converted "
+            "tiny-Llama eval capture; matches counts are perf-gated "
+            "fusion coverage, pipeline_ms is the per-compile-miss cost "
+            "(incl. per-pass + post-pipeline verify)"
+        ),
+    }
 
 
 def _build(batch, seq, heads, max_pos, steps, attn_dropout=0.0):
@@ -1270,8 +1339,9 @@ class _Snapshot:
     not yet run (which the final state marks as explicit skips), never the
     ones already measured."""
 
-    CONFIGS = ("seq128", "seq4096", "llama3_shape", "resnet50", "ppocr_e2e",
-               "serving", "fleet", "input_stream", "moe_longcontext")
+    CONFIGS = ("seq128", "passes", "seq4096", "llama3_shape", "resnet50",
+               "ppocr_e2e", "serving", "fleet", "input_stream",
+               "moe_longcontext")
 
     def __init__(self):
         self.result = {
@@ -1419,6 +1489,20 @@ def main():
     else:
         detail["seq128"] = {"skipped": "deadline"}
         snap.resolve("seq128", "skipped:deadline")
+
+    # ---- graph-pass pipeline probe (round 15; in-parent, seconds-scale,
+    # CPU-capable — the fusion-coverage fields perf_gate gates) ----
+    if _remaining() >= _est("passes"):
+        try:
+            detail["passes"] = _measure_passes()
+            snap.resolve("passes", "measured")
+        except Exception as e:  # noqa: BLE001 — the capture must survive
+            print(f"bench passes failed: {e}", file=sys.stderr)
+            detail["passes"] = {"skipped": "error", "error": str(e)[-400:]}
+            snap.resolve("passes", "skipped:error")
+    else:
+        detail["passes"] = {"skipped": "deadline"}
+        snap.resolve("passes", "skipped:deadline")
 
     # ---- satellites, CHEAPEST-FIRST (ocr/input_stream 90s <
     # serving/resnet 180s < fleet/moe_longcontext/ernie4096 240s < llama):

@@ -103,6 +103,24 @@ define_flag(
     "naming the op/var instead of an XLA traceback; costs ~O(#ops) python "
     "per COMPILE (cache hits never re-verify)",
 )
+define_flag(
+    "FLAGS_program_passes",
+    True,
+    "run the static.passes rewrite pipeline (dead-op elimination, scalar "
+    "constant folding, redundant cast/reshape elimination, DRR fusion "
+    "patterns: attention cluster -> Pallas flash, norm+matmul, "
+    "bias+dropout+residual) over a CLONE of the recorded Program on every "
+    "Executor compile-miss and before program-export lowering; the "
+    "verifier re-runs after each rewriting pass. The caller's Program is "
+    "never mutated. Disable to replay the capture exactly as recorded",
+)
+define_flag(
+    "FLAGS_print_after_pass",
+    "",
+    "comma-separated pass names (or 'all') whose to_text() diff is printed "
+    "to stderr after the pass rewrites a program — the --print-after-pass "
+    "debugging surface of the pass pipeline; empty disables",
+)
 # Training guardian (framework/guardian.py): state-failure guards layered on
 # the PR 2 process/IO resilience — numerical anomaly policy, last-known-good
 # rollback ring, cross-rank desync digest, crash flight recorder.

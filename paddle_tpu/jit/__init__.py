@@ -1,5 +1,12 @@
 """paddle.jit namespace (python/paddle/jit/__init__.py)."""
-from .api import StaticFunction, cond, ignore_module, not_to_static, to_static  # noqa: F401
+from .api import (  # noqa: F401
+    StaticFunction,
+    capture_program,
+    cond,
+    ignore_module,
+    not_to_static,
+    to_static,
+)
 from .save_load import TranslatedLayer, load, save  # noqa: F401
 
 

@@ -546,6 +546,58 @@ def state_values(layer) -> dict:
     return {k: v._value for k, v in layer.state_dict().items()}
 
 
+def capture_program(function, *example_args, feed_names=None):
+    """Eager-convert `function` (a callable or Layer) into a recorded
+    static Program with ZERO model-code changes: one eager run under
+    program_guard with each example arg replaced by a static.data feed
+    placeholder of the same shape/dtype. Returns
+    (program, feed_names, fetch_list) ready for Executor.run — and for the
+    static.passes pipeline, which rewrites exactly this recorded form
+    (DCE, canonicalization, DRR fusion into the Pallas kernels).
+
+    This is the op-level ProgramTranslator counterpart of `to_static`
+    (which stages the same eager run straight into one jax.jit): to_static
+    gives you a compiled step, capture_program gives you the inspectable,
+    rewritable IR — `program.to_text()`, `verify()`, the pass pipeline.
+
+    `example_args` must be Tensors (or array-likes); outputs that are
+    Tensors recorded in the program become the fetch_list. `feed_names`
+    overrides the default arg0..argN placeholder names."""
+    from ..static import program as static_program
+
+    names = list(feed_names) if feed_names is not None else [
+        f"arg{i}" for i in range(len(example_args))
+    ]
+    if len(names) != len(example_args):
+        raise ValueError(
+            f"capture_program: {len(example_args)} example arg(s) but "
+            f"{len(names)} feed name(s)"
+        )
+    main = static_program.Program()
+    with static_program.program_guard(main, static_program.Program()):
+        feeds = []
+        for name, a in zip(names, example_args):
+            raw = a._value if isinstance(a, Tensor) else jnp.asarray(a)
+            feeds.append(
+                static_program.data(name, list(raw.shape), str(raw.dtype))
+            )
+            # the placeholder carries the EXAMPLE values, not zeros: the
+            # eager dry-run then computes real activations (value-dependent
+            # capture paths behave as they would on this input), and the
+            # harvested shape/dtype metadata is identical either way (jax
+            # arrays are immutable, so sharing the caller's buffer is safe)
+            feeds[-1]._value = raw
+        out = function(*feeds)
+    leaves, _ = tree_util.tree_flatten(
+        out, is_leaf=lambda x: isinstance(x, Tensor)
+    )
+    fetch_list = [
+        t for t in leaves
+        if isinstance(t, Tensor) and id(t) in main._id2var
+    ]
+    return main, names, fetch_list
+
+
 def not_to_static(fn):
     fn._paddle_not_to_static = True
     return fn

@@ -19,6 +19,7 @@ from .program import (  # noqa: F401
 )
 from ..ops.creation import create_parameter  # noqa: F401
 from . import analysis  # noqa: F401
+from . import passes  # noqa: F401
 from .analysis import (  # noqa: F401
     Diagnostic,
     ProgramVerifyError,

@@ -10,15 +10,15 @@ Layered over the recorded Program (static/program.py):
   fetch/grad/opt refs, op-output arity, donation hazards) run flag-gated
   (`FLAGS_verify_program`, default on) before `Executor._compile` and
   program-export lowering;
-- `dead_op_elimination` (dce.py): the first analysis-proven rewrite,
-  liveness walked backward from the fetch/grad/opt roots;
+- `dead_op_elimination` (dce.py): thin wrapper (fetch resolution +
+  validation) over the pipeline pass in static/passes/dce_pass.py;
 - donation checks (donation.py): fused-bucket read-after-donation,
   fed-and-fetched aliasing, duplicate donated buffers at to_static
   lowering.
 
-This is the substrate the ROADMAP's pass/fusion layer rewrites against:
-every future pattern-rewrite pass runs `verify` after itself and shows up
-in `to_text` diffs.
+This is the substrate `static.passes` (the pass/fusion layer) rewrites
+against: every pattern-rewrite pass runs `verify` after itself and shows
+up in `to_text` diffs.
 """
 from .dce import dead_op_elimination  # noqa: F401
 from .donation import check_donation, verify_donated_state  # noqa: F401

@@ -1,20 +1,15 @@
-"""Dead-op elimination: the first analysis-proven rewrite.
+"""Dead-op elimination: library entrypoint.
 
-Reference parity: paddle/fluid/pir/transforms/dead_code_elimination_pass.cc.
-TPU-native: XLA already DCEs the *lowered* jaxpr, but dead recorded ops
-still cost trace time on every (feed-shape, fetch-set) signature and
-pollute to_text dumps the pass layer diffs — eliminating them at the
-Program level is what makes `--print-after-pass` meaningful. Liveness is
-walked backward from the escape roots (fetches, grad requests, optimizer
-updates); effectful ops (print_op) and zero-output ops survive
-unconditionally. Removal is telemetry-counted and, by construction,
-bit-identical: a removed op's outputs are read by nothing live.
+The implementation now lives in static/passes/dce_pass.py, where it runs
+as pass #0 of the default pipeline (every compiled signature ships
+dead-op-free). This module keeps the public `dead_op_elimination` API as a
+thin wrapper: it resolves + validates fetch_list-style entries through THE
+shared policy (Program.resolve_fetch — liveness roots must match what a
+later exe.run resolves), then delegates.
 """
 from __future__ import annotations
 
 from typing import List
-
-from .graph import ProgramGraph
 
 
 def dead_op_elimination(program, fetch_list=None) -> int:
@@ -25,30 +20,9 @@ def dead_op_elimination(program, fetch_list=None) -> int:
     `fetch_list` entries may be Tensors recorded in the program or raw var
     ids; omitted, only grad/opt roots pin liveness (an inference program
     with no fetch list would lose everything — pass your fetches)."""
-    fetch_vars = _resolve_fetch(program, fetch_list)
-    graph = ProgramGraph(program, fetch_vars=fetch_vars)
-    mask = graph.live_ops()
-    removed = [op for op, live in zip(program.ops, mask) if not live]
-    if removed:
-        program.ops = [op for op, live in zip(program.ops, mask) if live]
-        # release the dead outputs' placeholder Tensors: the keepalive dict
-        # would otherwise pin their eagerly-evaluated activations (the
-        # largest arrays a capture holds) for the program's lifetime, and a
-        # stale vid must stop validating as a var of this program
-        for op in removed:
-            for vid in op.out_vars:
-                t = program._var_tensors.pop(vid, None)
-                if t is not None:
-                    program._id2var.pop(id(t), None)
-        program._compiled.clear()
-    from ... import telemetry as _tm
+    from ..passes.dce_pass import eliminate_dead_ops
 
-    if _tm.enabled():
-        _tm.counter(
-            "paddle_tpu_program_dce_removed_ops_total",
-            "recorded ops removed by dead-op elimination",
-        ).inc(len(removed))
-    return len(removed)
+    return eliminate_dead_ops(program, _resolve_fetch(program, fetch_list))
 
 
 def _resolve_fetch(program, fetch_list) -> List[int]:

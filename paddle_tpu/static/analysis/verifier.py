@@ -64,12 +64,17 @@ class Diagnostic:
 
 class ProgramVerifyError(ValueError):
     """Raised by verify() when error-severity diagnostics are found; carries
-    the full diagnostic list on `.diagnostics`."""
+    the full diagnostic list on `.diagnostics`. `context` names WHERE in a
+    pipeline the program went bad (e.g. "after pass 'fuse_attention'") —
+    the pass layer re-raises with it so a miscompiling rewrite is
+    attributed to its pass, not to verification in general."""
 
-    def __init__(self, diagnostics):
+    def __init__(self, diagnostics, context=None):
         self.diagnostics = list(diagnostics)
+        self.context = context
         errors = [d for d in self.diagnostics if d.severity == "error"]
-        lines = [f"Program verification failed ({len(errors)} error(s)):"]
+        where = f" {context}" if context else ""
+        lines = [f"Program verification failed{where} ({len(errors)} error(s)):"]
         lines += [f"  {d!r}" for d in self.diagnostics]
         lines.append("(set FLAGS_verify_program=0 to skip verification)")
         super().__init__("\n".join(lines))

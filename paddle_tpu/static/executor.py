@@ -208,10 +208,16 @@ class Executor:
 
     def _compile(self, program: Program, feed_names, fetch_vars):
         from .. import telemetry as _tm
+        from . import passes as _passes
 
         telemetry_on = _tm.enabled()
         structure = self._program_structure_key(program)
-        key = (feed_names, fetch_vars, structure)
+        # the pipeline flag is part of compiled identity: toggling
+        # FLAGS_program_passes must recompile, not replay the other mode's
+        # cached artifact (the flag's contract is "replay the capture
+        # exactly as recorded" when off)
+        passes_on = _passes.pipeline_enabled()
+        key = (feed_names, fetch_vars, structure, passes_on)
         hit = program._compiled.get(key)
         if telemetry_on:
             _tm.counter(
@@ -220,9 +226,15 @@ class Executor:
             ).labels(result="hit" if hit is not None else "miss").inc()
         if hit is not None:
             return hit
-        # evict entries for the same (feed, fetch) signature whose program
-        # structure went stale — they can never hit again
-        stale = [k for k in program._compiled if k[0] == feed_names and k[1] == fetch_vars]
+        # evict entries for the same (feed, fetch, passes-mode) signature
+        # whose program structure went stale — they can never hit again
+        # (the OTHER pipeline mode's entry stays valid: its structure is
+        # checked when that mode next runs)
+        stale = [
+            k for k in program._compiled
+            if k[0] == feed_names and k[1] == fetch_vars
+            and (len(k) < 4 or k[3] == passes_on)
+        ]
         for k in stale:
             del program._compiled[k]
         if stale and telemetry_on:
@@ -231,22 +243,37 @@ class Executor:
                 "stale compiled-program cache entries dropped on recompile",
             ).inc(len(stale))
 
-        # verify BEFORE lowering (flag-gated, compile-miss only): a malformed
-        # program fails here with a diagnostic naming the op/var, not as a
-        # KeyError/XLA traceback from inside the jit trace below
+        # verify BEFORE passes and lowering (flag-gated, compile-miss only):
+        # a malformed program fails here with a diagnostic naming the
+        # op/var, not as a KeyError/XLA traceback from inside the jit trace
+        # below. The pipeline then re-verifies after every rewriting pass
+        # and once more post-pipeline (a miscompiling pass fails with ITS
+        # name in the message), so the program that lowers is verified in
+        # exactly the form it replays.
         from .analysis import verifier as _verifier
 
         if _verifier.verify_enabled():
             _verifier.verify(program, feed_names=feed_names, fetch_vars=fetch_vars)
 
-        feed_var_ids = [program.feed_vars[n] for n in feed_names]
-        grad_requests = list(program.grad_requests)
-        opt_updates = list(program.opt_updates)
+        # pass pipeline (FLAGS_program_passes, default on): rewrite a CLONE
+        # per compiled signature — DCE prunes to THIS fetch set and fusion
+        # patterns collapse clusters, while the caller's Program keeps every
+        # recorded op for other signatures. param_vars/feed_vars/opt lists
+        # are shared verbatim, so run()'s marshalling stays aligned.
+        work = program
+        if passes_on:
+            work, _pass_result = _passes.run_default_pipeline(
+                program, fetch_vars=fetch_vars, feed_names=feed_names
+            )
+
+        feed_var_ids = [work.feed_vars[n] for n in feed_names]
+        grad_requests = list(work.grad_requests)
+        opt_updates = list(work.opt_updates)
 
         def forward_env(feed_arrays, param_arrays):
-            return program.replay_env(dict(zip(feed_var_ids, feed_arrays)), param_arrays)
+            return work.replay_env(dict(zip(feed_var_ids, feed_arrays)), param_arrays)
 
-        pos_of_param = {v: i for i, v in enumerate(program.param_vars)}
+        pos_of_param = {v: i for i, v in enumerate(work.param_vars)}
         updated_positions = sorted(
             {pos_of_param[pv] for u in opt_updates for pv in _update_params_of(u)}
         )

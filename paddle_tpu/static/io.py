@@ -64,10 +64,21 @@ def _export_program(feed_vars, fetch_vars, program):
     if _verifier.verify_enabled():
         _verifier.verify(program, feed_names=feed_names, fetch_vars=fetch_ids)
 
-    param_arrays = [program._var_tensors[v]._value for v in program.param_vars]
+    # pass pipeline before export lowering (FLAGS_program_passes): the
+    # frozen artifact ships the same dead-op-free, fusion-rewritten form
+    # the Executor compiles — rewritten on a clone, caller's program intact
+    from . import passes as _passes
+
+    work = program
+    if _passes.pipeline_enabled():
+        work, _pass_result = _passes.run_default_pipeline(
+            program, fetch_vars=fetch_ids, feed_names=feed_names
+        )
+
+    param_arrays = [work._var_tensors[v]._value for v in work.param_vars]
 
     def infer_fn(*feed_arrays):
-        env = program.replay_env(dict(zip(feed_ids, feed_arrays)), param_arrays)
+        env = work.replay_env(dict(zip(feed_ids, feed_arrays)), param_arrays)
         return tuple(env[v] for v in fetch_ids)
 
     # dynamic batch: feed placeholders keep their declared -1 dims

@@ -14,8 +14,9 @@ import subprocess
 import sys
 
 BENCH = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "bench.py")
-CONFIGS = {"seq128", "seq4096", "llama3_shape", "resnet50", "ppocr_e2e",
-           "serving", "fleet", "input_stream", "moe_longcontext"}
+CONFIGS = {"seq128", "passes", "seq4096", "llama3_shape", "resnet50",
+           "ppocr_e2e", "serving", "fleet", "input_stream",
+           "moe_longcontext"}
 
 
 def _run_bench(deadline_s):
@@ -80,6 +81,16 @@ def test_measured_config_carries_attribution():
     last = json.loads(r.stdout.strip().splitlines()[-1])
     assert last["detail"]["configs"]["seq128"] == "measured", last["detail"]["configs"]
     assert last["detail"]["dims_override"]["hidden"] == 64
+
+    # round-15 contract: the passes probe is measured in-parent and carries
+    # the gated fusion-coverage fields
+    assert last["detail"]["configs"]["passes"] == "measured", last["detail"]["configs"]
+    pblock = last["detail"]["passes"]
+    assert pblock["matches"]["fuse_attention"] >= 2
+    assert pblock["matches"]["fuse_norm_matmul"] >= 1
+    assert pblock["outputs_identical"] is True
+    assert pblock["pipeline_ms"] > 0
+    assert pblock["n_ops_after"] < pblock["n_ops_recorded"]
 
     attr = last["detail"]["attribution"]
     if attr.get("attribution") == "unavailable":

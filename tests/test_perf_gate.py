@@ -420,3 +420,79 @@ def test_fleet_explained_by_attributed_work(tmp_path):
     b = _write(tmp_path, "b.json", _with_fleet(swap_p99=7.5, flops=2.6e11))
     rc, out, err = _run(a, b)
     assert rc == 0, (out, err)
+
+
+# ---------------------------------------------------------------------------
+# round 15: detail.passes — fusion coverage is GATED, not just reported
+# ---------------------------------------------------------------------------
+
+def _with_passes(fa=2, fnm=1, identical=True, hidden=64, extra_matches=None):
+    c = _capture()
+    c["detail"]["configs"]["passes"] = "measured"
+    matches = {"dead_op_elimination": 0, "fuse_attention": fa,
+               "fuse_norm_matmul": fnm}
+    if extra_matches:
+        matches.update(extra_matches)
+    c["detail"]["passes"] = {
+        "passes_dims": {"vocab_size": 256, "hidden_size": hidden,
+                        "num_hidden_layers": 2, "batch": 1, "seq": 16},
+        "n_ops_recorded": 41, "n_ops_after": 38,
+        "pipeline_ms": 5.5,
+        "matches": matches,
+        "rewritten_ops": {k: v * 2 for k, v in matches.items()},
+        "outputs_identical": identical,
+    }
+    return c
+
+
+def test_passes_equal_coverage_passes(tmp_path):
+    a = _write(tmp_path, "a.json", _with_passes())
+    b = _write(tmp_path, "b.json", _with_passes())
+    rc, out, err = _run(a, b)
+    assert rc == 0, (out, err)
+
+
+def test_passes_match_count_drop_fails(tmp_path):
+    # the acceptance case: a pattern silently un-matching (fusion coverage
+    # falls 2 -> 0) exits 1 even though no time field moved
+    a = _write(tmp_path, "a.json", _with_passes(fa=2))
+    b = _write(tmp_path, "b.json", _with_passes(fa=0))
+    rc, out, err = _run(a, b)
+    assert rc == 1, (out, err)
+    assert "FUSION COVERAGE" in out and "fuse_attention" in out
+
+
+def test_passes_pattern_disappearing_fails(tmp_path):
+    # a pattern present in the baseline but absent from the candidate's
+    # matches dict counts as dropping to zero
+    a = _write(tmp_path, "a.json", _with_passes(extra_matches={"fuse_bias_dropout_residual": 1}))
+    b = _write(tmp_path, "b.json", _with_passes())
+    rc, out, err = _run(a, b)
+    assert rc == 1, (out, err)
+    assert "fuse_bias_dropout_residual" in out
+
+
+def test_passes_more_matches_is_progress(tmp_path):
+    # new patterns / higher counts never fail — coverage may only grow
+    a = _write(tmp_path, "a.json", _with_passes(fa=2))
+    b = _write(tmp_path, "b.json",
+               _with_passes(fa=3, extra_matches={"fuse_new_thing": 4}))
+    rc, out, err = _run(a, b)
+    assert rc == 0, (out, err)
+
+
+def test_passes_shape_change_not_compared(tmp_path):
+    # a different probe model legitimately matches a different count
+    a = _write(tmp_path, "a.json", _with_passes(fa=2, hidden=64))
+    b = _write(tmp_path, "b.json", _with_passes(fa=0, hidden=128))
+    rc, out, err = _run(a, b)
+    assert rc == 0, (out, err)
+    assert "workload changed" in out
+
+
+def test_passes_identity_flip_fails(tmp_path):
+    a = _write(tmp_path, "a.json", _with_passes(identical=True))
+    b = _write(tmp_path, "b.json", _with_passes(identical=False))
+    rc, out, err = _run(a, b)
+    assert rc == 1, (out, err)
+    assert "outputs_identical" in out

@@ -510,6 +510,52 @@ def test_trace_lint_baseline_suppression_and_justification(tmp_path):
         trace_lint.load_baseline(str(bad))
 
 
+def test_trace_lint_stale_baseline_fails_gate_with_entry_named(tmp_path, capsys):
+    """Round 15: a stale baseline entry (file/qualname no longer matches any
+    finding) FAILS the CI gate, naming the entry — a dead suppression is a
+    standing mute for a future regression."""
+    from tools import trace_lint
+
+    (tmp_path / "clean.py").write_text("x = 1\n")
+    bl = tmp_path / "baseline.txt"
+    bl.write_text("clean.py::TL004::gone  # was removed in a refactor\n")
+    rc = trace_lint.main([str(tmp_path / "clean.py"),
+                          "--baseline", str(bl), "--root", str(tmp_path)])
+    captured = capsys.readouterr()
+    assert rc == 1
+    assert "stale baseline entry clean.py::TL004::gone" in captured.err
+    assert "--prune" in captured.err  # the fix is advertised
+
+
+def test_trace_lint_prune_rewrites_baseline(tmp_path, capsys):
+    """--prune drops stale entries, keeps live ones (justifications and
+    comments verbatim), and the gate passes."""
+    from tools import trace_lint
+
+    src = "import jax.numpy as jnp\ndef f(x):\n    return bool(jnp.any(x))\n"
+    (tmp_path / "mod.py").write_text(src)
+    bl = tmp_path / "baseline.txt"
+    bl.write_text(
+        "# reviewed hazards\n"
+        "mod.py::TL004::f  # eager-only helper\n"
+        "mod.py::TL001::gone_fn  # stale: function was deleted\n"
+    )
+    rc = trace_lint.main(["--prune", str(tmp_path / "mod.py"),
+                          "--baseline", str(bl), "--root", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "pruned 1 stale baseline entry" in out
+    assert bl.read_text() == (
+        "# reviewed hazards\n"
+        "mod.py::TL004::f  # eager-only helper\n"
+    )
+    # idempotent: a second run has nothing to prune and still passes
+    rc2 = trace_lint.main(["--prune", str(tmp_path / "mod.py"),
+                           "--baseline", str(bl), "--root", str(tmp_path)])
+    assert rc2 == 0
+    assert bl.read_text().endswith("mod.py::TL004::f  # eager-only helper\n")
+
+
 def test_trace_lint_tree_is_clean():
     """Tier-1 gate: the shipped tree has zero unsuppressed trace hazards —
     new ones are un-shippable. Runs the real CLI exactly as CI would."""

@@ -1,0 +1,612 @@
+"""Graph pass & fusion framework (static/passes): golden to_text
+before/after dumps per shipped pass, DRR pattern matching + safety,
+deliberately-miscompiling mutant passes that verify() must catch (with the
+pass named), passes-on == passes-off identity on eager-converted tiny-Llama
+captures (eval AND train), Executor/export integration, per-pass
+telemetry, print-after-pass diffs, and custom pass registration."""
+import math
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static, telemetry
+from paddle_tpu.core.apply import apply
+from paddle_tpu.jit import capture_program
+from paddle_tpu.nn import functional as F
+from paddle_tpu.ops import manipulation as manip
+from paddle_tpu.static import passes
+from paddle_tpu.static.analysis import ProgramVerifyError, verify
+from paddle_tpu.static.passes.pass_base import PassStats, ProgramPass, clone_op_with_inputs
+
+
+def _counter_value(name, **labels):
+    fam = telemetry.default_registry().get(name)
+    if fam is None:
+        return 0
+    child = fam.labels(**labels) if labels else fam._default()
+    return child.value
+
+
+def _run_pass(main, pass_name, fetch_vids):
+    """Run ONE registered pass over a clone; returns (work, stats)."""
+    work = main.clone()
+    p = passes.get_pass(pass_name)
+    ctx = passes.PassContext(work, fetch_vars=fetch_vids)
+    stats = p.run(work, ctx)
+    return work, stats
+
+
+def _replay(prog, feeds, fetch_vid):
+    import jax.numpy as jnp
+
+    env = prog.replay_env(
+        {prog.feed_vars[n]: jnp.asarray(a) for n, a in feeds.items()},
+        [prog._var_tensors[v]._value for v in prog.param_vars],
+    )
+    return np.asarray(env[fetch_vid])
+
+
+def _golden(text):
+    return textwrap.dedent(text).strip("\n")
+
+
+# ---------------------------------------------------------------------------
+# golden to_text before/after dumps — one per shipped pass
+# ---------------------------------------------------------------------------
+
+def test_golden_dce():
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        x = static.data("x", [2, 3], "float32")
+        y = x * 2.0
+        F.softmax(y) * 3.0  # two dead ops
+    fv = [main._id2var[id(y)]]
+    before = main.to_text(fetch_vars=fv)
+    assert before == main.to_text(fetch_vars=fv)  # stable across renders
+    assert before == _golden("""
+        program {  # 3 ops, 1 feeds, 2 params, 0 grad_requests, 0 opt_updates
+          feed  %v0 'x' : float32[2, 3]
+          param %v1 : float32[]
+          param %v4 : float32[]
+          %v2 = multiply(%v0, %v1) : float32[2, 3]  # op#0
+          %v3 = softmax(%v2) : float32[2, 3]  # op#1
+          %v5 = multiply(%v3, %v4) : float32[2, 3]  # op#2
+          fetch %v2
+        }""")
+    work, stats = _run_pass(main, "dead_op_elimination", fv)
+    assert (stats.matches, stats.rewritten_ops) == (2, 2)
+    assert work.to_text(fetch_vars=fv) == _golden("""
+        program {  # 1 ops, 1 feeds, 2 params, 0 grad_requests, 0 opt_updates
+          feed  %v0 'x' : float32[2, 3]
+          param %v1 : float32[]
+          param %v4 : float32[]
+          %v2 = multiply(%v0, %v1) : float32[2, 3]  # op#0
+          fetch %v2
+        }""")
+    assert len(main.ops) == 3  # the caller's program is untouched
+
+
+def test_golden_constant_fold_scalars():
+    from jax import numpy as jnp
+
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        x = static.data("x", [2], "float32")
+        c = apply("const_three", lambda: jnp.float32(3.0))
+        y = x * c
+    fv = [main._id2var[id(y)]]
+    assert main.to_text(fetch_vars=fv) == _golden("""
+        program {  # 2 ops, 1 feeds, 0 params, 0 grad_requests, 0 opt_updates
+          feed  %v0 'x' : float32[2]
+          %v1 = const_three() : float32[]  # op#0
+          %v2 = multiply(%v0, %v1) : float32[2]  # op#1
+          fetch %v2
+        }""")
+    work, stats = _run_pass(main, "constant_fold_scalars", fv)
+    assert (stats.matches, stats.rewritten_ops) == (1, 1)
+    assert work.to_text(fetch_vars=fv) == _golden("""
+        program {  # 1 ops, 1 feeds, 0 params, 0 grad_requests, 0 opt_updates
+          feed  %v0 'x' : float32[2]
+          %v2 = multiply(%v0, array(3., dtype=float32)) : float32[2]  # op#0
+          fetch %v2
+        }""")
+    xv = np.array([1.5, -2.0], "float32")
+    np.testing.assert_array_equal(
+        _replay(main, {"x": xv}, fv[0]), _replay(work, {"x": xv}, fv[0])
+    )
+
+
+def test_golden_redundant_cast_reshape_elim():
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        x = static.data("x", [2, 3], "float32")
+        y = manip.cast(x, "float32")       # same dtype: redundant
+        z = manip.reshape(y, [2, 3])       # same shape: redundant
+        w = z * 2.0
+    fv = [main._id2var[id(w)]]
+    assert main.to_text(fetch_vars=fv) == _golden("""
+        program {  # 3 ops, 1 feeds, 1 params, 0 grad_requests, 0 opt_updates
+          feed  %v0 'x' : float32[2, 3]
+          param %v3 : float32[]
+          %v1 = cast(%v0) : float32[2, 3]  # op#0
+          %v2 = reshape(%v1) : float32[2, 3]  # op#1
+          %v4 = multiply(%v2, %v3) : float32[2, 3]  # op#2
+          fetch %v4
+        }""")
+    work, stats = _run_pass(main, "redundant_cast_reshape_elim", fv)
+    assert (stats.matches, stats.rewritten_ops) == (2, 2)
+    assert work.to_text(fetch_vars=fv) == _golden("""
+        program {  # 1 ops, 1 feeds, 1 params, 0 grad_requests, 0 opt_updates
+          feed  %v0 'x' : float32[2, 3]
+          param %v3 : float32[]
+          %v4 = multiply(%v0, %v3) : float32[2, 3]  # op#0
+          fetch %v4
+        }""")
+    xv = np.random.RandomState(0).randn(2, 3).astype("float32")
+    np.testing.assert_array_equal(
+        _replay(main, {"x": xv}, fv[0]), _replay(work, {"x": xv}, fv[0])
+    )
+
+
+def _rope_sdpa_program():
+    from paddle_tpu.models.llama import _rope
+
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        q = static.data("q", [1, 8, 4, 16], "float32")
+        k = static.data("k", [1, 8, 4, 16], "float32")
+        v = static.data("v", [1, 8, 4, 16], "float32")
+        qk = apply("rope", lambda qv, kv: _rope(qv, kv), q, k)
+        out = F.scaled_dot_product_attention(
+            qk[0], qk[1], v, is_causal=True, training=False
+        )
+    return main, [main._id2var[id(out)]]
+
+
+def test_golden_fuse_attention_rope_sdpa():
+    main, fv = _rope_sdpa_program()
+    assert main.to_text(fetch_vars=fv) == _golden("""
+        program {  # 2 ops, 3 feeds, 0 params, 0 grad_requests, 0 opt_updates
+          feed  %v0 'q' : float32[1, 8, 4, 16]
+          feed  %v1 'k' : float32[1, 8, 4, 16]
+          feed  %v2 'v' : float32[1, 8, 4, 16]
+          %v3, %v4 = rope(%v0, %v1) : float32[1, 8, 4, 16], float32[1, 8, 4, 16]  # op#0
+          %v5 = scaled_dot_product_attention(%v3, %v4, %v2) : float32[1, 8, 4, 16]  # op#1
+          fetch %v5
+        }""")
+    work, stats = _run_pass(main, "fuse_attention", fv)
+    assert (stats.matches, stats.rewritten_ops) == (1, 2)
+    assert work.to_text(fetch_vars=fv) == _golden("""
+        program {  # 1 ops, 3 feeds, 0 params, 0 grad_requests, 0 opt_updates
+          feed  %v0 'q' : float32[1, 8, 4, 16]
+          feed  %v1 'k' : float32[1, 8, 4, 16]
+          feed  %v2 'v' : float32[1, 8, 4, 16]
+          %v5 = fused_rope_flash_attention(%v0, %v1, %v2) : float32[1, 8, 4, 16]  # op#0
+          fetch %v5
+        }""")
+    # mini-replay composition: bit-identical to the unfused chain
+    rng = np.random.RandomState(1)
+    feeds = {n: rng.randn(1, 8, 4, 16).astype("float32") for n in "qkv"}
+    np.testing.assert_array_equal(
+        _replay(main, feeds, fv[0]), _replay(work, feeds, fv[0])
+    )
+
+
+def test_golden_fuse_norm_matmul():
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        x = static.data("x", [2, 8], "float32")
+        norm = paddle.nn.RMSNorm(8)
+        w2 = paddle.to_tensor(
+            np.random.RandomState(0).randn(8, 4).astype("float32")
+        )
+        y = paddle.matmul(norm(x), w2)
+    fv = [main._id2var[id(y)]]
+    assert main.to_text(fetch_vars=fv) == _golden("""
+        program {  # 2 ops, 1 feeds, 2 params, 0 grad_requests, 0 opt_updates
+          feed  %v0 'x' : float32[2, 8]
+          param %v1 : float32[8]
+          param %v3 : float32[8, 4]
+          %v2 = rms_norm(%v0, %v1) : float32[2, 8]  # op#0
+          %v4 = matmul(%v2, %v3) : float32[2, 4]  # op#1
+          fetch %v4
+        }""")
+    work, stats = _run_pass(main, "fuse_norm_matmul", fv)
+    assert (stats.matches, stats.rewritten_ops) == (1, 2)
+    assert work.to_text(fetch_vars=fv) == _golden("""
+        program {  # 1 ops, 1 feeds, 2 params, 0 grad_requests, 0 opt_updates
+          feed  %v0 'x' : float32[2, 8]
+          param %v1 : float32[8]
+          param %v3 : float32[8, 4]
+          %v4 = fused_rms_norm_matmul(%v0, %v1, %v3) : float32[2, 4]  # op#0
+          fetch %v4
+        }""")
+    xv = np.random.RandomState(2).randn(2, 8).astype("float32")
+    np.testing.assert_array_equal(
+        _replay(main, {"x": xv}, fv[0]), _replay(work, {"x": xv}, fv[0])
+    )
+
+
+def test_golden_fuse_bias_dropout_residual():
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        x = static.data("x", [2, 8], "float32")
+        b = static.data("b", [8], "float32")
+        r = static.data("r", [2, 8], "float32")
+        t = x + b
+        d = F.dropout(t, p=0.3, training=True)
+        y = d + r
+    fv = [main._id2var[id(y)]]
+    assert main.to_text(fetch_vars=fv) == _golden("""
+        program {  # 3 ops, 3 feeds, 0 params, 0 grad_requests, 0 opt_updates
+          feed  %v0 'x' : float32[2, 8]
+          feed  %v1 'b' : float32[8]
+          feed  %v2 'r' : float32[2, 8]
+          %v3 = add(%v0, %v1) : float32[2, 8]  # op#0
+          %v4 = dropout(%v3) : float32[2, 8]  # op#1
+          %v5 = add(%v4, %v2) : float32[2, 8]  # op#2
+          fetch %v5
+        }""")
+    work, stats = _run_pass(main, "fuse_bias_dropout_residual", fv)
+    assert (stats.matches, stats.rewritten_ops) == (1, 3)
+    assert work.to_text(fetch_vars=fv) == _golden("""
+        program {  # 1 ops, 3 feeds, 0 params, 0 grad_requests, 0 opt_updates
+          feed  %v0 'x' : float32[2, 8]
+          feed  %v1 'b' : float32[8]
+          feed  %v2 'r' : float32[2, 8]
+          %v5 = fused_bias_dropout_residual(%v0, %v1, %v2) : float32[2, 8]  # op#0
+          fetch %v5
+        }""")
+    # the fused fn replays the recorded dropout fn with its captured RNG
+    # key: bit-identical mask, bit-identical outputs
+    rng = np.random.RandomState(3)
+    feeds = {"x": rng.randn(2, 8).astype("float32"),
+             "b": rng.randn(8).astype("float32"),
+             "r": rng.randn(2, 8).astype("float32")}
+    np.testing.assert_array_equal(
+        _replay(main, feeds, fv[0]), _replay(work, feeds, fv[0])
+    )
+
+
+# ---------------------------------------------------------------------------
+# unfused attention chain -> Pallas flash dispatch (probed pattern)
+# ---------------------------------------------------------------------------
+
+def _unfused_attention_program(scale=None):
+    d = 16
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        q = static.data("q", [1, 2, 8, d], "float32")
+        k = static.data("k", [1, 2, 8, d], "float32")
+        v = static.data("v", [1, 2, 8, d], "float32")
+        s = paddle.matmul(q, k, transpose_y=True)
+        s = paddle.scale(s, scale if scale is not None else 1.0 / math.sqrt(d))
+        p = F.softmax(s)
+        out = paddle.matmul(p, v)
+    return main, [main._id2var[id(out)]]
+
+
+def test_unfused_attention_chain_rewrites_to_flash():
+    main, fv = _unfused_attention_program()
+    work, stats = _run_pass(main, "fuse_attention", fv)
+    assert (stats.matches, stats.rewritten_ops) == (1, 4)
+    assert [op.name for op in work.ops] == ["fused_flash_attention"]
+    rng = np.random.RandomState(4)
+    feeds = {n: rng.randn(1, 2, 8, 16).astype("float32") for n in "qkv"}
+    a, b = _replay(main, feeds, fv[0]), _replay(work, feeds, fv[0])
+    # the flash path legitimately reassociates the softmax reduction:
+    # fp tolerance, not bit identity (the one shipped pattern with that
+    # contract)
+    np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-5)
+
+
+def test_unfused_attention_wrong_scale_does_not_match():
+    # the probe harvests the recorded scale factor from the op's closure;
+    # anything but 1/sqrt(D) must NOT be rewritten into the flash kernel
+    main, fv = _unfused_attention_program(scale=0.5)
+    work, stats = _run_pass(main, "fuse_attention", fv)
+    assert stats.matches == 0
+    assert [op.name for op in work.ops] == ["matmul", "scale", "softmax", "matmul"]
+
+
+def test_fusion_blocked_when_interior_var_is_fetched():
+    # fetching the rope output pins it as a liveness root: the cluster may
+    # not be collapsed (the interior value must stay observable)
+    main, fv = _rope_sdpa_program()
+    rope_out = main.ops[0].out_vars[0]
+    work, stats = _run_pass(main, "fuse_attention", [fv[0], rope_out])
+    assert stats.matches == 0
+    assert len(work.ops) == 2
+
+
+# ---------------------------------------------------------------------------
+# mutant passes: one deliberately-miscompiling rewrite per pass class,
+# caught by the post-pass verify with the pass NAMED
+# ---------------------------------------------------------------------------
+
+class _MutantFusionUndefinedRead(ProgramPass):
+    """Fusion-class mutant: the 'replacement' reads a var no site defines."""
+
+    name = "mutant_fusion_undefined_read"
+
+    def run(self, program, ctx):
+        op = program.ops[-1]
+        program.ops[-1] = clone_op_with_inputs(
+            op, [("var", 999999)] + list(op.in_refs[1:])
+        )
+        return PassStats(matches=1, rewritten_ops=1)
+
+
+class _MutantCanonicalizeDoubleDefine(ProgramPass):
+    """Canonicalize-class mutant: 'simplifies' by emitting a second op that
+    re-binds an existing var (SSA violation)."""
+
+    name = "mutant_canonicalize_double_define"
+
+    def run(self, program, ctx):
+        op = program.ops[0]
+        program.ops.append(clone_op_with_inputs(op, list(op.in_refs)))
+        return PassStats(matches=1, rewritten_ops=1)
+
+
+class _MutantDceRemovesLiveOp(ProgramPass):
+    """DCE-class mutant: removes the producer of the fetch target."""
+
+    name = "mutant_dce_removes_live_op"
+
+    def run(self, program, ctx):
+        program.ops = program.ops[:-1]
+        return PassStats(matches=1, rewritten_ops=1)
+
+
+@pytest.mark.parametrize("mutant,check", [
+    (_MutantFusionUndefinedRead, "undefined-var"),
+    (_MutantCanonicalizeDoubleDefine, "single-assignment"),
+    (_MutantDceRemovesLiveOp, "dangling-fetch"),
+])
+def test_mutant_pass_caught_by_verify_with_pass_named(mutant, check):
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        x = static.data("x", [2, 3], "float32")
+        y = F.softmax(x * 2.0)
+    fv = [main._id2var[id(y)]]
+    mgr = passes.PassManager([mutant()])
+    with pytest.raises(ProgramVerifyError, match=mutant.name) as ei:
+        mgr.run(main.clone(), fetch_vars=fv)
+    assert check in [d.check for d in ei.value.diagnostics]
+    assert f"after pass '{mutant.name}'" in str(ei.value)
+
+
+def test_post_pipeline_verify_context_named():
+    # run_default_pipeline's final verify re-checks the REWRITTEN program;
+    # corrupting the clone's fetch target surfaces as 'post-pipeline'
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        x = static.data("x", [2], "float32")
+        y = x * 2.0
+    with pytest.raises(ProgramVerifyError, match="post-pipeline|dangling-fetch"):
+        passes.run_default_pipeline(main, fetch_vars=[987654])
+
+
+# ---------------------------------------------------------------------------
+# eager-converted tiny-Llama captures: the acceptance criteria
+# ---------------------------------------------------------------------------
+
+def _tiny_llama(**kw):
+    from paddle_tpu.models.llama import LlamaForCausalLM
+
+    cfg = dict(vocab_size=64, hidden_size=32, num_hidden_layers=2,
+               num_attention_heads=4, intermediate_size=48)
+    cfg.update(kw)
+    return LlamaForCausalLM(**cfg)
+
+
+def test_llama_eval_capture_matches_two_fusion_patterns():
+    """Acceptance: the eager-converted capture (ZERO model-code changes via
+    capture_program) hits >= 2 fusion patterns, visible in
+    paddle_tpu_pass_matches_total, with outputs identical to passes-off."""
+    model = _tiny_llama()
+    model.eval()
+    ids = paddle.to_tensor((np.arange(8) % 64).reshape(1, 8).astype("int64"))
+    program, feed_names, fetch_list = capture_program(
+        model, ids, feed_names=["ids"]
+    )
+    n_ops = len(program.ops)
+    fa0 = _counter_value("paddle_tpu_pass_matches_total", **{"pass": "fuse_attention"})
+    nm0 = _counter_value("paddle_tpu_pass_matches_total", **{"pass": "fuse_norm_matmul"})
+    exe = static.Executor()
+    feed = {"ids": ids.numpy()}
+    (on,) = exe.run(program, feed=feed, fetch_list=fetch_list)
+    # two distinct fusion patterns matched: one attention cluster per layer
+    # plus the final norm -> lm_head projection
+    assert _counter_value(
+        "paddle_tpu_pass_matches_total", **{"pass": "fuse_attention"}
+    ) == fa0 + 2
+    assert _counter_value(
+        "paddle_tpu_pass_matches_total", **{"pass": "fuse_norm_matmul"}
+    ) == nm0 + 1
+    assert len(program.ops) == n_ops  # the recorded capture is untouched
+    paddle.set_flags({"FLAGS_program_passes": False})
+    try:
+        (off,) = exe.run(program, feed=feed, fetch_list=fetch_list)
+    finally:
+        paddle.set_flags({"FLAGS_program_passes": True})
+    np.testing.assert_array_equal(np.asarray(on), np.asarray(off))
+
+
+def test_llama_train_capture_passes_on_off_identity():
+    """Acceptance: the TRAIN capture (loss + SGD minimize) produces
+    bit-identical losses AND updated weights with the pipeline on vs off —
+    grads flow through the fused ops unchanged."""
+    model = _tiny_llama()
+    ids_np = (np.arange(16) % 64).reshape(2, 8).astype("int64")
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        ids = static.data("ids", [2, 8], "int64")
+        labels = static.data("labels", [2, 8], "int64")
+        loss, _ = model(ids, labels=labels)
+        opt = paddle.optimizer.SGD(0.1, parameters=model.parameters())
+        opt.minimize(loss)
+    import jax.numpy as jnp
+
+    def snapshot():
+        return (
+            {v: np.asarray(main._var_tensors[v]._value) for v in main.param_vars},
+            [[np.asarray(a._value) for a in u.accum_tensors]
+             for u in main.opt_updates],
+        )
+
+    def restore(state):
+        params, accums = state
+        for v, val in params.items():
+            main._var_tensors[v]._replace_value(jnp.asarray(val))
+        for u, vals in zip(main.opt_updates, accums):
+            for a, val in zip(u.accum_tensors, vals):
+                a._replace_value(jnp.asarray(val))
+
+    exe = static.Executor()
+    feed = {"ids": ids_np, "labels": ids_np}
+    s0 = snapshot()
+    losses_on = [
+        np.asarray(exe.run(main, feed=feed, fetch_list=[loss])[0])
+        for _ in range(2)
+    ]
+    w_on = model.parameters()[0].numpy().copy()
+    restore(s0)
+    paddle.set_flags({"FLAGS_program_passes": False})
+    try:
+        losses_off = [
+            np.asarray(exe.run(main, feed=feed, fetch_list=[loss])[0])
+            for _ in range(2)
+        ]
+        w_off = model.parameters()[0].numpy().copy()
+    finally:
+        paddle.set_flags({"FLAGS_program_passes": True})
+    assert losses_on[1] != losses_on[0]  # the update really ran
+    for a, b in zip(losses_on, losses_off):
+        np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(w_on, w_off)
+
+
+def test_export_runs_pipeline(tmp_path):
+    runs0 = _counter_value(
+        "paddle_tpu_pass_runs_total", **{"pass": "dead_op_elimination"})
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        x = static.data("x", [2, 4], "float32")
+        lin = paddle.nn.Linear(4, 2)
+        y = lin(x)
+        F.softmax(y)  # dead at export
+    path = str(tmp_path / "model")
+    static.save_inference_model(path, [x], [y], program=main)
+    assert _counter_value(
+        "paddle_tpu_pass_runs_total", **{"pass": "dead_op_elimination"}
+    ) == runs0 + 1
+    prog, feed_names, _fetches = static.load_inference_model(path)
+    xv = np.random.RandomState(5).randn(2, 4).astype("float32")
+    (got,) = static.Executor().run(prog, feed={"x": xv}, fetch_list=None)
+    exe = static.Executor()
+    (want,) = exe.run(main, feed={"x": xv}, fetch_list=[y])
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+
+def test_flag_off_skips_pipeline_entirely():
+    runs0 = _counter_value(
+        "paddle_tpu_pass_runs_total", **{"pass": "fuse_attention"})
+    main, fv = _rope_sdpa_program()
+    exe = static.Executor()
+    rng = np.random.RandomState(6)
+    feed = {n: rng.randn(1, 8, 4, 16).astype("float32") for n in "qkv"}
+    paddle.set_flags({"FLAGS_program_passes": False})
+    try:
+        exe.run(main, feed=feed, fetch_list=[main._var_tensors[fv[0]]])
+    finally:
+        paddle.set_flags({"FLAGS_program_passes": True})
+    assert _counter_value(
+        "paddle_tpu_pass_runs_total", **{"pass": "fuse_attention"}) == runs0
+
+
+def test_pass_telemetry_schema():
+    main, fv = _rope_sdpa_program()
+    runs0 = _counter_value(
+        "paddle_tpu_pass_runs_total", **{"pass": "fuse_attention"})
+    rw0 = _counter_value(
+        "paddle_tpu_pass_rewritten_ops_total", **{"pass": "fuse_attention"})
+    work, res = passes.run_default_pipeline(main, fetch_vars=fv)
+    assert _counter_value(
+        "paddle_tpu_pass_runs_total", **{"pass": "fuse_attention"}) == runs0 + 1
+    assert _counter_value(
+        "paddle_tpu_pass_rewritten_ops_total", **{"pass": "fuse_attention"}
+    ) == rw0 + 2
+    hist = telemetry.default_registry().get("paddle_tpu_pass_seconds")
+    assert hist is not None
+    # the pipeline summary is the bench detail.passes shape
+    s = res.summary()
+    assert s["matches"]["fuse_attention"] == 1
+    assert s["rewritten_ops"]["fuse_attention"] == 2
+    assert s["pipeline_ms"] > 0
+    # verify ran after the rewriting pass AND post-pipeline: clean program
+    assert verify(work, fetch_vars=fv) == []
+
+
+def test_print_after_pass_diff(capsys):
+    main, fv = _rope_sdpa_program()
+    mgr = passes.PassManager(print_after={"fuse_attention"})
+    mgr.run(main.clone(), fetch_vars=fv)
+    err = capsys.readouterr().err
+    assert "fuse_attention: before" in err
+    assert "-  %v3, %v4 = rope(%v0, %v1)" in err
+    assert "+  %v5 = fused_rope_flash_attention(%v0, %v1, %v2)" in err
+
+
+def test_flag_toggle_recompiles_not_cache_hit():
+    """FLAGS_program_passes is part of compiled identity: toggling it must
+    MISS the compile cache and re-run (or skip) the pipeline — replaying
+    the other mode's cached artifact would make every on/off identity
+    comparison vacuous (a miscompiling pass could never be detected)."""
+    main, fv = _rope_sdpa_program()
+    exe = static.Executor()
+    rng = np.random.RandomState(7)
+    feed = {n: rng.randn(1, 8, 4, 16).astype("float32") for n in "qkv"}
+    fetch = [main._var_tensors[fv[0]]]
+    miss0 = _counter_value(
+        "paddle_tpu_executor_compile_cache_total", result="miss")
+    runs0 = _counter_value(
+        "paddle_tpu_pass_runs_total", **{"pass": "fuse_attention"})
+    exe.run(main, feed=feed, fetch_list=fetch)       # miss, pipeline runs
+    paddle.set_flags({"FLAGS_program_passes": False})
+    try:
+        exe.run(main, feed=feed, fetch_list=fetch)   # MISS again, no pipeline
+    finally:
+        paddle.set_flags({"FLAGS_program_passes": True})
+    exe.run(main, feed=feed, fetch_list=fetch)       # HIT the passes-on entry
+    assert _counter_value(
+        "paddle_tpu_executor_compile_cache_total", result="miss") == miss0 + 2
+    assert _counter_value(
+        "paddle_tpu_pass_runs_total", **{"pass": "fuse_attention"}) == runs0 + 1
+
+
+def test_register_custom_pass_in_default_pipeline():
+    from paddle_tpu.static.passes import pass_base
+
+    calls = []
+
+    class _ProbePass(ProgramPass):
+        name = "test_probe_pass"
+
+        def run(self, program, ctx):
+            calls.append(len(program.ops))
+            return PassStats()
+
+    passes.register_pass(_ProbePass, before="fuse_attention")
+    try:
+        names = [p.name for p in passes.default_pipeline()]
+        assert names.index("test_probe_pass") == names.index("fuse_attention") - 1
+        main, fv = _rope_sdpa_program()
+        passes.run_default_pipeline(main, fetch_vars=fv)
+        assert calls == [2]  # ran, before fusion collapsed the cluster
+    finally:
+        pass_base._REGISTRY.pop("test_probe_pass", None)
+        pass_base.PIPELINE_ORDER.remove("test_probe_pass")

@@ -26,6 +26,12 @@ Capture schema is validated FIRST and hard-fails (exit 2) on torn files:
 a truncated JSON, a `parsed: null` driver record (the r5 timeout shape),
 or a record missing `detail.configs` never silently passes.
 
+Round 15: the `passes` config (graph-pass pipeline probe) carries gated
+FUSION COVERAGE fields — `matches` per-pattern counts may only grow for an
+unchanged `passes_dims` probe shape (a pattern silently un-matching exits
+1, not just a slower bench), and `outputs_identical` may never flip to
+false.
+
 Exit codes: 0 = pass, 1 = regression, 2 = invalid capture / bad usage.
 
 Accepted inputs: a driver capture ({"n":…, "tail":…, "parsed": {...}}), a
@@ -42,7 +48,7 @@ from typing import Optional
 # config keys inside `detail` holding per-config stat dicts, plus the
 # headline whose stats live directly in `detail`
 NESTED_CONFIGS = ("seq4096", "llama3_shape", "resnet50", "ppocr_e2e", "serving",
-                  "fleet", "input_stream", "moe_longcontext")
+                  "fleet", "input_stream", "moe_longcontext", "passes")
 # fields whose change means "different workload" (never a regression)
 SHAPE_FIELDS = (
     "batch", "seq", "heads", "layers", "rung", "micro", "n_images",
@@ -56,6 +62,9 @@ SHAPE_FIELDS = (
     # round 13: fleet width + replay shape — a different replica ladder or
     # swap/kill schedule is a different problem
     "n_replicas", "fleet_dims",
+    # round 15: the pass-pipeline probe model's shape — a different capture
+    # legitimately matches a different number of fusion patterns
+    "passes_dims",
 )
 # larger-is-worse regression metrics per config record; the names match
 # what bench.py actually emits per config (ernie/llama/resnet report
@@ -222,6 +231,34 @@ def compare_config(key: str, old: dict, new: dict, tol: float):
                     f"attributed work +{work_growth:.1%} — UNEXPLAINED throughput regression"
                 )
                 verdict = "regress"
+    # fusion coverage (round 15, the `passes` config): per-pattern match
+    # counts are GATED fields — a pattern silently un-matching is a fusion
+    # regression (every future step compiles the unfused chain) even though
+    # no time field moved on the probe model. More matches than baseline is
+    # progress, never a failure; fewer (same shape fields — shape changes
+    # already returned above) exits 1.
+    om, nm = old.get("matches"), new.get("matches")
+    if isinstance(om, dict) and isinstance(nm, dict):
+        for pat in sorted(om):
+            if not pat.startswith("fuse"):
+                # only FUSION passes gate: cleanup counts (dead-op
+                # elimination, constant folding) legitimately shrink when
+                # the probe capture gets cleaner — fewer dead ops is
+                # progress, not a coverage regression
+                continue
+            o, nv = om[pat], nm.get(pat, 0)
+            if isinstance(o, (int, float)) and isinstance(nv, (int, float)) and nv < o:
+                lines.append(
+                    f"{key}: matches[{pat}] {o} -> {nv} — FUSION COVERAGE "
+                    f"regression (pattern stopped matching)"
+                )
+                verdict = "regress"
+    if old.get("outputs_identical") is True and new.get("outputs_identical") is False:
+        lines.append(
+            f"{key}: outputs_identical true -> false — the rewritten "
+            f"program no longer reproduces the passes-off outputs"
+        )
+        verdict = "regress"
     for f in ATTR_MEM_FIELDS:
         if oa.get(f) and na.get(f):
             r = _rel(na[f], oa[f])

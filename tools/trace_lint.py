@@ -38,12 +38,17 @@ Suppression:
   baseline — tools/trace_lint_baseline.txt, one entry per line:
                  <relpath>::<rule>::<enclosing-qualname>  # justification
              the justification comment is REQUIRED (entries without one are
-             a lint error themselves); unmatched entries warn but don't fail.
+             a lint error themselves). A STALE entry — file/qualname no
+             longer matches any finding — FAILS the gate with the entry
+             named: a dead suppression is a hazard that can silently
+             return under its old mute. `--prune` rewrites the baseline
+             dropping stale entries (comments and justifications kept).
 
 Usage:
   python -m tools.trace_lint paddle_tpu [more paths] [--baseline FILE]
-         [--no-baseline]
-Exit 0 when every finding is suppressed; 1 otherwise (CI gates on this).
+         [--no-baseline] [--prune]
+Exit 0 when every finding is suppressed and no baseline entry is stale;
+1 otherwise (CI gates on this).
 """
 from __future__ import annotations
 
@@ -482,8 +487,10 @@ def lint_paths(paths, baseline: Optional[dict] = None, root: Optional[str] = Non
             files.append(p)
     unsuppressed, suppressed = [], []
     matched_keys = set()
+    scanned_rels = set()
     for f in sorted(files):
         rel = os.path.relpath(os.path.abspath(f), root).replace(os.sep, "/")
+        scanned_rels.add(rel)
         for finding in lint_file(f, rel):
             # a parse failure means NOTHING in the file was checked — it can
             # never be baselined away
@@ -492,8 +499,38 @@ def lint_paths(paths, baseline: Optional[dict] = None, root: Optional[str] = Non
                 suppressed.append(finding)
             else:
                 unsuppressed.append(finding)
-    unused = [k for k in baseline if k not in matched_keys]
+    # staleness is only judged for entries whose FILE was actually linted
+    # this run — a partial-path invocation (`trace_lint paddle_tpu/nn`)
+    # must neither fail on, nor --prune away, suppressions for files it
+    # never looked at
+    unused = [k for k in baseline
+              if k not in matched_keys and k[0] in scanned_rels]
     return unsuppressed, suppressed, unused
+
+
+def prune_baseline(path: str, stale_keys) -> int:
+    """Rewrite the baseline file dropping the stale entries (comments,
+    blank lines, and every live entry's justification are preserved
+    verbatim). Returns the number of lines removed."""
+    stale = set(stale_keys)
+    with open(path, "r", encoding="utf-8") as f:
+        lines = f.readlines()
+    kept, dropped = [], 0
+    for raw in lines:
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            kept.append(raw)
+            continue
+        entry = line.split("#", 1)[0]
+        parts = [p.strip() for p in entry.strip().split("::")]
+        key = (parts[0].replace(os.sep, "/"), parts[1], parts[2]) if len(parts) == 3 else None
+        if key in stale:
+            dropped += 1
+            continue
+        kept.append(raw)
+    with open(path, "w", encoding="utf-8") as f:
+        f.writelines(kept)
+    return dropped
 
 
 def main(argv=None) -> int:
@@ -504,6 +541,9 @@ def main(argv=None) -> int:
                     help="suppression baseline file (default: %(default)s)")
     ap.add_argument("--no-baseline", action="store_true",
                     help="ignore the baseline (report everything)")
+    ap.add_argument("--prune", action="store_true",
+                    help="rewrite the baseline file dropping stale entries "
+                         "(instead of failing on them)")
     ap.add_argument("--root", default=None,
                     help="directory baseline relpaths are anchored at "
                          "(default: the baseline file's repo root, so "
@@ -523,14 +563,24 @@ def main(argv=None) -> int:
     unsuppressed, suppressed, unused = lint_paths(args.paths, baseline, root=root)
     for f in unsuppressed:
         print(f)
-    for key in unused:
-        print(f"trace_lint: warning: unused baseline entry "
-              f"{key[0]}::{key[1]}::{key[2]}", file=sys.stderr)
+    stale_fail = False
+    if unused and args.prune:
+        n = prune_baseline(args.baseline, unused)
+        print(f"trace_lint: pruned {n} stale baseline entr"
+              f"{'y' if n == 1 else 'ies'} from {args.baseline}")
+    else:
+        for key in unused:
+            # a stale suppression FAILS the gate: the hazard it muted is
+            # gone, so the entry is a standing mute for a future regression
+            print(f"trace_lint: stale baseline entry "
+                  f"{key[0]}::{key[1]}::{key[2]} — no finding matches it; "
+                  f"remove it or rerun with --prune", file=sys.stderr)
+            stale_fail = True
     print(
         f"trace_lint: {len(unsuppressed)} finding(s), "
         f"{len(suppressed)} baselined, over {len(args.paths)} path(s)"
     )
-    return 1 if unsuppressed else 0
+    return 1 if (unsuppressed or stale_fail) else 0
 
 
 if __name__ == "__main__":
