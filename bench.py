@@ -87,6 +87,15 @@ with the widest run taking a mid-run zero-downtime weight hot-swap AND a
 FaultPlan-injected replica kill (swap-blip p99 + zero-loss asserted).
 BENCH_FLEET_* shrink knobs; BENCH_SKIP_FLEET=1 skips it.
 
+Round 16: the serving/fleet configs run their headline replays REQUEST-
+TRACED (telemetry/request_trace.py) and record `detail.slo_breakdown` —
+the per-component TTFT/TPOT decomposition (queue_wait/prefill/decode/
+preempt/swap_overlap, cause-labeled), a p99 blame table, consistency
+(component-sum vs measured wall, ≈1.0 by construction), and the SLO burn
+rate against BENCH_{SERVE,FLEET}_SLO_{TTFT,TPOT}_MS targets. perf_gate
+checks the candidate's consistency AND accepts/rejects p99 TTFT moves by
+whether the breakdown explains them.
+
 Round 11: a `serving` config measures the decode-optimized serving tier —
 greedy decode through the paged-KV InferenceEngine (Pallas flash-decode on
 TPU, AOT prefill/decode shape buckets) under a synthetic heavy-traffic
@@ -525,6 +534,10 @@ def _serve_dims():
         "n_requests": int(g("BENCH_SERVE_REQUESTS", 48)),
         "seed": int(g("BENCH_SERVE_SEED", 11)),
         "gap_s": float(g("BENCH_SERVE_GAP", 0.002)),
+        # round 16: SLO targets the request-trace burn rate reports against
+        # (generous CPU-scale defaults; real deployments override)
+        "slo_ttft_ms": float(g("BENCH_SERVE_SLO_TTFT_MS", 1000.0)),
+        "slo_tpot_ms": float(g("BENCH_SERVE_SLO_TPOT_MS", 200.0)),
     }
 
 
@@ -594,16 +607,34 @@ def _build_serving():
         return eng
 
     def measured(kind):
+        from paddle_tpu.telemetry import request_trace as _rt
+
         eng = fresh_engine()
         sched = (ContinuousBatchingScheduler(eng) if kind == "continuous"
                  else StaticBatchingScheduler(eng))
+        # round 16: the continuous (headline) replay runs REQUEST-TRACED so
+        # the capture carries the TTFT/TPOT decomposition of the very
+        # numbers it reports (perf_gate checks the components sum to the
+        # measured walls and explains p99 moves through them); measured
+        # overhead is ~1 µs per lifecycle transition (BASELINE round-16),
+        # noise against the ~10 ms CPU decode step
+        traced = kind == "continuous"
+        if traced:
+            _rt.reset()
+            paddle.set_flags({"FLAGS_request_trace": True})
         gc.collect()
         gc.disable()
         try:
             stats = replay(sched, mk_requests())
         finally:
             gc.enable()
+            if traced:
+                paddle.set_flags({"FLAGS_request_trace": False})
         stats["bucket_stats"] = dict(eng.bucket_stats)
+        if traced:
+            stats["slo_breakdown"] = _rt.slo_breakdown(
+                slo_ttft_ms=d["slo_ttft_ms"], slo_tpot_ms=d["slo_tpot_ms"]
+            )
         return stats
 
     cont = measured("continuous")
@@ -661,6 +692,9 @@ def _fleet_dims():
         # event triggers as completed-request fractions of the replay
         "swap_at": float(g("BENCH_FLEET_SWAP_AT", 0.3)),
         "kill_at": float(g("BENCH_FLEET_KILL_AT", 0.6)),
+        # round 16: SLO targets for the request-trace burn rate
+        "slo_ttft_ms": float(g("BENCH_FLEET_SLO_TTFT_MS", 1000.0)),
+        "slo_tpot_ms": float(g("BENCH_FLEET_SLO_TPOT_MS", 200.0)),
     }
 
 
@@ -733,6 +767,7 @@ def _build_fleet():
     try:
         _ckpt.save_state_dict({"model": model.state_dict()}, ck_root, step=1)
         widest = max(d["replicas"])
+        slo_breakdown = None
         for n in d["replicas"]:
             fleet = ReplicaFleet([fresh_engine() for _ in range(n)])
             events = []
@@ -754,6 +789,14 @@ def _build_fleet():
                     events.append((
                         max(2, int(d["kill_at"] * d["n_requests"])), kill,
                     ))
+            # round 16: the chaos (headline) width runs request-traced so
+            # the capture's decomposition covers evacuation + swap-drain
+            # attribution (cause-labeled preempt spans, swap windows)
+            from paddle_tpu.telemetry import request_trace as _rt
+
+            if chaos:
+                _rt.reset()
+                paddle.set_flags({"FLAGS_request_trace": True})
             gc.collect()
             gc.disable()
             try:
@@ -761,7 +804,12 @@ def _build_fleet():
             finally:
                 gc.enable()
                 if chaos:
+                    paddle.set_flags({"FLAGS_request_trace": False})
                     _fi.clear_plan()
+            if chaos:
+                slo_breakdown = _rt.slo_breakdown(
+                    slo_ttft_ms=d["slo_ttft_ms"], slo_tpot_ms=d["slo_tpot_ms"]
+                )
             assert stats["lost"] == 0 and stats["duplicated"] == 0, stats
             per_n[str(n)] = {
                 k: stats.get(k)
@@ -789,6 +837,7 @@ def _build_fleet():
                 round(head["tokens_per_sec"] / tps_1, 3)
                 if head.get("tokens_per_sec") and tps_1 else None
             ),
+            "slo_breakdown": slo_breakdown,
             "replicas": per_n,
             "note": (
                 "same seeded replay at each fleet width; widest run takes a "

@@ -99,6 +99,13 @@ def flush_diagnostics() -> None:
         if _tm.enabled():
             sys.stderr.write("--- telemetry snapshot ---\n")
             sys.stderr.write(_tm.to_prometheus())
+            # JSON-lines for machine post-mortems, LENIENT mode: a gauge
+            # that went NaN may be the whole story of this crash — skip
+            # and count it (loud marker line) instead of letting
+            # allow_nan=False throw away the entire snapshot
+            sys.stderr.write("\n--- telemetry snapshot (jsonl) ---\n")
+            sys.stderr.write(_tm.to_json_lines(strict=False))
+            sys.stderr.write("\n")
     except Exception:
         pass  # diagnostics must never mask the abort
     try:

@@ -227,6 +227,17 @@ class FlightRecorder:
             payload["perf_report"] = _pa.snapshot_for_crash()
         except Exception:
             pass  # attribution must never mask the dump
+        # the full metric registry rides too — LENIENT mode: the dump must
+        # survive the very NaN gauge it exists to report (invalid samples
+        # are skipped-and-counted with a marker line; CI snapshots stay
+        # strict through the default to_json_lines)
+        try:
+            from .. import telemetry as _tm
+
+            if _tm.enabled():
+                payload["telemetry"] = _tm.to_json_lines(strict=False).splitlines()
+        except Exception:
+            pass
         with open(path, "w") as f:
             json.dump(payload, f, indent=1, default=str)
             f.write("\n")

@@ -32,6 +32,7 @@ from jax import numpy as jnp
 
 from .. import telemetry
 from ..telemetry import metrics as _metrics
+from ..telemetry import request_trace as _rt
 from .kv_cache import BlockPool, PagedCacheView
 
 __all__ = ["InferenceEngine"]
@@ -246,12 +247,21 @@ class InferenceEngine:
             self.bucket_stats["hits"] += 1
             if telemetry.enabled():
                 _bucket_counter().labels(kind=kind, event="hit").inc()
+            if _rt.enabled():
+                _rt.record_event("engine", "dispatch", kind=kind, size=size,
+                                 event="hit")
             return ex
         t0 = time.perf_counter()
         ex = (self._compile_prefill if kind == "prefill" else self._compile_decode)(size)
         dt = time.perf_counter() - t0
         self._compiled[key] = ex
         self.bucket_stats["compiles"] += 1
+        if _rt.enabled():
+            # a compile-miss dispatch IS a tail-latency event: the signature
+            # + wall time land in the trace so a bucket-miss-shaped p99 blip
+            # is attributable instead of mysterious
+            _rt.record_event("engine", "dispatch", kind=kind, size=size,
+                             event="compile", dur_s=round(dt, 6))
         if telemetry.enabled():
             _bucket_counter().labels(kind=kind, event="compile").inc()
             try:

@@ -56,6 +56,7 @@ import numpy as np
 
 from .. import telemetry
 from ..telemetry import metrics as _metrics
+from ..telemetry import request_trace as _rt
 from ..distributed.resilience import fault_injection as _fi
 from .scheduler import ContinuousBatchingScheduler, Request, percentiles
 
@@ -279,6 +280,11 @@ class ReplicaFleet:
             self._session_home.move_to_end(req.session)
             while len(self._session_home) > self.session_cache_size:
                 self._session_home.popitem(last=False)
+        if _rt.enabled() and _rt.sampled(req.rid):
+            # lands in the request's own chrome lane: WHY it went where it
+            # went (affinity home vs SLO-scored pick vs evacuation target)
+            _rt.record_event("request", "route", t=self.clock(), rid=req.rid,
+                             replica=rep.idx, reason=reason)
         if telemetry.enabled():
             _routed_counter(reason).inc()
         return rep
@@ -290,6 +296,13 @@ class ReplicaFleet:
             # since no scheduler will stamp it until it routes
             if req.submitted_time is None:
                 req.submitted_time = self.clock()
+            if req.trace is None:
+                req.trace = _rt.start(req.rid, req.submitted_time,
+                                      prompt_len=req.prompt_len,
+                                      max_new=req.max_new_tokens)
+            if req.trace is not None and req.trace.phase_name is None:
+                # held time is queue time with a cause: no healthy replica
+                req.trace.phase("queue", self.clock(), cause="held")
             self._pending.append(req)
         else:
             # the scheduler stamps submitted_time itself AFTER its own
@@ -315,6 +328,9 @@ class ReplicaFleet:
                 req.outcome = "expired"
                 req.finish_time = now
                 self.finished.append(req)
+                if req.trace is not None:
+                    req.trace.close(now, "expired", generated=0,
+                                    preemptions=req.preemptions)
                 if telemetry.enabled():
                     _metrics.counter(
                         "paddle_tpu_serving_requests_total",
@@ -332,6 +348,9 @@ class ReplicaFleet:
                 req.outcome = "cancelled"
                 req.finish_time = self.clock()
                 self.finished.append(self._pending.pop(i))
+                if req.trace is not None:
+                    req.trace.close(req.finish_time, "cancelled", generated=0,
+                                    preemptions=req.preemptions)
                 if telemetry.enabled():
                     _metrics.counter(
                         "paddle_tpu_serving_requests_total",
@@ -385,6 +404,9 @@ class ReplicaFleet:
     def _kill(self, rep: _Replica) -> None:
         rep.status = ReplicaStatus.DOWN
         rep.draining_for_swap = False
+        _rt.record_event("fleet", "replica_down", t=self.clock(),
+                         replica=rep.idx,
+                         failures=rep.consecutive_failures)
         # break session affinity: homes on a dead replica re-route freely
         for s, idx in list(self._session_home.items()):
             if idx == rep.idx:
@@ -478,6 +500,8 @@ class ReplicaFleet:
             if sw["swapped"]:
                 self.swap_windows.append((self._swap_t0, now))
                 self.swaps_completed += 1
+                _rt.record_span("fleet", "swap_rollout", self._swap_t0, now,
+                                swapped=sw["swapped"])
                 if telemetry.enabled():
                     _swap_counter("completed").inc()
             elif telemetry.enabled():
@@ -513,6 +537,10 @@ class ReplicaFleet:
             rep.status = ReplicaStatus.HEALTHY
             rep.draining_for_swap = False
             rep.consecutive_failures = 0
+            # the per-replica drain window: requests whose queue/preempt
+            # time overlaps these spans get it attributed as swap_overlap
+            _rt.record_span("fleet", "swap_drain", sw["t_active"], now,
+                            replica=rep.idx)
             if telemetry.enabled():
                 _swap_counter("replica_swapped").inc()
                 _drain_hist().observe(max(0.0, now - sw["t_active"]))

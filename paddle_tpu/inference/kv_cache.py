@@ -18,12 +18,13 @@ fault) and padded-position writes scribble somewhere harmless.
 from __future__ import annotations
 
 import math
-from typing import List, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 from jax import numpy as jnp
 
 from .. import telemetry
 from ..telemetry import metrics as _metrics
+from ..telemetry import request_trace as _rt
 
 __all__ = ["BlockPool", "PagedCacheView", "PoolExhausted", "TRASH_PAGE"]
 
@@ -120,13 +121,18 @@ class BlockPool:
     def used(self) -> int:
         return (self.num_blocks - 1) - len(self._free)
 
-    def alloc(self, n: int) -> List[int]:
+    def alloc(self, n: int, owner: Optional[int] = None) -> List[int]:
+        """`owner` is the request id the pages are charged to (request-trace
+        attribution only; the allocator itself is owner-blind)."""
         if n > len(self._free):
             if telemetry.enabled():
                 _metrics.counter(
                     "paddle_tpu_kv_pool_alloc_failures_total",
                     "paged KV pool allocations refused for lack of free pages",
                 ).inc()
+            if _rt.enabled():
+                _rt.record_event("kv_pool", "alloc_failure", rid=owner,
+                                 n=n, free=len(self._free))
             raise PoolExhausted(
                 f"paged KV pool exhausted: want {n} pages, {len(self._free)} free "
                 f"of {self.num_blocks - 1}"
@@ -137,9 +143,13 @@ class BlockPool:
                 "paddle_tpu_kv_pool_allocs_total", "paged KV pool pages handed out"
             ).inc(n)
             _pool_gauge("used").set(self.used())
+        if _rt.enabled():
+            # used-after rides every event: the report reconstructs the
+            # pool-occupancy-over-time curve from these alone
+            _rt.record_event("kv_pool", "alloc", rid=owner, n=n, used=self.used())
         return out
 
-    def free(self, pages: Sequence[int]) -> None:
+    def free(self, pages: Sequence[int], owner: Optional[int] = None) -> None:
         for p in pages:
             p = int(p)
             if p == TRASH_PAGE:
@@ -152,6 +162,9 @@ class BlockPool:
                 "paddle_tpu_kv_pool_frees_total", "paged KV pool pages returned"
             ).inc(len(pages))
             _pool_gauge("used").set(self.used())
+        if _rt.enabled() and pages:
+            _rt.record_event("kv_pool", "free", rid=owner,
+                             n=len(pages), used=self.used())
 
     def reset(self) -> None:
         self._free = list(range(self.num_blocks - 1, 0, -1))

@@ -36,6 +36,7 @@ import numpy as np
 
 from .. import telemetry
 from ..telemetry import metrics as _metrics
+from ..telemetry import request_trace as _rt
 from .kv_cache import PoolExhausted
 
 __all__ = [
@@ -121,6 +122,11 @@ class Request:
     # recompute-on-resume: prompt tokens re-prefilled after a preemption
     # include the already-generated prefix; `_prompt_len` keeps the original
     _prompt_len: Optional[int] = None
+    # request-scoped trace handle (telemetry.request_trace) — None unless
+    # FLAGS_request_trace sampled this request; travels WITH the request
+    # across preemption/evacuation/re-dispatch so the phase chain stays
+    # unbroken end to end
+    trace: Optional[object] = field(default=None, repr=False, compare=False)
 
     @property
     def prompt_len(self) -> int:
@@ -213,6 +219,18 @@ class ContinuousBatchingScheduler:
         if req.submitted_time is None:
             req.submitted_time = self.clock()
         self.waiting.append(req)
+        if req.trace is None:
+            req.trace = _rt.start(
+                req.rid, req.submitted_time,
+                prompt_len=req.prompt_len, max_new=req.max_new_tokens,
+            )
+            if req.trace is not None:
+                req.trace.phase("queue", self.clock())
+        elif req.trace.phase_name != "preempt":
+            # re-dispatch of an already-traced request (fleet migration off
+            # a draining replica): it queues again; an open "preempt" span
+            # (evacuation/preemption) instead runs until re-admission
+            req.trace.phase("queue", self.clock(), cause="requeue")
         if telemetry.enabled():
             _req_counter().labels(event="submitted").inc()
             self._sync_gauges()
@@ -228,9 +246,15 @@ class ContinuousBatchingScheduler:
     def _finish(self, req: Request, now: float) -> None:
         req.finish_time = now
         req.outcome = req.outcome or "completed"
-        self.engine.pool.free(req.pages)
+        self.engine.pool.free(req.pages, owner=req.rid)
         req.pages = []
         self.finished.append(req)
+        if req.trace is not None:
+            req.trace.close(
+                now, req.outcome,
+                generated=(len(req.prompt) - req.prompt_len) + len(req.generated),
+                preemptions=req.preemptions,
+            )
         if telemetry.enabled():
             _req_counter().labels(event=req.outcome).inc()
             tpot = req.tpot()
@@ -292,12 +316,15 @@ class ContinuousBatchingScheduler:
             key=lambda r: (r.first_token_time is None, r.first_token_time or 0.0, r.rid),
         )
         self.running.remove(victim)
-        self.engine.pool.free(victim.pages)
+        self.engine.pool.free(victim.pages, owner=victim.rid)
         victim.pages = []
         self._reset_for_resume(victim)
         victim.preemptions += 1
         self.preempted_total += 1
         self.waiting.insert(0, victim)
+        if victim.trace is not None:
+            # the preempt span runs until re-admission (recompute resumes)
+            victim.trace.phase("preempt", self.clock(), cause="pool_dry")
         if telemetry.enabled():
             _req_counter().labels(event="preempted").inc()
         return True
@@ -311,13 +338,18 @@ class ContinuousBatchingScheduler:
         re-submitted to a healthy replica and their K/V pages are rebuilt
         from the folded prompt there."""
         evacuated: List[Request] = []
+        now = self.clock()
         for req in self.running:
-            self.engine.pool.free(req.pages)
+            self.engine.pool.free(req.pages, owner=req.rid)
             req.pages = []
             evacuated.append(self._reset_for_resume(req))
         # waiting requests hold no pages; a preemption-requeued one is
         # already in resume form
         evacuated.extend(self.waiting)
+        for req in evacuated:
+            if req.trace is not None:
+                # cause-labeled: distinguishable from pool_dry preemption
+                req.trace.phase("preempt", now, cause="evacuation")
         self.running = []
         self.waiting = []
         if telemetry.enabled():
@@ -328,6 +360,12 @@ class ContinuousBatchingScheduler:
         token = int(np.argmax(logits))
         req.generated.append(token)
         req.token_times.append(now)
+        # every emitted token belongs to the decode phase — keyed on the
+        # trace's own phase, not first_token_time, because a mid-decode
+        # preemption re-opens a prefill span on resume (first_token_time
+        # stays set) and the post-resume tokens must flip back to decode
+        if req.trace is not None and req.trace.phase_name != "decode":
+            req.trace.phase("decode", now)
         if req.first_token_time is None:
             req.first_token_time = now
             if telemetry.enabled() and req.submitted_time is not None:
@@ -372,7 +410,9 @@ class ContinuousBatchingScheduler:
             need = pool.blocks_for_tokens(len(req.prompt) + 1)
             if need <= pool.available():
                 self.waiting.pop(0)
-                req.pages = pool.alloc(need)
+                req.pages = pool.alloc(need, owner=req.rid)
+                if req.trace is not None:
+                    self._trace_admit(req, mode="bucketed")
                 logits = self.engine.prefill(req.prompt, req.pages)
                 req.cursor = len(req.prompt)
                 if telemetry.enabled():
@@ -384,12 +424,23 @@ class ContinuousBatchingScheduler:
         if pool.available() < 1:
             return None
         self.waiting.pop(0)
-        req.pages = pool.alloc(1)
+        req.pages = pool.alloc(1, owner=req.rid)
         req.cursor = 0
         self.running.append(req)
+        if req.trace is not None:
+            self._trace_admit(req, mode="streamed")
         if telemetry.enabled():
             _req_counter().labels(event="admitted").inc()
         return 0
+
+    def _trace_admit(self, req: Request, mode: str) -> None:
+        """Open the prefill span; `recompute_tokens` counts the generated
+        prefix folded into the prompt by preemption/evacuation — the K/V
+        this prefill rebuilds rather than computes for the first time."""
+        req.trace.phase(
+            "prefill", self.clock(), mode=mode,
+            recompute_tokens=len(req.prompt) - req.prompt_len,
+        )
 
     def step(self) -> int:
         """One scheduler tick; returns the number of tokens produced."""
@@ -425,7 +476,7 @@ class ContinuousBatchingScheduler:
                 continue
             while pool.blocks_for_tokens(need_tokens) > len(req.pages):
                 try:
-                    req.pages.extend(pool.alloc(1))
+                    req.pages.extend(pool.alloc(1, owner=req.rid))
                 except PoolExhausted:
                     if req in self.running and len(self.running) == 1:
                         raise  # nothing left to evict but ourselves

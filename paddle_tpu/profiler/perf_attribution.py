@@ -467,6 +467,7 @@ def roofline(flops, bytes_accessed, seconds, platform: Optional[str] = None,
 _REPORT_KEYS = (
     "version", "generated_at", "platform", "telemetry_enabled",
     "programs", "live_arrays", "hbm_watermark", "input_pipeline",
+    "serving",
 )
 _PROGRAM_KEYS = (
     "serial", "origin", "name", "platform", "flops", "bytes_accessed",
@@ -499,6 +500,20 @@ def _input_pipeline_section() -> dict:
     return section
 
 
+def _serving_section() -> dict:
+    """The request-trace SLO decomposition (round 16): per-component
+    TTFT/TPOT attribution over sampled serving requests, or an explicit
+    unavailable marker. The component sums equal the measured request wall
+    time by construction (contiguous phase spans), so the `consistency`
+    field doubles as a tracing-health check perf_gate enforces."""
+    try:
+        from ..telemetry import request_trace as _rt
+
+        return _rt.serving_section()
+    except Exception as e:  # the report must render without the serving tier
+        return {"available": False, "reason": f"request_trace failed: {e}"}
+
+
 def perf_report(origin: Optional[str] = None) -> dict:
     """The queryable attribution summary (exported as
     `paddle.profiler.perf_report`): every recorded program's FLOPs / bytes /
@@ -514,6 +529,7 @@ def perf_report(origin: Optional[str] = None) -> dict:
         "live_arrays": live_array_census(set_gauges=False),
         "hbm_watermark": watermark(),
         "input_pipeline": _input_pipeline_section(),
+        "serving": _serving_section(),
     }
 
 
@@ -536,6 +552,8 @@ def validate_report(report: dict) -> dict:
         raise ValueError("hbm_watermark missing peak_hbm_bytes")
     if "verdict" not in report["input_pipeline"]:
         raise ValueError("input_pipeline missing verdict")
+    if "available" not in report["serving"]:
+        raise ValueError("serving section missing 'available'")
     return report
 
 

@@ -22,10 +22,19 @@ Traces without `clock_sync` metadata degrade to best-effort alignment
 
 CLI:
     python -m paddle_tpu.profiler.trace_merge -o merged.json \
-        rank0.paddle_trace.json rank1.paddle_trace.json [--summary]
+        rank0.paddle_trace.json rank1.paddle_trace.json \
+        [--requests timeline.json] [--summary]
 
 `--summary` prints the DistributedView communication table over the merged
 events (feeding profiler_statistic's existing builder).
+
+`--requests` interleaves a request-trace timeline
+(`telemetry.request_trace.dump_chrome_trace`) into the merged view: request
+lanes keep their own per-request pids (they are NOT flattened onto a rank
+lane — `metadata.request_lanes` marks such traces) and are clock-aligned
+through the same clock_sync machinery, so one chrome trace shows per-rank
+host/collective spans stacked against per-request queue/prefill/decode/
+preempt spans on a shared wall clock.
 """
 from __future__ import annotations
 
@@ -167,6 +176,38 @@ def merge_traces(traces: Sequence[Union[str, dict]],
     }
 
 
+def merge_request_lanes(merged: dict, req_trace: Union[str, dict]) -> dict:
+    """Interleave a request-trace chrome export (one lane per request plus
+    the engine/kv-pool/fleet lanes) into an already-merged rank timeline.
+
+    The request trace keeps its own pids (allocated far above any rank id
+    by `telemetry.request_trace`), so lanes never collide; its timestamps
+    shift onto the merged wall clock via its embedded clock_sync pair, or
+    pin to the merged origin when unsynced (same degradation contract as
+    rank traces)."""
+    tr = load_trace(req_trace)
+    origin = (merged.get("metadata") or {}).get("origin_unix_us", 0.0)
+    off = _trace_offset_us(tr, origin)
+    events = merged.setdefault("traceEvents", [])
+    for e in tr.get("traceEvents", ()):
+        e2 = dict(e)
+        if "ts" in e2 and e2.get("ph") != "M":
+            e2["ts"] = e2["ts"] + off - origin
+        events.append(e2)
+    events.sort(key=lambda e: e.get("ts", -1.0))
+    meta = merged.setdefault("metadata", {})
+    meta["request_lanes"] = True
+    # count only the per-request pid block — the export also carries the
+    # engine/kv_pool/fleet global lanes below REQUEST_PID_BASE
+    from paddle_tpu.telemetry.request_trace import REQUEST_PID_BASE
+    meta["request_lane_count"] = len({
+        e.get("pid") for e in tr.get("traceEvents", ())
+        if e.get("ph") != "M" and isinstance(e.get("pid"), int)
+        and e["pid"] >= REQUEST_PID_BASE
+    })
+    return merged
+
+
 def to_statistic_data(merged: dict):
     """Rehydrate a merged trace into a StatisticData so the existing
     summary builders (DistributedView's communication table in particular)
@@ -205,6 +246,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         help="comma-separated rank override (default: trace metadata)",
     )
     p.add_argument(
+        "--requests", default=None, metavar="timeline.json",
+        help="request-trace chrome export (telemetry.request_trace."
+             "dump_chrome_trace) whose per-request lanes interleave with "
+             "the rank lanes",
+    )
+    p.add_argument(
         "--summary", action="store_true",
         help="print the merged DistributedView communication table",
     )
@@ -213,13 +260,19 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         [int(r) for r in args.ranks.split(",")] if args.ranks else None
     )
     merged = merge_traces(args.traces, ranks=ranks)
+    if args.requests:
+        merged = merge_request_lanes(merged, args.requests)
     with open(args.output, "w") as f:
         json.dump(merged, f)
     n = sum(1 for e in merged["traceEvents"] if e.get("ph") != "M")
+    req_note = (
+        f", {merged['metadata'].get('request_lane_count', 0)} request lane(s)"
+        if args.requests else ""
+    )
     print(
         f"merged {len(args.traces)} trace(s) -> {args.output}: {n} events, "
         f"ranks {merged['metadata']['merged_ranks']}, "
-        f"alignment={merged['metadata']['alignment']}"
+        f"alignment={merged['metadata']['alignment']}{req_note}"
     )
     if args.summary:
         from .profiler_statistic import _build_distributed_table

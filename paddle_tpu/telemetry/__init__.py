@@ -26,10 +26,12 @@ from .metrics import (  # noqa: F401
 from .exporters import (  # noqa: F401
     dump_snapshot,
     parse_prometheus,
+    start_metrics_server,
     to_json_lines,
     to_prometheus,
     validate_snapshot,
 )
+from . import request_trace  # noqa: F401
 
 __all__ = [
     "Counter",
@@ -47,5 +49,7 @@ __all__ = [
     "to_json_lines",
     "parse_prometheus",
     "dump_snapshot",
+    "start_metrics_server",
     "validate_snapshot",
+    "request_trace",
 ]

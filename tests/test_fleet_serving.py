@@ -657,3 +657,10 @@ def test_fleet_bench_child_record():
     assert widest["swaps_completed"] == 1
     assert widest["replica_failures"] >= 2  # the FaultPlan kill fired
     assert rec["replicas"]["1"]["tokens_per_sec"] > 0
+    # round 16: the chaos run is request-traced — the capture's breakdown
+    # covers the swap window and carries cause-labeled evacuation counts
+    bd = rec["slo_breakdown"]
+    assert bd["n_traced"] == 10 and bd["open_spans"] == 0
+    assert abs(bd["consistency"]["mean"] - 1.0) <= 0.05
+    assert bd["swap_windows"] >= 1
+    assert bd["causes"].get("evacuation", 0) >= 1

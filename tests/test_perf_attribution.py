@@ -414,3 +414,60 @@ def test_note_rendezvous_round_trips_into_export_metadata():
         assert trace["metadata"]["clock_sync"]["perf_ns"] == cs["perf_ns"]
     finally:
         tm._clock_sync[0] = was
+
+def test_trace_merge_requests_interleaves_request_lanes(tmp_path):
+    """Round 16: `--requests timeline.json` interleaves per-request lanes
+    (telemetry.request_trace chrome export) with the rank lanes — request
+    pids preserved (not flattened onto a rank), clock-aligned through the
+    same clock_sync machinery."""
+    from paddle_tpu.telemetry import request_trace as rt
+
+    t0 = _rank_trace(0, perf_ns=1_000_000, unix_ns=2_000_000, events=[
+        ("all_reduce", "Communication", 1.0, 2.0, None),
+    ])
+    # a request timeline whose clock maps onto the same wall clock: span at
+    # clock 1500us with (perf 1000us <-> unix 2000us) sync -> wall 2500us;
+    # the rank event (ts 1us, same pair) is wall 1001us = the merged origin,
+    # so the request span lands 1499us after it
+    req = {
+        "traceEvents": [
+            {"ph": "M", "name": "process_name", "pid": rt.REQUEST_PID_BASE,
+             "tid": 0, "args": {"name": "request 0"}},
+            {"ph": "X", "name": "decode", "cat": "serving_request",
+             "pid": rt.REQUEST_PID_BASE, "tid": 0, "ts": 1500.0, "dur": 500.0,
+             "args": {"rid": 0}},
+            # a global engine-lane event rides along but is NOT a request
+            # lane — request_lane_count must not include it
+            {"ph": "X", "name": "dispatch", "cat": "serving_engine",
+             "pid": 90001, "tid": 0, "ts": 1500.0, "dur": 10.0, "args": {}},
+        ],
+        "metadata": {"request_lanes": True,
+                     "clock_sync": {"perf_ns": 1_000_000, "unix_ns": 2_000_000}},
+    }
+    p0, pr = tmp_path / "r0.json", tmp_path / "req.json"
+    p0.write_text(json.dumps(t0))
+    pr.write_text(json.dumps(req))
+    out = tmp_path / "merged.json"
+    rc = tm.main([str(p0), "-o", str(out), "--requests", str(pr)])
+    assert rc == 0
+    merged = json.loads(out.read_text())
+    assert merged["metadata"]["request_lanes"] is True
+    assert merged["metadata"]["request_lane_count"] == 1
+    real = [e for e in merged["traceEvents"] if e.get("ph") != "M"]
+    by_pid = {e["pid"]: e for e in real}
+    assert set(by_pid) == {0, 90001, rt.REQUEST_PID_BASE}
+    # both lanes share the wall clock: rank event pins the origin, the
+    # request span lands 1499us later (2500us wall - 1001us origin)
+    assert abs(by_pid[0]["ts"] - 0.0) < 1e-6
+    assert abs(by_pid[rt.REQUEST_PID_BASE]["ts"] - 1499.0) < 1e-6
+    # the real thing round-trips too: a live recorder's export merges clean
+    rec = rt.RequestTraceRecorder(capacity=64)
+    rec.add_span("request", "queue", 0.001, 0.002, rid=7)
+    rec.add_event("request", "finish", 0.002, rid=7, attrs={"outcome": "completed"})
+    live = tmp_path / "live.json"
+    live.write_text(json.dumps(rt.to_chrome_trace(rec)))
+    rc = tm.main([str(p0), "-o", str(out), "--requests", str(live)])
+    assert rc == 0
+    merged = json.loads(out.read_text())
+    pids = {e["pid"] for e in merged["traceEvents"] if e.get("ph") != "M"}
+    assert rt.REQUEST_PID_BASE + 7 in pids and 0 in pids

@@ -496,3 +496,134 @@ def test_passes_identity_flip_fails(tmp_path):
     rc, out, err = _run(a, b)
     assert rc == 1, (out, err)
     assert "outputs_identical" in out
+
+
+# ---------------------------------------------------------------------------
+# round 16: request-trace slo_breakdown gates (consistency + explanation)
+# ---------------------------------------------------------------------------
+
+def _with_breakdown(ttft=40.0, queue_p99=10.0, prefill_p99=25.0,
+                    preempt_p99=5.0, consistency=1.0, open_spans=0,
+                    tpot=8.0, decode_p99=100.0, max_err=None,
+                    dropped=0, truncated=0):
+    """Serving capture whose record carries the round-16 slo_breakdown
+    (the request-trace TTFT decomposition bench.py now emits)."""
+    c = _with_serving(ttft=ttft, tpot=tpot)
+    c["detail"]["serving"]["slo_breakdown"] = {
+        "n_traced": 48,
+        "open_spans": open_spans,
+        "dropped_records": dropped,
+        "truncated_requests": truncated,
+        "consistency": {
+            "mean": consistency, "min": consistency,
+            "max_abs_err_frac": (abs(consistency - 1.0)
+                                 if max_err is None else max_err),
+        },
+        "ttft_p99_components_ms": {
+            "queue_wait": queue_p99, "prefill": prefill_p99,
+            "preempt": preempt_p99,
+        },
+        "e2e_p99_components_ms": {
+            "queue_wait": queue_p99, "prefill": prefill_p99,
+            "preempt": preempt_p99, "decode": decode_p99,
+        },
+    }
+    return c
+
+
+def test_breakdown_ttft_regression_flat_breakdown_fails(tmp_path):
+    """The ISSUE-14 acceptance bar, failing half: p99 TTFT +25% while every
+    breakdown component stayed flat — time appeared that no component
+    accounts for, which is exactly the attribution-must-explain contract."""
+    a = _write(tmp_path, "a.json", _with_breakdown(ttft=40.0))
+    b = _write(tmp_path, "b.json", _with_breakdown(ttft=50.0))
+    rc, out, err = _run(a, b)
+    assert rc == 1, (out, err)
+    assert "UNEXPLAINED" in out and "breakdown flat" in out
+
+
+def test_breakdown_ttft_regression_explained_by_queue_wait_passes(tmp_path):
+    """Passing half: the same +10 ms p99 TTFT with queue_wait's p99
+    component grown by the regression — heavier admission pressure, not a
+    scheduling bug — passes and names the component."""
+    a = _write(tmp_path, "a.json", _with_breakdown(ttft=40.0, queue_p99=10.0))
+    b = _write(tmp_path, "b.json", _with_breakdown(ttft=50.0, queue_p99=20.5))
+    rc, out, err = _run(a, b)
+    assert rc == 0, (out, err)
+    assert "explained by slo_breakdown" in out and "queue_wait" in out
+
+
+def test_breakdown_consistency_violation_fails(tmp_path):
+    """Components summing to 85% of the measured wall means the tracing
+    surface itself broke (evicted/missed spans) — the candidate fails even
+    with every time field flat."""
+    a = _write(tmp_path, "a.json", _with_breakdown())
+    b = _write(tmp_path, "b.json", _with_breakdown(consistency=0.85))
+    rc, out, err = _run(a, b)
+    assert rc == 1, (out, err)
+    assert "consistency" in out and "do not sum" in out
+
+
+def test_breakdown_tpot_regression_not_explained_by_ttft_side_growth(tmp_path):
+    """Unit guard: TPOT is per-TOKEN while the e2e components are
+    per-request totals — a grown queue_wait (15 ms, far above the 4 ms
+    per-token regression) must NOT explain a +50% p99 TPOT when the
+    inter-token components (decode/preempt) stayed flat."""
+    a = _write(tmp_path, "a.json", _with_breakdown(tpot=8.0, queue_p99=10.0))
+    b = _write(tmp_path, "b.json", _with_breakdown(tpot=12.0, queue_p99=25.0))
+    rc, out, err = _run(a, b)
+    assert rc == 1, (out, err)
+    assert "p99_tpot_ms" in out and "UNEXPLAINED" in out
+
+
+def test_breakdown_tpot_regression_explained_by_intertoken_growth_passes(tmp_path):
+    """A +50% p99 TPOT with the inter-token components (decode+preempt)
+    grown by the same fraction — chaos recompute gaps, not a decode-step
+    regression — passes and names the component."""
+    a = _write(tmp_path, "a.json",
+               _with_breakdown(tpot=8.0, decode_p99=100.0, preempt_p99=5.0))
+    b = _write(tmp_path, "b.json",
+               _with_breakdown(tpot=12.0, decode_p99=140.0, preempt_p99=20.0))
+    rc, out, err = _run(a, b)
+    assert rc == 0, (out, err)
+    assert "p99_tpot_ms" in out and "explained by slo_breakdown" in out
+
+
+def test_breakdown_worst_request_consistency_fails_despite_clean_mean(tmp_path):
+    """Per-request errors that cancel in the mean (one request over-sums,
+    another under-sums) still fail: max_abs_err_frac is the real bar."""
+    a = _write(tmp_path, "a.json", _with_breakdown())
+    b = _write(tmp_path, "b.json", _with_breakdown(consistency=1.0, max_err=0.15))
+    rc, out, err = _run(a, b)
+    assert rc == 1, (out, err)
+    assert "worst-request consistency" in out
+
+
+def test_breakdown_orphaned_open_spans_fail(tmp_path):
+    a = _write(tmp_path, "a.json", _with_breakdown())
+    b = _write(tmp_path, "b.json", _with_breakdown(open_spans=3))
+    rc, out, err = _run(a, b)
+    assert rc == 1, (out, err)
+    assert "orphaned open span" in out
+
+
+def test_breakdown_ring_eviction_fails(tmp_path):
+    """Ring eviction can shrink a request's wall and component sum TOGETHER
+    (head-of-trace loss), leaving consistency ~1.0 while the attribution
+    understates — the dropped/truncated counters are the real signal, and
+    any eviction disqualifies the candidate's breakdown."""
+    a = _write(tmp_path, "a.json", _with_breakdown())
+    b = _write(tmp_path, "b.json", _with_breakdown(dropped=12, truncated=2))
+    rc, out, err = _run(a, b)
+    assert rc == 1, (out, err)
+    assert "lost trace data" in out and "FLAGS_request_trace_ring" in out
+
+
+def test_breakdown_absent_keeps_legacy_behavior(tmp_path):
+    # captures predating round 16 (no slo_breakdown) still gate TTFT the
+    # old way: regression with flat attributed work fails, nothing crashes
+    a = _write(tmp_path, "a.json", _with_serving(ttft=40.0))
+    b = _write(tmp_path, "b.json", _with_serving(ttft=50.0))
+    rc, out, err = _run(a, b)
+    assert rc == 1, (out, err)
+    assert "p99_ttft_ms" in out

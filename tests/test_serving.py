@@ -617,3 +617,11 @@ def test_serving_bench_child_record(tmp_path):
     assert rec["static"]["tokens_per_sec"] > 0
     assert rec["serve_dims"]["hidden"] == 64  # shrunken run records its dims
     assert rec["bucket_stats"]["compiles"] >= 2
+    # round 16: the record decomposes its own SLO numbers — components sum
+    # to the measured walls (the perf-gate consistency contract) and the
+    # TTFT-side component p99s + burn rate ride the capture
+    bd = rec["slo_breakdown"]
+    assert bd["n_traced"] == 8 and bd["open_spans"] == 0
+    assert abs(bd["consistency"]["mean"] - 1.0) <= 0.05
+    assert set(bd["ttft_p99_components_ms"]) == {"queue_wait", "prefill", "preempt"}
+    assert bd["slo"]["ttft_burn_rate"] is not None

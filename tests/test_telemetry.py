@@ -535,3 +535,92 @@ def test_telemetry_smoke_three_step_train_loop(tmp_path):
     # chrome trace side: the profiled window carries the Communication spans
     comm = p.profiler_result.comm_events()
     assert len([e for e in comm if e.name == "collective.all_reduce"]) >= 1
+
+
+# ---------------------------------------------------------------------------
+# round 16: live scrape endpoint + lenient crash-path snapshots
+# ---------------------------------------------------------------------------
+
+def test_metrics_server_round_trip():
+    """start_metrics_server serves live Prometheus text at /metrics and a
+    JSON-lines snapshot at /metrics.json — urllib round-trip, ephemeral
+    port, values move between scrapes without restarting anything."""
+    import urllib.request
+
+    from paddle_tpu.telemetry import exporters as ex
+    from paddle_tpu.telemetry import metrics as tm_metrics
+
+    reg = tm_metrics.Registry()
+    c = reg.counter("scrape_test_total", "round-trip probe", ("kind",))
+    c.labels(kind="a").inc(3)
+    srv = telemetry.start_metrics_server(port=0, registry=reg)
+    try:
+        text = urllib.request.urlopen(srv.url + "/metrics", timeout=10).read().decode()
+        parsed = ex.parse_prometheus(text)
+        assert parsed[("scrape_test_total", (("kind", "a"),))] == 3.0
+        c.labels(kind="a").inc(2)  # live: next scrape sees the new value
+        text = urllib.request.urlopen(srv.url + "/metrics", timeout=10).read().decode()
+        assert ex.parse_prometheus(text)[("scrape_test_total", (("kind", "a"),))] == 5.0
+        body = urllib.request.urlopen(srv.url + "/metrics.json", timeout=10).read().decode()
+        assert telemetry.validate_snapshot(body) >= 1
+        with pytest.raises(Exception):
+            urllib.request.urlopen(srv.url + "/nope", timeout=10)
+    finally:
+        srv.stop()
+    srv.stop()  # idempotent
+
+
+def test_json_lines_strict_throws_on_nan_lenient_skips_and_marks():
+    """Crash-path hardening: strict mode (CI snapshots) raises on a NaN
+    gauge; lenient mode (guardian/watchdog dumps) skips-and-counts it with
+    a loud, schema-valid marker line."""
+    from paddle_tpu.telemetry import exporters as ex
+    from paddle_tpu.telemetry import metrics as tm_metrics
+
+    reg = tm_metrics.Registry()
+    reg.gauge("fine_gauge", "ok").set(1.0)
+    reg.gauge("poisoned_gauge", "went NaN mid-crash").set(float("nan"))
+    with pytest.raises(ValueError):
+        telemetry.to_json_lines(reg)  # strict default: CI stays strict
+    lenient = telemetry.to_json_lines(reg, strict=False)
+    lines = [json.loads(l) for l in lenient.splitlines()]
+    names = [l["name"] for l in lines]
+    assert "fine_gauge" in names and "poisoned_gauge" not in names
+    marker = next(l for l in lines if l["name"] == ex.INVALID_SAMPLES_METRIC)
+    assert marker["value"] == 1
+    assert marker["labels"]["marker"] == "INVALID_SAMPLES_SKIPPED"
+    assert any("poisoned_gauge" in s for s in marker["skipped"])
+    # the lenient output itself passes the snapshot schema (tools keep
+    # parsing a crash dump)
+    assert telemetry.validate_snapshot(lenient) == 2
+    # inf is rejected/skipped the same way as nan
+    reg.gauge("inf_gauge", "").set(float("inf"))
+    lenient = telemetry.to_json_lines(reg, strict=False)
+    marker = next(json.loads(l) for l in lenient.splitlines()
+                  if json.loads(l)["name"] == ex.INVALID_SAMPLES_METRIC)
+    assert marker["value"] == 2
+
+
+def test_guardian_crash_dump_survives_nan_gauge(tmp_path):
+    """The satellite's point: a flight-recorder dump taken WHILE a gauge is
+    NaN still writes (lenient mode inside), with the telemetry snapshot
+    carried and the marker naming the skip."""
+    from paddle_tpu.framework.guardian import FlightRecorder
+    from paddle_tpu.telemetry import exporters as ex
+    from paddle_tpu.telemetry import metrics as tm_metrics
+
+    g = tm_metrics.gauge("crash_nan_gauge_r16", "poisoned")
+    g.set(float("nan"))
+    try:
+        fr = FlightRecorder(capacity=8, name="t16", crash_dir=str(tmp_path))
+        fr.record_step(1, loss=1.0)
+        path = fr.dump(reason="nan-test")
+        payload = json.loads(open(path).read())
+        assert payload["records"][0]["step"] == 1
+        tel_lines = payload.get("telemetry")
+        assert tel_lines, "telemetry snapshot must ride the crash dump"
+        marker = [json.loads(l) for l in tel_lines
+                  if json.loads(l)["name"] == ex.INVALID_SAMPLES_METRIC]
+        assert marker and marker[0]["value"] >= 1
+    finally:
+        tm_metrics.default_registry().unregister("crash_nan_gauge_r16")

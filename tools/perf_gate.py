@@ -32,6 +32,17 @@ unchanged `passes_dims` probe shape (a pattern silently un-matching exits
 1, not just a slower bench), and `outputs_identical` may never flip to
 false.
 
+Round 16: serving/fleet records carry `slo_breakdown` (the request-trace
+TTFT/TPOT decomposition). Two new checks: (a) CONSISTENCY — the candidate's
+breakdown components must sum to the measured request wall time within 5%
+(contiguous phase spans make the sum exact; a shortfall means ring
+eviction or a missed lifecycle transition, i.e. the attribution surface
+itself regressed); (b) EXPLANATION — a p99 TTFT regression beyond tol is
+explained (and passes) when the breakdown's TTFT-side component p99s grew
+by at least the regression (e.g. queue_wait under heavier admission
+pressure), and FAILS when the breakdown stayed flat (time appeared that no
+component accounts for — the attribution-must-explain-the-tail contract).
+
 Exit codes: 0 = pass, 1 = regression, 2 = invalid capture / bad usage.
 
 Accepted inputs: a driver capture ({"n":…, "tail":…, "parsed": {...}}), a
@@ -89,6 +100,26 @@ THROUGHPUT_FIELDS = ("tokens_per_sec", "samples_per_sec",
                      "scaling_vs_1replica")
 ATTR_WORK_FIELDS = ("flops", "hbm_bytes")
 ATTR_MEM_FIELDS = ("program_memory_bytes", "peak_hbm_bytes")
+# round 16: breakdown-sum-vs-measured-wall tolerance (matches the 5%
+# acceptance bar the serving tests pin on real replays)
+BREAKDOWN_CONSISTENCY_TOL = 0.05
+# time fields whose regression the slo_breakdown can explain, mapped to
+# (component key, comparison mode). TTFT components share the field's
+# unit (ms per request), so absolute growth must cover the regression;
+# TPOT is PER-TOKEN while the e2e components are per-request totals, so
+# only proportional growth of the decode-side components (the ones that
+# land between tokens) can explain it — absolute comparison there would
+# let per-request-scale noise explain any per-token regression.
+BREAKDOWN_EXPLAINED_FIELDS = {
+    "p99_ttft_ms": ("ttft_p99_components_ms", "absolute"),
+    "p99_tpot_ms": ("e2e_p99_components_ms", "relative"),
+}
+# e2e components accrued after the first token: the only ones whose growth
+# can legitimately explain a TPOT (inter-token interval) regression. Swap
+# drain time is NOT listed — it rides inside the decode spans it overlaps
+# (the p99 component dict holds additive phases only), so a swap-driven
+# TPOT regression surfaces as decode growth
+TPOT_SIDE_COMPONENTS = ("decode", "preempt")
 
 
 class CaptureError(Exception):
@@ -206,13 +237,108 @@ def compare_config(key: str, old: dict, new: dict, tol: float):
         if oa.get(f) and na.get(f):
             work_growth = max(work_growth, _rel(na[f], oa[f]))
     verdict = "pass"
+    # round 16: the CANDIDATE's slo_breakdown must be internally consistent
+    # — components summing short of the measured wall means the attribution
+    # surface itself broke (ring eviction, missed transition), which would
+    # silently disarm the explanation check below
+    obd = old.get("slo_breakdown") if isinstance(old.get("slo_breakdown"), dict) else {}
+    nbd = new.get("slo_breakdown") if isinstance(new.get("slo_breakdown"), dict) else {}
+    ncons = (nbd.get("consistency") or {}) if isinstance(nbd.get("consistency"), dict) else {}
+    if ncons.get("mean") is not None and abs(ncons["mean"] - 1.0) > BREAKDOWN_CONSISTENCY_TOL:
+        lines.append(
+            f"{key}: slo_breakdown consistency {ncons['mean']:.3f} — "
+            f"components do not sum to the measured request time within "
+            f"{BREAKDOWN_CONSISTENCY_TOL:.0%} (request-trace attribution broke)"
+        )
+        verdict = "regress"
+    elif (ncons.get("max_abs_err_frac") is not None
+          and ncons["max_abs_err_frac"] > BREAKDOWN_CONSISTENCY_TOL):
+        # per-request errors can cancel in the mean (one request over-sums,
+        # another under-sums) — the worst single request is the real bar
+        lines.append(
+            f"{key}: slo_breakdown worst-request consistency error "
+            f"{ncons['max_abs_err_frac']:.1%} exceeds "
+            f"{BREAKDOWN_CONSISTENCY_TOL:.0%} (mean {ncons['mean']:.3f} hides "
+            f"cancelling per-request attribution errors)"
+        )
+        verdict = "regress"
+    if nbd.get("open_spans"):
+        lines.append(
+            f"{key}: slo_breakdown reports {nbd['open_spans']} orphaned open "
+            f"span(s) after a drained replay — lifecycle instrumentation leak"
+        )
+        verdict = "regress"
+    if nbd.get("dropped_records") or nbd.get("truncated_requests"):
+        # head-of-trace eviction shrinks a request's wall and component sum
+        # TOGETHER, so consistency stays ~1.0 while queue_wait/TTFT
+        # attribution silently understates — any eviction is disqualifying
+        lines.append(
+            f"{key}: slo_breakdown lost trace data "
+            f"({nbd.get('dropped_records') or 0} ring-evicted record(s), "
+            f"{nbd.get('truncated_requests') or 0} truncated request "
+            f"trace(s)) — attribution untrustworthy; raise "
+            f"FLAGS_request_trace_ring"
+        )
+        verdict = "regress"
+
+    def _breakdown_explains(f, regress_ms, regress_frac):
+        """Does component growth in the breakdown account for the time-field
+        regression? Returns (explained, detail_str). `absolute` fields share
+        the component unit (ms/request) and require the grown ms to cover
+        the regressed ms; `relative` fields (per-token TPOT vs per-request
+        e2e components) require the TPOT-side components to have grown by at
+        least the same FRACTION — absolute ms there would let per-request
+        noise explain any per-token regression."""
+        comp_key, mode = BREAKDOWN_EXPLAINED_FIELDS.get(f, (None, None))
+        if not comp_key:
+            return False, None
+        oc, nc = obd.get(comp_key), nbd.get(comp_key)
+        if not isinstance(oc, dict) or not isinstance(nc, dict):
+            return False, None
+        grown = {
+            c: nc[c] - oc[c]
+            for c in nc
+            if c in oc
+            and isinstance(nc[c], (int, float)) and isinstance(oc[c], (int, float))
+            and nc[c] > oc[c]
+        }
+        if mode == "relative":
+            side = [c for c in TPOT_SIDE_COMPONENTS
+                    if isinstance(oc.get(c), (int, float))]
+            base_ms = sum(oc[c] for c in side)
+            grown = {c: g for c, g in grown.items() if c in side}
+            if base_ms > 0.0 and sum(grown.values()) / base_ms >= regress_frac * (1.0 - tol):
+                top = max(grown, key=grown.get)
+                return True, (
+                    f"{top} +{grown[top] / base_ms:.1%} of the inter-token "
+                    f"components vs +{regress_frac:.1%} regression"
+                )
+        else:
+            explained_ms = sum(grown.values())
+            if explained_ms >= regress_ms * (1.0 - tol):
+                top = max(grown, key=grown.get)
+                return True, f"{top} +{grown[top]:.1f} ms of +{regress_ms:.1f} ms"
+        flat = ", ".join(f"{c} {oc.get(c)}->{nc.get(c)}" for c in sorted(nc))
+        return False, f"breakdown flat ({flat})"
+
     for f in TIME_FIELDS:
         if f in old and f in new and isinstance(old[f], (int, float)) and isinstance(new[f], (int, float)):
             r = _rel(new[f], old[f])
             if r > tol + max(0.0, work_growth):
+                explained, why = _breakdown_explains(f, new[f] - old[f], r)
+                if explained:
+                    lines.append(
+                        f"{key}: {f} +{r:.1%} explained by slo_breakdown "
+                        f"component growth ({why})"
+                    )
+                    if verdict == "pass":
+                        verdict = "explained"
+                    continue
+                blame = f" [{why}]" if why else ""
                 lines.append(
                     f"{key}: {f} {old[f]:.3f} -> {new[f]:.3f} (+{r:.1%}) with "
-                    f"attributed work +{work_growth:.1%} — UNEXPLAINED step-time regression"
+                    f"attributed work +{work_growth:.1%} — UNEXPLAINED step-time "
+                    f"regression{blame}"
                 )
                 verdict = "regress"
             elif r > tol:
