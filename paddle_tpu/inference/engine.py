@@ -86,6 +86,7 @@ class InferenceEngine:
         decode_batch_buckets: Optional[Sequence[int]] = None,
         mesh=None,
         layout_table=None,
+        kv_dtype: Optional[str] = None,
     ):
         from ..jit.api import state_values
 
@@ -154,6 +155,7 @@ class InferenceEngine:
         self.pool = BlockPool(
             num_blocks, self.block_size, self.num_layers,
             self.num_kv_heads, self.head_dim, dtype=w_dtype,
+            kv_dtype=kv_dtype,
         )
         # donation keeps exactly one pool copy live on TPU; CPU's donation
         # path only warns, so gate it on the platform
@@ -203,6 +205,12 @@ class InferenceEngine:
             new[k] = v
         self.params = new
         self.weights_version += 1
+        # resident prefix-cache K/V was computed under the OLD weights — a
+        # post-swap hit would mix old-weight keys/values into new-weight
+        # attention; drop the index (active requests' own pages are
+        # unaffected: the drained-replica swap protocol means there are
+        # none, and any stragglers just lose shareability)
+        self.pool.invalidate_prefix()
         if telemetry.enabled():
             _metrics.counter(
                 "paddle_tpu_serving_weight_swaps_total",
@@ -240,19 +248,27 @@ class InferenceEngine:
                 return b
         raise ValueError(f"{kind} size {n} exceeds the largest bucket {buckets[-1]}")
 
-    def _get_compiled(self, kind: str, size: int):
+    def _get_compiled(self, kind: str, size):
         key = (kind, size)
+        # extend signatures are (B, Q) pairs; everything downstream wants a
+        # flat printable size ("4x4") rather than a tuple repr
+        sz = size if isinstance(size, int) else "x".join(str(s) for s in size)
         ex = self._compiled.get(key)
         if ex is not None:
             self.bucket_stats["hits"] += 1
             if telemetry.enabled():
                 _bucket_counter().labels(kind=kind, event="hit").inc()
             if _rt.enabled():
-                _rt.record_event("engine", "dispatch", kind=kind, size=size,
+                _rt.record_event("engine", "dispatch", kind=kind, size=sz,
                                  event="hit")
             return ex
         t0 = time.perf_counter()
-        ex = (self._compile_prefill if kind == "prefill" else self._compile_decode)(size)
+        if kind == "prefill":
+            ex = self._compile_prefill(size)
+        elif kind == "decode":
+            ex = self._compile_decode(size)
+        else:  # ("extend", (B, Q))
+            ex = self._compile_extend(*size)
         dt = time.perf_counter() - t0
         self._compiled[key] = ex
         self.bucket_stats["compiles"] += 1
@@ -260,7 +276,7 @@ class InferenceEngine:
             # a compile-miss dispatch IS a tail-latency event: the signature
             # + wall time land in the trace so a bucket-miss-shaped p99 blip
             # is attributable instead of mysterious
-            _rt.record_event("engine", "dispatch", kind=kind, size=size,
+            _rt.record_event("engine", "dispatch", kind=kind, size=sz,
                              event="compile", dur_s=round(dt, 6))
         if telemetry.enabled():
             _bucket_counter().labels(kind=kind, event="compile").inc()
@@ -268,38 +284,84 @@ class InferenceEngine:
                 from ..profiler import perf_attribution as _pa
 
                 _pa.record_compiled(
-                    "serving", f"{kind}_{size}", compiled=ex, compile_seconds=dt
+                    "serving", f"{kind}_{sz}", compiled=ex, compile_seconds=dt
                 )
             except Exception:
                 pass
         return ex
 
-    def _page_avals(self):
+    def _state_avals(self):
+        """Avals mirroring pool.device_state(): per-layer page arrays plus
+        scale planes on a quantized pool — the ONE pytree every compiled
+        step threads through (and donates)."""
         shape = (self.pool.num_blocks, self.block_size, self.num_kv_heads, self.head_dim)
         one = jax.ShapeDtypeStruct(shape, self.pool.dtype)
-        return [one] * self.num_layers
+        avals = {"k": [one] * self.num_layers, "v": [one] * self.num_layers}
+        if self.pool.quantized:
+            sc = jax.ShapeDtypeStruct(shape[:3], jnp.float32)
+            avals["k_scale"] = [sc] * self.num_layers
+            avals["v_scale"] = [sc] * self.num_layers
+        return avals
 
-    def _jit(self, fn, n_leading_args: int, donate_pages_from: int):
+    def _state_shardings(self):
+        """NamedShardings matching _state_avals: pages follow the kv-head
+        TP split; scale planes share it (their head axis is axis 2 too)."""
+        pages = [self._page_sharding] * self.num_layers
+        sh = {"k": pages, "v": list(pages)}
+        if self.pool.quantized:
+            if self._page_sharding is not self._repl:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                spec = self._page_sharding.spec
+                sc = NamedSharding(self._mesh, P(*spec[:3]))
+            else:
+                sc = self._repl
+            sh["k_scale"] = [sc] * self.num_layers
+            sh["v_scale"] = [sc] * self.num_layers
+        return sh
+
+    @staticmethod
+    def _view_from_state(state, bt, seq_lens, block_size, write_mask=None):
+        return PagedCacheView(
+            state["k"], state["v"], bt, seq_lens, block_size,
+            k_scales=state.get("k_scale"), v_scales=state.get("v_scale"),
+            write_mask=write_mask,
+        )
+
+    @staticmethod
+    def _state_from_view(view):
+        state = {"k": view.k_pages, "v": view.v_pages}
+        if view.k_scales is not None:
+            state["k_scale"] = view.k_scales
+            state["v_scale"] = view.v_scales
+        return state
+
+    def _jit(self, fn, n_args: int):
+        """fn's signature is (params, *scalars, cache_state) with the state
+        pytree LAST (argnum n_args - 1): donated (TPU), sharded per
+        _state_shardings, and pinned on the outputs so threaded pages keep
+        one layout across programs."""
         kwargs = {}
         if self._donate:
-            # page arrays are threaded through every step — alias them
-            kwargs["donate_argnums"] = tuple(
-                range(donate_pages_from, donate_pages_from + 2)
-            )
+            # the state pytree is threaded through every step — alias it
+            kwargs["donate_argnums"] = (n_args - 1,)
         if self._param_shardings is not None:
             repl = self._repl
-            pages = [self._page_sharding] * self.num_layers
             kwargs["in_shardings"] = (
                 self._param_shardings,
-                *([repl] * (n_leading_args - 1)),
-                pages,
-                list(pages),
+                *([repl] * (n_args - 2)),
+                self._state_shardings(),
             )
             # pin the outputs too: prefill/decode THREAD the pages — without
             # this GSPMD picks per-program layouts and the next program's
             # compiled signature rejects them
-            kwargs["out_shardings"] = (repl, pages, list(pages))
+            kwargs["out_shardings"] = (repl, self._state_shardings())
         return jax.jit(fn, **kwargs)
+
+    def _param_avals(self):
+        return {
+            k: jax.ShapeDtypeStruct(v.shape, v.dtype) for k, v in self.params.items()
+        }
 
     def _compile_prefill(self, S: int):
         from ..core.tensor import Tensor
@@ -307,26 +369,26 @@ class InferenceEngine:
         from ..autograd import no_grad
 
         model, block_size = self._model, self.block_size
+        view_from, state_from = self._view_from_state, self._state_from_view
 
-        def fn(params, ids, true_len, bt, k_pages, v_pages):
-            view = PagedCacheView(k_pages, v_pages, bt, true_len, block_size)
+        def fn(params, ids, true_len, bt, state):
+            view = view_from(state, bt, true_len, block_size)
             with no_grad():
                 logits = functional_call(
                     model, params, Tensor(ids), cache=view,
                     last_index=true_len - 1, training=False,
                 )
-            return logits.value, view.k_pages, view.v_pages
+            return logits.value, state_from(view)
 
         i32 = jnp.int32
         avals = (
-            {k: jax.ShapeDtypeStruct(v.shape, v.dtype) for k, v in self.params.items()},
+            self._param_avals(),
             jax.ShapeDtypeStruct((1, S), i32),
             jax.ShapeDtypeStruct((1,), i32),
             jax.ShapeDtypeStruct((1, self.max_pages), i32),
-            self._page_avals(),
-            self._page_avals(),
+            self._state_avals(),
         )
-        return self._jit(fn, 4, 4).lower(*avals).compile()
+        return self._jit(fn, 5).lower(*avals).compile()
 
     def _compile_decode(self, B: int):
         from ..core.tensor import Tensor
@@ -334,27 +396,62 @@ class InferenceEngine:
         from ..autograd import no_grad
 
         model, block_size = self._model, self.block_size
+        view_from, state_from = self._view_from_state, self._state_from_view
 
-        def fn(params, tokens, positions, seq_lens, bt, k_pages, v_pages):
-            view = PagedCacheView(k_pages, v_pages, bt, seq_lens, block_size)
+        def fn(params, tokens, positions, seq_lens, bt, state):
+            view = view_from(state, bt, seq_lens, block_size)
             with no_grad():
                 logits = functional_call(
                     model, params, Tensor(tokens[:, None]), cache=view,
                     positions=positions, training=False,
                 )
-            return logits.value[:, 0], view.k_pages, view.v_pages
+            return logits.value[:, 0], state_from(view)
 
         i32 = jnp.int32
         avals = (
-            {k: jax.ShapeDtypeStruct(v.shape, v.dtype) for k, v in self.params.items()},
+            self._param_avals(),
             jax.ShapeDtypeStruct((B,), i32),
             jax.ShapeDtypeStruct((B,), i32),
             jax.ShapeDtypeStruct((B,), i32),
             jax.ShapeDtypeStruct((B, self.max_pages), i32),
-            self._page_avals(),
-            self._page_avals(),
+            self._state_avals(),
         )
-        return self._jit(fn, 5, 5).lower(*avals).compile()
+        return self._jit(fn, 6).lower(*avals).compile()
+
+    def _compile_extend(self, B: int, Q: int):
+        """The extend/verify program (round 17): Q tokens per row written +
+        read through the paged cache in ONE call — speculative-decode
+        verify (1 committed token + k drafts) and chunked suffix prefill
+        (Q prompt tokens per step after a prefix-cache hit) both run here.
+        `valid` masks pad slots: their K/V writes are redirected to the
+        trash page and their logits are discarded host-side."""
+        from ..core.tensor import Tensor
+        from ..jit.api import functional_call
+        from ..autograd import no_grad
+
+        model, block_size = self._model, self.block_size
+        view_from, state_from = self._view_from_state, self._state_from_view
+
+        def fn(params, tokens, positions, valid, bt, state):
+            view = view_from(state, bt, positions[:, -1] + 1, block_size,
+                             write_mask=valid)
+            with no_grad():
+                logits = functional_call(
+                    model, params, Tensor(tokens), cache=view,
+                    positions=positions, training=False,
+                )
+            return logits.value, state_from(view)
+
+        i32 = jnp.int32
+        avals = (
+            self._param_avals(),
+            jax.ShapeDtypeStruct((B, Q), i32),
+            jax.ShapeDtypeStruct((B, Q), i32),
+            jax.ShapeDtypeStruct((B, Q), jnp.bool_),
+            jax.ShapeDtypeStruct((B, self.max_pages), i32),
+            self._state_avals(),
+        )
+        return self._jit(fn, 6).lower(*avals).compile()
 
     # ---- steps ----
     def prefill(self, prompt_ids: Sequence[int], pages: Sequence[int]) -> np.ndarray:
@@ -368,11 +465,11 @@ class InferenceEngine:
         ids[0, :L] = np.asarray(prompt_ids, np.int32)
         bt = np.asarray([self.pool.padded_table(pages, self.max_pages)], np.int32)
         ex = self._get_compiled("prefill", S)
-        logits, k_pages, v_pages = ex(
+        logits, state = ex(
             self.params, jnp.asarray(ids), jnp.asarray([L], jnp.int32),
-            jnp.asarray(bt), self.pool.k_pages, self.pool.v_pages,
+            jnp.asarray(bt), self.pool.device_state(),
         )
-        self.pool.adopt(k_pages, v_pages)
+        self.pool.adopt_state(state)
         return np.asarray(logits[0])
 
     def decode(
@@ -399,11 +496,51 @@ class InferenceEngine:
         for i, row in enumerate(page_rows):
             bt[i] = self.pool.padded_table(row, self.max_pages)
         ex = self._get_compiled("decode", B)
-        logits, k_pages, v_pages = ex(
+        logits, state = ex(
             self.params, jnp.asarray(tok), jnp.asarray(pos), jnp.asarray(lens),
-            jnp.asarray(bt), self.pool.k_pages, self.pool.v_pages,
+            jnp.asarray(bt), self.pool.device_state(),
         )
-        self.pool.adopt(k_pages, v_pages)
+        self.pool.adopt_state(state)
+        return np.asarray(logits[:n])
+
+    def extend(
+        self,
+        token_rows: Sequence[Sequence[int]],
+        position_rows: Sequence[Sequence[int]],
+        page_rows: Sequence[Sequence[int]],
+        q_len: int,
+    ) -> np.ndarray:
+        """One extend/verify step: row i consumes len(token_rows[i]) <=
+        q_len consecutive tokens at position_rows[i], writing their K/V and
+        returning next-token logits for EVERY consumed position —
+        [n, q_len, V] (pad slots hold garbage; callers read only their real
+        prefix). Speculative verify reads the whole greedy chain from one
+        call; chunked suffix prefill streams q_len prompt tokens per step."""
+        n = len(token_rows)
+        if n < 1:
+            raise ValueError("extend needs at least one sequence")
+        B = self.bucket_for("decode", n)
+        tok = np.zeros((B, q_len), np.int32)
+        pos = np.zeros((B, q_len), np.int32)
+        valid = np.zeros((B, q_len), bool)
+        bt = np.zeros((B, self.max_pages), np.int32)
+        for i, (toks, poss) in enumerate(zip(token_rows, position_rows)):
+            r = len(toks)
+            if r < 1 or r > q_len:
+                raise ValueError(f"extend row {i}: {r} tokens outside [1, {q_len}]")
+            if len(poss) != r:
+                raise ValueError(f"extend row {i}: positions/tokens length mismatch")
+            tok[i, :r] = np.asarray(toks, np.int32)
+            pos[i, :r] = np.asarray(poss, np.int32)
+            valid[i, :r] = True
+        for i, row in enumerate(page_rows):
+            bt[i] = self.pool.padded_table(row, self.max_pages)
+        ex = self._get_compiled("extend", (B, q_len))
+        logits, state = ex(
+            self.params, jnp.asarray(tok), jnp.asarray(pos),
+            jnp.asarray(valid), jnp.asarray(bt), self.pool.device_state(),
+        )
+        self.pool.adopt_state(state)
         return np.asarray(logits[:n])
 
     # ---- convenience: batch greedy generation through the scheduler ----

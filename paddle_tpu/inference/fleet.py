@@ -188,6 +188,8 @@ class ReplicaFleet:
         breaker_threshold: int = 2,
         heartbeat_deadline_s: Optional[float] = None,
         session_cache_size: int = 4096,
+        prefix_cache: bool = True,
+        spec_decode=None,
     ):
         if not engines:
             raise ValueError("ReplicaFleet needs at least one engine")
@@ -195,12 +197,17 @@ class ReplicaFleet:
         self.breaker_threshold = max(1, int(breaker_threshold))
         self.heartbeat_deadline_s = heartbeat_deadline_s
         self.session_cache_size = max(1, int(session_cache_size))
+        # round 17: every replica's scheduler gets the prefix cache (on by
+        # default — session affinity already routes a conversation to the
+        # replica holding its warm pages, so hits compound) and, opt-in,
+        # speculative decoding
         self.replicas: List[_Replica] = [
             _Replica(
                 i,
                 eng,
                 ContinuousBatchingScheduler(
-                    eng, eos_id=eos_id, max_running=max_running, clock=clock
+                    eng, eos_id=eos_id, max_running=max_running, clock=clock,
+                    prefix_cache=prefix_cache, spec_decode=spec_decode,
                 ),
             )
             for i, eng in enumerate(engines)

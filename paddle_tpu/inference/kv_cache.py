@@ -6,19 +6,49 @@ fixed-size pages drawn from one preallocated pool per layer, addressed
 through a per-sequence block table. Allocation is a host-side free-list
 (O(1) alloc/free, no compaction — pages are interchangeable), the device
 arrays are functional jax values the compiled prefill/decode steps thread
-through, and pool pressure is observable: total/used blocks, alloc/free
-counts, allocation failures (the scheduler's preemption trigger), and
-internal fragmentation (allocated-but-unwritten slots) all export through
-the PR 1 telemetry registry.
+through, and pool pressure is observable: total/used/shared/retained
+blocks, alloc/free counts, allocation failures (the scheduler's preemption
+trigger), and internal fragmentation all export through the PR 1 telemetry
+registry.
 
 Page 0 is RESERVED as the trash page: block tables are padded with 0 past
 a sequence's last real page, so masked reads land on a valid page (never a
 fault) and padded-position writes scribble somewhere harmless.
+
+Round 17 — prefix sharing + int8 storage:
+
+- Pages are REF-COUNTED. A page's KV depends on its whole token prefix, so
+  the pool keeps a hash index over FULL pages keyed by the chain digest of
+  every token up to and including the page (`prefix_chain_keys`): a new
+  request whose prompt extends a resident chain `share()`s those pages
+  (refcount+1) and prefill collapses to O(new suffix). Freeing decrements;
+  at refcount zero an INDEXED page is RETAINED (resident, evictable)
+  instead of returning to the free list, and `alloc()` reclaims retained
+  pages LRU-first when the free list runs short — eviction is LRU over
+  refcount-zero chains. The reserved trash page can never be registered.
+  Callers that free pages whose content must not be reused (preemption,
+  fleet evacuation) pass `retain=False`, which also drops index entries —
+  a freed-for-reuse page never lingers in the index.
+- Copy-on-write: `make_private()` clones a shared page into a fresh
+  exclusive one (device-side copy of K/V + scale planes) so a writer can
+  never scribble on a page another request still reads. Full-page-aligned
+  sharing means steady-state writes land past shared pages, but the
+  machinery guards every write range (scheduler growth loop) and is what
+  makes speculative-decode rollback and evacuate-resume races safe.
+- int8 KV (`kv_dtype="int8"`): pages store int8 with per-slot-per-kv-head
+  f32 scale planes `[N, bs, Hkv]` alongside — written slots are quantized
+  with the absmax observer rule (quantization/observers.absmax_scale — the
+  SAME math, not a fork) and dequantized on read inside the paged-attention
+  kernel/reference. ~4x pages per pool byte at head_dim 64 (scale overhead
+  4/head_dim), halved-or-better decode HBM traffic.
 """
 from __future__ import annotations
 
 import math
-from typing import List, Optional, Sequence, Tuple
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import hashlib
 
 from jax import numpy as jnp
 
@@ -26,7 +56,14 @@ from .. import telemetry
 from ..telemetry import metrics as _metrics
 from ..telemetry import request_trace as _rt
 
-__all__ = ["BlockPool", "PagedCacheView", "PoolExhausted", "TRASH_PAGE"]
+__all__ = [
+    "BlockPool",
+    "PagedCacheView",
+    "PoolExhausted",
+    "TRASH_PAGE",
+    "chain_extend",
+    "prefix_chain_keys",
+]
 
 TRASH_PAGE = 0  # reserved: block-table padding + padded-position writes
 
@@ -44,6 +81,38 @@ def _pool_gauge(state: str):
     ).labels(state=state)
 
 
+def _prefix_counter(event: str):
+    return _metrics.counter(
+        "paddle_tpu_kv_prefix_lookups_total",
+        "prefix-cache admission lookups by outcome",
+        label_names=("event",),
+    ).labels(event=event)
+
+
+def chain_extend(h: bytes, page_tokens: Sequence[int]) -> bytes:
+    """One chain-digest step: the key of the page holding `page_tokens`
+    given `h`, the key of the previous page (b"" at the chain head). The
+    key therefore commits to EVERY token up to and including this page —
+    a page's KV depends on its entire prefix, so the key must too (two
+    pages holding the same 16 tokens after different prefixes hold
+    different K/V). Append-only, so incremental callers (the scheduler's
+    per-step registration) pay O(block_size) per new page, not O(context)."""
+    digest = hashlib.blake2b(digest_size=16)
+    digest.update(h)
+    digest.update(b",".join(str(int(t)).encode() for t in page_tokens))
+    return digest.digest()
+
+
+def prefix_chain_keys(tokens: Sequence[int], block_size: int) -> List[bytes]:
+    """Chain digests for every FULL page of `tokens` (see chain_extend)."""
+    keys: List[bytes] = []
+    h = b""
+    for i in range(len(tokens) // block_size):
+        h = chain_extend(h, tokens[i * block_size:(i + 1) * block_size])
+        keys.append(h)
+    return keys
+
+
 class PagedCacheView:
     """Functional view of the pool's device arrays for ONE traced step.
 
@@ -51,22 +120,43 @@ class PagedCacheView:
     block tables [B, M] and seq_lens [B], and applies writes as functional
     `.at[].set` updates stored back on the view — the compiled step returns
     the updated arrays and the engine adopts them into the pool.
+
+    Quantized pools add per-layer scale planes (k_scales/v_scales,
+    [N, bs, Hkv] f32): `write` quantizes each slot with the absmax observer
+    rule and scatters value + scale together. `write_mask` [B, S] bool
+    (optional) redirects masked positions' writes to the trash page — the
+    engine's extend/verify program uses it to neutralize pad queries.
     """
 
     def __init__(self, k_pages: Sequence, v_pages: Sequence, block_tables,
-                 seq_lens, block_size: int):
+                 seq_lens, block_size: int, k_scales: Optional[Sequence] = None,
+                 v_scales: Optional[Sequence] = None, write_mask=None):
         self.k_pages = list(k_pages)
         self.v_pages = list(v_pages)
+        self.k_scales = list(k_scales) if k_scales is not None else None
+        self.v_scales = list(v_scales) if v_scales is not None else None
         self.block_tables = jnp.asarray(block_tables, jnp.int32)
         self.seq_lens = jnp.asarray(seq_lens, jnp.int32)
         self.block_size = int(block_size)
+        self.write_mask = write_mask
 
     @property
     def num_layers(self) -> int:
         return len(self.k_pages)
 
+    @property
+    def quantized(self) -> bool:
+        return self.k_scales is not None
+
     def layer(self, idx: int) -> Tuple:
         return self.k_pages[idx], self.v_pages[idx]
+
+    def scales(self, idx: int) -> Tuple:
+        """(k_scales, v_scales) for layer `idx`, or (None, None) on an
+        unquantized pool — shaped for flash_decode_paged's kwargs."""
+        if self.k_scales is None:
+            return None, None
+        return self.k_scales[idx], self.v_scales[idx]
 
     def write(self, idx: int, k_new, v_new, positions) -> None:
         """Scatter new K/V into layer `idx`'s pages.
@@ -74,14 +164,32 @@ class PagedCacheView:
         k_new/v_new [B, S, Hkv, D]; positions [B, S] int32 absolute token
         positions. Position p of row b lands in page block_tables[b, p//bs]
         slot p % bs; positions past a row's real pages hit table padding
-        (the trash page) by construction.
+        (the trash page) by construction, and write_mask=False positions
+        are redirected to the trash page explicitly.
         """
         positions = jnp.asarray(positions, jnp.int32)
         bs = self.block_size
         pages = jnp.take_along_axis(self.block_tables, positions // bs, axis=1)
+        if self.write_mask is not None:
+            pages = jnp.where(jnp.asarray(self.write_mask, bool), pages, TRASH_PAGE)
         slots = positions % bs
-        self.k_pages[idx] = self.k_pages[idx].at[pages, slots].set(k_new)
-        self.v_pages[idx] = self.v_pages[idx].at[pages, slots].set(v_new)
+        if self.k_scales is not None:
+            # int8 storage: per-slot-per-kv-head absmax scales — the
+            # observer rule (quantization/observers), applied per written
+            # token so appends never requantize resident slots
+            from ..quantization.observers import absmax_scale, quantize_absmax
+
+            k_sc = absmax_scale(k_new, axis=-1)  # [B, S, Hkv] f32
+            v_sc = absmax_scale(v_new, axis=-1)
+            k_q = quantize_absmax(k_new, k_sc[..., None])
+            v_q = quantize_absmax(v_new, v_sc[..., None])
+            self.k_pages[idx] = self.k_pages[idx].at[pages, slots].set(k_q)
+            self.v_pages[idx] = self.v_pages[idx].at[pages, slots].set(v_q)
+            self.k_scales[idx] = self.k_scales[idx].at[pages, slots].set(k_sc)
+            self.v_scales[idx] = self.v_scales[idx].at[pages, slots].set(v_sc)
+        else:
+            self.k_pages[idx] = self.k_pages[idx].at[pages, slots].set(k_new)
+            self.v_pages[idx] = self.v_pages[idx].at[pages, slots].set(v_new)
 
 
 class BlockPool:
@@ -90,40 +198,122 @@ class BlockPool:
     Device layout: per layer, k/v pages of shape
     [num_blocks, block_size, num_kv_heads, head_dim]. `num_blocks` INCLUDES
     the reserved trash page 0; usable capacity is num_blocks - 1 pages.
+    `kv_dtype="int8"` stores int8 pages with f32 scale planes alongside.
     """
 
     def __init__(self, num_blocks: int, block_size: int, num_layers: int,
-                 num_kv_heads: int, head_dim: int, dtype=jnp.float32):
+                 num_kv_heads: int, head_dim: int, dtype=jnp.float32,
+                 kv_dtype: Optional[str] = None):
         if num_blocks < 2:
             raise ValueError("BlockPool needs >= 2 blocks (page 0 is reserved)")
+        if kv_dtype not in (None, "int8"):
+            raise ValueError(f"unsupported kv_dtype {kv_dtype!r} (int8 or None)")
         self.num_blocks = int(num_blocks)
         self.block_size = int(block_size)
         self.num_layers = int(num_layers)
         self.num_kv_heads = int(num_kv_heads)
         self.head_dim = int(head_dim)
-        self.dtype = dtype
+        self.kv_dtype = kv_dtype
+        self.compute_dtype = dtype
+        self.dtype = jnp.int8 if kv_dtype == "int8" else dtype
         shape = (self.num_blocks, self.block_size, self.num_kv_heads, self.head_dim)
-        self.k_pages: List = [jnp.zeros(shape, dtype) for _ in range(self.num_layers)]
-        self.v_pages: List = [jnp.zeros(shape, dtype) for _ in range(self.num_layers)]
+        self.k_pages: List = [jnp.zeros(shape, self.dtype) for _ in range(self.num_layers)]
+        self.v_pages: List = [jnp.zeros(shape, self.dtype) for _ in range(self.num_layers)]
+        if kv_dtype == "int8":
+            sshape = shape[:3]
+            self.k_scales: Optional[List] = [
+                jnp.zeros(sshape, jnp.float32) for _ in range(self.num_layers)
+            ]
+            self.v_scales: Optional[List] = [
+                jnp.zeros(sshape, jnp.float32) for _ in range(self.num_layers)
+            ]
+        else:
+            self.k_scales = None
+            self.v_scales = None
         # LIFO free list: recently-freed (cache-warm) pages hand out first
         self._free: List[int] = list(range(self.num_blocks - 1, 0, -1))
+        # page -> refcount, for every page a request currently holds
+        self._refs: Dict[int, int] = {}
+        # refcount-zero pages kept resident for prefix reuse, LRU order
+        # (oldest first); values are the index keys they serve
+        self._retained: "OrderedDict[int, bytes]" = OrderedDict()
+        # prefix index: chain key -> page, page -> chain key
+        self._prefix: Dict[bytes, int] = {}
+        self._page_key: Dict[int, bytes] = {}
+        self.cow_copies = 0
         if telemetry.enabled():
             _pool_gauge("total").set(self.num_blocks - 1)
-            _pool_gauge("used").set(0)
+            self._sync_gauges()
 
-    # ---- allocator ----
+    @property
+    def quantized(self) -> bool:
+        return self.kv_dtype == "int8"
+
+    # ---- accounting ----
     def blocks_for_tokens(self, n_tokens: int) -> int:
         return max(1, math.ceil(n_tokens / self.block_size))
 
     def available(self) -> int:
-        return len(self._free)
+        """Pages alloc() can satisfy: free-list pages plus refcount-zero
+        retained pages (reclaimed LRU-first on demand)."""
+        return len(self._free) + len(self._retained)
 
     def used(self) -> int:
-        return (self.num_blocks - 1) - len(self._free)
+        """Pages some request currently holds (refcount >= 1); retained
+        prefix pages are evictable cache, not usage."""
+        return len(self._refs)
+
+    def shared(self) -> int:
+        """Pages held by more than one request."""
+        return sum(1 for r in self._refs.values() if r >= 2)
+
+    def retained(self) -> int:
+        return len(self._retained)
+
+    def refcount(self, page: int) -> int:
+        return self._refs.get(int(page), 0)
+
+    def page_bytes(self) -> int:
+        """Device bytes ONE page costs across all layers (K + V + scale
+        planes) — the bench's same-pool-bytes comparisons use this."""
+        slot = self.block_size * self.num_kv_heads
+        data = 2 * self.num_layers * slot * self.head_dim * jnp.dtype(self.dtype).itemsize
+        scales = 0
+        if self.quantized:
+            scales = 2 * self.num_layers * slot * 4
+        return data + scales
+
+    def pool_bytes(self) -> int:
+        return self.num_blocks * self.page_bytes()
+
+    def _sync_gauges(self) -> None:
+        _pool_gauge("used").set(self.used())
+        _pool_gauge("shared").set(self.shared())
+        _pool_gauge("retained").set(self.retained())
+
+    # ---- allocator ----
+    def _evict_retained(self, n: int) -> int:
+        """Reclaim up to `n` refcount-zero retained pages, LRU-first,
+        dropping their index entries; returns the number reclaimed."""
+        evicted = 0
+        while evicted < n and self._retained:
+            page, key = self._retained.popitem(last=False)
+            self._prefix.pop(key, None)
+            self._page_key.pop(page, None)
+            self._free.append(page)
+            evicted += 1
+        if evicted and telemetry.enabled():
+            _metrics.counter(
+                "paddle_tpu_kv_prefix_evictions_total",
+                "retained prefix pages reclaimed (LRU) to satisfy allocation",
+            ).inc(evicted)
+        return evicted
 
     def alloc(self, n: int, owner: Optional[int] = None) -> List[int]:
         """`owner` is the request id the pages are charged to (request-trace
         attribution only; the allocator itself is owner-blind)."""
+        if n > len(self._free):
+            self._evict_retained(n - len(self._free))
         if n > len(self._free):
             if telemetry.enabled():
                 _metrics.counter(
@@ -134,42 +324,90 @@ class BlockPool:
                 _rt.record_event("kv_pool", "alloc_failure", rid=owner,
                                  n=n, free=len(self._free))
             raise PoolExhausted(
-                f"paged KV pool exhausted: want {n} pages, {len(self._free)} free "
-                f"of {self.num_blocks - 1}"
+                f"paged KV pool exhausted: want {n} pages, {self.available()} "
+                f"reclaimable of {self.num_blocks - 1}"
             )
         out = [self._free.pop() for _ in range(n)]
+        for p in out:
+            self._refs[p] = 1
         if telemetry.enabled():
             _metrics.counter(
                 "paddle_tpu_kv_pool_allocs_total", "paged KV pool pages handed out"
             ).inc(n)
-            _pool_gauge("used").set(self.used())
+            self._sync_gauges()
         if _rt.enabled():
             # used-after rides every event: the report reconstructs the
             # pool-occupancy-over-time curve from these alone
             _rt.record_event("kv_pool", "alloc", rid=owner, n=n, used=self.used())
         return out
 
-    def free(self, pages: Sequence[int], owner: Optional[int] = None) -> None:
+    def share(self, pages: Sequence[int], owner: Optional[int] = None) -> None:
+        """Take an additional reference on already-resident pages (prefix
+        reuse). Retained (refcount-zero) pages revive back to active."""
+        for p in pages:
+            p = int(p)
+            if p == TRASH_PAGE:
+                raise ValueError("page 0 is reserved and never shared")
+            if p in self._refs:
+                self._refs[p] += 1
+            elif p in self._retained:
+                self._retained.pop(p)
+                self._refs[p] = 1
+            else:
+                raise ValueError(f"share of page {p} that is not resident")
+        if telemetry.enabled() and pages:
+            self._sync_gauges()
+        if _rt.enabled() and pages:
+            _rt.record_event("kv_pool", "share", rid=owner,
+                             n=len(pages), used=self.used())
+
+    def free(self, pages: Sequence[int], owner: Optional[int] = None,
+             retain: bool = True) -> None:
+        """Drop one reference per page. At refcount zero an INDEXED page is
+        retained for prefix reuse when `retain` (completion paths) — else
+        (preemption/evacuation: the content is conceptually discarded) its
+        index entry is dropped and the page returns to the free list."""
         for p in pages:
             p = int(p)
             if p == TRASH_PAGE:
                 raise ValueError("page 0 is reserved and never allocated")
-            if p in self._free:
+            ref = self._refs.get(p)
+            if ref is None:
                 raise ValueError(f"double free of page {p}")
-            self._free.append(p)
+            if ref > 1:
+                # another holder keeps the page alive: its content is
+                # immutable and cannot be recycled while refcount >= 1, so
+                # the index entry STAYS valid even when this freer is a
+                # preemption (the stale-chain hazard only exists for pages
+                # returning to the free list)
+                self._refs[p] = ref - 1
+                continue
+            del self._refs[p]
+            key = self._page_key.get(p)
+            if retain and key is not None:
+                self._retained[p] = key  # MRU end
+            else:
+                if key is not None:
+                    self._page_key.pop(p, None)
+                    self._prefix.pop(key, None)
+                self._free.append(p)
         if telemetry.enabled() and pages:
             _metrics.counter(
                 "paddle_tpu_kv_pool_frees_total", "paged KV pool pages returned"
             ).inc(len(pages))
-            _pool_gauge("used").set(self.used())
+            self._sync_gauges()
         if _rt.enabled() and pages:
             _rt.record_event("kv_pool", "free", rid=owner,
                              n=len(pages), used=self.used())
 
     def reset(self) -> None:
         self._free = list(range(self.num_blocks - 1, 0, -1))
+        self._refs.clear()
+        self._retained.clear()
+        self._prefix.clear()
+        self._page_key.clear()
         if telemetry.enabled():
-            _pool_gauge("used").set(0)
+            self._sync_gauges()
 
     def note_fragmentation(self, active_tokens: int) -> None:
         """Internal fragmentation: allocated slots minus live tokens — the
@@ -181,13 +419,137 @@ class BlockPool:
                 "allocated-but-unwritten KV slots (internal fragmentation)",
             ).set(self.used() * self.block_size - int(active_tokens))
 
+    # ---- prefix index ----
+    def register_prefix(self, key: bytes, page: int) -> bool:
+        """Publish a FULL, committed page under its chain key. First
+        registration wins (an identical chain is already served by the
+        earlier page); the reserved trash page and non-resident pages are
+        rejected — a page must be actively held (its content stable) to
+        enter the index."""
+        page = int(page)
+        if page == TRASH_PAGE:
+            raise ValueError("page 0 is reserved and never enters the prefix index")
+        if page not in self._refs:
+            raise ValueError(
+                f"page {page} is not actively held — only live pages register"
+            )
+        if key in self._prefix or page in self._page_key:
+            return False
+        self._prefix[key] = page
+        self._page_key[page] = key
+        return True
+
+    def acquire_prefix(self, keys: Sequence[bytes],
+                       owner: Optional[int] = None) -> List[int]:
+        """Longest-prefix lookup + share in one atomic host step: walk the
+        chain keys from page 0, stop at the first miss, take a reference on
+        every hit page, and return them (possibly empty). Counts hit/miss
+        lookups and cached tokens."""
+        pages: List[int] = []
+        for key in keys:
+            page = self._prefix.get(key)
+            if page is None or (page not in self._refs and page not in self._retained):
+                break
+            pages.append(page)
+        if pages:
+            self.share(pages, owner=owner)
+        if telemetry.enabled():
+            _prefix_counter("hit" if pages else "miss").inc()
+            if pages:
+                _metrics.counter(
+                    "paddle_tpu_kv_prefix_cached_tokens_total",
+                    "prompt tokens served from shared prefix pages instead of "
+                    "recomputed",
+                ).inc(len(pages) * self.block_size)
+        return pages
+
+    def prefix_index_size(self) -> int:
+        return len(self._prefix)
+
+    def invalidate_prefix(self) -> int:
+        """Drop EVERY index entry and release retained pages to the free
+        list; active pages stay held (their current readers are unaffected)
+        but no future request can share them. The weight hot-swap hook:
+        cached K/V was computed under the OLD parameters, so after
+        `engine.load_weights` a prefix hit would silently mix old-weight
+        keys/values into new-weight attention. Returns entries dropped."""
+        n = len(self._prefix)
+        self._prefix.clear()
+        self._page_key.clear()
+        while self._retained:
+            page, _ = self._retained.popitem(last=False)
+            self._free.append(page)
+        if telemetry.enabled():
+            if n:
+                _metrics.counter(
+                    "paddle_tpu_kv_prefix_invalidations_total",
+                    "prefix-index entries dropped wholesale (weight swap)",
+                ).inc(n)
+            self._sync_gauges()
+        return n
+
+    def is_indexed(self, page: int) -> bool:
+        return int(page) in self._page_key
+
+    # ---- copy-on-write ----
+    def make_private(self, page: int, owner: Optional[int] = None) -> int:
+        """Clone `page` into a freshly allocated exclusive page (device-side
+        copy of K/V and scale planes on every layer) and drop the caller's
+        reference on the original. The write-side half of copy-on-write:
+        call before writing into a page whose refcount > 1."""
+        page = int(page)
+        if page == TRASH_PAGE:
+            raise ValueError("page 0 is reserved; writes there are scribbles")
+        if page not in self._refs:
+            raise ValueError(f"make_private of page {page} that is not held")
+        (new,) = self.alloc(1, owner=owner)
+        for layer in range(self.num_layers):
+            self.k_pages[layer] = self.k_pages[layer].at[new].set(self.k_pages[layer][page])
+            self.v_pages[layer] = self.v_pages[layer].at[new].set(self.v_pages[layer][page])
+            if self.k_scales is not None:
+                self.k_scales[layer] = self.k_scales[layer].at[new].set(self.k_scales[layer][page])
+                self.v_scales[layer] = self.v_scales[layer].at[new].set(self.v_scales[layer][page])
+        # drop the caller's reference; the clone is NOT index-shareable (its
+        # divergent future writes are exactly why it was cloned)
+        self.free([page], owner=owner, retain=True)
+        self.cow_copies += 1
+        if telemetry.enabled():
+            _metrics.counter(
+                "paddle_tpu_kv_pool_cow_copies_total",
+                "shared pages cloned copy-on-write before a divergent write",
+            ).inc()
+        if _rt.enabled():
+            _rt.record_event("kv_pool", "cow", rid=owner, src=page, dst=new,
+                             used=self.used())
+        return new
+
     # ---- device-array plumbing ----
-    def view(self, block_tables, seq_lens) -> PagedCacheView:
+    def view(self, block_tables, seq_lens, write_mask=None) -> PagedCacheView:
         """Eager-path view over the pool's current arrays: run the model
-        with `cache=view`, then `adopt(view.k_pages, view.v_pages)`."""
+        with `cache=view`, then `adopt(view.k_pages, view.v_pages)` (or
+        `adopt_state(...)` on a quantized pool)."""
         return PagedCacheView(
-            self.k_pages, self.v_pages, block_tables, seq_lens, self.block_size
+            self.k_pages, self.v_pages, block_tables, seq_lens, self.block_size,
+            k_scales=self.k_scales, v_scales=self.v_scales, write_mask=write_mask,
         )
+
+    def device_state(self) -> Dict[str, List]:
+        """The pool's device arrays as ONE pytree, for threading through
+        compiled steps (donated whole; scale planes ride along when
+        quantized)."""
+        state = {"k": list(self.k_pages), "v": list(self.v_pages)}
+        if self.k_scales is not None:
+            state["k_scale"] = list(self.k_scales)
+            state["v_scale"] = list(self.v_scales)
+        return state
+
+    def adopt_state(self, state: Dict[str, List]) -> None:
+        self.adopt(state["k"], state["v"])
+        if self.k_scales is not None:
+            if "k_scale" not in state:
+                raise ValueError("quantized pool state is missing scale planes")
+            self.k_scales = list(state["k_scale"])
+            self.v_scales = list(state["v_scale"])
 
     def adopt(self, k_pages: Sequence, v_pages: Sequence) -> None:
         """Install a step's updated page arrays back into the pool."""

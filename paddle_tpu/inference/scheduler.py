@@ -25,23 +25,47 @@ outcome="cancelled"); the scheduler drains (`drain()` /
 (`evacuate()` — the preemption-resume path applied to every request at
 once) for the fleet's hot-swap and failure-survival protocols
 (inference/fleet.py).
+
+Round 17 — prefix sharing + speculative decoding:
+
+- Admission consults the pool's prefix index (`prefix_cache=True`,
+  default): a prompt whose leading FULL pages match a resident chain
+  shares those pages ref-counted (the last prompt token is always
+  recomputed — its logits emit the first generated token) and streams only
+  the suffix, so prefill work drops to O(new suffix) and shared system
+  prompts occupy the pool once. Every running request publishes its
+  committed full pages back into the index; completion retains them
+  (refcount-zero LRU), while preemption/evacuation frees with
+  retain=False so a recycled page can never serve a stale chain.
+- `spec_decode=SpecDecodeConfig(...)` turns decode steps into
+  draft-then-verify: an n-gram self-draft proposer guesses up to
+  `draft_len` continuation tokens from the request's own context, and ONE
+  engine.extend() call (the multi-query paged-attention program) verifies
+  the whole chain — each position's greedy argmax either matches the next
+  draft (accept, keep reading) or replaces it (reject; later drafts'
+  stale K/V writes sit past seq_len, masked and overwritten, and surplus
+  tail pages are rolled back to the pool). Greedy verify emits EXACTLY
+  the tokens plain decode would — byte-identical outputs, fewer steps.
+  Prompt streaming rides the same program `draft_len + 1` tokens per
+  step (chunked prefill at chunk granularity).
 """
 from __future__ import annotations
 
 import time
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from .. import telemetry
 from ..telemetry import metrics as _metrics
 from ..telemetry import request_trace as _rt
-from .kv_cache import PoolExhausted
+from .kv_cache import PoolExhausted, chain_extend, prefix_chain_keys
 
 __all__ = [
     "Request",
     "ContinuousBatchingScheduler",
+    "SpecDecodeConfig",
     "StaticBatchingScheduler",
     "replay",
     "percentiles",
@@ -85,6 +109,33 @@ def _queue_gauge(state: str):
     ).labels(state=state)
 
 
+def _spec_counter(event: str):
+    return _metrics.counter(
+        "paddle_tpu_spec_decode_tokens_total",
+        "speculative-decode tokens by event (drafted = proposed by the "
+        "n-gram self-draft, accepted = verified equal to the greedy chain)",
+        label_names=("event",),
+    ).labels(event=event)
+
+
+@dataclass
+class SpecDecodeConfig:
+    """Speculative decoding knobs: `draft_len` tokens are proposed per
+    decode step by an n-gram self-draft (the most recent earlier occurrence
+    of the context's final `ngram` tokens proposes its continuation — the
+    zero-extra-model proposer that exploits the repetition heavy serving
+    traffic actually has) and verified in one engine.extend() call."""
+
+    draft_len: int = 3
+    ngram: int = 2
+
+    def __post_init__(self):
+        if self.draft_len < 1:
+            raise ValueError("SpecDecodeConfig.draft_len must be >= 1")
+        if self.ngram < 1:
+            raise ValueError("SpecDecodeConfig.ngram must be >= 1")
+
+
 @dataclass
 class Request:
     """One generation request. `prompt` is token ids; the scheduler fills
@@ -122,6 +173,17 @@ class Request:
     # recompute-on-resume: prompt tokens re-prefilled after a preemption
     # include the already-generated prefix; `_prompt_len` keeps the original
     _prompt_len: Optional[int] = None
+    # prefix cache: prompt tokens served from shared pages instead of
+    # recomputed (cumulative across resumes); speculative decoding: tokens
+    # proposed by the draft / verified equal to the greedy chain
+    cached_tokens: int = 0
+    drafted: int = 0
+    accepted: int = 0
+    # committed full pages already published into the prefix index, and
+    # the chain digest AFTER them (== the last registered page's key) so
+    # each new page's key costs O(block_size), not O(context)
+    _registered_pages: int = 0
+    _chain_digest: bytes = b""
     # request-scoped trace handle (telemetry.request_trace) — None unless
     # FLAGS_request_trace sampled this request; travels WITH the request
     # across preemption/evacuation/re-dispatch so the phase chain stays
@@ -165,13 +227,17 @@ class ContinuousBatchingScheduler:
 
     def __init__(self, engine, *, max_running: Optional[int] = None,
                  eos_id: Optional[int] = None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 prefix_cache: bool = True,
+                 spec_decode: Optional[SpecDecodeConfig] = None):
         self.engine = engine
         self.max_running = int(max_running or engine.max_batch)
         if self.max_running > engine.max_batch:
             raise ValueError("max_running exceeds the engine's decode capacity")
         self.eos_id = eos_id
         self.clock = clock
+        self.prefix_cache = bool(prefix_cache)
+        self.spec = spec_decode
         self.waiting: List[Request] = []
         self.running: List[Request] = []
         self.finished: List[Request] = []
@@ -246,7 +312,10 @@ class ContinuousBatchingScheduler:
     def _finish(self, req: Request, now: float) -> None:
         req.finish_time = now
         req.outcome = req.outcome or "completed"
-        self.engine.pool.free(req.pages, owner=req.rid)
+        # retain=True: a finished request's registered (committed, full)
+        # pages stay resident at refcount zero, LRU-evictable — the warm
+        # prefix cache a follow-on request with the same system prompt hits
+        self.engine.pool.free(req.pages, owner=req.rid, retain=True)
         req.pages = []
         self.finished.append(req)
         if req.trace is not None:
@@ -254,6 +323,9 @@ class ContinuousBatchingScheduler:
                 now, req.outcome,
                 generated=(len(req.prompt) - req.prompt_len) + len(req.generated),
                 preemptions=req.preemptions,
+                cached_tokens=req.cached_tokens,
+                drafted=req.drafted,
+                accepted=req.accepted,
             )
         if telemetry.enabled():
             _req_counter().labels(event=req.outcome).inc()
@@ -303,6 +375,8 @@ class ContinuousBatchingScheduler:
         req.prompt = req.prompt + req.generated
         req.generated = []
         req.cursor = 0
+        req._registered_pages = 0
+        req._chain_digest = b""
         return req
 
     def _preempt_one(self) -> bool:
@@ -316,7 +390,11 @@ class ContinuousBatchingScheduler:
             key=lambda r: (r.first_token_time is None, r.first_token_time or 0.0, r.rid),
         )
         self.running.remove(victim)
-        self.engine.pool.free(victim.pages, owner=victim.rid)
+        # retain=False: an evicted context is conceptually discarded — its
+        # refcount-zero pages go straight back to the free list and their
+        # index entries drop, so a preemption-freed page can NEVER serve a
+        # later prefix hit after being overwritten by a new owner
+        self.engine.pool.free(victim.pages, owner=victim.rid, retain=False)
         victim.pages = []
         self._reset_for_resume(victim)
         victim.preemptions += 1
@@ -340,7 +418,9 @@ class ContinuousBatchingScheduler:
         evacuated: List[Request] = []
         now = self.clock()
         for req in self.running:
-            self.engine.pool.free(req.pages, owner=req.rid)
+            # same retain=False contract as preemption (the PR 11 path):
+            # evacuated pages leave the index before they can be recycled
+            self.engine.pool.free(req.pages, owner=req.rid, retain=False)
             req.pages = []
             evacuated.append(self._reset_for_resume(req))
         # waiting requests hold no pages; a preemption-requeued one is
@@ -401,12 +481,26 @@ class ContinuousBatchingScheduler:
         — instead the prompt is STREAMED through the request's own decode
         slot one token per step (chunked prefill at token granularity), so
         admission never stalls anyone else's decode cadence.
+
+        Round 17: admission consults the prefix index first. A hit shares
+        the resident pages (refcounted) and ALWAYS streams — only the
+        un-cached suffix flows through decode slots, and the bucketed
+        prefill (which writes every prompt position) never touches shared
+        pages. The last prompt token is never served from cache: its
+        logits emit the first generated token, so at least one position
+        always recomputes.
         """
         if self.draining or not self.waiting or len(self.running) >= self.max_running:
             return None
         req = self.waiting[0]
         pool = self.engine.pool
-        if not self.running:
+        shared: List[int] = []
+        if self.prefix_cache and req.cursor == 0:
+            n_shareable = (len(req.prompt) - 1) // pool.block_size
+            if n_shareable > 0:
+                keys = prefix_chain_keys(req.prompt, pool.block_size)[:n_shareable]
+                shared = pool.acquire_prefix(keys, owner=req.rid)
+        if not self.running and not shared:
             need = pool.blocks_for_tokens(len(req.prompt) + 1)
             if need <= pool.available():
                 self.waiting.pop(0)
@@ -420,27 +514,169 @@ class ContinuousBatchingScheduler:
                 self._emit_token(req, logits, self.clock())
                 if not req.done:
                     self.running.append(req)
+                self._register_committed(req)
                 return 1
+            # bucketed allocation doesn't fit: fall through and stream the
+            # prompt page-by-page instead (the pool-constrained path)
+        # streamed admission: one fresh page holds the first uncached write
         if pool.available() < 1:
+            if shared:
+                # admission blocked after the lookup took refs — hand them
+                # back (retained, still indexed) so nothing leaks
+                pool.free(shared, owner=req.rid, retain=True)
             return None
         self.waiting.pop(0)
-        req.pages = pool.alloc(1, owner=req.rid)
-        req.cursor = 0
+        cached = len(shared) * pool.block_size
+        req.pages = list(shared) + pool.alloc(1, owner=req.rid)
+        req.cursor = cached
+        req.cached_tokens += cached
+        # shared pages are already indexed; the chain digest resumes from
+        # the last hit page's key (keys ARE the chain digests)
+        req._registered_pages = len(shared)
+        req._chain_digest = keys[len(shared) - 1] if shared else b""
         self.running.append(req)
         if req.trace is not None:
-            self._trace_admit(req, mode="streamed")
+            self._trace_admit(req, mode="streamed", cached=cached)
         if telemetry.enabled():
             _req_counter().labels(event="admitted").inc()
         return 0
 
-    def _trace_admit(self, req: Request, mode: str) -> None:
+    def _trace_admit(self, req: Request, mode: str, cached: int = 0) -> None:
         """Open the prefill span; `recompute_tokens` counts the generated
         prefix folded into the prompt by preemption/evacuation — the K/V
-        this prefill rebuilds rather than computes for the first time."""
+        this prefill rebuilds rather than computes for the first time —
+        and `cached_tokens` the prompt tokens served from shared prefix
+        pages (never recomputed at all)."""
         req.trace.phase(
             "prefill", self.clock(), mode=mode,
             recompute_tokens=len(req.prompt) - req.prompt_len,
+            cached_tokens=cached,
         )
+
+    # ---- prefix-index registration ----
+    def _kv_committed(self, req: Request) -> int:
+        """Cache positions holding FINAL K/V: a streaming request has
+        written [0, cursor); a generating one everything except the newest
+        token (whose K/V lands when it is fed back in)."""
+        if req.cursor < len(req.prompt):
+            return req.cursor
+        return req.context_len - 1
+
+    def _register_committed(self, req: Request) -> None:
+        """Publish the request's committed FULL pages into the prefix
+        index (idempotent; shared pages are already registered). Draft
+        positions are never committed, so a speculatively-written page can
+        only register after its tokens are verified."""
+        if not self.prefix_cache or not req.pages:
+            return
+        pool = self.engine.pool
+        bs = pool.block_size
+        full = self._kv_committed(req) // bs
+        if full <= req._registered_pages:
+            return
+        tokens = req.prompt + req.generated
+        h = req._chain_digest
+        for i in range(req._registered_pages, full):
+            h = chain_extend(h, tokens[i * bs:(i + 1) * bs])
+            pool.register_prefix(h, req.pages[i])
+        req._chain_digest = h
+        req._registered_pages = full
+
+    # ---- speculative decoding ----
+    def _propose_ngram(self, req: Request, k: int) -> List[int]:
+        """n-gram self-draft: the most recent earlier occurrence of the
+        context's final `ngram` tokens proposes the k tokens that followed
+        it. Zero extra model weights; exact greedy verify makes a bad guess
+        cost only wasted FLOPs, never a wrong token."""
+        n = self.spec.ngram
+        seq = req.prompt + req.generated
+        if k <= 0 or len(seq) <= n:
+            return []
+        tail = seq[-n:]
+        for i in range(len(seq) - n - 1, -1, -1):
+            if seq[i:i + n] == tail:
+                return list(seq[i + n:i + n + k])
+        return []
+
+    def _plan_row(self, req: Request) -> Tuple[str, List[int], List[int]]:
+        """One request's extend-row plan: (kind, tokens, positions).
+        Streaming rows chunk up to Q prompt tokens per step (chunked
+        prefill at chunk granularity); generating rows carry the committed
+        last token plus up to draft_len n-gram drafts to verify."""
+        Q = self.spec.draft_len + 1
+        if req.cursor < len(req.prompt):
+            take = min(Q, len(req.prompt) - req.cursor)
+            toks = list(req.prompt[req.cursor:req.cursor + take])
+            poss = list(range(req.cursor, req.cursor + take))
+            return "stream", toks, poss
+        ctx = req.context_len
+        total_gen = (len(req.prompt) - req.prompt_len) + len(req.generated)
+        rem = req.max_new_tokens - total_gen
+        # a chain of d drafts can emit d+1 tokens and writes K/V through
+        # position ctx-1+d — cap by the generation budget AND the table
+        budget = min(self.spec.draft_len, rem - 1,
+                     self.engine.max_seq_len - ctx)
+        drafts = self._propose_ngram(req, budget) if budget > 0 else []
+        if drafts:
+            req.drafted += len(drafts)
+            if telemetry.enabled():
+                _spec_counter("drafted").inc(len(drafts))
+        toks = [req.generated[-1]] + drafts
+        poss = list(range(ctx - 1, ctx - 1 + len(toks)))
+        return "draft", toks, poss
+
+    def _spec_decode_step(self, alive: List[Request], plans: Dict) -> int:
+        """One verify/extend tick: every alive row's plan runs through a
+        single engine.extend() call; draft rows commit their greedy-
+        verified chain (byte-identical to plain decode — each emitted token
+        IS the argmax the plain path would have produced), then roll back
+        surplus tail pages the rejected drafts grew."""
+        pool = self.engine.pool
+        Q = self.spec.draft_len + 1
+        logits = self.engine.extend(
+            [plans[r.rid][1] for r in alive],
+            [plans[r.rid][2] for r in alive],
+            [r.pages for r in alive],
+            q_len=Q,
+        )
+        now = self.clock()
+        produced = 0
+        for i, r in enumerate(alive):
+            kind, toks, _poss = plans[r.rid]
+            if kind == "stream":
+                r.cursor += len(toks)
+                if r.cursor == len(r.prompt):
+                    # the last prompt token's logits ARE the first
+                    # generated token
+                    self._emit_token(r, logits[i, len(toks) - 1], now)
+                    produced += 1
+                continue
+            drafts = toks[1:]
+            j = 0
+            while j < len(toks):
+                self._emit_token(r, logits[i, j], now)
+                produced += 1
+                if r.done:
+                    break
+                if j < len(drafts) and drafts[j] == r.generated[-1]:
+                    # draft j matches the greedy chain: its K/V is already
+                    # written and logits[i, j+1] verified it — keep reading
+                    r.accepted += 1
+                    if telemetry.enabled():
+                        _spec_counter("accepted").inc()
+                    j += 1
+                else:
+                    break
+            if not r.done and drafts:
+                # rollback: rejected drafts' stale K/V sits past seq_len
+                # (masked, overwritten on commit); surplus TAIL pages the
+                # draft chain grew go back to the pool now — they are
+                # exclusively owned and never registered (only committed
+                # full pages enter the index)
+                keep = pool.blocks_for_tokens(self._tokens_needed(r))
+                while len(r.pages) > keep:
+                    pool.free([r.pages.pop()], owner=r.rid, retain=False)
+        return produced
 
     def step(self) -> int:
         """One scheduler tick; returns the number of tokens produced."""
@@ -460,7 +696,14 @@ class ContinuousBatchingScheduler:
                 self._sync_gauges()
             return produced
 
-        # growth: every running sequence needs a page covering the K/V slot
+        # speculative plans first: growth must cover every position the
+        # draft chain will write, not just the next token
+        plans: Dict[int, Tuple[str, List[int], List[int]]] = {}
+        if self.spec is not None:
+            for req in self.running:
+                plans[req.rid] = self._plan_row(req)
+
+        # growth: every running sequence needs pages covering the K/V slots
         # this step writes; allocate at block boundaries, preempting until
         # the pool yields one
         pool = self.engine.pool
@@ -469,7 +712,10 @@ class ContinuousBatchingScheduler:
                 # evicted by an earlier iteration's preemption — allocating
                 # into it now would leak the page at re-admission
                 continue
-            need_tokens = self._tokens_needed(req)
+            if self.spec is not None:
+                need_tokens = plans[req.rid][2][-1] + 1
+            else:
+                need_tokens = self._tokens_needed(req)
             if need_tokens > self.engine.max_seq_len:
                 # capacity guard (submit() bounds this; belt-and-braces)
                 self._finish(req, self.clock())
@@ -484,9 +730,28 @@ class ContinuousBatchingScheduler:
                         raise
                     if req not in self.running:
                         break  # we were the victim
+            # copy-on-write guard: no position this step writes may land in
+            # a page another request still reads. Full-page-aligned sharing
+            # makes this structurally unreachable in steady state, but the
+            # evacuate/resume and rollback races are exactly where a silent
+            # scribble would corrupt a neighbor — clone instead.
+            if req in self.running and req.pages:
+                if self.spec is not None:
+                    _, _, poss = plans[req.rid]
+                    lo, hi = poss[0], poss[-1]
+                else:
+                    hi = self._tokens_needed(req) - 1
+                    lo = hi
+                for pi in range(lo // pool.block_size,
+                                min(hi // pool.block_size, len(req.pages) - 1) + 1):
+                    if pool.refcount(req.pages[pi]) > 1:
+                        req.pages[pi] = pool.make_private(req.pages[pi], owner=req.rid)
         alive = [r for r in self.running if r.pages]
 
-        if alive:
+        if alive and self.spec is not None:
+            produced += self._spec_decode_step(alive, plans)
+            self.running = [r for r in self.running if not r.done]
+        elif alive:
             rows = []
             for r in alive:
                 if r.cursor < len(r.prompt):  # streaming its prompt in
@@ -512,6 +777,9 @@ class ContinuousBatchingScheduler:
                     self._emit_token(r, lg, now)
                     produced += 1
             self.running = [r for r in self.running if not r.done]
+        if self.prefix_cache:
+            for r in self.running:
+                self._register_committed(r)
         if telemetry.enabled():
             self._sync_gauges()
             active_tokens = sum(self._tokens_needed(r) for r in self.running)

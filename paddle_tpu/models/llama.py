@@ -106,7 +106,7 @@ class LlamaAttention(nn.Layer):
             return self.o_proj(out)
 
         # ---- serving cache mode (inference-only) ----
-        from ..ops.pallas import flash_decode_paged
+        from ..ops.pallas import flash_decode_paged, flash_decode_paged_multi
 
         max_pos = cache.block_tables.shape[1] * cache.block_size
         if positions is None:
@@ -116,19 +116,31 @@ class LlamaAttention(nn.Layer):
             pos2d = jnp.asarray(raw_pos, jnp.int32).reshape(b, -1)
         qr, kr = _rope(q.value, k.value, positions=pos2d, max_pos=max_pos)
         cache.write(self.layer_idx, kr, v.value, pos2d)
-        if s == 1:
-            kp, vp = cache.layer(self.layer_idx)
-            out = flash_decode_paged(
-                qr[:, 0], kp, vp, cache.block_tables, cache.seq_lens
-            )[:, None]  # [B, 1, H, D]
-            out_t = Tensor(out)
-        else:
+        if positions is None:
             # prefill: the context IS this call's k/v — normal causal
             # attention; padded tail positions produce discarded rows (their
             # queries only ever see real keys at or before themselves)
             out_t = F.scaled_dot_product_attention(
                 Tensor(qr), Tensor(kr), v, is_causal=True, training=False
             )
+        else:
+            kp, vp = cache.layer(self.layer_idx)
+            ks, vs = cache.scales(self.layer_idx)
+            if s == 1:
+                out = flash_decode_paged(
+                    qr[:, 0], kp, vp, cache.block_tables, cache.seq_lens,
+                    k_scales=ks, v_scales=vs,
+                )[:, None]  # [B, 1, H, D]
+            else:
+                # extend/verify: s > 1 explicit positions — every query
+                # reads the PAGED context up through its own position (the
+                # K/V for all s tokens was just written above), the
+                # speculative-verify / chunked-suffix-prefill layout
+                out = flash_decode_paged_multi(
+                    qr, kp, vp, cache.block_tables, pos2d,
+                    k_scales=ks, v_scales=vs,
+                )
+            out_t = Tensor(out)
         out_t = manip.reshape(out_t, [b, s, self.num_heads * self.head_dim])
         return self.o_proj(out_t)
 

@@ -841,37 +841,83 @@ def paged_decode_usable(q, k_pages) -> bool:
     return hkv <= h and h % hkv == 0
 
 
-def paged_decode_reference(q, k_pages, v_pages, block_tables, seq_lens, sm_scale=None):
+def _dequant_pages(pages, scales):
+    """int8 pages [*, bs, Hkv, D] + per-slot absmax scale planes
+    [*, bs, Hkv] -> f32 values, via the OBSERVERS' dequant rule (the write
+    side quantized with their grid — read and write must share one
+    implementation; the in-kernel dequant mirrors it and the lockstep
+    interpret==reference tests keep the two from drifting)."""
+    from ..quantization.observers import dequantize_absmax
+
+    return dequantize_absmax(pages, jnp.asarray(scales, jnp.float32)[..., None])
+
+
+def paged_decode_reference(q, k_pages, v_pages, block_tables, seq_lens,
+                           sm_scale=None, k_scales=None, v_scales=None):
     """jnp oracle for the paged decode kernel (and the off-TPU dispatch
     path). Same accumulation discipline as the kernel: f32 logits via
     preferred_element_type, probabilities cast to the storage dtype before
-    the value matmul. q [B, H, D] -> [B, H, D]."""
-    b, h, d = q.shape
+    the value matmul (f32 throughout on an int8 pool — the kernel
+    dequantizes into f32 VMEM). q [B, H, D] -> [B, H, D]."""
+    q_positions = jnp.asarray(seq_lens, jnp.int32) - 1
+    out = paged_extend_reference(
+        q[:, None], k_pages, v_pages, block_tables, q_positions[:, None],
+        sm_scale=sm_scale, k_scales=k_scales, v_scales=v_scales,
+    )
+    return out[:, 0]
+
+
+def paged_extend_reference(q, k_pages, v_pages, block_tables, q_positions,
+                           sm_scale=None, k_scales=None, v_scales=None):
+    """jnp oracle for the MULTI-query paged kernel: q [B, Q, H, D] holds Q
+    query tokens per sequence; query j of row b attends to every cache
+    position <= q_positions[b, j] (each draft/suffix token sees the context
+    up through itself — the per-query causal frontier). Returns
+    [B, Q, H, D]. The single-query decode is the Q == 1 special case with
+    q_positions = seq_lens - 1."""
+    b, qn, h, d = q.shape
     n, bs, hkv, _ = k_pages.shape
     group = h // hkv
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
     block_tables = jnp.asarray(block_tables, jnp.int32)
-    seq_lens = jnp.asarray(seq_lens, jnp.int32)
+    q_positions = jnp.asarray(q_positions, jnp.int32)
+    quantized = k_scales is not None
 
-    def one(qb, bt, sl):
+    def one(qb, bt, qp):
         # gather this sequence's pages -> a contiguous [S, Hkv, D] view
-        k = k_pages[bt].reshape(-1, hkv, d)
-        v = v_pages[bt].reshape(-1, hkv, d)
+        if quantized:
+            k = _dequant_pages(k_pages[bt], k_scales[bt]).reshape(-1, hkv, d)
+            v = _dequant_pages(v_pages[bt], v_scales[bt]).reshape(-1, hkv, d)
+        else:
+            k = k_pages[bt].reshape(-1, hkv, d)
+            v = v_pages[bt].reshape(-1, hkv, d)
         kg = repeat_kv(k[None], group)[0]  # [S, H, D], kernel head order
         vg = repeat_kv(v[None], group)[0]
         logits = jnp.einsum(
-            "hd,shd->hs", qb, kg, preferred_element_type=jnp.float32
+            "qhd,shd->qhs", qb, kg, preferred_element_type=jnp.float32
         ) * scale
         pos = jnp.arange(kg.shape[0], dtype=jnp.int32)
-        logits = jnp.where(pos[None, :] < sl, logits, -1e30)
-        p = jax.nn.softmax(logits, axis=-1).astype(qb.dtype)
-        return jnp.einsum("hs,shd->hd", p, vg, preferred_element_type=jnp.float32).astype(qb.dtype)
+        logits = jnp.where(pos[None, None, :] <= qp[:, None, None], logits, -1e30)
+        p = jax.nn.softmax(logits, axis=-1).astype(vg.dtype)
+        return jnp.einsum(
+            "qhs,shd->qhd", p, vg, preferred_element_type=jnp.float32
+        ).astype(qb.dtype)
 
-    return jax.vmap(one)(q, block_tables, seq_lens)
+    return jax.vmap(one)(q, block_tables, q_positions)
 
 
-def _paged_decode_kernel(bs, d, group, scale):
-    def kernel(bt_ref, seq_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr):
+def _paged_attn_kernel(bs, d, group, q_count, scale, quantized):
+    """Unified paged-attention kernel body: Q >= 1 query tokens per
+    sequence packed as rows [Q * group, d] (query-major, so row r is query
+    r // group of kv-head-group slot r % group), each masked to its own
+    causal frontier q_positions[b, r // group]. `quantized` adds per-page
+    scale-plane operands and dequantizes into f32 before the matmuls."""
+
+    def kernel(bt_ref, qpos_ref, q_ref, k_ref, v_ref, *rest):
+        if quantized:
+            ksc_ref, vsc_ref, o_ref, m_scr, l_scr, acc_scr = rest
+        else:
+            o_ref, m_scr, l_scr, acc_scr = rest
         b = pl.program_id(0)
         i = pl.program_id(2)
 
@@ -881,13 +927,20 @@ def _paged_decode_kernel(bs, d, group, scale):
             l_scr[...] = jnp.zeros_like(l_scr)
             acc_scr[...] = jnp.zeros_like(acc_scr)
 
-        sl = seq_ref[b]
-        qb = q_ref[...]  # [group, d] — storage dtype, MXU at bf16 rate
-        kb = k_ref[...]  # [bs, d]   — one page of this kv head
+        qb = q_ref[...]  # [Q*group, d] — storage dtype, MXU at bf16 rate
+        kb = k_ref[...]  # [bs, d]      — one page of this kv head
         vb = v_ref[...]
-        logits = _dot_nt(qb, kb) * scale  # [group, bs] f32
+        if quantized:
+            kb = kb.astype(jnp.float32) * (ksc_ref[...] * (1.0 / 127.0))[:, None]
+            vb = vb.astype(jnp.float32) * (vsc_ref[...] * (1.0 / 127.0))[:, None]
+        logits = _dot_nt(qb, kb) * scale  # [Q*group, bs] f32
         pos = i * bs + lax.broadcasted_iota(jnp.int32, (group, bs), 1)
-        logits = jnp.where(pos < sl, logits, -1e30)
+        # per-query frontier: Q is static and small, so the mask unrolls as
+        # Q scalar-prefetch reads (SMEM scalars never vector-gather)
+        mask = jnp.concatenate(
+            [pos <= qpos_ref[b, qi] for qi in range(q_count)], axis=0
+        )
+        logits = jnp.where(mask, logits, -1e30)
         m_prev = m_scr[...]
         m_new = jnp.maximum(m_prev, jnp.max(logits, axis=-1, keepdims=True))
         p = jnp.exp(logits - m_new)
@@ -905,29 +958,49 @@ def _paged_decode_kernel(bs, d, group, scale):
     return kernel
 
 
-def _paged_decode_impl(q, k_pages, v_pages, block_tables, seq_lens, sm_scale):
-    b, h, d = q.shape
+def _paged_extend_impl(q, k_pages, v_pages, block_tables, q_positions,
+                       sm_scale, k_scales=None, v_scales=None):
+    b, qn, h, d = q.shape
     n, bs, hkv, _ = k_pages.shape
     group = h // hkv
+    rows = qn * group
     m = block_tables.shape[1]
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
-    qg = q.reshape(b, hkv, group, d)  # q head j = kv head j//group's group
+    quantized = k_scales is not None
+    # pack queries query-major per kv head: row qi*group + g is query qi of
+    # group slot g (q head hi*group + g reads kv head hi)
+    qg = (
+        q.reshape(b, qn, hkv, group, d)
+        .transpose(0, 2, 1, 3, 4)
+        .reshape(b, hkv, rows, d)
+    )
 
+    page_spec = pl.BlockSpec(
+        (None, bs, None, d), lambda bi, hi, pi, bt, qp: (bt[bi, pi], 0, hi, 0)
+    )
+    scale_spec = pl.BlockSpec(
+        (None, bs, None), lambda bi, hi, pi, bt, qp: (bt[bi, pi], 0, hi)
+    )
+    in_specs = [
+        pl.BlockSpec((None, None, rows, d), lambda bi, hi, pi, *_: (bi, hi, 0, 0)),
+        # page fetch: the block table names the pool page for grid step
+        # (bi, pi); padded table entries point at the reserved page 0
+        page_spec,
+        page_spec,
+    ]
+    operands = [qg, k_pages, v_pages]
+    if quantized:
+        in_specs += [scale_spec, scale_spec]
+        operands += [k_scales, v_scales]
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,  # block table + seq lens drive the index maps
+        num_scalar_prefetch=2,  # block table + query frontiers drive the maps
         grid=(b, hkv, m),
-        in_specs=[
-            pl.BlockSpec((None, None, group, d), lambda bi, hi, pi, *_: (bi, hi, 0, 0)),
-            # page fetch: the block table names the pool page for grid step
-            # (bi, pi); padded table entries point at the reserved page 0
-            pl.BlockSpec((None, bs, None, d), lambda bi, hi, pi, bt, sl: (bt[bi, pi], 0, hi, 0)),
-            pl.BlockSpec((None, bs, None, d), lambda bi, hi, pi, bt, sl: (bt[bi, pi], 0, hi, 0)),
-        ],
-        out_specs=pl.BlockSpec((None, None, group, d), lambda bi, hi, pi, *_: (bi, hi, 0, 0)),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((None, None, rows, d), lambda bi, hi, pi, *_: (bi, hi, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((group, 1), jnp.float32),
-            pltpu.VMEM((group, 1), jnp.float32),
-            pltpu.VMEM((group, d), jnp.float32),
+            pltpu.VMEM((rows, 1), jnp.float32),
+            pltpu.VMEM((rows, 1), jnp.float32),
+            pltpu.VMEM((rows, d), jnp.float32),
         ],
     )
     # the page axis REVISITS the (bi, hi) accumulator scratch + out block on
@@ -938,21 +1011,64 @@ def _paged_decode_impl(q, k_pages, v_pages, block_tables, seq_lens, sm_scale):
         vmem_limit_bytes=_VMEM_LIMIT,
     )
     out = pl.pallas_call(
-        _paged_decode_kernel(bs, d, group, scale),
+        _paged_attn_kernel(bs, d, group, qn, scale, quantized),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, hkv, group, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, hkv, rows, d), q.dtype),
         compiler_params=params,
         interpret=_INTERPRET,
-    )(block_tables, seq_lens, qg, k_pages, v_pages)
-    return out.reshape(b, h, d)
+    )(block_tables, q_positions, *operands)
+    return (
+        out.reshape(b, hkv, qn, group, d)
+        .transpose(0, 2, 1, 3, 4)
+        .reshape(b, qn, h, d)
+    )
+
+
+def _paged_decode_impl(q, k_pages, v_pages, block_tables, seq_lens, sm_scale,
+                       k_scales=None, v_scales=None):
+    q_positions = (jnp.asarray(seq_lens, jnp.int32) - 1)[:, None]
+    out = _paged_extend_impl(
+        q[:, None], k_pages, v_pages, block_tables, q_positions, sm_scale,
+        k_scales=k_scales, v_scales=v_scales,
+    )
+    return out[:, 0]
 
 
 @functools.partial(jax.jit, static_argnames=("sm_scale",))
-def _paged_decode_jit(q, k_pages, v_pages, block_tables, seq_lens, sm_scale=None):
-    return _paged_decode_impl(q, k_pages, v_pages, block_tables, seq_lens, sm_scale)
+def _paged_decode_jit(q, k_pages, v_pages, block_tables, seq_lens,
+                      sm_scale=None, k_scales=None, v_scales=None):
+    return _paged_decode_impl(q, k_pages, v_pages, block_tables, seq_lens,
+                              sm_scale, k_scales=k_scales, v_scales=v_scales)
 
 
-def flash_decode_paged(q, k_pages, v_pages, block_tables, seq_lens, sm_scale=None):
+@functools.partial(jax.jit, static_argnames=("sm_scale",))
+def _paged_extend_jit(q, k_pages, v_pages, block_tables, q_positions,
+                      sm_scale=None, k_scales=None, v_scales=None):
+    return _paged_extend_impl(q, k_pages, v_pages, block_tables, q_positions,
+                              sm_scale, k_scales=k_scales, v_scales=v_scales)
+
+
+def _validate_paged(q, k_pages, k_scales, v_scales, fname):
+    if q.shape[-1] != k_pages.shape[3]:
+        raise ValueError(
+            f"{fname}: head_dim mismatch q={q.shape} pages={k_pages.shape}"
+        )
+    h, hkv = q.shape[-2], k_pages.shape[2]
+    if hkv > h or h % hkv != 0:
+        raise ValueError(
+            f"{fname}: kv heads must divide q heads; got q={h}, kv={hkv}"
+        )
+    if (k_scales is None) != (v_scales is None):
+        raise ValueError(f"{fname}: k_scales and v_scales must come together")
+    if k_scales is not None and tuple(k_scales.shape) != tuple(k_pages.shape[:3]):
+        raise ValueError(
+            f"{fname}: scale planes {k_scales.shape} do not match pages "
+            f"{k_pages.shape[:3]} (per-slot-per-kv-head absmax)"
+        )
+
+
+def flash_decode_paged(q, k_pages, v_pages, block_tables, seq_lens,
+                       sm_scale=None, k_scales=None, v_scales=None):
     """Single-query attention over the paged KV cache.
 
     q            [B, H, D]     — one query token per sequence
@@ -961,21 +1077,48 @@ def flash_decode_paged(q, k_pages, v_pages, block_tables, seq_lens, sm_scale=Non
     block_tables [B, M] int32  — page indices per sequence, padded with the
                                  reserved page 0 past the last real page
     seq_lens     [B]   int32   — valid context length per sequence (>= 1)
+    k_scales/v_scales [N, bs, Hkv] f32 — per-slot absmax scale planes of an
+                                 int8 pool; reads dequantize on the fly
 
     Dispatches the Pallas kernel on TPU (or under interpret mode), else the
-    jnp reference — identical masking/GQA semantics either way."""
-    if q.shape[2] != k_pages.shape[3]:
-        raise ValueError(
-            f"flash_decode_paged: head_dim mismatch q={q.shape} pages={k_pages.shape}"
-        )
-    h, hkv = q.shape[1], k_pages.shape[2]
-    if hkv > h or h % hkv != 0:
-        raise ValueError(
-            f"flash_decode_paged: kv heads must divide q heads; got q={h}, kv={hkv}"
-        )
+    jnp reference — identical masking/GQA/dequant semantics either way."""
+    _validate_paged(q, k_pages, k_scales, v_scales, "flash_decode_paged")
     block_tables = jnp.asarray(block_tables, jnp.int32)
     seq_lens = jnp.asarray(seq_lens, jnp.int32)
     if paged_decode_usable(q, k_pages):
         with enable_x64(False):
-            return _paged_decode_jit(q, k_pages, v_pages, block_tables, seq_lens, sm_scale)
-    return paged_decode_reference(q, k_pages, v_pages, block_tables, seq_lens, sm_scale)
+            return _paged_decode_jit(q, k_pages, v_pages, block_tables, seq_lens,
+                                     sm_scale, k_scales=k_scales, v_scales=v_scales)
+    return paged_decode_reference(q, k_pages, v_pages, block_tables, seq_lens,
+                                  sm_scale, k_scales=k_scales, v_scales=v_scales)
+
+
+def flash_decode_paged_multi(q, k_pages, v_pages, block_tables, q_positions,
+                             sm_scale=None, k_scales=None, v_scales=None):
+    """Multi-query paged attention: Q consecutive tokens per sequence in
+    one call — the speculative-decode verify step (k draft positions
+    checked by one kernel launch) and chunked suffix prefill share this.
+
+    q            [B, Q, H, D]  — Q query tokens per sequence
+    q_positions  [B, Q] int32  — absolute cache position of each query;
+                                 query j attends to positions <= its own
+                                 (the K/V for all Q tokens must already be
+                                 written — write-then-read like decode)
+
+    Same dispatch contract as flash_decode_paged."""
+    if q.ndim != 4:
+        raise ValueError(f"flash_decode_paged_multi: q must be [B, Q, H, D], got {q.shape}")
+    _validate_paged(q, k_pages, k_scales, v_scales, "flash_decode_paged_multi")
+    block_tables = jnp.asarray(block_tables, jnp.int32)
+    q_positions = jnp.asarray(q_positions, jnp.int32)
+    if q_positions.shape != q.shape[:2]:
+        raise ValueError(
+            f"flash_decode_paged_multi: q_positions {q_positions.shape} must "
+            f"match q's [B, Q] {q.shape[:2]}"
+        )
+    if paged_decode_usable(q[:, 0], k_pages):
+        with enable_x64(False):
+            return _paged_extend_jit(q, k_pages, v_pages, block_tables, q_positions,
+                                     sm_scale, k_scales=k_scales, v_scales=v_scales)
+    return paged_extend_reference(q, k_pages, v_pages, block_tables, q_positions,
+                                  sm_scale, k_scales=k_scales, v_scales=v_scales)

@@ -554,6 +554,13 @@ def validate_report(report: dict) -> dict:
         raise ValueError("input_pipeline missing verdict")
     if "available" not in report["serving"]:
         raise ValueError("serving section missing 'available'")
+    if report["serving"].get("available") and report["serving"].get("n_traced"):
+        # round 17: a populated serving section must attribute where the
+        # latency wins come from (prefix reuse + speculative decoding) —
+        # zeros are fine, absence means the breakdown regressed
+        for k in ("cached_tokens", "spec"):
+            if k not in report["serving"]:
+                raise ValueError(f"serving section missing {k!r}")
     return report
 
 

@@ -9,7 +9,16 @@ which is how XLA consumes quantization anyway (scale annotations, not int
 kernels, on current TPU gens).
 """
 from .config import QuantConfig  # noqa: F401
-from .observers import AbsmaxObserver, AVGObserver, BaseObserver  # noqa: F401
+from .observers import (  # noqa: F401
+    AbsmaxObserver,
+    AVGObserver,
+    BaseObserver,
+    absmax_scale,
+    dequantize_absmax,
+    quantize_absmax,
+    running_absmax,
+    running_avg,
+)
 from .ptq import PTQ  # noqa: F401
 from .qat import QAT  # noqa: F401
 from .quanters import (  # noqa: F401
@@ -29,4 +38,9 @@ __all__ = [
     "FakeQuanterWithAbsMaxObserver",
     "AbsmaxObserver",
     "AVGObserver",
+    "absmax_scale",
+    "running_absmax",
+    "running_avg",
+    "quantize_absmax",
+    "dequantize_absmax",
 ]

@@ -2,6 +2,13 @@
 
 Observers watch activations during calibration (forward-only) and expose
 scales; they never alter the tensor.
+
+The scale math itself lives in small functional helpers (`absmax_scale`,
+`running_absmax`, `running_avg`, `quantize_absmax`, `dequantize_absmax`) so
+other consumers — round 17's int8 KV-cache pool quantizes every written
+K/V slot with exactly this absmax rule — reuse the observers' arithmetic
+instead of forking it. The helpers are raw-jnp (trace-safe: the KV path
+calls them inside compiled serving steps).
 """
 from __future__ import annotations
 
@@ -9,6 +16,45 @@ import jax.numpy as jnp
 
 from ..core.tensor import Tensor
 from .quanters import BaseQuanter, fake_quant
+
+# absmax scales are floored so a quantize of an all-zero block divides by
+# something finite (matches AbsmaxObserverLayer's initial buffer value)
+SCALE_FLOOR = 1e-9
+
+
+def absmax_scale(x, axis=None, keepdims=False):
+    """max|x| over `axis` (None = whole tensor), floored at SCALE_FLOOR,
+    in f32 — THE absmax observer rule. Works on tracers."""
+    s = jnp.max(jnp.abs(jnp.asarray(x)), axis=axis, keepdims=keepdims)
+    return jnp.maximum(s.astype(jnp.float32), SCALE_FLOOR)
+
+
+def running_absmax(prev, x):
+    """AbsmaxObserverLayer's update: the running max of per-call absmaxes."""
+    return jnp.maximum(jnp.asarray(prev, jnp.float32), absmax_scale(x))
+
+
+def running_avg(prev, x, n):
+    """AVGObserverLayer's update: the running mean of per-call absmaxes
+    after this (the n-th, 1-based) observation."""
+    prev = jnp.asarray(prev, jnp.float32)
+    return prev + (absmax_scale(x) - prev) / n
+
+
+def quantize_absmax(x, scale, bits=8):
+    """Symmetric int quantization on the absmax grid: round(x/scale * qmax)
+    clipped to [-qmax, qmax]. `scale` broadcasts against x (append trailing
+    dims yourself for per-axis scales)."""
+    qmax = float(2 ** (bits - 1) - 1)
+    s = jnp.maximum(jnp.asarray(scale, jnp.float32), SCALE_FLOOR)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / s * qmax), -qmax, qmax)
+    return q.astype(jnp.int8 if bits == 8 else jnp.int32)
+
+
+def dequantize_absmax(q, scale, bits=8, dtype=jnp.float32):
+    """Inverse of quantize_absmax: q * scale / qmax."""
+    qmax = float(2 ** (bits - 1) - 1)
+    return (q.astype(jnp.float32) * (jnp.asarray(scale, jnp.float32) / qmax)).astype(dtype)
 
 
 class BaseObserver(BaseQuanter):
@@ -19,11 +65,10 @@ class AbsmaxObserverLayer(BaseObserver):
     def __init__(self, layer=None, quant_bits=8):
         super().__init__()
         self._quant_bits = quant_bits
-        self.register_buffer("scale", Tensor(jnp.asarray(1e-9, jnp.float32)))
+        self.register_buffer("scale", Tensor(jnp.asarray(SCALE_FLOOR, jnp.float32)))
 
     def forward(self, x):
-        absmax = jnp.max(jnp.abs(x._value)).astype(jnp.float32)
-        self.scale._replace_value(jnp.maximum(self.scale._value, absmax))
+        self.scale._replace_value(running_absmax(self.scale._value, x._value))
         return x
 
     def scales(self):
@@ -41,9 +86,8 @@ class AVGObserverLayer(BaseObserver):
         self._n = 0
 
     def forward(self, x):
-        absmax = jnp.max(jnp.abs(x._value)).astype(jnp.float32)
         self._n += 1
-        self.scale._replace_value(self.scale._value + (absmax - self.scale._value) / self._n)
+        self.scale._replace_value(running_avg(self.scale._value, x._value, self._n))
         return x
 
     def scales(self):

@@ -478,7 +478,9 @@ def analyze(records: Optional[Sequence[dict]] = None) -> dict:
             "components": {p: 0.0 for p in PHASES}, "decode_start": None,
             "generated": None, "preemptions": 0, "causes": {},
             "swap_overlap_s": 0.0, "pages_allocated": 0, "pages_freed": 0,
-            "routes": [], "first_span": None,
+            "pages_shared": 0, "routes": [], "first_span": None,
+            # round 17: prefix-cache + speculative-decode attribution
+            "cached_tokens": 0, "drafted": 0, "accepted": 0,
         })
 
     engine = {"bucket_hits": 0, "bucket_compiles": 0, "compile_s_total": 0.0}
@@ -509,6 +511,12 @@ def analyze(records: Optional[Sequence[dict]] = None) -> dict:
                     q["generated"] = r["attrs"]["generated"]
                 if r["attrs"].get("preemptions") is not None:
                     q["preemptions"] = r["attrs"]["preemptions"]
+                # round 17: where the TTFT/TPOT wins came from — prompt
+                # tokens served from shared prefix pages, and draft tokens
+                # proposed/verified by speculative decoding
+                for fld in ("cached_tokens", "drafted", "accepted"):
+                    if r["attrs"].get(fld) is not None:
+                        q[fld] = r["attrs"][fld]
             elif r["name"] == "route":
                 q["routes"].append({
                     "replica": r["attrs"].get("replica"),
@@ -533,6 +541,8 @@ def analyze(records: Optional[Sequence[dict]] = None) -> dict:
                     q["pages_allocated"] += n
                 elif r["name"] == "free":
                     q["pages_freed"] += n
+                elif r["name"] == "share":
+                    q["pages_shared"] += n
     for q in per.values():
         if q["start"] is not None and q["decode_start"] is not None:
             q["ttft_s"] = q["decode_start"] - q["start"]
@@ -670,6 +680,19 @@ def slo_breakdown(
     out["outcomes"] = outcomes
     out["preemptions"] = sum(q["preemptions"] for q in done)
     out["pages_allocated"] = sum(q["pages_allocated"] for q in done)
+    out["pages_shared"] = sum(q["pages_shared"] for q in done)
+    # round 17: attribution for WHERE TTFT/TPOT wins come from — prefix
+    # reuse (prompt tokens never recomputed) and speculative decoding
+    # (tokens committed per verify step beyond the baseline one)
+    out["cached_tokens"] = sum(q["cached_tokens"] for q in done)
+    out["prefix_hit_requests"] = sum(1 for q in done if q["cached_tokens"])
+    drafted = sum(q["drafted"] for q in done)
+    accepted = sum(q["accepted"] for q in done)
+    out["spec"] = {
+        "drafted_tokens": drafted,
+        "accepted_tokens": accepted,
+        "accept_rate": round(accepted / drafted, 4) if drafted else None,
+    }
 
     if slo_ttft_ms is not None or slo_tpot_ms is not None:
         budget = max(1e-9, 1.0 - float(slo_target))
@@ -750,6 +773,19 @@ def _format_report(bd: dict) -> str:
         lines.append(
             "outcomes: "
             + ", ".join(f"{k}={v}" for k, v in sorted(bd["outcomes"].items()))
+        )
+    if bd.get("cached_tokens"):
+        lines.append(
+            f"prefix cache: {bd['cached_tokens']} prompt token(s) served from "
+            f"shared pages across {bd.get('prefix_hit_requests', 0)} request(s), "
+            f"{bd.get('pages_shared', 0)} page share(s)"
+        )
+    spec = bd.get("spec") or {}
+    if spec.get("drafted_tokens"):
+        lines.append(
+            f"speculative decode: {spec['drafted_tokens']} drafted, "
+            f"{spec['accepted_tokens']} accepted "
+            f"(accept rate {spec['accept_rate']:.1%})"
         )
     slo = bd.get("slo")
     if slo:

@@ -627,3 +627,66 @@ def test_breakdown_absent_keeps_legacy_behavior(tmp_path):
     rc, out, err = _run(a, b)
     assert rc == 1, (out, err)
     assert "p99_ttft_ms" in out
+
+
+# ---------------------------------------------------------------------------
+# round 17: prefix-cache / speculative-decode / same-bytes-concurrency gates
+# ---------------------------------------------------------------------------
+
+def _with_prefix_spec(hit=0.5, accept=0.4, conc=3.0, prefix_len=48):
+    c = _with_serving()
+    sv = c["detail"]["serving"]
+    sv["prefix_hit_rate"] = hit
+    sv["spec_accept_rate"] = accept
+    sv["concurrency_vs_baseline"] = conc
+    sv["prefix_spec_dims"] = {
+        "templates": 4, "prefix_len": prefix_len, "draft_len": 3,
+        "ngram": 2, "kv_dtype": "int8", "n_requests": 32,
+        "base_blocks": 17, "opt_blocks": 54,
+    }
+    return c
+
+
+def test_prefix_hit_rate_drop_fails(tmp_path):
+    a = _write(tmp_path, "a.json", _with_prefix_spec(hit=0.5))
+    b = _write(tmp_path, "b.json", _with_prefix_spec(hit=0.35))  # -30%
+    rc, out, err = _run(a, b)
+    assert rc == 1, (out, err)
+    assert "prefix_hit_rate" in out and "throughput regression" in out
+
+
+def test_spec_accept_rate_drop_fails(tmp_path):
+    a = _write(tmp_path, "a.json", _with_prefix_spec(accept=0.4))
+    b = _write(tmp_path, "b.json", _with_prefix_spec(accept=0.28))  # -30%
+    rc, out, err = _run(a, b)
+    assert rc == 1, (out, err)
+    assert "spec_accept_rate" in out
+
+
+def test_concurrency_vs_baseline_drop_fails(tmp_path):
+    a = _write(tmp_path, "a.json", _with_prefix_spec(conc=3.0))
+    b = _write(tmp_path, "b.json", _with_prefix_spec(conc=2.0))
+    rc, out, err = _run(a, b)
+    assert rc == 1, (out, err)
+    assert "concurrency_vs_baseline" in out
+
+
+def test_prefix_spec_improvement_and_equal_pass(tmp_path):
+    a = _write(tmp_path, "a.json", _with_prefix_spec())
+    b = _write(tmp_path, "b.json",
+               _with_prefix_spec(hit=0.6, accept=0.5, conc=3.5))
+    rc, out, err = _run(a, b)
+    assert rc == 0, (out, err)
+    c = _write(tmp_path, "c.json", _with_prefix_spec())
+    rc, out, err = _run(a, c)
+    assert rc == 0, (out, err)
+
+
+def test_prefix_spec_dims_change_not_compared(tmp_path):
+    # a different template/knob set is a different workload — lower rates
+    # under different knobs are not a regression
+    a = _write(tmp_path, "a.json", _with_prefix_spec(hit=0.5, prefix_len=48))
+    b = _write(tmp_path, "b.json", _with_prefix_spec(hit=0.2, prefix_len=16))
+    rc, out, err = _run(a, b)
+    assert rc == 0, (out, err)
+    assert "workload changed" in out and "prefix_spec_dims" in out

@@ -383,3 +383,50 @@ def test_perf_report_carries_serving_section(tiny_model, traced):
     assert rep["serving"]["available"] is True
     assert rep["serving"]["n_traced"] == 3
     assert rep["serving"]["consistency"]["max_abs_err_frac"] <= 0.05
+
+
+def test_trace_carries_prefix_and_spec_attribution(tiny_model, traced):
+    """Round-17 satellite: cached_tokens (prefix hits) and drafted/accepted
+    counts ride each request's trace, surface in slo_breakdown (where the
+    TTFT/TPOT wins come from), and validate_report accepts the extended
+    serving section."""
+    from paddle_tpu.inference.scheduler import SpecDecodeConfig
+
+    rng = np.random.RandomState(55)
+    prefix = rng.randint(0, 1024, (17,)).tolist()
+    motif = rng.randint(0, 64, (4,)).tolist()
+    eng = _engine(tiny_model)
+    sched = ContinuousBatchingScheduler(
+        eng, prefix_cache=True, spec_decode=SpecDecodeConfig(draft_len=3))
+    prompts = [prefix + motif * 2, prefix + rng.randint(0, 1024, (3,)).tolist()]
+    for i, p in enumerate(prompts):
+        r = Request(rid=i, prompt=list(p), max_new_tokens=8)
+        sched.submit(r)
+        while not sched.idle():
+            sched.step()
+    bd = rt.slo_breakdown()
+    assert bd["open_spans"] == 0
+    assert bd["cached_tokens"] >= 16          # request 1 shared the prefix
+    assert bd["prefix_hit_requests"] >= 1
+    assert bd["spec"]["drafted_tokens"] > 0
+    assert bd["spec"]["accepted_tokens"] >= 0
+    if bd["spec"]["accepted_tokens"]:
+        assert bd["spec"]["accept_rate"] == pytest.approx(
+            bd["spec"]["accepted_tokens"] / bd["spec"]["drafted_tokens"], abs=1e-3)
+    # the prefill span carries the per-admission cached_tokens attr
+    cached_attrs = [r["attrs"].get("cached_tokens") for r in rt.recorder().records()
+                    if r["type"] == "span" and r["name"] == "prefill"]
+    assert any(c for c in cached_attrs if c)
+    # pool share events are attributed to the sharing request
+    assert bd["pages_shared"] >= 2
+    # and the perf_report schema carries it end to end
+    from paddle_tpu.profiler.perf_attribution import perf_report, validate_report
+
+    rep = validate_report(perf_report())
+    assert rep["serving"]["available"] and rep["serving"]["cached_tokens"] >= 16
+    # a serving section claiming traced requests but missing the round-17
+    # attribution fields is a schema regression
+    broken = json.loads(json.dumps(rep))
+    del broken["serving"]["spec"]
+    with pytest.raises(ValueError, match="spec"):
+        validate_report(broken)

@@ -606,17 +606,27 @@ def test_serving_bench_child_record(tmp_path):
         PADDLE_TPU_TELEMETRY="1",
     )
     r = subprocess.run([sys.executable, bench], env=env, capture_output=True,
-                       text=True, timeout=240)
+                       text=True, timeout=400)
     assert r.returncode == 0, r.stderr[-2000:]
     rec = json.loads(r.stdout.strip().splitlines()[-1])
     for k in ("tokens_per_sec", "p50_ttft_ms", "p99_ttft_ms", "p50_tpot_ms",
               "p99_tpot_ms", "n_requests", "speedup_vs_static", "serve_dims",
-              "bucket_stats", "static", "attribution"):
+              "bucket_stats", "static", "attribution",
+              # round 17: the gated prefix/spec fields + their shape dict
+              "prefix_hit_rate", "spec_accept_rate", "concurrency_vs_baseline",
+              "prefix_spec_dims", "prefix_spec"):
         assert k in rec, k
     assert rec["n_requests"] == 8
     assert rec["static"]["tokens_per_sec"] > 0
     assert rec["serve_dims"]["hidden"] == 64  # shrunken run records its dims
     assert rec["bucket_stats"]["compiles"] >= 2
+    # the session-template A/B really shared prefixes and spent no more
+    # bytes on the optimized pool than the baseline
+    assert rec["prefix_hit_rate"] and rec["prefix_hit_rate"] > 0
+    ps = rec["prefix_spec"]
+    assert ps["optimized"]["pool_bytes"] <= ps["baseline"]["pool_bytes"]
+    assert ps["cached_tokens"] > 0 and ps["drafted_tokens"] > 0
+    assert rec["prefix_spec_dims"]["kv_dtype"] == "int8"
     # round 16: the record decomposes its own SLO numbers — components sum
     # to the measured walls (the perf-gate consistency contract) and the
     # TTFT-side component p99s + burn rate ride the capture
@@ -625,3 +635,543 @@ def test_serving_bench_child_record(tmp_path):
     assert abs(bd["consistency"]["mean"] - 1.0) <= 0.05
     assert set(bd["ttft_p99_components_ms"]) == {"queue_wait", "prefill", "preempt"}
     assert bd["slo"]["ttft_burn_rate"] is not None
+
+
+# ---------------------------------------------------------------------------
+# round 17: multi-query (extend/verify) kernel + int8 dequant-on-read
+# ---------------------------------------------------------------------------
+
+def test_paged_extend_kernel_vs_reference_vs_single_query():
+    """Multi-query kernel: interpret mode == jnp reference == a stack of
+    single-query calls at each query's own frontier — on shuffled pages
+    with GQA, so the per-query masking and row packing are both pinned."""
+    rng = np.random.RandomState(21)
+    B, Q, H, HKV, D, BS, N, M = 2, 3, 8, 2, 64, 16, 10, 4
+    q = jnp.asarray(rng.randn(B, Q, H, D), jnp.float32)
+    kp = jnp.asarray(rng.randn(N, BS, HKV, D), jnp.float32)
+    vp = jnp.asarray(rng.randn(N, BS, HKV, D), jnp.float32)
+    bt = np.asarray([[7, 3, 9, TRASH_PAGE], [5, 1, 2, 8]], np.int32)
+    # per-row frontiers ending mid-page, consecutive positions per query
+    qpos = np.asarray([[37, 38, 39], [14, 15, 16]], np.int32)
+
+    ref = pk.paged_extend_reference(q, kp, vp, bt, qpos)
+    for j in range(Q):
+        single = pk.paged_decode_reference(q[:, j], kp, vp, bt, qpos[:, j] + 1)
+        np.testing.assert_allclose(
+            np.asarray(ref[:, j]), np.asarray(single), rtol=2e-5, atol=2e-6
+        )
+    old = pk._INTERPRET
+    pk._INTERPRET = True
+    try:
+        got = pk._paged_extend_jit(q, kp, vp, jnp.asarray(bt), jnp.asarray(qpos))
+    finally:
+        pk._INTERPRET = old
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-6)
+
+    # dispatch validation
+    with pytest.raises(ValueError, match="q_positions"):
+        pk.flash_decode_paged_multi(q, kp, vp, bt, qpos[:, :2])
+    with pytest.raises(ValueError, match="must be \\[B, Q, H, D\\]"):
+        pk.flash_decode_paged_multi(q[:, 0], kp, vp, bt, qpos)
+
+
+def test_paged_decode_int8_pinned_against_f32_oracle():
+    """int8 KV acceptance: dequantize-on-read outputs pinned within
+    tolerance of the f32 oracle in BOTH dispatch modes available off-TPU
+    (interpret-mode kernel and jnp reference), single- and multi-query;
+    the quantization grid is the absmax observers' (reused, not forked)."""
+    from paddle_tpu.quantization.observers import absmax_scale, quantize_absmax
+
+    rng = np.random.RandomState(22)
+    B, H, HKV, D, BS, N, M = 3, 8, 2, 64, 16, 12, 4
+    q = jnp.asarray(rng.randn(B, H, D), jnp.float32)
+    kp = jnp.asarray(rng.randn(N, BS, HKV, D), jnp.float32)
+    vp = jnp.asarray(rng.randn(N, BS, HKV, D), jnp.float32)
+    bt = np.asarray([[7, 3, 11, TRASH_PAGE], [5, 1, TRASH_PAGE, TRASH_PAGE],
+                     [2, 9, 4, 6]], np.int32)
+    sl = np.asarray([50, 17, 64], np.int32)
+    ks, vs = absmax_scale(kp, axis=-1), absmax_scale(vp, axis=-1)
+    kq, vq = quantize_absmax(kp, ks[..., None]), quantize_absmax(vp, vs[..., None])
+
+    oracle = np.asarray(pk.paged_decode_reference(q, kp, vp, bt, sl))
+    ref8 = np.asarray(
+        pk.paged_decode_reference(q, kq, vq, bt, sl, k_scales=ks, v_scales=vs))
+    assert np.abs(ref8 - oracle).max() < 0.05  # int8 grid error, not drift
+    old = pk._INTERPRET
+    pk._INTERPRET = True
+    try:
+        got8 = pk._paged_decode_jit(q, kq, vq, jnp.asarray(bt), jnp.asarray(sl),
+                                    k_scales=ks, v_scales=vs)
+        qm = jnp.asarray(rng.randn(2, 2, H, D), jnp.float32)
+        qpos = np.asarray([[38, 39], [15, 16]], np.int32)
+        gotm = pk._paged_extend_jit(qm, kq, vq, jnp.asarray(bt[:2]),
+                                    jnp.asarray(qpos), k_scales=ks, v_scales=vs)
+    finally:
+        pk._INTERPRET = old
+    np.testing.assert_allclose(np.asarray(got8), ref8, rtol=2e-4, atol=2e-5)
+    refm = pk.paged_extend_reference(qm, kq, vq, bt[:2], qpos,
+                                     k_scales=ks, v_scales=vs)
+    np.testing.assert_allclose(np.asarray(gotm), np.asarray(refm),
+                               rtol=2e-4, atol=2e-5)
+    # scale planes must match the pages' [N, bs, Hkv] — a mismatched plane
+    # is a wiring bug, not a broadcast
+    with pytest.raises(ValueError, match="scale planes"):
+        pk.flash_decode_paged(q, kq, vq, bt, sl, k_scales=ks[:, :4], v_scales=vs)
+    with pytest.raises(ValueError, match="come together"):
+        pk.flash_decode_paged(q, kq, vq, bt, sl, k_scales=ks)
+
+
+def test_engine_extend_matches_sequential_decode(tiny_model, shared_engine):
+    """engine.extend over [last committed, d1, d2] returns per-position
+    logits equal to running each token through the sequential full-forward
+    recompute — the property that makes greedy verify exact."""
+    eng = shared_engine
+    eng.pool.reset()
+    rng = np.random.RandomState(23)
+    prompt = rng.randint(0, 1024, (11,)).tolist()
+    pages = eng.pool.alloc(eng.pool.blocks_for_tokens(11 + 5))
+    lg = eng.prefill(prompt, pages)
+    cur = list(prompt)
+    nxt = int(lg.argmax())
+    drafts = [7, 13]
+    ext = eng.extend([[nxt] + drafts], [[len(cur), len(cur) + 1, len(cur) + 2]],
+                     [pages], q_len=4)
+    seq = list(cur)
+    for j, t in enumerate([nxt] + drafts):
+        seq.append(t)
+        with paddle.no_grad():
+            fr = tiny_model(paddle.to_tensor(np.asarray([seq], np.int64))).numpy()[0, -1]
+        np.testing.assert_allclose(ext[0, j], fr, rtol=2e-4, atol=2e-5)
+    eng.pool.reset()
+
+
+def test_int8_engine_reference_mode_tolerance(tiny_model):
+    """Engine-level int8 acceptance in the jnp-reference dispatch mode (the
+    CPU path): prefill logits are EXACT (attention reads this call's own
+    f32 K/V), decode logits stay within the int8 grid tolerance of the f32
+    engine, and the pool spends ~1/3 the bytes per page."""
+    from paddle_tpu.inference.engine import InferenceEngine
+
+    eng32 = InferenceEngine(tiny_model, max_seq_len=64, block_size=8, max_batch=2)
+    eng8 = InferenceEngine(tiny_model, max_seq_len=64, block_size=8, max_batch=2,
+                           kv_dtype="int8")
+    assert eng8.pool.page_bytes() < eng32.pool.page_bytes() / 2
+    rng = np.random.RandomState(24)
+    prompt = rng.randint(0, 1024, (13,)).tolist()
+    pg32 = eng32.pool.alloc(3)
+    pg8 = eng8.pool.alloc(3)
+    l32 = eng32.prefill(prompt, pg32)
+    l8 = eng8.prefill(prompt, pg8)
+    np.testing.assert_allclose(l8, l32, rtol=2e-5, atol=2e-6)  # exact-ish
+    cur = list(prompt)
+    for _ in range(4):
+        nxt = int(l32.argmax())
+        cur.append(nxt)
+        l32 = eng32.decode([nxt], [len(cur) - 1], [len(cur)], [pg32])[0]
+        l8 = eng8.decode([nxt], [len(cur) - 1], [len(cur)], [pg8])[0]
+        rel = np.abs(l8 - l32).max() / max(np.abs(l32).max(), 1e-6)
+        assert rel < 0.05, rel
+
+
+# ---------------------------------------------------------------------------
+# round 17: pool refcounts, prefix index, retention LRU, copy-on-write
+# ---------------------------------------------------------------------------
+
+def test_block_pool_refcount_share_retain_evict():
+    from paddle_tpu.inference.kv_cache import prefix_chain_keys
+
+    pool = BlockPool(num_blocks=6, block_size=8, num_layers=1, num_kv_heads=2,
+                     head_dim=4)
+    keys = prefix_chain_keys(list(range(24)), 8)
+    a = pool.alloc(3)
+    pool.register_prefix(keys[0], a[0])
+    pool.register_prefix(keys[1], a[1])
+    pool.share([a[0], a[1]])  # a second holder
+    assert pool.refcount(a[0]) == 2 and pool.shared() == 2
+    pool.free([a[0], a[1]])           # holder 2 gone; still active (ref 1)
+    assert pool.refcount(a[0]) == 1 and pool.shared() == 0
+    with pytest.raises(ValueError, match="double free"):
+        pool.free([a[2], a[2]])
+    # a[2] now freed (unregistered -> straight to the free list)
+    pool.free(a[:2])                  # ref 0 + indexed -> RETAINED, not free
+    assert pool.used() == 0 and pool.retained() == 2
+    assert pool.available() == 5      # retained pages are reclaimable
+    # LRU reclaim: asking for more than the free list holds evicts retained
+    big = pool.alloc(5)
+    assert len(big) == 5 and pool.retained() == 0
+    assert pool.prefix_index_size() == 0  # eviction dropped the entries
+    evs = tm.default_registry().get("paddle_tpu_kv_prefix_evictions_total")
+    assert evs is not None and evs.value >= 2
+    pool.free(big)
+    # share of a non-resident page is a caller bug, loudly
+    with pytest.raises(ValueError, match="not resident"):
+        pool.share([big[0]])
+    with pytest.raises(ValueError, match="reserved"):
+        pool.share([TRASH_PAGE])
+
+
+def test_block_pool_prefix_index_guards_trash_and_nonresident():
+    """Regression (round-17 satellite): the reserved trash page can never
+    enter the radix index, free/retained pages cannot register, and a
+    lookup stops at the first gap in a chain."""
+    from paddle_tpu.inference.kv_cache import prefix_chain_keys
+
+    pool = BlockPool(num_blocks=8, block_size=8, num_layers=1, num_kv_heads=2,
+                     head_dim=4)
+    keys = prefix_chain_keys(list(range(32)), 8)
+    with pytest.raises(ValueError, match="reserved"):
+        pool.register_prefix(keys[0], TRASH_PAGE)
+    with pytest.raises(ValueError, match="not actively held"):
+        pool.register_prefix(keys[0], 3)  # free page
+    a = pool.alloc(3)
+    assert pool.register_prefix(keys[0], a[0])
+    assert pool.register_prefix(keys[1], a[1])
+    assert not pool.register_prefix(keys[0], a[2])  # first wins
+    assert not pool.register_prefix(keys[2], a[0])  # page already keyed
+    # chain gap: drop the middle entry -> lookup must stop at page 0's hit
+    pool.free([a[1]], retain=False)  # ref 0, retain=False -> de-indexed
+    got = pool.acquire_prefix(keys)
+    assert got == [a[0]]
+    pool.free(got)
+    pool.free([a[0], a[2]], retain=False)
+    assert pool.prefix_index_size() == 0
+
+
+def test_block_pool_cow_make_private():
+    """make_private clones content (all layers + scale planes) into an
+    exclusive page, drops the caller's ref on the original, and counts."""
+    pool = BlockPool(num_blocks=6, block_size=4, num_layers=2, num_kv_heads=2,
+                     head_dim=4, kv_dtype="int8")
+    (page,) = pool.alloc(1)
+    rng = np.random.RandomState(25)
+    for layer in range(2):
+        pool.k_pages[layer] = pool.k_pages[layer].at[page].set(
+            jnp.asarray(rng.randint(-127, 127, (4, 2, 4)), jnp.int8))
+        pool.k_scales[layer] = pool.k_scales[layer].at[page].set(
+            jnp.asarray(rng.rand(4, 2), jnp.float32))
+    pool.share([page])
+    assert pool.refcount(page) == 2
+    cow_before = pool.cow_copies
+    new = pool.make_private(page)
+    assert new != page and pool.refcount(new) == 1 and pool.refcount(page) == 1
+    assert pool.cow_copies == cow_before + 1
+    for layer in range(2):
+        np.testing.assert_array_equal(
+            np.asarray(pool.k_pages[layer][new]), np.asarray(pool.k_pages[layer][page]))
+        np.testing.assert_array_equal(
+            np.asarray(pool.k_scales[layer][new]), np.asarray(pool.k_scales[layer][page]))
+    cnt = tm.default_registry().get("paddle_tpu_kv_pool_cow_copies_total")
+    assert cnt is not None and cnt.value >= 1
+    with pytest.raises(ValueError, match="reserved"):
+        pool.make_private(TRASH_PAGE)
+
+
+# ---------------------------------------------------------------------------
+# round 17: prefix-cache admission through the scheduler
+# ---------------------------------------------------------------------------
+
+def test_prefix_admission_byte_identical_and_fewer_allocs(tiny_model):
+    """Acceptance: greedy ids byte-identical with prefix sharing on/off;
+    the sharing request allocates strictly fewer pages, serves the shared
+    prefix from cache (cached_tokens), and the hit/miss + shared-state
+    telemetry fires."""
+    from paddle_tpu.inference.engine import InferenceEngine
+    from paddle_tpu.inference.scheduler import ContinuousBatchingScheduler, Request
+
+    rng = np.random.RandomState(26)
+    shared_prefix = rng.randint(0, 1024, (17,)).tolist()
+    p1 = shared_prefix + rng.randint(0, 1024, (5,)).tolist()
+    p2 = shared_prefix + rng.randint(0, 1024, (3,)).tolist()
+
+    def run(prefix_on):
+        eng = InferenceEngine(tiny_model, max_seq_len=64, block_size=8, max_batch=4)
+        allocs = {}
+        orig = eng.pool.alloc
+
+        def counting(n, owner=None):
+            allocs[owner] = allocs.get(owner, 0) + n
+            return orig(n, owner=owner)
+
+        eng.pool.alloc = counting
+        sched = ContinuousBatchingScheduler(eng, prefix_cache=prefix_on)
+        out = []
+        for rid, p in ((0, p1), (1, p2)):
+            r = Request(rid=rid, prompt=list(p), max_new_tokens=6)
+            sched.submit(r)
+            while not sched.idle():
+                sched.step()
+            out.append(r)
+        assert eng.pool.used() == 0
+        return out, allocs
+
+    (r1_off, r2_off), _ = run(prefix_on=False)
+    (r1_on, r2_on), allocs = run(prefix_on=True)
+    assert r1_on.generated == r1_off.generated
+    assert r2_on.generated == r2_off.generated
+    assert r2_on.cached_tokens == 16 and r1_on.cached_tokens == 0
+    assert allocs[1] < allocs[0]
+    hits = tm.default_registry().get("paddle_tpu_kv_prefix_lookups_total")
+    assert hits.labels(event="hit").value >= 1
+    cached = tm.default_registry().get("paddle_tpu_kv_prefix_cached_tokens_total")
+    assert cached.value >= 16
+
+
+def test_preempted_pages_never_reenter_index(tiny_model):
+    """Regression (round-17 satellite): preemption frees with retain=False
+    — the victim's registered pages leave the index BEFORE they can be
+    recycled, so no later request can share a page whose content a new
+    owner overwrote; outputs stay exact across the preempt-resume."""
+    from paddle_tpu.inference.engine import InferenceEngine
+    from paddle_tpu.inference.scheduler import ContinuousBatchingScheduler, Request
+
+    eng = InferenceEngine(tiny_model, max_seq_len=48, block_size=8, max_batch=2,
+                          num_blocks=6, decode_batch_buckets=(2,),
+                          prefill_buckets=(16, 32))
+    rng = np.random.RandomState(27)
+    p0 = rng.randint(0, 1024, (15,)).tolist()
+    sched = ContinuousBatchingScheduler(eng)
+    r0 = Request(rid=0, prompt=p0, max_new_tokens=12)
+    sched.submit(r0)
+    sched.step()
+    assert r0._registered_pages >= 1
+    registered = list(r0.pages[:r0._registered_pages])
+    assert all(eng.pool.is_indexed(p) for p in registered)
+    assert sched._preempt_one()
+    # the freed pages are OUT of the index and back on the free list
+    assert all(not eng.pool.is_indexed(p) for p in registered)
+    assert all(eng.pool.refcount(p) == 0 for p in registered)
+    assert eng.pool.retained() == 0
+    while not sched.idle():
+        sched.step()
+    assert r0.prompt[r0.prompt_len:] + r0.generated == _greedy_oracle(
+        tiny_model, p0, 12)
+    assert eng.pool.used() == 0
+
+
+def test_cow_after_evacuate_and_shared_write_guard(tiny_model):
+    """Regression (round-17 satellite): CoW-after-evacuate is safe — a
+    request resumed after evacuation whose write range lands in a page
+    another live request still reads gets a PRIVATE clone (no scribble),
+    and both requests' outputs stay exact."""
+    from paddle_tpu.inference.engine import InferenceEngine
+    from paddle_tpu.inference.scheduler import ContinuousBatchingScheduler, Request
+
+    rng = np.random.RandomState(28)
+    shared_prefix = rng.randint(0, 1024, (16,)).tolist()
+    p1 = shared_prefix + rng.randint(0, 1024, (4,)).tolist()
+    p2 = shared_prefix + rng.randint(0, 1024, (2,)).tolist()
+    eng = InferenceEngine(tiny_model, max_seq_len=64, block_size=8, max_batch=4)
+    sched = ContinuousBatchingScheduler(eng)
+    r1 = Request(rid=0, prompt=list(p1), max_new_tokens=6)
+    sched.submit(r1)
+    while not sched.idle():
+        sched.step()
+    r2 = Request(rid=1, prompt=list(p2), max_new_tokens=6)
+    sched.submit(r2)
+    sched.step()  # r2 admitted sharing the prefix pages
+    assert r2.cached_tokens == 16
+    # simulate the evacuate-resume race: a THIRD holder appears on the page
+    # r2 will write into next (force refcount > 1 on its tail page)
+    tail = r2.pages[-1]
+    eng.pool.share([tail])
+    cow_before = eng.pool.cow_copies
+    while not sched.idle():
+        sched.step()
+    assert eng.pool.cow_copies > cow_before  # the guard cloned, not scribbled
+    assert tail not in r2.pages              # r2 writes its private clone
+    eng.pool.free([tail])                    # release the simulated holder
+    assert r2.generated == _greedy_oracle(tiny_model, p2, 6)
+    assert eng.pool.used() == 0
+
+    # evacuation itself: shared pages leave the index (PR 11 path)
+    sched2 = ContinuousBatchingScheduler(eng)
+    r3 = Request(rid=2, prompt=list(p1), max_new_tokens=8)
+    sched2.submit(r3)
+    sched2.step()
+    assert any(eng.pool.is_indexed(p) for p in r3.pages)
+    held = list(r3.pages)
+    evacuated = sched2.evacuate()
+    assert [r.rid for r in evacuated] == [2]
+    assert eng.pool.used() == 0
+    # every page the evacuation freed left the index (retained pages from
+    # earlier COMPLETED requests legitimately stay)
+    assert all(not eng.pool.is_indexed(p) for p in held)
+    # resume elsewhere: recompute-from-folded-prompt stays exact
+    sched3 = ContinuousBatchingScheduler(eng)
+    sched3.submit(r3)
+    while not sched3.idle():
+        sched3.step()
+    assert r3.prompt[r3.prompt_len:] + r3.generated == _greedy_oracle(
+        tiny_model, p1, 8)
+
+
+# ---------------------------------------------------------------------------
+# round 17: speculative decoding
+# ---------------------------------------------------------------------------
+
+def test_spec_decode_byte_identical_and_fewer_steps(tiny_model):
+    """Acceptance: greedy outputs byte-identical with speculative decoding
+    on/off (greedy verify is exact), in fewer scheduler steps, with the
+    drafted/accepted telemetry counted."""
+    from paddle_tpu.inference.engine import InferenceEngine
+    from paddle_tpu.inference.scheduler import (
+        ContinuousBatchingScheduler, Request, SpecDecodeConfig)
+
+    rng = np.random.RandomState(29)
+    motif = rng.randint(0, 64, (5,)).tolist()
+    prompt = motif * 4  # repetition the n-gram draft can exploit
+
+    def run(spec):
+        eng = InferenceEngine(tiny_model, max_seq_len=64, block_size=8,
+                              max_batch=2, decode_batch_buckets=(2,))
+        sched = ContinuousBatchingScheduler(eng, spec_decode=spec)
+        r = Request(rid=0, prompt=list(prompt), max_new_tokens=12)
+        sched.submit(r)
+        steps = 0
+        while not sched.idle():
+            sched.step()
+            steps += 1
+        assert eng.pool.used() == 0
+        return r, steps
+
+    r_off, steps_off = run(None)
+    r_on, steps_on = run(SpecDecodeConfig(draft_len=3, ngram=2))
+    assert r_on.generated == r_off.generated == _greedy_oracle(
+        tiny_model, prompt, 12)
+    assert steps_on < steps_off
+    assert r_on.drafted > 0 and 0 < r_on.accepted <= r_on.drafted
+    fam = tm.default_registry().get("paddle_tpu_spec_decode_tokens_total")
+    assert fam.labels(event="drafted").value >= r_on.drafted
+    assert fam.labels(event="accepted").value >= r_on.accepted
+    with pytest.raises(ValueError, match="draft_len"):
+        SpecDecodeConfig(draft_len=0)
+
+
+def test_spec_decode_mixed_batch_preemption_exact(tiny_model):
+    """Spec decoding under pool pressure: two requests, tiny pool, draft
+    rollback + preemption both fire, and EVERY output still equals the
+    plain greedy oracle (the rollback path frees surplus draft pages
+    without corrupting neighbors)."""
+    from paddle_tpu.inference.engine import InferenceEngine
+    from paddle_tpu.inference.scheduler import (
+        ContinuousBatchingScheduler, Request, SpecDecodeConfig)
+
+    rng = np.random.RandomState(30)
+    motif = rng.randint(0, 64, (4,)).tolist()
+    p0 = motif * 4                                    # draft-friendly
+    p1 = rng.randint(0, 1024, (15,)).tolist()         # draft-hostile
+    eng = InferenceEngine(tiny_model, max_seq_len=48, block_size=8, max_batch=2,
+                          num_blocks=7, decode_batch_buckets=(2,),
+                          prefill_buckets=(16, 32))
+    sched = ContinuousBatchingScheduler(
+        eng, spec_decode=SpecDecodeConfig(draft_len=3, ngram=2))
+    r0 = Request(rid=0, prompt=list(p0), max_new_tokens=12)
+    r1 = Request(rid=1, prompt=list(p1), max_new_tokens=12)
+    sched.submit(r0)
+    sched.submit(r1)
+    while not sched.idle():
+        sched.step()
+    for r, p in ((r0, p0), (r1, p1)):
+        assert r.prompt[r.prompt_len:] + list(r.generated) == _greedy_oracle(
+            tiny_model, p, 12), r.rid
+    assert eng.pool.used() == 0
+
+
+def test_spec_prefix_int8_stack_composes(tiny_model):
+    """All three round-17 features at once (int8 pool + prefix sharing +
+    spec decoding): the stack drains clean, shares the prefix, accepts
+    drafts, and the telemetry pool gauges cover the shared/retained
+    states."""
+    from paddle_tpu.inference.engine import InferenceEngine
+    from paddle_tpu.inference.scheduler import (
+        ContinuousBatchingScheduler, Request, SpecDecodeConfig)
+
+    rng = np.random.RandomState(31)
+    prefix = rng.randint(0, 1024, (17,)).tolist()
+    motif = rng.randint(0, 64, (4,)).tolist()
+    prompts = [prefix + motif * 2, prefix + rng.randint(0, 1024, (3,)).tolist()]
+    eng = InferenceEngine(tiny_model, max_seq_len=64, block_size=8, max_batch=4,
+                          kv_dtype="int8")
+    sched = ContinuousBatchingScheduler(
+        eng, prefix_cache=True, spec_decode=SpecDecodeConfig(draft_len=3))
+    reqs = [Request(rid=i, prompt=list(p), max_new_tokens=8)
+            for i, p in enumerate(prompts)]
+    for r in reqs:
+        sched.submit(r)
+    shared_seen = 0
+    while not sched.idle():
+        sched.step()
+        shared_seen = max(shared_seen, eng.pool.shared())
+    assert shared_seen >= 1            # prefix pages were concurrently shared
+    assert reqs[1].cached_tokens >= 16
+    assert all(len(r.generated) == 8 for r in reqs)
+    assert eng.pool.used() == 0 and eng.pool.retained() > 0
+    fam = tm.default_registry().get("paddle_tpu_kv_pool_blocks")
+    assert fam.labels(state="shared").value == 0   # drained
+    assert fam.labels(state="retained").value == eng.pool.retained()
+
+
+def test_weight_swap_invalidates_prefix_cache(tiny_model):
+    """Review-found regression: resident prefix K/V was computed under the
+    OLD weights — load_weights must drop the index + retained pages so a
+    post-swap request recomputes under the new parameters instead of
+    mixing stale keys/values into new-weight attention."""
+    from paddle_tpu.inference.engine import InferenceEngine
+    from paddle_tpu.inference.scheduler import ContinuousBatchingScheduler, Request
+
+    paddle.seed(7)
+    from paddle_tpu.models.llama import llama_tiny
+
+    other = llama_tiny(num_key_value_heads=2)
+    other.eval()
+    rng = np.random.RandomState(33)
+    prompt = rng.randint(0, 1024, (20,)).tolist()
+    eng = InferenceEngine(tiny_model, max_seq_len=64, block_size=8, max_batch=2)
+    sched = ContinuousBatchingScheduler(eng)
+    r0 = Request(rid=0, prompt=list(prompt), max_new_tokens=4)
+    sched.submit(r0)
+    while not sched.idle():
+        sched.step()
+    assert eng.pool.retained() > 0 and eng.pool.prefix_index_size() > 0
+    eng.load_weights({k: v for k, v in
+                      __import__("paddle_tpu.jit.api", fromlist=["state_values"])
+                      .state_values(other).items()})
+    assert eng.pool.prefix_index_size() == 0 and eng.pool.retained() == 0
+    inv = tm.default_registry().get("paddle_tpu_kv_prefix_invalidations_total")
+    assert inv is not None and inv.value >= 1
+    # post-swap request: NO prefix hit, output equals the NEW weights' oracle
+    r1 = Request(rid=1, prompt=list(prompt), max_new_tokens=4)
+    sched.submit(r1)
+    while not sched.idle():
+        sched.step()
+    assert r1.cached_tokens == 0
+    assert r1.generated == _greedy_oracle(other, prompt, 4)
+
+
+def test_shared_page_survives_sharers_preemption_in_index():
+    """Review refinement: retain=False on a refcount>1 page must NOT drop
+    the index entry — the other holder keeps the page alive and immutable,
+    so the chain stays valid (the stale hazard only exists for pages
+    returning to the free list)."""
+    from paddle_tpu.inference.kv_cache import prefix_chain_keys
+
+    pool = BlockPool(num_blocks=6, block_size=8, num_layers=1, num_kv_heads=2,
+                     head_dim=4)
+    keys = prefix_chain_keys(list(range(16)), 8)
+    a = pool.alloc(2)
+    pool.register_prefix(keys[0], a[0])
+    pool.register_prefix(keys[1], a[1])
+    pool.share(a)  # a second holder (requests A and B sharing a template)
+    # A preempted: retain=False, but B still holds — entries stay
+    pool.free(a, retain=False)
+    assert pool.prefix_index_size() == 2
+    assert pool.acquire_prefix(keys) == a  # a third request still hits
+    pool.free(a)
+    # B gone too (completion): retained with entries intact
+    pool.free(a, retain=True)
+    assert pool.retained() == 2 and pool.prefix_index_size() == 2
+    # but a SOLE holder's preemption (ref 1 -> 0, retain=False) still
+    # drops the entry and frees the page — the original satellite contract
+    got = pool.acquire_prefix(keys)
+    pool.free(got, retain=False)
+    assert pool.prefix_index_size() == 0 and pool.retained() == 0

@@ -32,6 +32,15 @@ unchanged `passes_dims` probe shape (a pattern silently un-matching exits
 1, not just a slower bench), and `outputs_identical` may never flip to
 false.
 
+Round 17: the serving record carries the prefix-cache/speculative-decode
+sub-run — `prefix_hit_rate` (prompt tokens served from shared KV pages),
+`spec_accept_rate` (drafted tokens verified equal to the greedy chain),
+and `concurrency_vs_baseline` (peak concurrent requests sustained on the
+SAME pool bytes vs the unoptimized engine) are larger-is-better gated
+fields: a drop beyond tolerance with flat attributed work exits 1. The
+sub-run's knobs live in `prefix_spec_dims` (a shape field — changing the
+trace/knobs is a different problem, not a regression).
+
 Round 16: serving/fleet records carry `slo_breakdown` (the request-trace
 TTFT/TPOT decomposition). Two new checks: (a) CONSISTENCY — the candidate's
 breakdown components must sum to the measured request wall time within 5%
@@ -76,6 +85,9 @@ SHAPE_FIELDS = (
     # round 15: the pass-pipeline probe model's shape — a different capture
     # legitimately matches a different number of fusion patterns
     "passes_dims",
+    # round 17: the prefix/spec sub-run's trace + knobs (session templates,
+    # draft length, kv dtype, pool bytes) — different knobs, different rates
+    "prefix_spec_dims",
 )
 # larger-is-worse regression metrics per config record; the names match
 # what bench.py actually emits per config (ernie/llama/resnet report
@@ -97,7 +109,15 @@ THROUGHPUT_FIELDS = ("tokens_per_sec", "samples_per_sec",
                      # round 13: fleet tokens/s at the widest replica count
                      # over the 1-replica run — scaling falling with flat
                      # work is a routing/overlap regression
-                     "scaling_vs_1replica")
+                     "scaling_vs_1replica",
+                     # round 17: prefix-cache hit rate, speculative-decode
+                     # accept rate, and same-pool-bytes concurrency ratio —
+                     # any of them falling with an unchanged prefix_spec_dims
+                     # means the serving optimizations silently stopped
+                     # working (index un-matching, draft quality loss, CoW
+                     # storm), which no time field on the small probe sees
+                     "prefix_hit_rate", "spec_accept_rate",
+                     "concurrency_vs_baseline")
 ATTR_WORK_FIELDS = ("flops", "hbm_bytes")
 ATTR_MEM_FIELDS = ("program_memory_bytes", "peak_hbm_bytes")
 # round 16: breakdown-sum-vs-measured-wall tolerance (matches the 5%
