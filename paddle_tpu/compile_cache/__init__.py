@@ -1,0 +1,76 @@
+"""Compilation-lifecycle observability + persistent compile cache.
+
+One subsystem, two inseparable halves (round 18):
+
+- **Observability** (`ledger`): every `lower()`/`compile()` across the
+  four compile entry points — static `Executor`, `to_static`, the
+  `InferenceEngine` shape buckets, the fused-optimizer engine — emits a
+  structured event (origin, stable program fingerprint, signature, wall
+  seconds, hit|miss|restore|shared|persist outcome) into a bounded store
+  with `paddle_tpu_compile_*` telemetry, compile spans in the request
+  trace's chrome lanes, and a cold-start timeline report
+  (`python -m paddle_tpu.compile_cache report`) decomposing the
+  engine-load -> first-token wall.
+
+- **Cache** (`store`): compiled executables persisted keyed by
+  (program fingerprint, topology meta, jax version) in an atomic
+  CRC-verified layout (PR 2's torn-write discipline), restored instead of
+  recompiled on the next process — plus an in-process shared registry so
+  fleet replicas with identical signatures compile once. Point the process
+  at a directory with `configure(path)` or the
+  `PADDLE_TPU_COMPILE_CACHE_DIR` env var (exported ahead by the elastic
+  relaunch path so restarted workers land on a warm cache).
+"""
+from . import fingerprint, ledger, report, store  # noqa: F401
+from .fingerprint import (  # noqa: F401
+    aval_signature,
+    entry_key,
+    fingerprint_text,
+    topology_meta,
+)
+from .ledger import (  # noqa: F401
+    events,
+    record,
+    reset,
+    reset_timeline,
+    summary,
+)
+from .report import cold_start_report, format_report  # noqa: F401
+from .store import (  # noqa: F401
+    CompileCacheStore,
+    active_store,
+    clear_shared,
+    configure,
+    make_meta,
+    serialization_available,
+    shared_get,
+    shared_put,
+    store_dir,
+)
+
+__all__ = [
+    "fingerprint",
+    "ledger",
+    "report",
+    "store",
+    "aval_signature",
+    "entry_key",
+    "fingerprint_text",
+    "topology_meta",
+    "events",
+    "record",
+    "reset",
+    "reset_timeline",
+    "summary",
+    "cold_start_report",
+    "format_report",
+    "CompileCacheStore",
+    "active_store",
+    "clear_shared",
+    "configure",
+    "make_meta",
+    "serialization_available",
+    "shared_get",
+    "shared_put",
+    "store_dir",
+]

@@ -90,6 +90,7 @@ class InferenceEngine:
     ):
         from ..jit.api import state_values
 
+        _t_init = time.monotonic()
         cfg = dict(getattr(model, "config", {}))
         if not cfg:
             raise ValueError(
@@ -165,6 +166,18 @@ class InferenceEngine:
         # bumped by every load_weights(); the fleet exports it per replica
         # so a half-finished rollout is visible in telemetry
         self.weights_version = 0
+        # round 18: compile-cache plumbing — per-signature fingerprints
+        # (lazy), the topology meta restore verifies against, and the
+        # cold-start timeline marks the `compile_cache report` decomposes
+        self._fingerprints: Dict[Tuple[str, object], Tuple[str, str]] = {}
+        self._fp_base: Optional[str] = None
+        self._topo_meta: Optional[dict] = None
+        self._first_token_marked = False
+        if telemetry.enabled():
+            from .. import compile_cache as _cc
+
+            _cc.ledger.mark("engine_load_start", _t_init)
+            _cc.ledger.span("engine_init", _t_init, time.monotonic())
 
     # ---- zero-downtime weight hot-swap hooks ----
     def load_weights(self, state) -> int:
@@ -248,6 +261,39 @@ class InferenceEngine:
                 return b
         raise ValueError(f"{kind} size {n} exceeds the largest bucket {buckets[-1]}")
 
+    def _bucket_key(self, kind: str, size) -> Tuple[str, str]:
+        """(program fingerprint, disk/share entry key) for one bucket
+        signature — a canonical text over everything the compiled artifact
+        depends on (dims, bucket, pool/state avals, param avals, donation,
+        shardings) and nothing it doesn't: weight VALUES are call
+        arguments, so same-signature replicas share by construction."""
+        cached = self._fingerprints.get((kind, size))
+        if cached is not None:
+            return cached
+        from .. import compile_cache as _cc
+
+        if self._fp_base is None:
+            shard_txt = "none"
+            if self._param_shardings is not None:
+                shard_txt = ";".join(
+                    f"{k}={s.spec}" for k, s in sorted(self._param_shardings.items())
+                ) + f"|pages={self._page_sharding.spec}"
+            self._fp_base = "|".join((
+                "serving-bucket-v1",
+                _cc.aval_signature(self._param_avals()),
+                _cc.aval_signature(self._state_avals()),
+                f"block={self.block_size},pages={self.max_pages},"
+                f"vocab={self.vocab_size},donate={self._donate}",
+                f"model={type(self._model).__name__}",
+                shard_txt,
+            ))
+            self._topo_meta = _cc.topology_meta(self._mesh)
+        sz = size if isinstance(size, int) else "x".join(str(s) for s in size)
+        fp = _cc.fingerprint_text(f"{self._fp_base}|{kind}:{sz}")
+        out = (fp, _cc.entry_key(fp, self._topo_meta))
+        self._fingerprints[(kind, size)] = out
+        return out
+
     def _get_compiled(self, kind: str, size):
         key = (kind, size)
         # extend signatures are (B, Q) pairs; everything downstream wants a
@@ -258,37 +304,112 @@ class InferenceEngine:
             self.bucket_stats["hits"] += 1
             if telemetry.enabled():
                 _bucket_counter().labels(kind=kind, event="hit").inc()
+                from .. import compile_cache as _cc
+
+                _cc.record("serving", f"{kind}_{sz}", "hit")
             if _rt.enabled():
                 _rt.record_event("engine", "dispatch", kind=kind, size=sz,
                                  event="hit")
             return ex
+        from .. import compile_cache as _cc
+
+        name = f"{kind}_{sz}"
         t0 = time.perf_counter()
-        if kind == "prefill":
-            ex = self._compile_prefill(size)
-        elif kind == "decode":
-            ex = self._compile_decode(size)
-        else:  # ("extend", (B, Q))
-            ex = self._compile_extend(*size)
+        fp, ekey = self._bucket_key(kind, size)
+        outcome = "miss"
+        ex = _cc.shared_get(ekey)
+        if ex is not None:
+            # in-process sharing (round-18 bugfix): a same-signature replica
+            # already compiled this bucket program — reuse its executable
+            outcome = "shared"
+        else:
+            st = _cc.active_store()
+            if st is not None:
+                got = st.get(ekey, expect_meta=self._topo_meta)
+                if got is not None:
+                    ex = got[0]
+                    outcome = "restore"
+        if ex is None:
+            if kind == "prefill":
+                ex = self._compile_prefill(size)
+            elif kind == "decode":
+                ex = self._compile_decode(size)
+            else:  # ("extend", (B, Q))
+                ex = self._compile_extend(*size)
         dt = time.perf_counter() - t0
         self._compiled[key] = ex
-        self.bucket_stats["compiles"] += 1
+        if outcome == "miss":
+            self.bucket_stats["compiles"] += 1
+        else:
+            # shared/restored keys appear only when those outcomes happen:
+            # the baseline {hits, compiles} shape is unchanged for engines
+            # that never touch the cache
+            k = "shared" if outcome == "shared" else "restored"
+            self.bucket_stats[k] = self.bucket_stats.get(k, 0) + 1
+        _cc.shared_put(ekey, ex)
+        event = "compile" if outcome == "miss" else outcome
         if _rt.enabled():
             # a compile-miss dispatch IS a tail-latency event: the signature
             # + wall time land in the trace so a bucket-miss-shaped p99 blip
             # is attributable instead of mysterious
             _rt.record_event("engine", "dispatch", kind=kind, size=sz,
-                             event="compile", dur_s=round(dt, 6))
+                             event=event, dur_s=round(dt, 6))
+        _cc.record("serving", name, outcome, seconds=dt, fingerprint=fp,
+                   signature=sz)
         if telemetry.enabled():
-            _bucket_counter().labels(kind=kind, event="compile").inc()
-            try:
-                from ..profiler import perf_attribution as _pa
+            _bucket_counter().labels(kind=kind, event=event).inc()
+            if outcome == "miss":
+                try:
+                    from ..profiler import perf_attribution as _pa
 
-                _pa.record_compiled(
-                    "serving", f"{kind}_{sz}", compiled=ex, compile_seconds=dt
-                )
-            except Exception:
-                pass
+                    _pa.record_compiled(
+                        "serving", name, compiled=ex, compile_seconds=dt
+                    )
+                except Exception:
+                    pass
+        if outcome == "miss":
+            st = _cc.active_store()
+            if st is not None:
+                tp = time.perf_counter()
+                if st.put(ekey, ex,
+                          _cc.make_meta("serving", name, fp, signature=sz,
+                                        mesh=self._mesh)):
+                    _cc.record("serving", name, "persist",
+                               seconds=time.perf_counter() - tp,
+                               fingerprint=fp, signature=sz)
         return ex
+
+    def prewarm(self, *, include_decode: bool = True,
+                extend_q: Sequence[int] = ()) -> dict:
+        """Compile (or restore/share) every bucket program up front, so
+        steady-state serving — and the first token — never pays a compile.
+        `extend_q` adds the (B, Q) extend/verify family for the given
+        query lengths (speculative decode uses draft_len + 1). Records the
+        `prewarm` span the cold-start report decomposes. Returns a copy of
+        bucket_stats."""
+        t0 = time.monotonic()
+        for S in self.prefill_buckets:
+            self._get_compiled("prefill", S)
+        if include_decode:
+            for B in self.decode_batch_buckets:
+                self._get_compiled("decode", B)
+        for q in extend_q:
+            for B in self.decode_batch_buckets:
+                self._get_compiled("extend", (B, int(q)))
+        if telemetry.enabled():
+            from .. import compile_cache as _cc
+
+            _cc.ledger.span("prewarm", t0, time.monotonic())
+        return dict(self.bucket_stats)
+
+    def _mark_first_token(self) -> None:
+        if self._first_token_marked:
+            return
+        self._first_token_marked = True
+        if telemetry.enabled():
+            from .. import compile_cache as _cc
+
+            _cc.ledger.mark("first_token")
 
     def _state_avals(self):
         """Avals mirroring pool.device_state(): per-layer page arrays plus
@@ -470,7 +591,9 @@ class InferenceEngine:
             jnp.asarray(bt), self.pool.device_state(),
         )
         self.pool.adopt_state(state)
-        return np.asarray(logits[0])
+        out = np.asarray(logits[0])
+        self._mark_first_token()
+        return out
 
     def decode(
         self,
@@ -501,7 +624,9 @@ class InferenceEngine:
             jnp.asarray(bt), self.pool.device_state(),
         )
         self.pool.adopt_state(state)
-        return np.asarray(logits[:n])
+        out = np.asarray(logits[:n])
+        self._mark_first_token()
+        return out
 
     def extend(
         self,
@@ -541,7 +666,9 @@ class InferenceEngine:
             jnp.asarray(valid), jnp.asarray(bt), self.pool.device_state(),
         )
         self.pool.adopt_state(state)
-        return np.asarray(logits[:n])
+        out = np.asarray(logits[:n])
+        self._mark_first_token()
+        return out
 
     # ---- convenience: batch greedy generation through the scheduler ----
     def generate(

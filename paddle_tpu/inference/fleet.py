@@ -252,6 +252,19 @@ class ReplicaFleet:
     def healthy(self) -> List[_Replica]:
         return [r for r in self.replicas if r.status == ReplicaStatus.HEALTHY]
 
+    def prewarm(self) -> dict:
+        """Compile (or restore) every replica's shape buckets before
+        traffic. Replicas sharing a model signature compile each bucket
+        ONCE: the first replica pays the miss (or a persistent-cache
+        restore), the rest adopt the executable from the in-process shared
+        registry (ledger outcome=shared) — N-replica fleet cold start costs
+        one replica's compiles, not N. Returns per-replica bucket stats."""
+        return {
+            r.idx: r.engine.prewarm()
+            for r in self.replicas
+            if hasattr(r.engine, "prewarm")
+        }
+
     # ---- routing ----
     def _score(self, rep: _Replica) -> float:
         """Expected time for a new request to start making progress:

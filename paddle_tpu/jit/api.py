@@ -328,8 +328,42 @@ class _CompiledEntry:
                                 self.jitted = None
                                 raise
                     t0 = _time.perf_counter()
+                    # round 18: fingerprint the traced jaxpr (the PR 12
+                    # textual IR of a to_static step) and try the persistent
+                    # cache before paying XLA compile. Fingerprinting is
+                    # telemetry-gated like the rest of the attribution path.
+                    from .. import compile_cache as _cc
+                    from .. import telemetry as _tm
+
+                    fname = getattr(self.fn, "__name__", "<fn>")
+                    fp = ekey = st = None
+                    if _tm.enabled():
+                        try:
+                            fp = _cc.fingerprint_text(
+                                f"to_static-v1|{fname}|"
+                                f"donate={self.donated}|{traced.jaxpr}"
+                            )
+                            ekey = _cc.entry_key(fp)
+                            st = _cc.active_store()
+                        except Exception:
+                            fp = ekey = st = None
+                    restored = None
+                    if st is not None and ekey is not None:
+                        got = st.get(ekey, expect_meta=_cc.topology_meta())
+                        if got is not None:
+                            restored = got[0]
+                    if restored is not None:
+                        self.jitted = restored
+                        _cc.record(
+                            "to_static", fname, "restore",
+                            seconds=_time.perf_counter() - t0,
+                            fingerprint=fp,
+                            signature=f"n_state={len(self.state)}",
+                        )
+                        break
                     lowered = traced.lower()
                     self.jitted = lowered.compile()
+                    dt = _time.perf_counter() - t0
                     # attribution capture at the one place the whole train
                     # step exists as a compiled XLA program: FLOPs, HBM
                     # bytes, memory footprint, compile time (telemetry-gated
@@ -338,12 +372,26 @@ class _CompiledEntry:
 
                     _pa.record_compiled(
                         "to_static",
-                        getattr(self.fn, "__name__", "<fn>"),
+                        fname,
                         lowered=lowered,
                         compiled=self.jitted,
-                        compile_seconds=_time.perf_counter() - t0,
+                        compile_seconds=dt,
                         extra={"n_state": len(self.state)},
                     )
+                    _cc.record(
+                        "to_static", fname, "miss", seconds=dt,
+                        fingerprint=fp,
+                        signature=f"n_state={len(self.state)}",
+                    )
+                    if st is not None and ekey is not None:
+                        tp = _time.perf_counter()
+                        if st.put(ekey, self.jitted,
+                                  _cc.make_meta("to_static", fname, fp)):
+                            _cc.record(
+                                "to_static", fname, "persist",
+                                seconds=_time.perf_counter() - tp,
+                                fingerprint=fp,
+                            )
                     break
                 self.state.extend(missed)
             else:

@@ -205,13 +205,28 @@ class FlatAdamWEngine:
                 flat(jnp.float32), scalar, scalar, scalar,
             )
             compiled = lowered.compile()
+            dt = time.perf_counter() - t0
+            name = f"bucket[{_np.dtype(dtype).name},n={n_pad}]"
             _pa.record_compiled(
                 "fused_optimizer",
-                f"bucket[{_np.dtype(dtype).name},n={n_pad}]",
+                name,
                 lowered=lowered,
                 compiled=compiled,
-                compile_seconds=time.perf_counter() - t0,
+                compile_seconds=dt,
                 extra={"n_elems": n_pad, "m2_dtype": str(_np.dtype(m2_dtype))},
+            )
+            # round 18 compile ledger (observability only — the bucket
+            # kernel re-specializes on optimizer state in ways the
+            # persistent store's fingerprint can't capture, so no store)
+            from .. import compile_cache as _cc
+
+            _cc.record(
+                "fused_optimizer", name, "miss", seconds=dt,
+                fingerprint=_cc.fingerprint_text(
+                    f"fused-optimizer-v1|{name}|wd={wdv}|"
+                    f"decoupled={decoupled}|m2={_np.dtype(m2_dtype).name}"
+                ),
+                signature=f"n={n_pad}",
             )
         except Exception:
             pass

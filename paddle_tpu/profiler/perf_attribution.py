@@ -467,7 +467,7 @@ def roofline(flops, bytes_accessed, seconds, platform: Optional[str] = None,
 _REPORT_KEYS = (
     "version", "generated_at", "platform", "telemetry_enabled",
     "programs", "live_arrays", "hbm_watermark", "input_pipeline",
-    "serving",
+    "serving", "compilation",
 )
 _PROGRAM_KEYS = (
     "serial", "origin", "name", "platform", "flops", "bytes_accessed",
@@ -514,6 +514,26 @@ def _serving_section() -> dict:
         return {"available": False, "reason": f"request_trace failed: {e}"}
 
 
+def _compilation_section() -> dict:
+    """The compile-lifecycle ledger rollup (round 18): event/hit/miss/
+    restore counts and compile seconds by origin, plus the persistent
+    store's size/entry footprint when one is configured. Answers 'what did
+    cold start cost and how much of it did the cache absorb' from the same
+    report that already attributes steady-state FLOPs."""
+    try:
+        from .. import compile_cache as _cc
+
+        section = _cc.summary()
+    except Exception as e:  # the report must render without the ledger
+        return {"available": False, "reason": f"compile ledger failed: {e}"}
+    try:
+        st = _cc.active_store()
+        section["store"] = st.stats() if st is not None else None
+    except Exception:
+        section["store"] = None
+    return section
+
+
 def perf_report(origin: Optional[str] = None) -> dict:
     """The queryable attribution summary (exported as
     `paddle.profiler.perf_report`): every recorded program's FLOPs / bytes /
@@ -530,6 +550,7 @@ def perf_report(origin: Optional[str] = None) -> dict:
         "hbm_watermark": watermark(),
         "input_pipeline": _input_pipeline_section(),
         "serving": _serving_section(),
+        "compilation": _compilation_section(),
     }
 
 
@@ -561,6 +582,16 @@ def validate_report(report: dict) -> dict:
         for k in ("cached_tokens", "spec"):
             if k not in report["serving"]:
                 raise ValueError(f"serving section missing {k!r}")
+    comp = report["compilation"]
+    if "available" not in comp:
+        raise ValueError("compilation section missing 'available'")
+    if comp.get("available"):
+        # round 18: a live ledger must carry the cold-start accounting —
+        # zero counts are fine, absent keys mean the rollup regressed
+        for k in ("hits", "misses", "hit_rate", "total_compile_seconds",
+                  "by_origin"):
+            if k not in comp:
+                raise ValueError(f"compilation section missing {k!r}")
     return report
 
 

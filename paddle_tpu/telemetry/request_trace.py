@@ -323,9 +323,12 @@ def record_span(lane: str, name: str, t0: float, t1: float,
 # ---------------------------------------------------------------------------
 
 # chrome-trace pid blocks: request lanes live far above any real rank pid
-# so a merged trace can never collide lanes
+# so a merged trace can never collide lanes. The `compile` lane carries the
+# compile-cache ledger's spans (round 18) so cold-start compile activity
+# interleaves with the request/engine lanes in a merged trace.
 REQUEST_PID_BASE = 100000
-_GLOBAL_LANE_PIDS = {"engine": 90001, "kv_pool": 90002, "fleet": 90003}
+_GLOBAL_LANE_PIDS = {"engine": 90001, "kv_pool": 90002, "fleet": 90003,
+                     "compile": 90004}
 
 
 def to_chrome_trace(rec: Optional[RequestTraceRecorder] = None) -> dict:

@@ -26,3 +26,20 @@ def pytest_configure(config):
         "slow: subprocess-spawning chaos/integration tests excluded from the "
         "tier-1 run (-m 'not slow')",
     )
+
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _isolate_compile_cache():
+    """The in-process shared executable registry (round 18) deliberately
+    spans engine instances — which would also span TESTS: an engine built in
+    an earlier test would donate buckets to a later test's identical-dims
+    engine, breaking exact bucket_stats assertions. Start every test with an
+    empty registry (the persistent store is untouched — it is opt-in via
+    env/configure and tests that want it set their own tmp dir)."""
+    from paddle_tpu import compile_cache
+
+    compile_cache.clear_shared()
+    yield

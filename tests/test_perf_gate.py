@@ -690,3 +690,70 @@ def test_prefix_spec_dims_change_not_compared(tmp_path):
     rc, out, err = _run(a, b)
     assert rc == 0, (out, err)
     assert "workload changed" in out and "prefix_spec_dims" in out
+
+
+# ---------------------------------------------------------------------------
+# round 18: compile-cache cold/warm start gates
+# ---------------------------------------------------------------------------
+
+def _with_coldstart(cold=2500.0, warm=170.0, hit=1.0, max_batch=8):
+    c = _with_serving()
+    sv = c["detail"]["serving"]
+    sv["cold_start_ttft_ms"] = cold
+    sv["warm_start_ttft_ms"] = warm
+    sv["cache_hit_rate"] = hit
+    sv["coldstart_dims"] = {
+        "vocab": 8192, "hidden": 512, "layers": 4, "max_seq": 256,
+        "block_size": 16, "max_batch": max_batch, "gen_tokens": 4,
+    }
+    return c
+
+
+def test_warm_start_ttft_regression_fails(tmp_path):
+    """Polarity pin: warm_start_ttft_ms is larger-is-WORSE — the warm
+    relaunch creeping back toward cold is exactly the restore-path rot the
+    gate exists to catch."""
+    a = _write(tmp_path, "a.json", _with_coldstart(warm=170.0))
+    b = _write(tmp_path, "b.json", _with_coldstart(warm=240.0))  # +41%
+    rc, out, err = _run(a, b)
+    assert rc == 1, (out, err)
+    assert "warm_start_ttft_ms" in out
+
+
+def test_cold_start_ttft_regression_fails(tmp_path):
+    a = _write(tmp_path, "a.json", _with_coldstart(cold=2500.0))
+    b = _write(tmp_path, "b.json", _with_coldstart(cold=3300.0))  # +32%
+    rc, out, err = _run(a, b)
+    assert rc == 1, (out, err)
+    assert "cold_start_ttft_ms" in out
+
+
+def test_cache_hit_rate_drop_fails(tmp_path):
+    """Polarity pin: cache_hit_rate is larger-is-BETTER — a drop with flat
+    coldstart_dims means the store stopped matching its own entries."""
+    a = _write(tmp_path, "a.json", _with_coldstart(hit=1.0))
+    b = _write(tmp_path, "b.json", _with_coldstart(hit=0.6))
+    rc, out, err = _run(a, b)
+    assert rc == 1, (out, err)
+    assert "cache_hit_rate" in out and "throughput regression" in out
+
+
+def test_coldstart_improvement_and_equal_pass(tmp_path):
+    a = _write(tmp_path, "a.json", _with_coldstart())
+    b = _write(tmp_path, "b.json",
+               _with_coldstart(cold=2000.0, warm=120.0, hit=1.0))
+    rc, out, err = _run(a, b)
+    assert rc == 0, (out, err)
+    c = _write(tmp_path, "c.json", _with_coldstart())
+    rc, out, err = _run(a, c)
+    assert rc == 0, (out, err)
+
+
+def test_coldstart_dims_change_not_compared(tmp_path):
+    # a different bucket family compiles a different number of programs —
+    # slower starts under different dims are a different workload
+    a = _write(tmp_path, "a.json", _with_coldstart(warm=170.0, max_batch=8))
+    b = _write(tmp_path, "b.json", _with_coldstart(warm=400.0, max_batch=16))
+    rc, out, err = _run(a, b)
+    assert rc == 0, (out, err)
+    assert "workload changed" in out and "coldstart_dims" in out

@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""Persistent compile-cache maintenance CLI (round 18).
+
+The on-disk executable cache (`paddle_tpu.compile_cache.store`) is an
+append-mostly directory of CRC-verified entries that serving processes
+read at engine load. This tool is the operator surface over that
+directory:
+
+    python tools/compile_cache.py stats  [--dir DIR]
+    python tools/compile_cache.py verify [--dir DIR]
+    python tools/compile_cache.py gc     [--dir DIR] --max-bytes N
+
+  - `stats`  — entry count / payload bytes / per-origin breakdown;
+  - `verify` — walk every entry through the same commit-marker + CRC
+    checks a restore performs; exits 1 when any entry is corrupt (a torn
+    write that slipped past the atomic-rename discipline, bit rot, a
+    partial rsync) so a cron wrapper can alert;
+  - `gc`     — delete corrupt entries first, then evict LRU (by
+    last-restore time) until the payload footprint fits under
+    `--max-bytes`. Eviction is safe by construction: a reader that loses
+    the race sees a missing COMPLETE marker and recompiles.
+
+`--dir` defaults to $PADDLE_TPU_COMPILE_CACHE_DIR; all subcommands print
+one JSON document to stdout so wrappers parse instead of scrape.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from paddle_tpu.compile_cache.store import ENV_DIR, CompileCacheStore  # noqa: E402
+
+
+def _store(args) -> CompileCacheStore:
+    root = args.dir or os.environ.get(ENV_DIR)
+    if not root:
+        print(f"compile_cache: no cache dir (pass --dir or set {ENV_DIR})",
+              file=sys.stderr)
+        raise SystemExit(2)
+    return CompileCacheStore(root)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="tools/compile_cache.py",
+        description="persistent compile-cache maintenance",
+    )
+    common = argparse.ArgumentParser(add_help=False)
+    common.add_argument("--dir", default=None,
+                        help=f"cache directory (default: ${ENV_DIR})")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("stats", parents=[common],
+                   help="entry count / bytes / per-origin breakdown")
+    sub.add_parser("verify", parents=[common],
+                   help="CRC+marker check every entry; exit 1 on "
+                        "any corrupt entry")
+    gp = sub.add_parser("gc", parents=[common],
+                        help="drop corrupt entries, evict LRU to fit "
+                             "a byte budget")
+    gp.add_argument("--max-bytes", type=int, required=True,
+                    help="payload budget; 0 empties the cache")
+    args = p.parse_args(argv)
+    st = _store(args)
+
+    if args.cmd == "stats":
+        print(json.dumps(st.stats(), indent=1, sort_keys=True))
+        return 0
+    if args.cmd == "verify":
+        rep = st.verify()
+        print(json.dumps(rep, indent=1, sort_keys=True))
+        return 0 if not rep.get("corrupt") else 1
+    rep = st.gc(max_bytes=args.max_bytes)
+    print(json.dumps(rep, indent=1, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

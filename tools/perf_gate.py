@@ -88,6 +88,9 @@ SHAPE_FIELDS = (
     # round 17: the prefix/spec sub-run's trace + knobs (session templates,
     # draft length, kv dtype, pool bytes) — different knobs, different rates
     "prefix_spec_dims",
+    # round 18: the cold-start sub-run's engine dims + bucket ladder — a
+    # different bucket family compiles a different number of executables
+    "coldstart_dims",
 )
 # larger-is-worse regression metrics per config record; the names match
 # what bench.py actually emits per config (ernie/llama/resnet report
@@ -100,6 +103,10 @@ TIME_FIELDS = (
     # round 13: the inter-token p99 measured INSIDE the weight-swap window —
     # a rollout whose blip grows past tol is a drain-protocol regression
     "p99_tpot_swap_ms",
+    # round 18: engine-start -> first-token wall, cold (empty persistent
+    # cache: pays XLA) and warm (restore-only relaunch). Warm growing back
+    # toward cold means the compile cache quietly stopped restoring
+    "cold_start_ttft_ms", "warm_start_ttft_ms",
 )
 # larger-is-BETTER metrics: a drop beyond tolerance with flat attributed
 # work is the same unexplained-regression signal inverted (serving
@@ -117,7 +124,12 @@ THROUGHPUT_FIELDS = ("tokens_per_sec", "samples_per_sec",
                      # working (index un-matching, draft quality loss, CoW
                      # storm), which no time field on the small probe sees
                      "prefix_hit_rate", "spec_accept_rate",
-                     "concurrency_vs_baseline")
+                     "concurrency_vs_baseline",
+                     # round 18: fraction of compile-cache lookups served
+                     # without paying XLA (hit|shared|restore) on the warm
+                     # relaunch — falling with flat coldstart_dims means the
+                     # persistent store stopped matching its own entries
+                     "cache_hit_rate")
 ATTR_WORK_FIELDS = ("flops", "hbm_bytes")
 ATTR_MEM_FIELDS = ("program_memory_bytes", "peak_hbm_bytes")
 # round 16: breakdown-sum-vs-measured-wall tolerance (matches the 5%
