@@ -157,6 +157,7 @@ _EST_S = {
     # A/B (baseline f32 vs int8+prefix+spec on the same pool bytes)
     "serving": 300,
     "fleet": 240,
+    "qos": 180,
     "resnet": 180,
     "moe_longcontext": 240,
     "ernie4096": 240,
@@ -1150,6 +1151,219 @@ def _build_fleet():
         shutil.rmtree(ck_root, ignore_errors=True)
 
 
+def _qos_dims():
+    """QoS overload-replay knobs (round 19), all BENCH_QOS_* overridable
+    (tier-1 capture tests run a seconds-scale replay; a shrunken run
+    records qos_dims so it can't masquerade). The replay offers
+    `overload_factor` x the decode-slot capacity in a burst of mixed
+    tenants/priorities; `free_rate`/`free_burst` are the rate-limited
+    tenant's token bucket."""
+    g = os.environ.get
+    return {
+        "vocab": int(g("BENCH_QOS_VOCAB", 8192)),
+        "hidden": int(g("BENCH_QOS_HIDDEN", 256)),
+        "layers": int(g("BENCH_QOS_LAYERS", 2)),
+        "heads": int(g("BENCH_QOS_HEADS", 8)),
+        "kv_heads": int(g("BENCH_QOS_KV_HEADS", 4)),
+        "ffn": int(g("BENCH_QOS_FFN", 688)),
+        "max_seq": int(g("BENCH_QOS_MAX_SEQ", 128)),
+        "block_size": int(g("BENCH_QOS_BLOCK", 16)),
+        "max_batch": int(g("BENCH_QOS_BATCH", 4)),
+        "n_requests": int(g("BENCH_QOS_REQUESTS", 40)),
+        "max_new": int(g("BENCH_QOS_MAX_NEW", 8)),
+        "seed": int(g("BENCH_QOS_SEED", 19)),
+        "gap_s": float(g("BENCH_QOS_GAP", 0.001)),
+        "free_rate": float(g("BENCH_QOS_FREE_RATE", 300.0)),
+        "free_burst": float(g("BENCH_QOS_FREE_BURST", 120.0)),
+        "enter_pressure": float(g("BENCH_QOS_ENTER", 0.9)),
+        "exit_pressure": float(g("BENCH_QOS_EXIT", 0.5)),
+        "cooldown_s": float(g("BENCH_QOS_COOLDOWN", 0.05)),
+        "capped_max_new": int(g("BENCH_QOS_CAP", 4)),
+        "submit_probe_n": int(g("BENCH_QOS_SUBMIT_PROBE", 2000)),
+    }
+
+
+def _build_qos():
+    """Round 19: overload protection under a >= 2x-capacity mixed-tenant
+    burst. The SAME seeded traffic runs twice: the priority-0 ("gold")
+    class alone (uncontended baseline), then the full burst through the
+    QoS scheduler (weighted-fair dequeue, per-tenant rate limit, brownout
+    ladder). Gated fields: fairness_index (throughput-polarity — falling
+    means weighted-fair dequeue stopped holding), p99_tpot_gold_ms and
+    gold_p99_vs_uncontended (time-polarity — growing means priority
+    admission/preemption stopped shielding the top class); qos_dims is the
+    shape guard. Sheds are counted by reason; zero-loss is asserted here
+    (every offered request terminal exactly once), not just reported."""
+    import gc
+    import timeit
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.engine import InferenceEngine
+    from paddle_tpu.inference.qos import (
+        BrownoutConfig, QoSConfig, QoSPolicy, TenantConfig, tenant_report,
+    )
+    from paddle_tpu.inference.scheduler import (
+        ContinuousBatchingScheduler, Request, percentiles, replay,
+    )
+    from paddle_tpu.models.llama import LlamaForCausalLM
+
+    d = _qos_dims()
+    paddle.seed(0)
+    model = LlamaForCausalLM(
+        vocab_size=d["vocab"], hidden_size=d["hidden"],
+        num_hidden_layers=d["layers"], num_attention_heads=d["heads"],
+        num_key_value_heads=d["kv_heads"], intermediate_size=d["ffn"],
+    )
+    model.eval()
+
+    TENANTS = (("gold", 0, 4.0), ("silver", 1, 2.0),
+               ("bronze", 2, 1.0), ("free", 2, 1.0))
+
+    def mk_requests(only_tenant=None):
+        rng = np.random.RandomState(d["seed"])
+        max_prompt = max(8, d["max_seq"] // 4)
+        reqs, t = [], 0.0
+        for i in range(d["n_requests"]):
+            t += rng.exponential(d["gap_s"])
+            tenant, prio, _w = TENANTS[i % len(TENANTS)]
+            r = Request(
+                rid=i,
+                prompt=rng.randint(0, d["vocab"], (int(rng.randint(4, max_prompt)),)).tolist(),
+                max_new_tokens=d["max_new"],
+                arrival_time=t, tenant=tenant, priority=prio,
+            )
+            if only_tenant is None or tenant == only_tenant:
+                reqs.append(r)
+        return reqs
+
+    def fresh_engine():
+        eng = InferenceEngine(
+            model, max_seq_len=d["max_seq"], block_size=d["block_size"],
+            max_batch=d["max_batch"], decode_batch_buckets=(d["max_batch"],),
+        )
+        for b in eng.prefill_buckets:  # warmup: compile outside the replay
+            pages = eng.pool.alloc(eng.pool.blocks_for_tokens(b))
+            eng.prefill(list(range(1, b + 1)), pages)
+            eng.pool.reset()
+        pages = eng.pool.alloc(1)
+        eng.decode([1], [0], [1], [pages])
+        eng.pool.reset()
+        return eng
+
+    def mk_policy():
+        return QoSPolicy(QoSConfig(
+            tenants={
+                name: TenantConfig(
+                    weight=w,
+                    rate_tokens_per_s=d["free_rate"] if name == "free" else None,
+                    burst_tokens=d["free_burst"] if name == "free" else None,
+                )
+                for name, _p, w in TENANTS
+            },
+            brownout=BrownoutConfig(
+                enter_pressure=d["enter_pressure"],
+                exit_pressure=d["exit_pressure"],
+                cooldown_s=d["cooldown_s"],
+                capped_max_new=d["capped_max_new"],
+            ),
+        ))
+
+    gc.collect()
+    gc.disable()
+    try:
+        # uncontended baseline: the gold class alone, no QoS layer
+        base_sched = ContinuousBatchingScheduler(fresh_engine())
+        gold_only = mk_requests("gold")
+        replay(base_sched, gold_only)
+        base_gold_tpots = [iv * 1000.0 for r in gold_only
+                           for iv in np.diff(r.token_times)]
+        base_p99 = percentiles("x", base_gold_tpots)["p99_x"]
+
+        # the contended run: full burst through the QoS scheduler
+        qos = mk_policy()
+        sched = ContinuousBatchingScheduler(fresh_engine(), qos=qos)
+        reqs = mk_requests()
+        stats = replay(sched, reqs)
+    finally:
+        gc.enable()
+
+    # zero-loss: every offered request terminal exactly once
+    assert len(sched.finished) == len(reqs), (len(sched.finished), len(reqs))
+    assert sorted(r.rid for r in sched.finished) == [r.rid for r in reqs]
+    assert all(r.outcome in ("completed", "shed") for r in reqs)
+
+    rep = tenant_report(sched.finished, qos.config)
+    per_tenant_p99 = {
+        t: rep["tenants"][t].get("p99_tpot_ms")
+        for t in rep["tenants"]
+    }
+    gold_tpots = [iv * 1000.0 for r in reqs if r.tenant == "gold"
+                  for iv in np.diff(r.token_times)]
+    gold_p99 = percentiles("x", gold_tpots)["p99_x"]
+    sheds = sum(qos.shed_counts.values())
+
+    # per-submit QoS overhead: the admission gates on an already-drained
+    # scheduler (rate bucket + brownout + bounded-queue checks), measured
+    # against the same submit with no QoS layer (BASELINE round 19)
+    def probe(policy):
+        s = ContinuousBatchingScheduler(fresh_engine(), qos=policy)
+        s.drain()
+        n = d["submit_probe_n"]
+        reqs_p = [Request(rid=i, prompt=[1, 2, 3, 4], max_new_tokens=4)
+                  for i in range(n)]
+        it = iter(reqs_p)
+        return timeit.timeit(lambda: s.submit(next(it)), number=n) / n
+
+    t_plain = probe(None)
+    t_qos = probe(mk_policy())
+
+    res = {
+        "n_requests": len(reqs),
+        "overload_factor": round(len(reqs) / d["max_batch"], 2),
+        "tokens_per_sec": stats["tokens_per_sec"],
+        "p99_ttft_ms": stats["p99_ttft_ms"],
+        "p99_tpot_ms": stats["p99_tpot_ms"],
+        "p99_tpot_gold_ms": gold_p99,
+        "p99_tpot_uncontended_ms": base_p99,
+        "gold_p99_vs_uncontended": (
+            round(gold_p99 / base_p99, 3) if gold_p99 and base_p99 else None
+        ),
+        "per_tenant_p99_tpot_ms": per_tenant_p99,
+        "fairness_index": rep["fairness_index"],
+        "completed": sum(1 for r in reqs if r.outcome == "completed"),
+        "shed": sheds,
+        "shed_rate": round(sheds / len(reqs), 3),
+        "sheds_by_reason": dict(qos.shed_counts),
+        "preempted": sched.preempted_total,
+        "brownout_transitions": qos.brownout.transitions,
+        "brownout_final_step": qos.brownout.step,
+        "submit_overhead_us": round((t_qos - t_plain) * 1e6, 3),
+        "submit_plain_us": round(t_plain * 1e6, 3),
+        "wall_s": stats["wall_s"],
+        "note": (
+            "same seeded >= 2x-capacity burst: gold-alone baseline, then "
+            "the full mixed-tenant run under weighted-fair dequeue + rate "
+            "limit + brownout ladder; zero-loss asserted, sheds counted by "
+            "reason, fairness over weight-normalized generated tokens"
+        ),
+        "attribution": _attribution(
+            (stats.get("p50_tpot_ms") or 0) / 1000.0 or None, origin="serving"
+        ),
+    }
+    res["qos_dims"] = {k: d[k] for k in (
+        "vocab", "hidden", "layers", "heads", "kv_heads", "ffn", "max_seq",
+        "block_size", "max_batch", "max_new", "seed", "gap_s", "free_rate",
+        "free_burst", "enter_pressure", "exit_pressure", "cooldown_s",
+        "capped_max_new",
+    )}
+    res["qos_dims"]["tenants"] = [
+        {"name": n, "priority": p, "weight": w} for n, p, w in TENANTS
+    ]
+    return res
+
+
 def _input_dims():
     """Input-bound streaming-bench knobs, all BENCH_INPUT_* overridable
     (tier-1 capture tests run a seconds-scale pipeline; a shrunken run
@@ -1678,7 +1892,7 @@ class _Snapshot:
     ones already measured."""
 
     CONFIGS = ("seq128", "passes", "seq4096", "llama3_shape", "resnet50",
-               "ppocr_e2e", "serving", "fleet", "input_stream",
+               "ppocr_e2e", "serving", "fleet", "qos", "input_stream",
                "moe_longcontext")
 
     def __init__(self):
@@ -1727,6 +1941,7 @@ def main():
             "ocr": lambda: _build_ppocr(n_images=steps_c),
             "serving": _build_serving,
             "fleet": _build_fleet,
+            "qos": _build_qos,
             "input_stream": _build_input_stream,
             "moe_longcontext": _build_moe_longcontext,
         }
@@ -1907,6 +2122,22 @@ def main():
             "fleet",
             "measured" if "skipped" not in res_fl
             else f"skipped:{res_fl['skipped']}",
+        )
+
+    if skip_env("BENCH_SKIP_QOS"):
+        snap.resolve("qos", "skipped:env")
+    else:
+        res_qs = _run_config_child("qos", 0)
+        detail["qos"] = res_qs if "skipped" in res_qs else {
+            **res_qs,
+            "note": res_qs.get("note", "") + " (round 19: fairness_index, "
+                    "p99_tpot_gold_ms and gold_p99_vs_uncontended gate in "
+                    "tools/perf_gate.py against qos_dims)",
+        }
+        snap.resolve(
+            "qos",
+            "measured" if "skipped" not in res_qs
+            else f"skipped:{res_qs['skipped']}",
         )
 
     if skip_env("BENCH_SKIP_VISION"):

@@ -99,7 +99,7 @@ class Config:
 
     # ---- serving engine (paged-KV decode) ----
     def enable_llm_engine(self, max_new_tokens=32, eos_id=None, llm_replicas=1,
-                          **engine_opts):
+                          qos=None, **engine_opts):
         """Route this Config through the serving InferenceEngine (paged KV
         cache + AOT shape buckets + continuous batching) instead of the
         frozen-program Predictor. Automatic when the model path carries a
@@ -108,9 +108,17 @@ class Config:
 
         `llm_replicas > 1` backs the predictor with a ReplicaFleet over
         that many engines sharing one weight set: SLO-aware routed,
-        replica-failure-surviving, hot-swappable (inference/fleet.py)."""
+        replica-failure-surviving, hot-swappable (inference/fleet.py).
+
+        `qos` (a qos.QoSConfig or qos.QoSPolicy) turns on overload
+        protection & multi-tenant fairness: per-tenant token buckets,
+        weighted-fair dequeue, bounded queues with explicit sheds, and
+        the brownout ladder. QoS always runs through a fleet backend
+        (of 1 when llm_replicas == 1) so the policy state is shared the
+        way a multi-replica deployment shares it."""
         self._llm_opts.update(max_new_tokens=max_new_tokens, eos_id=eos_id,
-                              llm_replicas=int(llm_replicas), **engine_opts)
+                              llm_replicas=int(llm_replicas), qos=qos,
+                              **engine_opts)
 
     def llm_replicas(self) -> int:
         return int(self._llm_opts.get("llm_replicas", 1))
@@ -238,6 +246,7 @@ class LLMPredictor:
         self._max_new_tokens = int(opts.pop("max_new_tokens", 32))
         self._eos_id = opts.pop("eos_id", None)
         self._n_replicas = max(1, int(opts.pop("llm_replicas", 1)))
+        self._qos = opts.pop("qos", None)
         self._engine_opts = opts
         self._build_backend()
         self._inputs = {
@@ -257,12 +266,25 @@ class LLMPredictor:
             for _ in range(self._n_replicas)
         ]
         self._engine = engines[0]
-        if self._n_replicas > 1:
+        qos = self._qos
+        if qos is not None:
+            # accept a bare QoSConfig; wrap it in the shared policy object
+            from .qos import QoSPolicy
+
+            if not isinstance(qos, QoSPolicy):
+                qos = QoSPolicy(qos)
+        self._qos = qos
+        if self._n_replicas > 1 or qos is not None:
             from .fleet import ReplicaFleet
 
-            self._fleet = ReplicaFleet(engines, eos_id=self._eos_id)
+            self._fleet = ReplicaFleet(engines, eos_id=self._eos_id, qos=qos)
         else:
             self._fleet = None
+
+    def qos(self):
+        """The shared QoSPolicy (None when QoS is off) — operational
+        surface for shed counts and the brownout rung."""
+        return self._qos
 
     def fleet(self):
         """The backing ReplicaFleet (None for a single-replica predictor) —
@@ -326,6 +348,9 @@ class LLMPredictor:
         c._max_new_tokens = self._max_new_tokens
         c._eos_id = self._eos_id
         c._n_replicas = self._n_replicas
+        # re-normalized by _build_backend: a QoSConfig yields the clone its
+        # own fresh policy state, an explicitly shared QoSPolicy stays shared
+        c._qos = self._config._llm_opts.get("qos")
         c._engine_opts = dict(self._engine_opts)
         c._build_backend()
         c._inputs = {

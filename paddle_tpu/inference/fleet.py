@@ -58,7 +58,13 @@ from .. import telemetry
 from ..telemetry import metrics as _metrics
 from ..telemetry import request_trace as _rt
 from ..distributed.resilience import fault_injection as _fi
-from .scheduler import ContinuousBatchingScheduler, Request, percentiles
+from .qos import QoSPolicy
+from .scheduler import (
+    ContinuousBatchingScheduler,
+    Request,
+    _req_counter,
+    percentiles,
+)
 
 __all__ = ["ReplicaFleet", "ReplicaStatus", "NoHealthyReplica", "fleet_replay"]
 
@@ -190,6 +196,7 @@ class ReplicaFleet:
         session_cache_size: int = 4096,
         prefix_cache: bool = True,
         spec_decode=None,
+        qos: Optional[QoSPolicy] = None,
     ):
         if not engines:
             raise ValueError("ReplicaFleet needs at least one engine")
@@ -197,6 +204,11 @@ class ReplicaFleet:
         self.breaker_threshold = max(1, int(breaker_threshold))
         self.heartbeat_deadline_s = heartbeat_deadline_s
         self.session_cache_size = max(1, int(session_cache_size))
+        # round 19: ONE QoSPolicy instance is shared by every replica's
+        # scheduler — token buckets, fair-share debt, and the brownout
+        # ladder are fleet-wide (a tenant can't dodge its quota by
+        # spraying replicas), and the held queue below shares its bounds
+        self.qos = qos
         # round 17: every replica's scheduler gets the prefix cache (on by
         # default — session affinity already routes a conversation to the
         # replica holding its warm pages, so hits compound) and, opt-in,
@@ -208,6 +220,7 @@ class ReplicaFleet:
                 ContinuousBatchingScheduler(
                     eng, eos_id=eos_id, max_running=max_running, clock=clock,
                     prefix_cache=prefix_cache, spec_decode=spec_decode,
+                    qos=qos,
                 ),
             )
             for i, eng in enumerate(engines)
@@ -222,6 +235,7 @@ class ReplicaFleet:
         # swap-blip p99
         self.swap_windows: List[tuple] = []
         self._pending: List[Request] = []  # held: no healthy replica yet
+        self._held_shed = 0  # sheds off the held list (bounded _pending)
         # affinity is a performance hint, so the home map is a bounded LRU:
         # an unbounded dict would grow by one entry per session ever seen,
         # exactly the steady state a long-lived fleet serves
@@ -235,6 +249,10 @@ class ReplicaFleet:
     @property
     def preempted_total(self) -> int:
         return sum(r.sched.preempted_total for r in self.replicas)
+
+    @property
+    def shed_total(self) -> int:
+        return self._held_shed + sum(r.sched.shed_total for r in self.replicas)
 
     def idle(self) -> bool:
         # an in-progress swap keeps the fleet non-idle so replay loops
@@ -310,6 +328,12 @@ class ReplicaFleet:
         return rep
 
     def submit(self, req: Request) -> None:
+        # TTL-sweep the held list on EVERY submit, not only in step(): a
+        # fully-down fleet raises NoHealthyReplica out of step(), after
+        # which callers stop stepping — without this sweep, expired work
+        # would sit in _pending forever and the outcome="expired" counter
+        # contract would silently stop holding on a dead fleet
+        self._expire_pending(self.clock())
         rep = self._route(req)  # a chaos raise leaves the request unstamped
         if rep is None:
             # held at the fleet: the TTL clock starts NOW — acceptance —
@@ -323,7 +347,20 @@ class ReplicaFleet:
             if req.trace is not None and req.trace.phase_name is None:
                 # held time is queue time with a cause: no healthy replica
                 req.trace.phase("queue", self.clock(), cause="held")
-            self._pending.append(req)
+            # the held line shares the QoS waiting bound: a dead fleet
+            # must shed the lowest eligible class explicitly, not grow
+            # an unbounded list nobody is draining
+            if self.qos is not None and self.qos.queue_full(len(self._pending)):
+                victim = self.qos.queue_full_victim(self._pending, req)
+                if victim is not req:
+                    self._pending.remove(victim)
+                    self._pending.append(req)
+                self.qos.note_shed("queue_full")
+                self._held_shed += 1
+                self._finish_held(victim, self.clock(), "shed",
+                                  reason="queue_full")
+            else:
+                self._pending.append(req)
         else:
             # the scheduler stamps submitted_time itself AFTER its own
             # validation, so a reject leaves the request entirely
@@ -335,9 +372,27 @@ class ReplicaFleet:
         # the caller retries
         self.submitted_total += 1
 
+    def _finish_held(self, req: Request, now: float, outcome: str,
+                     reason: str = "") -> None:
+        """Terminal disposition of a request that never left the fleet's
+        held list (no pages, no scheduler): same trace-close + counter
+        contract every scheduler-side terminal path honors."""
+        req.outcome = outcome
+        if outcome == "shed":
+            req.shed_reason = reason
+        req.finish_time = now
+        self.finished.append(req)
+        if req.trace is not None:
+            extra = {"reason": reason} if reason else {}
+            req.trace.close(now, outcome, generated=0,
+                            preemptions=req.preemptions, **extra)
+        if telemetry.enabled():
+            _req_counter().labels(event=outcome, reason=reason).inc()
+
     def _expire_pending(self, now: float) -> None:
         """TTL sweep over requests HELD at the fleet — a deadline must
-        bind even while no replica can take the work."""
+        bind even while no replica can take the work (run from submit()
+        as well as step(), so a dead fleet still expires its holds)."""
         for req in list(self._pending):
             if (
                 req.deadline_s is not None
@@ -345,17 +400,7 @@ class ReplicaFleet:
                 and now - req.submitted_time > req.deadline_s
             ):
                 self._pending.remove(req)
-                req.outcome = "expired"
-                req.finish_time = now
-                self.finished.append(req)
-                if req.trace is not None:
-                    req.trace.close(now, "expired", generated=0,
-                                    preemptions=req.preemptions)
-                if telemetry.enabled():
-                    _metrics.counter(
-                        "paddle_tpu_serving_requests_total",
-                        "request lifecycle events", ("event",),
-                    ).labels(event="expired").inc()
+                self._finish_held(req, now, "expired")
 
     def cancel(self, rid: int) -> bool:
         """Client cancellation, fleet-wide: whichever replica (or the held
@@ -365,17 +410,8 @@ class ReplicaFleet:
         strand a cancel that empties the fleet."""
         for i, req in enumerate(self._pending):
             if req.rid == rid:
-                req.outcome = "cancelled"
-                req.finish_time = self.clock()
-                self.finished.append(self._pending.pop(i))
-                if req.trace is not None:
-                    req.trace.close(req.finish_time, "cancelled", generated=0,
-                                    preemptions=req.preemptions)
-                if telemetry.enabled():
-                    _metrics.counter(
-                        "paddle_tpu_serving_requests_total",
-                        "request lifecycle events", ("event",),
-                    ).labels(event="cancelled").inc()
+                self._pending.pop(i)
+                self._finish_held(req, self.clock(), "cancelled")
                 return True
         for rep in self.replicas:
             if rep.sched.cancel(rid):

@@ -270,6 +270,13 @@ class BlockPool:
     def retained(self) -> int:
         return len(self._retained)
 
+    def occupancy(self) -> float:
+        """Held fraction of the usable pool (used / (num_blocks - 1),
+        page 0 is reserved) — the QoS brownout ladder's pool-pressure
+        signal. Retained prefix pages are reclaimable cache and do not
+        count as pressure."""
+        return self.used() / max(1, self.num_blocks - 1)
+
     def refcount(self, page: int) -> int:
         return self._refs.get(int(page), 0)
 

@@ -48,6 +48,23 @@ Round 17 — prefix sharing + speculative decoding:
   the tokens plain decode would — byte-identical outputs, fewer steps.
   Prompt streaming rides the same program `draft_len + 1` tokens per
   step (chunked prefill at chunk granularity).
+
+Round 19 — overload protection & multi-tenant QoS (inference/qos.py):
+
+- Requests carry `tenant` + `priority` (0 = highest class). With a
+  `qos=QoSPolicy(...)`, submit() gates through per-tenant token buckets
+  and the brownout ladder, dequeue order is strict-priority then
+  deficit-round-robin over token debt, and a blocked high-priority head
+  may PREEMPT a strictly lower-class running request through the same
+  pool-dry preempt-resume machinery (exact-output resume guarantee
+  intact). Overload sheds work EXPLICITLY: `outcome="shed"` with a
+  `retry_after_s` hint and a reason label on the lifecycle counter —
+  bounded waiting line (lowest eligible class loses the slot), queue-wait
+  bound, rate limit, deadline-unmeetable (TTL shorter than the provable
+  minimum service time at the measured EWMA step latency), and brownout
+  step 3. The ladder (spec off -> cap low-priority max_new -> shed lowest
+  class) degrades only in output-exact ways: greedy spec-off is
+  byte-identical, a capped budget is an exact prefix.
 """
 from __future__ import annotations
 
@@ -61,6 +78,7 @@ from .. import telemetry
 from ..telemetry import metrics as _metrics
 from ..telemetry import request_trace as _rt
 from .kv_cache import PoolExhausted, chain_extend, prefix_chain_keys
+from .qos import BROWNOUT_STEPS, QoSPolicy
 
 __all__ = [
     "Request",
@@ -96,9 +114,25 @@ def _tpot_hist():
 def _req_counter():
     return _metrics.counter(
         "paddle_tpu_serving_requests_total",
-        "request lifecycle events",
-        label_names=("event",),
+        "request lifecycle events; `reason` distinguishes shed/reject "
+        "causes (empty on plain lifecycle transitions)",
+        label_names=("event", "reason"),
     )
+
+
+def _brownout_step_gauge():
+    return _metrics.gauge(
+        "paddle_tpu_qos_brownout_step",
+        "current brownout ladder rung (0 = normal, 3 = shedding lowest class)",
+    )
+
+
+def _brownout_transitions(direction: str, to: str):
+    return _metrics.counter(
+        "paddle_tpu_qos_brownout_transitions_total",
+        "brownout ladder transitions by direction and destination rung",
+        label_names=("direction", "to"),
+    ).labels(direction=direction, to=to)
 
 
 def _queue_gauge(state: str):
@@ -153,14 +187,29 @@ class Request:
     # carry the same session so the router sends them to the replica that
     # (may) hold their warm KV pages; None = no affinity
     session: Optional[object] = None
+    # QoS identity: tenant keys the token bucket + fair-share debt;
+    # priority is the preemption/shed class (0 = highest — a P0 may evict
+    # a strictly larger-priority victim's pages, brownout acts on
+    # priorities >= the configured low class)
+    tenant: str = "default"
+    priority: int = 1
 
     # runtime (scheduler-owned)
     generated: List[int] = field(default_factory=list)
     pages: List[int] = field(default_factory=list)
     preemptions: int = 0
-    # terminal disposition: "completed" | "expired" | "cancelled" (None
-    # while in flight); the fleet also reads it for zero-loss accounting
+    # terminal disposition: "completed" | "expired" | "cancelled" |
+    # "shed" (None while in flight); the fleet also reads it for
+    # zero-loss accounting. A shed request carries the retry hint.
     outcome: Optional[str] = None
+    # a shed request carries WHY (one of qos.SHED_REASONS) and when to
+    # retry — the client-facing half of the explicit-backpressure contract
+    shed_reason: Optional[str] = None
+    retry_after_s: Optional[float] = None
+    # brownout step 2 bookkeeping: the pre-cap generation budget (None =
+    # never capped) — recovery tests pin that a capped survivor's output
+    # is an exact prefix of its uncapped greedy chain
+    qos_orig_max_new: Optional[int] = None
     # absolute clock at submit() — arrival_time is a REPLAY-relative offset
     # and must never be differenced against absolute timestamps
     submitted_time: Optional[float] = None
@@ -229,7 +278,8 @@ class ContinuousBatchingScheduler:
                  eos_id: Optional[int] = None,
                  clock: Callable[[], float] = time.monotonic,
                  prefix_cache: bool = True,
-                 spec_decode: Optional[SpecDecodeConfig] = None):
+                 spec_decode: Optional[SpecDecodeConfig] = None,
+                 qos: Optional[QoSPolicy] = None):
         self.engine = engine
         self.max_running = int(max_running or engine.max_batch)
         if self.max_running > engine.max_batch:
@@ -238,10 +288,17 @@ class ContinuousBatchingScheduler:
         self.clock = clock
         self.prefix_cache = bool(prefix_cache)
         self.spec = spec_decode
+        # shared across a fleet's replicas: buckets/debt/ladder are
+        # fleet-wide state, the scheduler only consults it
+        self.qos = qos
         self.waiting: List[Request] = []
         self.running: List[Request] = []
         self.finished: List[Request] = []
         self.preempted_total = 0
+        self.shed_total = 0
+        # measured per-step latency (same 0.8/0.2 blend the fleet router
+        # drains by) — the deadline-shed and retry-after estimates
+        self.ewma_step_s: Optional[float] = None
         # drain mode (fleet hot-swap protocol): admissions stop, in-flight
         # work keeps decoding to completion, submit() still accepts (the
         # caller is expected to route elsewhere; anything queued here just
@@ -266,24 +323,31 @@ class ContinuousBatchingScheduler:
         # would reject a legal request mid-recovery)
         total = req.prompt_len + req.max_new_tokens
         if total > max_ctx:
+            self._count_reject("context_overflow")
             raise ValueError(
-                f"request {req.rid}: prompt {req.prompt_len} + "
-                f"max_new {req.max_new_tokens} exceeds max_seq_len {max_ctx}"
+                f"request {req.rid}: prompt_len {req.prompt_len} + "
+                f"max_new_tokens {req.max_new_tokens} = {total} "
+                f"exceeds max_seq_len {max_ctx}"
             )
         pool = self.engine.pool
         if pool.blocks_for_tokens(total) > pool.num_blocks - 1:
             # would deadlock at its final preemption-resume: even an empty
             # pool could never hold the full context
+            self._count_reject("pool_capacity")
             raise ValueError(
-                f"request {req.rid}: full context {total} tokens needs "
-                f"{pool.blocks_for_tokens(total)} pages; the pool has "
-                f"{pool.num_blocks - 1}"
+                f"request {req.rid}: full context of {total} tokens "
+                f"(prompt_len {req.prompt_len} + max_new_tokens "
+                f"{req.max_new_tokens}) needs {pool.blocks_for_tokens(total)} "
+                f"pages; the pool has {pool.num_blocks - 1} usable "
+                f"(num_blocks {pool.num_blocks} minus the reserved page)"
             )
         # preserved across re-dispatch (like _prompt_len): a request
         # evacuated off a dead replica keeps its ORIGINAL submit clock, so
         # its TTL and client-perceived TTFT never silently restart
         if req.submitted_time is None:
             req.submitted_time = self.clock()
+        if self.qos is not None and self._qos_submit_gate(req):
+            return  # shed: terminal, counted, retryable
         self.waiting.append(req)
         if req.trace is None:
             req.trace = _rt.start(
@@ -298,7 +362,81 @@ class ContinuousBatchingScheduler:
             # (evacuation/preemption) instead runs until re-admission
             req.trace.phase("queue", self.clock(), cause="requeue")
         if telemetry.enabled():
-            _req_counter().labels(event="submitted").inc()
+            _req_counter().labels(event="submitted", reason="").inc()
+            self._sync_gauges()
+
+    @staticmethod
+    def _count_reject(reason: str) -> None:
+        """Validation rejections (the ValueError paths) get the same
+        reason-labeled visibility as sheds — a dashboard must be able to
+        tell WHY requests bounce, not just that they did."""
+        if telemetry.enabled():
+            _req_counter().labels(event="rejected", reason=reason).inc()
+
+    def _qos_submit_gate(self, req: Request) -> bool:
+        """Admission-time QoS gates in cheapest-first order; returns True
+        when the request was shed (terminal — caller must not queue it)."""
+        qos = self.qos
+        now = self.clock()
+        # brownout step 3: new lowest-class work is refused while the
+        # ladder is at the top rung; retry after the recovery cooldown
+        if qos.brownout.sheds(req.priority):
+            self._shed_submit(req, now, "brownout",
+                              retry_after=qos.brownout.cfg.cooldown_s)
+            return True
+        ok, retry = qos.rate_gate(req, now)
+        if not ok:
+            self._shed_submit(req, now, "rate_limit", retry_after=retry)
+            return True
+        emit_bound = (self.spec.draft_len + 1) if self.spec is not None else 1
+        if qos.deadline_unmeetable(req, self.ewma_step_s, emit_bound):
+            # no retry hint: a TTL the engine provably cannot meet will
+            # not be meetable a bucket-refill later either
+            self._shed_submit(req, now, "deadline_unmeetable")
+            return True
+        if qos.queue_full(len(self.waiting)):
+            victim = qos.queue_full_victim(self.waiting, req)
+            retry = (round(self.ewma_step_s * max(1, len(self.waiting)), 6)
+                     if self.ewma_step_s else None)
+            if victim is req:
+                self._shed_submit(req, now, "queue_full", retry_after=retry)
+                return True
+            # the newcomer strictly outranks the lowest queued class:
+            # the victim sheds, the newcomer takes its slot
+            self.waiting.remove(victim)
+            self._shed(victim, now, "queue_full", retry_after=retry)
+        return False
+
+    def _shed_submit(self, req: Request, now: float, reason: str,
+                     retry_after: Optional[float] = None) -> None:
+        """Shed at the submit boundary: the request still counts as
+        submitted (offered load) and gets a trace so the span chain
+        contract holds for EVERY terminal path."""
+        if req.trace is None:
+            req.trace = _rt.start(
+                req.rid, req.submitted_time,
+                prompt_len=req.prompt_len, max_new=req.max_new_tokens,
+            )
+            if req.trace is not None:
+                req.trace.phase("queue", now)
+        if telemetry.enabled():
+            _req_counter().labels(event="submitted", reason="").inc()
+        self._shed(req, now, reason, retry_after=retry_after)
+
+    def _shed(self, req: Request, now: float, reason: str,
+              retry_after: Optional[float] = None) -> None:
+        """Terminal overload rejection: explicit, counted, retryable.
+        Waiting/new requests hold no pages, so _finish's free is a no-op;
+        the request lands in `finished` with outcome="shed" (zero-loss
+        fleet accounting sees it like any other terminal outcome)."""
+        req.outcome = "shed"
+        req.shed_reason = reason
+        req.retry_after_s = retry_after
+        self.shed_total += 1
+        if self.qos is not None:
+            self.qos.note_shed(reason)
+        self._finish(req, now, reason=reason)
+        if telemetry.enabled():
             self._sync_gauges()
 
     def idle(self) -> bool:
@@ -309,7 +447,7 @@ class ContinuousBatchingScheduler:
         _queue_gauge("waiting").set(len(self.waiting))
 
     # ---- lifecycle ----
-    def _finish(self, req: Request, now: float) -> None:
+    def _finish(self, req: Request, now: float, reason: str = "") -> None:
         req.finish_time = now
         req.outcome = req.outcome or "completed"
         # retain=True: a finished request's registered (committed, full)
@@ -319,6 +457,9 @@ class ContinuousBatchingScheduler:
         req.pages = []
         self.finished.append(req)
         if req.trace is not None:
+            extra = {"reason": reason} if reason else {}
+            if req.retry_after_s is not None:
+                extra["retry_after_s"] = req.retry_after_s
             req.trace.close(
                 now, req.outcome,
                 generated=(len(req.prompt) - req.prompt_len) + len(req.generated),
@@ -326,9 +467,10 @@ class ContinuousBatchingScheduler:
                 cached_tokens=req.cached_tokens,
                 drafted=req.drafted,
                 accepted=req.accepted,
+                **extra,
             )
         if telemetry.enabled():
-            _req_counter().labels(event=req.outcome).inc()
+            _req_counter().labels(event=req.outcome, reason=reason).inc()
             tpot = req.tpot()
             if tpot is not None:
                 _tpot_hist().observe(tpot)
@@ -379,15 +521,24 @@ class ContinuousBatchingScheduler:
         req._chain_digest = b""
         return req
 
-    def _preempt_one(self) -> bool:
-        """Evict the request with the least sunk work (still-streaming
-        first, then youngest) back to the front of the waiting queue,
-        recompute-on-resume."""
-        if not self.running:
+    def _preempt_one(self, cause: str = "pool_dry",
+                     below_priority: Optional[int] = None) -> bool:
+        """Evict the lowest-class request with the least sunk work
+        (priority descending, then still-streaming first, then youngest)
+        back to the front of the waiting queue, recompute-on-resume.
+        `below_priority` restricts victims to strictly lower classes —
+        the QoS priority-preemption path; equal-priority traffic (the
+        default) keeps the original pool-dry victim order exactly."""
+        candidates = (
+            [r for r in self.running if r.priority > below_priority]
+            if below_priority is not None else self.running
+        )
+        if not candidates:
             return False
         victim = max(
-            self.running,
-            key=lambda r: (r.first_token_time is None, r.first_token_time or 0.0, r.rid),
+            candidates,
+            key=lambda r: (r.priority, r.first_token_time is None,
+                           r.first_token_time or 0.0, r.rid),
         )
         self.running.remove(victim)
         # retain=False: an evicted context is conceptually discarded — its
@@ -402,9 +553,12 @@ class ContinuousBatchingScheduler:
         self.waiting.insert(0, victim)
         if victim.trace is not None:
             # the preempt span runs until re-admission (recompute resumes)
-            victim.trace.phase("preempt", self.clock(), cause="pool_dry")
+            victim.trace.phase("preempt", self.clock(), cause=cause)
         if telemetry.enabled():
-            _req_counter().labels(event="preempted").inc()
+            _req_counter().labels(
+                event="preempted",
+                reason="" if cause == "pool_dry" else cause,
+            ).inc()
         return True
 
     def evacuate(self) -> List[Request]:
@@ -492,7 +646,11 @@ class ContinuousBatchingScheduler:
         """
         if self.draining or not self.waiting or len(self.running) >= self.max_running:
             return None
-        req = self.waiting[0]
+        # QoS dequeue order: strict priority, then deficit-round-robin
+        # over token debt (single-tenant equal-priority traffic selects
+        # index 0 — the pre-QoS FIFO, preemption-requeue order included)
+        idx = self.qos.select(self.waiting) if self.qos is not None else 0
+        req = self.waiting[idx]
         pool = self.engine.pool
         shared: List[int] = []
         if self.prefix_cache and req.cursor == 0:
@@ -503,14 +661,15 @@ class ContinuousBatchingScheduler:
         if not self.running and not shared:
             need = pool.blocks_for_tokens(len(req.prompt) + 1)
             if need <= pool.available():
-                self.waiting.pop(0)
+                self.waiting.pop(idx)
+                self._qos_on_admit(req)
                 req.pages = pool.alloc(need, owner=req.rid)
                 if req.trace is not None:
                     self._trace_admit(req, mode="bucketed")
                 logits = self.engine.prefill(req.prompt, req.pages)
                 req.cursor = len(req.prompt)
                 if telemetry.enabled():
-                    _req_counter().labels(event="admitted").inc()
+                    _req_counter().labels(event="admitted", reason="").inc()
                 self._emit_token(req, logits, self.clock())
                 if not req.done:
                     self.running.append(req)
@@ -525,7 +684,8 @@ class ContinuousBatchingScheduler:
                 # back (retained, still indexed) so nothing leaks
                 pool.free(shared, owner=req.rid, retain=True)
             return None
-        self.waiting.pop(0)
+        self.waiting.pop(idx)
+        self._qos_on_admit(req)
         cached = len(shared) * pool.block_size
         req.pages = list(shared) + pool.alloc(1, owner=req.rid)
         req.cursor = cached
@@ -538,7 +698,7 @@ class ContinuousBatchingScheduler:
         if req.trace is not None:
             self._trace_admit(req, mode="streamed", cached=cached)
         if telemetry.enabled():
-            _req_counter().labels(event="admitted").inc()
+            _req_counter().labels(event="admitted", reason="").inc()
         return 0
 
     def _trace_admit(self, req: Request, mode: str, cached: int = 0) -> None:
@@ -552,6 +712,41 @@ class ContinuousBatchingScheduler:
             recompute_tokens=len(req.prompt) - req.prompt_len,
             cached_tokens=cached,
         )
+
+    def _qos_on_admit(self, req: Request) -> None:
+        """Dequeue accounting + brownout step-2 budget cap. The cap is an
+        exact PREFIX of the uncapped greedy chain (greedy decode is
+        deterministic), and recovery keeps the original budget in
+        `qos_orig_max_new` so tests can pin prefix-exactness."""
+        if self.qos is None:
+            return
+        self.qos.charge(req)
+        cap = self.qos.brownout.max_new_cap(req.priority)
+        if cap is not None and req.max_new_tokens > cap:
+            # never cap below what a resume has already folded/generated
+            # (+1 so the request still terminates on its next token)
+            already = (len(req.prompt) - req.prompt_len) + len(req.generated)
+            budget = max(cap, already + 1)
+            if budget < req.max_new_tokens:
+                if req.qos_orig_max_new is None:
+                    req.qos_orig_max_new = req.max_new_tokens
+                req.max_new_tokens = budget
+                if req.trace is not None:
+                    req.trace.event("qos_max_new_capped", self.clock(),
+                                    cap=budget, orig=req.qos_orig_max_new)
+
+    def _qos_priority_preempt(self) -> bool:
+        """A blocked high-priority head may evict ONE strictly
+        lower-class running request through the pool-dry preempt-resume
+        machinery (the victim resumes later with the exact-output
+        guarantee). Returns True when a victim was evicted — the caller
+        retries admission."""
+        if (self.qos is None or self.draining or not self.waiting
+                or not self.running):
+            return False
+        head = self.waiting[self.qos.select(self.waiting)]
+        return self._preempt_one(cause="priority",
+                                 below_priority=head.priority)
 
     # ---- prefix-index registration ----
     def _kv_committed(self, req: Request) -> int:
@@ -679,17 +874,73 @@ class ContinuousBatchingScheduler:
         return produced
 
     def step(self) -> int:
-        """One scheduler tick; returns the number of tokens produced."""
+        """One scheduler tick; returns the number of tokens produced.
+
+        With QoS: sweep the queue-wait bound, feed measured pressure into
+        the brownout ladder (transitions counted + trace-annotated), gate
+        speculative decoding off at rung >= 1 (greedy verify is
+        byte-identical, so this degrades only step count), and blend this
+        tick's wall into `ewma_step_s` — the drain estimate the
+        deadline/retry-after hints run on."""
+        t_start = self.clock()
+        if self.qos is not None:
+            self._qos_pre_step(t_start)
+        spec_saved = self.spec
+        if (self.spec is not None and self.qos is not None
+                and not self.qos.brownout.spec_allowed()):
+            self.spec = None
+        try:
+            produced = self._step_inner()
+        finally:
+            self.spec = spec_saved
+        dt = self.clock() - t_start
+        if dt > 0.0:
+            self.ewma_step_s = (dt if self.ewma_step_s is None
+                                else 0.8 * self.ewma_step_s + 0.2 * dt)
+        return produced
+
+    def _qos_pre_step(self, now: float) -> None:
+        qos = self.qos
+        bound = qos.config.max_queue_wait_s
+        if bound is not None:
+            for req in list(self.waiting):
+                if (req.submitted_time is not None
+                        and now - req.submitted_time > bound):
+                    self.waiting.remove(req)
+                    self._shed(req, now, "queue_wait")
+        pool = self.engine.pool
+        pool_frac = pool.occupancy()
+        if qos.config.max_waiting:
+            queue_frac = len(self.waiting) / qos.config.max_waiting
+        else:
+            # unbounded line: scale depth against a few batches' worth so
+            # sustained backlog still reads as pressure
+            queue_frac = len(self.waiting) / float(4 * self.max_running)
+        for direction, to_step in qos.update_pressure(now, pool_frac, queue_frac):
+            if telemetry.enabled():
+                _brownout_step_gauge().set(to_step)
+                _brownout_transitions(direction, BROWNOUT_STEPS[to_step]).inc()
+            _rt.record_event(
+                "qos", "brownout", now, direction=direction, step=to_step,
+                rung=BROWNOUT_STEPS[to_step],
+                pressure=round(qos.last_pressure, 4),
+            )
+
+    def _step_inner(self) -> int:
         produced = 0
         # TTL sweep first: an expired request must not consume an admission
         # slot or grow pages this very tick
         self._expire_due(self.clock())
-        # admission: fill free decode slots from the waiting line
+        # admission: fill free decode slots from the waiting line; a
+        # blocked high-priority head may preempt a strictly lower-class
+        # running victim (its pages free, admission retries)
         while True:
             emitted = self._try_admit()
-            if emitted is None:
+            if emitted is not None:
+                produced += emitted
+                continue
+            if not self._qos_priority_preempt():
                 break
-            produced += emitted
 
         if not self.running:
             if telemetry.enabled():

@@ -130,7 +130,7 @@ def sampled(rid: int) -> bool:
 # span/phase names (the breakdown components)
 PHASES = ("queue", "prefill", "decode", "preempt")
 # global lanes (non-request-keyed events ride the same ring)
-LANES = ("request", "engine", "kv_pool", "fleet")
+LANES = ("request", "engine", "kv_pool", "fleet", "qos")
 
 
 class RequestTraceRecorder:
@@ -328,7 +328,7 @@ def record_span(lane: str, name: str, t0: float, t1: float,
 # interleaves with the request/engine lanes in a merged trace.
 REQUEST_PID_BASE = 100000
 _GLOBAL_LANE_PIDS = {"engine": 90001, "kv_pool": 90002, "fleet": 90003,
-                     "compile": 90004}
+                     "compile": 90004, "qos": 90005}
 
 
 def to_chrome_trace(rec: Optional[RequestTraceRecorder] = None) -> dict:

@@ -15,7 +15,7 @@ import sys
 
 BENCH = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "bench.py")
 CONFIGS = {"seq128", "passes", "seq4096", "llama3_shape", "resnet50",
-           "ppocr_e2e", "serving", "fleet", "input_stream",
+           "ppocr_e2e", "serving", "fleet", "qos", "input_stream",
            "moe_longcontext"}
 
 
@@ -247,3 +247,38 @@ def test_deadline_skip_reason_survives_env_skips():
     assert cfg["seq4096"] == "skipped:env"
     assert cfg["llama3_shape"] == "skipped:env"
     assert cfg["seq128"] == "skipped:deadline"
+
+
+def test_qos_child_overload_replay_record():
+    """Round-19 acceptance at tier-1 scale: the QoS child runs the
+    >= 2x-capacity mixed-tenant burst for real and the record carries the
+    gated fields (fairness_index, p99_tpot_gold_ms, gold_p99_vs_uncontended,
+    qos_dims) plus the zero-loss/shed accounting the gate reads."""
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu", BENCH_CHILD="qos",
+        BENCH_QOS_VOCAB="512", BENCH_QOS_HIDDEN="64", BENCH_QOS_FFN="128",
+        BENCH_QOS_HEADS="4", BENCH_QOS_KV_HEADS="2", BENCH_QOS_MAX_SEQ="64",
+        BENCH_QOS_REQUESTS="24", BENCH_QOS_SUBMIT_PROBE="300",
+        PADDLE_TPU_TELEMETRY="1",
+    )
+    r = subprocess.run(
+        [sys.executable, BENCH], env=env, capture_output=True, text=True,
+        timeout=220,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    res = json.loads(r.stdout.strip().splitlines()[-1])
+    assert res["qos_dims"]["hidden"] == 64          # shrink is recorded
+    assert res["overload_factor"] >= 2.0            # the acceptance floor
+    # zero-loss: every offered request is terminal exactly once
+    assert res["completed"] + res["shed"] == res["n_requests"]
+    assert res["shed"] == sum(res["sheds_by_reason"].values())
+    # gated fields present and sane
+    assert res["fairness_index"] is None or 0.0 < res["fairness_index"] <= 1.0
+    assert res["p99_tpot_gold_ms"] is None or res["p99_tpot_gold_ms"] > 0
+    assert "gold_p99_vs_uncontended" in res
+    assert set(res["per_tenant_p99_tpot_ms"]) >= {"gold", "bronze"}
+    # the round-19 BASELINE number: per-submit QoS overhead is measured
+    assert isinstance(res["submit_overhead_us"], float)
+    attr = res["attribution"]
+    assert attr.get("flops") or attr.get("attribution") == "unavailable"

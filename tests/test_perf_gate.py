@@ -757,3 +757,99 @@ def test_coldstart_dims_change_not_compared(tmp_path):
     rc, out, err = _run(a, b)
     assert rc == 0, (out, err)
     assert "workload changed" in out and "coldstart_dims" in out
+
+
+# ---------------------------------------------------------------------------
+# round 19: QoS overload-replay gates (fairness + protected-class p99)
+# ---------------------------------------------------------------------------
+
+def _with_qos(fairness=0.94, gold_p99=4.0, ratio=1.2, free_rate=300.0,
+              flops=2.0e11):
+    """Capture carrying the round-19 qos config (the field shape
+    bench.py's _build_qos emits)."""
+    c = _capture()
+    c["detail"]["configs"]["qos"] = "measured"
+    c["detail"]["qos"] = {
+        "n_requests": 40,
+        "overload_factor": 10.0,
+        "tokens_per_sec": 900.0,
+        "p99_ttft_ms": 40.0,
+        "p99_tpot_ms": 6.0,
+        "p99_tpot_gold_ms": gold_p99,
+        "p99_tpot_uncontended_ms": round(gold_p99 / ratio, 3),
+        "gold_p99_vs_uncontended": ratio,
+        "per_tenant_p99_tpot_ms": {"gold": gold_p99, "bronze": 8.0},
+        "fairness_index": fairness,
+        "completed": 36, "shed": 4, "shed_rate": 0.1,
+        "sheds_by_reason": {"rate_limit": 3, "brownout": 1},
+        "brownout_transitions": 4, "brownout_final_step": 0,
+        "qos_dims": {"hidden": 256, "max_batch": 4, "max_new": 8,
+                     "free_rate": free_rate, "enter_pressure": 0.9},
+        "attribution": {"flops": flops, "hbm_bytes": 4.0e9,
+                        "program_memory_bytes": 1.0e9},
+    }
+    return c
+
+
+def test_qos_fairness_drop_fails(tmp_path):
+    # Jain fairness is larger-is-better: weighted-fair dequeue delivering
+    # 0.6 instead of 0.94 on the same qos_dims is a DRR regression
+    a = _write(tmp_path, "a.json", _with_qos(fairness=0.94))
+    b = _write(tmp_path, "b.json", _with_qos(fairness=0.6))
+    rc, out, err = _run(a, b)
+    assert rc == 1, (out, err)
+    assert "fairness_index" in out and "throughput regression" in out
+
+
+def test_qos_fairness_rise_passes(tmp_path):
+    # the opposite polarity: MORE fairness is progress, never a failure
+    a = _write(tmp_path, "a.json", _with_qos(fairness=0.8))
+    b = _write(tmp_path, "b.json", _with_qos(fairness=0.97))
+    rc, out, err = _run(a, b)
+    assert rc == 0, (out, err)
+
+
+def test_qos_gold_p99_regression_fails(tmp_path):
+    # the protected class's p99 TPOT is a TIME_FIELD: +35% unexplained on
+    # the same qos_dims means priority admission stopped shielding it
+    a = _write(tmp_path, "a.json", _with_qos(gold_p99=4.0))
+    b = _write(tmp_path, "b.json", _with_qos(gold_p99=5.4))
+    rc, out, err = _run(a, b)
+    assert rc == 1, (out, err)
+    assert "p99_tpot_gold_ms" in out and "UNEXPLAINED" in out
+
+
+def test_qos_gold_p99_improvement_passes(tmp_path):
+    # time polarity inverted: a faster protected class passes
+    a = _write(tmp_path, "a.json", _with_qos(gold_p99=5.4, ratio=1.5))
+    b = _write(tmp_path, "b.json", _with_qos(gold_p99=4.0, ratio=1.1))
+    rc, out, err = _run(a, b)
+    assert rc == 0, (out, err)
+
+
+def test_qos_contention_ratio_regression_fails(tmp_path):
+    # gold p99 over the uncontended baseline growing past tol is the same
+    # shielding regression even when absolute numbers drift together
+    a = _write(tmp_path, "a.json", _with_qos(ratio=1.2))
+    b = _write(tmp_path, "b.json", _with_qos(ratio=1.8))
+    rc, out, err = _run(a, b)
+    assert rc == 1, (out, err)
+    assert "gold_p99_vs_uncontended" in out and "UNEXPLAINED" in out
+
+
+def test_qos_dims_change_not_compared(tmp_path):
+    # a different tenant mix / rate limit is a different overload problem
+    a = _write(tmp_path, "a.json", _with_qos(fairness=0.94, free_rate=300.0))
+    b = _write(tmp_path, "b.json", _with_qos(fairness=0.5, free_rate=50.0))
+    rc, out, err = _run(a, b)
+    assert rc == 0, (out, err)
+    assert "workload changed" in out and "qos_dims" in out
+
+
+def test_qos_explained_by_attributed_work(tmp_path):
+    # gold p99 +35% alongside +40% attributed FLOPs: a bigger model per
+    # token, not a QoS regression
+    a = _write(tmp_path, "a.json", _with_qos(gold_p99=4.0, flops=2.0e11))
+    b = _write(tmp_path, "b.json", _with_qos(gold_p99=5.4, flops=2.8e11))
+    rc, out, err = _run(a, b)
+    assert rc == 0, (out, err)

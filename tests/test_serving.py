@@ -359,7 +359,7 @@ def test_scheduler_preemption_on_pool_exhaustion(tiny_model):
         assert produced == _greedy_oracle(tiny_model, p, 12), rid
     assert eng.pool.used() == 0
     cnt = tm.default_registry().get("paddle_tpu_serving_requests_total")
-    assert cnt.labels(event="preempted").value >= 1
+    assert cnt.labels(event="preempted", reason="").value >= 1
 
 
 def test_generate_returns_full_output_across_preemption(tiny_model):
@@ -464,9 +464,12 @@ def test_request_ttl_expires_and_frees_pages(tiny_model, shared_engine):
 
     eng = shared_engine
     eng.pool.reset()
-    cnt = tm.counter("paddle_tpu_serving_requests_total",
-                     "request lifecycle events", ("event",))
-    expired_before = cnt.labels(event="expired").value
+    cnt = tm.counter(
+        "paddle_tpu_serving_requests_total",
+        "request lifecycle events; `reason` distinguishes shed/reject causes "
+        "(empty on plain lifecycle transitions)",
+        ("event", "reason"))
+    expired_before = cnt.labels(event="expired", reason="").value
     t = [0.0]
     sched = ContinuousBatchingScheduler(eng, clock=lambda: t[0])
     r0 = Request(rid=0, prompt=[1, 2, 3, 4], max_new_tokens=20, deadline_s=0.5)
@@ -479,7 +482,7 @@ def test_request_ttl_expires_and_frees_pages(tiny_model, shared_engine):
     sched.step()
     assert r0.outcome == "expired" and r0.done and r0.pages == []
     assert r0 in sched.finished
-    assert cnt.labels(event="expired").value == expired_before + 1
+    assert cnt.labels(event="expired", reason="").value == expired_before + 1
     while not sched.idle():
         sched.step()
     assert r1.outcome == "completed" and len(r1.generated) == 3
@@ -491,9 +494,12 @@ def test_request_cancellation_frees_pages(tiny_model, shared_engine):
 
     eng = shared_engine
     eng.pool.reset()
-    cnt = tm.counter("paddle_tpu_serving_requests_total",
-                     "request lifecycle events", ("event",))
-    cancelled_before = cnt.labels(event="cancelled").value
+    cnt = tm.counter(
+        "paddle_tpu_serving_requests_total",
+        "request lifecycle events; `reason` distinguishes shed/reject causes "
+        "(empty on plain lifecycle transitions)",
+        ("event", "reason"))
+    cancelled_before = cnt.labels(event="cancelled", reason="").value
     sched = ContinuousBatchingScheduler(eng)
     r0 = Request(rid=0, prompt=[1, 2, 3, 4, 5], max_new_tokens=30)
     r1 = Request(rid=1, prompt=[6, 7, 8], max_new_tokens=3)
@@ -504,7 +510,7 @@ def test_request_cancellation_frees_pages(tiny_model, shared_engine):
     assert r0.outcome == "cancelled" and r0.done and r0.pages == []
     assert sched.cancel(0) is False  # already gone
     assert sched.cancel(99) is False  # never submitted
-    assert cnt.labels(event="cancelled").value == cancelled_before + 1
+    assert cnt.labels(event="cancelled", reason="").value == cancelled_before + 1
     while not sched.idle():
         sched.step()
     assert r1.outcome == "completed"

@@ -68,7 +68,7 @@ from typing import Optional
 # config keys inside `detail` holding per-config stat dicts, plus the
 # headline whose stats live directly in `detail`
 NESTED_CONFIGS = ("seq4096", "llama3_shape", "resnet50", "ppocr_e2e", "serving",
-                  "fleet", "input_stream", "moe_longcontext", "passes")
+                  "fleet", "input_stream", "moe_longcontext", "passes", "qos")
 # fields whose change means "different workload" (never a regression)
 SHAPE_FIELDS = (
     "batch", "seq", "heads", "layers", "rung", "micro", "n_images",
@@ -91,6 +91,9 @@ SHAPE_FIELDS = (
     # round 18: the cold-start sub-run's engine dims + bucket ladder — a
     # different bucket family compiles a different number of executables
     "coldstart_dims",
+    # round 19: the QoS overload replay's tenant mix / rate limits /
+    # brownout thresholds — different pressure, different sheds
+    "qos_dims",
 )
 # larger-is-worse regression metrics per config record; the names match
 # what bench.py actually emits per config (ernie/llama/resnet report
@@ -107,6 +110,11 @@ TIME_FIELDS = (
     # cache: pays XLA) and warm (restore-only relaunch). Warm growing back
     # toward cold means the compile cache quietly stopped restoring
     "cold_start_ttft_ms", "warm_start_ttft_ms",
+    # round 19: the protected (priority-0) tenant's p99 TPOT under the
+    # QoS overload replay, and its ratio over the uncontended baseline —
+    # either growing past tol with flat qos_dims means priority
+    # admission/preemption stopped shielding the top class
+    "p99_tpot_gold_ms", "gold_p99_vs_uncontended",
 )
 # larger-is-BETTER metrics: a drop beyond tolerance with flat attributed
 # work is the same unexplained-regression signal inverted (serving
@@ -129,7 +137,12 @@ THROUGHPUT_FIELDS = ("tokens_per_sec", "samples_per_sec",
                      # without paying XLA (hit|shared|restore) on the warm
                      # relaunch — falling with flat coldstart_dims means the
                      # persistent store stopped matching its own entries
-                     "cache_hit_rate")
+                     "cache_hit_rate",
+                     # round 19: Jain fairness over weight-normalized
+                     # per-tenant service in the QoS overload replay —
+                     # falling with flat qos_dims means weighted-fair
+                     # dequeue stopped holding under pressure
+                     "fairness_index")
 ATTR_WORK_FIELDS = ("flops", "hbm_bytes")
 ATTR_MEM_FIELDS = ("program_memory_bytes", "peak_hbm_bytes")
 # round 16: breakdown-sum-vs-measured-wall tolerance (matches the 5%
