@@ -159,7 +159,8 @@ _EST_S = {
     "fleet": 240,
     "qos": 180,
     "resnet": 180,
-    "moe_longcontext": 240,
+    # round 20: compiled by default + warm-restore probe + fusion capture
+    "moe_longcontext": 300,
     "ernie4096": 240,
     "llama": 300,
 }
@@ -1544,31 +1545,47 @@ def _build_moe_longcontext():
     ring attention over the sep axis (the seq >= 16k path), and MoE
     expert-parallel routing with a REAL capacity factor (1.2 train) whose
     token drops land in the guardian telemetry counters
-    (`paddle_tpu_moe_{routed,dropped}_tokens_total`). Runs EAGER: the drop
-    counters need concrete values each step (a traced count is a tracer),
-    so the capture records an explicit attribution-unavailable marker."""
-    import jax
+    (`paddle_tpu_moe_{routed,dropped}_tokens_total`).
+
+    COMPILED by default (round 20): routing is fully jittable and the step
+    RETURNS each layer's drop count as an on-device scalar read once at the
+    step boundary — no host branch inside the trace — so the whole stack
+    runs through to_static over the sep×ep mesh (fleet hybrid topology ->
+    SpecLayout build_mesh; ep rides the dp axis, sep is the ring axis) and
+    the record carries real attribution like the dense configs.
+    BENCH_MOE_EAGER=1 is the escape hatch back to the eager step. The
+    compile routes through the round-18 persistent cache (cold vs warm wall
+    recorded) and the static-capture fusion probe records the `fuse_moe`
+    dispatch->expert->combine match count perf_gate gates."""
+    import tempfile
+
     import numpy as np
-    from jax.sharding import Mesh
 
     import paddle_tpu as paddle
     from paddle_tpu import nn
-    from paddle_tpu.core.apply import apply as _apply
+    from paddle_tpu import compile_cache as _cc
     from paddle_tpu.distributed import fleet
     from paddle_tpu.incubate.distributed.models.moe import ExpertLayer, MoELayer
-    from paddle_tpu.ops.ring_attention import ring_attention
+    from paddle_tpu.ops.ring_attention import ring_attention_op
 
     d = _moe_dims()
     hd = d["d_model"] // d["heads"]
     B, S = 1, d["seq"]
+    eager = os.environ.get("BENCH_MOE_EAGER", "") == "1"
+    sep = int(os.environ.get("BENCH_MOE_SEP", "1"))
+    ep = int(os.environ.get("BENCH_MOE_EP", "1"))
 
-    # ep routing needs a hybrid topology; on one chip the dp axis is width 1
-    # (the dispatch/combine einsums and capacity math are identical, the
-    # all-to-all is a no-op) — dryrun_multichip covers the 8-way EP path
+    # the sep×ep mesh, built from SpecLayout roles: fleet.init routes the
+    # hybrid dims through spec_layout.build_mesh and registers the result
+    # as THE global mesh. ep rides the data axis (the reference's
+    # moe_group == dp convention); on one chip both degrees are 1 (the
+    # dispatch/combine einsums, ring layout, and capacity math are
+    # identical, the collectives are no-ops) — dryrun_multichip runs the
+    # real sep×ep decomposition on 8 devices
     strategy = fleet.DistributedStrategy()
-    strategy.hybrid_configs = {"dp_degree": 1}
+    strategy.hybrid_configs = {"dp_degree": ep, "sep_degree": sep}
     fleet.init(is_collective=True, strategy=strategy)
-    sep_mesh = Mesh(np.array(jax.devices()[:1]), ("sep",))
+    mesh = fleet.get_hybrid_communicate_group().mesh
 
     paddle.seed(0)
     q_proj = nn.Linear(d["d_model"], d["heads"] * hd)
@@ -1606,51 +1623,136 @@ def _build_moe_longcontext():
         a = nn.functional.scaled_dot_product_attention(q, k, v, is_causal=True)
         h = h + out_proj(a.reshape([B, S, d["heads"] * hd]))
         h = h + moe0(h)
-        # block 1: exact ring attention with the sequence sharded over sep
-        # (the seq >= 16k long-context path; on one chip the ring is width 1
-        # but the kernel, layout, and chunked online-softmax are the real
-        # ones — dryrun_multichip runs the 8-device ring)
+        # block 1: exact ring attention with the sequence sharded over the
+        # sep axis of the SAME sep×ep mesh (the seq >= 16k long-context
+        # path), recorded as one fixed-arity op (ring_attention_op)
         qkv = ring_qkv(h).reshape([B, S, 3 * d["heads"], hd])
         rq = qkv[:, :, : d["heads"]]
         rk = qkv[:, :, d["heads"]: 2 * d["heads"]]
         rv = qkv[:, :, 2 * d["heads"]:]
-        r = _apply(
-            "ring_attention",
-            lambda a_, b_, c_: ring_attention(
-                a_, b_, c_, mesh=sep_mesh, causal=True
-            ),
-            rq, rk, rv,
-        )
+        r = ring_attention_op(rq, rk, rv, mesh=mesh, causal=True)
         h = h + ring_out(r.reshape([B, S, d["heads"] * hd]))
         h = h + moe1(h)
         return h
 
-    def train_step():
-        out = forward(x)
+    def moe_longcontext_step(xb):
+        out = forward(xb)
         loss = (out * out).mean() + 0.01 * (moe0.l_aux + moe1.l_aux)
         loss.backward()
         opt.step()
         opt.clear_grad()
-        return loss
+        # the post-step scalar-read contract: the per-layer drop counts
+        # leave the (traced) step as program OUTPUTS; the host performs
+        # ONE blocking read per layer at the step boundary
+        # (record_drop_telemetry(dropped=...)), never inside the trace
+        return loss, moe0.last_drop_count(), moe1.last_drop_count()
 
-    def run(n):
-        t0 = time.perf_counter()
-        for _ in range(n):
-            loss = train_step()
-        val = float(loss.numpy())
-        return time.perf_counter() - t0, val
+    # compile through the round-18 persistent cache so the (expensive)
+    # long-context compile is a one-time cost: BENCH_MOE_CACHE_DIR shares a
+    # store across runs; the default ephemeral dir makes cold REALLY cold
+    prev_store = _cc.active_store()
+    cache_dir = os.environ.get("BENCH_MOE_CACHE_DIR") or tempfile.mkdtemp(
+        prefix="bench_moe_cc_"
+    )
+    try:
+        if not eager:
+            _cc.configure(cache_dir)
+        step = (moe_longcontext_step if eager
+                else paddle.jit.to_static(moe_longcontext_step))
+        state = {}
 
-    dt_step, final_loss = _slope_measure(run, d["steps"], warm=2)
+        def run(n):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                loss, d0, d1 = step(x)
+            state["drops"] = (d0, d1)
+            val = float(loss.numpy())
+            return time.perf_counter() - t0, val
 
-    # capacity-drop counters: harvest the LAST (eager) forward's concrete
-    # counts into the guardian telemetry + the capture record
-    drops = {
-        name: m.record_drop_telemetry(name=name)
-        for name, m in (("moe0", moe0), ("moe1", moe1))
-    }
-    routed = sum(s["routed"] for s in drops.values() if s)
-    dropped = sum(s["dropped"] for s in drops.values() if s)
-    return {
+        dt_step, final_loss = _slope_measure(run, d["steps"], warm=2)
+        if dt_step <= 0:
+            # slope noise at CI-shrunk dims (one-step deltas): fall back to
+            # a plain per-step average so the roofline (mfu/hbm_util) and
+            # tokens_per_sec stay well-defined
+            n_avg = max(2, d["steps"])
+            t_avg, final_loss = run(n_avg)
+            dt_step = t_avg / n_avg
+        attribution = (_attribution(dt_step) if not eager else {
+            "attribution": "unavailable",
+            "why": "BENCH_MOE_EAGER=1 escape hatch (uncompiled eager step; "
+                   "no compiled-program cost record to attribute)",
+        })
+
+        # capacity-drop counters: ONE blocking read per layer of the LAST
+        # step's returned device scalars, into the guardian telemetry +
+        # the capture record (eager steps return concrete values — the
+        # same read path)
+        drops = {
+            name: m.record_drop_telemetry(name=name, dropped=dv)
+            for (name, m), dv in zip(
+                (("moe0", moe0), ("moe1", moe1)), state["drops"]
+            )
+        }
+        routed = sum(s["routed"] for s in drops.values() if s)
+        dropped = sum(s["dropped"] for s in drops.values() if s)
+
+        # cold vs warm compile wall through the persistent store: drop the
+        # in-process shared entries, re-stage the same step, and let the
+        # fingerprint restore from disk (serialization permitting) — the
+        # warm path a relaunch would pay
+        compile_cache = {"cache_dir_ephemeral": "BENCH_MOE_CACHE_DIR" not in os.environ}
+        if not eager:
+            fname = "moe_longcontext_step"
+            cold = [e for e in _cc.events(origin="to_static")
+                    if e["name"] == fname and e["outcome"] in ("miss", "restore")]
+            if cold:
+                compile_cache["cold"] = {
+                    "outcome": cold[0]["outcome"],
+                    "compile_s": round(cold[0]["seconds"], 3),
+                }
+            serial0 = cold[-1]["serial"] if cold else 0
+            _cc.clear_shared()
+            warm_step = paddle.jit.to_static(moe_longcontext_step)
+            t0 = time.perf_counter()
+            warm_step(x)  # call 1: the eager recording pass (no compile yet)
+            warm_step(x)  # call 2: trace + fingerprint -> disk restore
+            warm_wall = time.perf_counter() - t0
+            warm = [e for e in _cc.events(origin="to_static",
+                                          since_serial=serial0)
+                    if e["name"] == fname and e["outcome"] in ("miss", "restore")]
+            compile_cache["warm"] = {
+                "outcome": warm[-1]["outcome"] if warm else None,
+                "compile_s": round(warm[-1]["seconds"], 3) if warm else None,
+                "wall_s": round(warm_wall, 3),
+            }
+            compile_cache["serialization_available"] = _cc.serialization_available()
+    finally:
+        if not eager:
+            _cc.configure(prev_store.root if prev_store is not None else None)
+
+    # fusion-coverage probe: the SAME forward, eager-converted to a static
+    # Program and run through the default pass pipeline — `fuse_moe` must
+    # collapse both layers' dispatch->expert->combine chains (match count
+    # perf-gated like the `passes` config)
+    fusion = {}
+    try:
+        from paddle_tpu.jit import capture_program
+        from paddle_tpu.static import passes as passes_mod
+
+        program, feed_names, fetch_list = capture_program(
+            forward, x, feed_names=["h"]
+        )
+        fetch_vid = program.resolve_fetch(fetch_list[0])
+        _work, pres = passes_mod.run_default_pipeline(
+            program, fetch_vars=[fetch_vid], feed_names=feed_names
+        )
+        fusion = {"matches": pres.matches, "rewritten_ops": pres.rewritten_ops}
+    except Exception as e:  # noqa: BLE001 — the probe must never kill the config
+        fusion = {"error": str(e)[-200:]}
+
+    from paddle_tpu.distributed.sharding import spec_layout as _slx
+
+    res = {
         "batch": B,
         "seq": S,
         "heads": f"{d['heads']}q/{d['kv_heads']}kv",
@@ -1658,7 +1760,10 @@ def _build_moe_longcontext():
         "top_k": d["top_k"],
         "capacity_factor": d["capacity"],
         "moe_dims": {k: d[k] for k in ("d_model", "ffn")},
+        "sep_ep_dims": {"sep": sep, "ep": ep,
+                        "mesh_axes": _slx.mesh_degrees(mesh)},
         "steps": d["steps"],
+        "compiled": not eager,
         "ms_per_step": round(dt_step * 1000, 2),
         "tokens_per_sec": round(B * S / dt_step, 1),
         "final_loss": final_loss,
@@ -1668,18 +1773,22 @@ def _build_moe_longcontext():
             "drop_fraction": round(dropped / routed, 4) if routed else None,
             "per_layer": drops,
         },
+        "compile_cache": compile_cache,
         "note": (
             "GQA flash attention + exact ring attention (sep axis) + "
-            "GShard-capacity MoE EP routing in one eager block; drop "
-            "counters land in paddle_tpu_moe_*_tokens_total (guardian "
-            "telemetry); eager because traced drop counts are tracers"
+            "GShard-capacity MoE EP routing in one to_static step over the "
+            "sep×ep mesh; per-layer drop counts return as on-device scalars "
+            "read once post-step into paddle_tpu_moe_*_tokens_total "
+            "(guardian telemetry); BENCH_MOE_EAGER=1 for the eager baseline"
         ),
-        "attribution": {
-            "attribution": "unavailable",
-            "why": "eager config (concrete per-step capacity-drop counters); "
-                   "no compiled-program cost record to attribute",
-        },
+        "attribution": attribution,
     }
+    if fusion.get("matches") is not None:
+        res["matches"] = fusion["matches"]
+        res["rewritten_ops"] = fusion["rewritten_ops"]
+    elif fusion:
+        res["fusion_probe_error"] = fusion.get("error")
+    return res
 
 
 def _release_device_memory():

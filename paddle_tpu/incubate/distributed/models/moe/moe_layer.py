@@ -18,9 +18,11 @@ TPU-native design (GShard recipe, not a port):
   axis of the hybrid topology, matching the reference's moe_group ==
   data-parallel group convention). No per-rank expert lists: the layer owns
   all experts globally; the mesh decides locality.
-- The fused fast path (all experts are ExpertLayer) runs dispatch + both
-  expert matmuls + combine in one traced op: two batched einsums over
-  [E, C, ...] keep the MXU busy and let XLA overlap the a2a with compute.
+- The fast path (all experts are ExpertLayer) records a fixed-arity
+  routing -> dispatch -> expert-FFN -> combine op chain: the two batched
+  einsums over [E, C, ...] keep the MXU busy and let XLA overlap the a2a
+  with compute, and the static pass pipeline's `fuse_moe` pattern collapses
+  the dispatch->expert->combine tail into one op (see _fused_forward).
 - Arbitrary expert Layers fall back to a per-expert loop over the
   dispatched [E, C, M] buffer (still static shapes, still jittable).
 """
@@ -56,9 +58,33 @@ def _constrain_first_dim(x, sharding):
     return jax.device_put(x, sharding)
 
 
+def _stack_constrained(parts, esh):
+    """Stack per-expert tensors into [E, ...] with an EXPLICIT sharding pin.
+
+    XLA's CPU SPMD partitioner miscompiles a concatenate of separate
+    program arguments when sharding propagation hands it a partially
+    replicated spec from a multi-axis mesh — the VALUES come out wrong,
+    not just the layout (jax 0.4.37, mesh (dp=2, sep=4), P('dp'):
+    jit(lambda *f: with_sharding_constraint(stack(f), P('dp'))) returns
+    garbage while the single-axis mesh and pre-stacked-input forms are
+    exact). Pinning the stack to an explicit sharding stops the bad
+    propagation: the expert-sharded spec where the partitioner handles it
+    (TPU), full replication on CPU where only dryrun correctness matters.
+    Eager (non-tracer) stacks skip the pin — the hazard is a jit
+    partitioner artifact, and replicating concrete weights every eager
+    forward would only add transfers.
+    """
+    w = jnp.stack(parts)
+    if esh is None or not isinstance(w, jax.core.Tracer):
+        return w
+    if jax.default_backend() == "cpu":
+        return jax.lax.with_sharding_constraint(w, NamedSharding(esh.mesh, P()))
+    return jax.lax.with_sharding_constraint(w, esh)
+
+
 def _routing(probs, top_k: int, capacity: int, aux_mode, normalize: bool):
-    """Dense GShard routing: probs [T, E] -> combine [T, E, C], aux loss,
-    dropped-assignment count.
+    """Dense GShard routing: probs [T, E] -> dispatch [T, E, C] (0/1 mask),
+    combine [T, E, C] (gate-weighted), aux loss, dropped-assignment count.
 
     Positions are assigned priority-major (all first choices before any
     second choice, matching gshard_gate.py's limit_by_capacity order);
@@ -66,6 +92,12 @@ def _routing(probs, top_k: int, capacity: int, aux_mode, normalize: bool):
     returned `dropped` scalar counts zeroed (token, k) assignments out of
     T * top_k routed — the capacity-factor overflow signal the guardian
     telemetry counters report (round 12).
+
+    Fully jittable: every output (including `dropped`) is an ON-DEVICE
+    value — no host branch reads it inside the trace. The step loop returns
+    the drop count as a program output and performs ONE blocking read at
+    the step boundary (see MoELayer.last_drop_count /
+    record_drop_telemetry(dropped=...)).
     """
     T, E = probs.shape
     compute_dtype = probs.dtype
@@ -100,7 +132,8 @@ def _routing(probs, top_k: int, capacity: int, aux_mode, normalize: bool):
         w = gate_vals[:, k] * keep.astype(compute_dtype)  # [T]
         pos_oh = jax.nn.one_hot(jnp.clip(pos_k, 0, capacity - 1), capacity, dtype=compute_dtype)
         combine = combine + w[:, None, None] * m[:, :, None] * pos_oh[:, None, :]
-    return combine, l_aux, dropped
+    dispatch = (combine > 0).astype(compute_dtype)
+    return dispatch, combine, l_aux, dropped
 
 
 class ExpertLayer(Layer):
@@ -219,8 +252,10 @@ class MoELayer(Layer):
         self.l_aux = l_aux
         self.gate.l_aux = l_aux
         # capacity-overflow accounting: dropped (token, k) assignments out
-        # of T * top_k routed this forward; host-queryable via drop_stats()
-        # (None under a jax trace — the count is a tracer there)
+        # of T * top_k routed this forward. Host-queryable via drop_stats()
+        # eagerly; under jit/to_static the count is a tracer — return
+        # last_drop_count() from the compiled step and hand the concrete
+        # per-step value to record_drop_telemetry(dropped=...) post-step.
         self._last_dropped = dropped
         self._last_routed = T * self.gate.top_k
         if len(orig_shape) != 2:
@@ -247,13 +282,41 @@ class MoELayer(Layer):
             "drop_fraction": n_dropped / routed if routed else 0.0,
         }
 
-    def record_drop_telemetry(self, recorder=None, name: str = "moe"):
+    def last_drop_count(self):
+        """The last forward's dropped-assignment count, UNREAD: a Tensor
+        holding the on-device f32 scalar (a tracer inside a jit/to_static
+        trace). The compiled-step contract: return this from the traced
+        step so it becomes a program OUTPUT, then read the concrete
+        per-step value once at the step boundary via
+        record_drop_telemetry(dropped=...). None before any forward."""
+        return getattr(self, "_last_dropped", None)
+
+    def record_drop_telemetry(self, recorder=None, name: str = "moe",
+                              dropped=None):
         """Publish the last forward's drop stats into the guardian
         telemetry: `paddle_tpu_moe_{routed,dropped}_tokens_total` counters +
         a drop-fraction gauge, and (optionally) a flight-recorder event so
         crash dumps carry the capacity-overflow state. Returns the stats
-        dict (or None when unavailable — see drop_stats)."""
-        stats = self.drop_stats()
+        dict (or None when unavailable — see drop_stats).
+
+        `dropped` accepts the DEVICE scalar a compiled step returned (a
+        Tensor, jax array, or float): ONE blocking read happens here, at
+        the step boundary, and the value is counted once. Loader-less
+        eager callers keep the original no-argument form (drop_stats on
+        the last eager forward)."""
+        if dropped is not None:
+            v = dropped._raw() if isinstance(dropped, Tensor) else dropped
+            if isinstance(v, jax.core.Tracer):
+                return None  # called inside a trace — nothing concrete to count
+            n_dropped = float(jax.device_get(v))
+            routed = int(getattr(self, "_last_routed", 0))
+            stats = {
+                "routed": routed,
+                "dropped": n_dropped,
+                "drop_fraction": n_dropped / routed if routed else 0.0,
+            }
+        else:
+            stats = self.drop_stats()
         if stats is None:
             return None
         from ..... import telemetry as _tm
@@ -278,6 +341,20 @@ class MoELayer(Layer):
         return stats
 
     def _fused_forward(self, x, probs, gate_cfg, esh):
+        """Default-expert fast path, recorded as a FIXED-ARITY op chain:
+
+            moe_routing(probs)            -> dispatch, combine, l_aux, dropped
+            moe_dispatch_ec(dispatch, x)  -> dispatched [E, C, M]
+            moe_expert_ffn(dispatched, *) -> expert outputs [E, C, M]
+            moe_combine_ec(combine, eo)   -> out [T, M]
+
+        The dispatch->expert->combine tail is dataflow-connected with no
+        interior escape (l_aux and the drop count leave through moe_routing,
+        which stays OUTSIDE the cluster), so the static pass pipeline's
+        `fuse_moe` DRR pattern can legally collapse it into one op
+        (static/passes/fusion.py). Under jit the four ops trace into one
+        XLA program either way — the split costs nothing compiled and keeps
+        the pattern matchable."""
         top_k, C, aux_mode, normalize = gate_cfg
         act = _act(self.experts[0].activation)
         remat = self.recompute_interval > 0
@@ -286,35 +363,50 @@ class MoELayer(Layer):
         for e in self.experts:
             params += [e.htoh4_weight, e.htoh4_bias, e.h4toh_weight, e.h4toh_bias]
 
-        def fn(xv, pv, *flat):
-            w1 = jnp.stack(flat[0::4])  # [E, M, H]
-            b1 = jnp.stack(flat[1::4])  # [E, H]
-            w2 = jnp.stack(flat[2::4])  # [E, H, M]
-            b2 = jnp.stack(flat[3::4])  # [E, M]
-            combine, l_aux, dropped = _routing(pv, top_k, C, aux_mode, normalize)
-            dispatch = (combine > 0).astype(xv.dtype)
+        def routing_fn(pv):
+            return _routing(pv, top_k, C, aux_mode, normalize)
 
-            def experts_fn(disp, w1, b1, w2, b2):
+        dispatch, combine, l_aux, dropped = apply(
+            "moe_routing", routing_fn, probs, n_outputs=4
+        )
+
+        def dispatch_fn(dv, xv):
+            return jnp.einsum("tec,tm->ecm", dv.astype(xv.dtype), xv)
+
+        dispatched = apply("moe_dispatch_ec", dispatch_fn, dispatch, x)
+
+        def experts_fn(disp, *flat):
+            w1 = _stack_constrained(flat[0::4], esh)  # [E, M, H]
+            b1 = _stack_constrained(flat[1::4], esh)  # [E, H]
+            w2 = _stack_constrained(flat[2::4], esh)  # [E, H, M]
+            b2 = _stack_constrained(flat[3::4], esh)  # [E, M]
+
+            def body(disp, w1, b1, w2, b2):
                 disp = _constrain_first_dim(disp, esh)
                 h = jnp.einsum("ecm,emh->ech", disp, w1) + b1[:, None, :]
                 h = act(h)
                 eo = jnp.einsum("ech,ehm->ecm", h, w2) + b2[:, None, :]
                 return _constrain_first_dim(eo, esh)
 
-            dispatched = jnp.einsum("tec,tm->ecm", dispatch, xv)
-            body = jax.checkpoint(experts_fn) if remat else experts_fn
-            eo = body(dispatched, w1, b1, w2, b2)
-            out = jnp.einsum("tec,ecm->tm", combine, eo)
-            return out, l_aux, dropped
+            fn = jax.checkpoint(body) if remat else body
+            return fn(disp, w1, b1, w2, b2)
 
-        return apply("moe_fused", fn, x, probs, *params, n_outputs=3)
+        eo = apply("moe_expert_ffn", experts_fn, dispatched, *params)
+
+        def combine_fn(cv, eov):
+            return jnp.einsum("tec,ecm->tm", cv, eov)
+
+        out = apply("moe_combine_ec", combine_fn, combine, eo)
+        return out, l_aux, dropped
 
     def _generic_forward(self, x, probs, gate_cfg, esh):
         top_k, C, aux_mode, normalize = gate_cfg
 
         def dispatch_fn(xv, pv):
-            combine, l_aux, dropped = _routing(pv, top_k, C, aux_mode, normalize)
-            dispatched = jnp.einsum("tec,tm->ecm", (combine > 0).astype(xv.dtype), xv)
+            dispatch, combine, l_aux, dropped = _routing(
+                pv, top_k, C, aux_mode, normalize
+            )
+            dispatched = jnp.einsum("tec,tm->ecm", dispatch.astype(xv.dtype), xv)
             return _constrain_first_dim(dispatched, esh), combine, l_aux, dropped
 
         dispatched, combine, l_aux, dropped = apply(
@@ -326,7 +418,7 @@ class MoELayer(Layer):
             outs.append(expert(dispatched[i]))  # [C, M]
 
         def combine_fn(cv, *eov):
-            eo = jnp.stack(eov)  # [E, C, M]
+            eo = _stack_constrained(eov, esh)  # [E, C, M]
             return jnp.einsum("tec,ecm->tm", cv, eo)
 
         out = apply("moe_combine", combine_fn, combine, *outs)

@@ -354,6 +354,22 @@ class _CompiledEntry:
                             restored = got[0]
                     if restored is not None:
                         self.jitted = restored
+                        # a restored step must not LOSE its attribution
+                        # record: cost/memory analysis comes off the
+                        # deserialized executable, so warm runs report the
+                        # same FLOPs/HBM the cold compile did (perf_gate
+                        # hard-fails configs that regress from measured
+                        # attribution back to unavailable)
+                        from ..profiler import perf_attribution as _pa
+
+                        _pa.record_compiled(
+                            "to_static",
+                            fname,
+                            compiled=restored,
+                            compile_seconds=0.0,
+                            extra={"n_state": len(self.state),
+                                   "restored": True},
+                        )
                         _cc.record(
                             "to_static", fname, "restore",
                             seconds=_time.perf_counter() - t0,

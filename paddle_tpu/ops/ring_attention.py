@@ -189,6 +189,25 @@ def ring_attention_local(
     return jnp.swapaxes(out, 1, 2).astype(q.dtype)
 
 
+def ring_attention_op(q, k, v, *, mesh, axis_name: str = "sep",
+                      causal: bool = False, sm_scale: Optional[float] = None):
+    """Tensor-level entry recorded as ONE `ring_attention` op on the
+    framework tape (core.apply): eager callers get the jitted whole-array
+    ring below; `capture_program`/`to_static` see a single fixed-arity op
+    whose closure carries the static mesh/axis/causal config — the
+    long-context capture path the static pass pipeline and the compiled
+    bench config consume. q/k/v are paddle Tensors [B, S, H, D]."""
+    from ..core.apply import apply as _apply
+
+    def fn(qv, kv, vv):
+        return ring_attention(
+            qv, kv, vv, mesh=mesh, axis_name=axis_name, causal=causal,
+            sm_scale=sm_scale,
+        )
+
+    return _apply("ring_attention", fn, q, k, v)
+
+
 @functools.partial(
     jax.jit, static_argnames=("mesh", "axis_name", "causal", "sm_scale")
 )

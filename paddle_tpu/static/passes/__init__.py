@@ -14,7 +14,8 @@ Default pipeline order (import order below defines it):
   3. redundant_cast_reshape_elim identity casts/reshapes forward through
   4. fuse_attention             rope+sdpa / matmul-softmax chain -> flash
   5. fuse_norm_matmul           rms/layer_norm -> linear/matmul epilogue
-  6. fuse_bias_dropout_residual add -> dropout -> add collapse
+  6. fuse_moe                   MoE dispatch -> expert FFN -> combine collapse
+  7. fuse_bias_dropout_residual add -> dropout -> add collapse
 
 Custom passes: subclass ProgramPass, decorate with @register_pass (use
 `before="fuse_attention"` to insert mid-pipeline), and every later
@@ -51,6 +52,7 @@ from .canonicalize import (  # noqa: F401
 from .fusion import (  # noqa: F401
     FuseAttentionPass,
     FuseBiasDropoutResidualPass,
+    FuseMoEDispatchCombinePass,
     FuseNormMatmulPass,
     PatternRewritePass,
 )

@@ -269,6 +269,50 @@ class FuseNormMatmulPass(PatternRewritePass):
 
 
 # ---------------------------------------------------------------------------
+# fuse_moe (dispatch -> expert FFN -> combine)
+# ---------------------------------------------------------------------------
+
+def _moe_builder(program, match):
+    return build_cluster_instr(program, match,
+                               "fused_moe_dispatch_expert_combine")
+
+
+@register_pass
+class FuseMoEDispatchCombinePass(PatternRewritePass):
+    """The MoE data path — dispatch einsum -> batched expert FFN ->
+    combine einsum — collapses into one op (reference fused_ec_moe /
+    fused_moe approached GShard-side). MoELayer's fast path records this
+    exact fixed-arity chain (`moe_dispatch_ec` -> `moe_expert_ffn` ->
+    `moe_combine_ec`, incubate/.../moe_layer.py); routing stays OUTSIDE
+    the cluster because its other outputs (aux loss, the on-device drop
+    count) escape to the loss and the post-step telemetry read, so the
+    tail is the largest legally fusible cluster. The fused fn mini-replays
+    the recorded fns (bit-identical) — one recorded op whose a2a + both
+    expert matmuls XLA schedules as a unit. Match counts land in
+    `detail.moe_longcontext.fusion` and are perf-gated like the dense
+    patterns (a silent un-match is a coverage regression, exit 1)."""
+
+    name = "fuse_moe"
+    patterns = (
+        (
+            Pattern(
+                "moe_dispatch_expert_combine",
+                [
+                    OpPat("moe_dispatch_ec", ins=["d", "x"], outs=["ecm"],
+                          allow_extra_ins=False),
+                    OpPat("moe_expert_ffn", ins=["ecm"], outs=["eo"],
+                          allow_extra_ins=True),  # stacked expert weights
+                    OpPat("moe_combine_ec", ins=["c", "eo"], outs=["y"],
+                          allow_extra_ins=False),
+                ],
+                roots=["y"],
+            ),
+            _moe_builder,
+        ),
+    )
+
+
+# ---------------------------------------------------------------------------
 # fuse_bias_dropout_residual
 # ---------------------------------------------------------------------------
 

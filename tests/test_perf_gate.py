@@ -853,3 +853,138 @@ def test_qos_explained_by_attributed_work(tmp_path):
     b = _write(tmp_path, "b.json", _with_qos(gold_p99=5.4, flops=2.8e11))
     rc, out, err = _run(a, b)
     assert rc == 0, (out, err)
+
+
+# ---------------------------------------------------------------------------
+# round 20: compiled moe_longcontext — attribution may not go dark, mfu
+# gates, capacity drop_fraction gates, sep×ep mesh is shape
+# ---------------------------------------------------------------------------
+
+def _with_moe_compiled(tps=50000.0, ms=160.0, mfu=0.30, drop_frac=0.02,
+                       fuse_moe=2, sep=1, ep=1, flops=3.0e12,
+                       attribution=None):
+    """Capture carrying the round-20 moe_longcontext shape: compiled by
+    default, REAL attribution (flops/hbm/mfu), moe_drops with a measured
+    drop_fraction, sep_ep_dims, and the fuse_moe match count."""
+    c = _capture()
+    c["detail"]["configs"]["moe_longcontext"] = "measured"
+    c["detail"]["moe_longcontext"] = {
+        "batch": 1, "seq": 16384, "heads": "8q/2kv",
+        "experts": 8, "top_k": 2, "capacity_factor": 1.2,
+        "moe_dims": {"d_model": 512, "ffn": 1024},
+        "sep_ep_dims": {"sep": sep, "ep": ep},
+        "compiled": True,
+        "ms_per_step": ms, "tokens_per_sec": tps,
+        "moe_drops": {"routed_per_step": 65536, "dropped_per_step": 1310,
+                      "drop_fraction": drop_frac},
+        "matches": {"dead_op_elimination": 0, "fuse_attention": 0,
+                    "fuse_moe": fuse_moe},
+        "attribution": attribution if attribution is not None else {
+            "program": "moe_longcontext_step",
+            "flops": flops, "hbm_bytes": 6.0e9,
+            "program_memory_bytes": 2.0e9, "peak_hbm_bytes": 2.0e9,
+            "compile_seconds": 20.0,
+            "mfu": mfu, "hbm_util": 0.4, "bound": "compute",
+            "platform": "cpu",
+        },
+    }
+    return c
+
+
+def test_moe_attribution_regression_fails(tmp_path):
+    """The satellite-2 acceptance: moe_longcontext lost its
+    unavailable-attribution exemption — a candidate regressing from
+    measured attribution back to the explicit unavailable marker (eager
+    fallback, restore path gone dark) exits 1 even with every time field
+    flat."""
+    a = _write(tmp_path, "a.json", _with_moe_compiled())
+    b = _write(tmp_path, "b.json", _with_moe_compiled(attribution={
+        "attribution": "unavailable",
+        "why": "BENCH_MOE_EAGER=1 escape hatch",
+    }))
+    rc, out, err = _run(a, b)
+    assert rc == 1, (out, err)
+    assert "ATTRIBUTION REGRESSION" in out and "moe_longcontext" in out
+
+
+def test_moe_measured_attribution_both_sides_passes(tmp_path):
+    a = _write(tmp_path, "a.json", _with_moe_compiled())
+    b = _write(tmp_path, "b.json", _with_moe_compiled())
+    rc, out, err = _run(a, b)
+    assert rc == 0, (out, err)
+
+
+def test_mfu_drop_fails(tmp_path):
+    """Polarity pin (worse): mfu is now a GATED field — utilization falling
+    -33% with flat attributed work is an unexplained regression even if
+    the absolute time fields drifted under noise."""
+    a = _write(tmp_path, "a.json", _with_moe_compiled(mfu=0.30))
+    b = _write(tmp_path, "b.json", _with_moe_compiled(mfu=0.20))
+    rc, out, err = _run(a, b)
+    assert rc == 1, (out, err)
+    assert "mfu" in out and "UNEXPLAINED utilization regression" in out
+
+
+def test_mfu_rise_passes(tmp_path):
+    # polarity pin (better): higher utilization is progress, never a failure
+    a = _write(tmp_path, "a.json", _with_moe_compiled(mfu=0.20))
+    b = _write(tmp_path, "b.json", _with_moe_compiled(mfu=0.30))
+    rc, out, err = _run(a, b)
+    assert rc == 0, (out, err)
+
+
+def test_mfu_drop_explained_by_work_growth_passes(tmp_path):
+    # mfu falling alongside attributed work growing the same fraction is
+    # the explained case (e.g. a memory-bound tail got longer)
+    a = _write(tmp_path, "a.json", _with_moe_compiled(mfu=0.30, flops=3.0e12))
+    b = _write(tmp_path, "b.json", _with_moe_compiled(mfu=0.22, flops=4.2e12))
+    rc, out, err = _run(a, b)
+    assert rc == 0, (out, err)
+
+
+def test_drop_fraction_rise_fails(tmp_path):
+    """Polarity pin (worse): tokens silently falling off the fixed-capacity
+    buffers makes the step FASTER, so only this field can catch it —
+    0.02 -> 0.05 is far past the tol * max(old, 0.01) band."""
+    a = _write(tmp_path, "a.json", _with_moe_compiled(drop_frac=0.02))
+    b = _write(tmp_path, "b.json", _with_moe_compiled(drop_frac=0.05))
+    rc, out, err = _run(a, b)
+    assert rc == 1, (out, err)
+    assert "drop_fraction" in out and "CAPACITY DROP" in out
+
+
+def test_drop_fraction_fall_passes(tmp_path):
+    # polarity pin (better): fewer dropped tokens is routing progress
+    a = _write(tmp_path, "a.json", _with_moe_compiled(drop_frac=0.05))
+    b = _write(tmp_path, "b.json", _with_moe_compiled(drop_frac=0.02))
+    rc, out, err = _run(a, b)
+    assert rc == 0, (out, err)
+
+
+def test_drop_fraction_noise_band_from_zero_passes(tmp_path):
+    # a 0.0 baseline still tolerates sub-noise drift via the absolute floor
+    a = _write(tmp_path, "a.json", _with_moe_compiled(drop_frac=0.0))
+    b = _write(tmp_path, "b.json", _with_moe_compiled(drop_frac=0.0005))
+    rc, out, err = _run(a, b)
+    assert rc == 0, (out, err)
+
+
+def test_moe_fusion_match_drop_fails(tmp_path):
+    """The tentpole acceptance: the fuse_moe dispatch->expert->combine
+    match count landing in the moe record is gated by the same fuse*
+    coverage rule as the passes config — 2 -> 0 exits 1."""
+    a = _write(tmp_path, "a.json", _with_moe_compiled(fuse_moe=2))
+    b = _write(tmp_path, "b.json", _with_moe_compiled(fuse_moe=0))
+    rc, out, err = _run(a, b)
+    assert rc == 1, (out, err)
+    assert "fuse_moe" in out and "FUSION COVERAGE" in out
+
+
+def test_sep_ep_dims_change_not_compared(tmp_path):
+    # a different mesh decomposition is a different problem, not a
+    # regression — even with wildly different numbers
+    a = _write(tmp_path, "a.json", _with_moe_compiled(tps=50000.0, sep=1, ep=1))
+    b = _write(tmp_path, "b.json", _with_moe_compiled(tps=20000.0, sep=4, ep=2))
+    rc, out, err = _run(a, b)
+    assert rc == 0, (out, err)
+    assert "workload changed" in out and "sep_ep_dims" in out

@@ -565,3 +565,34 @@ def test_trace_lint_tree_is_clean():
     )
     assert proc.returncode == 0, f"trace_lint found hazards:\n{proc.stdout}{proc.stderr}"
     assert "0 finding(s)" in proc.stdout
+
+
+def test_trace_lint_tracer_drop_count_fixture(tmp_path):
+    """Round 20 regression fixture for the tracer-drop-count bug class:
+    the pre-rewrite MoE telemetry read branched on the traced per-step
+    drop count inside the step ("if dropped > 0: publish") — a
+    TracerBoolConversionError the moment the layer compiles. The lint
+    must flag that host branch (TL004) and stay clean on the shipped
+    post-step pattern (return the on-device scalar, read it at the step
+    boundary)."""
+    src = textwrap.dedent(
+        '''
+        import jax.numpy as jnp
+
+        def bad_step(combine):
+            dropped = jnp.sum(combine <= 0).astype(jnp.float32)
+            if jnp.sum(combine <= 0) > 0:  # TL004: host branch on traced count
+                dropped = dropped + 0
+            return dropped
+
+        def good_step(combine):
+            # the jittable routing contract: the count stays on device and
+            # leaves the step as a program output — no host branch here
+            dropped = jnp.sum(combine <= 0).astype(jnp.float32)
+            return dropped
+        '''
+    )
+    unsup, sup, unused = _lint(tmp_path, src, name="moe_drop_fixture.py")
+    assert [f.rule for f in unsup] == ["TL004"]
+    assert unsup[0].qualname == "bad_step"
+    assert sup == [] and unused == []

@@ -41,6 +41,18 @@ fields: a drop beyond tolerance with flat attributed work exits 1. The
 sub-run's knobs live in `prefix_spec_dims` (a shape field — changing the
 trace/knobs is a different problem, not a regression).
 
+Round 20: the compiled moe_longcontext config lost its
+unavailable-attribution exemption. A config whose baseline carried
+measured attribution that regresses to the explicit
+`attribution: unavailable` marker exits 1 (the attribution surface went
+dark — eager fallback or a restore path that stopped recording cost
+analysis). `mfu` joins the gated fields (the dimensionless step-time
+check; `hbm_util` stays informational), and `moe_drops.drop_fraction`
+gates larger-is-worse with a `tol * max(old, 0.01)` band — dropped
+tokens make the step faster, so no time field can catch that one.
+`sep_ep_dims` is a shape field: a different mesh decomposition is a
+different problem.
+
 Round 16: serving/fleet records carry `slo_breakdown` (the request-trace
 TTFT/TPOT decomposition). Two new checks: (a) CONSISTENCY — the candidate's
 breakdown components must sum to the measured request wall time within 5%
@@ -94,6 +106,10 @@ SHAPE_FIELDS = (
     # round 19: the QoS overload replay's tenant mix / rate limits /
     # brownout thresholds — different pressure, different sheds
     "qos_dims",
+    # round 20: the compiled MoE long-context mesh decomposition (sep ×
+    # ep degrees) — a different mesh is a different problem, not a
+    # regression
+    "sep_ep_dims",
 )
 # larger-is-worse regression metrics per config record; the names match
 # what bench.py actually emits per config (ernie/llama/resnet report
@@ -265,6 +281,22 @@ def compare_config(key: str, old: dict, new: dict, tol: float):
     if shape:
         return "explained", [f"{key}: workload changed ({', '.join(shape)}) — not compared"]
     oa, na = _attr(old), _attr(new)
+    verdict = "pass"
+    # round 20: a config whose baseline carried MEASURED attribution may
+    # never regress to the explicit `attribution: unavailable` marker —
+    # that is the whole attribution surface going dark (the moe_longcontext
+    # exemption ended when the config compiled; falling back to eager, or
+    # a restore path that stops recording cost analysis, must exit 1, not
+    # quietly narrow the gate to time fields)
+    na_marker = new.get("attribution")
+    if oa and isinstance(na_marker, dict) and "attribution" in na_marker:
+        lines.append(
+            f"{key}: attribution measured -> "
+            f"{na_marker.get('attribution')!r} "
+            f"({na_marker.get('why') or na_marker.get('error') or 'no reason'}) "
+            f"— ATTRIBUTION REGRESSION (config went dark)"
+        )
+        verdict = "regress"
     # a field the baseline measured but the candidate lost (or zeroed) is
     # suspicious — never silently narrow the gate's coverage; absence in
     # BOTH captures is the legitimate no-cost-analysis platform case
@@ -281,7 +313,6 @@ def compare_config(key: str, old: dict, new: dict, tol: float):
     for f in ATTR_WORK_FIELDS:
         if oa.get(f) and na.get(f):
             work_growth = max(work_growth, _rel(na[f], oa[f]))
-    verdict = "pass"
     # round 16: the CANDIDATE's slo_breakdown must be internally consistent
     # — components summing short of the measured wall means the attribution
     # surface itself broke (ring eviction, missed transition), which would
@@ -443,15 +474,43 @@ def compare_config(key: str, old: dict, new: dict, tol: float):
                 )
                 verdict = "regress"
     # roofline drop: utilization falling past tol while work stayed flat is
-    # the overlap/scheduling signal even if absolute time fields are absent
-    for f in ("mfu", "hbm_util"):
+    # the overlap/scheduling signal even if absolute time fields are absent.
+    # Round 20: `mfu` GATES — it is the dimensionless form of the step-time
+    # check (flops / time / peak), so a drop past tol with flat work is the
+    # same unexplained regression even when a config's absolute time field
+    # moved under measurement noise. `hbm_util` stays informational: on
+    # compute-bound configs it legitimately swings with fusion decisions.
+    for f, gates in (("mfu", True), ("hbm_util", False)):
         if oa.get(f) and na.get(f):
             r = _rel(na[f], oa[f])
-            if r < -(tol + max(0.0, work_growth)) and not any("UNEXPLAINED" in l for l in lines):
-                lines.append(
-                    f"{key}: roofline {f} {oa[f]:.3f} -> {na[f]:.3f} ({r:.1%}) — "
-                    "utilization regression (informational; time fields gate)"
-                )
+            if r < -(tol + max(0.0, work_growth)):
+                if gates:
+                    lines.append(
+                        f"{key}: roofline {f} {oa[f]:.3f} -> {na[f]:.3f} "
+                        f"({r:.1%}) with attributed work +{work_growth:.1%} — "
+                        f"UNEXPLAINED utilization regression"
+                    )
+                    verdict = "regress"
+                elif not any("UNEXPLAINED" in l for l in lines):
+                    lines.append(
+                        f"{key}: roofline {f} {oa[f]:.3f} -> {na[f]:.3f} ({r:.1%}) — "
+                        "utilization regression (informational; time fields gate)"
+                    )
+    # round 20: capacity-drop fraction (moe_longcontext) — tokens silently
+    # falling off the fixed-capacity buffers is a MODEL-QUALITY regression
+    # no time field sees (dropping tokens makes the step FASTER). Gated
+    # larger-is-worse with an absolute floor so a 0.0 baseline still
+    # tolerates sub-noise drift: allowed increase is tol * max(old, 0.01).
+    od_ = (old.get("moe_drops") or {}).get("drop_fraction")
+    nd_ = (new.get("moe_drops") or {}).get("drop_fraction")
+    if isinstance(od_, (int, float)) and isinstance(nd_, (int, float)):
+        if nd_ > od_ + tol * max(od_, 0.01):
+            lines.append(
+                f"{key}: moe_drops.drop_fraction {od_:.4f} -> {nd_:.4f} — "
+                f"CAPACITY DROP regression (routing quality, not speed; "
+                f"allowed +{tol * max(od_, 0.01):.4f})"
+            )
+            verdict = "regress"
     if not lines:
         lines.append(f"{key}: ok")
     return verdict, lines
