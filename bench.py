@@ -156,7 +156,9 @@ _EST_S = {
     # round 17: the serving child also replays the prefix/spec concurrency
     # A/B (baseline f32 vs int8+prefix+spec on the same pool bytes)
     "serving": 300,
-    "fleet": 240,
+    # round 21: the fleet child also replays the disaggregated-vs-
+    # monolithic burst A/B (KV migration + tier-death chaos)
+    "fleet": 360,
     "qos": 180,
     "resnet": 180,
     # round 20: compiled by default + warm-restore probe + fusion capture
@@ -986,6 +988,14 @@ def _fleet_dims():
         # round 16: SLO targets for the request-trace burn rate
         "slo_ttft_ms": float(g("BENCH_FLEET_SLO_TTFT_MS", 1000.0)),
         "slo_tpot_ms": float(g("BENCH_FLEET_SLO_TPOT_MS", 200.0)),
+        # round 21: the disaggregated-vs-monolithic burst A/B — requests
+        # arriving near-simultaneously with a shared system-prompt prefix
+        # (prefix_pages full pages), replayed on an untiered fleet and a
+        # prefill/decode split of the SAME width (equal chips)
+        "burst_requests": int(g("BENCH_FLEET_BURST_REQUESTS", 16)),
+        "burst_gap_s": float(g("BENCH_FLEET_BURST_GAP", 0.0005)),
+        "prefix_pages": int(g("BENCH_FLEET_PREFIX_PAGES", 2)),
+        "decode_kv_dtype": g("BENCH_FLEET_DECODE_KV", "int8"),
     }
 
 
@@ -1039,10 +1049,12 @@ def _build_fleet():
             ))
         return reqs
 
-    def fresh_engine():
+    def fresh_engine(kv_dtype=None):
+        kw = {} if kv_dtype is None else {"kv_dtype": kv_dtype}
         eng = InferenceEngine(
             model, max_seq_len=d["max_seq"], block_size=d["block_size"],
             max_batch=d["max_batch"], decode_batch_buckets=(d["max_batch"],),
+            **kw,
         )
         for b in eng.prefill_buckets:  # warmup: compile outside the replay
             pages = eng.pool.alloc(eng.pool.blocks_for_tokens(b))
@@ -1109,6 +1121,113 @@ def _build_fleet():
                           "evacuated", "replica_failures", "preempted",
                           "swaps_completed", "p99_tpot_swap_ms", "wall_s")
             }
+        # ---- round 21: disaggregated-vs-monolithic burst A/B ----
+        # the same near-simultaneous shared-prefix burst replayed twice at
+        # EQUAL chips: an untiered fleet (replica-local prefix serving
+        # only: owner map cut to one entry) vs a prefill/decode split with
+        # fleet-global prefix routing, int8 decode KV, and injected
+        # migration + decode-replica-death chaos. TTFT/TPOT/hit-rate land
+        # in the capture for perf_gate; only the robustness invariants
+        # (zero lost/duplicated/failed, global >= local hit rate) are
+        # asserted here — timing claims gate across captures, not runs.
+        def mk_burst():
+            rng = np.random.RandomState(d["seed"] + 1)
+            shared = rng.randint(
+                0, d["vocab"], (d["prefix_pages"] * d["block_size"],)
+            ).tolist()
+            reqs, t = [], 0.0
+            for i in range(d["burst_requests"]):
+                t += rng.exponential(d["burst_gap_s"])
+                reqs.append(Request(
+                    rid=i,
+                    prompt=shared + rng.randint(
+                        0, d["vocab"], (int(rng.randint(2, 6)),)).tolist(),
+                    max_new_tokens=int(rng.choice([4, 8, 12])),
+                    arrival_time=t,
+                ))
+            return reqs
+
+        def hit_rate(stats_fleet):
+            # per-request cap: a preempted request prefills its folded
+            # prompt more than once, so raw cached_tokens can exceed the
+            # prompt — the rate reported is "fraction of prompt tokens a
+            # request never had to compute at least once"
+            done = [r for r in stats_fleet.finished
+                    if r.outcome == "completed"]
+            total = sum(r.prompt_len for r in done)
+            return round(
+                sum(min(r.cached_tokens, r.prompt_len) for r in done)
+                / max(1, total), 4)
+
+        def mk_disagg():
+            f = ReplicaFleet(
+                [fresh_engine() for _ in range(n_prefill)]
+                + [fresh_engine(d["decode_kv_dtype"] or None)
+                   for _ in range(width - n_prefill)],
+                tiers=["prefill"] * n_prefill
+                + ["decode"] * (width - n_prefill),
+            )
+            f.prewarm()
+            return f
+
+        width = max(2, widest)
+        n_prefill = max(1, width // 2)
+        mono = ReplicaFleet(
+            [fresh_engine() for _ in range(width)],
+            prefix_owner_cache_size=1,
+        )
+        gc.collect()
+        gc.disable()
+        try:
+            mono_stats = fleet_replay(mono, mk_burst())
+        finally:
+            gc.enable()
+        assert mono_stats["lost"] == 0 and mono_stats["duplicated"] == 0
+        local_rate = hit_rate(mono)
+
+        # clean disagg run: the headline TTFT/TPOT/hit-rate comparison
+        # (chaos inflating only one side would make the A/B meaningless)
+        disagg = mk_disagg()
+        gc.collect()
+        gc.disable()
+        try:
+            disagg_stats = fleet_replay(disagg, mk_burst())
+        finally:
+            gc.enable()
+        assert disagg_stats["lost"] == 0 and disagg_stats["duplicated"] == 0
+        assert disagg_stats["migration_failures"] == 0, disagg_stats
+        fleet_rate = hit_rate(disagg)
+        # fleet-global routing must never do WORSE than replica-local
+        # luck on the same burst (one first-miss vs one per intake
+        # replica is structural, not timing)
+        assert fleet_rate >= local_rate, (fleet_rate, local_rate)
+
+        # chaos disagg run: migrate-site faults mid-burst, then a decode
+        # replica killed — the robustness invariants (zero lost/dup/
+        # failed, recompute fallbacks fired) hold; its tail is recorded
+        # separately, never mixed into the headline
+        def migrate_chaos():
+            _fi.install_plan(_fi.FaultPlan().add(
+                "fleet.kv_migrate.*", "fail", times=2))
+
+        def decode_kill(idx=width - 1):
+            _fi.install_plan(_fi.FaultPlan().add(
+                f"fleet.replica_step.{idx}", "fail", times=2))
+
+        chaos_fleet = mk_disagg()
+        gc.collect()
+        gc.disable()
+        try:
+            chaos_stats = fleet_replay(chaos_fleet, mk_burst(), events=[
+                (max(1, int(0.25 * d["burst_requests"])), migrate_chaos),
+                (max(2, int(0.6 * d["burst_requests"])), decode_kill),
+            ])
+        finally:
+            gc.enable()
+            _fi.clear_plan()
+        assert chaos_stats["lost"] == 0 and chaos_stats["duplicated"] == 0
+        assert chaos_stats["migration_failures"] == 0, chaos_stats
+
         head = per_n[str(widest)]
         tps_1 = per_n.get("1", {}).get("tokens_per_sec")
         res = {
@@ -1128,6 +1247,30 @@ def _build_fleet():
                 round(head["tokens_per_sec"] / tps_1, 3)
                 if head.get("tokens_per_sec") and tps_1 else None
             ),
+            # round 21: the disaggregated A/B headline fields (gated)
+            "p99_ttft_burst_ms": disagg_stats.get("p99_ttft_ms"),
+            "disagg_p99_tpot_ms": disagg_stats.get("p99_tpot_ms"),
+            "mono_p99_ttft_burst_ms": mono_stats.get("p99_ttft_ms"),
+            "ttft_burst_improvement": (
+                round(mono_stats["p99_ttft_ms"]
+                      / disagg_stats["p99_ttft_ms"], 3)
+                if mono_stats.get("p99_ttft_ms")
+                and disagg_stats.get("p99_ttft_ms") else None
+            ),
+            "fleet_prefix_hit_rate": fleet_rate,
+            "local_prefix_hit_rate": local_rate,
+            "migrations": disagg_stats["migrations"],
+            "migration_fallbacks": chaos_stats["migration_fallbacks"],
+            # max over the clean AND chaos runs: a failure anywhere fails
+            "migration_failures": max(disagg_stats["migration_failures"],
+                                      chaos_stats["migration_failures"]),
+            "migration_cost_per_page_ms": (
+                round(1000.0 * disagg.migration_wall_s
+                      / disagg.migrated_pages_total, 4)
+                if disagg.migrated_pages_total else None
+            ),
+            "p99_ttft_burst_chaos_ms": chaos_stats.get("p99_ttft_ms"),
+            "chaos_crc_rejects": chaos_stats["crc_rejects"],
             "slo_breakdown": slo_breakdown,
             "replicas": per_n,
             "note": (
@@ -1147,6 +1290,14 @@ def _build_fleet():
             "swap_at", "kill_at",
         )}
         res["fleet_dims"]["replicas"] = list(d["replicas"])
+        res["disagg_dims"] = {
+            "prefill_replicas": n_prefill,
+            "decode_replicas": width - n_prefill,
+            "kv_dtype": d["decode_kv_dtype"],
+            "burst_requests": d["burst_requests"],
+            "burst_gap_s": d["burst_gap_s"],
+            "prefix_pages": d["prefix_pages"],
+        }
         return res
     finally:
         shutil.rmtree(ck_root, ignore_errors=True)

@@ -7,6 +7,14 @@ bytes they just wrote. Production code calls `fault_point(site, **ctx)` at
 each instrumented site; with no plan installed that is a single module-level
 bool check, so the hooks are free in real runs.
 
+Sites may be globs (`fnmatch`), so one spec covers a whole family — the
+serving fleet's are the heaviest users: `fleet.replica_step.<idx>` (kill or
+stall one replica), `fleet.route` / `fleet.tier_route` (routing decisions,
+monolithic and tiered), and `fleet.kv_migrate.<src>.<dst>` (the
+prefill→decode KV-page handoff; `fail` aborts it mid-flight, `corrupt`
+flips payload bytes that the readback CRC must then catch —
+`fleet.kv_migrate.*` chaoses every pair).
+
 Plans are seedable (corruption flips deterministic byte positions) and
 env-activatable: `PADDLE_TPU_FAULT_PLAN` holds either a JSON list of specs
 or the compact form `site=action[*times][:arg][;site=...]`, e.g.

@@ -379,17 +379,22 @@ class InferenceEngine:
                                fingerprint=fp, signature=sz)
         return ex
 
-    def prewarm(self, *, include_decode: bool = True,
+    def prewarm(self, *, include_prefill: bool = True,
+                include_decode: bool = True,
                 extend_q: Sequence[int] = ()) -> dict:
         """Compile (or restore/share) every bucket program up front, so
         steady-state serving — and the first token — never pays a compile.
         `extend_q` adds the (B, Q) extend/verify family for the given
-        query lengths (speculative decode uses draft_len + 1). Records the
-        `prewarm` span the cold-start report decomposes. Returns a copy of
+        query lengths (speculative decode uses draft_len + 1);
+        `include_prefill=False` warms a decode-tier engine (streamed
+        admission never runs a bucketed prefill, so the prefill family
+        would be dead weight in its compile ledger). Records the `prewarm`
+        span the cold-start report decomposes. Returns a copy of
         bucket_stats."""
         t0 = time.monotonic()
-        for S in self.prefill_buckets:
-            self._get_compiled("prefill", S)
+        if include_prefill:
+            for S in self.prefill_buckets:
+                self._get_compiled("prefill", S)
         if include_decode:
             for B in self.decode_batch_buckets:
                 self._get_compiled("decode", B)

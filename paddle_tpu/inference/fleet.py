@@ -45,6 +45,42 @@ evacuation / failure / swap counters, per-replica step-latency and
 swap-drain histograms; request-level TTFT/TPOT land in the PR 8 serving
 histograms (the schedulers observe them), so fleet p99s come from the same
 families the single-replica tier exports.
+
+Round 20 — disaggregated prefill/decode serving (`tiers=(...)`):
+
+- **Tiered fleet**: each replica is labeled "prefill" or "decode".
+  Intake routes to the prefill tier (bucketed prefill, TTFT-optimal);
+  once a request's prompt is fully written and its first token emitted,
+  its KV pages MIGRATE to a decode replica — a host-side reshard of the
+  pool pytree (kv_cache.export_pages/import_pages), re-encoded when the
+  decode tier stores int8 (the absmax observer rule, byte-identical to
+  quantize-on-write), verified by per-page CRC32 over the migrated
+  block-table range. The handoff runs behind deterministic FaultPlan
+  sites (`fleet.kv_migrate.<src>.<dst>` for the transfer,
+  `fleet.tier_route` for tiered intake): a fault or CRC mismatch frees
+  the destination pages and falls back to recompute-on-resume through
+  the existing preemption path — never a corrupt page, never a lost or
+  duplicated request. Repeated fallbacks stop retrying (the request just
+  finishes on its prefill replica — per-request monolithic degradation).
+- **Fleet-global prefix routing**: the router keeps a bounded chain-digest
+  -> owner-replica map, fed by migration (the source keeps its committed
+  prompt pages retained) and by completions on intake-eligible replicas.
+  A new request whose prompt extends a known chain routes to the owner
+  (reason="prefix"), so prefix-sharing sessions land where the pages are
+  warm. Ownership fails over on replica death (entries drop; the next
+  completion re-publishes) and `invalidate_prefix()` broadcasts a
+  hot-swap invalidation fleet-wide (PR 15's per-pool hook generalized —
+  `request_swap` calls it up front).
+- **Degradation ladder** (above PR 17's brownout): decode tier dead ->
+  `mode()=="monolithic"` — the prefill tier serves both phases, no
+  migration; prefill tier dead -> `mode()=="streamed_prefill"` — decode
+  replicas take intake and stream prompts through their decode program
+  (their schedulers run admission_mode="streamed", so no prefill bucket
+  ever compiles there); both tiers alive again (`revive(idx)`) -> the
+  fleet RE-SPLITS one replica at a time like the PR 11 swap rollout,
+  draining each prefill replica's decode-phase backlog to the decode
+  tier before moving to the next. NoHealthyReplica is reserved for every
+  replica fully down, and its message reports per-tier state.
 """
 from __future__ import annotations
 
@@ -58,6 +94,8 @@ from .. import telemetry
 from ..telemetry import metrics as _metrics
 from ..telemetry import request_trace as _rt
 from ..distributed.resilience import fault_injection as _fi
+from . import kv_cache as _kvc
+from .kv_cache import PoolExhausted, prefix_chain_keys
 from .qos import QoSPolicy
 from .scheduler import (
     ContinuousBatchingScheduler,
@@ -67,6 +105,17 @@ from .scheduler import (
 )
 
 __all__ = ["ReplicaFleet", "ReplicaStatus", "NoHealthyReplica", "fleet_replay"]
+
+# fleet modes (the degradation ladder): disaggregated = both tiers alive,
+# KV migrates prefill -> decode; monolithic = decode tier dead (or the
+# fleet is untiered), intake tier serves both phases; streamed_prefill =
+# prefill tier dead, decode replicas take intake and stream prompts
+FLEET_MODES = ("disaggregated", "monolithic", "streamed_prefill")
+
+# a request whose migration fell back this many times stops being retried
+# and simply finishes on its prefill replica (per-request monolithic
+# degradation beats a recompute livelock under a perma-faulted site)
+_MIGRATE_FALLBACK_CAP = 2
 
 
 class ReplicaStatus:
@@ -82,12 +131,42 @@ class NoHealthyReplica(RuntimeError):
     make progress (the caller's cue to escalate/restart, not spin)."""
 
 
-def _replicas_gauge(state: str):
+def _replicas_gauge(state: str, tier: str = "none"):
     return _metrics.gauge(
         "paddle_tpu_fleet_replicas",
-        "fleet replicas by health state",
-        label_names=("state",),
-    ).labels(state=state)
+        "fleet replicas by health state and tier (tier=none on an "
+        "untiered fleet)",
+        label_names=("state", "tier"),
+    ).labels(state=state, tier=tier)
+
+
+def _held_gauge(tier: str = "none"):
+    return _metrics.gauge(
+        "paddle_tpu_fleet_held_requests",
+        "requests held at the fleet for want of a healthy replica, by the "
+        "intake tier that would take them (tier=none on an untiered fleet)",
+        label_names=("tier",),
+    ).labels(tier=tier)
+
+
+def _mode_gauge(mode: str):
+    return _metrics.gauge(
+        "paddle_tpu_fleet_mode",
+        "1 on the fleet's current degradation-ladder rung, 0 elsewhere",
+        label_names=("mode",),
+    ).labels(mode=mode)
+
+
+def _migration_counter(event: str):
+    return _metrics.counter(
+        "paddle_tpu_fleet_kv_migrations_total",
+        "prefill->decode KV page migrations by outcome (completed = pages "
+        "CRC-verified on the decode replica, fallback_fault / fallback_crc "
+        "= recovered via recompute-on-resume, deferred = no decode "
+        "capacity, left decoding on the prefill replica, failed = "
+        "unexpected error — the zero-gate invariant)",
+        label_names=("event",),
+    ).labels(event=event)
 
 
 def _queue_gauge(replica: int, state: str):
@@ -102,10 +181,12 @@ def _routed_counter(reason: str):
     return _metrics.counter(
         "paddle_tpu_fleet_routed_total",
         "routing decisions by reason (affinity = session home, "
+        "prefix = fleet-global prefix-owner hit, "
         "least_loaded = SLO-aware pick, evacuated = re-dispatch off a dead "
         "replica, migrated = drained off a swapping replica, held = no "
         "healthy replica, queued at the fleet, requeued = held request "
-        "flushed to a recovered replica)",
+        "flushed to a recovered replica, migration_fallback = KV handoff "
+        "failed, recompute-on-resume re-dispatch)",
         label_names=("reason",),
     ).labels(reason=reason)
 
@@ -163,10 +244,12 @@ def _drain_hist():
 class _Replica:
     """One engine + scheduler behind the router, plus its health record."""
 
-    def __init__(self, idx: int, engine, sched: ContinuousBatchingScheduler):
+    def __init__(self, idx: int, engine, sched: ContinuousBatchingScheduler,
+                 tier: Optional[str] = None):
         self.idx = idx
         self.engine = engine
         self.sched = sched
+        self.tier = tier  # "prefill" | "decode" | None (untiered)
         self.status = ReplicaStatus.HEALTHY
         self.consecutive_failures = 0
         self.ewma_step_s = 0.0
@@ -197,6 +280,8 @@ class ReplicaFleet:
         prefix_cache: bool = True,
         spec_decode=None,
         qos: Optional[QoSPolicy] = None,
+        tiers: Optional[Sequence[str]] = None,
+        prefix_owner_cache_size: int = 8192,
     ):
         if not engines:
             raise ValueError("ReplicaFleet needs at least one engine")
@@ -209,19 +294,57 @@ class ReplicaFleet:
         # ladder are fleet-wide (a tenant can't dodge its quota by
         # spraying replicas), and the held queue below shares its bounds
         self.qos = qos
+        self.spec = spec_decode
+        # round 20: tiers split the fleet into disaggregated prefill and
+        # decode pools. Page migration reshards pool pytrees across
+        # replicas, so the KV geometry must agree fleet-wide
+        if tiers is not None:
+            tiers = tuple(tiers)
+            if len(tiers) != len(engines):
+                raise ValueError(
+                    f"tiers has {len(tiers)} entries for {len(engines)} engines")
+            bad = [t for t in tiers if t not in ("prefill", "decode")]
+            if bad:
+                raise ValueError(f"unknown tier(s) {bad}; 'prefill' or 'decode'")
+            if "prefill" not in tiers or "decode" not in tiers:
+                raise ValueError(
+                    "a tiered fleet needs at least one prefill AND one "
+                    "decode replica (run untiered otherwise)")
+            geo = [
+                (e.block_size, e.num_layers, e.num_kv_heads, e.head_dim,
+                 e.max_seq_len)
+                for e in engines
+            ]
+            if len(set(geo)) != 1:
+                raise ValueError(
+                    "tiered replicas must share KV geometry (block_size, "
+                    f"layers, kv_heads, head_dim, max_seq_len); got {geo}")
+        self._tiers = tiers
         # round 17: every replica's scheduler gets the prefix cache (on by
         # default — session affinity already routes a conversation to the
         # replica holding its warm pages, so hits compound) and, opt-in,
-        # speculative decoding
+        # speculative decoding. Tiered: decode replicas admit "streamed"
+        # only (tier degradation intake never compiles a prefill bucket)
+        # and own the spec-decode path; prefill replicas draft nothing —
+        # their decode steps are a short bridge until migration
         self.replicas: List[_Replica] = [
             _Replica(
                 i,
                 eng,
                 ContinuousBatchingScheduler(
                     eng, eos_id=eos_id, max_running=max_running, clock=clock,
-                    prefix_cache=prefix_cache, spec_decode=spec_decode,
+                    prefix_cache=prefix_cache,
+                    spec_decode=(
+                        spec_decode if tiers is None or tiers[i] == "decode"
+                        else None
+                    ),
                     qos=qos,
+                    admission_mode=(
+                        "streamed" if tiers is not None and tiers[i] == "decode"
+                        else "auto"
+                    ),
                 ),
+                tier=tiers[i] if tiers is not None else None,
             )
             for i, eng in enumerate(engines)
         ]
@@ -242,6 +365,29 @@ class ReplicaFleet:
         self._session_home: "OrderedDict[object, int]" = OrderedDict()
         self._swap: Optional[dict] = None
         self._swap_t0: Optional[float] = None
+        # round 20: fleet-global prefix routing — chain digest -> replica
+        # idx holding that chain's pages warm (bounded LRU, like the
+        # session-home map and for the same reason)
+        self.prefix_owner_cache_size = max(1, int(prefix_owner_cache_size))
+        self._prefix_owner: "OrderedDict[bytes, int]" = OrderedDict()
+        self.prefix_routed_total = 0
+        # migration accounting: completed handoffs, clean fallbacks
+        # (recompute-on-resume), CRC rejections (a subset of fallbacks),
+        # capacity deferrals, and FAILURES — migrations that neither
+        # completed nor fell back cleanly. failures stays 0 by
+        # construction; perf_gate pins it there
+        self.migrations_total = 0
+        self.migration_fallbacks = 0
+        self.migration_crc_rejects = 0
+        self.migration_deferred = 0
+        self.migration_failures = 0
+        self.migrated_pages_total = 0
+        self.migration_wall_s = 0.0
+        self._migrate_fallback_counts: Dict[int, int] = {}  # rid -> fallbacks
+        # degradation ladder state: current mode + the one-replica-at-a-time
+        # re-split queue a monolithic -> disaggregated recovery drains
+        self._mode = "disaggregated" if tiers is not None else "monolithic"
+        self._resplit: Optional[List[int]] = None
         if telemetry.enabled():
             self._sync_gauges()
 
@@ -270,18 +416,137 @@ class ReplicaFleet:
     def healthy(self) -> List[_Replica]:
         return [r for r in self.replicas if r.status == ReplicaStatus.HEALTHY]
 
+    # ---- tiers & the degradation ladder ----
+    @property
+    def tiered(self) -> bool:
+        return self._tiers is not None
+
+    def tier_replicas(self, tier: str) -> List[_Replica]:
+        return [r for r in self.replicas if r.tier == tier]
+
+    def tier_health(self) -> Dict[str, Dict[str, int]]:
+        """Per-tier status counts ({} on an untiered fleet) — the
+        operator's degraded-vs-down signal: a dead decode tier with a live
+        prefill tier is mode()=="monolithic", not an outage."""
+        out: Dict[str, Dict[str, int]] = {}
+        if not self.tiered:
+            return out
+        for t in ("prefill", "decode"):
+            counts = {s: 0 for s in ReplicaStatus.ALL}
+            for r in self.tier_replicas(t):
+                counts[r.status] += 1
+            out[t] = counts
+        return out
+
+    def mode(self) -> str:
+        """Current degradation-ladder rung (one of FLEET_MODES). An
+        untiered fleet is always "monolithic"."""
+        return self._mode
+
+    def _tier_alive(self, tier: str) -> bool:
+        # DRAINING counts as alive (half-open circuits recover; killing a
+        # tier's mode over a transient would thrash the ladder)
+        return any(
+            r.status != ReplicaStatus.DOWN for r in self.tier_replicas(tier)
+        )
+
+    def _update_mode(self) -> None:
+        """Recompute the ladder rung from per-tier health; a monolithic ->
+        disaggregated recovery arms the one-replica-at-a-time re-split."""
+        if not self.tiered:
+            return
+        prev = self._mode
+        if self._tier_alive("decode"):
+            new = ("disaggregated" if self._tier_alive("prefill")
+                   else "streamed_prefill")
+        else:
+            # decode tier fully down (prefill too = every replica down —
+            # step() raises; keep reporting monolithic meanwhile)
+            new = "monolithic"
+        if new == prev:
+            return
+        self._mode = new
+        if self.qos is not None:
+            # half the chips now run both phases: floor the brownout
+            # pressure reading (qos.BrownoutConfig.degraded_pressure_floor,
+            # default 0.0 = no effect) so shedding leans pessimistic
+            # BEFORE the thinner fleet's queues back up
+            self.qos.set_degraded(new != "disaggregated")
+        if new == "disaggregated":
+            # recovery: prefill replicas may hold a decode-phase backlog
+            # accumulated while the fleet ran monolithic — drain it to the
+            # decode tier ONE replica at a time (the PR 11 swap-rollout
+            # discipline: no thundering herd into the recovering tier)
+            self._resplit = [
+                r.idx for r in self.tier_replicas("prefill")
+                if r.status != ReplicaStatus.DOWN
+            ]
+        else:
+            self._resplit = None
+        _rt.record_event("fleet", "mode", t=self.clock(), mode=new, was=prev)
+        if telemetry.enabled():
+            for m in FLEET_MODES:
+                _mode_gauge(m).set(1 if m == self._mode else 0)
+
+    def revive(self, idx: int) -> None:
+        """Operator surface: bring a DOWN replica back (its process/chips
+        recovered). Health state resets and the local prefix index is
+        defensively invalidated — the fleet may have hot-swapped weights
+        while this replica was dark, and stale-chain K/V must never serve
+        a post-revival prefix hit. Mode recomputes (possibly arming the
+        re-split ladder)."""
+        rep = self.replicas[idx]
+        if rep.status != ReplicaStatus.DOWN:
+            return
+        rep.status = ReplicaStatus.HEALTHY
+        rep.consecutive_failures = 0
+        rep.engine.pool.invalidate_prefix()
+        _rt.record_event("fleet", "replica_revived", t=self.clock(),
+                         replica=idx)
+        self._update_mode()
+        if telemetry.enabled():
+            self._sync_gauges()
+
+    def _intake_tier(self) -> Optional[str]:
+        """The tier new/re-dispatched requests route to under the current
+        mode; None on an untiered fleet (every replica is intake)."""
+        if not self.tiered:
+            return None
+        return "decode" if self._mode == "streamed_prefill" else "prefill"
+
+    def _intake_replicas(self) -> List[_Replica]:
+        tier = self._intake_tier()
+        if tier is None:
+            return self.healthy()
+        return [r for r in self.healthy() if r.tier == tier]
+
     def prewarm(self) -> dict:
         """Compile (or restore) every replica's shape buckets before
         traffic. Replicas sharing a model signature compile each bucket
         ONCE: the first replica pays the miss (or a persistent-cache
         restore), the rest adopt the executable from the in-process shared
         registry (ledger outcome=shared) — N-replica fleet cold start costs
-        one replica's compiles, not N. Returns per-replica bucket stats."""
-        return {
-            r.idx: r.engine.prewarm()
-            for r in self.replicas
-            if hasattr(r.engine, "prewarm")
-        }
+        one replica's compiles, not N. Returns per-replica bucket stats.
+
+        Tiered: each tier warms ITS bucket family. Decode replicas skip
+        the prefill buckets entirely (streamed admission never runs one)
+        and add the (B, Q) extend family when speculative decoding is on;
+        prefill replicas keep the decode family too — streamed admission,
+        the pre-migration decode bridge, and monolithic degradation all
+        ride the decode program, so dropping it would turn the first
+        degraded step into a compile stall."""
+        out = {}
+        for r in self.replicas:
+            if not hasattr(r.engine, "prewarm"):
+                continue
+            if r.tier == "decode":
+                extend_q = ((self.spec.draft_len + 1,)
+                            if self.spec is not None else ())
+                out[r.idx] = r.engine.prewarm(include_prefill=False,
+                                              extend_q=extend_q)
+            else:
+                out[r.idx] = r.engine.prewarm()
+        return out
 
     # ---- routing ----
     def _score(self, rep: _Replica) -> float:
@@ -299,8 +564,15 @@ class ReplicaFleet:
         # a raise would silently lose it and void the zero-loss invariant
         if reason_override is None:
             _fi.fault_point("fleet.route", rid=req.rid)
-        healthy = self.healthy()
-        if not healthy:
+            if self.tiered:
+                # tier selection is its own failure domain: a chaos raise
+                # here models a router that can't resolve the intake tier
+                # (e.g. mode flapping mid-decision), distinct from the
+                # generic route fault above
+                _fi.fault_point("fleet.tier_route", rid=req.rid,
+                                mode=self._mode)
+        eligible = self._intake_replicas()
+        if not eligible:
             if telemetry.enabled():
                 _routed_counter("held").inc()
             return None
@@ -308,11 +580,19 @@ class ReplicaFleet:
         reason = reason_override or "least_loaded"
         if req.session is not None and reason_override is None:
             home = self._session_home.get(req.session)
-            if home is not None and self.replicas[home].status == ReplicaStatus.HEALTHY:
-                rep = self.replicas[home]
-                reason = "affinity"
+            if home is not None:
+                cand = self.replicas[home]
+                if cand.status == ReplicaStatus.HEALTHY and cand in eligible:
+                    rep = cand
+                    reason = "affinity"
+        if rep is None and reason_override is None:
+            owner = self._prefix_owner_for(req, eligible)
+            if owner is not None:
+                rep = owner
+                reason = "prefix"
+                self.prefix_routed_total += 1
         if rep is None:
-            rep = min(healthy, key=lambda r: (self._score(r), r.idx))
+            rep = min(eligible, key=lambda r: (self._score(r), r.idx))
         if req.session is not None:
             self._session_home[req.session] = rep.idx
             self._session_home.move_to_end(req.session)
@@ -444,6 +724,232 @@ class ReplicaFleet:
             # still can't route lands back in _pending, never on the floor
             self._redispatch(req, reason="requeued")
 
+    # ---- fleet-global prefix routing ----
+    def _prefix_owner_for(self, req: Request,
+                          eligible: List[_Replica]) -> Optional[_Replica]:
+        """Longest-match walk of the fleet-global digest→owner map: route
+        a prefix-sharing request to the replica already HOLDING the chain
+        (its local retained index turns the hit into skipped prefill).
+        Owners that died or fell out of the intake set are skipped — the
+        map is a routing hint, never a correctness surface (the replica's
+        own index still validates the chain on arrival)."""
+        if not self._prefix_owner:
+            return None
+        bs = self.replicas[0].engine.block_size
+        # only pages a server could actually have committed: the last
+        # token is never pre-committed (see scheduler._kv_committed), so
+        # a whole-prompt key can exist only via a harvested completion
+        keys = prefix_chain_keys(req.prompt, bs)
+        for key in reversed(keys):
+            idx = self._prefix_owner.get(key)
+            if idx is None:
+                continue
+            cand = self.replicas[idx]
+            if cand.status == ReplicaStatus.HEALTHY and cand in eligible:
+                self._prefix_owner.move_to_end(key)
+                return cand
+        return None
+
+    def _record_prefix_owner(self, rep: _Replica, req: Request) -> None:
+        """Publish `rep` as the owner of every chain digest the request
+        registered locally (bounded LRU — eviction only loses a routing
+        hint)."""
+        reg = getattr(req, "_registered_pages", 0)
+        if reg <= 0:
+            return
+        bs = rep.engine.block_size
+        tokens = (list(req.prompt) + list(req.generated))[: reg * bs]
+        for key in prefix_chain_keys(tokens, bs):
+            self._prefix_owner[key] = rep.idx
+            self._prefix_owner.move_to_end(key)
+        while len(self._prefix_owner) > self.prefix_owner_cache_size:
+            self._prefix_owner.popitem(last=False)
+
+    def invalidate_prefix(self) -> int:
+        """Fleet-wide hot-swap broadcast: drop the router's digest→owner
+        map AND every live replica's local prefix index in one call —
+        after a weight swap begins, no request may be routed toward (or
+        served from) a chain computed under the old parameters. Returns
+        total local entries dropped."""
+        self._prefix_owner.clear()
+        dropped = 0
+        for rep in self.replicas:
+            if rep.status != ReplicaStatus.DOWN:
+                dropped += rep.engine.pool.invalidate_prefix()
+        return dropped
+
+    # ---- KV migration (prefill → decode handoff) ----
+    def _advance_resplit(self) -> None:
+        """Recovery re-split, one replica at a time: the head of the
+        queue drains its decode-phase backlog to the decode tier first;
+        only when it is clean does the next prefill replica start
+        migrating (the PR 11 rollout discipline applied to pages)."""
+        if self._resplit is None:
+            return
+        while self._resplit:
+            head = self.replicas[self._resplit[0]]
+            if head.status != ReplicaStatus.DOWN and any(
+                req.cursor >= len(req.prompt) and not req.done
+                for req in head.sched.running
+            ):
+                return  # head still holds decode-phase work — keep draining it
+            self._resplit.pop(0)
+        self._resplit = None
+
+    def _decode_target(self, n_pages: int) -> Optional[_Replica]:
+        """Least-loaded HEALTHY decode replica with a free slot and room
+        for the migrating pages; None defers the migration (the request
+        keeps decoding on its prefill replica — correct, just not
+        disaggregated)."""
+        cands = [
+            r for r in self.tier_replicas("decode")
+            if r.status == ReplicaStatus.HEALTHY
+            and not r.draining_for_swap
+            and len(r.sched.running) < r.sched.max_running
+            and r.engine.pool.available() >= n_pages
+        ]
+        if not cands:
+            return None
+        return min(cands, key=lambda r: (self._score(r), r.idx))
+
+    def _migrate_ready(self) -> None:
+        """Move every prefill-complete request from the prefill tier to a
+        decode replica. Runs only on the disaggregated rung; during a
+        re-split only the rollout head migrates (one replica at a time)."""
+        if not self.tiered or self._mode != "disaggregated":
+            return
+        sources = [
+            r for r in self.tier_replicas("prefill")
+            if r.status != ReplicaStatus.DOWN
+        ]
+        if self._resplit is not None:
+            sources = [r for r in sources if r.idx == self._resplit[0]]
+        for src in sources:
+            for req in list(src.sched.running):
+                # prefill-complete means the CURRENT prompt (which folds
+                # recomputed tokens after a resume) is fully consumed
+                if req.done or req.cursor < len(req.prompt):
+                    continue
+                if (self._migrate_fallback_counts.get(req.rid, 0)
+                        >= _MIGRATE_FALLBACK_CAP):
+                    # perma-faulted site: stop burning recomputes — this
+                    # request finishes monolithically on its prefill
+                    # replica (per-request degradation, not fleet-wide)
+                    continue
+                dst = self._decode_target(len(req.pages))
+                if dst is None:
+                    self.migration_deferred += 1
+                    if telemetry.enabled():
+                        _migration_counter("deferred").inc()
+                    continue
+                try:
+                    self._migrate_request(src, dst, req)
+                except _fi.FaultInjected:
+                    self._migration_fallback(src, req, "fault")
+                except ValueError:
+                    # lossy-direction conversion (int8 source → f32
+                    # decode): the pages cannot move losslessly, so the
+                    # request recomputes on the decode side instead
+                    self._migration_fallback(src, req, "lossy")
+                except Exception:
+                    # the invariant the chaos tests pin: an UNEXPECTED
+                    # migration error still never loses the request —
+                    # it is accounted as a failure (perf_gate gates this
+                    # at zero) and recovered through the same fallback
+                    self.migration_failures += 1
+                    if telemetry.enabled():
+                        _migration_counter("failed").inc()
+                    self._migration_fallback(src, req, "error")
+
+    def _migrate_request(self, src: _Replica, dst: _Replica,
+                         req: Request) -> None:
+        """The handoff itself: export the request's pages from the source
+        pool, convert to the destination's KV dtype (f32→int8 quantizes
+        with the EXACT quantize-on-write math, so migrated pages are
+        byte-identical to locally-written ones), CRC every page, import
+        into freshly allocated destination pages, read back and re-verify
+        — only then does ownership commit. Any fault/CRC mismatch before
+        commit leaves the source untouched and falls back to
+        recompute-on-resume; a torn page can never serve attention."""
+        t0 = self.clock()
+        site = f"fleet.kv_migrate.{src.idx}.{dst.idx}"
+        _fi.fault_point(site, rid=req.rid, pages=len(req.pages))
+        payload = _kvc.export_pages(src.engine.pool, req.pages)
+        payload = _kvc.convert_payload(payload, dst.engine.pool.kv_dtype)
+        crcs = _kvc.payload_page_crcs(payload)
+        spec = _fi.corrupt_value(site)
+        if spec is not None:
+            # deterministic torn-transfer: flip one byte in flight; the
+            # readback CRC below MUST catch it (the test pins that)
+            _kvc.corrupt_payload(payload, seed=f"{spec.arg}:{spec.fired}")
+        try:
+            new_pages = dst.engine.pool.alloc(len(req.pages))
+        except PoolExhausted:
+            self.migration_deferred += 1
+            if telemetry.enabled():
+                _migration_counter("deferred").inc()
+            return
+        _kvc.import_pages(dst.engine.pool, new_pages, payload)
+        readback = _kvc.export_pages(dst.engine.pool, new_pages)
+        if _kvc.payload_page_crcs(readback) != crcs:
+            dst.engine.pool.free(new_pages, retain=False)
+            self.migration_crc_rejects += 1
+            if telemetry.enabled():
+                _migration_counter("fallback_crc").inc()
+            self._migration_fallback(src, req, "crc")
+            return
+        # ---- commit: single ownership transfer, no partial state ----
+        src.sched.running.remove(req)
+        # the source RETAINS its copy under the prefix index: the chain
+        # stays shareable for future prefix-routed intake on this replica
+        # (dropping it would make every migration a fleet-wide cache miss)
+        src.engine.pool.free(req.pages, retain=True)
+        self._record_prefix_owner(src, req)
+        req.pages = new_pages
+        # destination registers its own chain incrementally from scratch
+        req._registered_pages = 0
+        req._chain_digest = b""
+        dst.sched.adopt_running(req)
+        self.migrations_total += 1
+        self.migrated_pages_total += len(new_pages)
+        self.migration_wall_s += self.clock() - t0
+        if telemetry.enabled():
+            _migration_counter("completed").inc()
+            src.sched._sync_gauges()
+        if _rt.enabled() and _rt.sampled(req.rid):
+            _rt.record_event("request", "kv_migrate", t=self.clock(),
+                             rid=req.rid, src=src.idx, dst=dst.idx,
+                             pages=len(new_pages))
+
+    def _migration_fallback(self, src: _Replica, req: Request,
+                            why: str) -> None:
+        """Recompute-on-resume: the migration never committed, so the
+        request is still wholly owned by the source — strip its pages
+        (retain=False: a possibly-torn chain must NOT enter the prefix
+        index) and push it back through the normal re-dispatch path as a
+        fresh prefill. Identical to pool-pressure preemption, which is
+        what makes it byte-safe: decode restarts from the full recomputed
+        context, so output ids cannot diverge."""
+        if req in src.sched.running:
+            src.sched.running.remove(req)
+        if req.pages:
+            src.engine.pool.free(req.pages, retain=False)
+            req.pages = []
+        src.sched._reset_for_resume(req)
+        req.preemptions += 1
+        self.migration_fallbacks += 1
+        self._migrate_fallback_counts[req.rid] = (
+            self._migrate_fallback_counts.get(req.rid, 0) + 1
+        )
+        if telemetry.enabled():
+            if why != "crc":  # crc path already counted its own event
+                _migration_counter("fallback_fault").inc()
+            src.sched._sync_gauges()
+        if req.trace is not None:
+            req.trace.phase("preempt", self.clock(),
+                            cause="migration_" + why)
+        self._redispatch(req, reason="migration_fallback")
+
     # ---- health ----
     def _note_failure(self, rep: _Replica, reason: str) -> None:
         rep.consecutive_failures += 1
@@ -467,6 +973,17 @@ class ReplicaFleet:
         for s, idx in list(self._session_home.items()):
             if idx == rep.idx:
                 del self._session_home[s]
+        # prefix-ownership failover: a dead replica's chains are
+        # unreachable — drop its entries so prefix-sharing intake stops
+        # routing toward pages nobody can serve (survivors re-earn
+        # ownership as they commit the chains themselves)
+        for key, idx in list(self._prefix_owner.items()):
+            if idx == rep.idx:
+                del self._prefix_owner[key]
+        # the ladder moves BEFORE evacuation re-dispatch: if this kill
+        # took the last replica of a tier, the evacuated requests must
+        # route under the NEW intake tier, not the one that just died
+        self._update_mode()
         evacuated = rep.sched.evacuate()
         self.evacuated_total += len(evacuated)
         if telemetry.enabled() and evacuated:
@@ -490,6 +1007,10 @@ class ReplicaFleet:
         happens inside step(); the fleet stays serving throughout."""
         if self._swap is not None:
             raise RuntimeError("a weight swap is already in progress")
+        # fleet-wide invalidation broadcast FIRST: from this instant no
+        # request may be prefix-routed toward a chain that will be
+        # recomputed under new weights mid-rollout
+        self.invalidate_prefix()
         self._swap = {
             "source": source,
             "state_key": state_key,
@@ -620,8 +1141,17 @@ class ReplicaFleet:
         if self._pending and all(
             r.status == ReplicaStatus.DOWN for r in self.replicas
         ):
+            detail = ""
+            if self.tiered:
+                detail = " " + " ".join(
+                    f"[{t}: " + " ".join(
+                        f"{s}={n}" for s, n in counts.items() if n
+                    ) + "]"
+                    for t, counts in self.tier_health().items()
+                )
             raise NoHealthyReplica(
-                f"{len(self._pending)} request(s) held with every replica down"
+                f"{len(self._pending)} request(s) held with every replica "
+                f"down{detail}"
             )
         produced = 0
         for rep in self.replicas:
@@ -666,8 +1196,20 @@ class ReplicaFleet:
             rep.consecutive_failures = 0
             if rep.status == ReplicaStatus.DRAINING and not rep.draining_for_swap:
                 rep.status = ReplicaStatus.HEALTHY  # circuit closes
+        # the handoff runs AFTER the tier stepped (a request finishes its
+        # prefill inside this very tick) and BEFORE harvest, so a
+        # one-token request still migrates before its terminal record
+        self._advance_resplit()
+        self._migrate_ready()
         for rep in self.replicas:
             if rep.sched.finished:
+                for req in rep.sched.finished:
+                    self._migrate_fallback_counts.pop(req.rid, None)
+                    # completion publishes chain ownership fleet-wide:
+                    # only intake-eligible replicas can SERVE a prefix
+                    # hit, so only they earn map entries
+                    if rep in self._intake_replicas():
+                        self._record_prefix_owner(rep, req)
                 self.finished.extend(rep.sched.finished)
                 rep.sched.finished = []
         if telemetry.enabled():
@@ -675,17 +1217,28 @@ class ReplicaFleet:
         return produced
 
     def _sync_gauges(self) -> None:
-        counts = {s: 0 for s in ReplicaStatus.ALL}
         for rep in self.replicas:
-            counts[rep.status] += 1
             _queue_gauge(rep.idx, "running").set(len(rep.sched.running))
             _queue_gauge(rep.idx, "waiting").set(len(rep.sched.waiting))
-        for s, n in counts.items():
-            _replicas_gauge(s).set(n)
-        _metrics.gauge(
-            "paddle_tpu_fleet_held_requests",
-            "requests held at the fleet for want of a healthy replica",
-        ).set(len(self._pending))
+        if self.tiered:
+            # per-tier breakdown: a dead decode tier with a live prefill
+            # tier must read as DEGRADED (mode gauge: monolithic), never
+            # as a fleet-wide outage
+            for t in ("prefill", "decode"):
+                counts = {s: 0 for s in ReplicaStatus.ALL}
+                for rep in self.tier_replicas(t):
+                    counts[rep.status] += 1
+                for s, n in counts.items():
+                    _replicas_gauge(s, t).set(n)
+            for m in FLEET_MODES:
+                _mode_gauge(m).set(1 if m == self._mode else 0)
+        else:
+            counts = {s: 0 for s in ReplicaStatus.ALL}
+            for rep in self.replicas:
+                counts[rep.status] += 1
+            for s, n in counts.items():
+                _replicas_gauge(s).set(n)
+        _held_gauge(self._intake_tier() or "none").set(len(self._pending))
 
     # ---- convenience: batch greedy generation through the fleet ----
     def generate(self, prompts, max_new_tokens=16) -> List[List[int]]:
@@ -793,6 +1346,13 @@ def fleet_replay(
         "evacuated": fleet.evacuated_total,
         "replica_failures": fleet.failures_total,
         "swaps_completed": fleet.swaps_completed,
+        # disaggregation accounting (all zero on an untiered fleet)
+        "migrations": fleet.migrations_total,
+        "migration_fallbacks": fleet.migration_fallbacks,
+        "migration_failures": fleet.migration_failures,
+        "migration_deferred": fleet.migration_deferred,
+        "crc_rejects": fleet.migration_crc_rejects,
+        "prefix_routed": fleet.prefix_routed_total,
     }
     out.update(percentiles("ttft_ms", [t * 1000 for t in ttfts]))
     out.update(percentiles("tpot_ms", [iv * 1000 for iv, _ in itls]))

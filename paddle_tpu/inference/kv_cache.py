@@ -45,11 +45,14 @@ Round 17 — prefix sharing + int8 storage:
 from __future__ import annotations
 
 import math
+import random
+import zlib
 from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import hashlib
 
+import numpy as np
 from jax import numpy as jnp
 
 from .. import telemetry
@@ -63,6 +66,11 @@ __all__ = [
     "TRASH_PAGE",
     "chain_extend",
     "prefix_chain_keys",
+    "export_pages",
+    "convert_payload",
+    "import_pages",
+    "payload_page_crcs",
+    "corrupt_payload",
 ]
 
 TRASH_PAGE = 0  # reserved: block-table padding + padded-position writes
@@ -569,3 +577,113 @@ class BlockPool:
         """One sequence's block-table row padded with the trash page."""
         row = list(pages)[:n_cols]
         return row + [TRASH_PAGE] * (n_cols - len(row))
+
+
+# ---------------------------------------------------------------------------
+# cross-pool page migration (round 20: disaggregated prefill/decode fleet)
+# ---------------------------------------------------------------------------
+#
+# A migration moves one request's pages between two BlockPools (prefill
+# replica -> decode replica) as a host-side payload: gather the block-table
+# range out of the source pool's pytree, optionally re-encode for the
+# destination's kv_dtype, scatter into freshly allocated destination pages.
+# Integrity is per-page CRC32 over every byte the payload writes: the
+# sender CRCs the CONVERTED payload, the receiver re-exports what actually
+# landed and compares — a torn or corrupted handoff is detected before a
+# single read, and the caller falls back to recompute-on-resume.
+
+
+def export_pages(pool: BlockPool, pages: Sequence[int]) -> Dict:
+    """Gather `pages`' K/V (plus scale planes on a quantized pool) into a
+    host payload for cross-pool migration. Page order is preserved — entry
+    j of every plane is the content of pages[j]."""
+    idx = jnp.asarray(list(pages), jnp.int32)
+    payload: Dict = {
+        "kv_dtype": pool.kv_dtype,
+        "k": [np.asarray(jnp.take(a, idx, axis=0)) for a in pool.k_pages],
+        "v": [np.asarray(jnp.take(a, idx, axis=0)) for a in pool.v_pages],
+    }
+    if pool.quantized:
+        payload["k_scale"] = [np.asarray(jnp.take(a, idx, axis=0)) for a in pool.k_scales]
+        payload["v_scale"] = [np.asarray(jnp.take(a, idx, axis=0)) for a in pool.v_scales]
+    return payload
+
+
+def convert_payload(payload: Dict, kv_dtype: Optional[str]) -> Dict:
+    """Re-encode a migration payload for a destination pool storing
+    `kv_dtype`. f32 -> int8 quantizes every slot with the absmax observer
+    rule (quantization/observers — the SAME math the destination's own
+    write path runs), so the migrated pages are byte-identical to what the
+    decode replica would have written had it prefilled the tokens itself.
+    int8 -> f32 is refused: dequantization is lossy, and the exactness
+    contract says recompute instead of silently degrading."""
+    src = payload["kv_dtype"]
+    if src == kv_dtype:
+        return payload
+    if src is None and kv_dtype == "int8":
+        from ..quantization.observers import absmax_scale, quantize_absmax
+
+        out: Dict = {"kv_dtype": "int8", "k": [], "v": [], "k_scale": [], "v_scale": []}
+        for plane, scale_key in (("k", "k_scale"), ("v", "v_scale")):
+            for arr in payload[plane]:
+                x = jnp.asarray(arr)
+                sc = absmax_scale(x, axis=-1)  # [n, bs, Hkv] f32
+                out[plane].append(np.asarray(quantize_absmax(x, sc[..., None])))
+                out[scale_key].append(np.asarray(sc))
+        return out
+    raise ValueError(
+        f"unsupported KV migration {src!r} -> {kv_dtype!r} "
+        "(int8 pages cannot re-expand losslessly; recompute instead)"
+    )
+
+
+def payload_page_crcs(payload: Dict) -> List[int]:
+    """Per-page CRC32 over every byte the payload writes into the
+    destination (K + V + scale planes across all layers) — computed on the
+    converted payload before import and again on a readback export after,
+    so a torn migration can never serve a corrupt page."""
+    n = payload["k"][0].shape[0] if payload["k"] else 0
+    crcs: List[int] = []
+    for j in range(n):
+        c = 0
+        for key in ("k", "v", "k_scale", "v_scale"):
+            for arr in payload.get(key) or ():
+                c = zlib.crc32(np.ascontiguousarray(arr[j]).tobytes(), c)
+        crcs.append(c)
+    return crcs
+
+
+def import_pages(pool: BlockPool, pages: Sequence[int], payload: Dict) -> None:
+    """Scatter a (converted) payload into already-allocated `pages` of
+    `pool`. The payload's kv_dtype must match the pool's — convert first."""
+    if payload["kv_dtype"] != pool.kv_dtype:
+        raise ValueError(
+            f"payload kv_dtype {payload['kv_dtype']!r} does not match the "
+            f"destination pool's {pool.kv_dtype!r} — convert_payload first"
+        )
+    idx = jnp.asarray(list(pages), jnp.int32)
+    for layer in range(pool.num_layers):
+        pool.k_pages[layer] = pool.k_pages[layer].at[idx].set(
+            jnp.asarray(payload["k"][layer], pool.dtype))
+        pool.v_pages[layer] = pool.v_pages[layer].at[idx].set(
+            jnp.asarray(payload["v"][layer], pool.dtype))
+        if pool.quantized:
+            pool.k_scales[layer] = pool.k_scales[layer].at[idx].set(
+                jnp.asarray(payload["k_scale"][layer], jnp.float32))
+            pool.v_scales[layer] = pool.v_scales[layer].at[idx].set(
+                jnp.asarray(payload["v_scale"][layer], jnp.float32))
+
+
+def corrupt_payload(payload: Dict, seed=0) -> Dict:
+    """Flip ONE deterministic byte in the payload in place (the torn-write
+    / bit-rot shape a mid-migration failure produces) — the in-memory
+    analog of fault_injection.corrupt_file, applied by the fleet when a
+    CORRUPT spec claims the kv_migrate site AFTER the source CRC was
+    recorded. x ^ 0xFF never equals x, so detection is guaranteed."""
+    rng = random.Random(seed)
+    arr = np.ascontiguousarray(payload["k"][0])
+    raw = bytearray(arr.tobytes())
+    pos = rng.randrange(len(raw))
+    raw[pos] ^= 0xFF
+    payload["k"][0] = np.frombuffer(bytes(raw), dtype=arr.dtype).reshape(arr.shape)
+    return payload

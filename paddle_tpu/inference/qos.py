@@ -115,6 +115,12 @@ class BrownoutConfig:
     capped_max_new: int = 8
     # priority >= this is the "low class" steps 2/3 act on
     low_priority: int = 2
+    # pressure FLOOR applied while the fleet reports itself degraded (a
+    # tiered fleet off its disaggregated rung is running double duty on
+    # half the chips — the ladder should lean pessimistic before queues
+    # actually back up). 0.0 = off (default: degradation alone never
+    # escalates the ladder).
+    degraded_pressure_floor: float = 0.0
 
     def __post_init__(self):
         if not (0.0 < self.exit_pressure < self.enter_pressure <= 1.0):
@@ -123,6 +129,10 @@ class BrownoutConfig:
             )
         if self.capped_max_new < 1:
             raise ValueError("BrownoutConfig.capped_max_new must be >= 1")
+        if not (0.0 <= self.degraded_pressure_floor <= 1.0):
+            raise ValueError(
+                "BrownoutConfig.degraded_pressure_floor must be in [0, 1]"
+            )
 
 
 @dataclass
@@ -241,6 +251,10 @@ class QoSPolicy:
         # slo_breakdown() is too heavy to recompute per tick, so the
         # fleet/bench feed it at their own cadence
         self._slo_burn = 0.0
+        # externally-fed fleet degradation flag (a tiered fleet off its
+        # disaggregated rung sets this); floors pressure at
+        # brownout.degraded_pressure_floor while held
+        self.degraded = False
         self.last_pressure = 0.0
 
     # ---- token-debt accounting ----
@@ -343,15 +357,27 @@ class QoSPolicy:
         requests over budget, e.g. from slo_breakdown()['slo'])."""
         self._slo_burn = min(1.0, max(0.0, float(frac)))
 
+    def set_degraded(self, flag: bool) -> None:
+        """Fleet hook: a tiered fleet off its disaggregated rung (decode
+        or prefill tier dead — half the chips doing both phases) marks
+        the shared policy degraded; while held, pressure readings are
+        floored at ``brownout.degraded_pressure_floor`` so the ladder
+        escalates BEFORE the thinner fleet's queues actually back up.
+        Cleared automatically when the fleet re-splits."""
+        self.degraded = bool(flag)
+
     def pressure(self, pool_frac: float, queue_frac: float) -> float:
         """Composite pressure: the WORST of pool occupancy, queue depth
         (vs max_waiting), and fed SLO burn — any one resource saturating
-        is overload, averaging would hide it."""
+        is overload, averaging would hide it. A degraded fleet floors
+        the reading (see ``set_degraded``)."""
         p = max(
             min(1.0, max(0.0, pool_frac)),
             min(1.0, max(0.0, queue_frac)),
             self._slo_burn,
         )
+        if self.degraded:
+            p = max(p, self.config.brownout.degraded_pressure_floor)
         self.last_pressure = p
         return p
 

@@ -279,7 +279,8 @@ class ContinuousBatchingScheduler:
                  clock: Callable[[], float] = time.monotonic,
                  prefix_cache: bool = True,
                  spec_decode: Optional[SpecDecodeConfig] = None,
-                 qos: Optional[QoSPolicy] = None):
+                 qos: Optional[QoSPolicy] = None,
+                 admission_mode: str = "auto"):
         self.engine = engine
         self.max_running = int(max_running or engine.max_batch)
         if self.max_running > engine.max_batch:
@@ -288,6 +289,15 @@ class ContinuousBatchingScheduler:
         self.clock = clock
         self.prefix_cache = bool(prefix_cache)
         self.spec = spec_decode
+        # "auto" (default): idle-scheduler admissions run a bucketed prefill
+        # program, busy ones stream. "streamed": NEVER bucketed — the
+        # disaggregated fleet's decode tier runs this, so it serves streamed
+        # prefill (tier-degradation intake) without ever compiling a prefill
+        # bucket, keeping its compile family decode-only
+        if admission_mode not in ("auto", "streamed"):
+            raise ValueError(
+                f"admission_mode {admission_mode!r} is not 'auto' or 'streamed'")
+        self.admission_mode = admission_mode
         # shared across a fleet's replicas: buckets/debt/ladder are
         # fleet-wide state, the scheduler only consults it
         self.qos = qos
@@ -590,6 +600,20 @@ class ContinuousBatchingScheduler:
             self._sync_gauges()
         return evacuated
 
+    def adopt_running(self, req: Request) -> None:
+        """Attach an in-flight request whose KV pages are ALREADY resident
+        in this scheduler's pool (the fleet's prefill->decode KV migration):
+        no re-validation, no clock re-stamping — the request keeps decoding
+        exactly where it left off. The caller owns the page handoff (pages
+        allocated here, CRC-verified) and the prefix-registration reset so
+        this pool republishes the chain itself."""
+        if len(self.running) >= self.max_running:
+            raise RuntimeError(
+                f"adopt_running: no free decode slot for request {req.rid}")
+        self.running.append(req)
+        if telemetry.enabled():
+            self._sync_gauges()
+
     def _emit_token(self, req: Request, logits: np.ndarray, now: float) -> None:
         token = int(np.argmax(logits))
         req.generated.append(token)
@@ -658,7 +682,7 @@ class ContinuousBatchingScheduler:
             if n_shareable > 0:
                 keys = prefix_chain_keys(req.prompt, pool.block_size)[:n_shareable]
                 shared = pool.acquire_prefix(keys, owner=req.rid)
-        if not self.running and not shared:
+        if not self.running and not shared and self.admission_mode == "auto":
             need = pool.blocks_for_tokens(len(req.prompt) + 1)
             if need <= pool.available():
                 self.waiting.pop(idx)

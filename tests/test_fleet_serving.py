@@ -136,7 +136,7 @@ def test_replica_kill_mid_decode_redispatches_with_exact_outputs(tiny_model):
     # the survivors returned every page
     assert fleet.replicas[0].engine.pool.used() == 0
     fam = tm.default_registry().get("paddle_tpu_fleet_replicas")
-    assert fam.labels(state="down").value == 1
+    assert fam.labels(state="down", tier="none").value == 1
 
 
 def test_one_failure_opens_circuit_halfway_then_recovers(tiny_model):
@@ -389,7 +389,7 @@ def test_single_replica_swap_holds_traffic_no_loss(tiny_model):
     assert got[0] == _greedy_oracle(tiny_model, [1, 2, 3, 4], 6)
     assert got[1] == _greedy_oracle(tiny_model, [5, 6, 7], 3)
     held = tm.default_registry().get("paddle_tpu_fleet_held_requests")
-    assert held is not None and held.value == 0
+    assert held is not None and held.labels(tier="none").value == 0
 
 
 def test_fleet_cancel_harvests_immediately(tiny_model):
@@ -640,16 +640,28 @@ def test_fleet_bench_child_record():
         BENCH_FLEET_MAX_SEQ="64", BENCH_FLEET_BLOCK="8",
         BENCH_FLEET_BATCH="4", BENCH_FLEET_REQUESTS="10",
         BENCH_FLEET_REPLICAS="1,2",
+        BENCH_FLEET_BURST_REQUESTS="8",
         PADDLE_TPU_TELEMETRY="1",
     )
     r = subprocess.run([sys.executable, bench], env=env, capture_output=True,
-                       text=True, timeout=240)
+                       text=True, timeout=360)
     assert r.returncode == 0, r.stderr[-2000:]
     rec = json.loads(r.stdout.strip().splitlines()[-1])
     for k in ("n_replicas", "n_requests", "tokens_per_sec", "p99_tpot_ms",
               "p99_tpot_swap_ms", "scaling_vs_1replica", "swap_blip_ratio",
-              "replicas", "fleet_dims", "attribution"):
+              "replicas", "fleet_dims", "attribution",
+              # round 21: the disaggregated A/B fields perf_gate gates
+              "p99_ttft_burst_ms", "disagg_p99_tpot_ms",
+              "ttft_burst_improvement", "fleet_prefix_hit_rate",
+              "local_prefix_hit_rate", "migration_failures",
+              "migration_cost_per_page_ms", "disagg_dims"):
         assert k in rec, k
+    # the A/B's robustness bars: zero integrity failures, handoffs ran,
+    # fleet-global prefix routing at least matches replica-local serving
+    assert rec["migration_failures"] == 0
+    assert rec["migrations"] >= 1
+    assert rec["fleet_prefix_hit_rate"] >= rec["local_prefix_hit_rate"]
+    assert rec["disagg_dims"]["prefill_replicas"] == 1
     assert rec["n_replicas"] == 2
     assert rec["fleet_dims"]["hidden"] == 64  # shrunken run records its dims
     widest = rec["replicas"]["2"]
@@ -664,3 +676,338 @@ def test_fleet_bench_child_record():
     assert abs(bd["consistency"]["mean"] - 1.0) <= 0.05
     assert bd["swap_windows"] >= 1
     assert bd["causes"].get("evacuation", 0) >= 1
+
+
+# ---------------------------------------------------------------------------
+# round 20: disaggregated prefill/decode tiers — KV migration, fleet-global
+# prefix routing, degradation ladder
+# ---------------------------------------------------------------------------
+
+def _disagg(model, *, decode_dtype="int8", **kw):
+    """1 prefill (full-precision) + 1 decode replica fleet, shared tiny
+    geometry; decode_dtype=None keeps the decode tier full-precision."""
+    dc = _engine(model) if decode_dtype is None else _engine(
+        model, kv_dtype=decode_dtype)
+    return ReplicaFleet([_engine(model), dc],
+                        tiers=["prefill", "decode"], **kw)
+
+
+_PROMPTS = [[1, 2, 3, 4, 5], [7, 8, 9, 10, 11, 12, 13],
+            [2, 4, 6, 8, 10, 12, 14, 16, 18]]
+
+
+def _oracle_all(model, n=10):
+    return [_greedy_oracle(model, p, n) for p in _PROMPTS]
+
+
+def test_disagg_migrates_every_request_exactly(tiny_model):
+    """The happy path: every request prefills on the prefill tier, its
+    pages migrate, and decode finishes on the decode tier — outputs
+    byte-identical to a monolithic oracle (same-dtype tiers make the
+    handoff a pure page move, so exactness is unconditional), source
+    pages retained (not leaked) behind the prefix index."""
+    fleet = _disagg(tiny_model, decode_dtype=None)
+    out = fleet.generate(_PROMPTS, max_new_tokens=10)
+    assert out == _oracle_all(tiny_model)
+    assert fleet.migrations_total == len(_PROMPTS)
+    assert fleet.migration_failures == 0
+    assert fleet.migration_fallbacks == 0
+    assert fleet.migrated_pages_total > 0
+    pf, dc = fleet.replicas
+    # both pools returned every page (retained pages are reclaimable)
+    assert pf.engine.pool.used() == 0
+    assert dc.engine.pool.used() == 0
+
+
+def test_disagg_int8_decode_tier_is_deterministic(tiny_model):
+    """Cross-dtype tiers (f32 prefill → int8 decode): requantization at
+    the migrate boundary means outputs may differ from an f32 oracle by
+    quantization noise, but the pipeline is DETERMINISTIC — two
+    identical runs are byte-identical — and every handoff completes
+    cleanly. (Requant math exactness is pinned one test down.)"""
+    out1 = _disagg(tiny_model).generate(_PROMPTS, max_new_tokens=10)
+    fleet = _disagg(tiny_model)
+    out2 = fleet.generate(_PROMPTS, max_new_tokens=10)
+    assert out1 == out2
+    assert fleet.migrations_total == len(_PROMPTS)
+    assert fleet.migration_failures == 0
+
+
+def test_migrated_int8_pages_match_quantize_on_write(tiny_model):
+    """Requantization at migrate must be byte-identical to the decode
+    pool's own quantize-on-write math: export f32 pages, convert, and
+    check the int8 planes equal quantize_absmax(absmax_scale(x)) of the
+    source — plus a CRC round-trip through import/export."""
+    from paddle_tpu.inference import kv_cache as kvc
+    from paddle_tpu.quantization.observers import absmax_scale, quantize_absmax
+    import jax.numpy as jnp
+
+    eng_f32 = _engine(tiny_model)
+    eng_i8 = _engine(tiny_model, kv_dtype="int8")
+    # put real KV into the f32 pool by running a prompt
+    sched_out = eng_f32.pool
+    fleet = ReplicaFleet([eng_f32])
+    fleet.generate([_PROMPTS[2]], max_new_tokens=2)
+    # the finished request retained its pages in the index — steal them
+    pages = list(sched_out._retained.keys())[:1] or [1]
+    payload = kvc.export_pages(eng_f32.pool, pages)
+    conv = kvc.convert_payload(payload, "int8")
+    for li in range(len(payload["k"])):
+        src = jnp.asarray(payload["k"][li])
+        sc = absmax_scale(src, axis=-1)
+        want = np.asarray(quantize_absmax(src, sc[..., None]))
+        assert np.array_equal(conv["k"][li], want)
+        assert np.allclose(conv["k_scale"][li], np.asarray(sc))
+    crcs = kvc.payload_page_crcs(conv)
+    new_pages = eng_i8.pool.alloc(len(pages))
+    kvc.import_pages(eng_i8.pool, new_pages, conv)
+    back = kvc.export_pages(eng_i8.pool, new_pages)
+    assert kvc.payload_page_crcs(back) == crcs
+    # lossy direction is refused, never silently dequantized
+    with pytest.raises(ValueError):
+        kvc.convert_payload(conv, "f32")
+
+
+@pytest.mark.parametrize("action,times", [
+    ("fail", 1),        # torn handoff before export
+    ("corrupt", 1),     # byte flipped in flight — CRC must catch it
+    ("fail", None),     # perma-faulted site — fallback cap, then monolithic
+])
+def test_migration_chaos_recovers_byte_identical(tiny_model, action, times):
+    """ISSUE acceptance: a FaultPlan kill mid-migration at the migrate
+    site → every request completes byte-identical to the no-fault oracle
+    via recompute-on-resume, zero lost/duplicated, no page leaked into
+    the destination pool, and migration_failures stays 0 (chaos is an
+    EXPECTED fault, not an accounting failure). Same-dtype tiers: the
+    exactness claim is the point here; the recompute fallback IS the
+    preemption path, whose byte-safety the scheduler suite pins."""
+    fi.install_plan(fi.FaultPlan().add(
+        "fleet.kv_migrate.*", action, times=times, arg=5))
+    fleet = _disagg(tiny_model, decode_dtype=None)
+    out = fleet.generate(_PROMPTS, max_new_tokens=10)
+    fi.clear_plan()
+    assert out == _oracle_all(tiny_model)
+    assert fleet.migration_failures == 0
+    assert fleet.migration_fallbacks >= 1
+    if action == "corrupt":
+        assert fleet.migration_crc_rejects >= 1
+    if times is None:
+        # perma-fault: capped requests finish monolithically on prefill
+        assert all(
+            n <= 2 for n in ([2] if not fleet._migrate_fallback_counts
+                             else fleet._migrate_fallback_counts.values()))
+        assert fleet.migrations_total == 0
+    pf, dc = fleet.replicas
+    assert pf.engine.pool.used() == 0
+    assert dc.engine.pool.used() == 0
+
+
+def test_tier_route_fault_site_raises_to_caller(tiny_model):
+    fleet = _disagg(tiny_model)
+    fi.install_plan(fi.FaultPlan().add("fleet.tier_route", "fail", times=1))
+    with pytest.raises(fi.FaultInjected):
+        fleet.submit(Request(rid=0, prompt=[1, 2], max_new_tokens=1))
+    fi.clear_plan()
+    fleet.submit(Request(rid=1, prompt=[1, 2], max_new_tokens=1))
+    while not fleet.idle():
+        fleet.step()
+    assert len(fleet.finished) == 1
+
+
+def test_decode_tier_death_degrades_to_monolithic(tiny_model):
+    """Dead decode tier + live prefill tier = DEGRADED, not down: mode
+    drops to monolithic, the prefill tier serves both phases, outputs
+    stay exact, and the replica gauge carries the tier label."""
+    fi.install_plan(fi.FaultPlan().add("fleet.replica_step.1", "fail",
+                                       times=None))
+    fleet = _disagg(tiny_model, breaker_threshold=1)
+    out = fleet.generate(_PROMPTS, max_new_tokens=10)
+    fi.clear_plan()
+    assert out == _oracle_all(tiny_model)
+    assert fleet.mode() == "monolithic"
+    assert fleet.replicas[1].status == ReplicaStatus.DOWN
+    fam = tm.default_registry().get("paddle_tpu_fleet_replicas")
+    assert fam.labels(state="down", tier="decode").value == 1
+    assert fam.labels(state="healthy", tier="prefill").value == 1
+    mode = tm.default_registry().get("paddle_tpu_fleet_mode")
+    assert mode.labels(mode="monolithic").value == 1
+    assert mode.labels(mode="disaggregated").value == 0
+
+
+def test_prefill_tier_death_streams_prefill_on_decode(tiny_model):
+    """Dead prefill tier: decode replicas accept streamed prefill — and
+    because their admission is streamed-only, NO prefill bucket is ever
+    compiled on the decode tier even while it serves whole requests."""
+    fi.install_plan(fi.FaultPlan().add("fleet.replica_step.0", "fail",
+                                       times=None))
+    fleet = _disagg(tiny_model, decode_dtype=None, breaker_threshold=1)
+    out = fleet.generate(_PROMPTS, max_new_tokens=10)
+    fi.clear_plan()
+    assert out == _oracle_all(tiny_model)
+    assert fleet.mode() == "streamed_prefill"
+    dc = fleet.replicas[1]
+    assert not any(k[0] == "prefill" for k in dc.engine._compiled)
+
+
+def test_revive_resplits_one_replica_at_a_time(tiny_model):
+    """Recovery rung: revive the dead decode tier mid-backlog — mode
+    returns to disaggregated, the re-split queue drains the prefill
+    replica's decode-phase backlog one replica at a time, and everything
+    still matches the oracle."""
+    fi.install_plan(fi.FaultPlan().add("fleet.replica_step.1", "fail",
+                                       times=None))
+    fleet = _disagg(tiny_model, decode_dtype=None, breaker_threshold=1)
+    reqs = [Request(rid=i, prompt=list(p), max_new_tokens=10)
+            for i, p in enumerate(_PROMPTS)]
+    for r in reqs:
+        fleet.submit(r)
+    # run monolithic until the first request finishes
+    while len(fleet.finished) < 1:
+        fleet.step()
+    assert fleet.mode() == "monolithic"
+    fi.clear_plan()
+    fleet.revive(1)
+    assert fleet.mode() == "disaggregated"
+    assert fleet._resplit == [0]  # the rollout queue armed
+    while not fleet.idle():
+        fleet.step()
+    assert fleet._resplit is None  # fully re-split
+    got = _outputs(fleet)
+    oracle = _oracle_all(tiny_model)
+    for i in range(len(_PROMPTS)):
+        assert got[i] == oracle[i], i
+    assert fleet.migration_failures == 0
+
+
+def test_per_tier_prewarm_zero_cross_tier_compiles(tiny_model):
+    """Satellite: prewarm warms each tier's OWN bucket family — the
+    decode tier compiles zero prefill buckets, and serving traffic after
+    prewarm triggers zero new compiles anywhere (ledger-verified)."""
+    from paddle_tpu import compile_cache as _cc
+    fleet = _disagg(tiny_model, decode_dtype=None)
+    fleet.prewarm()
+    pf, dc = fleet.replicas
+    assert any(k[0] == "prefill" for k in pf.engine._compiled)
+    assert any(k[0] == "decode" for k in pf.engine._compiled)
+    assert not any(k[0] == "prefill" for k in dc.engine._compiled)
+    assert any(k[0] == "decode" for k in dc.engine._compiled)
+    before = len([e for e in _cc.events()
+                  if e.get("origin") == "serving" and e["outcome"] == "miss"])
+    out = fleet.generate(_PROMPTS, max_new_tokens=10)
+    after = len([e for e in _cc.events()
+                 if e.get("origin") == "serving" and e["outcome"] == "miss"])
+    assert out == _oracle_all(tiny_model)
+    assert after == before  # fully warm: zero cross-tier (or any) compiles
+
+
+def test_fleet_prefix_owner_routes_to_chain_holder(tiny_model):
+    """Fleet-global prefix routing: after a request completes on one
+    replica, a sessionless request SHARING its prefix routes to that
+    replica (reason=prefix) and serves prompt pages from the retained
+    chain instead of recomputing them."""
+    fleet = ReplicaFleet([_engine(tiny_model), _engine(tiny_model)])
+    routed = tm.counter("paddle_tpu_fleet_routed_total", "", ("reason",))
+    prefix_before = routed.labels(reason="prefix").value
+    long_prompt = [1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15, 16, 17]
+    r0 = Request(rid=0, prompt=list(long_prompt), max_new_tokens=2)
+    fleet.submit(r0)
+    while not fleet.idle():
+        fleet.step()
+    assert fleet._prefix_owner  # ownership published at harvest
+    r1 = Request(rid=1, prompt=list(long_prompt), max_new_tokens=2)
+    fleet.submit(r1)
+    while not fleet.idle():
+        fleet.step()
+    assert routed.labels(reason="prefix").value == prefix_before + 1
+    assert fleet.prefix_routed_total == 1
+    assert r1.cached_tokens > 0  # the chain actually served pages
+    assert (r1.prompt[r1.prompt_len:] + list(r1.generated)
+            == _greedy_oracle(tiny_model, long_prompt, 2))
+
+
+def test_prefix_ownership_fails_over_on_replica_death(tiny_model):
+    """A dead replica's chain entries drop from the fleet map — prefix
+    intake must never route toward pages nobody can serve."""
+    fleet = ReplicaFleet([_engine(tiny_model), _engine(tiny_model)],
+                         breaker_threshold=1)
+    long_prompt = list(range(1, 18))
+    fleet.generate([long_prompt], max_new_tokens=2)
+    owner_idx = next(iter(fleet._prefix_owner.values()))
+    # a prefix-sharing request routes TO the owner — whose every step
+    # now faults, so the breaker kills it with the request in flight
+    fi.install_plan(fi.FaultPlan().add(
+        f"fleet.replica_step.{owner_idx}", "fail", times=None))
+    fleet.submit(Request(rid=5, prompt=list(long_prompt), max_new_tokens=2))
+    while not fleet.idle():
+        fleet.step()
+    fi.clear_plan()
+    assert fleet.replicas[owner_idx].status == ReplicaStatus.DOWN
+    assert owner_idx not in set(fleet._prefix_owner.values())
+    # the evacuated request still finished exactly on the survivor
+    got = _outputs(fleet)
+    assert got[5] == _greedy_oracle(tiny_model, long_prompt, 2)
+    # a NEW prefix-sharing request routes fine (least-loaded survivor)
+    out = fleet.generate([long_prompt], max_new_tokens=2)
+    assert out[0] == _greedy_oracle(tiny_model, long_prompt, 2)
+
+
+def test_hot_swap_invalidates_prefix_fleet_wide(tiny_model):
+    """request_swap broadcasts invalidation BEFORE the rollout starts:
+    the router's owner map and every replica's local index empty out —
+    no post-swap request can be routed toward old-weight K/V."""
+    eng0, eng1 = _engine(tiny_model), _engine(tiny_model)
+    fleet = ReplicaFleet([eng0, eng1])
+    fleet.generate([list(range(1, 18))], max_new_tokens=2)
+    assert fleet._prefix_owner
+    fleet.request_swap(dict(eng0.params))
+    assert not fleet._prefix_owner
+    assert len(eng0.pool._prefix) == 0 and len(eng1.pool._prefix) == 0
+    while not fleet.idle():
+        fleet.step()
+    assert eng0.weights_version == 1 and eng1.weights_version == 1
+
+
+def test_tiered_fleet_validation(tiny_model):
+    e = _engine(tiny_model)
+    with pytest.raises(ValueError, match="at least one prefill"):
+        ReplicaFleet([e, _engine(tiny_model)], tiers=["decode", "decode"])
+    with pytest.raises(ValueError, match="tiers has"):
+        ReplicaFleet([e], tiers=["prefill", "decode"])
+    with pytest.raises(ValueError, match="unknown tier"):
+        ReplicaFleet([e, _engine(tiny_model)], tiers=["prefill", "draft"])
+    with pytest.raises(ValueError, match="share KV geometry"):
+        ReplicaFleet(
+            [e, InferenceEngine(tiny_model, max_seq_len=32, block_size=8,
+                                max_batch=4)],
+            tiers=["prefill", "decode"])
+
+
+def test_all_down_tiered_reports_per_tier_detail(tiny_model):
+    fi.install_plan(
+        fi.FaultPlan()
+        .add("fleet.replica_step.0", "fail", times=None)
+        .add("fleet.replica_step.1", "fail", times=None))
+    fleet = _disagg(tiny_model, breaker_threshold=1)
+    fleet.submit(Request(rid=0, prompt=[1, 2, 3], max_new_tokens=4))
+    with pytest.raises(NoHealthyReplica, match=r"\[prefill: .*\[decode: "):
+        for _ in range(50):
+            fleet.step()
+    fi.clear_plan()
+
+
+def test_disagg_replay_accounting_zero_loss_under_chaos(tiny_model):
+    """fleet_replay over a tiered fleet with migrate-site chaos: zero
+    lost, zero duplicated, migration fields surfaced in the stats."""
+    fi.install_plan(fi.FaultPlan().add("fleet.kv_migrate.*", "fail",
+                                       times=2))
+    fleet = _disagg(tiny_model)
+    reqs = [Request(rid=i, prompt=list(p), max_new_tokens=8,
+                    arrival_time=0.01 * i)
+            for i, p in enumerate(_PROMPTS)]
+    stats = fleet_replay(fleet, reqs, max_wall_s=120)
+    fi.clear_plan()
+    assert stats["lost"] == 0 and stats["duplicated"] == 0
+    assert stats["migration_failures"] == 0
+    assert stats["migration_fallbacks"] >= 1
+    assert stats["migrations"] >= 1
+    assert stats["completed"] == len(_PROMPTS)

@@ -988,3 +988,112 @@ def test_sep_ep_dims_change_not_compared(tmp_path):
     rc, out, err = _run(a, b)
     assert rc == 0, (out, err)
     assert "workload changed" in out and "sep_ep_dims" in out
+
+
+# ---------------------------------------------------------------------------
+# round 21: disaggregated prefill/decode A/B gates
+# ---------------------------------------------------------------------------
+
+def _with_disagg(burst_ttft=22.0, disagg_tpot=4.2, hit_rate=0.5,
+                 improvement=1.6, failures=0, prefill=2, flops=2.0e11):
+    """Capture whose fleet config carries the round-21 disaggregated-vs-
+    monolithic A/B fields bench.py emits alongside the swap/kill run."""
+    c = _with_fleet(flops=flops)
+    c["detail"]["fleet"].update({
+        "p99_ttft_burst_ms": burst_ttft,
+        "disagg_p99_tpot_ms": disagg_tpot,
+        "fleet_prefix_hit_rate": hit_rate,
+        "ttft_burst_improvement": improvement,
+        "migration_failures": failures,
+        "migrations": 12, "migration_fallbacks": 1,
+        "migration_cost_per_page_ms": 0.4,
+        "disagg_dims": {"prefill_replicas": prefill, "decode_replicas": 2,
+                        "kv_dtype": "int8", "burst_requests": 16},
+    })
+    return c
+
+
+def test_disagg_burst_ttft_regression_fails(tmp_path):
+    # the headline win: p99 TTFT under burst is a TIME_FIELD — growing
+    # +36% unexplained on the same disagg_dims means the prefill tier
+    # stopped absorbing bursts
+    a = _write(tmp_path, "a.json", _with_disagg(burst_ttft=22.0))
+    b = _write(tmp_path, "b.json", _with_disagg(burst_ttft=30.0))
+    rc, out, err = _run(a, b)
+    assert rc == 1, (out, err)
+    assert "p99_ttft_burst_ms" in out and "UNEXPLAINED" in out
+
+
+def test_disagg_burst_ttft_improvement_passes(tmp_path):
+    # time polarity inverted: faster burst TTFT is progress
+    a = _write(tmp_path, "a.json", _with_disagg(burst_ttft=30.0))
+    b = _write(tmp_path, "b.json", _with_disagg(burst_ttft=22.0))
+    rc, out, err = _run(a, b)
+    assert rc == 0, (out, err)
+
+
+def test_disagg_decode_tpot_regression_fails(tmp_path):
+    # "TPOT held" is the other half of the trade: the decode tier's p99
+    # inter-token interval regressing past tol fails even when TTFT shines
+    a = _write(tmp_path, "a.json", _with_disagg(disagg_tpot=4.2))
+    b = _write(tmp_path, "b.json", _with_disagg(disagg_tpot=5.6))
+    rc, out, err = _run(a, b)
+    assert rc == 1, (out, err)
+    assert "disagg_p99_tpot_ms" in out and "UNEXPLAINED" in out
+
+
+def test_fleet_prefix_hit_rate_drop_fails(tmp_path):
+    # fleet-global hit rate is larger-is-better: falling from 0.5 to 0.3
+    # on the same disagg_dims means the digest→owner router un-matched
+    a = _write(tmp_path, "a.json", _with_disagg(hit_rate=0.5))
+    b = _write(tmp_path, "b.json", _with_disagg(hit_rate=0.3))
+    rc, out, err = _run(a, b)
+    assert rc == 1, (out, err)
+    assert "fleet_prefix_hit_rate" in out and "throughput regression" in out
+
+
+def test_fleet_prefix_hit_rate_rise_passes(tmp_path):
+    a = _write(tmp_path, "a.json", _with_disagg(hit_rate=0.4))
+    b = _write(tmp_path, "b.json", _with_disagg(hit_rate=0.6))
+    rc, out, err = _run(a, b)
+    assert rc == 0, (out, err)
+
+
+def test_disagg_ttft_improvement_ratio_drop_fails(tmp_path):
+    # mono-p99/disagg-p99 under burst is the A/B's headline ratio —
+    # larger is better; sliding toward 1.0 means disaggregation stopped
+    # paying for its extra moving parts
+    a = _write(tmp_path, "a.json", _with_disagg(improvement=1.6))
+    b = _write(tmp_path, "b.json", _with_disagg(improvement=1.1))
+    rc, out, err = _run(a, b)
+    assert rc == 1, (out, err)
+    assert "ttft_burst_improvement" in out and "throughput regression" in out
+
+
+def test_migration_failures_zero_gate_fails_on_any(tmp_path):
+    # ABSOLUTE zero-gate, not a tolerance comparison: one migration that
+    # neither completed nor fell back cleanly fails the gate outright
+    a = _write(tmp_path, "a.json", _with_disagg(failures=0))
+    b = _write(tmp_path, "b.json", _with_disagg(failures=1))
+    rc, out, err = _run(a, b)
+    assert rc == 1, (out, err)
+    assert "migration_failures" in out and "integrity" in out
+
+
+def test_migration_failures_zero_passes_even_from_dirty_baseline(tmp_path):
+    # the gate reads the NEW side only: a once-dirty baseline never
+    # grandfathers failures in, and a clean new capture always passes
+    a = _write(tmp_path, "a.json", _with_disagg(failures=3))
+    b = _write(tmp_path, "b.json", _with_disagg(failures=0))
+    rc, out, err = _run(a, b)
+    assert rc == 0, (out, err)
+
+
+def test_disagg_dims_change_not_compared(tmp_path):
+    # a different tier split / burst shape is a different problem
+    a = _write(tmp_path, "a.json", _with_disagg(burst_ttft=22.0, prefill=2))
+    b = _write(tmp_path, "b.json", _with_disagg(burst_ttft=40.0, hit_rate=0.2,
+                                                improvement=1.0, prefill=3))
+    rc, out, err = _run(a, b)
+    assert rc == 0, (out, err)
+    assert "workload changed" in out and "disagg_dims" in out

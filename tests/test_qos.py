@@ -756,6 +756,39 @@ def test_fleet_shares_one_policy_across_replicas(tiny_model):
     assert fleet.shed_total == 1
 
 
+def test_degraded_fleet_floors_brownout_pressure(tiny_model):
+    """Round 21: a tiered fleet knocked off its disaggregated rung (decode
+    tier dead -> monolithic) marks the shared QoS policy degraded, which
+    FLOORS the pressure reading at degraded_pressure_floor — the brownout
+    ladder escalates on an otherwise idle half-fleet instead of waiting
+    for its queues to back up. Recovery (revive -> re-split) clears it."""
+    qos = QoSPolicy(QoSConfig(brownout=BrownoutConfig(
+        enter_pressure=0.8, exit_pressure=0.5, cooldown_s=0.0,
+        degraded_pressure_floor=0.9)))
+    assert qos.pressure(0.0, 0.0) == 0.0        # floor off while split
+    fi.install_plan(fi.FaultPlan().add("fleet.replica_step.1", "fail",
+                                       times=None))
+    fleet = ReplicaFleet([_engine(tiny_model), _engine(tiny_model)],
+                         tiers=["prefill", "decode"], breaker_threshold=1,
+                         qos=qos)
+    try:
+        out = fleet.generate([[1, 2, 3, 4]], max_new_tokens=4)
+    finally:
+        fi.clear_plan()
+    assert out == [_greedy_oracle(tiny_model, [1, 2, 3, 4], 4)]
+    assert fleet.mode() == "monolithic"
+    assert qos.degraded
+    assert qos.pressure(0.0, 0.0) == 0.9        # floored while degraded
+    # the prefill replica's ticks fed the floored reading into the ladder
+    assert qos.brownout.step >= 1
+    fleet.revive(1)
+    assert fleet.mode() == "disaggregated"
+    assert not qos.degraded                     # re-split clears the floor
+    assert qos.pressure(0.0, 0.0) == 0.0
+    with pytest.raises(ValueError):
+        BrownoutConfig(degraded_pressure_floor=1.5)
+
+
 # ---------------------------------------------------------------------------
 # predictor wiring
 # ---------------------------------------------------------------------------

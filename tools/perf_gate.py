@@ -110,6 +110,9 @@ SHAPE_FIELDS = (
     # ep degrees) — a different mesh is a different problem, not a
     # regression
     "sep_ep_dims",
+    # round 21: the disaggregated A/B's tier split + burst shape + chaos
+    # schedule — a different tiering is a different problem
+    "disagg_dims",
 )
 # larger-is-worse regression metrics per config record; the names match
 # what bench.py actually emits per config (ernie/llama/resnet report
@@ -131,6 +134,11 @@ TIME_FIELDS = (
     # either growing past tol with flat qos_dims means priority
     # admission/preemption stopped shielding the top class
     "p99_tpot_gold_ms", "gold_p99_vs_uncontended",
+    # round 21: p99 TTFT under burst arrivals on the disaggregated fleet,
+    # and the decode tier's p99 TPOT — the disaggregation trade is "TTFT
+    # improves, TPOT held"; either growing past tol with flat disagg_dims
+    # means the prefill/decode split stopped paying for itself
+    "p99_ttft_burst_ms", "disagg_p99_tpot_ms",
 )
 # larger-is-BETTER metrics: a drop beyond tolerance with flat attributed
 # work is the same unexplained-regression signal inverted (serving
@@ -158,7 +166,13 @@ THROUGHPUT_FIELDS = ("tokens_per_sec", "samples_per_sec",
                      # per-tenant service in the QoS overload replay —
                      # falling with flat qos_dims means weighted-fair
                      # dequeue stopped holding under pressure
-                     "fairness_index")
+                     "fairness_index",
+                     # round 21: fleet-global prefix hit rate (must stay
+                     # at/above the replica-local rate — the digest→owner
+                     # router un-matching is invisible to time fields on a
+                     # small probe) and the monolithic/disaggregated p99
+                     # TTFT ratio under burst (the headline win)
+                     "fleet_prefix_hit_rate", "ttft_burst_improvement")
 ATTR_WORK_FIELDS = ("flops", "hbm_bytes")
 ATTR_MEM_FIELDS = ("program_memory_bytes", "peak_hbm_bytes")
 # round 16: breakdown-sum-vs-measured-wall tolerance (matches the 5%
@@ -511,6 +525,17 @@ def compare_config(key: str, old: dict, new: dict, tol: float):
                 f"allowed +{tol * max(od_, 0.01):.4f})"
             )
             verdict = "regress"
+    # round 21: migration integrity is an absolute zero-gate, not a
+    # tolerance comparison — ONE migration that neither completed nor
+    # fell back cleanly means a request could have decoded from a torn
+    # page, and no baseline drift ever excuses that
+    mf = new.get("migration_failures")
+    if isinstance(mf, (int, float)) and mf > 0:
+        lines.append(
+            f"{key}: migration_failures {mf:g} — KV handoff integrity "
+            f"violation (must be exactly 0)"
+        )
+        verdict = "regress"
     if not lines:
         lines.append(f"{key}: ok")
     return verdict, lines
