@@ -1067,6 +1067,14 @@ def _build_fleet():
 
     ck_root = tempfile.mkdtemp(prefix="bench_fleet_swap_")
     per_n = {}
+    # round 22: the whole fleet capture runs with the incident timeline on —
+    # every FaultPlan injection below (replica kills, migrate-site faults)
+    # must surface as a causally-matched timeline event, and the resulting
+    # unobserved_faults / dropped counts are perf-gated to exactly zero
+    from paddle_tpu.telemetry import timeline as _tl
+
+    _tl.reset()
+    paddle.set_flags({"FLAGS_incident_timeline": True})
     try:
         _ckpt.save_state_dict({"model": model.state_dict()}, ck_root, step=1)
         widest = max(d["replicas"])
@@ -1298,8 +1306,16 @@ def _build_fleet():
             "burst_gap_s": d["burst_gap_s"],
             "prefix_pages": d["prefix_pages"],
         }
+        # chaos observability coverage over EVERY injection this capture
+        # made (replica kills in the widest swap run, migrate faults and
+        # the decode kill in the chaos disagg run) — zero-gated
+        cov = _tl.chaos_coverage()
+        res["chaos_faults_injected"] = cov["injected"]
+        res["unobserved_faults"] = cov["unobserved_faults"]
+        res["timeline_dropped_events"] = _tl.recorder().dropped
         return res
     finally:
+        paddle.set_flags({"FLAGS_incident_timeline": False})
         shutil.rmtree(ck_root, ignore_errors=True)
 
 

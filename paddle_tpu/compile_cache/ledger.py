@@ -43,6 +43,7 @@ from collections import deque
 from typing import List, Optional
 
 from .. import telemetry as _tm
+from ..telemetry import timeline as _tl
 
 __all__ = [
     "record",
@@ -115,11 +116,19 @@ def record(
     instrumented hot path in this repo follows). Never raises: a telemetry
     schema clash must not break a compile path. Returns the event dict
     (None when disabled or for counter-only hits)."""
-    if not _tm.enabled():
-        return None
     if outcome not in OUTCOMES:
         outcome = "error"
     seconds = float(seconds or 0.0)
+    if outcome != "hit":
+        # the incident timeline sees compile-lifecycle transitions even
+        # with the metrics registry off (independent gates); per-dispatch
+        # hits stay counter-only — they would flood any event stream
+        _tl.emit("compile", f"compile.{outcome}",
+                 severity="warn" if outcome == "error" else "info",
+                 origin=str(origin), name=str(name),
+                 seconds=round(seconds, 6))
+    if not _tm.enabled():
+        return None
     try:
         _counters(origin, outcome, seconds)
     except Exception:

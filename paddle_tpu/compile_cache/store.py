@@ -70,12 +70,23 @@ def _err_counter(reason: str):
     ).labels(reason=reason)
 
 
+_READ_REASONS = ("torn_entry", "bad_meta", "topology_mismatch",
+                 "crc_mismatch", "read_failed")
+
+
 def _count_error(reason: str) -> None:
     if _tm.enabled():
         try:
             _err_counter(reason).inc()
         except Exception:
             pass
+    from ..telemetry import timeline as _tl
+
+    # read-path rejections carry the chaos site label so an injected
+    # compile_cache.read corruption is matched to the error it caused
+    labels = ({"site": "compile_cache.read", "reason": reason}
+              if reason in _READ_REASONS else {"reason": reason})
+    _tl.emit("compile_cache", "store.error", severity="warn", labels=labels)
 
 
 def _crc32_bytes(data: bytes) -> int:

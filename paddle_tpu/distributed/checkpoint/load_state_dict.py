@@ -171,6 +171,21 @@ def select_checkpoint_dir(path):
             except CheckpointCorrupt as e:
                 reason = "torn" if COMPLETE_MARKER in str(e) else "corrupt"
                 _record_fallback(reason)
+                try:
+                    from ...telemetry import timeline as _tl
+
+                    # site label names the save-side fault family that
+                    # produces each rejection shape (torn = publish died,
+                    # corrupt = shard/metadata bytes flipped), so an
+                    # injected save corruption is chaos-coverage-matched by
+                    # the fallback it forces at load
+                    _tl.emit("checkpoint", "load.fallback", severity="warn",
+                             labels={"site": "ckpt.publish" if reason == "torn"
+                                     else "ckpt.write_shard",
+                                     "reason": reason},
+                             step_dir=os.path.basename(step_dir))
+                except Exception:
+                    pass
                 sys.stderr.write(
                     f"[paddle_tpu.checkpoint] skipping {os.path.basename(step_dir)}: "
                     f"{e}; falling back to the previous complete step\n"
@@ -280,4 +295,14 @@ def load_state_dict(state_dict, path, process_group=None, coordinator_rank=0):
     if missing:
         raise KeyError(f"tensors missing from checkpoint: {missing}")
     _record_reshard(tensors_resharded, cross_mesh)
+    try:
+        from ...telemetry import timeline as _tl
+
+        _tl.emit("checkpoint", "load.completed",
+                 severity="warn" if cross_mesh else "info",
+                 path=str(path), tensors=len(flat),
+                 resharded=int(tensors_resharded),
+                 cross_topology=bool(cross_mesh))
+    except Exception:
+        pass
     return state_dict

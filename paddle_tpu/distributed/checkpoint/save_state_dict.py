@@ -257,10 +257,25 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
             # overwrites that died between their two renames is superseded
             # now that a newer COMPLETE step exists
             prune_stale_old_steps(path)
-    except BaseException:
+    except BaseException as e:
         _record_save_metric("failed")
+        try:
+            from ...telemetry import timeline as _tl
+
+            _tl.emit("checkpoint", "save.failed", severity="error",
+                     step=int(step), path=str(path),
+                     error=type(e).__name__)
+        except Exception:
+            pass
         raise
     _record_save_metric("ok")
+    try:
+        from ...telemetry import timeline as _tl
+
+        _tl.emit("checkpoint", "save.published", step=int(step),
+                 path=str(step_dir), files=int(file_idx))
+    except Exception:
+        pass
     try:
         # guardian crash dumps default to a `crash/` dir NEXT TO the newest
         # checkpoint, so the flight recorder lands where the operator is

@@ -109,6 +109,25 @@ def flush_diagnostics() -> None:
     except Exception:
         pass  # diagnostics must never mask the abort
     try:
+        # the incident-timeline tail is the cross-subsystem event order
+        # leading up to the hang (injections, migrations, mode changes);
+        # tail() is NaN-lenient so the dump survives poisoned payloads
+        from ..telemetry import timeline as _tl
+
+        if _tl.enabled():
+            import json as _json
+
+            sys.stderr.write("--- incident timeline tail (jsonl) ---\n")
+            for rec in _tl.tail(256):
+                sys.stderr.write(_json.dumps(rec, sort_keys=True))
+                sys.stderr.write("\n")
+            if _tl.dropped():
+                sys.stderr.write(
+                    f"(+{_tl.dropped()} older event(s) ring-evicted)\n"
+                )
+    except Exception:
+        pass
+    try:
         sys.stderr.flush()
     except Exception:
         pass
@@ -120,6 +139,14 @@ def _default_abort(task: CommTask) -> None:
 
 def _default_handler(task: CommTask, dump: str) -> None:
     """Hard-deadline stages of the escalation ladder: dump, then abort."""
+    try:
+        from ..telemetry import timeline as _tl
+
+        _tl.emit("watchdog", "escalation", severity="fatal",
+                 op=task.op, elapsed_s=round(task.elapsed(), 3),
+                 timeout_s=task.timeout)
+    except Exception:
+        pass
     sys.stderr.write(
         f"\n=== paddle_tpu comm watchdog: HUNG COLLECTIVE DETECTED ===\n"
         f"{task.describe()}\n--- all in-flight comm tasks ---\n{dump}\n"
@@ -216,6 +243,14 @@ class CommTaskManager:
     def _warn(self, task: CommTask) -> None:
         task.warned = True
         _record_task_metric("paddle_tpu_comm_tasks_warned_total", task.op)
+        try:
+            from ..telemetry import timeline as _tl
+
+            _tl.emit("watchdog", "soft_deadline", severity="warn",
+                     op=task.op, elapsed_s=round(task.elapsed(), 3),
+                     timeout_s=task.timeout)
+        except Exception:
+            pass
         sys.stderr.write(
             f"[paddle_tpu comm watchdog] WARNING: {task.describe()} — past the "
             f"soft deadline (FLAGS_comm_watchdog_warn_s), will abort at "

@@ -167,6 +167,15 @@ class CollectiveController:
             "paddle_tpu_launch_elastic_restarts_total",
             "pod relaunches from elastic membership changes",
         )
+        try:
+            from ...telemetry import timeline as _tl
+
+            _tl.emit("elastic", "restart_plan", severity="warn",
+                     nodes=len(nodes), node_rank=int(args.node_rank),
+                     prev_world=int(prev_world), new_world=int(new_world),
+                     plan=dict(plan) if isinstance(plan, dict) else plan)
+        except Exception:
+            pass
         self.pod.stop(force=True)
         self._apply_restart_backoff()
         self.pod = Pod()

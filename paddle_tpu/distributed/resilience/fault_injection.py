@@ -182,14 +182,22 @@ def current_plan() -> Optional[FaultPlan]:
     return _active
 
 
-def _record(site: str, action: str) -> None:
+def _record(site: str, action: str, plan: Optional[FaultPlan] = None) -> None:
     from ... import telemetry as _tm
+    from ...telemetry import timeline as _tl
 
     if _tm.enabled():
         _tm.counter(
             "paddle_tpu_faults_injected_total",
             "faults triggered by the active FaultPlan", ("site", "action"),
         ).labels(site=site, action=action).inc()
+    # the chaos-coverage anchor: every claim lands on the incident timeline
+    # with its concrete site + seed, and the gate demands a later observed
+    # event with the SAME site label (timeline.chaos_coverage) — a fault no
+    # handler surfaced is an observability regression, not silence
+    _tl.emit("resilience", "fault.injected", severity="error",
+             labels={"site": site, "action": action},
+             seed=plan.seed if plan is not None else None)
 
 
 def fault_point(site: str, **ctx) -> None:
@@ -201,7 +209,7 @@ def fault_point(site: str, **ctx) -> None:
     spec = plan._claim(site, (FaultAction.FAIL, FaultAction.DELAY))
     if spec is None:
         return
-    _record(site, spec.action)
+    _record(site, spec.action, plan)
     if spec.action == FaultAction.DELAY:
         time.sleep(spec.arg or 0.01)
         return
@@ -223,7 +231,7 @@ def corrupt_value(site: str) -> Optional[FaultSpec]:
         return None
     spec = plan._claim(site, (FaultAction.CORRUPT,))
     if spec is not None:
-        _record(site, FaultAction.CORRUPT)
+        _record(site, FaultAction.CORRUPT, plan)
     return spec
 
 
@@ -238,7 +246,7 @@ def corrupt_file(site: str, path: str) -> bool:
     spec = plan._claim(site, (FaultAction.CORRUPT,))
     if spec is None:
         return False
-    _record(site, FaultAction.CORRUPT)
+    _record(site, FaultAction.CORRUPT, plan)
     size = os.path.getsize(path)
     if size == 0:
         return True

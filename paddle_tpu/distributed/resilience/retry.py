@@ -88,6 +88,8 @@ class RetryPolicy:
     def call(self, fn: Callable, *args, site: str = "unnamed", **kwargs):
         """Run `fn` until it returns, retrying transient errors with backoff
         until the attempt budget or the overall deadline runs out."""
+        from ...telemetry import timeline as _tl
+
         metrics = _retry_metrics(site)
         start = time.monotonic()
         last: Optional[BaseException] = None
@@ -104,9 +106,18 @@ class RetryPolicy:
                 break
             if metrics:
                 metrics[1].inc()
+            # site-labeled observation: an injected store/ckpt fault that a
+            # retry absorbed still SURFACES (chaos-coverage match key)
+            _tl.emit("resilience", "retry", severity="warn",
+                     labels={"site": site}, attempt=attempt + 1,
+                     delay_s=round(delay, 6), error=type(last).__name__)
             self.sleep(delay)
         if metrics:
             metrics[2].inc()
+        _tl.emit("resilience", "retry.giveup", severity="error",
+                 labels={"site": site}, attempts=attempt + 1,
+                 elapsed_s=round(time.monotonic() - start, 6),
+                 error=type(last).__name__ if last else None)
         raise RetryError(site, attempt + 1, time.monotonic() - start, last) from last
 
 

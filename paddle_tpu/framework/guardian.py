@@ -238,6 +238,17 @@ class FlightRecorder:
                 payload["telemetry"] = _tm.to_json_lines(strict=False).splitlines()
         except Exception:
             pass
+        # the incident-timeline tail rides every crash dump: the triage CLI
+        # reads it back with `report --crash-dump`. Same lenient discipline —
+        # tail() json-sanitizes so the dump survives a NaN payload field.
+        try:
+            from ..telemetry import timeline as _tl
+
+            if _tl.enabled():
+                payload["timeline"] = _tl.tail(256)
+                payload["timeline_dropped"] = _tl.dropped()
+        except Exception:
+            pass
         with open(path, "w") as f:
             json.dump(payload, f, indent=1, default=str)
             f.write("\n")
@@ -463,6 +474,14 @@ class DesyncDetector:
                     ).labels(unit=u["unit"], rank=str(r)).inc()
         if self.recorder is not None:
             self.recorder.record_event("desync", **report)
+        from ..telemetry import timeline as _tl
+
+        # the site label ties an injected bucket_bitflip drill to the
+        # desync it must produce (chaos-coverage match key)
+        _tl.emit("guardian", "desync", severity="fatal",
+                 labels={"site": "guardian.bucket_bitflip"},
+                 unit=report["unit"], ranks=list(report["ranks"]),
+                 step=report["step"])
         paths = dump_flight_recorders(reason="desync")
         if escalate:
             self._escalate(report, paths)
@@ -667,6 +686,9 @@ class TrainingGuardian:
         spec = _fi.corrupt_value("guardian.grad_nan")
         if spec is None or not grads:
             return
+        # remembered until the anomaly check fires, so the resulting
+        # anomaly event carries the injection's site label (chaos coverage)
+        self._injected_site = "guardian.grad_nan"
         g = grads[0]
         v = g._raw()
         flat = v.reshape(-1).astype(v.dtype)
@@ -706,6 +728,18 @@ class TrainingGuardian:
             # step that also went NaN should say so in the crash dump
             input_wait_s=_input_wait_delta(),
         )
+        try:
+            from ..telemetry import timeline as _tl
+
+            inj_site = getattr(self, "_injected_site", None)
+            self._injected_site = None
+            _tl.emit("guardian", "anomaly",
+                     severity="fatal" if policy == "raise" else "error",
+                     labels={"site": inj_site} if inj_site else None,
+                     anomaly=kind, policy=policy, step=step,
+                     loss=_loss_float(loss_raw), grad_norm=grad_norm)
+        except Exception:
+            pass
         if policy == "skip_step":
             self.skipped_steps += 1
             if _tm.enabled():
@@ -720,6 +754,8 @@ class TrainingGuardian:
             if not self._snapshots:
                 # nothing to restore yet — degrade to skip (recorded as such)
                 self.recorder.record_event("rollback_unavailable", step=step)
+                _tl.emit("guardian", "rollback_unavailable", severity="warn",
+                         step=step)
                 self.skipped_steps += 1
                 if self.scaler is not None and self.scaler.is_enable():
                     self.scaler.record_external_skip()
@@ -783,6 +819,7 @@ class TrainingGuardian:
         re-applied to the restored params.
         """
         from .. import telemetry as _tm
+        from ..telemetry import timeline as _tl
 
         snap = self._snapshots[-1]
         covered = {id(t): v for t, v in snap["entries"]}
@@ -801,6 +838,8 @@ class TrainingGuardian:
         self.recorder.record_event(
             "rollback", restored_step=snap["step"], rollback=self._rollback_count,
         )
+        _tl.emit("guardian", "rollback", severity="warn",
+                 restored_step=snap["step"], rollback=self._rollback_count)
         if _tm.enabled():
             _tm.counter(
                 "paddle_tpu_guardian_rollbacks_total",

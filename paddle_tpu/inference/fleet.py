@@ -93,6 +93,7 @@ import numpy as np
 from .. import telemetry
 from ..telemetry import metrics as _metrics
 from ..telemetry import request_trace as _rt
+from ..telemetry import timeline as _tl
 from ..distributed.resilience import fault_injection as _fi
 from . import kv_cache as _kvc
 from .kv_cache import PoolExhausted, prefix_chain_keys
@@ -484,6 +485,11 @@ class ReplicaFleet:
         else:
             self._resplit = None
         _rt.record_event("fleet", "mode", t=self.clock(), mode=new, was=prev)
+        # a ladder move is an incident-grade transition either way:
+        # degradation explains a tail, recovery closes the incident
+        _tl.emit("fleet", "mode",
+                 severity="warn" if new != "disaggregated" else "info",
+                 mode=new, was=prev)
         if telemetry.enabled():
             for m in FLEET_MODES:
                 _mode_gauge(m).set(1 if m == self._mode else 0)
@@ -503,6 +509,7 @@ class ReplicaFleet:
         rep.engine.pool.invalidate_prefix()
         _rt.record_event("fleet", "replica_revived", t=self.clock(),
                          replica=idx)
+        _tl.emit("fleet", "replica.revived", replica=idx)
         self._update_mode()
         if telemetry.enabled():
             self._sync_gauges()
@@ -614,7 +621,15 @@ class ReplicaFleet:
         # would sit in _pending forever and the outcome="expired" counter
         # contract would silently stop holding on a dead fleet
         self._expire_pending(self.clock())
-        rep = self._route(req)  # a chaos raise leaves the request unstamped
+        try:
+            rep = self._route(req)  # a chaos raise leaves the request unstamped
+        except _fi.FaultInjected as e:
+            # the injected routing failure SURFACES before it propagates:
+            # the site-labeled observation the chaos-coverage gate matches
+            # against (the caller still owns the request and may retry)
+            _tl.emit("fleet", "route.fault", severity="error",
+                     labels={"site": e.site}, rid=req.rid, mode=self._mode)
+            raise
         if rep is None:
             # held at the fleet: the TTL clock starts NOW — acceptance —
             # since no scheduler will stamp it until it routes
@@ -668,6 +683,9 @@ class ReplicaFleet:
                             preemptions=req.preemptions, **extra)
         if telemetry.enabled():
             _req_counter().labels(event=outcome, reason=reason).inc()
+        if outcome != "completed":
+            _tl.emit("scheduler", "request.finish", severity="warn",
+                     rid=req.rid, outcome=outcome, reason=reason, held=True)
 
     def _expire_pending(self, now: float) -> None:
         """TTL sweep over requests HELD at the fleet — a deadline must
@@ -844,14 +862,19 @@ class ReplicaFleet:
                     continue
                 try:
                     self._migrate_request(src, dst, req)
-                except _fi.FaultInjected:
+                except _fi.FaultInjected as e:
+                    # e.site is the concrete injected site — the coverage
+                    # gate's match key for the in-flight handoff abort
+                    _tl.emit("fleet", "migrate.fallback", severity="warn",
+                             labels={"site": e.site}, rid=req.rid,
+                             src=src.idx, dst=dst.idx, why="fault")
                     self._migration_fallback(src, req, "fault")
                 except ValueError:
                     # lossy-direction conversion (int8 source → f32
                     # decode): the pages cannot move losslessly, so the
                     # request recomputes on the decode side instead
                     self._migration_fallback(src, req, "lossy")
-                except Exception:
+                except Exception as e:
                     # the invariant the chaos tests pin: an UNEXPECTED
                     # migration error still never loses the request —
                     # it is accounted as a failure (perf_gate gates this
@@ -859,6 +882,12 @@ class ReplicaFleet:
                     self.migration_failures += 1
                     if telemetry.enabled():
                         _migration_counter("failed").inc()
+                    _tl.emit(
+                        "fleet", "migrate.failed", severity="error",
+                        labels={
+                            "site": f"fleet.kv_migrate.{src.idx}.{dst.idx}"
+                        },
+                        rid=req.rid, error=type(e).__name__)
                     self._migration_fallback(src, req, "error")
 
     def _migrate_request(self, src: _Replica, dst: _Replica,
@@ -896,6 +925,9 @@ class ReplicaFleet:
             self.migration_crc_rejects += 1
             if telemetry.enabled():
                 _migration_counter("fallback_crc").inc()
+            _tl.emit("fleet", "migrate.crc_reject", severity="error",
+                     labels={"site": site}, rid=req.rid, src=src.idx,
+                     dst=dst.idx, pages=len(req.pages))
             self._migration_fallback(src, req, "crc")
             return
         # ---- commit: single ownership transfer, no partial state ----
@@ -916,6 +948,8 @@ class ReplicaFleet:
         if telemetry.enabled():
             _migration_counter("completed").inc()
             src.sched._sync_gauges()
+        _tl.emit("fleet", "migrate.completed", labels={"site": site},
+                 rid=req.rid, src=src.idx, dst=dst.idx, pages=len(new_pages))
         if _rt.enabled() and _rt.sampled(req.rid):
             _rt.record_event("request", "kv_migrate", t=self.clock(),
                              rid=req.rid, src=src.idx, dst=dst.idx,
@@ -956,6 +990,12 @@ class ReplicaFleet:
         self.failures_total += 1
         if telemetry.enabled():
             _failure_counter(rep.idx, reason).inc()
+        # site matches the step chaos point, so an injected replica kill is
+        # causally tied to the failure it produced (coverage match key)
+        _tl.emit("fleet", "replica.failure", severity="error",
+                 labels={"site": f"fleet.replica_step.{rep.idx}"},
+                 replica=rep.idx, reason=reason,
+                 consecutive=rep.consecutive_failures)
         if rep.consecutive_failures >= self.breaker_threshold:
             self._kill(rep)
         elif rep.status == ReplicaStatus.HEALTHY:
@@ -969,6 +1009,10 @@ class ReplicaFleet:
         _rt.record_event("fleet", "replica_down", t=self.clock(),
                          replica=rep.idx,
                          failures=rep.consecutive_failures)
+        _tl.emit("fleet", "replica.down", severity="error",
+                 labels={"site": f"fleet.replica_step.{rep.idx}"},
+                 replica=rep.idx, tier=rep.tier,
+                 failures=rep.consecutive_failures)
         # break session affinity: homes on a dead replica re-route freely
         for s, idx in list(self._session_home.items()):
             if idx == rep.idx:
@@ -988,6 +1032,9 @@ class ReplicaFleet:
         self.evacuated_total += len(evacuated)
         if telemetry.enabled() and evacuated:
             _evac_counter().inc(len(evacuated))
+        if evacuated:
+            _tl.emit("fleet", "evacuation", severity="warn",
+                     replica=rep.idx, requests=len(evacuated))
         for req in evacuated:
             self._redispatch(req, reason="evacuated")
         # a dead replica can't finish its drain — hand the swap machine on
@@ -1079,10 +1126,13 @@ class ReplicaFleet:
                 self.swaps_completed += 1
                 _rt.record_span("fleet", "swap_rollout", self._swap_t0, now,
                                 swapped=sw["swapped"])
+                _tl.emit("fleet", "swap.completed", swapped=sw["swapped"])
                 if telemetry.enabled():
                     _swap_counter("completed").inc()
-            elif telemetry.enabled():
-                _swap_counter("aborted").inc()
+            else:
+                _tl.emit("fleet", "swap.aborted", severity="warn")
+                if telemetry.enabled():
+                    _swap_counter("aborted").inc()
             return
         rep = self.replicas[sw["active"]]
         # keep the drain target's waiting queue empty EVERY tick, not just
@@ -1106,6 +1156,8 @@ class ReplicaFleet:
                 rep.status = ReplicaStatus.HEALTHY
                 rep.draining_for_swap = False
                 self._swap = None
+                _tl.emit("fleet", "swap.failed", severity="error",
+                         replica=rep.idx)
                 if telemetry.enabled():
                     _swap_counter("failed").inc()
                 raise
@@ -1149,6 +1201,8 @@ class ReplicaFleet:
                     ) + "]"
                     for t, counts in self.tier_health().items()
                 )
+            _tl.emit("fleet", "no_healthy_replica", severity="fatal",
+                     held=len(self._pending))
             raise NoHealthyReplica(
                 f"{len(self._pending)} request(s) held with every replica "
                 f"down{detail}"

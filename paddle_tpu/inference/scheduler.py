@@ -77,6 +77,7 @@ import numpy as np
 from .. import telemetry
 from ..telemetry import metrics as _metrics
 from ..telemetry import request_trace as _rt
+from ..telemetry import timeline as _tl
 from .kv_cache import PoolExhausted, chain_extend, prefix_chain_keys
 from .qos import BROWNOUT_STEPS, QoSPolicy
 
@@ -443,6 +444,8 @@ class ContinuousBatchingScheduler:
         req.shed_reason = reason
         req.retry_after_s = retry_after
         self.shed_total += 1
+        _tl.emit("qos", "shed", severity="warn", rid=req.rid, reason=reason,
+                 priority=req.priority, retry_after_s=retry_after)
         if self.qos is not None:
             self.qos.note_shed(reason)
         self._finish(req, now, reason=reason)
@@ -484,6 +487,13 @@ class ContinuousBatchingScheduler:
             tpot = req.tpot()
             if tpot is not None:
                 _tpot_hist().observe(tpot)
+        # every terminal disposition lands on the incident timeline: the
+        # completed ones are the denominator, the shed/expired/cancelled
+        # ones are what an SLO-burn triage window needs to see
+        _tl.emit("scheduler", "request.finish",
+                 severity="info" if req.outcome == "completed" else "warn",
+                 rid=req.rid, outcome=req.outcome, reason=reason,
+                 generated=len(req.generated), preemptions=req.preemptions)
 
     def cancel(self, rid: int) -> bool:
         """Client-side cancellation: drop the request wherever it is and
@@ -569,6 +579,8 @@ class ContinuousBatchingScheduler:
                 event="preempted",
                 reason="" if cause == "pool_dry" else cause,
             ).inc()
+        _tl.emit("scheduler", "preempt", severity="warn", rid=victim.rid,
+                 cause=cause, preemptions=victim.preemptions)
         return True
 
     def evacuate(self) -> List[Request]:
@@ -949,6 +961,11 @@ class ContinuousBatchingScheduler:
                 rung=BROWNOUT_STEPS[to_step],
                 pressure=round(qos.last_pressure, 4),
             )
+            _tl.emit("qos", "brownout",
+                     severity="warn" if direction == "up" else "info",
+                     direction=direction, step=to_step,
+                     rung=BROWNOUT_STEPS[to_step],
+                     pressure=round(qos.last_pressure, 4))
 
     def _step_inner(self) -> int:
         produced = 0

@@ -23,7 +23,7 @@ Traces without `clock_sync` metadata degrade to best-effort alignment
 CLI:
     python -m paddle_tpu.profiler.trace_merge -o merged.json \
         rank0.paddle_trace.json rank1.paddle_trace.json \
-        [--requests timeline.json] [--summary]
+        [--requests timeline.json] [--timeline incidents.json] [--summary]
 
 `--summary` prints the DistributedView communication table over the merged
 events (feeding profiler_statistic's existing builder).
@@ -208,6 +208,32 @@ def merge_request_lanes(merged: dict, req_trace: Union[str, dict]) -> dict:
     return merged
 
 
+def merge_timeline_lane(merged: dict, tl_trace: Union[str, dict]) -> dict:
+    """Interleave an incident-timeline chrome export
+    (`telemetry.timeline.dump_chrome_trace`, one instant-event lane at pid
+    90010) into an already-merged rank timeline. Timestamps shift onto the
+    merged wall clock via the export's clock_sync pair (derived from the
+    oldest retained record — every timeline record carries both clocks), or
+    pin to the merged origin when unsynced (same degradation contract as
+    rank and request lanes)."""
+    tr = load_trace(tl_trace)
+    origin = (merged.get("metadata") or {}).get("origin_unix_us", 0.0)
+    off = _trace_offset_us(tr, origin)
+    events = merged.setdefault("traceEvents", [])
+    n = 0
+    for e in tr.get("traceEvents", ()):
+        e2 = dict(e)
+        if "ts" in e2 and e2.get("ph") != "M":
+            e2["ts"] = e2["ts"] + off - origin
+            n += 1
+        events.append(e2)
+    events.sort(key=lambda e: e.get("ts", -1.0))
+    meta = merged.setdefault("metadata", {})
+    meta["timeline_lane"] = True
+    meta["timeline_event_count"] = n
+    return merged
+
+
 def to_statistic_data(merged: dict):
     """Rehydrate a merged trace into a StatisticData so the existing
     summary builders (DistributedView's communication table in particular)
@@ -252,6 +278,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
              "the rank lanes",
     )
     p.add_argument(
+        "--timeline", default=None, metavar="incidents.json",
+        help="incident-timeline chrome export (telemetry.timeline."
+             "dump_chrome_trace) merged as one instant-event lane so "
+             "fault injections / migrations / mode flips line up against "
+             "the rank and request lanes on the shared wall clock",
+    )
+    p.add_argument(
         "--summary", action="store_true",
         help="print the merged DistributedView communication table",
     )
@@ -262,6 +295,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     merged = merge_traces(args.traces, ranks=ranks)
     if args.requests:
         merged = merge_request_lanes(merged, args.requests)
+    if args.timeline:
+        merged = merge_timeline_lane(merged, args.timeline)
     with open(args.output, "w") as f:
         json.dump(merged, f)
     n = sum(1 for e in merged["traceEvents"] if e.get("ph") != "M")
@@ -269,6 +304,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         f", {merged['metadata'].get('request_lane_count', 0)} request lane(s)"
         if args.requests else ""
     )
+    if args.timeline:
+        req_note += (
+            f", {merged['metadata'].get('timeline_event_count', 0)} "
+            "incident event(s)"
+        )
     print(
         f"merged {len(args.traces)} trace(s) -> {args.output}: {n} events, "
         f"ranks {merged['metadata']['merged_ranks']}, "

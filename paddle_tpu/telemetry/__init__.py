@@ -32,6 +32,7 @@ from .exporters import (  # noqa: F401
     validate_snapshot,
 )
 from . import request_trace  # noqa: F401
+from . import timeline  # noqa: F401
 
 __all__ = [
     "Counter",
@@ -52,4 +53,5 @@ __all__ = [
     "start_metrics_server",
     "validate_snapshot",
     "request_trace",
+    "timeline",
 ]

@@ -243,26 +243,64 @@ def start_metrics_server(port: int = 0, host: str = "127.0.0.1",
                           same schema dump_snapshot writes — LENIENT mode,
                           because a scrape must never 500 on one NaN gauge
                           (the marker line carries the skip count instead)
+    GET /timeline.json -> bounded incident-timeline tail (?n=256, capped at
+                          the ring size) as `{"dropped", "clock_sync",
+                          "events"}` — the live-debug view of the unified
+                          incident timeline; `[]` events when the flag is off
+    GET /compile_cache.json -> compile-ledger events + summary (the
+                          dump_json doc shape, re-rendered per request)
 
     `port=0` binds an ephemeral port (read it back from the handle). The
     registry is re-rendered per request: a scraper always sees live values.
     """
     import http.server
+    import json as _json
     import socketserver
+    import urllib.parse
 
     reg = registry or default_registry()
 
     class _Handler(http.server.BaseHTTPRequestHandler):
         def do_GET(self):  # noqa: N802 (stdlib API name)
-            path = self.path.split("?", 1)[0]
+            path, _, query = self.path.partition("?")
             if path in ("/metrics", "/"):
                 body = to_prometheus(reg).encode()
                 ctype = "text/plain; version=0.0.4; charset=utf-8"
             elif path == "/metrics.json":
                 body = (to_json_lines(reg, strict=False) + "\n").encode()
                 ctype = "application/x-ndjson"
+            elif path == "/timeline.json":
+                from . import timeline as _tl
+
+                try:
+                    n = int(urllib.parse.parse_qs(query).get("n", ["256"])[0])
+                except (ValueError, IndexError):
+                    n = 256
+                rec = _tl.recorder()
+                doc = {
+                    "enabled": _tl.enabled(),
+                    "dropped": rec.dropped,
+                    "clock_sync": rec.clock_sync(),
+                    "events": rec.tail(max(1, min(n, 8192))),
+                }
+                body = (_json.dumps(doc, sort_keys=True) + "\n").encode()
+                ctype = "application/json"
+            elif path == "/compile_cache.json":
+                from ..compile_cache import ledger as _ledger
+
+                doc = {
+                    "events": _ledger.events(),
+                    "marks": _ledger.marks(),
+                    "spans": _ledger.spans(),
+                    "summary": _ledger.summary(),
+                }
+                body = (_json.dumps(doc, sort_keys=True, default=str)
+                        + "\n").encode()
+                ctype = "application/json"
             else:
-                self.send_error(404, "try /metrics or /metrics.json")
+                self.send_error(
+                    404, "try /metrics, /metrics.json, /timeline.json "
+                         "or /compile_cache.json")
                 return
             self.send_response(200)
             self.send_header("Content-Type", ctype)

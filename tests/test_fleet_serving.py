@@ -814,6 +814,40 @@ def test_tier_route_fault_site_raises_to_caller(tiny_model):
     assert len(fleet.finished) == 1
 
 
+def test_disagg_chaos_coverage_zero_unobserved(tiny_model):
+    """Incident-timeline coverage gate on the disagg fleet: every FaultPlan
+    injection (a tier_route probe and an in-flight kv_migrate corruption)
+    must be causally matched by a same-site timeline event — zero
+    unobserved faults, no orphans — and triage blames the injected cause
+    first. This is the fast-lane twin of the dryrun `disagg` scenario."""
+    from paddle_tpu.telemetry import timeline as tl
+
+    prev = paddle.get_flags("FLAGS_incident_timeline")["FLAGS_incident_timeline"]
+    paddle.set_flags({"FLAGS_incident_timeline": True})
+    tl.reset()
+    try:
+        fleet = _disagg(tiny_model, decode_dtype=None)
+        fi.install_plan(fi.FaultPlan().add("fleet.tier_route", "fail", times=1))
+        with pytest.raises(fi.FaultInjected):
+            fleet.submit(Request(rid=99, prompt=[1, 2], max_new_tokens=1))
+        fi.clear_plan()
+        fi.install_plan(fi.FaultPlan().add(
+            "fleet.kv_migrate.*", "corrupt", times=1, arg=5))
+        out = fleet.generate(_PROMPTS, max_new_tokens=10)
+        fi.clear_plan()
+        assert out == _oracle_all(tiny_model)
+        cov = tl.chaos_coverage()
+        assert cov["injected"] == 2
+        assert cov["observed"] == 2
+        assert cov["unobserved_faults"] == 0
+        assert cov["orphans"] == []
+        blame = tl.triage()["blame"]
+        assert blame and blame[0]["kind"] == "fault.injected"
+    finally:
+        paddle.set_flags({"FLAGS_incident_timeline": prev})
+        tl.reset()
+
+
 def test_decode_tier_death_degrades_to_monolithic(tiny_model):
     """Dead decode tier + live prefill tier = DEGRADED, not down: mode
     drops to monolithic, the prefill tier serves both phases, outputs

@@ -1097,3 +1097,55 @@ def test_disagg_dims_change_not_compared(tmp_path):
     rc, out, err = _run(a, b)
     assert rc == 0, (out, err)
     assert "workload changed" in out and "disagg_dims" in out
+
+
+# ---------------------------------------------------------------------------
+# round 22: chaos observability coverage + timeline eviction zero-gates
+# ---------------------------------------------------------------------------
+
+def _with_timeline(unobserved=0, dropped=0, injected=4):
+    """Capture whose fleet config carries the round-22 incident-timeline
+    coverage fields bench.py emits alongside the chaos runs."""
+    c = _with_disagg()
+    c["detail"]["fleet"].update({
+        "chaos_faults_injected": injected,
+        "unobserved_faults": unobserved,
+        "timeline_dropped_events": dropped,
+    })
+    return c
+
+
+def test_unobserved_faults_zero_gate_fails_on_any(tmp_path):
+    # ABSOLUTE zero-gate: one injection with no causally-matched timeline
+    # event means the failure-handling path went dark
+    a = _write(tmp_path, "a.json", _with_timeline(unobserved=0))
+    b = _write(tmp_path, "b.json", _with_timeline(unobserved=1))
+    rc, out, err = _run(a, b)
+    assert rc == 1, (out, err)
+    assert "unobserved_faults" in out and "must be exactly 0" in out
+
+
+def test_unobserved_faults_zero_passes_even_from_dirty_baseline(tmp_path):
+    # new-side-only, same as migration_failures: a dirty baseline never
+    # grandfathers dark injections in
+    a = _write(tmp_path, "a.json", _with_timeline(unobserved=2))
+    b = _write(tmp_path, "b.json", _with_timeline(unobserved=0))
+    rc, out, err = _run(a, b)
+    assert rc == 0, (out, err)
+
+
+def test_timeline_dropped_events_zero_gate_fails_on_any(tmp_path):
+    # ring evictions during a chaos capture may have dropped the very
+    # events the coverage match needed — also absolute zero
+    a = _write(tmp_path, "a.json", _with_timeline(dropped=0))
+    b = _write(tmp_path, "b.json", _with_timeline(dropped=7))
+    rc, out, err = _run(a, b)
+    assert rc == 1, (out, err)
+    assert "timeline_dropped_events" in out and "must be exactly 0" in out
+
+
+def test_timeline_dropped_events_zero_passes(tmp_path):
+    a = _write(tmp_path, "a.json", _with_timeline(dropped=3))
+    b = _write(tmp_path, "b.json", _with_timeline(dropped=0))
+    rc, out, err = _run(a, b)
+    assert rc == 0, (out, err)

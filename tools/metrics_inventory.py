@@ -1,0 +1,221 @@
+#!/usr/bin/env python
+"""Metrics inventory: keep the README metrics catalog honest.
+
+Scans the tree (paddle_tpu/ + bench.py) for registered telemetry
+metric-family names — any `counter(...)` / `gauge(...)` / `histogram(...)`
+call whose first argument is a `paddle_tpu_*` string literal, plus names
+forwarded through thin helper wrappers (`_launch_metric`,
+`_record_task_metric`, ...) and the synthetic marker families declared as
+`*_METRIC = "paddle_tpu_..."` constants — and diffs the result against the
+generated catalog table in README.md (between the
+`<!-- metrics-inventory:begin/end -->` markers).
+
+    python tools/metrics_inventory.py            # check; exit 1 on drift
+    python tools/metrics_inventory.py --write    # regenerate the table
+    python tools/metrics_inventory.py --list     # print the inventory
+
+A family registered in code but absent from the README fails CI: every
+metric an operator can scrape must be documented, in the same change that
+adds it.
+"""
+from __future__ import annotations
+
+import argparse
+import ast
+import os
+import re
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+README = os.path.join(ROOT, "README.md")
+SCAN = ["paddle_tpu", "bench.py"]
+PREFIX = "paddle_tpu_"
+BEGIN = "<!-- metrics-inventory:begin -->"
+END = "<!-- metrics-inventory:end -->"
+KINDS = ("counter", "gauge", "histogram")
+
+
+def _call_name(node: ast.Call) -> str:
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return ""
+
+
+def _const_str(node) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _first_help(node: ast.Call) -> str:
+    """The help/doc string of a registration call: the first constant-str
+    argument after the family name (concatenated literals included)."""
+    for arg in node.args[1:]:
+        s = _const_str(arg)
+        if s is not None:
+            return s
+        # "a" "b" implicit concatenation parses as a single Constant, but a
+        # ("a" + ...) or JoinedStr is not a literal we can recover — skip
+    return ""
+
+
+def scan_file(path: str, families: dict) -> None:
+    with open(path) as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return
+    rel = os.path.relpath(path, ROOT)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            fn = _call_name(node)
+            if fn in KINDS and node.args:
+                name = _const_str(node.args[0])
+                if name and name.startswith(PREFIX):
+                    _add(families, name, fn, _first_help(node), rel)
+            elif ("metric" in fn or "counter" in fn) and node.args:
+                # thin wrappers forwarding (name, doc) to counter()
+                name = _const_str(node.args[0])
+                if name and name.startswith(PREFIX):
+                    _add(families, name, "counter", _first_help(node), rel)
+        elif isinstance(node, ast.Assign):
+            # synthetic families: INVALID_SAMPLES_METRIC = "paddle_tpu_..."
+            name = _const_str(node.value)
+            if name and name.startswith(PREFIX) and any(
+                isinstance(t, ast.Name) and t.id.endswith("_METRIC")
+                for t in node.targets
+            ):
+                _add(families, name, "marker",
+                     "synthetic marker family (see source)", rel)
+
+
+def _add(families: dict, name: str, kind: str, help_: str, rel: str) -> None:
+    cur = families.get(name)
+    if cur is None:
+        families[name] = {"kind": kind, "help": help_, "where": rel}
+    else:
+        if not cur["help"] and help_:
+            cur["help"] = help_
+        # a name registered as non-marker anywhere is a real family
+        if cur["kind"] == "marker" and kind != "marker":
+            cur["kind"] = kind
+
+
+def scan_families(root: str = ROOT) -> dict:
+    families: dict = {}
+    for entry in SCAN:
+        p = os.path.join(root, entry)
+        if os.path.isfile(p):
+            scan_file(p, families)
+            continue
+        for dirpath, _dirnames, filenames in os.walk(p):
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    scan_file(os.path.join(dirpath, fn), families)
+    return families
+
+
+def render_table(families: dict) -> str:
+    lines = [
+        "| family | kind | registered in | help |",
+        "|---|---|---|---|",
+    ]
+    for name in sorted(families):
+        f = families[name]
+        help_ = " ".join(f["help"].split())
+        if len(help_) > 110:
+            help_ = help_[:107] + "..."
+        help_ = help_.replace("|", "\\|")
+        lines.append(
+            f"| `{name}` | {f['kind']} | `{f['where']}` | {help_} |"
+        )
+    return "\n".join(lines)
+
+
+def readme_families(readme_path: str = README) -> list | None:
+    """Family names listed in the generated README table, or None when the
+    marker block is missing entirely."""
+    with open(readme_path) as f:
+        text = f.read()
+    if BEGIN not in text or END not in text:
+        return None
+    block = text.split(BEGIN, 1)[1].split(END, 1)[0]
+    return re.findall(r"\|\s*`(paddle_tpu_[a-z0-9_]+)`", block)
+
+
+def write_readme(families: dict, readme_path: str = README) -> None:
+    with open(readme_path) as f:
+        text = f.read()
+    table = render_table(families)
+    if BEGIN in text and END in text:
+        head, rest = text.split(BEGIN, 1)
+        _old, tail = rest.split(END, 1)
+        text = f"{head}{BEGIN}\n{table}\n{END}{tail}"
+    else:
+        raise SystemExit(
+            f"README is missing the {BEGIN} / {END} markers — add a "
+            "'Metrics catalog' section with them first"
+        )
+    with open(readme_path, "w") as f:
+        f.write(text)
+
+
+def check(families: dict, readme_path: str = README) -> list:
+    """-> list of problem strings (empty = in sync)."""
+    listed = readme_families(readme_path)
+    if listed is None:
+        return [f"README has no {BEGIN} block — run --write after adding "
+                "the markers"]
+    listed_set = set(listed)
+    problems = []
+    for name in sorted(set(families) - listed_set):
+        problems.append(
+            f"metric family `{name}` (registered in "
+            f"{families[name]['where']}) is missing from the README "
+            "metrics catalog — run: python tools/metrics_inventory.py --write"
+        )
+    for name in sorted(listed_set - set(families)):
+        problems.append(
+            f"README metrics catalog lists `{name}` but no registration "
+            "was found in the tree — stale entry, run --write"
+        )
+    return problems
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python tools/metrics_inventory.py",
+        description="scan for registered metric families and check (or "
+                    "regenerate) the README metrics catalog",
+    )
+    p.add_argument("--write", action="store_true",
+                   help="regenerate the README table in place")
+    p.add_argument("--list", action="store_true",
+                   help="print the scanned inventory and exit")
+    args = p.parse_args(argv)
+    families = scan_families()
+    if args.list:
+        for name in sorted(families):
+            f = families[name]
+            print(f"{name}\t{f['kind']}\t{f['where']}")
+        print(f"({len(families)} families)", file=sys.stderr)
+        return 0
+    if args.write:
+        write_readme(families)
+        print(f"README metrics catalog regenerated: {len(families)} families")
+        return 0
+    problems = check(families)
+    for line in problems:
+        print(line, file=sys.stderr)
+    if problems:
+        return 1
+    print(f"metrics catalog in sync: {len(families)} families")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

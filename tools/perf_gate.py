@@ -536,6 +536,26 @@ def compare_config(key: str, old: dict, new: dict, tol: float):
             f"violation (must be exactly 0)"
         )
         verdict = "regress"
+    # round 22: chaos observability coverage is an absolute zero-gate —
+    # ONE injected fault with no causally-matched timeline event means the
+    # failure-handling path went dark, and a real incident on that path
+    # would be undebuggable. Same polarity for ring evictions: a chaos
+    # capture that dropped events may have dropped the matching ones.
+    uf = new.get("unobserved_faults")
+    if isinstance(uf, (int, float)) and uf > 0:
+        lines.append(
+            f"{key}: unobserved_faults {uf:g} — injected fault(s) left no "
+            f"matched incident-timeline event (must be exactly 0)"
+        )
+        verdict = "regress"
+    de = new.get("timeline_dropped_events")
+    if isinstance(de, (int, float)) and de > 0:
+        lines.append(
+            f"{key}: timeline_dropped_events {de:g} — incident-timeline "
+            f"ring evicted events during the capture (must be exactly 0; "
+            f"raise FLAGS_incident_timeline_ring)"
+        )
+        verdict = "regress"
     if not lines:
         lines.append(f"{key}: ok")
     return verdict, lines
